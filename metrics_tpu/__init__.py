@@ -1,0 +1,37 @@
+"""metrics_tpu — TPU-native machine-learning evaluation metrics.
+
+A ground-up JAX/XLA re-design of the TorchMetrics capability surface
+(reference: /root/reference, torchmetrics v0.9.0dev): ~90 metrics across
+classification, regression, retrieval, image, text, audio, detection,
+aggregation and pairwise domains, with a stateful ``Metric`` API whose state
+lives in device HBM as jax pytrees, pure jit-able update/compute reducers,
+and cross-device sync via XLA collectives over a ``jax.sharding.Mesh``.
+"""
+import logging
+
+from metrics_tpu.__about__ import __version__  # noqa: F401
+
+_logger = logging.getLogger("metrics_tpu")
+_logger.addHandler(logging.StreamHandler())
+_logger.setLevel(logging.INFO)
+
+from metrics_tpu.aggregation import (  # noqa: E402, F401
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    SumMetric,
+)
+from metrics_tpu.collections import MetricCollection  # noqa: E402, F401
+from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402, F401
+
+__all__ = [
+    "CatMetric",
+    "CompositionalMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "Metric",
+    "MetricCollection",
+    "MinMetric",
+    "SumMetric",
+]

@@ -1,0 +1,148 @@
+"""Aggregation metrics: running max/min/sum/cat/mean over a stream of values.
+
+Behavioral parity: /root/reference/torchmetrics/aggregation.py (402 LoC).
+NaN handling is expressed with jnp.where masks (jit-friendly) instead of
+boolean indexing where possible; the 'error'/'warn' strategies require
+concrete values and run eagerly like the reference.
+"""
+import warnings
+from typing import Any, Callable, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Base class for aggregation metrics (ref aggregation.py:24-98).
+
+    Args:
+        fn: named reduction for the ``value`` state.
+        default_value: initial state value (or empty list for ``cat``).
+        nan_strategy: 'error' | 'warn' | 'ignore' | float-impute.
+    """
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List, float],
+        nan_strategy: Union[str, float] = "error",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.add_state("value", default=default_value, dist_reduce_fx=fn)
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array]) -> Array:
+        """Cast to float array; apply the nan strategy (ref aggregation.py:72-92)."""
+        if not isinstance(x, jax.Array):
+            x = jnp.asarray(x, dtype=jnp.float32)
+        x = x.astype(jnp.float32) if not jnp.issubdtype(x.dtype, jnp.floating) else x
+
+        if isinstance(self.nan_strategy, str) and self.nan_strategy in ("error", "warn", "ignore"):
+            if not isinstance(x, jax.core.Tracer):
+                nans = jnp.isnan(x)
+                if bool(nans.any()):
+                    if self.nan_strategy == "error":
+                        raise RuntimeError("Encounted `nan` values in tensor")
+                    if self.nan_strategy == "warn":
+                        warnings.warn("Encounted `nan` values in tensor. Will be removed.", UserWarning)
+                    x = x[~nans]
+        else:
+            x = jnp.where(jnp.isnan(x), jnp.asarray(float(self.nan_strategy), dtype=x.dtype), x)
+        return x.astype(jnp.float32)
+
+    def update(self, value: Union[float, Array]) -> None:
+        """Overwrite in child class."""
+
+    def compute(self) -> Array:
+        return self.value
+
+
+class MaxMetric(BaseAggregator):
+    """Running maximum of all seen values (ref aggregation.py:101-157)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:  # make sure tensor not empty
+            self.value = jnp.maximum(self.value, jnp.max(value))
+
+
+class MinMetric(BaseAggregator):
+    """Running minimum of all seen values (ref aggregation.py:160-214)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = jnp.minimum(self.value, jnp.min(value))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum of all seen values (ref aggregation.py:217-270)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        self.value = self.value + value.sum()
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values (ref aggregation.py:273-324)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (ref aggregation.py:327-402)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        value = self._cast_and_nan_check_input(value)
+        weight = self._cast_and_nan_check_input(weight)
+        if value.size == 0:
+            return
+        weight = jnp.broadcast_to(weight, value.shape)
+        self.value = self.value + (value * weight).sum()
+        self.weight = self.weight + weight.sum()
+
+    def compute(self) -> Array:
+        return self.value / self.weight

@@ -1,0 +1,293 @@
+"""MetricCollection — chain metrics sharing a call pattern, with compute-group dedup.
+
+Behavioral parity: /root/reference/torchmetrics/collections.py (371 LoC).
+Compute groups merge metrics whose states are identical after the first
+update, so each group runs ``update`` only once per step (the reference's
+headline 2-3x optimization, collections.py:48-54). TPU note: dynamic group
+detection needs a host sync of state values (like the reference); declare
+groups explicitly via ``compute_groups=[[...]]`` to keep the step fully
+async on device.
+"""
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import _flatten_dict
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+class MetricCollection:
+    """Dict-like collection of metrics updated/computed together.
+
+    Args:
+        metrics: a single metric, a sequence (keys become class names), or a
+            dict of metrics.
+        prefix / postfix: strings added around every output key.
+        compute_groups: ``True`` (auto-detect), ``False`` (off), or an
+            explicit list of lists of metric names.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._modules: "OrderedDict[str, Metric]" = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked: bool = False
+        self._groups: Dict[int, List[str]] = {}
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    # --------------------------------------------------------------- mapping
+    def __getitem__(self, key: str) -> Metric:
+        return self._modules[key]
+
+    def __setitem__(self, key: str, value: Metric) -> None:
+        self._modules[key] = value
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._modules
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __iter__(self):
+        return iter(self._modules)
+
+    def __getattr__(self, name: str) -> Any:
+        modules = self.__dict__.get("_modules", {})
+        if name in modules:
+            return modules[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def values(self, copy_state: bool = True) -> Iterable[Metric]:
+        if copy_state:
+            self._compute_groups_create_state_ref(copy_state)
+        return self._modules.values()
+
+    # ----------------------------------------------------------------- calls
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Call forward on every metric; kwargs filtered per metric (ref :128-136)."""
+        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True)}
+        res = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    __call__ = forward
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each metric, or only group leaders once groups are formed (ref :138-157)."""
+        if self._groups_checked:
+            for _, cg in self._groups.items():
+                m0 = self._modules[cg[0]]
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
+        else:
+            for _, m in self.items(keep_base=True):
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+                self._groups_checked = True
+
+    def _merge_compute_groups(self) -> None:
+        """Merge groups whose leader states are equal (ref :159-192)."""
+        n_groups = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
+                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    metric1 = self._modules[cg_members1[0]]
+                    metric2 = self._modules[cg_members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        break
+                if len(self._groups) != n_groups:
+                    break
+            if len(self._groups) == n_groups:
+                break
+            n_groups = len(self._groups)
+
+        self._groups = {idx: values for idx, values in enumerate(deepcopy(self._groups).values())}
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """Device-side state equality between two metrics (ref :194-213)."""
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        for key in metric1._defaults:
+            state1 = getattr(metric1, key)
+            state2 = getattr(metric2, key)
+            if type(state1) != type(state2):
+                return False
+            if isinstance(state1, jax.Array) and isinstance(state2, jax.Array):
+                return state1.shape == state2.shape and bool(jnp.allclose(state1, state2))
+            if isinstance(state1, list) and isinstance(state2, list):
+                return len(state1) == len(state2) and all(
+                    s1.shape == s2.shape and bool(jnp.allclose(s1, s2)) for s1, s2 in zip(state1, state2)
+                )
+        return True
+
+    def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
+        """Copy leader state to other group members (ref :215-224)."""
+        if not (self._enable_compute_groups and self._groups_checked):
+            return
+        for _, cg in self._groups.items():
+            m0 = self._modules[cg[0]]
+            for i in range(1, len(cg)):
+                mi = self._modules[cg[i]]
+                for state in m0._defaults:
+                    value = getattr(m0, state)
+                    object.__setattr__(mi, state, list(value) if isinstance(value, list) else value)
+                mi._update_count = m0._update_count
+
+    def compute(self) -> Dict[str, Any]:
+        """Compute every metric, sharing leader state within groups (ref :215-227)."""
+        self._compute_groups_create_state_ref()
+        res = {k: m.compute() for k, m in self.items(keep_base=True)}
+        res = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def reset(self) -> None:
+        for _, m in self.items(keep_base=True):
+            m.reset()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for _, m in self.items(keep_base=True):
+            m.persistent(mode)
+
+    def state_dict(self, prefix: str = "") -> Dict[str, Any]:
+        destination: Dict[str, Any] = {}
+        for name, m in self.items(keep_base=True):
+            m.state_dict(destination, prefix=f"{prefix}{name}.")
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+        for name, m in self.items(keep_base=True):
+            m.load_state_dict(state_dict, prefix=f"{prefix}{name}.", strict=strict)
+
+    def to_device(self, device) -> "MetricCollection":
+        for _, m in self.items(keep_base=True):
+            m.to_device(device)
+        return self
+
+    def set_dtype(self, dst_type) -> "MetricCollection":
+        for _, m in self.items(keep_base=True):
+            m.set_dtype(dst_type)
+        return self
+
+    # --------------------------------------------------------------- adding
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        """Add metrics to the collection (ref :253-302)."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                rank_zero_warn(
+                    f"You have passed extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passed extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, Metric):
+                    raise ValueError(f"Value {metric} belonging to key {name} is not an instance of `Metric`")
+                self[name] = metric
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, Metric):
+                    raise ValueError(f"Input {metric} to `MetricCollection` is not an instance of `Metric`")
+                name = metric.__class__.__name__
+                if name in self:
+                    raise ValueError(f"Encountered two metrics both named {name}")
+                self[name] = metric
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+
+        self._groups_checked = False
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            self._groups = {}
+
+    def _init_compute_groups(self) -> None:
+        """Initialize groups: user-declared (static, no device sync) or singleton (ref :304-322)."""
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = {i: k for i, k in enumerate(self._enable_compute_groups)}
+            for v in self._groups.values():
+                for metric in v:
+                    if metric not in self:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the collection."
+                        )
+            self._groups_checked = True
+        else:
+            self._groups = {i: [str(k)] for i, k in enumerate(self.keys(keep_base=True))}
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        return self._groups
+
+    # ---------------------------------------------------------------- naming
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def _to_renamed_ordered_dict(self) -> OrderedDict:
+        od = OrderedDict()
+        for k, v in self._modules.items():
+            od[self._set_name(k)] = v
+        return od
+
+    def keys(self, keep_base: bool = False) -> Iterable[Hashable]:
+        if keep_base:
+            return self._modules.keys()
+        return self._to_renamed_ordered_dict().keys()
+
+    def items(self, keep_base: bool = False) -> Iterable[Tuple[str, Metric]]:
+        if keep_base:
+            return self._modules.items()
+        return self._to_renamed_ordered_dict().items()
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "(\n"
+        for k, v in self._modules.items():
+            repr_str += f"  ({k}): {v!r}\n"
+        if self.prefix:
+            repr_str += f"  prefix={self.prefix}\n"
+        if self.postfix:
+            repr_str += f"  postfix={self.postfix}\n"
+        return repr_str + ")"

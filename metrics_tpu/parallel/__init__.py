@@ -1,0 +1,8 @@
+from metrics_tpu.parallel.dist_env import (  # noqa: F401
+    AxisEnv,
+    DistEnv,
+    NoOpEnv,
+    ProcessEnv,
+    default_env,
+    gather_all_tensors,
+)

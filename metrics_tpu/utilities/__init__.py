@@ -1,0 +1,10 @@
+from metrics_tpu.utilities.data import (  # noqa: F401
+    apply_to_collection,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+from metrics_tpu.utilities.checks import _check_same_shape  # noqa: F401
+from metrics_tpu.utilities.prints import rank_zero_debug, rank_zero_info, rank_zero_warn  # noqa: F401

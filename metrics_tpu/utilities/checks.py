@@ -1,0 +1,386 @@
+"""Classification input validation and canonicalization.
+
+Behavioral parity: /root/reference/torchmetrics/utilities/checks.py
+(`_input_format_classification` :310-449 and its helpers). TPU-first design
+notes:
+
+* Layout decisions (binary / multi-label / multi-class / multi-dim
+  multi-class) are made from **static** information only — shapes, ndim and
+  dtypes — so the whole formatting pipeline traces cleanly under ``jax.jit``.
+* Value-dependent *validation* (targets non-negative, probabilities in
+  [0,1], labels < num_classes) runs only when inputs are concrete arrays;
+  under tracing it is skipped (XLA cannot branch on data).
+* Value-dependent *inference* of ``num_classes`` (from max label) likewise
+  only happens eagerly; inside jit the caller must pass ``num_classes``.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import select_topk, to_onehot
+from metrics_tpu.utilities.enums import DataType
+
+Array = jax.Array
+
+
+def _is_traced(*xs) -> bool:
+    return any(isinstance(x, jax.core.Tracer) for x in xs)
+
+
+def _is_floating(x: Array) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _check_for_empty_tensors(preds: Array, target: Array) -> bool:
+    return preds.size == 0 and target.size == 0
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Raise if predictions and target differ in shape (ref checks.py:29-32)."""
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, "
+            f"but got {preds.shape} and {target.shape}."
+        )
+
+
+def _basic_input_validation(
+    preds: Array, target: Array, threshold: float, multiclass: Optional[bool], ignore_index: Optional[int]
+) -> None:
+    """Value-level validation; skipped under jit tracing (ref checks.py:35-63)."""
+    if _check_for_empty_tensors(preds, target):
+        return
+
+    if _is_floating(target):
+        raise ValueError("The `target` has to be an integer tensor.")
+
+    if not preds.shape[0] == target.shape[0]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+
+    if _is_traced(preds, target):
+        return  # data-dependent checks impossible at trace time
+
+    if target.min() < 0 and (ignore_index is None or ignore_index >= 0):
+        raise ValueError("The `target` has to be a non-negative tensor.")
+
+    preds_float = _is_floating(preds)
+    if not preds_float and preds.min() < 0:
+        raise ValueError("If `preds` are integers, they have to be non-negative.")
+
+    if multiclass is False and target.max() > 1:
+        raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+
+    if multiclass is False and not preds_float and preds.max() > 1:
+        raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[DataType, int]:
+    """Infer the input case from static shape/dtype info (ref checks.py:65-118)."""
+    preds_float = _is_floating(preds)
+
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                f"The `preds` and `target` should have the same shape, got {preds.shape} and {target.shape}."
+            )
+        if preds_float and target.size > 0 and not _is_traced(target) and target.max() > 1:
+            raise ValueError(
+                "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+            )
+        if preds.ndim == 1 and preds_float:
+            case = DataType.BINARY
+        elif preds.ndim == 1 and not preds_float:
+            case = DataType.MULTICLASS
+        elif preds.ndim > 1 and preds_float:
+            case = DataType.MULTILABEL
+        else:
+            case = DataType.MULTIDIM_MULTICLASS
+        implied_classes = int(preds[0].size) if preds.size > 0 else 0
+
+    elif preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        implied_classes = preds.shape[1] if preds.size > 0 else 0
+        case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+
+    return case, implied_classes
+
+
+def _check_num_classes_binary(num_classes: int, multiclass: Optional[bool]) -> None:
+    """Parity: ref checks.py:120-135."""
+    if num_classes > 2:
+        raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+    if num_classes == 2 and not multiclass:
+        raise ValueError(
+            "Your data is binary and `num_classes=2`, but `multiclass` is not True."
+            " Set it to True if you want to transform binary data to multi-class format."
+        )
+    if num_classes == 1 and multiclass:
+        raise ValueError(
+            "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
+            " Either set `multiclass=None` (default) or set `num_classes=2`."
+        )
+
+
+def _check_num_classes_mc(
+    preds: Array, target: Array, num_classes: int, multiclass: Optional[bool], implied_classes: int
+) -> None:
+    """Parity: ref checks.py:138-166."""
+    if num_classes == 1 and multiclass is not False:
+        raise ValueError(
+            "You have set `num_classes=1`, but predictions are integers."
+            " If you want to convert (multi-dimensional) multi-class data with 2 classes"
+            " to binary/multi-label, set `multiclass=False`."
+        )
+    if num_classes > 1:
+        if multiclass is False and implied_classes != num_classes:
+            raise ValueError(
+                "You have set `multiclass=False`, but the implied number of classes"
+                " (from shape of inputs) does not match `num_classes`."
+            )
+        if target.size > 0 and not _is_traced(target) and num_classes <= target.max():
+            raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+        if preds.shape != target.shape and num_classes != implied_classes:
+            raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+
+
+def _check_num_classes_ml(num_classes: int, multiclass: Optional[bool], implied_classes: int) -> None:
+    """Parity: ref checks.py:169-180."""
+    if multiclass and num_classes != 2:
+        raise ValueError(
+            "You have set `multiclass=True`, but `num_classes` is not equal to 2."
+            " If you are trying to transform multi-label data to 2-class multi-dim"
+            " multi-class, you should set `num_classes` to either 2 or None."
+        )
+    if not multiclass and num_classes != implied_classes:
+        raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+
+
+def _check_top_k(top_k: int, case: DataType, implied_classes: int, multiclass: Optional[bool], preds_float: bool) -> None:
+    """Parity: ref checks.py:183-198."""
+    if case == DataType.BINARY:
+        raise ValueError("You can not use `top_k` parameter with binary data.")
+    if not isinstance(top_k, int) or top_k <= 0:
+        raise ValueError("The `top_k` has to be an integer larger than 0.")
+    if not preds_float:
+        raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+    if multiclass is False:
+        raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+    if case == DataType.MULTILABEL and multiclass:
+        raise ValueError(
+            "If you want to transform multi-label data to 2-class multi-dim"
+            " multi-class data using `multiclass=True`, you can not use `top_k`."
+        )
+    if top_k >= implied_classes:
+        raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+
+def _check_classification_inputs(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    top_k: Optional[int],
+    ignore_index: Optional[int] = None,
+) -> DataType:
+    """Full input validation; returns the detected case (ref checks.py:201-291)."""
+    _basic_input_validation(preds, target, threshold, multiclass, ignore_index)
+    case, implied_classes = _check_shape_and_type_consistency(preds, target)
+
+    if preds.shape != target.shape:
+        if multiclass is False and implied_classes != 2:
+            raise ValueError(
+                "You have set `multiclass=False`, but have more than 2 classes in your data,"
+                " based on the C dimension of `preds`."
+            )
+        if not _is_traced(target) and target.size > 0 and target.max() >= implied_classes:
+            raise ValueError(
+                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+            )
+
+    if num_classes:
+        if case == DataType.BINARY:
+            _check_num_classes_binary(num_classes, multiclass)
+        elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+            _check_num_classes_mc(preds, target, num_classes, multiclass, implied_classes)
+        elif case == DataType.MULTILABEL:
+            _check_num_classes_ml(num_classes, multiclass, implied_classes)
+
+    if top_k is not None:
+        _check_top_k(top_k, case, implied_classes, multiclass, _is_floating(preds))
+
+    return case
+
+
+def _input_squeeze(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Remove all size-1 dims except the batch dim (ref checks.py:294-303)."""
+    if preds.shape and preds.shape[0] == 1:
+        preds = jnp.expand_dims(jnp.squeeze(preds), 0)
+        target = jnp.expand_dims(jnp.squeeze(target), 0)
+    else:
+        preds, target = jnp.squeeze(preds), jnp.squeeze(target)
+    return preds, target
+
+
+def _input_format_classification(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, DataType]:
+    """Canonicalize any accepted classification layout to binary int tensors.
+
+    Output is ``(N, C)`` or ``(N, C, X)`` binary int32 tensors plus the
+    detected :class:`DataType`. Semantics follow the decision table of ref
+    checks.py:310-449. Under jit, ``num_classes`` must be given whenever a
+    one-hot expansion of integer labels is needed (the eager path infers it
+    from the data like the reference does).
+    """
+    preds, target = _input_squeeze(preds, target)
+    if preds.dtype == jnp.bfloat16 or preds.dtype == jnp.float16:
+        preds = preds.astype(jnp.float32)
+
+    case = _check_classification_inputs(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        top_k=top_k,
+        ignore_index=ignore_index,
+    )
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
+        preds = (preds >= threshold).astype(jnp.int32)
+        num_classes = num_classes if not multiclass else 2
+
+    if case == DataType.MULTILABEL and top_k:
+        preds = select_topk(preds, top_k)
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or multiclass:
+        if _is_floating(preds):
+            num_classes = preds.shape[1]
+            preds = select_topk(preds, top_k or 1)
+        else:
+            if num_classes is None:
+                if _is_traced(preds, target):
+                    raise ValueError(
+                        "`num_classes` must be given when formatting integer multi-class "
+                        "inputs under jit (cannot infer the class count from traced values)."
+                    )
+                num_classes = int(max(preds.max(), target.max())) + 1
+            preds = to_onehot(preds, max(2, num_classes))
+
+        target = to_onehot(target, max(2, int(num_classes)))
+
+        if multiclass is False:
+            preds, target = preds[:, 1, ...], target[:, 1, ...]
+
+    if not _check_for_empty_tensors(preds, target):
+        if (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and multiclass is not False) or multiclass:
+            target = target.reshape(target.shape[0], target.shape[1], -1)
+            preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+        else:
+            target = target.reshape(target.shape[0], -1)
+            preds = preds.reshape(preds.shape[0], -1)
+
+    if preds.ndim > 2 and preds.shape[-1] == 1:
+        preds, target = jnp.squeeze(preds, -1), jnp.squeeze(target, -1)
+
+    return preds.astype(jnp.int32), target.astype(jnp.int32), case
+
+
+def _input_format_classification_one_hot(
+    num_classes: int,
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+) -> Tuple[Array, Array]:
+    """Convert inputs to ``(C, N*...)`` one-hot layout (ref checks.py:452-498)."""
+    if preds.ndim == target.ndim + 1:
+        preds = jnp.argmax(preds, axis=1) if not multilabel else preds
+    if preds.ndim == target.ndim and jnp.issubdtype(preds.dtype, jnp.integer) and num_classes > 1 and not multilabel:
+        preds = to_onehot(preds, num_classes)
+        target = to_onehot(target, num_classes)
+    elif preds.ndim == target.ndim and _is_floating(preds):
+        preds = (preds >= threshold).astype(target.dtype)
+        if target.ndim == 1:
+            preds = to_onehot(preds, num_classes)
+            target = to_onehot(target, num_classes)
+    elif preds.ndim == target.ndim + 1 and _is_floating(preds):
+        preds = to_onehot(preds, num_classes)
+        target = to_onehot(target, num_classes)
+
+    preds = jnp.moveaxis(preds, 1, 0).reshape(num_classes, -1)
+    target = jnp.moveaxis(target, 1, 0).reshape(num_classes, -1)
+    return preds, target
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+) -> Tuple[Array, Array]:
+    """Validate retrieval functional inputs (ref checks.py:501-531)."""
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.size == 0 or preds.ndim != 1:
+        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+    return _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+
+
+def _check_retrieval_inputs(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """Validate retrieval module inputs (ref checks.py:534-579)."""
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of integers")
+    if ignore_index is not None:
+        valid = target != ignore_index
+        if not _is_traced(indexes, preds, target):
+            valid_np = jax.device_get(valid)
+            indexes = indexes[valid_np]
+            preds = preds[valid_np]
+            target = target[valid_np]
+    if indexes.size == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty")
+    preds, target = _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+    return indexes.reshape(-1).astype(jnp.int32), preds, target
+
+
+def _check_retrieval_target_and_prediction_types(
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool,
+) -> Tuple[Array, Array]:
+    """Parity: ref checks.py:582-607."""
+    if _is_floating(target) and not allow_non_binary_target:
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    if not _is_floating(preds):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not allow_non_binary_target and not _is_traced(target) and target.size and target.max() > 1:
+        raise ValueError("`target` must contain binary values")
+    dtype = jnp.float64 if jax.config.jax_enable_x64 and preds.dtype == jnp.float64 else jnp.float32
+    return preds.reshape(-1).astype(dtype), target.reshape(-1)

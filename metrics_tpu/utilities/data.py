@@ -1,0 +1,160 @@
+"""Tensor manipulation helpers and the dim-zero reductions.
+
+Parity: /root/reference/torchmetrics/utilities/data.py. The ``dim_zero_*``
+functions are the named distributed reductions a metric state can declare;
+after a cross-device gather the stacked ``(world, ...)`` tensor is collapsed
+with one of these. All are pure jnp ops, jit-safe.
+"""
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate a (list of) tensor(s) along dim 0 (ref data.py:22-27)."""
+    if isinstance(x, (list, tuple)):
+        if not x:
+            raise ValueError("No samples to concatenate")
+        x = [jnp.atleast_1d(v) for v in x]
+        return jnp.concatenate(x, axis=0)
+    return x
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten one level of nesting (ref data.py:59)."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: Dict) -> Dict:
+    """Flatten dict-of-dicts one level (ref data.py:63)."""
+    new_dict = {}
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                new_dict[k] = v
+        else:
+            new_dict[key] = value
+    return new_dict
+
+
+def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+    """Convert ``(N, ...)`` integer labels to one-hot ``(N, C, ...)``.
+
+    Parity: ref data.py:68-99. ``num_classes`` must be a static Python int
+    (XLA needs the output shape at trace time).
+    """
+    onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    # one_hot appends the class axis last; the reference layout puts it at dim 1.
+    return jnp.moveaxis(onehot, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the ``topk`` highest entries along ``dim``.
+
+    Parity: ref data.py:102-125 (incl. the k=1 argmax fast path).
+    """
+    if topk == 1:  # argmax fast path
+        idx = jnp.argmax(prob_tensor, axis=dim)
+        out = jax.nn.one_hot(idx, prob_tensor.shape[dim], dtype=jnp.int32)
+        return jnp.moveaxis(out, -1, dim)
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    onehots = jax.nn.one_hot(idx, moved.shape[-1], dtype=jnp.int32).sum(axis=-2)
+    return jnp.moveaxis(onehots, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/logits to class index along ``argmax_dim`` (ref data.py:128)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    wrong_dtype: Optional[Union[type, tuple]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all ``dtype`` leaves of a collection.
+
+    Parity: ref data.py:146-193. Kept for API parity; internally the framework
+    prefers ``jax.tree_util`` since metric states are registered pytrees.
+    """
+    elem_type = type(data)
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+    if isinstance(data, Mapping):
+        return elem_type(
+            {k: apply_to_collection(v, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for k, v in data.items()}
+        )
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return elem_type(*(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data))
+    if isinstance(data, Sequence) and not isinstance(data, str):
+        return elem_type([apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data])
+    return data
+
+
+def get_group_indexes(indexes: Array) -> List[Array]:
+    """Group row positions by query id — host-side helper for the retrieval API.
+
+    Parity: ref data.py:196-220 (a Python loop there too). The TPU compute
+    path in ``functional/retrieval`` avoids this entirely via sorted
+    segment reductions; this helper exists for API parity and host-side use.
+    """
+    indexes = np.asarray(indexes)
+    res: Dict[int, List[int]] = {}
+    for i, idx in enumerate(indexes.tolist()):
+        res.setdefault(idx, []).append(i)
+    return [jnp.asarray(x, dtype=jnp.int64 if jax.config.jax_enable_x64 else jnp.int32) for x in res.values()]
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    """Squeeze single-element tensors to scalars (ref data.py:224-228)."""
+
+    def _sq(x: Array) -> Array:
+        if isinstance(x, jax.Array) and x.size == 1:
+            return jnp.squeeze(x)
+        return x
+
+    return jax.tree_util.tree_map(_sq, data)
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Deterministic bincount with a static length.
+
+    Parity: ref data.py:231-251. Unlike torch, ``jnp.bincount`` with a static
+    ``length`` lowers to a scatter-add that XLA handles deterministically on
+    TPU — no slow-path loop needed. ``minlength`` must be static under jit.
+    """
+    return jnp.bincount(x.reshape(-1), length=minlength)
+
+
+def _cumsum(x: Array, axis: int = 0) -> Array:
+    return jnp.cumsum(x, axis=axis)
+
+
+def allclose(a: Array, b: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    if a.shape != b.shape:
+        return False
+    return bool(jnp.allclose(a, b, rtol=rtol, atol=atol))

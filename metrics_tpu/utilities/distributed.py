@@ -1,0 +1,48 @@
+"""Reduction helpers shared across metrics.
+
+Parity: /root/reference/torchmetrics/utilities/distributed.py (`reduce` :22,
+`class_reduce` :44-93). The cross-device gather itself
+(``gather_all_tensors`` in the reference) lives in
+:mod:`metrics_tpu.parallel` as the :class:`DistEnv` abstraction — on TPU it
+is a jitted ``jax.lax.all_gather``/``process_allgather`` over a device mesh
+rather than a torch.distributed call.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def reduce(x: Array, reduction: Optional[str]) -> Array:
+    """Reduce a tensor by 'elementwise_mean' | 'sum' | 'none' (ref :22-41)."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction is None or reduction == "none":
+        return x
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
+    """Per-class fraction reduction: micro/macro/weighted/none (ref :44-93).
+
+    ``num``/``denom`` are per-class numerators/denominators; ``weights`` are
+    per-class weights (usually support counts). 0/0 is treated as 0.
+    """
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    fraction = jnp.sum(num) / jnp.sum(denom) if class_reduction == "micro" else num / denom
+    # ignore 0/0 — set to 0
+    fraction = jnp.where(jnp.isnan(fraction), jnp.zeros_like(fraction), fraction)
+
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights / jnp.sum(weights)))
+    if class_reduction == "none" or class_reduction is None:
+        return fraction
+    raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")

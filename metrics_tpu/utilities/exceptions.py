@@ -1,0 +1,8 @@
+"""Framework exceptions.
+
+Parity: /root/reference/torchmetrics/utilities/exceptions.py
+"""
+
+
+class MetricsUserError(Exception):
+    """Error raised on misuse of the metrics API (double-sync, compute-before-update, ...)."""

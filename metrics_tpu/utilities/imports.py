@@ -1,0 +1,57 @@
+"""Dependency-availability flags gating optional metrics.
+
+Parity: /root/reference/torchmetrics/utilities/imports.py (:25-120). The
+reference's de-facto flag system: every optional metric's import surface is
+controlled by one of these booleans.
+"""
+import importlib.util
+from importlib.metadata import version as _pkg_version
+
+from packaging.version import Version
+
+
+def _package_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+def _module_available(path: str) -> bool:
+    head, *rest = path.split(".")
+    if not _package_available(head):
+        return False
+    try:
+        importlib.import_module(path)
+        return True
+    except Exception:
+        return False
+
+
+def _compare_version(package: str, op, ver: str) -> bool:
+    if not _package_available(package):
+        return False
+    try:
+        return op(Version(_pkg_version(package)), Version(ver))
+    except Exception:
+        return False
+
+
+_JAX_AVAILABLE = _package_available("jax")
+_FLAX_AVAILABLE = _package_available("flax")
+_OPTAX_AVAILABLE = _package_available("optax")
+_ORBAX_AVAILABLE = _package_available("orbax")
+_CHEX_AVAILABLE = _package_available("chex")
+
+_SCIPY_AVAILABLE = _package_available("scipy")
+_SKLEARN_AVAILABLE = _package_available("sklearn")
+_NLTK_AVAILABLE = _package_available("nltk")
+_REGEX_AVAILABLE = _package_available("regex")
+_TRANSFORMERS_AVAILABLE = _package_available("transformers")
+_PESQ_AVAILABLE = _package_available("pesq")
+_PYSTOI_AVAILABLE = _package_available("pystoi")
+_ROUGE_SCORE_AVAILABLE = _package_available("rouge_score")
+_SACREBLEU_AVAILABLE = _package_available("sacrebleu")
+_JIWER_AVAILABLE = _package_available("jiwer")
+_MECAB_AVAILABLE = _package_available("MeCab")
+_PYCOCOTOOLS_AVAILABLE = _package_available("pycocotools")

@@ -1,0 +1,79 @@
+"""Aggregation metric tests (translation of ref tests/bases/test_aggregation.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+def test_max():
+    m = MaxMetric()
+    m.update(jnp.asarray([1.0, 5.0, 3.0]))
+    m.update(jnp.asarray(2.0))
+    assert np.asarray(m.compute()) == 5.0
+
+
+def test_min():
+    m = MinMetric()
+    m.update(jnp.asarray([1.0, 5.0, 3.0]))
+    m.update(jnp.asarray(-2.0))
+    assert np.asarray(m.compute()) == -2.0
+
+
+def test_sum():
+    m = SumMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(3.0)
+    assert np.asarray(m.compute()) == 6.0
+
+
+def test_cat():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    assert np.allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+@pytest.mark.parametrize("weights,expected", [(1.0, 2.0), (jnp.asarray([1.0, 2.0, 3.0]), 14.0 / 6)])
+def test_mean(weights, expected):
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 2.0, 3.0]), weights)
+    assert np.allclose(np.asarray(m.compute()), expected)
+
+
+def test_mean_forward_matches_update():
+    m = MeanMetric()
+    vals = np.random.rand(4, 8).astype(np.float32)
+    for v in vals:
+        m(jnp.asarray(v))
+    assert np.allclose(np.asarray(m.compute()), vals.mean(), rtol=1e-6)
+
+
+@pytest.mark.parametrize("metric_cls", [MaxMetric, MinMetric, SumMetric, MeanMetric])
+def test_nan_error(metric_cls):
+    m = metric_cls(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="Encounted `nan` values"):
+        m.update(jnp.asarray([1.0, float("nan")]))
+
+
+@pytest.mark.parametrize(
+    "metric_cls,expected", [(MaxMetric, 2.0), (MinMetric, 1.0), (SumMetric, 3.0), (MeanMetric, 1.5)]
+)
+def test_nan_ignore(metric_cls, expected):
+    m = metric_cls(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, 2.0, float("nan")]))
+    assert np.allclose(np.asarray(m.compute()), expected)
+
+
+@pytest.mark.parametrize(
+    "metric_cls,expected", [(MaxMetric, 5.0), (MinMetric, 1.0), (SumMetric, 8.0), (MeanMetric, 8.0 / 3)]
+)
+def test_nan_impute(metric_cls, expected):
+    m = metric_cls(nan_strategy=5.0)
+    m.update(jnp.asarray([1.0, 2.0, float("nan")]))
+    assert np.allclose(np.asarray(m.compute()), expected)
+
+
+def test_invalid_nan_strategy():
+    with pytest.raises(ValueError, match="Arg `nan_strategy` should"):
+        SumMetric(nan_strategy="invalid")

@@ -1,0 +1,175 @@
+"""MetricCollection tests (translation of ref tests/bases/test_collections.py, 403 LoC)."""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+from tests.helpers.testers import DummyMetricDiff, DummyMetricMultiOutput, DummyMetricSum
+
+
+def test_metric_collection_list():
+    mc = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+    assert "DummyMetricSum" in mc and "DummyMetricDiff" in mc
+    mc.update(jnp.asarray(5.0))  # positional args go to every metric; DummySum takes x, DummyDiff takes y
+
+
+def test_metric_collection_same_class_raises():
+    with pytest.raises(ValueError, match="Encountered two metrics both named"):
+        MetricCollection([DummyMetricSum(), DummyMetricSum()])
+
+
+def test_metric_collection_dict():
+    mc = MetricCollection({"a": DummyMetricSum(), "b": DummyMetricDiff()})
+    mc.update(jnp.asarray(2.0))
+    out = mc.compute()
+    assert set(out.keys()) == {"a", "b"}
+    assert np.asarray(out["a"]) == 2.0
+    assert np.asarray(out["b"]) == -2.0
+
+
+def test_prefix_postfix():
+    mc = MetricCollection({"a": DummyMetricSum()}, prefix="pre_", postfix="_post")
+    mc.update(jnp.asarray(1.0))
+    out = mc.compute()
+    assert list(out.keys()) == ["pre_a_post"]
+
+    cloned = mc.clone(prefix="new_")
+    assert list(cloned.keys()) == ["new_a_post"]
+
+
+def test_forward_returns_batch_values():
+    mc = MetricCollection({"a": DummyMetricSum()})
+    out = mc(jnp.asarray(2.0))
+    assert np.asarray(out["a"]) == 2.0
+    out = mc(jnp.asarray(3.0))
+    assert np.asarray(out["a"]) == 3.0
+    assert np.asarray(mc.compute()["a"]) == 5.0
+
+
+def test_reset():
+    mc = MetricCollection({"a": DummyMetricSum()})
+    mc.update(jnp.asarray(2.0))
+    mc.reset()
+    assert np.asarray(mc["a"].x) == 0.0
+
+
+def test_collection_state_dict_roundtrip():
+    mc = MetricCollection({"a": DummyMetricSum(), "b": DummyMetricDiff()})
+    mc.persistent(True)
+    mc.update(jnp.asarray(3.0))
+    sd = mc.state_dict()
+    mc2 = MetricCollection({"a": DummyMetricSum(), "b": DummyMetricDiff()})
+    mc2.persistent(True)
+    mc2.load_state_dict(sd)
+    assert np.asarray(mc2["a"].x) == 3.0
+    assert np.asarray(mc2["b"].x) == -3.0
+
+
+class _StatsA(Metric):
+    """Two metrics with identical states -> must merge into one compute group."""
+
+    full_state_update = False
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.total / self.count
+
+
+class _StatsB(_StatsA):
+    def compute(self):
+        return self.total * 2
+
+
+class _Other(Metric):
+    full_state_update = False
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("prod", jnp.asarray(1.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.prod = self.prod * jnp.prod(x)
+
+    def compute(self):
+        return self.prod
+
+
+def test_compute_group_detection():
+    mc = MetricCollection([_StatsA(), _StatsB(), _Other()], compute_groups=True)
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    mc.update(x)
+    assert mc._groups_checked
+    groups = {frozenset(v) for v in mc.compute_groups.values()}
+    assert frozenset({"_StatsA", "_StatsB"}) in groups
+    assert frozenset({"_Other"}) in groups
+
+    mc.update(x)  # second update only touches group leaders
+    out = mc.compute()
+    assert np.allclose(np.asarray(out["_StatsA"]), 2.0)
+    assert np.allclose(np.asarray(out["_StatsB"]), 24.0)
+
+
+def test_explicit_compute_groups():
+    mc = MetricCollection(
+        [_StatsA(), _StatsB(), _Other()],
+        compute_groups=[["_StatsA", "_StatsB"], ["_Other"]],
+    )
+    assert mc._groups_checked  # static declaration: no device sync needed
+    x = jnp.asarray([2.0, 4.0])
+    mc.update(x)
+    mc.update(x)
+    out = mc.compute()
+    assert np.allclose(np.asarray(out["_StatsA"]), 3.0)
+    assert np.allclose(np.asarray(out["_Other"]), 64.0)
+
+
+def test_compute_groups_disabled_matches():
+    x = jnp.asarray([1.0, 5.0])
+    mc_on = MetricCollection([_StatsA(), _StatsB()], compute_groups=True)
+    mc_off = MetricCollection([_StatsA(), _StatsB()], compute_groups=False)
+    for _ in range(3):
+        mc_on.update(x)
+        mc_off.update(x)
+    out_on, out_off = mc_on.compute(), mc_off.compute()
+    for k in out_on:
+        assert np.allclose(np.asarray(out_on[k]), np.asarray(out_off[k]))
+
+
+def test_check_compute_groups_is_faster():
+    """Merged groups must reduce update cost (ref test_collections.py:360)."""
+    x = jnp.asarray(np.random.rand(1000).astype(np.float32))
+    mc_on = MetricCollection([_StatsA(), _StatsB()], compute_groups=[["_StatsA", "_StatsB"]])
+    mc_off = MetricCollection([_StatsA(), _StatsB()], compute_groups=False)
+    # warmup
+    mc_on.update(x)
+    mc_off.update(x)
+
+    n = 50
+    t0 = time.perf_counter()
+    for _ in range(n):
+        mc_on.update(x)
+    t_on = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        mc_off.update(x)
+    t_off = time.perf_counter() - t0
+    assert t_on < t_off, f"compute groups should be faster: {t_on:.4f}s vs {t_off:.4f}s"
+
+
+def test_multioutput_flattened():
+    mc = MetricCollection({"multi": DummyMetricMultiOutput()})
+    mc.update(jnp.asarray(2.0))
+    out = mc.compute()
+    assert "multi" in out

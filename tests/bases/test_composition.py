@@ -1,0 +1,104 @@
+"""Metric arithmetic tests (translation of ref tests/bases/test_composition.py, 555 LoC)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.metric import CompositionalMetric
+from tests.helpers.testers import DummyMetricDiff, DummyMetricSum
+
+
+@pytest.mark.parametrize("second_operand,expected", [(2.0, 7.0), (jnp.asarray(2.0), 7.0)])
+def test_add(second_operand, expected):
+    first = DummyMetricSum()
+    comp = first + second_operand
+    assert isinstance(comp, CompositionalMetric)
+    first.update(jnp.asarray(5.0))
+    assert np.asarray(comp.compute()) == expected
+
+    comp_r = second_operand + first
+    assert np.asarray(comp_r.compute()) == expected
+
+
+@pytest.mark.parametrize("second_operand,expected", [(2.0, 10.0)])
+def test_mul(second_operand, expected):
+    first = DummyMetricSum()
+    comp = first * second_operand
+    first.update(jnp.asarray(5.0))
+    assert np.asarray(comp.compute()) == expected
+
+
+def test_sub_and_div():
+    a = DummyMetricSum()
+    b = DummyMetricDiff()
+    sub = a - b
+    div = a / 2.0
+    a.update(jnp.asarray(6.0))
+    b.update(jnp.asarray(2.0))  # diff goes to -2
+    assert np.asarray(sub.compute()) == 8.0
+    assert np.asarray(div.compute()) == 3.0
+
+
+def test_metrics_composed_of_metrics():
+    a = DummyMetricSum()
+    b = DummyMetricSum()
+    comp = (a + b) / 2
+    a.update(jnp.asarray(4.0))
+    b.update(jnp.asarray(2.0))
+    assert np.asarray(comp.compute()) == 3.0
+
+
+def test_pow_mod_floordiv():
+    a = DummyMetricSum()
+    a.update(jnp.asarray(5.0))
+    assert np.asarray((a ** 2).compute()) == 25.0
+    assert np.asarray((a % 2).compute()) == 1.0
+    assert np.asarray((a // 2).compute()) == 2.0
+
+
+def test_comparisons():
+    a = DummyMetricSum()
+    a.update(jnp.asarray(5.0))
+    assert bool(np.asarray((a > 3).compute()))
+    assert not bool(np.asarray((a < 3).compute()))
+    assert bool(np.asarray((a >= 5).compute()))
+    assert bool(np.asarray((a <= 5).compute()))
+    assert bool(np.asarray((a == 5).compute()))
+    assert bool(np.asarray((a != 3).compute()))
+
+
+def test_abs_neg_getitem():
+    a = DummyMetricDiff()
+    a.update(jnp.asarray(3.0))  # state -3
+    assert np.asarray(abs(a).compute()) == 3.0
+    assert np.asarray((-a).compute()) == -3.0
+
+    b = DummyMetricSum()
+    b.update(jnp.asarray([1.0, 2.0, 3.0]))
+    assert np.asarray(b[1].compute()) == 2.0
+
+
+def test_compositional_forward():
+    a = DummyMetricSum()
+    b = DummyMetricSum()
+    comp = a + b
+    out = comp(jnp.asarray(2.0))
+    assert np.asarray(out) == 4.0
+    # states accumulated in both leaves
+    assert np.asarray(a.x) == 2.0
+    assert np.asarray(b.x) == 2.0
+
+
+def test_compositional_reset_and_update():
+    a = DummyMetricSum()
+    comp = a + 1.0
+    comp.update(jnp.asarray(2.0))
+    assert np.asarray(comp.compute()) == 3.0
+    comp.reset()
+    assert np.asarray(a.x) == 0.0
+
+
+def test_nested_composition():
+    a = DummyMetricSum()
+    comp = ((a + 1) * 2) - 1
+    a.update(jnp.asarray(3.0))
+    assert np.asarray(comp.compute()) == 7.0

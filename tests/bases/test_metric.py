@@ -1,0 +1,265 @@
+"""Base-class contract tests (translation of ref tests/bases/test_metric.py)."""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.exceptions import MetricsUserError
+from tests.helpers.testers import DummyListMetric, DummyMetric, DummyMetricDiff, DummyMetricSum
+
+
+def test_error_on_wrong_input():
+    with pytest.raises(ValueError, match="Expected keyword argument `compute_on_cpu` to be a bool"):
+        DummyMetric(compute_on_cpu=None)
+    with pytest.raises(ValueError, match="Expected keyword argument `dist_sync_on_step` to be a bool"):
+        DummyMetric(dist_sync_on_step=None)
+    with pytest.raises(ValueError, match="Expected keyword argument `dist_sync_fn` to be a callable"):
+        DummyMetric(dist_sync_fn=[2, 3])
+
+
+def test_inherit():
+    DummyMetric()
+
+
+def test_add_state():
+    m = DummyMetric()
+
+    m.add_state("a", jnp.asarray(0.0), "sum")
+    assert np.asarray(m._reductions["a"](jnp.asarray([1.0, 1.0]))) == 2
+
+    m.add_state("b", jnp.asarray(0.0), "mean")
+    assert np.allclose(np.asarray(m._reductions["b"](jnp.asarray([1.0, 2.0]))), 1.5)
+
+    m.add_state("c", jnp.asarray(0.0), "cat")
+    assert np.asarray(m._reductions["c"]([jnp.asarray([1.0]), jnp.asarray([1.0])])).shape == (2,)
+
+    with pytest.raises(ValueError):
+        m.add_state("d1", [2.0], "sum")  # non-empty list default
+    with pytest.raises(ValueError):
+        m.add_state("d3", jnp.asarray(0.0), "xyz")
+    with pytest.raises(ValueError):
+        m.add_state("d4", jnp.asarray(0.0), 42)
+
+    def custom_fx(_):
+        return -1
+
+    m.add_state("e", jnp.asarray(0.0), custom_fx)
+    assert np.asarray(m._reductions["e"](jnp.asarray([1.0, 1.0]))) == -1
+
+
+def test_add_state_persistent():
+    m = DummyMetric()
+    m.add_state("a", jnp.asarray(0.0), "sum", persistent=True)
+    assert "a" in m.state_dict()
+    m.add_state("b", jnp.asarray(0.0), "sum", persistent=False)
+    assert "b" not in m.state_dict()
+
+
+def test_reset():
+    class A(DummyMetric):
+        pass
+
+    class B(DummyListMetric):
+        pass
+
+    m = A()
+    assert np.asarray(m.x) == 0
+    m.x = jnp.asarray(5.0)
+    m.reset()
+    assert np.asarray(m.x) == 0
+
+    m = B()
+    assert isinstance(m.x, list) and len(m.x) == 0
+    m.x = [jnp.asarray(5.0)]
+    m.reset()
+    assert isinstance(m.x, list) and len(m.x) == 0
+
+
+def test_reset_compute():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    assert np.asarray(m.compute()) == 2
+    m.reset()
+    assert np.asarray(m.compute()) == 0
+
+
+def test_update():
+    m = DummyMetricSum()
+    assert np.asarray(m.x) == 0
+    assert m._update_count == 0
+    m.update(jnp.asarray(1.0))
+    assert m._update_count == 1
+    assert np.asarray(m.x) == 1
+    m.update(jnp.asarray(2.0))
+    assert m._update_count == 2
+    assert np.asarray(m.x) == 3
+
+
+def test_compute():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    assert np.asarray(m.compute()) == 1
+    m.update(jnp.asarray(2.0))
+    assert np.asarray(m.compute()) == 3
+
+    # called without update, pre-cache
+    m.reset()
+    assert np.asarray(m.compute()) == 0
+
+
+def test_compute_cached():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(5.0))
+    assert np.asarray(m.compute()) == 5
+    # cached value returned without recompute
+    assert m._computed is not None
+    assert np.asarray(m.compute()) == 5
+    m.update(jnp.asarray(1.0))
+    assert m._computed is None
+
+
+def test_forward():
+    m = DummyMetricSum()
+    val = m(jnp.asarray(1.0))
+    assert np.asarray(val) == 1
+    assert np.asarray(m.x) == 1
+    val = m(jnp.asarray(2.0))
+    assert np.asarray(val) == 2
+    assert np.asarray(m.x) == 3
+    assert np.asarray(m.compute()) == 3
+
+
+def test_forward_full_vs_reduce_state():
+    """Merge-based forward must equal the reference double-update path."""
+    m_full = DummyMetricSum()
+    m_reduce = DummyMetricSum()
+    for v in [1.0, 4.0, 2.5]:
+        a = m_full._forward_full_state_update(jnp.asarray(v))
+        b = m_reduce._forward_reduce_state_update(jnp.asarray(v))
+        assert np.asarray(a) == np.asarray(b)
+    assert np.asarray(m_full.compute()) == np.asarray(m_reduce.compute())
+
+
+def test_pickle():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    restored = pickle.loads(pickle.dumps(m))
+    assert np.asarray(restored.x) == 1
+    restored.update(jnp.asarray(2.0))
+    assert np.asarray(restored.compute()) == 3
+
+
+def test_state_dict_roundtrip():
+    m = DummyMetricSum()
+    m.persistent(True)
+    m.update(jnp.asarray(7.0))
+    sd = m.state_dict()
+    assert np.asarray(sd["x"]) == 7
+
+    m2 = DummyMetricSum()
+    m2.persistent(True)
+    m2.load_state_dict(sd)
+    assert np.asarray(m2.compute()) == 7
+
+
+def test_frozen_class_attrs():
+    m = DummyMetric()
+    for attr in ("is_differentiable", "higher_is_better", "full_state_update"):
+        with pytest.raises(RuntimeError, match="Can't change const"):
+            setattr(m, attr, True)
+
+
+def test_child_metric_state_dict():
+    class Parent(DummyMetric):
+        def __init__(self):
+            super().__init__()
+            self.child = DummyMetricSum()
+            self.child.persistent(True)
+            self.add_state("p", jnp.asarray(0.0), "sum", persistent=True)
+
+    m = Parent()
+    m.child.update(jnp.asarray(3.0))
+    sd = m.state_dict()
+    assert np.asarray(sd["child.x"]) == 3
+    m2 = Parent()
+    m2.load_state_dict(sd)
+    assert np.asarray(m2.child.x) == 3
+
+
+def test_sync_noop_single_device():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    m.sync()  # no-op env: world size 1
+    assert not m._is_synced
+    assert np.asarray(m.compute()) == 2
+
+
+def test_double_unsync_raises():
+    m = DummyMetricSum()
+    with pytest.raises(MetricsUserError, match="has already been un-synced"):
+        m.unsync()
+
+
+def test_device_and_put():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    dev = jax.devices("cpu")[0]
+    m.to_device(dev)
+    assert m.device == dev
+
+
+def test_set_dtype():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    m.set_dtype(jnp.bfloat16)
+    assert m.x.dtype == jnp.bfloat16
+
+
+def test_constant_memory_tensor_state():
+    """Tensor states must not grow with updates (ref test_metric.py:374)."""
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    shape0 = m.x.shape
+    nbytes0 = m.x.size
+    for _ in range(10):
+        m.update(jnp.asarray(1.0))
+    assert m.x.shape == shape0
+    assert m.x.size == nbytes0
+
+
+def test_pure_update_jit_and_scan():
+    """The pure reducer must work under jit and lax.scan (TPU-native contract)."""
+    m = DummyMetricSum()
+    state = m.state()
+    jitted = jax.jit(m.pure_update)
+    state = jitted(state, jnp.asarray(3.0))
+    assert np.asarray(state["x"]) == 3
+
+    def step(carry, x):
+        return m.pure_update(carry, x), None
+
+    final, _ = jax.lax.scan(step, state, jnp.arange(5.0))
+    assert np.asarray(final["x"]) == 3 + sum(range(5))
+    assert np.asarray(m.x) == 0  # shell state untouched
+
+
+def test_jit_update_option():
+    m = DummyMetricSum(jit_update=True)
+    m.update(jnp.asarray(2.0))
+    m.update(jnp.asarray(3.0))
+    assert np.asarray(m.compute()) == 5
+
+
+def test_compute_on_cpu_moves_list_states():
+    m = DummyListMetric(compute_on_cpu=True)
+
+    class L(DummyListMetric):
+        def update(self, x):
+            self.x.append(x)
+
+    m = L(compute_on_cpu=True)
+    m.update(jnp.ones(4))
+    assert all(next(iter(v.devices())).platform == "cpu" for v in m.x)

@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-virtual-device CPU platform.
+
+Translation of the reference's Pool+gloo multi-process trick
+(/root/reference/tests/helpers/testers.py:47-59): instead of spawning
+processes, we ask XLA for 8 host devices in one process and test the
+distributed paths with real collectives over a ``jax.sharding.Mesh``.
+Must run before jax initializes its backends.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    import numpy as np
+
+    np.random.seed(42)
+    yield
+
+
+NUM_DEVICES = 8
+
+
+def pytest_configure(config):
+    assert jax.device_count() == NUM_DEVICES, f"expected {NUM_DEVICES} forced host devices, got {jax.devices()}"

@@ -1,0 +1,325 @@
+"""Shared metric test harness.
+
+Translation of /root/reference/tests/helpers/testers.py (613 LoC). The
+reference spawns a 2-worker gloo process group to test DDP sync; here the
+distributed check runs the metric's **pure** update/sync reducers inside
+``shard_map`` over a mesh of forced host devices — real XLA collectives, one
+process. The single-device checks exercise the stateful shell (forward
+batch values, compute, pickling, frozen class attrs) exactly like the
+reference's ``_class_test``/``_functional_test``.
+"""
+import pickle
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.metric import Metric
+
+NUM_PROCESSES = 2
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+def _assert_allclose(tpu_result: Any, sk_result: Any, atol: float = 1e-6) -> None:
+    """Recursively assert closeness of metric results vs reference."""
+    if isinstance(tpu_result, dict):
+        assert isinstance(sk_result, dict), f"expected dict reference, got {type(sk_result)}"
+        for key in tpu_result:
+            _assert_allclose(tpu_result[key], sk_result[key], atol=atol)
+    elif isinstance(tpu_result, (list, tuple)):
+        for t, s in zip(tpu_result, sk_result):
+            _assert_allclose(t, s, atol=atol)
+    else:
+        t = np.asarray(tpu_result, dtype=np.float64)
+        s = np.asarray(sk_result, dtype=np.float64)
+        np.testing.assert_allclose(t, s, atol=atol, rtol=1e-4, equal_nan=True)
+
+
+def _select_batch(data: Any, i: int) -> Any:
+    if data is None:
+        return None
+    if isinstance(data, dict):
+        return {k: _select_batch(v, i) for k, v in data.items()}
+    return data[i]
+
+
+def _concat_all(data: Any) -> Any:
+    if isinstance(data, dict):
+        return {k: _concat_all(v) for k, v in data.items()}
+    return np.concatenate([np.asarray(data[i]) for i in range(len(data))], axis=0)
+
+
+class MetricTester:
+    """Test a module metric + functional metric against a reference oracle."""
+
+    atol: float = 1e-6
+
+    # ------------------------------------------------------------ functional
+    def run_functional_metric_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_functional: Callable,
+        reference_metric: Callable,
+        metric_args: Optional[dict] = None,
+        atol: Optional[float] = None,
+        **kwargs_update: Any,
+    ) -> None:
+        metric_args = metric_args or {}
+        atol = atol if atol is not None else self.atol
+        fn = partial(metric_functional, **metric_args)
+
+        for i in range(NUM_BATCHES):
+            extra = {k: _select_batch(v, i) for k, v in kwargs_update.items()}
+            result = fn(jnp.asarray(np.asarray(preds[i])), jnp.asarray(np.asarray(target[i])), **extra)
+            sk_result = reference_metric(np.asarray(preds[i]), np.asarray(target[i]), **extra)
+            _assert_allclose(result, sk_result, atol=atol)
+
+    def run_jit_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+        atol: Optional[float] = None,
+    ) -> None:
+        """Check the functional form is jit-clean and matches eager."""
+        metric_args = metric_args or {}
+        atol = atol if atol is not None else self.atol
+        fn = partial(metric_functional, **metric_args)
+        jitted = jax.jit(fn)
+        p, t = jnp.asarray(np.asarray(preds[0])), jnp.asarray(np.asarray(target[0]))
+        _assert_allclose(jitted(p, t), fn(p, t), atol=atol)
+
+    # ----------------------------------------------------------------- class
+    def run_class_metric_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        reference_metric: Callable,
+        dist: bool = False,
+        metric_args: Optional[dict] = None,
+        check_batch: bool = True,
+        check_state_merge: bool = True,
+        atol: Optional[float] = None,
+        world_size: int = NUM_PROCESSES,
+        **kwargs_update: Any,
+    ) -> None:
+        metric_args = metric_args or {}
+        atol = atol if atol is not None else self.atol
+        if dist:
+            self._dist_test(
+                preds, target, metric_class, reference_metric, metric_args, atol, world_size, **kwargs_update
+            )
+        else:
+            self._single_test(
+                preds, target, metric_class, reference_metric, metric_args, check_batch,
+                check_state_merge, atol, **kwargs_update,
+            )
+
+    def _single_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        reference_metric: Callable,
+        metric_args: dict,
+        check_batch: bool,
+        check_state_merge: bool,
+        atol: float,
+        **kwargs_update: Any,
+    ) -> None:
+        metric = metric_class(**metric_args)
+
+        # frozen class attrs must raise on instance assignment (ref testers.py:157-160)
+        with pytest.raises(RuntimeError):
+            metric.is_differentiable = not metric.is_differentiable
+        with pytest.raises(RuntimeError):
+            metric.higher_is_better = not metric.higher_is_better
+
+        # pickle round-trip (ref testers.py:173-175)
+        pickled = pickle.dumps(metric)
+        metric = pickle.loads(pickled)
+
+        for i in range(NUM_BATCHES):
+            extra = {k: _select_batch(v, i) for k, v in kwargs_update.items()}
+            batch_result = metric(jnp.asarray(np.asarray(preds[i])), jnp.asarray(np.asarray(target[i])), **extra)
+            if check_batch:
+                sk_batch = reference_metric(np.asarray(preds[i]), np.asarray(target[i]), **extra)
+                _assert_allclose(batch_result, sk_batch, atol=atol)
+
+        result = metric.compute()
+        total_extra = {k: _concat_all(v) for k, v in kwargs_update.items()}
+        sk_result = reference_metric(_concat_all(preds), _concat_all(target), **total_extra)
+        _assert_allclose(result, sk_result, atol=atol)
+
+        # reset restores defaults
+        metric.reset()
+        for attr, default in metric._defaults.items():
+            value = getattr(metric, attr)
+            if isinstance(default, list):
+                assert value == []
+            else:
+                np.testing.assert_allclose(np.asarray(value), np.asarray(default))
+
+        if check_state_merge and not metric.full_state_update:
+            # the merge-based forward must agree with the reference double-update path
+            m_full = metric_class(**metric_args)
+            object.__setattr__(m_full, "_forward_cache", None)
+            m_reduce = metric_class(**metric_args)
+            for i in range(NUM_BATCHES):
+                extra = {k: _select_batch(v, i) for k, v in kwargs_update.items()}
+                args = (jnp.asarray(np.asarray(preds[i])), jnp.asarray(np.asarray(target[i])))
+                v_full = m_full._forward_full_state_update(*args, **extra)
+                v_reduce = m_reduce._forward_reduce_state_update(*args, **extra)
+                _assert_allclose(v_full, v_reduce, atol=atol)
+            _assert_allclose(m_full.compute(), m_reduce.compute(), atol=atol)
+
+    def _dist_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        reference_metric: Callable,
+        metric_args: dict,
+        atol: float,
+        world_size: int,
+        **kwargs_update: Any,
+    ) -> None:
+        """Distributed check: pure update per shard + pure_sync collective.
+
+        Each device plays one DDP rank: batches are strided across devices
+        (rank r sees batches r, r+W, ...), states sync with a real XLA
+        all_gather over the mesh axis, and the synced compute must equal the
+        reference on the full data (ref testers.py:109-244).
+        """
+        assert NUM_BATCHES % world_size == 0
+        metric = metric_class(**metric_args)
+        init_state = metric.state()
+
+        mesh = Mesh(np.array(jax.devices()[:world_size]), ("r",))
+
+        # stack batches: rank r consumes batches [r::world_size]
+        def _stack_for_ranks(data):
+            arr = np.stack([np.asarray(data[i]) for i in range(NUM_BATCHES)])  # (NB, B, ...)
+            steps = NUM_BATCHES // world_size
+            # (NB, B, ...) -> (world, steps, B, ...) with rank-strided batches
+            return jnp.asarray(
+                np.stack([np.stack([arr[r + s * world_size] for s in range(steps)]) for r in range(world_size)])
+            )
+
+        preds_sh = _stack_for_ranks(preds)
+        target_sh = _stack_for_ranks(target)
+        extra_sh = {k: _stack_for_ranks(v) for k, v in kwargs_update.items()}
+        steps = NUM_BATCHES // world_size
+
+        def worker(state, p, t, extra):
+            # p, t: (1, steps, B, ...) local shard — drop the rank dim
+            p, t = p[0], t[0]
+            extra = {k: v[0] for k, v in extra.items()}
+            for s in range(steps):
+                state = metric.pure_update(state, p[s], t[s], **{k: v[s] for k, v in extra.items()})
+            return metric.pure_sync(state, "r")
+
+        in_state_spec = jax.tree_util.tree_map(lambda _: P(), init_state)
+        run = shard_map(
+            worker,
+            mesh=mesh,
+            in_specs=(in_state_spec, P("r"), P("r"), jax.tree_util.tree_map(lambda _: P("r"), extra_sh)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        synced_state = run(init_state, preds_sh, target_sh, extra_sh)
+        result = metric.pure_compute(synced_state)
+
+        total_extra = {k: _concat_all(v) for k, v in kwargs_update.items()}
+        sk_result = reference_metric(_concat_all(preds), _concat_all(target), **total_extra)
+        _assert_allclose(result, sk_result, atol=atol)
+
+    # -------------------------------------------------------- differentiability
+    def run_differentiability_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_module: Metric,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+    ) -> None:
+        metric_args = metric_args or {}
+        if not metric_module.is_differentiable:
+            return
+        p = jnp.asarray(np.asarray(preds[0]), dtype=jnp.float32)
+        t = jnp.asarray(np.asarray(target[0]))
+
+        def scalar_fn(p_):
+            out = metric_functional(p_, t, **metric_args)
+            leaves = jax.tree_util.tree_leaves(out)
+            return sum(jnp.sum(leaf) for leaf in leaves)
+
+        grad = jax.grad(scalar_fn)(p)
+        assert np.all(np.isfinite(np.asarray(grad))), "gradient contains non-finite values"
+
+
+class DummyMetric(Metric):
+    """Scalar-sum dummy metric for base-class tests (ref testers.py:567-583)."""
+
+    name = "Dummy"
+    full_state_update: Optional[bool] = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self) -> None:
+        pass
+
+    def compute(self) -> None:
+        pass
+
+
+class DummyListMetric(Metric):
+    """List-state dummy metric (ref testers.py:586-597)."""
+
+    name = "DummyList"
+    full_state_update: Optional[bool] = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("x", [], dist_reduce_fx="cat")
+
+    def update(self) -> None:
+        pass
+
+    def compute(self) -> None:
+        pass
+
+
+class DummyMetricSum(DummyMetric):
+    def update(self, x) -> None:
+        self.x = self.x + x
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricDiff(DummyMetric):
+    def update(self, y) -> None:
+        self.x = self.x - y
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricMultiOutput(DummyMetricSum):
+    def compute(self):
+        return [self.x, self.x]
