@@ -22,16 +22,34 @@ from metrics_tpu.aggregation import (  # noqa: E402, F401
     MinMetric,
     SumMetric,
 )
+from metrics_tpu.classification import (  # noqa: E402, F401
+    Accuracy,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+)
 from metrics_tpu.collections import MetricCollection  # noqa: E402, F401
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402, F401
 
 __all__ = [
+    "Accuracy",
     "CatMetric",
     "CompositionalMetric",
+    "F1Score",
+    "FBetaScore",
+    "HammingDistance",
     "MaxMetric",
     "MeanMetric",
     "Metric",
     "MetricCollection",
     "MinMetric",
+    "Precision",
+    "Recall",
+    "Specificity",
+    "StatScores",
     "SumMetric",
 ]

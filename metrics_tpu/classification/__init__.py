@@ -1,0 +1,6 @@
+from metrics_tpu.classification.accuracy import Accuracy  # noqa: F401
+from metrics_tpu.classification.f_beta import F1Score, FBetaScore  # noqa: F401
+from metrics_tpu.classification.hamming import HammingDistance  # noqa: F401
+from metrics_tpu.classification.precision_recall import Precision, Recall  # noqa: F401
+from metrics_tpu.classification.specificity import Specificity  # noqa: F401
+from metrics_tpu.classification.stat_scores import StatScores  # noqa: F401

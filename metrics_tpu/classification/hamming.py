@@ -1,0 +1,46 @@
+"""HammingDistance module metric.
+
+Behavioral parity: /root/reference/torchmetrics/classification/hamming.py
+(92 LoC).
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hamming import _hamming_distance_compute, _hamming_distance_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class HammingDistance(Metric):
+    """Average Hamming distance / loss (ref hamming.py:24-92).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HammingDistance
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> hamming_distance = HammingDistance()
+        >>> float(hamming_distance(preds, target))
+        0.25
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, threshold: float = 0.5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("correct", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.threshold = threshold
+
+    def update(self, preds: Array, target: Array) -> None:
+        correct, total = _hamming_distance_update(preds, target, self.threshold)
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hamming_distance_compute(self.correct, self.total)

@@ -1,0 +1,109 @@
+"""Precision and Recall module metrics.
+
+Behavioral parity: /root/reference/torchmetrics/classification/
+precision_recall.py (287 LoC).
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.precision_recall import _precision_compute, _recall_compute
+
+Array = jax.Array
+
+
+class Precision(StatScores):
+    """Precision: tp / (tp + fp) (ref precision_recall.py:24-150).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Precision
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> precision = Precision(average='macro', num_classes=3)
+        >>> round(float(precision(preds, target)), 4)
+        0.1667
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: Optional[str] = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        self.average = average
+
+    def compute(self) -> Array:
+        tp, fp, _, fn = self._get_final_stats()
+        return _precision_compute(tp, fp, fn, self.average, self.mdmc_reduce)
+
+
+class Recall(StatScores):
+    """Recall: tp / (tp + fn) (ref precision_recall.py:153-287).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Recall
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> recall = Recall(average='macro', num_classes=3)
+        >>> round(float(recall(preds, target)), 4)
+        0.3333
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: Optional[str] = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        self.average = average
+
+    def compute(self) -> Array:
+        tp, fp, _, fn = self._get_final_stats()
+        return _recall_compute(tp, fp, fn, self.average, self.mdmc_reduce)

@@ -1,0 +1,61 @@
+"""Specificity module metric.
+
+Behavioral parity: /root/reference/torchmetrics/classification/specificity.py
+(155 LoC).
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.specificity import _specificity_compute
+
+Array = jax.Array
+
+
+class Specificity(StatScores):
+    """Specificity: tn / (tn + fp) (ref specificity.py:24-155).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Specificity
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> specificity = Specificity(average='macro', num_classes=3)
+        >>> round(float(specificity(preds, target)), 4)
+        0.6111
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: Optional[str] = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        self.average = average
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _specificity_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce)

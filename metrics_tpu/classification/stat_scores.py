@@ -1,0 +1,110 @@
+"""StatScores module metric.
+
+Behavioral parity: /root/reference/torchmetrics/classification/stat_scores.py
+(242 LoC). State: tp/fp/tn/fn — fixed-shape arrays with sum reduce in the
+common case (XLA-friendly, constant memory); list states only for
+``reduce='samples'`` / ``mdmc_reduce='samplewise'``.
+"""
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import _stat_scores_compute, _stat_scores_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+class StatScores(Metric):
+    """Accumulate TP/FP/TN/FN counts (ref stat_scores.py:24-242)."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        top_k: Optional[int] = None,
+        reduce: str = "micro",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        mdmc_reduce: Optional[str] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        self.reduce = reduce
+        self.mdmc_reduce = mdmc_reduce
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.multiclass = multiclass
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+
+        if reduce not in ["micro", "macro", "samples"]:
+            raise ValueError(f"The `reduce` {reduce} is not valid.")
+        if mdmc_reduce not in [None, "samplewise", "global"]:
+            raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+        if reduce == "macro" and (not num_classes or num_classes < 1):
+            raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+        if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+        default: Any = lambda: []
+        reduce_fn: Optional[str] = None
+        if mdmc_reduce != "samplewise" and reduce != "samples":
+            if reduce == "micro":
+                zeros_shape = ()
+            elif reduce == "macro":
+                zeros_shape = (num_classes,)
+            else:
+                raise ValueError(f'Wrong reduce="{reduce}"')
+            default = lambda: jnp.zeros(zeros_shape, dtype=jnp.int32)
+            reduce_fn = "sum"
+        else:
+            reduce_fn = "cat"
+
+        for s in ("tp", "fp", "tn", "fn"):
+            self.add_state(s, default=default(), dist_reduce_fx=reduce_fn)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate stat scores for a batch (ref stat_scores.py:168-200)."""
+        tp, fp, tn, fn = _stat_scores_update(
+            preds,
+            target,
+            reduce=self.reduce,
+            mdmc_reduce=self.mdmc_reduce,
+            threshold=self.threshold,
+            num_classes=self.num_classes,
+            top_k=self.top_k,
+            multiclass=self.multiclass,
+            ignore_index=self.ignore_index,
+        )
+
+        if self.reduce != AverageMethod.SAMPLES and self.mdmc_reduce != MDMCAverageMethod.SAMPLEWISE:
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
+        else:
+            self.tp.append(tp)
+            self.fp.append(fp)
+            self.tn.append(tn)
+            self.fn.append(fn)
+
+    def _get_final_stats(self) -> Tuple[Array, Array, Array, Array]:
+        """Concatenate list states if necessary (ref stat_scores.py:202-208)."""
+        tp = jnp.concatenate(self.tp) if isinstance(self.tp, list) else self.tp
+        fp = jnp.concatenate(self.fp) if isinstance(self.fp, list) else self.fp
+        tn = jnp.concatenate(self.tn) if isinstance(self.tn, list) else self.tn
+        fn = jnp.concatenate(self.fn) if isinstance(self.fn, list) else self.fn
+        return tp, fp, tn, fn
+
+    def compute(self) -> Array:
+        """[..., 5] tensor of tp/fp/tn/fn/support (ref stat_scores.py:210-242)."""
+        tp, fp, tn, fn = self._get_final_stats()
+        return _stat_scores_compute(tp, fp, tn, fn)

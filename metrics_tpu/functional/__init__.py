@@ -1,0 +1,25 @@
+from metrics_tpu.functional.classification import (  # noqa: F401
+    accuracy,
+    dice_score,
+    f1_score,
+    fbeta_score,
+    hamming_distance,
+    precision,
+    precision_recall,
+    recall,
+    specificity,
+    stat_scores,
+)
+
+__all__ = [
+    "accuracy",
+    "dice_score",
+    "f1_score",
+    "fbeta_score",
+    "hamming_distance",
+    "precision",
+    "precision_recall",
+    "recall",
+    "specificity",
+    "stat_scores",
+]

@@ -1,0 +1,7 @@
+from metrics_tpu.functional.classification.accuracy import accuracy  # noqa: F401
+from metrics_tpu.functional.classification.dice import dice_score  # noqa: F401
+from metrics_tpu.functional.classification.f_beta import f1_score, fbeta_score  # noqa: F401
+from metrics_tpu.functional.classification.hamming import hamming_distance  # noqa: F401
+from metrics_tpu.functional.classification.precision_recall import precision, precision_recall, recall  # noqa: F401
+from metrics_tpu.functional.classification.specificity import specificity  # noqa: F401
+from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401

@@ -1,0 +1,142 @@
+"""F-beta and F1 functional implementations.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+f_beta.py (354 LoC).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.helpers import _safe_divide
+from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _fbeta_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    """F-beta from stat scores (ref f_beta.py:31-108)."""
+    if average == AverageMethod.MICRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        mask = tp >= 0
+        tp_s = jnp.where(mask, tp, 0).sum().astype(jnp.float32)
+        fp_s = jnp.where(mask, fp, 0).sum().astype(jnp.float32)
+        fn_s = jnp.where(mask, fn, 0).sum().astype(jnp.float32)
+        precision = _safe_divide(tp_s, tp_s + fp_s)
+        recall = _safe_divide(tp_s, tp_s + fn_s)
+    else:
+        precision = _safe_divide(tp.astype(jnp.float32), (tp + fp).astype(jnp.float32))
+        recall = _safe_divide(tp.astype(jnp.float32), (tp + fn).astype(jnp.float32))
+
+    num = (1 + beta**2) * precision * recall
+    denom = beta**2 * precision + recall
+    denom = jnp.where(denom == 0.0, 1.0, denom)  # avoid division by 0
+
+    # classes absent from preds and target are meaningless — mark ignored
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = (tp | fn | fp) == 0
+        if ignore_index is not None:
+            cond = cond | (jnp.arange(cond.shape[-1]) == ignore_index)
+        num = jnp.where(cond, -1.0, num)
+        denom = jnp.where(cond, -1.0, denom)
+    elif ignore_index is not None:
+        if average not in (AverageMethod.MICRO, AverageMethod.SAMPLES) and mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+            num = num.at[..., ignore_index].set(-1.0)
+            denom = denom.at[..., ignore_index].set(-1.0)
+        elif average not in (AverageMethod.MICRO, AverageMethod.SAMPLES):
+            num = num.at[ignore_index, ...].set(-1.0)
+            denom = denom.at[ignore_index, ...].set(-1.0)
+
+    if average == AverageMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = (tp + fp + fn == 0) | (tp + fp + fn == -3)
+        num = jnp.where(cond, -1.0, num)
+        denom = jnp.where(cond, -1.0, denom)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != AverageMethod.WEIGHTED else (tp + fn).astype(jnp.float32),
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def fbeta_score(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F-beta score (ref f_beta.py:111-231).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import fbeta_score
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> round(float(fbeta_score(preds, target, beta=0.5)), 4)
+        0.3333
+    """
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1_score(
+    preds: Array,
+    target: Array,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F1 score = F-beta with beta=1 (ref f_beta.py:234-354).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import f1_score
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> round(float(f1_score(preds, target)), 4)
+        0.3333
+    """
+    return fbeta_score(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)

@@ -1,0 +1,41 @@
+"""Hamming distance functional implementation.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+hamming.py (96 LoC).
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+
+Array = jax.Array
+
+
+def _hamming_distance_update(preds: Array, target: Array, threshold: float = 0.5) -> Tuple[Array, int]:
+    """Count matching positions and total positions (ref hamming.py:20-40)."""
+    preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
+    correct = (preds == target).sum()
+    total = preds.size
+    return correct, total
+
+
+def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array:
+    """1 - matching fraction (ref hamming.py:43-58)."""
+    return 1 - correct.astype(jnp.float32) / total
+
+
+def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
+    """Average Hamming distance / loss (ref hamming.py:61-96).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hamming_distance
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> float(hamming_distance(preds, target))
+        0.25
+    """
+    correct, total = _hamming_distance_update(preds, target, threshold)
+    return _hamming_distance_compute(correct, total)

@@ -1,0 +1,22 @@
+"""Small shared helpers for classification computes."""
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """Division with 0/0 -> 0 (ref functional/classification/f_beta.py:24-27)."""
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    return num / denom
+
+
+def _mask_ignored(num: Array, denom: Array, cond: Array):
+    """Mark entries where ``cond`` holds as ignored (-1 sentinel).
+
+    jit-friendly replacement for the reference's boolean-index removal
+    (e.g. precision_recall.py:57-58): ``_reduce_stat_scores`` treats negative
+    denominators as ignored with zero weight, which is mathematically
+    identical to removing them from a macro average.
+    """
+    return jnp.where(cond, -1.0, num), jnp.where(cond, -1.0, denom)

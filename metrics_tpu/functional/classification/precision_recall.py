@@ -1,0 +1,190 @@
+"""Precision and Recall functional implementations.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+precision_recall.py (552 LoC).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.helpers import _mask_ignored
+from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _precision_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    """Precision = tp / (tp + fp) with averaging (ref precision_recall.py:23-71)."""
+    numerator = tp.astype(jnp.float32)
+    denominator = (tp + fp).astype(jnp.float32)
+
+    if average == AverageMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = tp + fp + fn == 0
+        numerator, denominator = _mask_ignored(numerator, denominator, cond)
+
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = (tp | fn | fp) == 0
+        numerator, denominator = _mask_ignored(numerator, denominator, cond)
+
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else (tp + fn).astype(jnp.float32),
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _recall_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    """Recall = tp / (tp + fn) with averaging (ref precision_recall.py:216-263)."""
+    numerator = tp.astype(jnp.float32)
+    denominator = (tp + fn).astype(jnp.float32)
+
+    if average == AverageMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = tp + fp + fn == 0
+        numerator, denominator = _mask_ignored(numerator, denominator, cond)
+
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = (tp | fn | fp) == 0
+        numerator, denominator = _mask_ignored(numerator, denominator, cond)
+
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else (tp + fn).astype(jnp.float32),
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _check_avg_arguments(average: Optional[str], mdmc_average: Optional[str], num_classes: Optional[int],
+                         ignore_index: Optional[int]) -> None:
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+
+def precision(
+    preds: Array,
+    target: Array,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """Precision score (ref precision_recall.py:74-213).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> round(float(precision(preds, target, average='macro', num_classes=3)), 4)
+        0.1667
+    """
+    _check_avg_arguments(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, _, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _precision_compute(tp, fp, fn, average, mdmc_average)
+
+
+def recall(
+    preds: Array,
+    target: Array,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """Recall score (ref precision_recall.py:266-404).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import recall
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> round(float(recall(preds, target, average='macro', num_classes=3)), 4)
+        0.3333
+    """
+    _check_avg_arguments(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, _, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _recall_compute(tp, fp, fn, average, mdmc_average)
+
+
+def precision_recall(
+    preds: Array,
+    target: Array,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Tuple[Array, Array]:
+    """Both precision and recall from one stat-scores pass (ref precision_recall.py:407-552)."""
+    _check_avg_arguments(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, _, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return (
+        _precision_compute(tp, fp, fn, average, mdmc_average),
+        _recall_compute(tp, fp, fn, average, mdmc_average),
+    )

@@ -1,0 +1,59 @@
+"""Deterministic classification input fixtures.
+
+Modeled on /root/reference/tests/classification/inputs.py:23-60 — one
+namedtuple of (preds, target) per input mode, each shaped
+(NUM_BATCHES, BATCH_SIZE, ...).
+"""
+from collections import namedtuple
+
+import numpy as np
+
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+seed_all(1)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_binary_prob_inputs = Input(
+    preds=np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_binary_inputs = Input(
+    preds=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_multilabel_prob_inputs = Input(
+    preds=np.random.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_multilabel_inputs = Input(
+    preds=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_softmax = lambda x: np.exp(x) / np.exp(x).sum(-1, keepdims=True)
+
+_multiclass_prob_inputs = Input(
+    preds=_softmax(np.random.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32),
+    target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_multiclass_inputs = Input(
+    preds=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+    target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_mdmc_logits = np.random.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)
+_multidim_multiclass_prob_inputs = Input(
+    preds=(np.exp(_mdmc_logits) / np.exp(_mdmc_logits).sum(2, keepdims=True)).astype(np.float32),
+    target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)
+
+_multidim_multiclass_inputs = Input(
+    preds=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+    target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)

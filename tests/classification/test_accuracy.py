@@ -1,0 +1,126 @@
+"""Accuracy tests vs sklearn (translation of ref tests/classification/test_accuracy.py)."""
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from metrics_tpu import Accuracy
+from metrics_tpu.functional import accuracy
+from tests.helpers.testers import MetricTester, NUM_CLASSES, THRESHOLD
+from tests.classification.inputs import (
+    _binary_inputs,
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multidim_multiclass_inputs,
+    _multidim_multiclass_prob_inputs,
+    _multilabel_inputs,
+    _multilabel_prob_inputs,
+)
+
+
+def _sk_accuracy(preds, target, subset_accuracy=False):
+    """Canonicalize any input mode to sklearn format (mirrors ref test:45-58)."""
+    preds, target = np.asarray(preds), np.asarray(target)
+    if preds.ndim == target.ndim + 1:  # (N, C, ...) probabilities
+        preds = np.argmax(preds, axis=1)
+    elif preds.dtype.kind == "f":  # probabilities, same shape as target
+        preds = (preds >= THRESHOLD).astype(int)
+
+    if preds.ndim > 1 and subset_accuracy:
+        # exact-match over trailing dims
+        sk_preds = preds.reshape(preds.shape[0], -1)
+        sk_target = target.reshape(target.shape[0], -1)
+        return sk_accuracy(sk_target, sk_preds)
+    return sk_accuracy(target.reshape(-1), preds.reshape(-1))
+
+
+@pytest.mark.parametrize(
+    "preds,target,subset_accuracy",
+    [
+        (_binary_prob_inputs.preds, _binary_prob_inputs.target, False),
+        (_binary_inputs.preds, _binary_inputs.target, False),
+        (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, False),
+        (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, True),
+        (_multilabel_inputs.preds, _multilabel_inputs.target, False),
+        (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, False),
+        (_multiclass_inputs.preds, _multiclass_inputs.target, False),
+        (_multidim_multiclass_prob_inputs.preds, _multidim_multiclass_prob_inputs.target, False),
+        (_multidim_multiclass_inputs.preds, _multidim_multiclass_inputs.target, False),
+    ],
+)
+class TestAccuracy(MetricTester):
+    def test_accuracy_class(self, preds, target, subset_accuracy):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            reference_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+            atol=1e-5,
+        )
+
+    def test_accuracy_fn(self, preds, target, subset_accuracy):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=accuracy,
+            reference_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+            atol=1e-5,
+        )
+
+
+@pytest.mark.parametrize(
+    "preds,target,num_classes",
+    [
+        (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, NUM_CLASSES),
+        (_multiclass_inputs.preds, _multiclass_inputs.target, NUM_CLASSES),
+    ],
+)
+def test_accuracy_dist(preds, target, num_classes):
+    MetricTester().run_class_metric_test(
+        preds=preds,
+        target=target,
+        metric_class=Accuracy,
+        reference_metric=lambda p, t: _sk_accuracy(p, t),
+        metric_args={"num_classes": num_classes},
+        dist=True,
+        atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+def test_accuracy_averages(average):
+    """Macro/weighted averages vs sklearn balanced scores."""
+    from sklearn.metrics import recall_score
+
+    preds = _multiclass_inputs.preds
+    target = _multiclass_inputs.target
+
+    def _sk(p, t):
+        if average == "micro":
+            return sk_accuracy(t.reshape(-1), p.reshape(-1))
+        return recall_score(t.reshape(-1), p.reshape(-1), average=average)
+
+    MetricTester().run_class_metric_test(
+        preds=preds,
+        target=target,
+        metric_class=Accuracy,
+        reference_metric=_sk,
+        metric_args={"average": average, "num_classes": NUM_CLASSES},
+        atol=1e-5,
+    )
+
+
+def test_accuracy_topk():
+    target = np.asarray([[0, 1, 2]])
+    preds = np.asarray([[[0.1, 0.9, 0.0], [0.3, 0.1, 0.6], [0.2, 0.5, 0.3]]])
+    import jax.numpy as jnp
+
+    acc = Accuracy(top_k=2)
+    assert np.allclose(np.asarray(acc(jnp.asarray(preds[0]), jnp.asarray(target[0]))), 2 / 3)
+
+
+def test_wrong_average_raises():
+    with pytest.raises(ValueError, match="The `average` has to be one of"):
+        Accuracy(average="wrong")

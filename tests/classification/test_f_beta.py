@@ -1,0 +1,108 @@
+"""F-beta/F1 tests vs sklearn (ref tests/classification/test_f_beta.py)."""
+import numpy as np
+import pytest
+from sklearn.metrics import f1_score as sk_f1_score
+from sklearn.metrics import fbeta_score as sk_fbeta_score
+
+from metrics_tpu import F1Score, FBetaScore
+from metrics_tpu.functional import f1_score, fbeta_score
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import MetricTester, NUM_CLASSES, THRESHOLD
+
+
+def _make_sk(average, beta=None, multilabel=False):
+    def _sk(p, t):
+        p, t = np.asarray(p), np.asarray(t)
+        if multilabel:
+            pb = (p >= THRESHOLD).astype(int).reshape(-1, p.shape[-1])
+            tb = t.reshape(-1, t.shape[-1])
+        else:
+            if p.ndim == t.ndim + 1:
+                p = np.argmax(p, axis=1)
+            elif p.dtype.kind == "f":
+                p = (p >= THRESHOLD).astype(int)
+            pb, tb = p.reshape(-1), t.reshape(-1)
+        if beta is None:
+            return sk_f1_score(tb, pb, average=average, zero_division=0)
+        return sk_fbeta_score(tb, pb, beta=beta, average=average, zero_division=0)
+
+    return _sk
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+@pytest.mark.parametrize(
+    "preds,target,multilabel",
+    [
+        (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, False),
+        (_multiclass_inputs.preds, _multiclass_inputs.target, False),
+        (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, True),
+    ],
+)
+class TestFBeta(MetricTester):
+    def test_f1_class(self, preds, target, multilabel, average):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=F1Score,
+            reference_metric=_make_sk(average, None, multilabel),
+            metric_args={"average": average, "num_classes": NUM_CLASSES, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+    def test_fbeta_class(self, preds, target, multilabel, average):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=FBetaScore,
+            reference_metric=_make_sk(average, 2.0, multilabel),
+            metric_args={"average": average, "beta": 2.0, "num_classes": NUM_CLASSES, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+    def test_f1_fn(self, preds, target, multilabel, average):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=f1_score,
+            reference_metric=_make_sk(average, None, multilabel),
+            metric_args={"average": average, "num_classes": NUM_CLASSES, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+    def test_fbeta_fn(self, preds, target, multilabel, average):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=fbeta_score,
+            reference_metric=_make_sk(average, 0.5, multilabel),
+            metric_args={"average": average, "beta": 0.5, "num_classes": NUM_CLASSES, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+
+def test_f1_dist():
+    MetricTester().run_class_metric_test(
+        preds=_multiclass_inputs.preds,
+        target=_multiclass_inputs.target,
+        metric_class=F1Score,
+        reference_metric=_make_sk("macro"),
+        metric_args={"average": "macro", "num_classes": NUM_CLASSES},
+        dist=True,
+        atol=1e-5,
+    )
+
+
+def test_f1_binary():
+    MetricTester().run_class_metric_test(
+        preds=_binary_prob_inputs.preds,
+        target=_binary_prob_inputs.target,
+        metric_class=F1Score,
+        reference_metric=_make_sk("binary"),
+        metric_args={"threshold": THRESHOLD},
+        atol=1e-5,
+    )

@@ -1,0 +1,78 @@
+"""Hamming distance tests vs sklearn (ref tests/classification/test_hamming_distance.py)."""
+import numpy as np
+import pytest
+from sklearn.metrics import hamming_loss as sk_hamming_loss
+
+from metrics_tpu import HammingDistance
+from metrics_tpu.functional import hamming_distance
+from tests.classification.inputs import (
+    _binary_inputs,
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multilabel_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import MetricTester, THRESHOLD
+
+
+def _sk_hamming(preds, target):
+    p, t = np.asarray(preds), np.asarray(target)
+    if p.ndim == t.ndim + 1:  # (N, C, ...) probs -> onehot compare
+        num_classes = p.shape[1]
+        p = np.argmax(p, axis=1)
+        p_oh = np.eye(num_classes, dtype=int)[p.reshape(-1)]
+        t_oh = np.eye(num_classes, dtype=int)[t.reshape(-1)]
+        return sk_hamming_loss(t_oh, p_oh)
+    if p.dtype.kind == "f":
+        p = (p >= THRESHOLD).astype(int)
+    if t.max(initial=0) > 1 or p.max(initial=0) > 1:  # multiclass labels -> onehot
+        num_classes = int(max(p.max(), t.max())) + 1
+        p_oh = np.eye(num_classes, dtype=int)[p.reshape(-1)]
+        t_oh = np.eye(num_classes, dtype=int)[t.reshape(-1)]
+        return sk_hamming_loss(t_oh, p_oh)
+    return sk_hamming_loss(t.reshape(-1), p.reshape(-1))
+
+
+@pytest.mark.parametrize(
+    "preds,target",
+    [
+        (_binary_prob_inputs.preds, _binary_prob_inputs.target),
+        (_binary_inputs.preds, _binary_inputs.target),
+        (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target),
+        (_multilabel_inputs.preds, _multilabel_inputs.target),
+        (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target),
+        (_multiclass_inputs.preds, _multiclass_inputs.target),
+    ],
+)
+class TestHammingDistance(MetricTester):
+    def test_hamming_class(self, preds, target):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=HammingDistance,
+            reference_metric=_sk_hamming,
+            metric_args={"threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+    def test_hamming_fn(self, preds, target):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=hamming_distance,
+            reference_metric=_sk_hamming,
+            metric_args={"threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+
+def test_hamming_dist():
+    MetricTester().run_class_metric_test(
+        preds=_multilabel_prob_inputs.preds,
+        target=_multilabel_prob_inputs.target,
+        metric_class=HammingDistance,
+        reference_metric=_sk_hamming,
+        dist=True,
+        atol=1e-5,
+    )

@@ -1,0 +1,110 @@
+"""Precision/Recall tests vs sklearn (ref tests/classification/test_precision_recall.py)."""
+import numpy as np
+import pytest
+from sklearn.metrics import precision_score as sk_precision_score
+from sklearn.metrics import recall_score as sk_recall_score
+
+from metrics_tpu import Precision, Recall
+from metrics_tpu.functional import precision, recall
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import MetricTester, NUM_CLASSES, THRESHOLD
+
+
+def _canon(preds, target):
+    preds, target = np.asarray(preds), np.asarray(target)
+    if preds.ndim == target.ndim + 1:
+        preds = np.argmax(preds, axis=1)
+    elif preds.dtype.kind == "f":
+        preds = (preds >= THRESHOLD).astype(int)
+    return preds.reshape(-1), target.reshape(-1)
+
+
+def _make_sk(sk_fn, average, multilabel=False):
+    def _sk(p, t):
+        if multilabel:
+            pb = (np.asarray(p) >= THRESHOLD).astype(int).reshape(-1, np.asarray(p).shape[-1])
+            tb = np.asarray(t).reshape(-1, np.asarray(t).shape[-1])
+            return sk_fn(tb, pb, average=average, zero_division=0)
+        preds, target = _canon(p, t)
+        return sk_fn(target, preds, average=average, zero_division=0)
+
+    return _sk
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+@pytest.mark.parametrize(
+    "preds,target,num_classes,multilabel",
+    [
+        (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, NUM_CLASSES, False),
+        (_multiclass_inputs.preds, _multiclass_inputs.target, NUM_CLASSES, False),
+        (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, NUM_CLASSES, True),
+    ],
+)
+class TestPrecisionRecall(MetricTester):
+    def test_precision_class(self, preds, target, num_classes, multilabel, average):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=Precision,
+            reference_metric=_make_sk(sk_precision_score, average, multilabel),
+            metric_args={"average": average, "num_classes": num_classes, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+    def test_recall_class(self, preds, target, num_classes, multilabel, average):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=Recall,
+            reference_metric=_make_sk(sk_recall_score, average, multilabel),
+            metric_args={"average": average, "num_classes": num_classes, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+    def test_precision_fn(self, preds, target, num_classes, multilabel, average):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=precision,
+            reference_metric=_make_sk(sk_precision_score, average, multilabel),
+            metric_args={"average": average, "num_classes": num_classes, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+    def test_recall_fn(self, preds, target, num_classes, multilabel, average):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=recall,
+            reference_metric=_make_sk(sk_recall_score, average, multilabel),
+            metric_args={"average": average, "num_classes": num_classes, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+
+def test_precision_dist():
+    MetricTester().run_class_metric_test(
+        preds=_multiclass_inputs.preds,
+        target=_multiclass_inputs.target,
+        metric_class=Precision,
+        reference_metric=_make_sk(sk_precision_score, "macro"),
+        metric_args={"average": "macro", "num_classes": NUM_CLASSES},
+        dist=True,
+        atol=1e-5,
+    )
+
+
+def test_binary_precision():
+    MetricTester().run_class_metric_test(
+        preds=_binary_prob_inputs.preds,
+        target=_binary_prob_inputs.target,
+        metric_class=Precision,
+        reference_metric=_make_sk(sk_precision_score, "binary"),
+        metric_args={"threshold": THRESHOLD},
+        atol=1e-5,
+    )

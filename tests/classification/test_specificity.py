@@ -1,0 +1,71 @@
+"""Specificity tests vs hand-written numpy reference (ref tests/classification/test_specificity.py)."""
+import numpy as np
+import pytest
+from sklearn.metrics import multilabel_confusion_matrix
+
+from metrics_tpu import Specificity
+from metrics_tpu.functional import specificity
+from tests.classification.inputs import _multiclass_inputs, _multiclass_prob_inputs
+from tests.helpers.testers import MetricTester, NUM_CLASSES, THRESHOLD
+
+
+def _sk_specificity(preds, target, average):
+    p, t = np.asarray(preds), np.asarray(target)
+    if p.ndim == t.ndim + 1:
+        p = np.argmax(p, axis=1)
+    p, t = p.reshape(-1), t.reshape(-1)
+    cm = multilabel_confusion_matrix(t, p, labels=list(range(NUM_CLASSES)))
+    tn, fp = cm[:, 0, 0].astype(float), cm[:, 0, 1].astype(float)
+    fn, tp = cm[:, 1, 0].astype(float), cm[:, 1, 1].astype(float)
+    if average == "micro":
+        return tn.sum() / (tn.sum() + fp.sum())
+    denom = tn + fp
+    per_class = np.divide(tn, denom, out=np.zeros_like(tn), where=denom != 0)
+    if average == "macro":
+        return per_class.mean()
+    if average == "weighted":
+        # the reference weights specificity by tn+fp (ref specificity.py:64), not support
+        return (per_class * denom / denom.sum()).sum()
+    return per_class
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+@pytest.mark.parametrize(
+    "preds,target",
+    [
+        (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target),
+        (_multiclass_inputs.preds, _multiclass_inputs.target),
+    ],
+)
+class TestSpecificity(MetricTester):
+    def test_specificity_class(self, preds, target, average):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=Specificity,
+            reference_metric=lambda p, t: _sk_specificity(p, t, average),
+            metric_args={"average": average, "num_classes": NUM_CLASSES, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+    def test_specificity_fn(self, preds, target, average):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=specificity,
+            reference_metric=lambda p, t: _sk_specificity(p, t, average),
+            metric_args={"average": average, "num_classes": NUM_CLASSES, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+
+def test_specificity_dist():
+    MetricTester().run_class_metric_test(
+        preds=_multiclass_inputs.preds,
+        target=_multiclass_inputs.target,
+        metric_class=Specificity,
+        reference_metric=lambda p, t: _sk_specificity(p, t, "macro"),
+        metric_args={"average": "macro", "num_classes": NUM_CLASSES},
+        dist=True,
+        atol=1e-5,
+    )
