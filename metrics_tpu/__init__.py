@@ -23,12 +23,24 @@ from metrics_tpu.aggregation import (  # noqa: E402, F401
     SumMetric,
 )
 from metrics_tpu.classification import (  # noqa: E402, F401
+    AUC,
+    AUROC,
     Accuracy,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    CohenKappa,
+    ConfusionMatrix,
     F1Score,
     FBetaScore,
     HammingDistance,
+    JaccardIndex,
+    MatthewsCorrCoef,
     Precision,
+    PrecisionRecallCurve,
     Recall,
+    ROC,
     Specificity,
     StatScores,
 )
@@ -36,8 +48,20 @@ from metrics_tpu.collections import MetricCollection  # noqa: E402, F401
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402, F401
 
 __all__ = [
+    "AUC",
+    "AUROC",
     "Accuracy",
+    "AveragePrecision",
+    "BinnedAveragePrecision",
+    "BinnedPrecisionRecallCurve",
+    "BinnedRecallAtFixedPrecision",
     "CatMetric",
+    "CohenKappa",
+    "ConfusionMatrix",
+    "JaccardIndex",
+    "MatthewsCorrCoef",
+    "PrecisionRecallCurve",
+    "ROC",
     "CompositionalMetric",
     "F1Score",
     "FBetaScore",

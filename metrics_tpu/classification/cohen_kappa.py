@@ -1,0 +1,47 @@
+"""CohenKappa module metric.
+
+Behavioral parity: /root/reference/torchmetrics/classification/cohen_kappa.py
+(104 LoC).
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
+from metrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_compute
+
+Array = jax.Array
+
+
+class CohenKappa(ConfusionMatrix):
+    """Cohen's kappa agreement score (ref cohen_kappa.py:23-104).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CohenKappa
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> cohenkappa = CohenKappa(num_classes=2)
+        >>> float(cohenkappa(preds, target))
+        0.5
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        weights: Optional[str] = None,
+        threshold: float = 0.5,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes=num_classes, normalize=None, threshold=threshold, **kwargs)
+        self.weights = weights
+        allowed_weights = (None, "none", "linear", "quadratic")
+        if weights not in allowed_weights:
+            raise ValueError(f"Argument weights needs to one of the following: {allowed_weights}")
+
+    def compute(self) -> Array:
+        return _cohen_kappa_compute(self.confmat, self.weights)

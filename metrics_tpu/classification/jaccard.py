@@ -1,0 +1,57 @@
+"""JaccardIndex module metric.
+
+Behavioral parity: /root/reference/torchmetrics/classification/jaccard.py
+(102 LoC).
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
+from metrics_tpu.functional.classification.jaccard import _jaccard_from_confmat
+
+Array = jax.Array
+
+
+class JaccardIndex(ConfusionMatrix):
+    """Jaccard index / intersection-over-union (ref jaccard.py:24-102).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import JaccardIndex
+        >>> target = jnp.asarray([[0, 1, 1], [1, 1, 0]])
+        >>> pred = jnp.asarray([[0, 1, 0], [1, 1, 1]])
+        >>> jaccard = JaccardIndex(num_classes=2)
+        >>> round(float(jaccard(pred, target)), 4)
+        0.5833
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        ignore_index: Optional[int] = None,
+        absent_score: float = 0.0,
+        threshold: float = 0.5,
+        multilabel: bool = False,
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes,
+            normalize=None,
+            threshold=threshold,
+            multilabel=multilabel,
+            **kwargs,
+        )
+        self.reduction = reduction
+        self.ignore_index = ignore_index
+        self.absent_score = absent_score
+
+    def compute(self) -> Array:
+        return _jaccard_from_confmat(
+            self.confmat, self.num_classes, self.ignore_index, self.absent_score, self.reduction
+        )

@@ -1,0 +1,53 @@
+"""MatthewsCorrCoef module metric.
+
+Behavioral parity: /root/reference/torchmetrics/classification/
+matthews_corrcoef.py (94 LoC).
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.matthews_corrcoef import (
+    _matthews_corrcoef_compute,
+    _matthews_corrcoef_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MatthewsCorrCoef(Metric):
+    """Matthews correlation coefficient (ref matthews_corrcoef.py:23-94).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MatthewsCorrCoef
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> matthews_corrcoef = MatthewsCorrCoef(num_classes=2)
+        >>> round(float(matthews_corrcoef(preds, target)), 4)
+        0.5774
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        threshold: float = 0.5,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        confmat = _matthews_corrcoef_update(preds, target, self.num_classes, self.threshold)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _matthews_corrcoef_compute(self.confmat)

@@ -1,7 +1,16 @@
 from metrics_tpu.functional.classification.accuracy import accuracy  # noqa: F401
+from metrics_tpu.functional.classification.auc import auc  # noqa: F401
+from metrics_tpu.functional.classification.auroc import auroc  # noqa: F401
+from metrics_tpu.functional.classification.average_precision import average_precision  # noqa: F401
+from metrics_tpu.functional.classification.cohen_kappa import cohen_kappa  # noqa: F401
+from metrics_tpu.functional.classification.confusion_matrix import confusion_matrix  # noqa: F401
 from metrics_tpu.functional.classification.dice import dice_score  # noqa: F401
 from metrics_tpu.functional.classification.f_beta import f1_score, fbeta_score  # noqa: F401
 from metrics_tpu.functional.classification.hamming import hamming_distance  # noqa: F401
+from metrics_tpu.functional.classification.jaccard import jaccard_index  # noqa: F401
+from metrics_tpu.functional.classification.matthews_corrcoef import matthews_corrcoef  # noqa: F401
 from metrics_tpu.functional.classification.precision_recall import precision, precision_recall, recall  # noqa: F401
+from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve  # noqa: F401
+from metrics_tpu.functional.classification.roc import roc  # noqa: F401
 from metrics_tpu.functional.classification.specificity import specificity  # noqa: F401
 from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401

@@ -1,0 +1,63 @@
+"""Area under a curve via the trapezoidal rule.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+auc.py (133 LoC).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _auc_update(x: Array, y: Array) -> Tuple[Array, Array]:
+    """Validate curve coordinates (ref auc.py:20-44)."""
+    if x.ndim > 1:
+        x = jnp.squeeze(x)
+    if y.ndim > 1:
+        y = jnp.squeeze(y)
+    if x.ndim > 1 or y.ndim > 1:
+        raise ValueError(f"Expected both `x` and `y` tensor to be 1d, but got tensors with dimension {x.ndim} and {y.ndim}")
+    if x.size != y.size:
+        raise ValueError(f"Expected the same number of elements in `x` and `y` tensor but received {x.size} and {y.size}")
+    return x, y
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float) -> Array:
+    """Trapezoidal integral assuming monotone x (ref auc.py:46-64)."""
+    return jnp.trapezoid(y, x) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Trapezoidal integral with monotonicity check (ref auc.py:67-101)."""
+    if reorder:
+        x_idx = jnp.argsort(x, stable=True)
+        x, y = x[x_idx], y[x_idx]
+
+    dx = x[1:] - x[:-1]
+    if isinstance(dx, jax.core.Tracer):
+        direction = 1.0  # monotonicity cannot be checked under tracing
+    elif bool((dx < 0).any()):
+        if bool((dx <= 0).all()):
+            direction = -1.0
+        else:
+            raise ValueError("The `x` tensor is neither increasing or decreasing. Try setting the reorder argument to `True`.")
+    else:
+        direction = 1.0
+    return _auc_compute_without_check(x, y, direction)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area Under the Curve by trapezoidal rule (ref auc.py:104-133).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import auc
+        >>> x = jnp.asarray([0, 1, 2, 3])
+        >>> y = jnp.asarray([0, 1, 2, 2])
+        >>> float(auc(x, y))
+        4.0
+    """
+    x, y = _auc_update(x, y)
+    return _auc_compute(x, y, reorder=reorder)

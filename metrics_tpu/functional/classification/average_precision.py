@@ -1,0 +1,111 @@
+"""Average precision functional implementation.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+average_precision.py (235 LoC).
+"""
+import warnings
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.utilities.data import _bincount
+
+Array = jax.Array
+
+
+def _average_precision_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> Tuple[Array, Array, int, Optional[int]]:
+    """Canonicalize AP inputs (ref average_precision.py:27-55)."""
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    if average == "micro":
+        if preds.ndim == target.ndim:
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+            num_classes = 1
+        else:
+            raise ValueError("Cannot use `micro` average with multi-class input")
+    return preds, target, num_classes, pos_label
+
+
+def _average_precision_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    """AP from the PR curve (ref average_precision.py:58-110)."""
+    precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label)
+    if average == "weighted":
+        if preds.ndim == target.ndim and target.ndim > 1:
+            weights = target.sum(axis=0).astype(jnp.float32)
+        else:
+            weights = _bincount(target, minlength=num_classes).astype(jnp.float32)
+        weights = weights / jnp.sum(weights)
+    else:
+        weights = None
+    return _average_precision_compute_with_precision_recall(precision, recall, num_classes, average, weights)
+
+
+def _average_precision_compute_with_precision_recall(
+    precision: Union[Array, List[Array]],
+    recall: Union[Array, List[Array]],
+    num_classes: int,
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Union[List[Array], Array]:
+    """Step-function integral of the PR curve (ref average_precision.py:113-178)."""
+    if num_classes == 1:
+        return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+    res = [-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)]
+
+    if average in ("macro", "weighted"):
+        res_arr = jnp.stack(res)
+        if bool(jnp.isnan(res_arr).any()):
+            warnings.warn(
+                "Average precision score for one or more classes was `nan`. Ignoring these classes in average",
+                UserWarning,
+            )
+        nan_mask = jnp.isnan(res_arr)
+        if average == "macro":
+            return jnp.where(nan_mask, 0.0, res_arr).sum() / jnp.maximum((~nan_mask).sum(), 1)
+        weights = jnp.ones_like(res_arr) if weights is None else weights
+        return jnp.where(nan_mask, 0.0, res_arr * weights).sum()
+    if average is None:
+        return res
+    allowed_average = ("micro", "macro", "weighted", None)
+    raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    """Average precision score (ref average_precision.py:181-235).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import average_precision
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> float(average_precision(pred, target, pos_label=1))
+        1.0
+    """
+    preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label, average)
+    return _average_precision_compute(preds, target, num_classes, pos_label, average, sample_weights)

@@ -1,0 +1,61 @@
+"""Cohen's kappa functional implementation.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+cohen_kappa.py (110 LoC).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _confusion_matrix_compute,
+    _confusion_matrix_update,
+)
+
+Array = jax.Array
+
+_cohen_kappa_update = _confusion_matrix_update
+
+
+def _cohen_kappa_compute(confmat: Array, weights: Optional[str] = None) -> Array:
+    """Cohen's kappa with none/linear/quadratic weighting (ref cohen_kappa.py:24-67)."""
+    confmat = _confusion_matrix_compute(confmat)
+    confmat = confmat.astype(jnp.float32)
+    n_classes = confmat.shape[0]
+    sum0 = confmat.sum(axis=0, keepdims=True)
+    sum1 = confmat.sum(axis=1, keepdims=True)
+    expected = sum1 @ sum0 / sum0.sum()  # outer product of marginals
+
+    if weights is None or weights == "none":
+        w_mat = 1.0 - jnp.eye(n_classes, dtype=confmat.dtype)
+    elif weights in ("linear", "quadratic"):
+        idx = jnp.arange(n_classes, dtype=confmat.dtype)
+        diff = idx[:, None] - idx[None, :]
+        w_mat = jnp.abs(diff) if weights == "linear" else diff**2
+    else:
+        raise ValueError(f"Received {weights} for argument ``weights`` but should be either None, 'linear' or 'quadratic'")
+
+    k = jnp.sum(w_mat * confmat) / jnp.sum(w_mat * expected)
+    return 1 - k
+
+
+def cohen_kappa(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    weights: Optional[str] = None,
+    threshold: float = 0.5,
+) -> Array:
+    """Cohen's kappa score (ref cohen_kappa.py:70-110).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cohen_kappa
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> float(cohen_kappa(preds, target, num_classes=2))
+        0.5
+    """
+    confmat = _cohen_kappa_update(preds, target, num_classes, threshold)
+    return _cohen_kappa_compute(confmat, weights)

@@ -1,0 +1,62 @@
+"""Jaccard index (IoU) functional implementation.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+jaccard.py (129 LoC).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+from metrics_tpu.utilities.distributed import reduce
+
+Array = jax.Array
+
+_jaccard_update = _confusion_matrix_update
+
+
+def _jaccard_from_confmat(
+    confmat: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Intersection-over-union from a confusion matrix (ref jaccard.py:24-68)."""
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        confmat = confmat.at[ignore_index].set(0.0)
+
+    intersection = jnp.diag(confmat)
+    union = confmat.sum(axis=0) + confmat.sum(axis=1) - intersection
+
+    scores = intersection.astype(jnp.float32) / jnp.where(union == 0, 1.0, union.astype(jnp.float32))
+    scores = jnp.where(union == 0, absent_score, scores)
+
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        scores = jnp.concatenate([scores[:ignore_index], scores[ignore_index + 1:]])
+
+    return reduce(scores, reduction=reduction)
+
+
+def jaccard_index(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    threshold: float = 0.5,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Jaccard index / IoU (ref jaccard.py:69-129).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import jaccard_index
+        >>> target = jnp.asarray([[0, 1, 1], [1, 1, 0]])
+        >>> pred = jnp.asarray([[0, 1, 0], [1, 1, 1]])
+        >>> round(float(jaccard_index(pred, target, num_classes=2)), 4)
+        0.5833
+    """
+    confmat = _jaccard_update(preds, target, num_classes, threshold)
+    return _jaccard_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

@@ -1,0 +1,48 @@
+"""Matthews correlation coefficient functional implementation.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+matthews_corrcoef.py (86 LoC).
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+
+Array = jax.Array
+
+_matthews_corrcoef_update = _confusion_matrix_update
+
+
+def _matthews_corrcoef_compute(confmat: Array) -> Array:
+    """MCC from the multiclass confusion matrix (ref matthews_corrcoef.py:22-49)."""
+    tk = confmat.sum(axis=1).astype(jnp.float32)
+    pk = confmat.sum(axis=0).astype(jnp.float32)
+    c = jnp.trace(confmat).astype(jnp.float32)
+    s = confmat.sum().astype(jnp.float32)
+
+    cov_ytyp = c * s - jnp.sum(tk * pk)
+    cov_ypyp = s**2 - jnp.sum(pk * pk)
+    cov_ytyt = s**2 - jnp.sum(tk * tk)
+
+    denom = cov_ypyp * cov_ytyt
+    return jnp.where(denom == 0, 0.0, cov_ytyp / jnp.sqrt(jnp.where(denom == 0, 1.0, denom)))
+
+
+def matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    threshold: float = 0.5,
+) -> Array:
+    """Matthews correlation coefficient (ref matthews_corrcoef.py:51-86).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import matthews_corrcoef
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> round(float(matthews_corrcoef(preds, target, num_classes=2)), 4)
+        0.5774
+    """
+    confmat = _matthews_corrcoef_update(preds, target, num_classes, threshold)
+    return _matthews_corrcoef_compute(confmat)

@@ -1,0 +1,178 @@
+"""Precision-recall curve functional implementation.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+precision_recall_curve.py (331 LoC). The curve algorithm (argsort desc +
+cumsum at distinct thresholds, sklearn's formulation) runs at epoch-end
+``compute`` where dynamic output shapes are fine; for an O(1)-memory,
+fully-static-shape variant use the binned metrics
+(:mod:`metrics_tpu.classification.binned_precision_recall`) — the TPU-native
+default for threshold sweeps.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    sample_weights: Optional[Sequence] = None,
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Cumulative fps/tps at each distinct prediction value, descending
+    (sklearn's _binary_clf_curve algorithm; ref precision_recall_curve.py:23-61)."""
+    if sample_weights is not None and not isinstance(sample_weights, jax.Array):
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    desc_score_indices = jnp.argsort(-preds, stable=True)
+
+    preds = preds[desc_score_indices]
+    target = target[desc_score_indices]
+
+    weight = sample_weights[desc_score_indices] if sample_weights is not None else 1.0
+
+    # indices of distinct prediction values (ends of tied runs) + curve end
+    distinct_value_indices = jnp.nonzero(preds[1:] - preds[:-1])[0]
+    threshold_idxs = jnp.pad(distinct_value_indices, (0, 1), constant_values=target.shape[0] - 1)
+    target = (target == pos_label).astype(jnp.int32)
+    tps = jnp.cumsum(target * weight, axis=0)[threshold_idxs]
+
+    if sample_weights is not None:
+        fps = jnp.cumsum((1 - target) * weight, axis=0)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+
+    return fps, tps, preds[threshold_idxs]
+
+
+def _precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[Array, Array, int, Optional[int]]:
+    """Canonicalize curve inputs (ref precision_recall_curve.py:64-121)."""
+    if preds.ndim == target.ndim:
+        if pos_label is None:
+            pos_label = 1
+        if num_classes is not None and num_classes != 1:
+            # multilabel problem
+            if num_classes != preds.shape[1]:
+                raise ValueError(
+                    f"Argument `num_classes` was set to {num_classes} in"
+                    f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                    " number of classes from predictions"
+                )
+            preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+            target = jnp.swapaxes(target, 0, 1).reshape(num_classes, -1).T
+        else:
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+            num_classes = 1
+    elif preds.ndim == target.ndim + 1:
+        if pos_label is not None:
+            rank_zero_warn(
+                f"Argument `pos_label` should be `None` when running multiclass precision recall curve. Got {pos_label}"
+            )
+        if num_classes != preds.shape[1]:
+            raise ValueError(
+                f"Argument `num_classes` was set to {num_classes} in"
+                f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                " number of classes from predictions"
+            )
+        preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+        target = target.reshape(-1)
+    else:
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+
+    return preds, target, num_classes, pos_label
+
+
+def _precision_recall_curve_compute_single_class(
+    preds: Array,
+    target: Array,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[Array, Array, Array]:
+    """PR pairs for single-class inputs (ref precision_recall_curve.py:124-160)."""
+    fps, tps, thresholds = _binary_clf_curve(preds=preds, target=target, sample_weights=sample_weights, pos_label=pos_label)
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
+
+    # stop once full recall is attained, reverse so recall decreases
+    last_ind = jnp.nonzero(tps == tps[-1])[0][0]
+    sl = slice(0, int(last_ind) + 1)
+
+    precision = jnp.concatenate([precision[sl][::-1], jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([recall[sl][::-1], jnp.zeros(1, dtype=recall.dtype)])
+    thresholds = thresholds[sl][::-1]
+
+    return precision, recall, thresholds
+
+
+def _precision_recall_curve_compute_multi_class(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[List[Array], List[Array], List[Array]]:
+    """Per-class PR pairs (ref precision_recall_curve.py:163-199)."""
+    precision, recall, thresholds = [], [], []
+    for cls in range(num_classes):
+        preds_cls = preds[:, cls]
+        prc_args = dict(preds=preds_cls, target=target, num_classes=1, pos_label=cls, sample_weights=sample_weights)
+        if target.ndim > 1:
+            prc_args.update(dict(target=target[:, cls], pos_label=1))
+        res = precision_recall_curve(**prc_args)
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds.append(res[2])
+    return precision, recall, thresholds
+
+
+def _precision_recall_curve_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Dispatch on class count (ref precision_recall_curve.py:202-244)."""
+    if num_classes == 1:
+        if pos_label is None:
+            pos_label = 1
+        return _precision_recall_curve_compute_single_class(preds, target, pos_label, sample_weights)
+    return _precision_recall_curve_compute_multi_class(preds, target, num_classes, sample_weights)
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Precision-recall pairs at different thresholds (ref precision_recall_curve.py:247-331).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision_recall_curve
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> precision, recall, thresholds = precision_recall_curve(pred, target, pos_label=1)
+        >>> precision
+        Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
+        >>> recall
+        Array([1. , 0.5, 0. , 0. ], dtype=float32)
+        >>> thresholds
+        Array([1., 2., 3.], dtype=float32)
+    """
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

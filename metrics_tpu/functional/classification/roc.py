@@ -1,0 +1,124 @@
+"""ROC curve functional implementation.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+roc.py (282 LoC).
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _roc_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[Array, Array, int, Optional[int]]:
+    """Same canonicalization as the PR curve (ref roc.py:26-46)."""
+    return _precision_recall_curve_update(preds, target, num_classes, pos_label)
+
+
+def _roc_compute_single_class(
+    preds: Array,
+    target: Array,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[Array, Array, Array]:
+    """fpr/tpr/thresholds for a single class (ref roc.py:49-95)."""
+    fps, tps, thresholds = _binary_clf_curve(preds=preds, target=target, sample_weights=sample_weights, pos_label=pos_label)
+    # prepend a point so the curve starts at (0, 0)
+    tps = jnp.concatenate([jnp.zeros(1, dtype=tps.dtype), tps])
+    fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
+    thresholds = jnp.concatenate([thresholds[0][None] + 1, thresholds])
+
+    if bool(fps[-1] <= 0):
+        rank_zero_warn(
+            "No negative samples in targets, false positive value should be meaningless."
+            " Returning zero tensor in false positive score",
+            UserWarning,
+        )
+        fpr = jnp.zeros_like(thresholds)
+    else:
+        fpr = fps / fps[-1]
+
+    if bool(tps[-1] <= 0):
+        rank_zero_warn(
+            "No positive samples in targets, true positive value should be meaningless."
+            " Returning zero tensor in true positive score",
+            UserWarning,
+        )
+        tpr = jnp.zeros_like(thresholds)
+    else:
+        tpr = tps / tps[-1]
+
+    return fpr, tpr, thresholds
+
+
+def _roc_compute_multi_class(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[List[Array], List[Array], List[Array]]:
+    """Per-class fpr/tpr (ref roc.py:98-131)."""
+    fpr, tpr, thresholds = [], [], []
+    for cls in range(num_classes):
+        if preds.shape == target.shape:
+            target_cls = target[:, cls]
+            pos_label = 1
+        else:
+            target_cls = target
+            pos_label = cls
+        res = roc(preds=preds[:, cls], target=target_cls, num_classes=1, pos_label=pos_label, sample_weights=sample_weights)
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds.append(res[2])
+    return fpr, tpr, thresholds
+
+
+def _roc_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Dispatch on class count (ref roc.py:134-186)."""
+    if num_classes == 1 and preds.ndim == 1:
+        if pos_label is None:
+            pos_label = 1
+        return _roc_compute_single_class(preds, target, pos_label, sample_weights)
+    return _roc_compute_multi_class(preds, target, num_classes, sample_weights)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Receiver Operating Characteristic curve (ref roc.py:189-282).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import roc
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> fpr, tpr, thresholds = roc(pred, target, pos_label=1)
+        >>> fpr
+        Array([0., 0., 0., 0., 1.], dtype=float32)
+        >>> tpr
+        Array([0.       , 0.3333333, 0.6666667, 1.       , 1.       ],      dtype=float32)
+    """
+    preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
+    return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -1,0 +1,146 @@
+"""Confusion-matrix family tests vs sklearn."""
+import numpy as np
+import pytest
+from sklearn.metrics import cohen_kappa_score as sk_cohen_kappa
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import jaccard_score as sk_jaccard
+from sklearn.metrics import matthews_corrcoef as sk_matthews
+
+from metrics_tpu import CohenKappa, ConfusionMatrix, JaccardIndex, MatthewsCorrCoef
+from metrics_tpu.functional import cohen_kappa, confusion_matrix, jaccard_index, matthews_corrcoef
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+)
+from tests.helpers.testers import MetricTester, NUM_CLASSES, THRESHOLD
+
+
+def _canon(preds, target, num_classes):
+    p, t = np.asarray(preds), np.asarray(target)
+    if p.ndim == t.ndim + 1:
+        p = np.argmax(p, axis=1)
+    elif p.dtype.kind == "f":
+        p = (p >= THRESHOLD).astype(int)
+    return p.reshape(-1), t.reshape(-1)
+
+
+def _sk_cm(num_classes, normalize=None):
+    def _sk(p, t):
+        p, t = _canon(p, t, num_classes)
+        return sk_confusion_matrix(t, p, labels=list(range(num_classes)), normalize=normalize)
+
+    return _sk
+
+
+@pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+@pytest.mark.parametrize(
+    "preds,target,num_classes",
+    [
+        (_binary_prob_inputs.preds, _binary_prob_inputs.target, 2),
+        (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, NUM_CLASSES),
+        (_multiclass_inputs.preds, _multiclass_inputs.target, NUM_CLASSES),
+    ],
+)
+class TestConfusionMatrix(MetricTester):
+    def test_confusion_matrix_class(self, preds, target, num_classes, normalize):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=ConfusionMatrix,
+            reference_metric=_sk_cm(num_classes, normalize),
+            metric_args={"num_classes": num_classes, "normalize": normalize, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+    def test_confusion_matrix_fn(self, preds, target, num_classes, normalize):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=confusion_matrix,
+            reference_metric=_sk_cm(num_classes, normalize),
+            metric_args={"num_classes": num_classes, "normalize": normalize, "threshold": THRESHOLD},
+            atol=1e-5,
+        )
+
+
+def test_confusion_matrix_dist():
+    MetricTester().run_class_metric_test(
+        preds=_multiclass_inputs.preds,
+        target=_multiclass_inputs.target,
+        metric_class=ConfusionMatrix,
+        reference_metric=_sk_cm(NUM_CLASSES),
+        metric_args={"num_classes": NUM_CLASSES},
+        dist=True,
+        atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+def test_cohen_kappa(weights):
+    def _sk(p, t):
+        p, t = _canon(p, t, NUM_CLASSES)
+        return sk_cohen_kappa(t, p, weights=weights)
+
+    MetricTester().run_class_metric_test(
+        preds=_multiclass_inputs.preds,
+        target=_multiclass_inputs.target,
+        metric_class=CohenKappa,
+        reference_metric=_sk,
+        metric_args={"num_classes": NUM_CLASSES, "weights": weights},
+        atol=1e-5,
+    )
+    MetricTester().run_functional_metric_test(
+        _multiclass_inputs.preds,
+        _multiclass_inputs.target,
+        metric_functional=cohen_kappa,
+        reference_metric=_sk,
+        metric_args={"num_classes": NUM_CLASSES, "weights": weights},
+        atol=1e-5,
+    )
+
+
+def test_matthews_corrcoef():
+    def _sk(p, t):
+        p, t = _canon(p, t, NUM_CLASSES)
+        return sk_matthews(t, p)
+
+    MetricTester().run_class_metric_test(
+        preds=_multiclass_prob_inputs.preds,
+        target=_multiclass_prob_inputs.target,
+        metric_class=MatthewsCorrCoef,
+        reference_metric=_sk,
+        metric_args={"num_classes": NUM_CLASSES},
+        atol=1e-5,
+    )
+    MetricTester().run_functional_metric_test(
+        _multiclass_inputs.preds,
+        _multiclass_inputs.target,
+        metric_functional=matthews_corrcoef,
+        reference_metric=_sk,
+        metric_args={"num_classes": NUM_CLASSES},
+        atol=1e-5,
+    )
+
+
+def test_jaccard():
+    def _sk(p, t):
+        p, t = _canon(p, t, NUM_CLASSES)
+        return sk_jaccard(t, p, average="macro")
+
+    MetricTester().run_class_metric_test(
+        preds=_multiclass_prob_inputs.preds,
+        target=_multiclass_prob_inputs.target,
+        metric_class=JaccardIndex,
+        reference_metric=_sk,
+        metric_args={"num_classes": NUM_CLASSES},
+        atol=1e-5,
+    )
+    MetricTester().run_functional_metric_test(
+        _multiclass_inputs.preds,
+        _multiclass_inputs.target,
+        metric_functional=jaccard_index,
+        reference_metric=_sk,
+        metric_args={"num_classes": NUM_CLASSES},
+        atol=1e-5,
+    )

@@ -1,0 +1,231 @@
+"""Curve-family tests vs sklearn: PR curve, ROC, AUROC, AveragePrecision, AUC, binned variants."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import auc as sk_auc
+from sklearn.metrics import average_precision_score as sk_average_precision
+from sklearn.metrics import precision_recall_curve as sk_precision_recall_curve
+from sklearn.metrics import roc_auc_score as sk_roc_auc
+from sklearn.metrics import roc_curve as sk_roc_curve
+
+from metrics_tpu import (
+    AUC,
+    AUROC,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    PrecisionRecallCurve,
+    ROC,
+)
+from metrics_tpu.functional import auc, auroc, average_precision, precision_recall_curve, roc
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_prob_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import MetricTester, NUM_BATCHES, NUM_CLASSES
+
+
+def _cat(x):
+    return np.concatenate([np.asarray(x[i]) for i in range(NUM_BATCHES)])
+
+
+def _sk_pr_curve_truncated(t, p):
+    """sklearn>=1.x keeps every full-recall point; the reference (and we)
+    keep only the first one (highest threshold). Truncate for comparison."""
+    prec, rec, thr = sk_precision_recall_curve(t, p)
+    full = np.nonzero(rec == rec[0])[0]
+    k = full[-1] if rec[0] == 1.0 else 0
+    return np.concatenate([prec[k:]]), np.concatenate([rec[k:]]), thr[k:]
+
+
+class TestBinaryCurves:
+    preds = _binary_prob_inputs.preds
+    target = _binary_prob_inputs.target
+
+    def test_pr_curve_binary(self):
+        p_all, t_all = _cat(self.preds), _cat(self.target)
+        prec, rec, thr = precision_recall_curve(jnp.asarray(p_all), jnp.asarray(t_all), pos_label=1)
+        sk_prec, sk_rec, sk_thr = _sk_pr_curve_truncated(t_all, p_all)
+        np.testing.assert_allclose(np.asarray(prec), sk_prec, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rec), sk_rec, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(thr), sk_thr, atol=1e-6)
+
+    def test_pr_curve_module_accumulates(self):
+        m = PrecisionRecallCurve(pos_label=1)
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(self.preds[i]), jnp.asarray(self.target[i]))
+        prec, rec, thr = m.compute()
+        sk_prec, sk_rec, sk_thr = _sk_pr_curve_truncated(_cat(self.target), _cat(self.preds))
+        np.testing.assert_allclose(np.asarray(prec), sk_prec, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rec), sk_rec, atol=1e-6)
+
+    def test_roc_binary(self):
+        p_all, t_all = _cat(self.preds), _cat(self.target)
+        fpr, tpr, thr = roc(jnp.asarray(p_all), jnp.asarray(t_all), pos_label=1)
+        sk_fpr, sk_tpr, sk_thr = sk_roc_curve(t_all, p_all, drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+    def test_roc_module(self):
+        m = ROC(pos_label=1)
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(self.preds[i]), jnp.asarray(self.target[i]))
+        fpr, tpr, _ = m.compute()
+        sk_fpr, sk_tpr, _ = sk_roc_curve(_cat(self.target), _cat(self.preds), drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+    def test_auroc_binary(self):
+        MetricTester().run_class_metric_test(
+            preds=self.preds,
+            target=self.target,
+            metric_class=AUROC,
+            reference_metric=lambda p, t: sk_roc_auc(np.asarray(t).reshape(-1), np.asarray(p).reshape(-1)),
+            metric_args={"pos_label": 1},
+            atol=1e-5,
+        )
+
+    @pytest.mark.parametrize("max_fpr", [0.5, 0.2])
+    def test_auroc_max_fpr(self, max_fpr):
+        p_all, t_all = _cat(self.preds), _cat(self.target)
+        val = auroc(jnp.asarray(p_all), jnp.asarray(t_all), pos_label=1, max_fpr=max_fpr)
+        sk_val = sk_roc_auc(t_all, p_all, max_fpr=max_fpr)
+        np.testing.assert_allclose(np.asarray(val), sk_val, atol=1e-5)
+
+    def test_average_precision_binary(self):
+        MetricTester().run_class_metric_test(
+            preds=self.preds,
+            target=self.target,
+            metric_class=AveragePrecision,
+            reference_metric=lambda p, t: sk_average_precision(np.asarray(t).reshape(-1), np.asarray(p).reshape(-1)),
+            metric_args={"pos_label": 1},
+            atol=1e-5,
+        )
+
+
+class TestMulticlassCurves:
+    preds = _multiclass_prob_inputs.preds
+    target = _multiclass_prob_inputs.target
+
+    def test_auroc_multiclass(self):
+        def _sk(p, t):
+            return sk_roc_auc(np.asarray(t), np.asarray(p), multi_class="ovr", average="macro",
+                              labels=list(range(NUM_CLASSES)))
+
+        MetricTester().run_class_metric_test(
+            preds=self.preds,
+            target=self.target,
+            metric_class=AUROC,
+            reference_metric=_sk,
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+            atol=1e-5,
+        )
+
+    def test_auroc_multiclass_dist(self):
+        def _sk(p, t):
+            return sk_roc_auc(np.asarray(t), np.asarray(p), multi_class="ovr", average="macro",
+                              labels=list(range(NUM_CLASSES)))
+
+        MetricTester().run_class_metric_test(
+            preds=self.preds,
+            target=self.target,
+            metric_class=AUROC,
+            reference_metric=_sk,
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+            dist=True,
+            atol=1e-5,
+        )
+
+    def test_average_precision_multiclass(self):
+        p_all, t_all = _cat(self.preds), _cat(self.target)
+        res = average_precision(jnp.asarray(p_all), jnp.asarray(t_all), num_classes=NUM_CLASSES, average=None)
+        t_oh = np.eye(NUM_CLASSES)[t_all]
+        for c in range(NUM_CLASSES):
+            sk_val = sk_average_precision(t_oh[:, c], p_all[:, c])
+            np.testing.assert_allclose(np.asarray(res[c]), sk_val, atol=1e-5)
+
+    def test_pr_curve_multiclass(self):
+        p_all, t_all = _cat(self.preds), _cat(self.target)
+        precs, recs, thrs = precision_recall_curve(jnp.asarray(p_all), jnp.asarray(t_all), num_classes=NUM_CLASSES)
+        t_oh = np.eye(NUM_CLASSES)[t_all]
+        for c in range(NUM_CLASSES):
+            sk_prec, sk_rec, _ = _sk_pr_curve_truncated(t_oh[:, c], p_all[:, c])
+            np.testing.assert_allclose(np.asarray(precs[c]), sk_prec, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(recs[c]), sk_rec, atol=1e-6)
+
+
+class TestMultilabelAUROC:
+    preds = _multilabel_prob_inputs.preds
+    target = _multilabel_prob_inputs.target
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    def test_auroc_multilabel(self, average):
+        def _sk(p, t):
+            p = np.asarray(p).reshape(-1, NUM_CLASSES)
+            t = np.asarray(t).reshape(-1, NUM_CLASSES)
+            return sk_roc_auc(t, p, average=average)
+
+        MetricTester().run_class_metric_test(
+            preds=self.preds,
+            target=self.target,
+            metric_class=AUROC,
+            reference_metric=_sk,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            atol=1e-5,
+        )
+
+
+def test_auc():
+    x = np.sort(np.random.rand(4, 16).astype(np.float32), axis=1)
+    y = np.random.rand(4, 16).astype(np.float32)
+    m = AUC()
+    # functional matches sklearn per batch
+    for i in range(4):
+        np.testing.assert_allclose(np.asarray(auc(jnp.asarray(x[i]), jnp.asarray(y[i]))), sk_auc(x[i], y[i]), atol=1e-6)
+    m.update(jnp.asarray(x[0]), jnp.asarray(y[0]))
+    np.testing.assert_allclose(np.asarray(m.compute()), sk_auc(x[0], y[0]), atol=1e-6)
+
+
+class TestBinned:
+    def test_binned_pr_curve_binary_matches_exact_with_dense_thresholds(self):
+        preds = _binary_prob_inputs.preds
+        target = _binary_prob_inputs.target
+        p_all, t_all = _cat(preds), _cat(target)
+
+        m = BinnedAveragePrecision(num_classes=1, thresholds=jnp.asarray(np.sort(np.unique(p_all))))
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        sk_val = sk_average_precision(t_all, p_all)
+        np.testing.assert_allclose(np.asarray(m.compute()), sk_val, atol=1e-3)
+
+    def test_binned_recall_at_fixed_precision(self):
+        pred = jnp.asarray([0, 0.2, 0.5, 0.8])
+        target = jnp.asarray([0, 1, 1, 0])
+        m = BinnedRecallAtFixedPrecision(num_classes=1, thresholds=10, min_precision=0.5)
+        recall, thr = m(pred, target)
+        np.testing.assert_allclose(np.asarray(recall), 1.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(thr), 1 / 9, atol=1e-5)
+
+    def test_binned_pr_curve_multiclass_shapes(self):
+        m = BinnedPrecisionRecallCurve(num_classes=NUM_CLASSES, thresholds=20)
+        preds = _multiclass_prob_inputs.preds
+        target = _multiclass_prob_inputs.target
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        precs, recs, thrs = m.compute()
+        assert len(precs) == NUM_CLASSES
+        assert all(p.shape == (21,) for p in precs)
+
+    def test_binned_dist(self):
+        """Binned states are fixed-shape -> exact single-collective sync."""
+        MetricTester().run_class_metric_test(
+            preds=_binary_prob_inputs.preds,
+            target=_binary_prob_inputs.target,
+            metric_class=BinnedAveragePrecision,
+            reference_metric=lambda p, t: sk_average_precision(np.asarray(t).reshape(-1), np.asarray(p).reshape(-1)),
+            metric_args={"num_classes": 1, "thresholds": 400},
+            dist=True,
+            atol=1e-2,
+        )
