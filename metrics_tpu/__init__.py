@@ -30,8 +30,14 @@ from metrics_tpu.classification import (  # noqa: E402, F401
     BinnedAveragePrecision,
     BinnedPrecisionRecallCurve,
     BinnedRecallAtFixedPrecision,
+    CalibrationError,
     CohenKappa,
     ConfusionMatrix,
+    CoverageError,
+    HingeLoss,
+    KLDivergence,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
     F1Score,
     FBetaScore,
     HammingDistance,
@@ -56,8 +62,14 @@ __all__ = [
     "BinnedPrecisionRecallCurve",
     "BinnedRecallAtFixedPrecision",
     "CatMetric",
+    "CalibrationError",
     "CohenKappa",
     "ConfusionMatrix",
+    "CoverageError",
+    "HingeLoss",
+    "KLDivergence",
+    "LabelRankingAveragePrecision",
+    "LabelRankingLoss",
     "JaccardIndex",
     "MatthewsCorrCoef",
     "PrecisionRecallCurve",

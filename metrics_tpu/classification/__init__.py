@@ -1,3 +1,11 @@
+from metrics_tpu.classification.calibration_error import CalibrationError  # noqa: F401
+from metrics_tpu.classification.hinge import HingeLoss  # noqa: F401
+from metrics_tpu.classification.kl_divergence import KLDivergence  # noqa: F401
+from metrics_tpu.classification.ranking import (  # noqa: F401
+    CoverageError,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
+)
 from metrics_tpu.classification.accuracy import Accuracy  # noqa: F401
 from metrics_tpu.classification.auc import AUC  # noqa: F401
 from metrics_tpu.classification.auroc import AUROC  # noqa: F401

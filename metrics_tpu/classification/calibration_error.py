@@ -1,0 +1,47 @@
+"""CalibrationError module metric.
+
+Behavioral parity: /root/reference/torchmetrics/classification/
+calibration_error.py (105 LoC).
+"""
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.calibration_error import _ce_compute, _ce_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CalibrationError(Metric):
+    """Top-label calibration error: ECE ('l1'), MCE ('max'), RMSCE ('l2')
+    (ref calibration_error.py:24-105)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    DISTANCES = {"l1", "l2", "max"}
+
+    def __init__(self, n_bins: int = 15, norm: str = "l1", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if norm not in self.DISTANCES:
+            raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+        if not isinstance(n_bins, int) or n_bins <= 0:
+            raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
+        self.n_bins = n_bins
+        self.norm = norm
+        self.bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
+        self.add_state("confidences", [], dist_reduce_fx="cat")
+        self.add_state("accuracies", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        confidences, accuracies = _ce_update(preds, target)
+        self.confidences.append(confidences)
+        self.accuracies.append(accuracies)
+
+    def compute(self) -> Array:
+        confidences = dim_zero_cat(self.confidences)
+        accuracies = dim_zero_cat(self.accuracies)
+        return _ce_compute(confidences, accuracies, self.bin_boundaries, norm=self.norm)

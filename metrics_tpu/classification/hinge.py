@@ -1,0 +1,58 @@
+"""HingeLoss module metric.
+
+Behavioral parity: /root/reference/torchmetrics/classification/hinge.py (123 LoC).
+"""
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hinge import MulticlassMode, _hinge_compute, _hinge_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class HingeLoss(Metric):
+    """Mean hinge loss (ref hinge.py:24-123).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HingeLoss
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
+        >>> hinge = HingeLoss()
+        >>> round(float(hinge(preds, target)), 4)
+        0.3
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        squared: bool = False,
+        multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.add_state("measure", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+        if multiclass_mode not in (None, MulticlassMode.CRAMMER_SINGER, MulticlassMode.ONE_VS_ALL):
+            raise ValueError(
+                "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+                "(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL,"
+                f" got {multiclass_mode}."
+            )
+        self.squared = squared
+        self.multiclass_mode = multiclass_mode
+
+    def update(self, preds: Array, target: Array) -> None:
+        measure, total = _hinge_update(preds, target, squared=self.squared, multiclass_mode=self.multiclass_mode)
+        self.measure = measure + self.measure
+        self.total = total + self.total
+
+    def compute(self) -> Array:
+        return _hinge_compute(self.measure, self.total)

@@ -1,0 +1,97 @@
+"""Multilabel ranking module metrics.
+
+Behavioral parity: /root/reference/torchmetrics/classification/ranking.py
+(192 LoC).
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.ranking import (
+    _coverage_error_compute,
+    _coverage_error_update,
+    _label_ranking_average_precision_compute,
+    _label_ranking_average_precision_update,
+    _label_ranking_loss_compute,
+    _label_ranking_loss_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class CoverageError(Metric):
+    """Multilabel coverage error (ref ranking.py:26-85)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("coverage", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numel", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("weight", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        coverage, numel, sample_weight = _coverage_error_update(preds, target, sample_weight)
+        self.coverage = self.coverage + coverage
+        self.numel = self.numel + numel
+        if sample_weight is not None:
+            self.weight = self.weight + sample_weight
+
+    def compute(self) -> Array:
+        return _coverage_error_compute(self.coverage, self.numel, self.weight if bool(self.weight != 0) else None)
+
+
+class LabelRankingAveragePrecision(Metric):
+    """Label ranking average precision (ref ranking.py:88-141)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numel", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sample_weight", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        score, numel, sample_weight = _label_ranking_average_precision_update(preds, target, sample_weight)
+        self.score = self.score + score
+        self.numel = self.numel + numel
+        if sample_weight is not None:
+            self.sample_weight = self.sample_weight + sample_weight
+
+    def compute(self) -> Array:
+        return _label_ranking_average_precision_compute(
+            self.score, self.numel, self.sample_weight if bool(self.sample_weight != 0) else None
+        )
+
+
+class LabelRankingLoss(Metric):
+    """Label ranking loss (ref ranking.py:144-192)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("loss", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numel", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sample_weight", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        loss, numel, sample_weight = _label_ranking_loss_update(preds, target, sample_weight)
+        self.loss = self.loss + loss
+        self.numel = self.numel + numel
+        if sample_weight is not None:
+            self.sample_weight = self.sample_weight + sample_weight
+
+    def compute(self) -> Array:
+        return _label_ranking_loss_compute(
+            self.loss, self.numel, self.sample_weight if bool(self.sample_weight != 0) else None
+        )

@@ -1,3 +1,11 @@
+from metrics_tpu.functional.classification.calibration_error import calibration_error  # noqa: F401
+from metrics_tpu.functional.classification.hinge import hinge_loss  # noqa: F401
+from metrics_tpu.functional.classification.kl_divergence import kl_divergence  # noqa: F401
+from metrics_tpu.functional.classification.ranking import (  # noqa: F401
+    coverage_error,
+    label_ranking_average_precision,
+    label_ranking_loss,
+)
 from metrics_tpu.functional.classification.accuracy import accuracy  # noqa: F401
 from metrics_tpu.functional.classification.auc import auc  # noqa: F401
 from metrics_tpu.functional.classification.auroc import auroc  # noqa: F401

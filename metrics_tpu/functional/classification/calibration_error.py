@@ -1,0 +1,96 @@
+"""Top-label calibration error (ECE/MCE/RMSCE) functional implementation.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+calibration_error.py (208 LoC). Binning is a single deterministic
+searchsorted + scatter-add — jit-clean fixed shapes (the reference's
+fallback loops over bins in Python).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.enums import DataType
+
+Array = jax.Array
+
+
+def _binning_bucketize(
+    confidences: Array, accuracies: Array, bin_boundaries: Array
+) -> Tuple[Array, Array, Array]:
+    """Per-bin accuracy/confidence/proportion via scatter-add (ref :51-80)."""
+    n_bins = bin_boundaries.shape[0] - 1
+    indices = jnp.clip(jnp.searchsorted(bin_boundaries, confidences, side="left") - 1, 0, n_bins - 1)
+
+    count_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(1.0)
+    conf_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(confidences)
+    acc_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(accuracies)
+
+    safe = jnp.where(count_bin == 0, 1.0, count_bin)
+    conf_bin = jnp.where(count_bin == 0, 0.0, conf_bin / safe)
+    acc_bin = jnp.where(count_bin == 0, 0.0, acc_bin / safe)
+    prop_bin = count_bin / count_bin.sum()
+    return acc_bin, conf_bin, prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    bin_boundaries: Array,
+    norm: str = "l1",
+    debias: bool = False,
+) -> Array:
+    """Calibration error under the given norm (ref :83-126)."""
+    if norm not in {"l1", "l2", "max"}:
+        raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
+
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    # l2
+    ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
+    if debias:
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * confidences.shape[0] - 1)
+        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
+    return jnp.where(ce > 0, jnp.sqrt(jnp.where(ce > 0, ce, 1.0)), 0.0)
+
+
+def _ce_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-1 confidences and their correctness (ref :129-161)."""
+    _, _, mode = _input_format_classification(preds, target)
+
+    if mode == DataType.BINARY:
+        confidences, accuracies = preds, target
+    elif mode == DataType.MULTICLASS:
+        confidences = preds.max(axis=1)
+        predictions = preds.argmax(axis=1)
+        accuracies = predictions == target
+    elif mode == DataType.MULTIDIM_MULTICLASS:
+        flat = jnp.swapaxes(preds, 1, -1).reshape(-1, preds.shape[1])
+        confidences = flat.max(axis=1)
+        predictions = flat.argmax(axis=1)
+        accuracies = predictions == target.reshape(-1)
+    else:
+        raise ValueError(
+            f"Calibration error is not well-defined for data with size {preds.shape} and targets {target.shape}."
+        )
+    return confidences.astype(jnp.float32), accuracies.astype(jnp.float32)
+
+
+def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str = "l1") -> Array:
+    """Top-label calibration error (ref :164-208).
+
+    L1 norm = ECE, max norm = MCE, L2 norm = RMSCE.
+    """
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+    if not isinstance(n_bins, int) or n_bins <= 0:
+        raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
+
+    confidences, accuracies = _ce_update(preds, target)
+    bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
+    return _ce_compute(confidences, accuracies, bin_boundaries, norm=norm)

@@ -1,0 +1,149 @@
+"""Multilabel ranking metrics: coverage error, LRAP, label ranking loss.
+
+Behavioral parity: /root/reference/torchmetrics/functional/classification/
+ranking.py (242 LoC). The reference computes LRAP with a Python loop over
+samples; here ranks come from one batched pairwise comparison
+``preds[:, :, None] <= preds[:, None, :]`` — O(N·L²) fused device work
+instead of N host iterations (L is small for multilabel problems).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _rank_data(x: Array) -> Array:
+    """Max-rank of each element among the 1D input (ties get the highest rank).
+
+    Equivalent to ref ranking.py:19-25 (unique + cumsum-of-counts) without the
+    dynamic-shape ``unique``: rank(x_i) = #{j : x_j <= x_i}.
+    """
+    return jnp.sum(x[None, :] <= x[:, None], axis=1)
+
+
+def _check_ranking_input(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+    """Parity: ref ranking.py:28-42."""
+    if preds.ndim != 2 or target.ndim != 2:
+        raise ValueError(
+            "Expected both predictions and target to matrices of shape `[N,C]`"
+            f" but got {preds.ndim} and {target.ndim}"
+        )
+    if preds.shape != target.shape:
+        raise ValueError("Expected both predictions and target to have same shape")
+    if sample_weight is not None:
+        if sample_weight.ndim != 1 or sample_weight.shape[0] != preds.shape[0]:
+            raise ValueError(
+                "Expected sample weights to be 1 dimensional and have same size"
+                f" as the first dimension of preds and target but got {sample_weight.shape}"
+            )
+
+
+def _coverage_error_update(
+    preds: Array, target: Array, sample_weight: Optional[Array] = None
+) -> Tuple[Array, int, Optional[Array]]:
+    """Parity: ref ranking.py:45-64."""
+    _check_ranking_input(preds, target, sample_weight)
+    offset = jnp.where(target == 0, jnp.abs(preds.min()) + 10, 0.0)  # any number > 1 works
+    preds_mod = preds + offset
+    preds_min = preds_mod.min(axis=1)
+    coverage = (preds >= preds_min[:, None]).sum(axis=1).astype(jnp.float32)
+    if isinstance(sample_weight, jax.Array):
+        coverage = coverage * sample_weight
+        sample_weight = sample_weight.sum()
+    return coverage.sum(), coverage.size, sample_weight
+
+
+def _coverage_error_compute(coverage: Array, n_elements: int, sample_weight: Optional[Array] = None) -> Array:
+    if sample_weight is not None and sample_weight != 0.0:
+        return coverage / sample_weight
+    return coverage / n_elements
+
+
+def coverage_error(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
+    """Multilabel coverage error (ref ranking.py:73-100)."""
+    coverage, n_elements, sample_weight = _coverage_error_update(preds, target, sample_weight)
+    return _coverage_error_compute(coverage, n_elements, sample_weight)
+
+
+def _label_ranking_average_precision_update(
+    preds: Array, target: Array, sample_weight: Optional[Array] = None
+) -> Tuple[Array, int, Optional[Array]]:
+    """Vectorized LRAP accumulation (semantics of ref ranking.py:103-131).
+
+    For each relevant label: (rank among relevant) / (rank among all), with
+    max-rank tie handling, averaged per sample; samples with zero or all
+    labels relevant score 1.
+    """
+    _check_ranking_input(preds, target, sample_weight)
+    n_preds, n_labels = preds.shape
+    relevant = target == 1
+    n_rel = relevant.sum(axis=1)
+
+    # pairwise: geq[i, j, k] = preds[i, k] >= preds[i, j]  (max-rank in -preds space)
+    geq = preds[:, None, :] >= preds[:, :, None]
+    rank_all = geq.sum(axis=2).astype(jnp.float32)  # (N, L)
+    rank_rel = (geq & relevant[:, None, :] & relevant[:, :, None]).sum(axis=2).astype(jnp.float32)
+
+    per_label = jnp.where(relevant, rank_rel / rank_all, 0.0)
+    score_idx = per_label.sum(axis=1) / jnp.maximum(n_rel, 1)
+    score_idx = jnp.where((n_rel == 0) | (n_rel == n_labels), 1.0, score_idx)
+
+    if sample_weight is not None:
+        score = (score_idx * sample_weight).sum()
+        sample_weight = sample_weight.sum()
+    else:
+        score = score_idx.sum()
+    return score, n_preds, sample_weight
+
+
+def _label_ranking_average_precision_compute(
+    score: Array, n_elements: int, sample_weight: Optional[Array] = None
+) -> Array:
+    if sample_weight is not None and sample_weight != 0.0:
+        return score / sample_weight
+    return score / n_elements
+
+
+def label_ranking_average_precision(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
+    """Label ranking average precision for multilabel data (ref ranking.py:141-169)."""
+    score, n_elements, sample_weight = _label_ranking_average_precision_update(preds, target, sample_weight)
+    return _label_ranking_average_precision_compute(score, n_elements, sample_weight)
+
+
+def _label_ranking_loss_update(
+    preds: Array, target: Array, sample_weight: Optional[Array] = None
+) -> Tuple[Array, int, Optional[Array]]:
+    """Parity: ref ranking.py:172-203, masking instead of boolean row removal."""
+    _check_ranking_input(preds, target, sample_weight)
+    n_preds, n_labels = preds.shape
+    relevant = target == 1
+    n_relevant = relevant.sum(axis=1)
+
+    # rows where all or none of the labels are relevant contribute zero
+    mask = (n_relevant > 0) & (n_relevant < n_labels)
+
+    inverse = jnp.argsort(jnp.argsort(preds, axis=1), axis=1)
+    per_label_loss = ((n_labels - inverse) * relevant).astype(jnp.float32)
+    correction = 0.5 * n_relevant * (n_relevant + 1)
+    denom = n_relevant * (n_labels - n_relevant)
+    safe_denom = jnp.where(mask, denom, 1)
+    loss = jnp.where(mask, (per_label_loss.sum(axis=1) - correction) / safe_denom, 0.0)
+
+    if isinstance(sample_weight, jax.Array):
+        loss = loss * jnp.where(mask, sample_weight, 0.0)
+        sample_weight = sample_weight.sum()
+    return loss.sum(), n_preds, sample_weight
+
+
+def _label_ranking_loss_compute(loss: Array, n_elements: int, sample_weight: Optional[Array] = None) -> Array:
+    if sample_weight is not None and sample_weight != 0.0:
+        return loss / sample_weight
+    return loss / n_elements
+
+
+def label_ranking_loss(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
+    """Label ranking loss for multilabel data (ref ranking.py:212-242)."""
+    loss, n_element, sample_weight = _label_ranking_loss_update(preds, target, sample_weight)
+    return _label_ranking_loss_compute(loss, n_element, sample_weight)

@@ -1,0 +1,205 @@
+"""Tests for hinge, calibration error, KL divergence, and ranking metrics."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.special import softmax
+from sklearn.metrics import coverage_error as sk_coverage_error
+from sklearn.metrics import hinge_loss as sk_hinge_loss
+from sklearn.metrics import label_ranking_average_precision_score as sk_lrap
+from sklearn.metrics import label_ranking_loss as sk_lr_loss
+
+from metrics_tpu import (
+    CalibrationError,
+    CoverageError,
+    HingeLoss,
+    KLDivergence,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
+)
+from metrics_tpu.functional import (
+    calibration_error,
+    coverage_error,
+    hinge_loss,
+    kl_divergence,
+    label_ranking_average_precision,
+    label_ranking_loss,
+)
+from tests.classification.inputs import (
+    _multiclass_prob_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import MetricTester, NUM_BATCHES, NUM_CLASSES
+
+
+def _cat(x):
+    return np.concatenate([np.asarray(x[i]) for i in range(NUM_BATCHES)])
+
+
+class TestHinge(MetricTester):
+    def test_binary_hinge(self):
+        np.random.seed(7)
+        preds = np.random.randn(NUM_BATCHES, 32).astype(np.float32)
+        target = np.random.randint(0, 2, (NUM_BATCHES, 32))
+
+        def _sk(p, t):
+            return sk_hinge_loss(np.asarray(t), np.asarray(p), labels=[0, 1])
+
+        self.run_class_metric_test(
+            preds=preds, target=target, metric_class=HingeLoss, reference_metric=_sk, atol=1e-5
+        )
+        self.run_functional_metric_test(
+            preds, target, metric_functional=hinge_loss, reference_metric=_sk, atol=1e-5
+        )
+
+    def test_multiclass_hinge_crammer_singer(self):
+        np.random.seed(8)
+        preds = np.random.randn(NUM_BATCHES, 32, NUM_CLASSES).astype(np.float32)
+        target = np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, 32))
+
+        def _sk(p, t):
+            return sk_hinge_loss(np.asarray(t), np.asarray(p), labels=list(range(NUM_CLASSES)))
+
+        self.run_class_metric_test(
+            preds=preds, target=target, metric_class=HingeLoss, reference_metric=_sk, atol=1e-5
+        )
+
+    def test_hinge_dist(self):
+        np.random.seed(9)
+        preds = np.random.randn(NUM_BATCHES, 32).astype(np.float32)
+        target = np.random.randint(0, 2, (NUM_BATCHES, 32))
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=HingeLoss,
+            reference_metric=lambda p, t: sk_hinge_loss(np.asarray(t), np.asarray(p), labels=[0, 1]),
+            dist=True,
+            atol=1e-5,
+        )
+
+
+def _np_ece(probs, target, n_bins=15, norm="l1"):
+    """Hand-written ECE/MCE reference (like ref tests' reference_metrics)."""
+    conf = probs.max(-1)
+    acc = (probs.argmax(-1) == target).astype(float)
+    bins = np.linspace(0, 1, n_bins + 1)
+    idx = np.clip(np.searchsorted(bins, conf, side="left") - 1, 0, n_bins - 1)
+    ce = []
+    weights = []
+    for b in range(n_bins):
+        m = idx == b
+        if m.sum() > 0:
+            ce.append(abs(acc[m].mean() - conf[m].mean()))
+            weights.append(m.mean())
+    ce, weights = np.asarray(ce), np.asarray(weights)
+    if norm == "l1":
+        return (ce * weights).sum()
+    if norm == "max":
+        return ce.max()
+    return np.sqrt(((ce**2) * weights).sum())
+
+
+@pytest.mark.parametrize("norm", ["l1", "max", "l2"])
+class TestCalibrationError(MetricTester):
+    def test_ce_multiclass(self, norm):
+        preds = _multiclass_prob_inputs.preds
+        target = _multiclass_prob_inputs.target
+
+        def _sk(p, t):
+            return _np_ece(np.asarray(p), np.asarray(t), norm=norm)
+
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=CalibrationError,
+            reference_metric=_sk,
+            metric_args={"norm": norm},
+            atol=1e-5,
+        )
+        self.run_functional_metric_test(
+            preds, target, metric_functional=calibration_error, reference_metric=_sk,
+            metric_args={"norm": norm}, atol=1e-5,
+        )
+
+
+class TestKLDivergence(MetricTester):
+    p = softmax(np.random.randn(NUM_BATCHES, 32, 8), -1).astype(np.float32)
+    q = softmax(np.random.randn(NUM_BATCHES, 32, 8), -1).astype(np.float32)
+
+    @staticmethod
+    def _sk(p, q):
+        p, q = np.asarray(p, dtype=np.float64), np.asarray(q, dtype=np.float64)
+        p = p / p.sum(-1, keepdims=True)
+        q = np.clip(q / q.sum(-1, keepdims=True), 1e-6, None)
+        return (p * np.log(p / q)).sum(-1).mean()
+
+    def test_kld(self):
+        self.run_class_metric_test(
+            preds=self.p, target=self.q, metric_class=KLDivergence, reference_metric=self._sk, atol=1e-5
+        )
+        self.run_functional_metric_test(
+            self.p, self.q, metric_functional=kl_divergence, reference_metric=self._sk, atol=1e-5
+        )
+
+    def test_kld_log_prob(self):
+        logp, logq = np.log(self.p), np.log(self.q)
+
+        def _sk_log(lp, lq):
+            lp, lq = np.asarray(lp, dtype=np.float64), np.asarray(lq, dtype=np.float64)
+            return (np.exp(lp) * (lp - lq)).sum(-1).mean()
+
+        self.run_functional_metric_test(
+            logp, logq, metric_functional=kl_divergence, reference_metric=_sk_log,
+            metric_args={"log_prob": True}, atol=1e-5,
+        )
+
+
+class TestRanking(MetricTester):
+    preds = _multilabel_prob_inputs.preds
+    target = _multilabel_prob_inputs.target
+
+    def test_coverage_error(self):
+        def _sk(p, t):
+            return sk_coverage_error(np.asarray(t), np.asarray(p))
+
+        self.run_class_metric_test(
+            preds=self.preds, target=self.target, metric_class=CoverageError, reference_metric=_sk, atol=1e-5
+        )
+        self.run_functional_metric_test(
+            self.preds, self.target, metric_functional=coverage_error, reference_metric=_sk, atol=1e-5
+        )
+
+    def test_lrap(self):
+        def _sk(p, t):
+            return sk_lrap(np.asarray(t), np.asarray(p))
+
+        self.run_class_metric_test(
+            preds=self.preds,
+            target=self.target,
+            metric_class=LabelRankingAveragePrecision,
+            reference_metric=_sk,
+            atol=1e-5,
+        )
+        self.run_functional_metric_test(
+            self.preds, self.target, metric_functional=label_ranking_average_precision, reference_metric=_sk, atol=1e-5
+        )
+
+    def test_label_ranking_loss(self):
+        def _sk(p, t):
+            return sk_lr_loss(np.asarray(t), np.asarray(p))
+
+        self.run_class_metric_test(
+            preds=self.preds, target=self.target, metric_class=LabelRankingLoss, reference_metric=_sk, atol=1e-5
+        )
+        self.run_functional_metric_test(
+            self.preds, self.target, metric_functional=label_ranking_loss, reference_metric=_sk, atol=1e-5
+        )
+
+    def test_ranking_dist(self):
+        self.run_class_metric_test(
+            preds=self.preds,
+            target=self.target,
+            metric_class=LabelRankingLoss,
+            reference_metric=lambda p, t: sk_lr_loss(np.asarray(t), np.asarray(p)),
+            dist=True,
+            atol=1e-5,
+        )
