@@ -53,6 +53,21 @@ from metrics_tpu.classification import (  # noqa: E402, F401
 from metrics_tpu.collections import MetricCollection  # noqa: E402, F401
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402, F401
 
+from metrics_tpu.regression import (  # noqa: E402, F401
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+
 __all__ = [
     "AUC",
     "AUROC",
@@ -87,5 +102,16 @@ __all__ = [
     "Recall",
     "Specificity",
     "StatScores",
-    "SumMetric",
+    "SumMetric",    "CosineSimilarity",
+    "ExplainedVariance",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "PearsonCorrCoef",
+    "R2Score",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
 ]

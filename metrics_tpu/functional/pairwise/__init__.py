@@ -1,0 +1,6 @@
+from metrics_tpu.functional.pairwise.metrics import (  # noqa: F401
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)

@@ -1,0 +1,76 @@
+"""Explained variance (ref /root/reference/torchmetrics/functional/regression/explained_variance.py, 137 LoC)."""
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
+    """Running sums of error / target moments (ref :22-41)."""
+    _check_same_shape(preds, target)
+    n_obs = preds.shape[0]
+    diff = target - preds
+    sum_error = jnp.sum(diff, axis=0)
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target * target, axis=0)
+    return n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    n_obs: Array,
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Parity: ref :44-97."""
+    diff_avg = sum_error / n_obs
+    numerator = sum_squared_error / n_obs - diff_avg * diff_avg
+
+    target_avg = sum_target / n_obs
+    denominator = sum_squared_target / n_obs - target_avg * target_avg
+
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    valid_score = nonzero_numerator & nonzero_denominator
+    output_scores = jnp.ones_like(jnp.atleast_1d(diff_avg), dtype=jnp.float32)
+    safe_denominator = jnp.where(nonzero_denominator, denominator, 1.0)
+    output_scores = jnp.where(
+        jnp.atleast_1d(valid_score), 1.0 - jnp.atleast_1d(numerator / safe_denominator), output_scores
+    )
+    output_scores = jnp.where(jnp.atleast_1d(nonzero_numerator & ~nonzero_denominator), 0.0, output_scores)
+    output_scores = output_scores.reshape(jnp.shape(diff_avg))
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(f"Invalid input to multioutput: {multioutput}")
+
+
+def explained_variance(
+    preds: Array,
+    target: Array,
+    multioutput: str = "uniform_average",
+) -> Union[Array, Sequence[Array]]:
+    """Explained variance score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import explained_variance
+        >>> target = jnp.asarray([3.0, -0.5, 2, 7])
+        >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
+        >>> round(float(explained_variance(preds, target)), 4)
+        0.9572
+    """
+    n_obs, sum_error, ss_error, sum_target, ss_target = _explained_variance_update(preds, target)
+    return _explained_variance_compute(n_obs, sum_error, ss_error, sum_target, ss_target, multioutput)

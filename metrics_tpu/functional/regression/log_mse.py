@@ -1,0 +1,34 @@
+"""Mean squared log error (ref /root/reference/torchmetrics/functional/regression/log_mse.py, 76 LoC)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    sum_squared_log_error = jnp.sum(jnp.square(jnp.log1p(preds) - jnp.log1p(target)))
+    return sum_squared_log_error, target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs: int) -> Array:
+    return sum_squared_log_error / n_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """MSLE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_log_error
+        >>> x = jnp.asarray([0.0, 1, 2, 3])
+        >>> y = jnp.asarray([0.0, 1, 2, 2])
+        >>> round(float(mean_squared_log_error(x, y)), 4)
+        0.0207
+    """
+    sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

@@ -1,0 +1,36 @@
+"""Mean squared error (ref /root/reference/torchmetrics/functional/regression/mse.py, 75 LoC)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff)
+    return sum_squared_error, target.size
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, n_obs: int, squared: bool = True) -> Array:
+    mse = sum_squared_error / n_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """MSE (or RMSE if ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_error
+        >>> x = jnp.asarray([0.0, 1, 2, 3])
+        >>> y = jnp.asarray([0.0, 1, 2, 2])
+        >>> float(mean_squared_error(x, y))
+        0.25
+    """
+    sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
+    return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)

@@ -1,0 +1,90 @@
+"""R2 score (ref /root/reference/torchmetrics/functional/regression/r2.py, 169 LoC)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _r2_score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, int]:
+    """Running sums for R2 (ref r2.py:23-47)."""
+    _check_same_shape(preds, target)
+    if preds.ndim > 2:
+        raise ValueError(
+            "Expected both prediction and target to be 1D or 2D tensors,"
+            f" but received tensors with dimension {preds.shape}"
+        )
+    sum_obs = jnp.sum(target, axis=0)
+    sum_squared_obs = jnp.sum(target * target, axis=0)
+    residual = target - preds
+    rss = jnp.sum(residual * residual, axis=0)
+    return sum_squared_obs, sum_obs, rss, target.shape[0]
+
+
+def _r2_score_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    rss: Array,
+    n_obs: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Parity: ref r2.py:49-113."""
+    if n_obs < 2:
+        raise ValueError("Needs at least two samples to calculate r2 score.")
+
+    mean_obs = sum_obs / n_obs
+    tss = sum_squared_obs - sum_obs * mean_obs
+    raw_scores = 1 - (rss / tss)
+
+    if multioutput == "raw_values":
+        r2 = raw_scores
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        tss_sum = jnp.sum(tss)
+        r2 = jnp.sum(tss / tss_sum * raw_scores)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`,"
+            f" `uniform_average` or `variance_weighted`. Received {multioutput}."
+        )
+
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+
+    if adjusted != 0:
+        if adjusted > n_obs - 1:
+            rank_zero_warn(
+                "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
+                UserWarning,
+            )
+        elif adjusted == n_obs - 1:
+            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+        else:
+            r2 = 1 - (1 - r2) * (n_obs - 1) / (n_obs - adjusted - 1)
+    return r2
+
+
+def r2_score(
+    preds: Array,
+    target: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """R2 score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import r2_score
+        >>> target = jnp.asarray([3.0, -0.5, 2, 7])
+        >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
+        >>> round(float(r2_score(preds, target)), 4)
+        0.9486
+    """
+    sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
+    return _r2_score_compute(sum_squared_obs, sum_obs, rss, n_obs, adjusted, multioutput)

@@ -1,0 +1,74 @@
+"""Tweedie deviance score (ref /root/reference/torchmetrics/functional/regression/tweedie_deviance.py, 146 LoC)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import xlogy
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    """Per-power deviance accumulation (ref tweedie_deviance.py:29-89)."""
+    _check_same_shape(preds, targets)
+
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+
+    concrete = not isinstance(preds, jax.core.Tracer) and not isinstance(targets, jax.core.Tracer)
+
+    if power == 0:
+        deviance_score = jnp.square(targets - preds)
+    elif power == 1:
+        # Poisson distribution
+        if concrete and (bool((preds <= 0).any()) or bool((targets < 0).any())):
+            raise ValueError(
+                f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative."
+            )
+        deviance_score = 2 * (xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:
+        # Gamma distribution
+        if concrete and (bool((preds <= 0).any()) or bool((targets <= 0).any())):
+            raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+        deviance_score = 2 * (jnp.log(preds / targets) + (targets / preds) - 1)
+    else:
+        if power < 0:
+            if concrete and bool((preds <= 0).any()):
+                raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
+        elif 1 < power < 2:
+            if concrete and (bool((preds <= 0).any()) or bool((targets < 0).any())):
+                raise ValueError(
+                    f"For power={power}, 'targets' has to be strictly positive and 'preds' cannot be negative."
+                )
+        else:
+            if concrete and (bool((preds <= 0).any()) or bool((targets <= 0).any())):
+                raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+
+        term_1 = jnp.power(jnp.maximum(targets, 0.0), 2 - power) / ((1 - power) * (2 - power))
+        term_2 = targets * jnp.power(preds, 1 - power) / (1 - power)
+        term_3 = jnp.power(preds, 2 - power) / (2 - power)
+        deviance_score = 2 * (term_1 - term_2 + term_3)
+
+    return jnp.sum(deviance_score), jnp.asarray(deviance_score.size)
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    """Parity: ref tweedie_deviance.py:92-107."""
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
+    """Tweedie deviance score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import tweedie_deviance_score
+        >>> targets = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        >>> preds = jnp.asarray([4.0, 3.0, 2.0, 1.0])
+        >>> round(float(tweedie_deviance_score(preds, targets, power=2)), 4)
+        4.8333
+    """
+    sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
+    return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

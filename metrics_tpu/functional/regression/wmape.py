@@ -1,0 +1,38 @@
+"""Weighted MAPE (ref /root/reference/torchmetrics/functional/regression/wmape.py, 93 LoC)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    sum_abs_error = jnp.abs(preds - target).sum()
+    sum_scale = jnp.abs(target).sum()
+    return sum_abs_error, sum_scale
+
+
+def _weighted_mean_absolute_percentage_error_compute(
+    sum_abs_error: Array, sum_scale: Array, epsilon: float = 1.17e-06
+) -> Array:
+    return sum_abs_error / jnp.clip(sum_scale, min=epsilon)
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """WMAPE.
+
+    Example:
+        >>> import jax
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import weighted_mean_absolute_percentage_error
+        >>> preds = jnp.asarray([1.0, 2.0])
+        >>> target = jnp.asarray([1.0, 1.0])
+        >>> float(weighted_mean_absolute_percentage_error(preds, target))
+        0.5
+    """
+    sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+    return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

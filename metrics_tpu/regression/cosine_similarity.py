@@ -1,0 +1,51 @@
+"""CosineSimilarity module (ref /root/reference/torchmetrics/regression/cosine_similarity.py, 88 LoC)."""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.regression.cosine_similarity import (
+    _cosine_similarity_compute,
+    _cosine_similarity_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CosineSimilarity(Metric):
+    """Cosine similarity over accumulated rows.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CosineSimilarity
+        >>> target = jnp.asarray([[0.0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0.0, 1], [0, 1]])
+        >>> cosine_similarity = CosineSimilarity(reduction='mean')
+        >>> round(float(cosine_similarity(preds, target)), 4)
+        0.8536
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed_reduction = ("sum", "mean", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _cosine_similarity_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _cosine_similarity_compute(preds, target, self.reduction)

@@ -1,0 +1,44 @@
+"""SpearmanCorrCoef module (ref /root/reference/torchmetrics/regression/spearman.py, 80 LoC)."""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class SpearmanCorrCoef(Metric):
+    """Spearman's rank correlation over accumulated samples.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SpearmanCorrCoef
+        >>> target = jnp.asarray([3.0, -0.5, 2, 7])
+        >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
+        >>> spearman = SpearmanCorrCoef()
+        >>> round(float(spearman(preds, target)), 4)
+        1.0
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _spearman_corrcoef_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spearman_corrcoef_compute(preds, target)

@@ -1,0 +1,34 @@
+"""WeightedMeanAbsolutePercentageError module (ref /root/reference/torchmetrics/regression/wmape.py, 73 LoC)."""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.wmape import (
+    _weighted_mean_absolute_percentage_error_compute,
+    _weighted_mean_absolute_percentage_error_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    """WMAPE."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_scale", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.sum_scale = self.sum_scale + sum_scale
+
+    def compute(self) -> Array:
+        return _weighted_mean_absolute_percentage_error_compute(self.sum_abs_error, self.sum_scale)

@@ -1,0 +1,63 @@
+"""Pairwise metric tests vs sklearn (translation of ref tests/pairwise/)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import (
+    cosine_similarity as sk_cosine,
+    euclidean_distances as sk_euclidean,
+    linear_kernel as sk_linear,
+    manhattan_distances as sk_manhattan,
+)
+
+from metrics_tpu.functional import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+from tests.helpers import seed_all
+
+seed_all(5)
+
+_x = np.random.rand(12, 6).astype(np.float32)
+_y = np.random.rand(8, 6).astype(np.float32)
+
+CASES = [
+    (pairwise_cosine_similarity, sk_cosine),
+    (pairwise_euclidean_distance, sk_euclidean),
+    (pairwise_linear_similarity, sk_linear),
+    (pairwise_manhattan_distance, sk_manhattan),
+]
+
+
+@pytest.mark.parametrize("tpu_fn,sk_fn", CASES)
+def test_pairwise_xy(tpu_fn, sk_fn):
+    res = tpu_fn(jnp.asarray(_x), jnp.asarray(_y))
+    np.testing.assert_allclose(np.asarray(res), sk_fn(_x, _y), atol=1e-5)
+
+
+@pytest.mark.parametrize("tpu_fn,sk_fn", CASES)
+def test_pairwise_x_only_zero_diagonal(tpu_fn, sk_fn):
+    res = tpu_fn(jnp.asarray(_x))
+    expected = sk_fn(_x, _x)
+    np.fill_diagonal(expected, 0)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("tpu_fn,sk_fn", CASES)
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_pairwise_reductions(tpu_fn, sk_fn, reduction):
+    res = tpu_fn(jnp.asarray(_x), jnp.asarray(_y), reduction=reduction)
+    full = sk_fn(_x, _y)
+    expected = full.mean(-1) if reduction == "mean" else full.sum(-1)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-4)
+
+
+def test_pairwise_jit():
+    jitted = jax.jit(pairwise_euclidean_distance)
+    np.testing.assert_allclose(
+        np.asarray(jitted(jnp.asarray(_x), jnp.asarray(_y))), sk_euclidean(_x, _y), atol=1e-5
+    )
+
+

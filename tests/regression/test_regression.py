@@ -1,0 +1,247 @@
+"""Regression metric tests vs sklearn/scipy oracles (translation of ref tests/regression/)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score as sk_explained_variance,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance as sk_tweedie,
+    r2_score as sk_r2,
+)
+
+from metrics_tpu import (
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+from metrics_tpu.functional import (
+    cosine_similarity,
+    explained_variance,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    pearson_corrcoef,
+    r2_score,
+    spearman_corrcoef,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+    weighted_mean_absolute_percentage_error,
+)
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, MetricTester, NUM_BATCHES
+
+seed_all(3)
+
+_preds = np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_target = np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+
+
+def _ref(fn):
+    return lambda p, t: fn(np.asarray(t, dtype=np.float64), np.asarray(p, dtype=np.float64))
+
+
+SIMPLE_CASES = [
+    (MeanSquaredError, mean_squared_error, _ref(sk_mse), {}),
+    (MeanAbsoluteError, mean_absolute_error, _ref(sk_mae), {}),
+    (MeanSquaredLogError, mean_squared_log_error, _ref(sk_msle), {}),
+    (MeanAbsolutePercentageError, mean_absolute_percentage_error, _ref(sk_mape), {}),
+    (
+        SymmetricMeanAbsolutePercentageError,
+        symmetric_mean_absolute_percentage_error,
+        lambda p, t: np.mean(2 * np.abs(np.asarray(p, np.float64) - np.asarray(t, np.float64))
+                             / (np.abs(np.asarray(t, np.float64)) + np.abs(np.asarray(p, np.float64)))),
+        {},
+    ),
+    (
+        WeightedMeanAbsolutePercentageError,
+        weighted_mean_absolute_percentage_error,
+        lambda p, t: np.abs(np.asarray(p, np.float64) - np.asarray(t, np.float64)).sum()
+        / np.abs(np.asarray(t, np.float64)).sum(),
+        {},
+    ),
+    (TweedieDevianceScore, tweedie_deviance_score,
+     lambda p, t: sk_tweedie(np.asarray(t, np.float64), np.asarray(p, np.float64), power=0), {}),
+]
+
+
+@pytest.mark.parametrize("metric_class,metric_fn,sk_fn,args", SIMPLE_CASES)
+class TestSimpleRegression(MetricTester):
+    def test_class(self, metric_class, metric_fn, sk_fn, args):
+        self.run_class_metric_test(
+            preds=_preds, target=_target, metric_class=metric_class, reference_metric=sk_fn,
+            metric_args=args, atol=1e-5,
+        )
+
+    def test_fn(self, metric_class, metric_fn, sk_fn, args):
+        self.run_functional_metric_test(
+            _preds, _target, metric_functional=metric_fn, reference_metric=sk_fn, metric_args=args, atol=1e-5
+        )
+
+    def test_dist(self, metric_class, metric_fn, sk_fn, args):
+        self.run_class_metric_test(
+            preds=_preds, target=_target, metric_class=metric_class, reference_metric=sk_fn,
+            metric_args=args, dist=True, atol=1e-5,
+        )
+
+    def test_differentiable(self, metric_class, metric_fn, sk_fn, args):
+        self.run_differentiability_test(_preds, _target, metric_class(**args), metric_fn, args)
+
+
+def test_rmse():
+    MetricTester().run_class_metric_test(
+        preds=_preds,
+        target=_target,
+        metric_class=MeanSquaredError,
+        reference_metric=lambda p, t: np.sqrt(sk_mse(np.asarray(t, np.float64), np.asarray(p, np.float64))),
+        metric_args={"squared": False},
+        atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("power", [1.0, 2.0, 1.5, 3.0])
+def test_tweedie_powers(power):
+    preds = np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) + 0.1
+    target = np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) + 0.1
+    MetricTester().run_class_metric_test(
+        preds=preds,
+        target=target,
+        metric_class=TweedieDevianceScore,
+        reference_metric=lambda p, t: sk_tweedie(np.asarray(t, np.float64), np.asarray(p, np.float64), power=power),
+        metric_args={"power": power},
+        atol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("multioutput", ["uniform_average", "raw_values", "variance_weighted"])
+def test_explained_variance(multioutput):
+    preds2 = np.random.rand(NUM_BATCHES, BATCH_SIZE, 3).astype(np.float32)
+    target2 = np.random.rand(NUM_BATCHES, BATCH_SIZE, 3).astype(np.float32)
+
+    def _sk(p, t):
+        return sk_explained_variance(np.asarray(t, np.float64), np.asarray(p, np.float64), multioutput=multioutput)
+
+    MetricTester().run_class_metric_test(
+        preds=preds2, target=target2, metric_class=ExplainedVariance,
+        reference_metric=_sk, metric_args={"multioutput": multioutput}, atol=1e-5,
+    )
+    MetricTester().run_functional_metric_test(
+        preds2, target2, metric_functional=explained_variance, reference_metric=_sk,
+        metric_args={"multioutput": multioutput}, atol=1e-5,
+    )
+
+
+def test_explained_variance_dist():
+    MetricTester().run_class_metric_test(
+        preds=_preds, target=_target, metric_class=ExplainedVariance,
+        reference_metric=_ref(sk_explained_variance), dist=True, atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("adjusted", [0, 5])
+def test_r2(adjusted):
+    def _sk(p, t):
+        r2 = sk_r2(np.asarray(t, np.float64), np.asarray(p, np.float64))
+        if adjusted:
+            n = np.asarray(t).size
+            r2 = 1 - (1 - r2) * (n - 1) / (n - adjusted - 1)
+        return r2
+
+    MetricTester().run_class_metric_test(
+        preds=_preds, target=_target, metric_class=R2Score, reference_metric=_sk,
+        metric_args={"adjusted": adjusted}, check_batch=False, check_state_merge=False, atol=1e-5,
+    )
+    if not adjusted:
+        MetricTester().run_functional_metric_test(
+            _preds, _target, metric_functional=r2_score, reference_metric=_sk, atol=1e-5
+        )
+
+
+def test_r2_dist():
+    MetricTester().run_class_metric_test(
+        preds=_preds, target=_target, metric_class=R2Score,
+        reference_metric=_ref(sk_r2), dist=True, atol=1e-5,
+    )
+
+
+# correlated data: near-zero correlations are dominated by float32 noise
+_preds_corr = (_target + 0.3 * np.random.rand(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+
+
+def test_pearson():
+    def _sk(p, t):
+        return pearsonr(np.asarray(t, np.float64).reshape(-1), np.asarray(p, np.float64).reshape(-1))[0]
+
+    MetricTester().run_class_metric_test(
+        preds=_preds_corr, target=_target, metric_class=PearsonCorrCoef, reference_metric=_sk,
+        atol=1e-4,
+    )
+    MetricTester().run_functional_metric_test(
+        _preds_corr, _target, metric_functional=pearson_corrcoef, reference_metric=_sk, atol=1e-4
+    )
+
+
+def test_pearson_dist():
+    """Pearson's None-reduce states stack per-device; _final_aggregation merges."""
+    MetricTester().run_class_metric_test(
+        preds=_preds_corr,
+        target=_target,
+        metric_class=PearsonCorrCoef,
+        reference_metric=lambda p, t: pearsonr(np.asarray(t, np.float64).reshape(-1),
+                                               np.asarray(p, np.float64).reshape(-1))[0],
+        dist=True,
+        atol=1e-4,
+    )
+
+
+def test_spearman():
+    def _sk(p, t):
+        return spearmanr(np.asarray(t, np.float64).reshape(-1), np.asarray(p, np.float64).reshape(-1))[0]
+
+    MetricTester().run_class_metric_test(
+        preds=_preds, target=_target, metric_class=SpearmanCorrCoef, reference_metric=_sk,
+        check_batch=True, atol=1e-4,
+    )
+    MetricTester().run_functional_metric_test(
+        _preds, _target, metric_functional=spearman_corrcoef, reference_metric=_sk, atol=1e-4
+    )
+
+
+def test_spearman_with_ties():
+    p = jnp.asarray([1.0, 1.0, 2.0, 3.0, 3.0, 3.0, 4.0])
+    t = jnp.asarray([1.0, 2.0, 2.0, 2.0, 5.0, 6.0, 7.0])
+    ours = float(spearman_corrcoef(p, t))
+    ref = spearmanr(np.asarray(t), np.asarray(p))[0]
+    assert abs(ours - ref) < 1e-4
+
+
+def test_cosine_similarity():
+    preds2 = np.random.rand(NUM_BATCHES, BATCH_SIZE, 8).astype(np.float32)
+    target2 = np.random.rand(NUM_BATCHES, BATCH_SIZE, 8).astype(np.float32)
+
+    def _sk(p, t):
+        p, t = np.asarray(p, np.float64), np.asarray(t, np.float64)
+        sim = (p * t).sum(-1) / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1))
+        return sim.mean()
+
+    MetricTester().run_class_metric_test(
+        preds=preds2, target=target2, metric_class=CosineSimilarity, reference_metric=_sk,
+        metric_args={"reduction": "mean"}, atol=1e-5,
+    )
+    MetricTester().run_functional_metric_test(
+        preds2, target2, metric_functional=cosine_similarity, reference_metric=_sk,
+        metric_args={"reduction": "mean"}, atol=1e-5,
+    )
