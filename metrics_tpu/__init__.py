@@ -68,6 +68,18 @@ from metrics_tpu.regression import (  # noqa: E402, F401
     WeightedMeanAbsolutePercentageError,
 )
 
+from metrics_tpu.retrieval import (  # noqa: E402, F401
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMetric,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+)
+
 __all__ = [
     "AUC",
     "AUROC",
@@ -113,5 +125,13 @@ __all__ = [
     "SpearmanCorrCoef",
     "SymmetricMeanAbsolutePercentageError",
     "TweedieDevianceScore",
-    "WeightedMeanAbsolutePercentageError",
+    "WeightedMeanAbsolutePercentageError",    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalMAP",
+    "RetrievalMetric",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalRecall",
+    "RetrievalRPrecision",
 ]

@@ -1,0 +1,144 @@
+"""Per-query retrieval metrics (single query -> scalar).
+
+Behavioral parity: /root/reference/torchmetrics/functional/retrieval/
+(average_precision.py, reciprocal_rank.py, precision.py, recall.py,
+hit_rate.py, fall_out.py, ndcg.py, r_precision.py; 486 LoC). These are the
+single-query building blocks; the module metrics' batched compute path
+(:mod:`metrics_tpu.retrieval.base`) evaluates all queries at once on padded
+(Q, L) tensors instead of looping.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_average_precision(preds: Array, target: Array) -> Array:
+    """AP over one query (ref average_precision.py:20-49).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_average_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> round(float(retrieval_average_precision(preds, target)), 4)
+        0.8333
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    sorted_target = target[jnp.argsort(-preds, stable=True)]
+    rel = sorted_target > 0
+    positions = jnp.arange(1, target.shape[0] + 1, dtype=jnp.float32)
+    prec_at_rel = jnp.cumsum(rel, axis=0) / positions
+    n_rel = rel.sum()
+    return jnp.where(n_rel > 0, (prec_at_rel * rel).sum() / jnp.maximum(n_rel, 1), 0.0)
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """Reciprocal rank of the first relevant doc (ref reciprocal_rank.py:20-49).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_reciprocal_rank
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([False, True, False])
+        >>> float(retrieval_reciprocal_rank(preds, target))
+        0.5
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    sorted_target = target[jnp.argsort(-preds, stable=True)] > 0
+    position = jnp.argmax(sorted_target)  # first True (0 if none, guarded below)
+    return jnp.where(sorted_target.any(), 1.0 / (position + 1.0), 0.0)
+
+
+def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, adaptive_k: bool = False) -> Array:
+    """Precision@k for one query (ref precision.py:18-66)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if k is None or (adaptive_k and k > preds.shape[-1]):
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    sorted_target = target[jnp.argsort(-preds, stable=True)][:k]
+    relevant = (sorted_target > 0).sum().astype(jnp.float32)
+    return jnp.where(target.sum() > 0, relevant / k, 0.0)
+
+
+def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Recall@k for one query (ref recall.py:18-60)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    sorted_target = target[jnp.argsort(-preds, stable=True)][:k]
+    relevant = (sorted_target > 0).sum().astype(jnp.float32)
+    n_rel = target.sum()
+    return jnp.where(n_rel > 0, relevant / jnp.maximum(n_rel, 1), 0.0)
+
+
+def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """HitRate@k for one query (ref hit_rate.py:18-57)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    relevant = (target[jnp.argsort(-preds, stable=True)][:k] > 0).sum()
+    return (relevant > 0).astype(jnp.float32)
+
+
+def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """FallOut@k for one query (ref fall_out.py:18-62)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    k = preds.shape[-1] if k is None else k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    target = 1 - (target > 0)  # fraction of non-relevant retrieved among non-relevant
+    relevant = target[jnp.argsort(-preds, stable=True)][:k].sum().astype(jnp.float32)
+    n_nonrel = target.sum()
+    return jnp.where(n_nonrel > 0, relevant / jnp.maximum(n_nonrel, 1), 0.0)
+
+
+def _dcg(target: Array) -> Array:
+    """DCG of an ordered relevance list (ref ndcg.py:18-20)."""
+    denom = jnp.log2(jnp.arange(target.shape[-1], dtype=jnp.float32) + 2.0)
+    return (target / denom).sum(axis=-1)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """nDCG@k for one query (ref ndcg.py:23-72).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_normalized_dcg
+        >>> preds = jnp.asarray([.1, .2, .3, 4, 70])
+        >>> target = jnp.asarray([10, 0, 0, 1, 5])
+        >>> round(float(retrieval_normalized_dcg(preds, target)), 4)
+        0.6957
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    k = preds.shape[-1] if k is None else k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    sorted_target = target[jnp.argsort(-preds, stable=True)][:k]
+    ideal_target = jnp.sort(target)[::-1][:k]
+    ideal_dcg = _dcg(ideal_target.astype(jnp.float32))
+    target_dcg = _dcg(sorted_target.astype(jnp.float32))
+    return jnp.where(ideal_dcg > 0, target_dcg / jnp.maximum(ideal_dcg, 1e-12), 0.0)
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """R-precision for one query (ref r_precision.py:18-49)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    relevant_number = int(target.sum()) if not isinstance(target, jax.core.Tracer) else None
+    if relevant_number is None:
+        raise ValueError("retrieval_r_precision requires concrete targets (top-r slicing is data dependent)")
+    if not relevant_number:
+        return jnp.asarray(0.0)
+    relevant = (target[jnp.argsort(-preds, stable=True)][:relevant_number] > 0).sum().astype(jnp.float32)
+    return relevant / relevant_number

@@ -1,0 +1,11 @@
+from metrics_tpu.retrieval.base import RetrievalMetric  # noqa: F401
+from metrics_tpu.retrieval.metrics import (  # noqa: F401
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+)

@@ -1,0 +1,148 @@
+"""RetrievalMetric base class with a vectorized multi-query compute.
+
+Behavioral parity: /root/reference/torchmetrics/retrieval/base.py (151 LoC).
+TPU-first redesign of the compute path: instead of the reference's Python
+loop over per-query index groups (`get_group_indexes` + one `_metric` call
+per query, base.py:113-143), all accumulated rows are scattered once into a
+padded ``(Q, L_max)`` matrix and every per-query score is computed in a
+single batched device computation (`_metric_batched`). The host does only
+the O(N) group bookkeeping in numpy; all scoring math runs on device.
+"""
+from abc import ABC, abstractmethod
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.checks import _check_retrieval_inputs
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _pad_by_query(indexes: Array, preds: Array, target: Array) -> Tuple[Array, Array, Array]:
+    """Scatter flat rows into padded (Q, L) matrices grouped by query id.
+
+    Returns (padded_preds [-inf pad], padded_target [0 pad], valid mask).
+    """
+    idx_np = np.asarray(indexes)
+    preds_np = np.asarray(preds)
+    target_np = np.asarray(target)
+
+    _, inverse = np.unique(idx_np, return_inverse=True)
+    counts = np.bincount(inverse)
+    num_queries, max_len = counts.size, int(counts.max())
+
+    order = np.argsort(inverse, kind="stable")
+    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos_in_group = np.empty(idx_np.size, dtype=np.int64)
+    pos_in_group[order] = np.arange(idx_np.size) - offsets[inverse[order]]
+
+    padded_preds = np.full((num_queries, max_len), -np.inf, dtype=np.float32)
+    padded_target = np.zeros((num_queries, max_len), dtype=target_np.dtype)
+    valid = np.zeros((num_queries, max_len), dtype=bool)
+    padded_preds[inverse, pos_in_group] = preds_np
+    padded_target[inverse, pos_in_group] = target_np
+    valid[inverse, pos_in_group] = True
+
+    return jnp.asarray(padded_preds), jnp.asarray(padded_target), jnp.asarray(valid)
+
+
+def _sort_by_preds(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
+    """Sort each query's docs by descending score (padding, at -inf, goes last)."""
+    order = jnp.argsort(-preds, axis=1, stable=True)
+    return jnp.take_along_axis(target, order, axis=1), jnp.take_along_axis(valid, order, axis=1)
+
+
+class RetrievalMetric(Metric, ABC):
+    """Accumulate (indexes, preds, target) rows; average a per-query metric.
+
+    Args:
+        empty_target_action: 'neg' (0.0) | 'pos' (1.0) | 'skip' | 'error'
+            for queries with no positive target (ref base.py:46-56).
+        ignore_index: drop rows whose target equals this value.
+    """
+
+    indexes: list
+    preds: list
+    target: list
+    higher_is_better = True
+    is_differentiable = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.allow_non_binary_target = False
+
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        """Validate, flatten, and append (ref base.py:101-112)."""
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target, ignore_index=self.ignore_index
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _empty_query_mask(self, padded_target: Array, valid: Array) -> Array:
+        """Queries considered 'empty' — no positive target by default."""
+        return ((padded_target > 0) & valid).sum(axis=1) == 0
+
+    def compute(self) -> Array:
+        """Batched multi-query evaluation (semantics of ref base.py:113-143)."""
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        padded_preds, padded_target, valid = _pad_by_query(indexes, preds, target)
+        scores = self._metric_batched(padded_preds, padded_target, valid)  # (Q,)
+
+        empty = self._empty_query_mask(padded_target, valid)
+        if self.empty_target_action == "error" and bool(empty.any()):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        if self.empty_target_action == "pos":
+            scores = jnp.where(empty, 1.0, scores)
+        elif self.empty_target_action == "neg":
+            scores = jnp.where(empty, 0.0, scores)
+        elif self.empty_target_action == "skip":
+            kept = ~empty
+            n_kept = kept.sum()
+            return jnp.where(n_kept > 0, jnp.where(kept, scores, 0.0).sum() / jnp.maximum(n_kept, 1), 0.0)
+        return scores.mean() if scores.size else jnp.asarray(0.0)
+
+    @abstractmethod
+    def _metric(self, preds: Array, target: Array) -> Array:
+        """Single-query metric (API parity with ref base.py:145-151)."""
+
+    def _metric_batched(self, padded_preds: Array, padded_target: Array, valid: Array) -> Array:
+        """Per-query scores for all queries at once; override for each metric.
+
+        Default falls back to looping `_metric` over rows (host loop) — every
+        shipped subclass overrides this with a batched implementation.
+        """
+        scores = []
+        for q in range(padded_preds.shape[0]):
+            m = np.asarray(valid[q])
+            scores.append(self._metric(padded_preds[q][m], padded_target[q][m]))
+        return jnp.stack(scores)

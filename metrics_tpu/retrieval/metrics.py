@@ -1,0 +1,219 @@
+"""Retrieval module metrics with batched multi-query computes.
+
+Behavioral parity with the per-metric modules under
+/root/reference/torchmetrics/retrieval/ (average_precision.py 74 LoC,
+reciprocal_rank.py 73, precision.py 105, recall.py 97, hit_rate.py 98,
+fall_out.py 131, ndcg.py 99, r_precision.py 74). Each `_metric_batched`
+evaluates every query in one (Q, L) device computation — no per-query loop.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval.metrics import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from metrics_tpu.retrieval.base import RetrievalMetric, _sort_by_preds
+
+Array = jax.Array
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean Average Precision for IR (ref retrieval/average_precision.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMAP
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> rmap = RetrievalMAP()
+        >>> round(float(rmap(preds, target, indexes)), 4)
+        0.7917
+    """
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_average_precision(preds, target)
+
+    def _metric_batched(self, padded_preds: Array, padded_target: Array, valid: Array) -> Array:
+        rel, _ = _sort_by_preds(padded_preds, padded_target > 0, valid)
+        positions = jnp.arange(1, padded_preds.shape[1] + 1, dtype=jnp.float32)
+        prec = jnp.cumsum(rel, axis=1) / positions
+        n_rel = rel.sum(axis=1)
+        return jnp.where(n_rel > 0, (prec * rel).sum(axis=1) / jnp.maximum(n_rel, 1), 0.0)
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean Reciprocal Rank (ref retrieval/reciprocal_rank.py)."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_reciprocal_rank(preds, target)
+
+    def _metric_batched(self, padded_preds: Array, padded_target: Array, valid: Array) -> Array:
+        rel, _ = _sort_by_preds(padded_preds, padded_target > 0, valid)
+        first = jnp.argmax(rel, axis=1)
+        return jnp.where(rel.any(axis=1), 1.0 / (first + 1.0), 0.0)
+
+
+class _TopKRetrievalMetric(RetrievalMetric):
+    """Shared ctor for metrics with a top-k cutoff."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _cutoff(self, padded_preds: Array) -> int:
+        return padded_preds.shape[1] if self.k is None else self.k
+
+
+class RetrievalPrecision(_TopKRetrievalMetric):
+    """Precision@k averaged over queries (ref retrieval/precision.py)."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        adaptive_k: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, k=k, **kwargs)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.adaptive_k = adaptive_k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_precision(preds, target, k=self.k, adaptive_k=self.adaptive_k)
+
+    def _metric_batched(self, padded_preds: Array, padded_target: Array, valid: Array) -> Array:
+        rel, valid_s = _sort_by_preds(padded_preds, padded_target > 0, valid)
+        max_len = padded_preds.shape[1]
+        group_sizes = valid.sum(axis=1)
+        if self.k is None:
+            kq = group_sizes  # k defaults to each query's document count
+        elif self.adaptive_k:
+            kq = jnp.minimum(self.k, group_sizes)
+        else:
+            kq = jnp.full((padded_preds.shape[0],), self.k)
+        pos = jnp.arange(max_len)
+        in_k = pos[None, :] < kq[:, None]
+        hits = (rel & in_k).sum(axis=1).astype(jnp.float32)
+        score = hits / kq
+        return jnp.where((padded_target > 0).sum(axis=1) > 0, score, 0.0)
+
+
+class RetrievalRecall(_TopKRetrievalMetric):
+    """Recall@k averaged over queries (ref retrieval/recall.py)."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_recall(preds, target, k=self.k)
+
+    def _metric_batched(self, padded_preds: Array, padded_target: Array, valid: Array) -> Array:
+        rel, _ = _sort_by_preds(padded_preds, padded_target > 0, valid)
+        k = self._cutoff(padded_preds)
+        hits = rel[:, :k].sum(axis=1).astype(jnp.float32)
+        n_rel = rel.sum(axis=1)
+        return jnp.where(n_rel > 0, hits / jnp.maximum(n_rel, 1), 0.0)
+
+
+class RetrievalHitRate(_TopKRetrievalMetric):
+    """HitRate@k averaged over queries (ref retrieval/hit_rate.py)."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_hit_rate(preds, target, k=self.k)
+
+    def _metric_batched(self, padded_preds: Array, padded_target: Array, valid: Array) -> Array:
+        rel, _ = _sort_by_preds(padded_preds, padded_target > 0, valid)
+        k = self._cutoff(padded_preds)
+        return (rel[:, :k].sum(axis=1) > 0).astype(jnp.float32)
+
+
+class RetrievalFallOut(_TopKRetrievalMetric):
+    """FallOut@k averaged over queries; empty = no *negative* target
+    (ref retrieval/fall_out.py:80-131)."""
+
+    higher_is_better = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "pos",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, k=k, **kwargs)
+
+    def _empty_query_mask(self, padded_target: Array, valid: Array) -> Array:
+        # empty = query with no negative targets (ref fall_out.py:117)
+        return ((padded_target == 0) & valid).sum(axis=1) == 0
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_fall_out(preds, target, k=self.k)
+
+    def _metric_batched(self, padded_preds: Array, padded_target: Array, valid: Array) -> Array:
+        nonrel_raw = (padded_target == 0) & valid
+        nonrel, _ = _sort_by_preds(padded_preds, nonrel_raw, valid)
+        k = self._cutoff(padded_preds)
+        hits = nonrel[:, :k].sum(axis=1).astype(jnp.float32)
+        n_nonrel = nonrel.sum(axis=1)
+        return jnp.where(n_nonrel > 0, hits / jnp.maximum(n_nonrel, 1), 0.0)
+
+
+class RetrievalNormalizedDCG(_TopKRetrievalMetric):
+    """nDCG@k averaged over queries (ref retrieval/ndcg.py)."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, k=k, **kwargs)
+        self.allow_non_binary_target = True
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_normalized_dcg(preds, target, k=self.k)
+
+    def _metric_batched(self, padded_preds: Array, padded_target: Array, valid: Array) -> Array:
+        target_f = padded_target.astype(jnp.float32) * valid
+        sorted_target, _ = _sort_by_preds(padded_preds, target_f, valid)
+        k = self._cutoff(padded_preds)
+        max_len = padded_preds.shape[1]
+        denom = jnp.log2(jnp.arange(max_len, dtype=jnp.float32) + 2.0)
+        in_k = jnp.arange(max_len) < k
+        dcg = (sorted_target / denom * in_k).sum(axis=1)
+        ideal = jnp.sort(target_f, axis=1)[:, ::-1]
+        idcg = (ideal / denom * in_k).sum(axis=1)
+        return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 0.0)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """R-precision averaged over queries (ref retrieval/r_precision.py)."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_r_precision(preds, target)
+
+    def _metric_batched(self, padded_preds: Array, padded_target: Array, valid: Array) -> Array:
+        rel, _ = _sort_by_preds(padded_preds, padded_target > 0, valid)
+        n_rel = rel.sum(axis=1)
+        pos = jnp.arange(padded_preds.shape[1])
+        in_r = pos[None, :] < n_rel[:, None]
+        hits = (rel & in_r).sum(axis=1).astype(jnp.float32)
+        return jnp.where(n_rel > 0, hits / jnp.maximum(n_rel, 1), 0.0)

@@ -1,0 +1,239 @@
+"""Retrieval metric tests vs sklearn per-query oracles (translation of ref tests/retrieval/)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_average_precision
+from sklearn.metrics import ndcg_score as sk_ndcg
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from tests.helpers import seed_all
+
+seed_all(7)
+
+N_QUERIES = 12
+DOCS_PER_QUERY_MAX = 20
+
+
+def _make_data(binary=True, seed=0):
+    """Variable-length per-query data, flattened with query indexes."""
+    rng = np.random.RandomState(seed)
+    indexes, preds, target = [], [], []
+    for q in range(N_QUERIES):
+        n = rng.randint(2, DOCS_PER_QUERY_MAX)
+        indexes += [q] * n
+        preds += list(rng.rand(n))
+        if binary:
+            target += list(rng.randint(0, 2, n))
+        else:
+            target += list(rng.randint(0, 5, n))
+    return (
+        np.asarray(indexes, dtype=np.int32),
+        np.asarray(preds, dtype=np.float32),
+        np.asarray(target, dtype=np.int64),
+    )
+
+
+def _per_query_mean(indexes, preds, target, fn, empty_action="neg"):
+    scores = []
+    for q in np.unique(indexes):
+        m = indexes == q
+        p, t = preds[m], target[m]
+        if t.sum() == 0:
+            if empty_action == "neg":
+                scores.append(0.0)
+            elif empty_action == "pos":
+                scores.append(1.0)
+            elif empty_action == "skip":
+                continue
+            continue
+        scores.append(fn(p, t))
+    return np.mean(scores) if scores else 0.0
+
+
+def _sk_ap(p, t):
+    return sk_average_precision(t, p)
+
+
+def _sk_mrr(p, t):
+    order = np.argsort(-p, kind="stable")
+    t_sorted = t[order]
+    pos = np.nonzero(t_sorted)[0]
+    return 1.0 / (pos[0] + 1) if len(pos) else 0.0
+
+
+def _sk_precision_at(k):
+    def _fn(p, t):
+        kk = k if k is not None else len(p)
+        t_sorted = t[np.argsort(-p, kind="stable")][:kk]
+        return t_sorted.sum() / kk
+
+    return _fn
+
+
+def _sk_recall_at(k):
+    def _fn(p, t):
+        kk = k if k is not None else len(p)
+        t_sorted = t[np.argsort(-p, kind="stable")][:kk]
+        return t_sorted.sum() / t.sum()
+
+    return _fn
+
+
+def _sk_hit_at(k):
+    def _fn(p, t):
+        kk = k if k is not None else len(p)
+        return float(t[np.argsort(-p, kind="stable")][:kk].sum() > 0)
+
+    return _fn
+
+
+def _sk_rprec(p, t):
+    r = int(t.sum())
+    return t[np.argsort(-p, kind="stable")][:r].sum() / r
+
+
+@pytest.mark.parametrize("k", [None, 1, 3])
+def test_retrieval_topk_metrics(k):
+    indexes, preds, target = _make_data()
+    cases = [
+        (RetrievalPrecision, {"k": k}, _sk_precision_at(k)),
+        (RetrievalRecall, {"k": k}, _sk_recall_at(k)),
+        (RetrievalHitRate, {"k": k}, _sk_hit_at(k)),
+    ]
+    for cls, args, sk_fn in cases:
+        m = cls(**args)
+        half = len(indexes) // 2
+        m.update(jnp.asarray(preds[:half]), jnp.asarray(target[:half]), jnp.asarray(indexes[:half]))
+        m.update(jnp.asarray(preds[half:]), jnp.asarray(target[half:]), jnp.asarray(indexes[half:]))
+        expected = _per_query_mean(indexes, preds, target, sk_fn)
+        np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5, err_msg=str(cls))
+
+
+def test_retrieval_map_and_mrr():
+    indexes, preds, target = _make_data()
+    for cls, sk_fn in [(RetrievalMAP, _sk_ap), (RetrievalMRR, _sk_mrr), (RetrievalRPrecision, _sk_rprec)]:
+        m = cls()
+        m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+        expected = _per_query_mean(indexes, preds, target, sk_fn)
+        np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5, err_msg=str(cls))
+
+
+@pytest.mark.parametrize("k", [None, 3])
+def test_retrieval_ndcg(k):
+    indexes, preds, target = _make_data(binary=False)
+
+    def _sk(p, t):
+        kk = k if k is not None else len(p)
+        return sk_ndcg(t[None, :], p[None, :], k=kk)
+
+    m = RetrievalNormalizedDCG(k=k)
+    m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    expected = _per_query_mean(indexes, preds, target, _sk)
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+def test_retrieval_fall_out():
+    indexes, preds, target = _make_data()
+
+    def _sk_fallout(p, t):
+        tn = 1 - t
+        return tn[np.argsort(-p, kind="stable")][:2].sum() / tn.sum()
+
+    scores = []
+    for q in np.unique(indexes):
+        m_ = indexes == q
+        p, t = preds[m_], target[m_]
+        if (1 - t).sum() == 0:
+            scores.append(1.0)  # empty_target_action='pos' default
+        else:
+            scores.append(_sk_fallout(p, t))
+    expected = np.mean(scores)
+
+    m = RetrievalFallOut(k=2)
+    m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+def test_empty_target_actions(action):
+    indexes = np.asarray([0, 0, 1, 1], dtype=np.int32)
+    preds = np.asarray([0.3, 0.7, 0.6, 0.4], dtype=np.float32)
+    target = np.asarray([0, 1, 0, 0], dtype=np.int64)  # query 1 has no positives
+
+    m = RetrievalMAP(empty_target_action=action)
+    m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    res = float(m.compute())
+    if action == "neg":
+        assert res == pytest.approx(0.5)
+    elif action == "pos":
+        assert res == pytest.approx(1.0)
+    else:  # skip
+        assert res == pytest.approx(1.0)
+
+
+def test_empty_target_error():
+    indexes = jnp.asarray([0, 0], dtype=jnp.int32)
+    preds = jnp.asarray([0.3, 0.7])
+    target = jnp.asarray([0, 0])
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(preds, target, indexes)
+    with pytest.raises(ValueError, match="no positive target"):
+        m.compute()
+
+
+def test_ignore_index():
+    indexes = jnp.asarray([0, 0, 0], dtype=jnp.int32)
+    preds = jnp.asarray([0.9, 0.7, 0.3])
+    target = jnp.asarray([1, -1, 0])
+    m = RetrievalMAP(ignore_index=-1)
+    m.update(preds, target, indexes)
+    assert float(m.compute()) == pytest.approx(1.0)
+
+
+def test_functional_forms():
+    p = jnp.asarray([0.2, 0.3, 0.5])
+    t = jnp.asarray([True, False, True])
+    assert float(retrieval_average_precision(p, t)) == pytest.approx(0.8333, abs=1e-4)
+    assert float(retrieval_reciprocal_rank(jnp.asarray([0.2, 0.3, 0.5]), jnp.asarray([False, True, False]))) == 0.5
+    assert float(retrieval_precision(p, t, k=2)) == 0.5
+    assert float(retrieval_recall(p, t, k=2)) == 0.5
+    assert float(retrieval_hit_rate(p, t, k=2)) == 1.0
+    assert float(retrieval_fall_out(p, t, k=2)) == 1.0
+    assert float(retrieval_r_precision(p, t)) == 0.5
+    v = retrieval_normalized_dcg(jnp.asarray([0.1, 0.2, 0.3, 4.0, 70.0]), jnp.asarray([10, 0, 0, 1, 5]))
+    assert float(v) == pytest.approx(0.6957, abs=1e-4)
+
+
+def test_batched_matches_loop():
+    """The vectorized padded compute must equal the per-query `_metric` loop."""
+    from metrics_tpu.retrieval.base import RetrievalMetric as _Base, _pad_by_query
+    from metrics_tpu.utilities.data import dim_zero_cat
+
+    indexes, preds, target = _make_data(seed=11)
+    for cls in [RetrievalMAP, RetrievalMRR, RetrievalPrecision, RetrievalRecall,
+                RetrievalHitRate, RetrievalRPrecision]:
+        m = cls()
+        m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+        padded = _pad_by_query(dim_zero_cat(m.indexes), dim_zero_cat(m.preds), dim_zero_cat(m.target))
+        batched_scores = np.asarray(m._metric_batched(*padded))
+        looped_scores = np.asarray(_Base._metric_batched(m, *padded))
+        np.testing.assert_allclose(batched_scores, looped_scores, atol=1e-5, err_msg=str(cls))
