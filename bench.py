@@ -1,0 +1,100 @@
+"""Driver benchmark: headline metric-update latency on the available accelerator.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Config: ``Accuracy`` (multiclass, probabilities (B, C) vs int targets) —
+BASELINE.md config #1 ("metric.update() µs/call"). Ours is the jitted pure
+``(state, batch) -> state`` reducer on the default JAX device (TPU under the
+driver). The baseline is the reference's eager formulation (torch CPU ops:
+argmax → one-hot → stat-score sums, the same math TorchMetrics executes per
+update) measured in-process — lower is better; ``vs_baseline`` is the
+speedup factor (baseline_time / our_time).
+"""
+import json
+import time
+
+import numpy as np
+
+BATCH, NUM_CLASSES = 1024, 128
+ITERS = 200
+
+
+def _bench_ours() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+
+    rng = np.random.RandomState(0)
+    logits = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, BATCH))
+
+    metric = Accuracy(num_classes=NUM_CLASSES, average="macro")
+    state = metric.state()
+    step = jax.jit(metric.pure_update)
+
+    state = step(state, preds, target)  # compile
+    jax.block_until_ready(jax.tree_util.tree_leaves(state))
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state = step(state, preds, target)
+    jax.block_until_ready(jax.tree_util.tree_leaves(state))
+    return (time.perf_counter() - t0) / ITERS * 1e6  # µs/call
+
+
+def _bench_torch_baseline() -> float:
+    """Eager torch-CPU equivalent of the reference's macro stat-score update."""
+    import torch
+
+    rng = np.random.RandomState(0)
+    logits = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+    preds = torch.from_numpy(logits / logits.sum(-1, keepdims=True))
+    target = torch.from_numpy(rng.randint(0, NUM_CLASSES, BATCH))
+
+    tp = torch.zeros(NUM_CLASSES, dtype=torch.long)
+    fp = torch.zeros(NUM_CLASSES, dtype=torch.long)
+    tn = torch.zeros(NUM_CLASSES, dtype=torch.long)
+    fn = torch.zeros(NUM_CLASSES, dtype=torch.long)
+
+    def update():
+        nonlocal tp, fp, tn, fn
+        p = torch.nn.functional.one_hot(preds.argmax(1), NUM_CLASSES)
+        t = torch.nn.functional.one_hot(target, NUM_CLASSES)
+        true_pred, false_pred = t == p, t != p
+        pos_pred, neg_pred = p == 1, p == 0
+        tp = tp + (true_pred * pos_pred).sum(0)
+        fp = fp + (false_pred * pos_pred).sum(0)
+        tn = tn + (true_pred * neg_pred).sum(0)
+        fn = fn + (false_pred * neg_pred).sum(0)
+
+    update()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        update()
+    return (time.perf_counter() - t0) / ITERS * 1e6
+
+
+def main() -> None:
+    ours_us = _bench_ours()
+    try:
+        base_us = _bench_torch_baseline()
+        vs_baseline = base_us / ours_us
+    except Exception:
+        vs_baseline = float("nan")
+    print(
+        json.dumps(
+            {
+                "metric": f"Accuracy.update (multiclass B={BATCH} C={NUM_CLASSES}, jitted) latency",
+                "value": round(ours_us, 2),
+                "unit": "us/call",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
