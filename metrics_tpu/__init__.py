@@ -80,6 +80,14 @@ from metrics_tpu.retrieval import (  # noqa: E402, F401
     RetrievalRPrecision,
 )
 
+from metrics_tpu.wrappers import (  # noqa: E402, F401
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+)
+
 __all__ = [
     "AUC",
     "AUROC",
@@ -133,5 +141,9 @@ __all__ = [
     "RetrievalNormalizedDCG",
     "RetrievalPrecision",
     "RetrievalRecall",
-    "RetrievalRPrecision",
+    "RetrievalRPrecision",    "BootStrapper",
+    "ClasswiseWrapper",
+    "MetricTracker",
+    "MinMaxMetric",
+    "MultioutputWrapper",
 ]

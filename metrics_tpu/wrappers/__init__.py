@@ -1,0 +1,5 @@
+from metrics_tpu.wrappers.bootstrapping import BootStrapper  # noqa: F401
+from metrics_tpu.wrappers.classwise import ClasswiseWrapper  # noqa: F401
+from metrics_tpu.wrappers.minmax import MinMaxMetric  # noqa: F401
+from metrics_tpu.wrappers.multioutput import MultioutputWrapper  # noqa: F401
+from metrics_tpu.wrappers.tracker import MetricTracker  # noqa: F401

@@ -1,0 +1,53 @@
+"""ClasswiseWrapper: unroll per-class results into a labeled dict.
+
+Behavioral parity: /root/reference/torchmetrics/wrappers/classwise.py (73 LoC).
+"""
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class ClasswiseWrapper(Metric):
+    """Turn a per-class result tensor into ``{metric_label: scalar}``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.wrappers import ClasswiseWrapper
+        >>> metric = ClasswiseWrapper(Accuracy(num_classes=3, average=None), labels=["horse", "fish", "dog"])
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.2, 0.7, 0.1]])
+        >>> target = jnp.asarray([0, 1])
+        >>> sorted(metric(preds, target).keys())
+        ['accuracy_dog', 'accuracy_fish', 'accuracy_horse']
+    """
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:
+        super().__init__()
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+
+    def _convert(self, x: Array) -> Dict[str, Any]:
+        name = self.metric.__class__.__name__.lower()
+        if self.labels is None:
+            return {f"{name}_{i}": val for i, val in enumerate(x)}
+        return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        return self._convert(self.metric.compute())
+
+    def reset(self) -> None:
+        self.metric.reset()
+        super().reset()

@@ -1,0 +1,54 @@
+"""MinMaxMetric: track the running min/max of a wrapped metric's compute.
+
+Behavioral parity: /root/reference/torchmetrics/wrappers/minmax.py (109 LoC).
+"""
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MinMaxMetric(Metric):
+    """Track min/max of the base metric's computed value (ref minmax.py:23-109)."""
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"Expected base metric to be an instance of `Metric` but received {base_metric}")
+        self._base_metric = base_metric
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(
+                f"Returned value from base metric should be a scalar (int, float or tensor of size 1, but got {val}"
+            )
+        val = jnp.asarray(val)
+        self.max_val = jnp.where(self.max_val < val, val, self.max_val)
+        self.min_val = jnp.where(self.min_val > val, val, self.min_val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def reset(self) -> None:
+        super().reset()
+        self._base_metric.reset()
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
+
+    @staticmethod
+    def _is_suitable_val(val: Union[int, float, Array]) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, jax.Array):
+            return val.size == 1
+        return False

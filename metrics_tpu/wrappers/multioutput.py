@@ -1,0 +1,101 @@
+"""MultioutputWrapper: one metric copy per output column.
+
+Behavioral parity: /root/reference/torchmetrics/wrappers/multioutput.py (146 LoC).
+"""
+from copy import deepcopy
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import apply_to_collection
+
+Array = jax.Array
+
+
+class MultioutputWrapper(Metric):
+    """Evaluate a single-output metric independently per output column.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import R2Score
+        >>> from metrics_tpu.wrappers import MultioutputWrapper
+        >>> target = jnp.asarray([[0.5, 1], [-1, 1], [7, -6]])
+        >>> preds = jnp.asarray([[0.0, 2], [-1, 2], [8, -5]])
+        >>> r2score = MultioutputWrapper(R2Score(), 2)
+        >>> [round(float(v), 4) for v in r2score(preds, target)]
+        [0.9654, 0.9082]
+    """
+
+    is_differentiable = False
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+    ) -> None:
+        super().__init__()
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple]:
+        """Slice each input along output_dim per output (ref multioutput.py:95-120)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            def _select(x, idx=i):
+                out = jnp.take(x, jnp.asarray([idx]), axis=self.output_dim)
+                return out
+
+            selected_args = apply_to_collection(args, jax.Array, _select)
+            selected_kwargs = apply_to_collection(kwargs, jax.Array, _select)
+
+            if self.remove_nans:
+                flat = list(selected_args) + list(selected_kwargs.values())
+                if flat:
+                    nan_idxs = None
+                    for x in flat:
+                        x2 = np.asarray(x).reshape(len(np.asarray(x)), -1)
+                        mask = np.isnan(x2).any(axis=1)
+                        nan_idxs = mask if nan_idxs is None else (nan_idxs | mask)
+                    keep = ~nan_idxs
+                    selected_args = apply_to_collection(selected_args, jax.Array, lambda x: x[jnp.asarray(keep)])
+                    selected_kwargs = apply_to_collection(selected_kwargs, jax.Array, lambda x: x[jnp.asarray(keep)])
+
+            if self.squeeze_outputs:
+                selected_args = apply_to_collection(selected_args, jax.Array, lambda x: jnp.squeeze(x, self.output_dim))
+                selected_kwargs = apply_to_collection(
+                    selected_kwargs, jax.Array, lambda x: jnp.squeeze(x, self.output_dim)
+                )
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> List[Array]:
+        return [m.compute() for m in self.metrics]
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
+        results = [
+            metric(*selected_args, **selected_kwargs)
+            for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped)
+        ]
+        if any(res is None for res in results):
+            return None
+        return results
+
+    def reset(self) -> None:
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()

@@ -1,0 +1,142 @@
+"""MetricTracker: per-step clones of a metric with best-value lookup.
+
+Behavioral parity: /root/reference/torchmetrics/wrappers/tracker.py (212 LoC).
+"""
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class MetricTracker:
+    """Track a metric (or collection) over multiple steps/epochs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.wrappers import MetricTracker
+        >>> tracker = MetricTracker(Accuracy(num_classes=2))
+        >>> for epoch in range(3):
+        ...     tracker.increment()
+        ...     _ = tracker.update(jnp.asarray([1, 0, 1, int(epoch > 0)]), jnp.asarray([1, 0, 1, 1]))
+        >>> best, step = tracker.best_metric(return_step=True)
+        >>> best, step
+        (1.0, 1)
+    """
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                f"Metric arg need to be an instance of a Metric or MetricCollection but got {metric}"
+            )
+        self._base_metric = metric
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list):
+            if not isinstance(metric, MetricCollection) or len(maximize) != len(metric):
+                raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        self.maximize = maximize
+        self._steps: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    def __len__(self) -> int:
+        return len(self._steps)
+
+    def __getitem__(self, idx: int) -> Union[Metric, MetricCollection]:
+        return self._steps[idx]
+
+    @property
+    def n_steps(self) -> int:
+        return len(self._steps)
+
+    def increment(self) -> None:
+        """Start a new tracking step with a fresh copy of the base metric."""
+        self._increment_called = True
+        self._steps.append(deepcopy(self._base_metric))
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._steps[-1](*args, **kwargs)
+
+    __call__ = forward
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._steps[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._steps[-1].compute()
+
+    def compute_all(self) -> Union[Array, Dict[str, Array]]:
+        """Stack computes from every step (ref tracker.py:109-117)."""
+        self._check_for_increment("compute_all")
+        res = [m.compute() for m in self._steps]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+        return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+
+    def reset(self) -> None:
+        if self._steps:
+            self._steps[-1].reset()
+
+    def reset_all(self) -> None:
+        for m in self._steps:
+            m.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[float, Tuple[float, int], Dict[str, Optional[float]], Tuple[Dict[str, Optional[float]], Dict[str, Optional[int]]]]:
+        """Best value (and optionally its step) honoring `maximize` (ref tracker.py:128-184)."""
+        if isinstance(self._base_metric, Metric):
+            try:
+                res = np.asarray(self.compute_all())
+                idx = int(res.argmax() if self.maximize else res.argmin())
+                best = float(res[idx])
+                if return_step:
+                    return best, idx
+                return best
+            except (ValueError, TypeError) as error:
+                rank_zero_warn(
+                    f"Encountered the following error when trying to get the best metric: {error}"
+                    "this is probably due to the 'best' not being defined for this metric."
+                    "Returning `None` instead.",
+                    UserWarning,
+                )
+                if return_step:
+                    return None, None
+                return None
+        else:
+            res = self.compute_all()
+            maximize = self.maximize if isinstance(self.maximize, list) else len(res) * [self.maximize]
+            value, idx = {}, {}
+            for i, (k, v) in enumerate(res.items()):
+                try:
+                    v = np.asarray(v)
+                    best_i = int(v.argmax() if maximize[i] else v.argmin())
+                    value[k] = float(v[best_i])
+                    idx[k] = best_i
+                except (ValueError, TypeError) as error:
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}:"
+                        f"{error} this is probably due to the 'best' not being defined for this metric."
+                        "Returning `None` instead.",
+                        UserWarning,
+                    )
+                    value[k], idx[k] = None, None
+            if return_step:
+                return value, idx
+            return value
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called")

@@ -1,0 +1,124 @@
+"""Wrapper tests (translation of ref tests/wrappers/)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection, R2Score
+from metrics_tpu.wrappers import (
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+)
+
+
+class TestBootStrapper:
+    def test_output_keys(self):
+        m = BootStrapper(MeanSquaredError(), num_bootstraps=5, quantile=0.95, raw=True)
+        m.update(jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([1.0, 2.5, 3.5]))
+        out = m.compute()
+        assert set(out.keys()) == {"mean", "std", "quantile", "raw"}
+        assert out["raw"].shape == (5,)
+
+    def test_mean_close_to_base(self):
+        np.random.seed(0)
+        preds = np.random.rand(256).astype(np.float32)
+        target = np.random.rand(256).astype(np.float32)
+        base = MeanSquaredError()
+        base.update(jnp.asarray(preds), jnp.asarray(target))
+        boot = BootStrapper(MeanSquaredError(), num_bootstraps=50)
+        boot.update(jnp.asarray(preds), jnp.asarray(target))
+        out = boot.compute()
+        assert abs(float(out["mean"]) - float(base.compute())) < 0.02
+
+    def test_invalid_strategy(self):
+        with pytest.raises(ValueError, match="sampling_strategy"):
+            BootStrapper(MeanSquaredError(), sampling_strategy="bad")
+
+
+class TestClasswiseWrapper:
+    def test_labels(self):
+        metric = ClasswiseWrapper(Accuracy(num_classes=3, average="none"), labels=["horse", "fish", "dog"])
+        preds = jnp.asarray([[0.7, 0.2, 0.1], [0.2, 0.7, 0.1], [0.1, 0.1, 0.8]])
+        target = jnp.asarray([0, 1, 1])
+        out = metric(preds, target)
+        assert set(out.keys()) == {"accuracy_horse", "accuracy_fish", "accuracy_dog"}
+        assert np.asarray(out["accuracy_horse"]) == 1.0
+
+    def test_no_labels(self):
+        metric = ClasswiseWrapper(Accuracy(num_classes=3, average="none"))
+        preds = jnp.asarray([[0.7, 0.2, 0.1]])
+        target = jnp.asarray([0])
+        out = metric(preds, target)
+        assert set(out.keys()) == {"accuracy_0", "accuracy_1", "accuracy_2"}
+
+
+class TestMinMax:
+    def test_tracks_min_max(self):
+        base = Accuracy()
+        mm = MinMaxMetric(base)
+        preds1 = jnp.asarray([[0.1, 0.9], [0.2, 0.8]])
+        preds2 = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+        labels = jnp.asarray([[0, 1], [0, 1]])
+        out = mm(preds1, labels)
+        assert float(out["raw"]) == 1.0 and float(out["min"]) == 1.0 and float(out["max"]) == 1.0
+        mm.update(preds2, labels)
+        out = mm.compute()
+        assert float(out["raw"]) == 0.75
+        assert float(out["min"]) == 0.75
+        assert float(out["max"]) == 1.0
+
+    def test_reset(self):
+        mm = MinMaxMetric(Accuracy())
+        mm.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        mm.compute()
+        mm.reset()
+        assert float(mm.min_val) == float("inf")
+
+
+class TestMultioutput:
+    def test_r2(self):
+        target = jnp.asarray([[0.5, 1], [-1.0, 1], [7.0, -6]])
+        preds = jnp.asarray([[0.0, 2], [-1.0, 2], [8.0, -5]])
+        r2 = MultioutputWrapper(R2Score(), 2)
+        out = r2(preds, target)
+        np.testing.assert_allclose(np.asarray(out[0]), 0.9654, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(out[1]), 0.9082, atol=1e-4)
+
+    def test_remove_nans(self):
+        target = np.asarray([[0.5, 1], [-1.0, 1], [7.0, np.nan]], dtype=np.float32)
+        preds = np.asarray([[0.0, 2], [-1.0, 2], [8.0, -5]], dtype=np.float32)
+        r2 = MultioutputWrapper(MeanSquaredError(), 2)
+        out = r2(jnp.asarray(preds), jnp.asarray(target))
+        assert np.isfinite(np.asarray(out[1]))
+
+
+class TestTracker:
+    def test_basic_flow(self):
+        tracker = MetricTracker(Accuracy(num_classes=2))
+        for epoch in range(3):
+            tracker.increment()
+            tracker.update(jnp.asarray([1, 0, 1, int(epoch > 0)]), jnp.asarray([1, 0, 1, 1]))
+        all_res = tracker.compute_all()
+        assert all_res.shape == (3,)
+        best, step = tracker.best_metric(return_step=True)
+        assert best == 1.0 and step == 1
+
+    def test_collection(self):
+        tracker = MetricTracker(
+            MetricCollection({"acc": Accuracy(num_classes=2), "mse": MeanSquaredError()}),
+            maximize=[True, False],
+        )
+        for _ in range(2):
+            tracker.increment()
+            tracker.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        res = tracker.compute_all()
+        assert set(res.keys()) == {"acc", "mse"}
+        best = tracker.best_metric()
+        assert set(best.keys()) == {"acc", "mse"}
+
+    def test_increment_required(self):
+        tracker = MetricTracker(Accuracy())
+        with pytest.raises(ValueError, match="cannot be called before"):
+            tracker.update(jnp.asarray([1]), jnp.asarray([1]))
