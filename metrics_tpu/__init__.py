@@ -88,6 +88,20 @@ from metrics_tpu.wrappers import (  # noqa: E402, F401
     MultioutputWrapper,
 )
 
+from metrics_tpu.image import (  # noqa: E402, F401
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    UniversalImageQualityIndex,
+)
+
 __all__ = [
     "AUC",
     "AUROC",
@@ -145,5 +159,15 @@ __all__ = [
     "ClasswiseWrapper",
     "MetricTracker",
     "MinMaxMetric",
-    "MultioutputWrapper",
+    "MultioutputWrapper",    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure",
+    "UniversalImageQualityIndex",
 ]

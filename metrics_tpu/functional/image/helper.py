@@ -1,0 +1,67 @@
+"""Gaussian kernels and padding helpers for image metrics.
+
+Behavioral parity: /root/reference/torchmetrics/functional/image/helper.py
+(122 LoC).
+"""
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    """1D gaussian window, normalized to sum 1 (ref helper.py:15-27)."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-jnp.square(dist / sigma) / 2)
+    return (gauss / gauss.sum())[None, :]  # (1, kernel_size)
+
+
+def _gaussian_kernel_2d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
+    """Depthwise 2D gaussian kernel of shape (C, 1, kh, kw) (ref helper.py:29-59)."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = kernel_x.T @ kernel_y  # (kh, kw)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
+    """Depthwise 3D gaussian kernel of shape (C, 1, kh, kw, kd) (ref helper.py:62-82)."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel_z = _gaussian(kernel_size[2], sigma[2], dtype)
+    kernel_xy = kernel_x.T @ kernel_y  # (kh, kw)
+    kernel = kernel_xy[:, :, None] * kernel_z.reshape(1, 1, -1)
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel_size))
+
+
+def _depthwise_conv(x: Array, kernel: Array) -> Array:
+    """Depthwise (grouped) valid convolution for NCHW / NCDHW inputs.
+
+    ``kernel`` has shape (C, 1, *spatial); lowers to one XLA conv with
+    ``feature_group_count=C`` — maps directly onto the TPU convolution unit.
+    """
+    spatial = kernel.ndim - 2
+    dn_str = ("NCHW", "OIHW", "NCHW") if spatial == 2 else ("NCDHW", "OIDHW", "NCDHW")
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1,) * spatial,
+        padding="VALID",
+        dimension_numbers=dn_str,
+        feature_group_count=kernel.shape[0],
+    )
+
+
+def _reflection_pad(x: Array, pads: Sequence[int]) -> Array:
+    """Reflection-pad the trailing spatial dims of an (N, C, *spatial) tensor."""
+    pad_width = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    return jnp.pad(x, pad_width, mode="reflect")
+
+
+def _avg_pool(x: Array, window: int = 2) -> Array:
+    """Non-overlapping average pooling over the trailing spatial dims."""
+    spatial = x.ndim - 2
+    dims = (1, 1) + (window,) * spatial
+    return jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, dims, "VALID") / (window**spatial)

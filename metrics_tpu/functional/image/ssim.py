@@ -1,0 +1,292 @@
+"""SSIM and multi-scale SSIM functional implementations.
+
+Behavioral parity: /root/reference/torchmetrics/functional/image/ssim.py
+(487 LoC). The five statistics convolutions are batched into one depthwise
+XLA conv (``_depthwise_conv`` with feature groups), matching the reference's
+trick of concatenating (preds, target, p², t², p·t) along batch.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.helper import (
+    _avg_pool,
+    _depthwise_conv,
+    _gaussian_kernel_2d,
+    _gaussian_kernel_3d,
+    _reflection_pad,
+)
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.distributed import reduce
+
+Array = jax.Array
+
+
+def _ssim_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Validate shape/dtype (ref ssim.py:25-45)."""
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim not in (4, 5):
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _ssim_compute(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """SSIM core (ref ssim.py:48-196)."""
+    is_3d = preds.ndim == 5
+    n_spatial = 3 if is_3d else 2
+
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = n_spatial * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = n_spatial * [sigma]
+
+    if len(kernel_size) != preds.ndim - 2 or len(kernel_size) not in (2, 3):
+        raise ValueError(
+            f"`kernel_size` has dimension {len(kernel_size)}, but expected to be two less than target dimensionality,"
+            f" which is: {preds.ndim}"
+        )
+    if len(sigma) != preds.ndim - 2 or len(sigma) not in (2, 3):
+        raise ValueError(
+            f"`sigma` has dimension {len(sigma)}, but expected to be two less than target dimensionality,"
+            f" which is: {preds.ndim}"
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    if data_range is None:
+        data_range = jnp.maximum(preds.max() - preds.min(), target.max() - target.min())
+
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+
+    channel = preds.shape[1]
+    dtype = preds.dtype
+    if gaussian_kernel:
+        used_kernel_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
+    else:
+        used_kernel_size = list(kernel_size)
+
+    pads = [(k - 1) // 2 for k in used_kernel_size]
+    preds_p = _reflection_pad(preds, pads)
+    target_p = _reflection_pad(target, pads)
+
+    if gaussian_kernel:
+        if is_3d:
+            kernel = _gaussian_kernel_3d(channel, used_kernel_size, sigma, dtype)
+        else:
+            kernel = _gaussian_kernel_2d(channel, used_kernel_size, sigma, dtype)
+    else:
+        kernel = jnp.ones((channel, 1, *kernel_size), dtype=dtype) / np_prod(kernel_size)
+
+    # one grouped conv over (5*B, C, ...) computes all five statistics
+    input_list = jnp.concatenate((preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p))
+    outputs = _depthwise_conv(input_list, kernel)
+    b = preds_p.shape[0]
+    mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (
+        outputs[i * b:(i + 1) * b] for i in range(5)
+    )
+
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+
+    sigma_pred_sq = e_pred_sq - mu_pred_sq
+    sigma_target_sq = e_target_sq - mu_target_sq
+    sigma_pred_target = e_pred_target - mu_pred_target
+
+    upper = 2 * sigma_pred_target + c2
+    lower = sigma_pred_sq + sigma_target_sq + c2
+
+    ssim_idx_full_image = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+
+    # conv was VALID on the padded image, so the output already has the
+    # original spatial extent; crop the border that saw reflected pixels
+    crops = tuple(slice(p, s - p) for p, s in zip(pads, ssim_idx_full_image.shape[2:]))
+    ssim_idx = ssim_idx_full_image[(Ellipsis, *crops)]
+
+    if return_contrast_sensitivity:
+        contrast_sensitivity = (upper / lower)[(Ellipsis, *crops)]
+        return (
+            reduce(ssim_idx.reshape(ssim_idx.shape[0], -1).mean(-1), reduction),
+            reduce(contrast_sensitivity.reshape(contrast_sensitivity.shape[0], -1).mean(-1), reduction),
+        )
+    if return_full_image:
+        return (
+            reduce(ssim_idx.reshape(ssim_idx.shape[0], -1).mean(-1), reduction),
+            reduce(ssim_idx_full_image, reduction),
+        )
+    return reduce(ssim_idx.reshape(ssim_idx.shape[0], -1).mean(-1), reduction)
+
+
+def np_prod(xs) -> float:
+    out = 1.0
+    for x in xs:
+        out *= x
+    return out
+
+
+def structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """SSIM (ref ssim.py:199-271).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 1, 16, 16))
+        >>> target = preds * 0.75
+        >>> from metrics_tpu.functional import structural_similarity_index_measure
+        >>> float(structural_similarity_index_measure(preds, target)) > 0.9
+        True
+    """
+    preds, target = _ssim_update(preds, target)
+    return _ssim_compute(
+        preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range, k1, k2,
+        return_full_image, return_contrast_sensitivity,
+    )
+
+
+def _get_normalized_sim_and_cs(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    normalize: Optional[str] = None,
+) -> Tuple[Array, Array]:
+    """Parity: ref ssim.py:274-303."""
+    sim, contrast_sensitivity = _ssim_compute(
+        preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range, k1, k2,
+        return_contrast_sensitivity=True,
+    )
+    if normalize == "relu":
+        sim = jax.nn.relu(sim)
+        contrast_sensitivity = jax.nn.relu(contrast_sensitivity)
+    return sim, contrast_sensitivity
+
+
+def _multiscale_ssim_compute(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = None,
+) -> Array:
+    """MS-SSIM: per-scale SSIM/CS with 2x downsampling (ref ssim.py:306-413)."""
+    sim_list: List[Array] = []
+    cs_list: List[Array] = []
+
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = (3 if preds.ndim == 5 else 2) * [kernel_size]
+
+    if preds.shape[-1] < 2 ** len(betas) or preds.shape[-2] < 2 ** len(betas):
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width dimensions must be"
+            f" larger than or equal to {2 ** len(betas)}."
+        )
+    _betas_div = max(1, (len(betas) - 1)) ** 2
+    if preds.shape[-2] // _betas_div <= kernel_size[0] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[0]},"
+            f" the image height must be larger than {(kernel_size[0] - 1) * _betas_div}."
+        )
+    if preds.shape[-1] // _betas_div <= kernel_size[1] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[1]},"
+            f" the image width must be larger than {(kernel_size[1] - 1) * _betas_div}."
+        )
+
+    for _ in range(len(betas)):
+        sim, contrast_sensitivity = _get_normalized_sim_and_cs(
+            preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range, k1, k2, normalize=normalize
+        )
+        sim_list.append(sim)
+        cs_list.append(contrast_sensitivity)
+        preds = _avg_pool(preds, 2)
+        target = _avg_pool(target, 2)
+
+    sim_stack = jnp.stack(sim_list)
+    cs_stack = jnp.stack(cs_list)
+
+    if normalize == "simple":
+        sim_stack = (sim_stack + 1) / 2
+        cs_stack = (cs_stack + 1) / 2
+
+    betas_arr = jnp.asarray(betas)
+    if reduction is None or reduction == "none":
+        sim_stack = sim_stack ** betas_arr[:, None]
+        cs_stack = cs_stack ** betas_arr[:, None]
+        cs_and_sim = jnp.concatenate((cs_stack[:-1], sim_stack[-1:]), axis=0)
+        return jnp.prod(cs_and_sim, axis=0)
+    sim_stack = sim_stack**betas_arr
+    cs_stack = cs_stack**betas_arr
+    return jnp.prod(cs_stack[:-1]) * sim_stack[-1]
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = "relu",
+) -> Array:
+    """Multi-scale SSIM (ref ssim.py:416-487)."""
+    if not isinstance(betas, tuple):
+        raise ValueError("Argument `betas` is expected to be of a type tuple")
+    if isinstance(betas, tuple) and not all(isinstance(beta, float) for beta in betas):
+        raise ValueError("Argument `betas` is expected to be a tuple of floats")
+    if normalize and normalize not in ("relu", "simple"):
+        raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+
+    preds, target = _ssim_update(preds, target)
+    return _multiscale_ssim_compute(
+        preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range, k1, k2, betas, normalize
+    )

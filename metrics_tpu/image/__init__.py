@@ -1,0 +1,13 @@
+from metrics_tpu.image.d_lambda import SpectralDistortionIndex  # noqa: F401
+from metrics_tpu.image.ergas import ErrorRelativeGlobalDimensionlessSynthesis  # noqa: F401
+from metrics_tpu.image.fid import FrechetInceptionDistance  # noqa: F401
+from metrics_tpu.image.inception import InceptionScore  # noqa: F401
+from metrics_tpu.image.kid import KernelInceptionDistance  # noqa: F401
+from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity  # noqa: F401
+from metrics_tpu.image.psnr import PeakSignalNoiseRatio  # noqa: F401
+from metrics_tpu.image.sam import SpectralAngleMapper  # noqa: F401
+from metrics_tpu.image.ssim import (  # noqa: F401
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+from metrics_tpu.image.uqi import UniversalImageQualityIndex  # noqa: F401

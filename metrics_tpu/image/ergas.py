@@ -1,0 +1,40 @@
+"""ErrorRelativeGlobalDimensionlessSynthesis module (ref /root/reference/torchmetrics/image/ergas.py, 97 LoC)."""
+from typing import Any, Optional, Union
+
+import jax
+
+from metrics_tpu.functional.image.ergas import _ergas_compute, _ergas_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
+    """ERGAS over accumulated image batches."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        ratio: Union[int, float] = 4,
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.ratio = ratio
+        self.reduction = reduction
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ergas_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _ergas_compute(preds, target, self.ratio, self.reduction)

@@ -1,0 +1,127 @@
+"""Fréchet Inception Distance with a jit-able device-side matrix sqrt.
+
+Behavioral parity: /root/reference/torchmetrics/image/fid.py (296 LoC). Two
+TPU-first departures:
+
+* The reference computes the matrix square root with
+  ``scipy.linalg.sqrtm`` on host CPU via a custom autograd Function
+  (fid.py:60-94) — a device→host→device round trip per compute. Here the
+  FID trace term is computed entirely on device from eigenvalues:
+  ``tr(sqrtm(S1 S2)) = sum(sqrt(eigvals(S1 S2)))`` evaluated via the
+  symmetric product ``sqrt(S1) S2 sqrt(S1)`` — pure jnp, jit-able,
+  differentiable.
+* The feature extractor is injectable: any callable mapping an image batch
+  to ``(N, D)`` features (e.g. a Flax InceptionV3 with loaded weights; the
+  reference hardcodes ``torch_fidelity``'s InceptionV3, fid.py:27-57).
+  Pretrained weights are an asset, not code, so the framework does not
+  bundle them.
+"""
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _sym_sqrtm(mat: Array, eps: float = 1e-12) -> Array:
+    """Symmetric PSD matrix square root via eigendecomposition (device-side)."""
+    vals, vecs = jnp.linalg.eigh(mat)
+    vals = jnp.clip(vals, min=0.0)
+    return (vecs * jnp.sqrt(vals + eps)) @ vecs.T
+
+
+def _trace_sqrtm_product(sigma1: Array, sigma2: Array) -> Array:
+    """tr(sqrtm(sigma1 @ sigma2)) for PSD inputs, fully on device."""
+    s1_half = _sym_sqrtm(sigma1)
+    m = s1_half @ sigma2 @ s1_half  # similar to sigma1 @ sigma2, symmetric PSD
+    vals = jnp.linalg.eigvalsh(m)
+    return jnp.sqrt(jnp.clip(vals, min=0.0)).sum()
+
+
+def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
+    """FID from feature means/covariances (semantics of ref fid.py:97-124)."""
+    diff = mu1 - mu2
+    a = (diff * diff).sum()
+    b = jnp.trace(sigma1) + jnp.trace(sigma2)
+    c = _trace_sqrtm_product(sigma1, sigma2)
+    return a + b - 2 * c
+
+
+def _mean_cov(features: Array) -> tuple:
+    n = features.shape[0]
+    mu = features.mean(axis=0)
+    centered = features - mu
+    sigma = centered.T @ centered / (n - 1)
+    return mu, sigma
+
+
+class FrechetInceptionDistance(Metric):
+    """FID between accumulated real and generated feature distributions.
+
+    Args:
+        feature_extractor: callable mapping an image batch to ``(N, D)``
+            features. Required unless updates are called with pre-extracted
+            features (``feature_extractor=None`` passes inputs through).
+        reset_real_features: keep real features across ``reset()`` calls
+            (ref fid.py:289).
+
+    Example (pre-extracted features):
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.image.fid import FrechetInceptionDistance
+        >>> fid = FrechetInceptionDistance()
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> fid.update(jax.random.normal(key1, (64, 8)), real=True)
+        >>> fid.update(jax.random.normal(key2, (64, 8)) + 1.0, real=False)
+        >>> float(fid.compute()) > 0
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        feature_extractor: Optional[Callable[[Array], Array]] = None,
+        reset_real_features: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.feature_extractor = feature_extractor
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+
+        self.add_state("real_features", [], dist_reduce_fx=None)
+        self.add_state("fake_features", [], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Extract features (or pass through) and accumulate (ref fid.py:254-266)."""
+        features = self.feature_extractor(imgs) if self.feature_extractor is not None else imgs
+        if features.ndim != 2:
+            raise ValueError(f"Expected extracted features to be 2d (N, D), got shape {features.shape}")
+        if real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    def compute(self) -> Array:
+        """FID over the accumulated features (ref fid.py:268-287)."""
+        real_features = dim_zero_cat(self.real_features)
+        fake_features = dim_zero_cat(self.fake_features)
+        mu1, sigma1 = _mean_cov(real_features.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32))
+        mu2, sigma2 = _mean_cov(fake_features.astype(mu1.dtype))
+        return _compute_fid(mu1, sigma1, mu2, sigma2)
+
+    def reset(self) -> None:
+        """Optionally preserve real features across resets (ref fid.py:289-296)."""
+        if not self.reset_real_features:
+            real_features = self.real_features
+            super().reset()
+            object.__setattr__(self, "real_features", real_features)
+        else:
+            super().reset()

@@ -1,0 +1,71 @@
+"""Learned Perceptual Image Patch Similarity with an injectable net.
+
+Behavioral parity: /root/reference/torchmetrics/image/lpip.py (149 LoC). The
+reference wraps the ``lpips`` package's pretrained AlexNet/VGG/SqueezeNet
+(lpip.py:25-40); pretrained perceptual nets are weight assets, so here the
+similarity network is injectable: any callable ``(img1, img2) -> (N,)``
+per-pair distances (e.g. a Flax port of LPIPS with loaded weights).
+"""
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """Average learned perceptual distance over batches (ref lpip.py:43-149).
+
+    Args:
+        net: callable ``(img1, img2) -> (N,)`` perceptual distances.
+        reduction: 'mean' | 'sum' over the accumulated per-pair scores.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+        >>> l2_net = lambda a, b: jnp.square(a - b).mean(axis=(1, 2, 3))
+        >>> lpips = LearnedPerceptualImagePatchSimilarity(net=l2_net)
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> img1 = jax.random.uniform(key1, (4, 3, 8, 8))
+        >>> img2 = jax.random.uniform(key2, (4, 3, 8, 8))
+        >>> float(lpips(img1, img2)) > 0
+        True
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        net: Optional[Callable[[Array, Array], Array]] = None,
+        reduction: str = "mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if net is None:
+            raise ValueError(
+                "LPIPS requires a perceptual network: pass `net=callable(img1, img2) -> (N,) distances`"
+                " (e.g. a Flax LPIPS port with loaded weights)."
+            )
+        self.net = net
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction} but got {reduction}")
+        self.reduction = reduction
+
+        self.add_state("sum_scores", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        loss = self.net(img1, img2)
+        self.sum_scores = self.sum_scores + loss.sum()
+        self.total = self.total + loss.shape[0]
+
+    def compute(self) -> Array:
+        if self.reduction == "mean":
+            return self.sum_scores / self.total
+        return self.sum_scores

@@ -1,0 +1,44 @@
+"""UniversalImageQualityIndex module (ref /root/reference/torchmetrics/image/uqi.py, 102 LoC)."""
+from typing import Any, Optional, Sequence
+
+import jax
+
+from metrics_tpu.functional.image.uqi import _uqi_compute, _uqi_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class UniversalImageQualityIndex(Metric):
+    """UQI over accumulated image batches."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+        self.data_range = data_range
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _uqi_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _uqi_compute(preds, target, self.kernel_size, self.sigma, self.reduction, self.data_range)

@@ -1,0 +1,246 @@
+"""Image metric tests vs skimage/scipy oracles (translation of ref tests/image/)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.ndimage import gaussian_filter
+
+
+def sk_psnr(target, preds, data_range):
+    """numpy PSNR reference (what skimage.metrics.peak_signal_noise_ratio computes)."""
+    mse = np.mean((np.asarray(target, np.float64) - np.asarray(preds, np.float64)) ** 2)
+    return 10 * np.log10(data_range**2 / mse)
+
+
+def _np_ssim_single_channel(t, p, data_range, sigma=1.5):
+    """numpy gaussian-weighted SSIM (population covariance), skimage-style."""
+    t, p = t.astype(np.float64), p.astype(np.float64)
+    filt = lambda x: gaussian_filter(x, sigma, truncate=3.5, mode="reflect")
+    c1, c2 = (0.01 * data_range) ** 2, (0.03 * data_range) ** 2
+    mu_t, mu_p = filt(t), filt(p)
+    s_tt = filt(t * t) - mu_t**2
+    s_pp = filt(p * p) - mu_p**2
+    s_tp = filt(t * p) - mu_t * mu_p
+    ssim_map = ((2 * mu_t * mu_p + c1) * (2 * s_tp + c2)) / ((mu_t**2 + mu_p**2 + c1) * (s_tt + s_pp + c2))
+    pad = int(3.5 * sigma + 0.5)
+    return ssim_map[pad:-pad, pad:-pad].mean()
+
+
+def sk_ssim(t, p, channel_axis, gaussian_weights, sigma, use_sample_covariance, data_range):
+    vals = [
+        _np_ssim_single_channel(np.take(t, c, channel_axis), np.take(p, c, channel_axis), data_range, sigma)
+        for c in range(t.shape[channel_axis])
+    ]
+    return np.mean(vals)
+
+from metrics_tpu import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    UniversalImageQualityIndex,
+)
+from metrics_tpu.functional import (
+    image_gradients,
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    spectral_angle_mapper,
+    structural_similarity_index_measure,
+)
+from tests.helpers import seed_all
+
+seed_all(9)
+
+_preds = np.random.rand(4, 8, 3, 32, 32).astype(np.float32)
+_target = np.clip(_preds + 0.1 * np.random.randn(4, 8, 3, 32, 32).astype(np.float32), 0, 1)
+
+
+class TestPSNR:
+    def test_vs_skimage(self):
+        m = PeakSignalNoiseRatio(data_range=1.0)
+        for i in range(4):
+            m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        expected = sk_psnr(_target.reshape(-1), _preds.reshape(-1), data_range=1.0)
+        np.testing.assert_allclose(np.asarray(m.compute()), expected, rtol=1e-4)
+
+    def test_functional(self):
+        val = peak_signal_noise_ratio(jnp.asarray(_preds[0]), jnp.asarray(_target[0]), data_range=1.0)
+        expected = sk_psnr(_target[0].reshape(-1), _preds[0].reshape(-1), data_range=1.0)
+        np.testing.assert_allclose(np.asarray(val), expected, rtol=1e-4)
+
+    def test_data_range_inferred(self):
+        pred = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        np.testing.assert_allclose(np.asarray(peak_signal_noise_ratio(pred, target)), 2.5527, atol=1e-4)
+
+
+class TestSSIM:
+    def test_vs_skimage(self):
+        """Per-image SSIM vs skimage's gaussian-weighted implementation."""
+        p, t = _preds[0], _target[0]
+        ours = structural_similarity_index_measure(
+            jnp.asarray(p), jnp.asarray(t), data_range=1.0, reduction="none"
+        )
+        for i in range(p.shape[0]):
+            expected = sk_ssim(
+                t[i], p[i], channel_axis=0, gaussian_weights=True, sigma=1.5,
+                use_sample_covariance=False, data_range=1.0,
+            )
+            np.testing.assert_allclose(np.asarray(ours[i]), expected, atol=5e-4)
+
+    def test_module_accumulates(self):
+        m = StructuralSimilarityIndexMeasure(data_range=1.0)
+        for i in range(2):
+            m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        val = float(m.compute())
+        assert 0.0 < val <= 1.0
+
+    def test_identical_images(self):
+        p = jnp.asarray(_preds[0])
+        val = structural_similarity_index_measure(p, p, data_range=1.0)
+        np.testing.assert_allclose(np.asarray(val), 1.0, atol=1e-6)
+
+    def test_ms_ssim_identical(self):
+        p = jnp.asarray(np.random.rand(2, 1, 192, 192).astype(np.float32))
+        val = multiscale_structural_similarity_index_measure(p, p, data_range=1.0)
+        np.testing.assert_allclose(np.asarray(val), 1.0, atol=1e-5)
+
+    def test_ms_ssim_module(self):
+        p = np.random.rand(2, 1, 192, 192).astype(np.float32)
+        t = np.clip(p + 0.05 * np.random.randn(2, 1, 192, 192).astype(np.float32), 0, 1)
+        m = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        val = float(m.compute())
+        assert 0.0 < val <= 1.0
+
+
+class TestUQI:
+    def test_identical(self):
+        p = jnp.asarray(_preds[0])
+        val = UniversalImageQualityIndex()(p, p)
+        np.testing.assert_allclose(np.asarray(val), 1.0, atol=1e-4)
+
+    def test_range(self):
+        val = UniversalImageQualityIndex()(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+        assert 0.0 < float(val) <= 1.0
+
+
+class TestERGAS:
+    def test_identical_is_zero(self):
+        p = jnp.asarray(_preds[0])
+        val = ErrorRelativeGlobalDimensionlessSynthesis()(p, p)
+        np.testing.assert_allclose(np.asarray(val), 0.0, atol=1e-5)
+
+    def test_numpy_reference(self):
+        p, t = _preds[0], _target[0]
+        b, c, h, w = p.shape
+        diff = (p - t).reshape(b, c, -1)
+        rmse = np.sqrt((diff**2).sum(-1) / (h * w))
+        mean_t = t.reshape(b, c, -1).mean(-1)
+        expected = (100 * 4 * np.sqrt(((rmse / mean_t) ** 2).sum(1) / c)).mean()
+        val = ErrorRelativeGlobalDimensionlessSynthesis()(jnp.asarray(p), jnp.asarray(t))
+        np.testing.assert_allclose(np.asarray(val), expected, rtol=1e-4)
+
+
+class TestSAM:
+    def test_identical_is_zero(self):
+        p = jnp.asarray(_preds[0])
+        val = SpectralAngleMapper()(p, p)
+        np.testing.assert_allclose(np.asarray(val), 0.0, atol=2e-3)
+
+    def test_numpy_reference(self):
+        p, t = _preds[0], _target[0]
+        dot = (p * t).sum(1)
+        angle = np.arccos(np.clip(dot / (np.linalg.norm(p, axis=1) * np.linalg.norm(t, axis=1)), -1, 1))
+        val = spectral_angle_mapper(jnp.asarray(p), jnp.asarray(t))
+        np.testing.assert_allclose(np.asarray(val), angle.mean(), atol=1e-4)
+
+
+class TestDLambda:
+    def test_identical_is_zero(self):
+        p = jnp.asarray(_preds[0])
+        val = SpectralDistortionIndex()(p, p)
+        np.testing.assert_allclose(np.asarray(val), 0.0, atol=1e-5)
+
+
+def test_image_gradients():
+    image = jnp.arange(0, 25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+    dy, dx = image_gradients(image)
+    np.testing.assert_allclose(np.asarray(dy[0, 0, :4]), 5 * np.ones((4, 5)))
+    np.testing.assert_allclose(np.asarray(dy[0, 0, 4]), np.zeros(5))
+    np.testing.assert_allclose(np.asarray(dx[0, 0, :, :4]), np.ones((5, 4)))
+
+
+class TestGenerativeMetrics:
+    def test_fid_vs_scipy(self):
+        """FID with on-device matrix sqrt must match the scipy sqrtm formula."""
+        from scipy import linalg
+
+        rng = np.random.RandomState(0)
+        real = rng.randn(256, 16).astype(np.float64)
+        fake = (rng.randn(256, 16) + 0.5).astype(np.float64)
+
+        fid = FrechetInceptionDistance()
+        fid.update(jnp.asarray(real, dtype=jnp.float32), real=True)
+        fid.update(jnp.asarray(fake, dtype=jnp.float32), real=False)
+        ours = float(fid.compute())
+
+        mu1, sigma1 = real.mean(0), np.cov(real, rowvar=False)
+        mu2, sigma2 = fake.mean(0), np.cov(fake, rowvar=False)
+        diff = mu1 - mu2
+        covmean = linalg.sqrtm(sigma1 @ sigma2).real
+        expected = diff @ diff + np.trace(sigma1) + np.trace(sigma2) - 2 * np.trace(covmean)
+        np.testing.assert_allclose(ours, expected, rtol=1e-2)
+
+    def test_fid_reset_real(self):
+        fid = FrechetInceptionDistance(reset_real_features=False)
+        fid.update(jnp.asarray(np.random.randn(8, 4), dtype=jnp.float32), real=True)
+        fid.reset()
+        assert len(fid.real_features) == 1
+
+    def test_fid_with_extractor(self):
+        extractor = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :8]
+        fid = FrechetInceptionDistance(feature_extractor=extractor)
+        fid.update(jnp.asarray(np.random.rand(16, 3, 4, 4), dtype=jnp.float32), real=True)
+        fid.update(jnp.asarray(np.random.rand(16, 3, 4, 4), dtype=jnp.float32), real=False)
+        assert np.isfinite(float(fid.compute()))
+
+    def test_inception_score(self):
+        inception = InceptionScore(splits=2)
+        inception.update(jnp.asarray(np.random.randn(64, 10), dtype=jnp.float32))
+        mean, std = inception.compute()
+        assert float(mean) >= 1.0  # IS is lower-bounded by 1
+        assert float(std) >= 0.0
+
+    def test_kid(self):
+        kid = KernelInceptionDistance(subsets=3, subset_size=32)
+        rng = np.random.RandomState(1)
+        kid.update(jnp.asarray(rng.randn(64, 8), dtype=jnp.float32), real=True)
+        kid.update(jnp.asarray(rng.randn(64, 8) + 1, dtype=jnp.float32), real=False)
+        mean, std = kid.compute()
+        assert float(mean) > 0
+
+    def test_kid_subset_size_error(self):
+        kid = KernelInceptionDistance(subsets=2, subset_size=100)
+        kid.update(jnp.asarray(np.random.randn(16, 4), dtype=jnp.float32), real=True)
+        kid.update(jnp.asarray(np.random.randn(16, 4), dtype=jnp.float32), real=False)
+        with pytest.raises(ValueError, match="subset_size"):
+            kid.compute()
+
+    def test_lpips_requires_net(self):
+        with pytest.raises(ValueError, match="perceptual network"):
+            LearnedPerceptualImagePatchSimilarity()
+
+    def test_lpips_with_net(self):
+        l2_net = lambda a, b: jnp.square(a - b).mean(axis=(1, 2, 3))
+        lpips = LearnedPerceptualImagePatchSimilarity(net=l2_net)
+        img1 = jnp.asarray(np.random.rand(4, 3, 8, 8), dtype=jnp.float32)
+        img2 = jnp.asarray(np.random.rand(4, 3, 8, 8), dtype=jnp.float32)
+        val = lpips(img1, img2)
+        assert float(val) > 0
