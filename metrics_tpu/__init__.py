@@ -102,6 +102,14 @@ from metrics_tpu.image import (  # noqa: E402, F401
     UniversalImageQualityIndex,
 )
 
+from metrics_tpu.audio import (  # noqa: E402, F401
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+
 __all__ = [
     "AUC",
     "AUROC",
@@ -169,5 +177,9 @@ __all__ = [
     "SpectralAngleMapper",
     "SpectralDistortionIndex",
     "StructuralSimilarityIndexMeasure",
-    "UniversalImageQualityIndex",
+    "UniversalImageQualityIndex",    "PermutationInvariantTraining",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "SignalDistortionRatio",
+    "SignalNoiseRatio",
 ]

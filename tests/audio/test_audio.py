@@ -1,0 +1,184 @@
+"""Audio metric tests vs numpy/mir_eval-style oracles (translation of ref tests/audio/)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from metrics_tpu.functional import (
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+)
+from tests.helpers import seed_all
+
+seed_all(11)
+
+
+def _np_snr(preds, target, zero_mean=False):
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    noise = target - preds
+    return 10 * np.log10((target**2).sum(-1) / (noise**2).sum(-1))
+
+
+def _np_si_sdr(preds, target, zero_mean=False):
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    alpha = (preds * target).sum(-1, keepdims=True) / (target**2).sum(-1, keepdims=True)
+    t = alpha * target
+    noise = t - preds
+    return 10 * np.log10((t**2).sum(-1) / (noise**2).sum(-1))
+
+
+class TestSNR:
+    preds = np.random.randn(4, 8, 500).astype(np.float32)
+    target = (np.random.randn(4, 8, 500) * 0.1).astype(np.float32) + preds
+
+    def test_snr_functional(self):
+        val = signal_noise_ratio(jnp.asarray(self.preds[0]), jnp.asarray(self.target[0]))
+        np.testing.assert_allclose(np.asarray(val), _np_snr(self.preds[0], self.target[0]), rtol=1e-4)
+
+    def test_snr_module(self):
+        m = SignalNoiseRatio()
+        for i in range(4):
+            m.update(jnp.asarray(self.preds[i]), jnp.asarray(self.target[i]))
+        expected = np.mean([_np_snr(self.preds[i], self.target[i]).mean() for i in range(4)])
+        np.testing.assert_allclose(np.asarray(m.compute()), expected, rtol=1e-4)
+
+    def test_si_snr(self):
+        val = scale_invariant_signal_noise_ratio(jnp.asarray(self.preds[0]), jnp.asarray(self.target[0]))
+        np.testing.assert_allclose(
+            np.asarray(val), _np_si_sdr(self.preds[0], self.target[0], zero_mean=True), rtol=1e-3
+        )
+
+    def test_si_snr_known_value(self):
+        target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        np.testing.assert_allclose(
+            np.asarray(ScaleInvariantSignalNoiseRatio()(preds, target)), 15.0918, atol=1e-3
+        )
+
+
+class TestSISDR:
+    def test_si_sdr_functional(self):
+        preds = np.random.randn(8, 1000).astype(np.float32)
+        target = (preds + 0.2 * np.random.randn(8, 1000)).astype(np.float32)
+        val = scale_invariant_signal_distortion_ratio(jnp.asarray(preds), jnp.asarray(target))
+        np.testing.assert_allclose(np.asarray(val), _np_si_sdr(preds, target), rtol=1e-3)
+
+    def test_module_average(self):
+        m = ScaleInvariantSignalDistortionRatio()
+        preds = np.random.randn(2, 4, 500).astype(np.float32)
+        target = (preds + 0.1 * np.random.randn(2, 4, 500)).astype(np.float32)
+        for i in range(2):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        expected = _np_si_sdr(preds.reshape(-1, 500), target.reshape(-1, 500)).mean()
+        np.testing.assert_allclose(np.asarray(m.compute()), expected, rtol=1e-3)
+
+
+class TestSDR:
+    def test_perfect_reconstruction(self):
+        """SDR of a signal against a filtered copy of itself should be high."""
+        x = np.random.randn(4000).astype(np.float32)
+        val = float(signal_distortion_ratio(jnp.asarray(x), jnp.asarray(x), filter_length=64))
+        assert val > 40  # near-perfect
+
+    def test_distorted_lower(self):
+        x = np.random.randn(4000).astype(np.float32)
+        y = x + 0.5 * np.random.randn(4000).astype(np.float32)
+        clean = float(signal_distortion_ratio(jnp.asarray(x), jnp.asarray(x), filter_length=64))
+        noisy = float(signal_distortion_ratio(jnp.asarray(y), jnp.asarray(x), filter_length=64))
+        assert noisy < clean
+
+    def test_filtered_signal_recovered(self):
+        """SDR is invariant to short linear filtering of the target."""
+        x = np.random.randn(4000)
+        h = np.asarray([1.0, 0.5, -0.3, 0.1])
+        y = np.convolve(x, h)[: len(x)].astype(np.float32)
+        val = float(signal_distortion_ratio(jnp.asarray(y), jnp.asarray(x.astype(np.float32)), filter_length=64))
+        assert val > 30
+
+    def test_module(self):
+        m = SignalDistortionRatio(filter_length=64)
+        x = np.random.randn(2, 2000).astype(np.float32)
+        y = (x + 0.2 * np.random.randn(2, 2000)).astype(np.float32)
+        m.update(jnp.asarray(y), jnp.asarray(x))
+        assert np.isfinite(float(m.compute()))
+
+
+class TestPIT:
+    def test_pit_picks_best_permutation(self):
+        rng = np.random.RandomState(0)
+        target = rng.randn(4, 2, 500).astype(np.float32)
+        preds = target[:, ::-1, :] + 0.01 * rng.randn(4, 2, 500).astype(np.float32)  # swapped speakers
+
+        best_metric, best_perm = permutation_invariant_training(
+            jnp.asarray(preds), jnp.asarray(target), scale_invariant_signal_distortion_ratio, "max"
+        )
+        # swapped perm [1, 0] must be detected
+        np.testing.assert_array_equal(np.asarray(best_perm), np.tile([1, 0], (4, 1)))
+        assert float(best_metric.mean()) > 10
+
+        permuted = pit_permutate(jnp.asarray(preds), best_perm)
+        direct = scale_invariant_signal_distortion_ratio(permuted, jnp.asarray(target)).mean(axis=-1)
+        np.testing.assert_allclose(np.asarray(best_metric), np.asarray(direct), rtol=1e-5)
+
+    def test_pit_exhaustive_matches_hungarian(self):
+        rng = np.random.RandomState(1)
+        preds = rng.randn(3, 3, 100).astype(np.float32)
+        target = rng.randn(3, 3, 100).astype(np.float32)
+        m_ex, p_ex = permutation_invariant_training(
+            jnp.asarray(preds), jnp.asarray(target), scale_invariant_signal_distortion_ratio, "max"
+        )
+        from metrics_tpu.functional.audio.pit import _find_best_perm_by_linear_sum_assignment
+
+        spk = 3
+        t_rep = jnp.repeat(jnp.asarray(target), spk, axis=1)
+        p_rep = jnp.tile(jnp.asarray(preds), (1, spk, 1))
+        mtx = scale_invariant_signal_distortion_ratio(p_rep, t_rep).reshape(3, spk, spk)
+        m_hu, p_hu = _find_best_perm_by_linear_sum_assignment(mtx, True)
+        np.testing.assert_allclose(np.asarray(m_ex), np.asarray(m_hu), rtol=1e-5)
+
+    def test_pit_module(self):
+        m = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, "max")
+        rng = np.random.RandomState(2)
+        preds = rng.randn(2, 2, 200).astype(np.float32)
+        target = rng.randn(2, 2, 200).astype(np.float32)
+        m.update(jnp.asarray(preds), jnp.asarray(target))
+        assert np.isfinite(float(m.compute()))
+
+    def test_error_on_wrong_eval_func(self):
+        with pytest.raises(ValueError, match='eval_func can only be "max" or "min"'):
+            permutation_invariant_training(
+                jnp.zeros((2, 2, 10)), jnp.zeros((2, 2, 10)), scale_invariant_signal_distortion_ratio, "best"
+            )
+
+
+def test_pesq_stoi_gated():
+    """PESQ/STOI require optional host packages; classes raise cleanly if absent."""
+    from metrics_tpu.utilities.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+
+    if not _PESQ_AVAILABLE:
+        from metrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality
+
+        with pytest.raises(ModuleNotFoundError):
+            PerceptualEvaluationSpeechQuality(16000, "wb")
+    if not _PYSTOI_AVAILABLE:
+        from metrics_tpu.audio.stoi import ShortTimeObjectiveIntelligibility
+
+        with pytest.raises(ModuleNotFoundError):
+            ShortTimeObjectiveIntelligibility(16000)
