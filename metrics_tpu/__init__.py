@@ -110,6 +110,22 @@ from metrics_tpu.audio import (  # noqa: E402, F401
     SignalNoiseRatio,
 )
 
+from metrics_tpu.text import (  # noqa: E402, F401
+    BERTScore,
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
 __all__ = [
     "AUC",
     "AUROC",
@@ -181,5 +197,17 @@ __all__ = [
     "ScaleInvariantSignalDistortionRatio",
     "ScaleInvariantSignalNoiseRatio",
     "SignalDistortionRatio",
-    "SignalNoiseRatio",
+    "SignalNoiseRatio",    "BERTScore",
+    "BLEUScore",
+    "CharErrorRate",
+    "CHRFScore",
+    "ExtendedEditDistance",
+    "MatchErrorRate",
+    "ROUGEScore",
+    "SacreBLEUScore",
+    "SQuAD",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
 ]

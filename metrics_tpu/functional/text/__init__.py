@@ -1,0 +1,15 @@
+from metrics_tpu.functional.text.bert import bert_score  # noqa: F401
+from metrics_tpu.functional.text.bleu import bleu_score  # noqa: F401
+from metrics_tpu.functional.text.chrf import chrf_score  # noqa: F401
+from metrics_tpu.functional.text.eed import extended_edit_distance  # noqa: F401
+from metrics_tpu.functional.text.rouge import rouge_score  # noqa: F401
+from metrics_tpu.functional.text.sacre_bleu import sacre_bleu_score  # noqa: F401
+from metrics_tpu.functional.text.squad import squad  # noqa: F401
+from metrics_tpu.functional.text.ter import translation_edit_rate  # noqa: F401
+from metrics_tpu.functional.text.wer import (  # noqa: F401
+    char_error_rate,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)

@@ -1,0 +1,174 @@
+"""BERTScore functional implementation with an injectable embedder.
+
+Behavioral parity: /root/reference/torchmetrics/functional/text/bert.py
+(629 LoC). The matching math (pairwise cosine between contextual token
+embeddings, greedy max-matching → precision/recall/F1, optional IDF
+weighting) is identical; the embedding model is injectable — any callable
+``List[str] -> (embeddings (N, L, D), mask (N, L), input_ids (N, L))``.
+Use :func:`transformers_flax_embedder` to wrap a local HF Flax checkpoint
+(the reference hardcodes a torch ``AutoModel`` inference loop,
+bert.py:136-325; weights are assets the framework does not bundle).
+"""
+import math
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+EmbedderType = Callable[[List[str]], Tuple[Array, Array, Array]]
+
+
+def _compute_idf(input_ids: Array, mask: Array) -> Dict[int, float]:
+    """Corpus-level inverse document frequencies (ref bert.py:178-199)."""
+    num_docs = input_ids.shape[0]
+    df: Counter = Counter()
+    ids_np, mask_np = np.asarray(input_ids), np.asarray(mask).astype(bool)
+    for row, m in zip(ids_np, mask_np):
+        df.update(set(row[m].tolist()))
+    return {token: math.log((num_docs + 1) / (df_t + 1)) for token, df_t in df.items()}
+
+
+def _idf_weights(input_ids: Array, mask: Array, idf_dict: Dict[int, float]) -> Array:
+    ids_np, mask_np = np.asarray(input_ids), np.asarray(mask).astype(bool)
+    default = math.log((ids_np.shape[0] + 1) / 1)
+    out = np.zeros(ids_np.shape, dtype=np.float32)
+    for i in range(ids_np.shape[0]):
+        for j in range(ids_np.shape[1]):
+            if mask_np[i, j]:
+                out[i, j] = idf_dict.get(int(ids_np[i, j]), default)
+    return jnp.asarray(out)
+
+
+def _greedy_cosine_match(
+    pred_emb: Array,
+    pred_mask: Array,
+    tgt_emb: Array,
+    tgt_mask: Array,
+    pred_weights: Optional[Array] = None,
+    tgt_weights: Optional[Array] = None,
+) -> Tuple[Array, Array, Array]:
+    """Batched greedy max cosine matching → (P, R, F1) (ref bert.py:327-361)."""
+    pred_emb = pred_emb / jnp.clip(jnp.linalg.norm(pred_emb, axis=-1, keepdims=True), min=1e-12)
+    tgt_emb = tgt_emb / jnp.clip(jnp.linalg.norm(tgt_emb, axis=-1, keepdims=True), min=1e-12)
+
+    sim = jnp.einsum("nld,nmd->nlm", pred_emb, tgt_emb)  # (N, Lp, Lt)
+    big_neg = -1e9
+    sim = jnp.where(pred_mask[:, :, None] > 0, sim, big_neg)
+    sim = jnp.where(tgt_mask[:, None, :] > 0, sim, big_neg)
+
+    best_for_pred = sim.max(axis=2)  # (N, Lp)
+    best_for_tgt = sim.max(axis=1)  # (N, Lt)
+
+    if pred_weights is None:
+        pred_weights = pred_mask.astype(jnp.float32)
+    else:
+        pred_weights = pred_weights * pred_mask
+    if tgt_weights is None:
+        tgt_weights = tgt_mask.astype(jnp.float32)
+    else:
+        tgt_weights = tgt_weights * tgt_mask
+
+    precision = (best_for_pred * pred_weights).sum(axis=1) / jnp.clip(pred_weights.sum(axis=1), min=1e-12)
+    recall = (best_for_tgt * tgt_weights).sum(axis=1) / jnp.clip(tgt_weights.sum(axis=1), min=1e-12)
+    f1 = 2 * precision * recall / jnp.clip(precision + recall, min=1e-12)
+    return precision, recall, f1
+
+
+def transformers_flax_embedder(
+    model_name_or_path: str,
+    max_length: int = 512,
+) -> EmbedderType:
+    """Build an embedder from a local HF Flax checkpoint (requires weights on disk)."""
+    from transformers import AutoTokenizer, FlaxAutoModel
+
+    tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+    model = FlaxAutoModel.from_pretrained(model_name_or_path)
+
+    def _embed(sentences: List[str]) -> Tuple[Array, Array, Array]:
+        enc = tokenizer(
+            sentences, return_tensors="np", padding=True, truncation=True, max_length=max_length
+        )
+        out = model(input_ids=jnp.asarray(enc["input_ids"]), attention_mask=jnp.asarray(enc["attention_mask"]))
+        return out.last_hidden_state, jnp.asarray(enc["attention_mask"]), jnp.asarray(enc["input_ids"])
+
+    return _embed
+
+
+def bert_score(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+    embedder: Optional[EmbedderType] = None,
+    model_name_or_path: Optional[str] = None,
+    idf: bool = False,
+    rescale_with_baseline: bool = False,
+    baseline: Optional[Dict[str, float]] = None,
+    **kwargs: Any,
+) -> Dict[str, Array]:
+    """BERTScore P/R/F1 (ref bert.py:364-629).
+
+    Example (with a toy embedder):
+        >>> import jax.numpy as jnp
+        >>> def toy_embedder(sents):
+        ...     ids = jnp.asarray([[hash(w) % 97 for w in s.split()] + [0] * (4 - len(s.split())) for s in sents])
+        ...     emb = jax.nn.one_hot(ids, 97)
+        ...     mask = (jnp.arange(4)[None, :] < jnp.asarray([[len(s.split())] for s in sents])).astype(jnp.int32)
+        ...     return emb, mask, ids
+        >>> import jax
+        >>> from metrics_tpu.functional.text.bert import bert_score
+        >>> out = bert_score(["hello there"], ["hello there"], embedder=toy_embedder)
+        >>> float(out["f1"])
+        1.0
+    """
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError("Number of predicted and reference sentences must be the same!")
+
+    if embedder is None:
+        if model_name_or_path is None:
+            raise ValueError(
+                "BERTScore requires an embedding model: pass `embedder=` (a callable) or"
+                " `model_name_or_path=` pointing at a local HF Flax checkpoint."
+            )
+        embedder = transformers_flax_embedder(model_name_or_path)
+
+    pred_emb, pred_mask, pred_ids = embedder(list(preds))
+    tgt_emb, tgt_mask, tgt_ids = embedder(list(target))
+
+    pred_weights = tgt_weights = None
+    if idf:
+        idf_dict = _compute_idf(tgt_ids, tgt_mask)
+        pred_weights = _idf_weights(pred_ids, pred_mask, idf_dict)
+        tgt_weights = _idf_weights(tgt_ids, tgt_mask, idf_dict)
+
+    # pad to a common token length so one einsum covers the batch
+    lp, lt = pred_emb.shape[1], tgt_emb.shape[1]
+    if lp != lt:
+        pad = abs(lp - lt)
+        if lp < lt:
+            pred_emb = jnp.pad(pred_emb, ((0, 0), (0, pad), (0, 0)))
+            pred_mask = jnp.pad(pred_mask, ((0, 0), (0, pad)))
+            if pred_weights is not None:
+                pred_weights = jnp.pad(pred_weights, ((0, 0), (0, pad)))
+        else:
+            tgt_emb = jnp.pad(tgt_emb, ((0, 0), (0, pad), (0, 0)))
+            tgt_mask = jnp.pad(tgt_mask, ((0, 0), (0, pad)))
+            if tgt_weights is not None:
+                tgt_weights = jnp.pad(tgt_weights, ((0, 0), (0, pad)))
+
+    precision, recall, f1 = _greedy_cosine_match(pred_emb, pred_mask, tgt_emb, tgt_mask, pred_weights, tgt_weights)
+
+    if rescale_with_baseline:
+        if baseline is None:
+            raise ValueError("`rescale_with_baseline` requires a `baseline` dict with keys precision/recall/f1")
+        precision = (precision - baseline["precision"]) / (1 - baseline["precision"])
+        recall = (recall - baseline["recall"]) / (1 - baseline["recall"])
+        f1 = (f1 - baseline["f1"]) / (1 - baseline["f1"])
+
+    return {"precision": precision, "recall": recall, "f1": f1}

@@ -1,0 +1,126 @@
+"""BLEU score functional implementation.
+
+Behavioral parity: /root/reference/torchmetrics/functional/text/bleu.py
+(191 LoC). N-gram counting is host-side (strings); the four-element
+numerator/denominator statistics are device arrays with sum reduce.
+"""
+from collections import Counter
+from typing import Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _count_ngram(ngram_input_list: Sequence[str], n_gram: int) -> Counter:
+    """Counts of all n-grams up to ``n_gram`` (ref bleu.py:26-43)."""
+    ngram_counter: Counter = Counter()
+    for i in range(1, n_gram + 1):
+        for j in range(len(ngram_input_list) - i + 1):
+            ngram_counter[tuple(ngram_input_list[j:(i + j)])] += 1
+    return ngram_counter
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    """Whitespace tokenizer (ref bleu.py:46-54)."""
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    numerator: Array,
+    denominator: Array,
+    preds_len: Array,
+    target_len: Array,
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[Array, Array, Array, Array]:
+    """Clipped n-gram statistics for a corpus (ref bleu.py:57-103).
+
+    Unlike the reference (which mutates numerator in place), the updated
+    numerator/denominator are *returned* along with the lengths.
+    """
+    target_tok: List[List[List[str]]] = [[tokenizer(line) if line else [] for line in t] for t in target]
+    preds_tok: List[List[str]] = [tokenizer(line) if line else [] for line in preds]
+
+    num_np = [0.0] * n_gram
+    den_np = [0.0] * n_gram
+    p_len, t_len = 0.0, 0.0
+
+    for pred, targets in zip(preds_tok, target_tok):
+        p_len += len(pred)
+        target_len_list = [len(tgt) for tgt in targets]
+        target_len_diff = [abs(len(pred) - x) for x in target_len_list]
+        t_len += target_len_list[target_len_diff.index(min(target_len_diff))]
+        preds_counter = _count_ngram(pred, n_gram)
+        target_counter: Counter = Counter()
+        for tgt in targets:
+            target_counter |= _count_ngram(tgt, n_gram)
+
+        ngram_counter_clip = preds_counter & target_counter
+        for counter_clip in ngram_counter_clip:
+            num_np[len(counter_clip) - 1] += ngram_counter_clip[counter_clip]
+        for counter in preds_counter:
+            den_np[len(counter) - 1] += preds_counter[counter]
+
+    numerator = numerator + jnp.asarray(num_np)
+    denominator = denominator + jnp.asarray(den_np)
+    return numerator, denominator, preds_len + p_len, target_len + t_len
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """Geometric-mean precision with brevity penalty (ref bleu.py:106-138)."""
+    if float(numerator.min()) == 0.0:
+        return jnp.asarray(0.0)
+
+    if smooth:
+        precision_scores = (numerator + 1.0) / (denominator + 1.0)
+        precision_scores = precision_scores.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision_scores = numerator / denominator
+
+    log_precision_scores = (1.0 / n_gram) * jnp.log(precision_scores)
+    geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
+    brevity_penalty = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - (target_len / preds_len)))
+    return brevity_penalty * geometric_mean
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """BLEU score of a corpus against (multi-)references (ref bleu.py:141-191).
+
+    Example:
+        >>> from metrics_tpu.functional import bleu_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> round(float(bleu_score(preds, target)), 4)
+        0.7598
+    """
+    preds_ = [preds] if isinstance(preds, str) else preds
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+
+    numerator = jnp.zeros(n_gram)
+    denominator = jnp.zeros(n_gram)
+    preds_len = jnp.asarray(0.0)
+    target_len = jnp.asarray(0.0)
+
+    numerator, denominator, preds_len, target_len = _bleu_score_update(
+        preds_, target_, numerator, denominator, preds_len, target_len, n_gram, _tokenize_fn
+    )
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, smooth)

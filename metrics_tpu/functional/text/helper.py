@@ -1,0 +1,31 @@
+"""Shared text helpers: edit distance.
+
+Behavioral parity: /root/reference/torchmetrics/functional/text/helper.py
+(_edit_distance :333-350). Host-side string processing — strings never enter
+XLA; only the integer statistics land on device.
+"""
+from typing import List, Sequence, Union
+
+import numpy as np
+
+
+def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence) -> int:
+    """Levenshtein distance between two token sequences (numpy row DP)."""
+    n, m = len(prediction_tokens), len(reference_tokens)
+    if n == 0:
+        return m
+    if m == 0:
+        return n
+    prev = np.arange(m + 1, dtype=np.int64)
+    for i in range(1, n + 1):
+        cur = np.empty(m + 1, dtype=np.int64)
+        cur[0] = i
+        p_tok = prediction_tokens[i - 1]
+        sub_cost = prev[:-1] + np.asarray([p_tok != r for r in reference_tokens], dtype=np.int64)
+        # cur[j] = min(prev[j] + 1, cur[j-1] + 1, sub_cost[j-1]) — resolve the
+        # cur[j-1] dependency with a running minimum scan
+        best = np.minimum(prev[1:] + 1, sub_cost)
+        for j in range(1, m + 1):
+            cur[j] = min(best[j - 1], cur[j - 1] + 1)
+        prev = cur
+    return int(prev[m])

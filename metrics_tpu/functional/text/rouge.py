@@ -1,0 +1,285 @@
+"""ROUGE score functional implementation.
+
+Behavioral parity: /root/reference/torchmetrics/functional/text/rouge.py
+(496 LoC) — rouge1/rouge2/rougeL/rougeLsum with the rouge_score package's
+tokenization ([a-z0-9]+ on lowercased text, optional Porter stemming) and
+precision/recall/F-measure outputs.
+"""
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.imports import _NLTK_AVAILABLE
+
+Array = jax.Array
+
+ALLOWED_ROUGE_KEYS = {
+    "rouge1": 1,
+    "rouge2": 2,
+    "rouge3": 3,
+    "rouge4": 4,
+    "rouge5": 5,
+    "rouge6": 6,
+    "rouge7": 7,
+    "rouge8": 8,
+    "rouge9": 9,
+    "rougeL": "L",
+    "rougeLsum": "Lsum",
+}
+ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
+
+
+def _add_newline_to_end_of_each_sentence(x: str) -> str:
+    """nltk sentence splitting for rougeLsum (ref rouge.py:64-72)."""
+    if not _NLTK_AVAILABLE:
+        raise ModuleNotFoundError("ROUGE-Lsum calculation requires that `nltk` is installed. Use `pip install nltk`.")
+    import nltk
+
+    try:
+        nltk.data.find("tokenizers/punkt_tab")
+    except LookupError:  # pragma: no cover
+        try:
+            nltk.download("punkt_tab", quiet=True)
+        except Exception:
+            pass
+    re.sub("<n>", "", x)
+    try:
+        return "\n".join(nltk.sent_tokenize(x))
+    except LookupError:
+        # offline fallback: naive sentence split on terminal punctuation
+        return "\n".join(s.strip() for s in re.split(r"(?<=[.!?])\s+", x) if s.strip())
+
+
+def _normalize_and_tokenize_text(text: str, stemmer: Optional[object] = None) -> List[str]:
+    """rouge_score tokenization: lowercase, [a-z0-9]+, optional stemming (>3 chars)."""
+    text = re.sub(r"[^a-z0-9]+", " ", text.lower())
+    tokens = re.split(r"\s+", text)
+    if stemmer is not None:
+        tokens = [stemmer.stem(x) if len(x) > 3 else x for x in tokens]
+    return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
+
+
+def _compute_metrics(hits: int, pred_len: int, target_len: int) -> Dict[str, float]:
+    precision = hits / pred_len if pred_len > 0 else 0.0
+    recall = hits / target_len if target_len > 0 else 0.0
+    if precision + recall > 0:
+        fmeasure = 2 * precision * recall / (precision + recall)
+    else:
+        fmeasure = 0.0
+    return {"precision": precision, "recall": recall, "fmeasure": fmeasure}
+
+
+def _rouge_n_score(pred: List[str], target: List[str], n_gram: int) -> Dict[str, float]:
+    """ROUGE-N overlap (ref rouge.py:75-101)."""
+
+    def _create_ngrams(tokens: List[str], n: int) -> Dict[Tuple, int]:
+        ngrams: Dict[Tuple, int] = {}
+        for i in range(len(tokens) - n + 1):
+            key = tuple(tokens[i:i + n])
+            ngrams[key] = ngrams.get(key, 0) + 1
+        return ngrams
+
+    pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
+    pred_len = sum(pred_ngrams.values())
+    target_len = sum(target_ngrams.values())
+    hits = sum(min(pred_ngrams.get(w, 0), target_ngrams.get(w, 0)) for w in set(pred_ngrams) & set(target_ngrams))
+    return _compute_metrics(hits, pred_len, target_len)
+
+
+def _lcs(pred_tokens: List[str], target_tokens: List[str]) -> int:
+    """Longest common subsequence length (numpy DP)."""
+    n, m = len(pred_tokens), len(target_tokens)
+    if n == 0 or m == 0:
+        return 0
+    prev = np.zeros(m + 1, dtype=np.int64)
+    for i in range(1, n + 1):
+        cur = np.zeros(m + 1, dtype=np.int64)
+        for j in range(1, m + 1):
+            if pred_tokens[i - 1] == target_tokens[j - 1]:
+                cur[j] = prev[j - 1] + 1
+            else:
+                cur[j] = max(prev[j], cur[j - 1])
+        prev = cur
+    return int(prev[m])
+
+
+def _rouge_l_score(pred: List[str], target: List[str]) -> Dict[str, float]:
+    """ROUGE-L via LCS (ref rouge.py:104-130)."""
+    if not pred or not target:
+        return _compute_metrics(0, len(pred), len(target))
+    lcs = _lcs(pred, target)
+    return _compute_metrics(lcs, len(pred), len(target))
+
+
+def _rouge_lsum_score(pred_sents: List[List[str]], target_sents: List[List[str]]) -> Dict[str, float]:
+    """Summary-level ROUGE-L: union-LCS over sentence pairs (rouge_score semantics)."""
+    pred_len = sum(len(s) for s in pred_sents)
+    target_len = sum(len(s) for s in target_sents)
+    if pred_len == 0 or target_len == 0:
+        return _compute_metrics(0, pred_len, target_len)
+
+    def _union_lcs(ref_sent: List[str], pred_sentences: List[List[str]]) -> int:
+        """Count of reference tokens covered by LCS with any pred sentence."""
+        covered = [False] * len(ref_sent)
+        for p_sent in pred_sentences:
+            # mark LCS positions of ref_sent vs p_sent
+            n, m = len(p_sent), len(ref_sent)
+            dp = np.zeros((n + 1, m + 1), dtype=np.int64)
+            for i in range(1, n + 1):
+                for j in range(1, m + 1):
+                    if p_sent[i - 1] == ref_sent[j - 1]:
+                        dp[i, j] = dp[i - 1, j - 1] + 1
+                    else:
+                        dp[i, j] = max(dp[i - 1, j], dp[i, j - 1])
+            # backtrack
+            i, j = n, m
+            while i > 0 and j > 0:
+                if p_sent[i - 1] == ref_sent[j - 1] and dp[i, j] == dp[i - 1, j - 1] + 1:
+                    covered[j - 1] = True
+                    i, j = i - 1, j - 1
+                elif dp[i - 1, j] >= dp[i, j - 1]:
+                    i -= 1
+                else:
+                    j -= 1
+        return sum(covered)
+
+    hits = sum(_union_lcs(ref_sent, pred_sents) for ref_sent in target_sents)
+    return _compute_metrics(hits, pred_len, target_len)
+
+
+def _rouge_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    rouge_keys_values: List[Union[int, str]],
+    accumulate: str,
+    stemmer: Optional[object] = None,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+) -> Dict[Union[int, str], List[Dict[str, Array]]]:
+    """Per-sample ROUGE results, best- or avg-aggregated over references
+    (ref rouge.py:133-236)."""
+    results: Dict[Union[int, str], List[Dict[str, Array]]] = {k: [] for k in rouge_keys_values}
+
+    for pred_raw, target_raw in zip(preds, target):
+        result_inner: Dict[Union[int, str], Dict[str, float]] = {k: {} for k in rouge_keys_values}
+        result_avg: Dict[Union[int, str], List[Dict[str, float]]] = {k: [] for k in rouge_keys_values}
+
+        if "Lsum" in rouge_keys_values:
+            pred_sents_raw = _add_newline_to_end_of_each_sentence(pred_raw).split("\n")
+
+        pred_tok = (
+            list(tokenizer(normalizer(pred_raw) if normalizer else pred_raw))
+            if tokenizer
+            else _normalize_and_tokenize_text(normalizer(pred_raw) if normalizer else pred_raw, stemmer)
+        )
+
+        for tgt_raw in target_raw:
+            tgt_tok = (
+                list(tokenizer(normalizer(tgt_raw) if normalizer else tgt_raw))
+                if tokenizer
+                else _normalize_and_tokenize_text(normalizer(tgt_raw) if normalizer else tgt_raw, stemmer)
+            )
+            for rouge_key in rouge_keys_values:
+                if isinstance(rouge_key, int):
+                    score = _rouge_n_score(pred_tok, tgt_tok, rouge_key)
+                elif rouge_key == "L":
+                    score = _rouge_l_score(pred_tok, tgt_tok)
+                else:  # Lsum
+                    tgt_sents_raw = _add_newline_to_end_of_each_sentence(tgt_raw).split("\n")
+                    pred_sents = [_normalize_and_tokenize_text(s, stemmer) for s in pred_sents_raw]
+                    tgt_sents = [_normalize_and_tokenize_text(s, stemmer) for s in tgt_sents_raw]
+                    score = _rouge_lsum_score(pred_sents, tgt_sents)
+                result_avg[rouge_key].append(score)
+                if not result_inner[rouge_key] or score["fmeasure"] > result_inner[rouge_key]["fmeasure"]:
+                    result_inner[rouge_key] = score
+
+        for rouge_key in rouge_keys_values:
+            if accumulate == "best":
+                results[rouge_key].append(
+                    {tp: jnp.asarray(result_inner[rouge_key][tp]) for tp in ("fmeasure", "precision", "recall")}
+                )
+            else:  # avg
+                results[rouge_key].append(
+                    {
+                        tp: jnp.asarray(np.mean([r[tp] for r in result_avg[rouge_key]]))
+                        for tp in ("fmeasure", "precision", "recall")
+                    }
+                )
+    return results
+
+
+def _rouge_score_compute(sentence_results: Dict[str, List[Array]]) -> Dict[str, Array]:
+    """Average per-sample results (ref rouge.py:239-256)."""
+    results: Dict[str, Array] = {}
+    for rouge_key, scores in sentence_results.items():
+        if isinstance(scores, list) and scores:
+            results[rouge_key] = jnp.stack(scores).mean()
+        elif isinstance(scores, list):
+            results[rouge_key] = jnp.asarray(0.0)
+        else:
+            results[rouge_key] = scores
+    return results
+
+
+def rouge_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    accumulate: str = "best",
+    use_stemmer: bool = False,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+    rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+) -> Dict[str, Array]:
+    """ROUGE scores (ref rouge.py:259-379).
+
+    Example:
+        >>> from metrics_tpu.functional import rouge_score
+        >>> preds = "My name is John"
+        >>> target = "Is your name John"
+        >>> result = rouge_score(preds, target, rouge_keys="rouge1")
+        >>> round(float(result["rouge1_fmeasure"]), 4)
+        0.75
+    """
+    if use_stemmer and not _NLTK_AVAILABLE:
+        raise ModuleNotFoundError("Stemmer requires that `nltk` is installed. Use `pip install nltk`.")
+    stemmer = None
+    if use_stemmer:
+        import nltk
+
+        stemmer = nltk.stem.porter.PorterStemmer()
+
+    if not isinstance(rouge_keys, tuple):
+        rouge_keys = (rouge_keys,)
+    for key in rouge_keys:
+        if key not in ALLOWED_ROUGE_KEYS:
+            raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS.keys())}")
+    rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+
+    if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+        target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [[target]]
+
+    if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+        raise ValueError(
+            f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+        )
+
+    sentence_results = _rouge_score_update(
+        preds, target, rouge_keys_values, accumulate, stemmer, normalizer, tokenizer
+    )
+
+    output: Dict[str, List[Array]] = {
+        f"rouge{rouge_key}_{tp}": [] for rouge_key in rouge_keys_values for tp in ("fmeasure", "precision", "recall")
+    }
+    for rouge_key, metrics in sentence_results.items():
+        for metric in metrics:
+            for tp, value in metric.items():
+                output[f"rouge{rouge_key}_{tp}"].append(value)
+
+    return _rouge_score_compute(output)

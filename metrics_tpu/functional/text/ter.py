@@ -1,0 +1,238 @@
+"""Translation Edit Rate functional implementation.
+
+Behavioral parity: /root/reference/torchmetrics/functional/text/ter.py
+(587 LoC) — the tercom algorithm: tokenize/normalize, then greedy phrase
+shifts + Levenshtein edits; TER = edits / reference length, best reference
+per sentence, micro-averaged over the corpus.
+"""
+import re
+import string
+from functools import lru_cache
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+_MAX_SHIFT_SIZE = 10
+_MAX_SHIFT_DIST = 50
+
+
+class _TercomTokenizer:
+    """Tercom-style normalization (ref ter.py:40-169)."""
+
+    _ASIAN_PUNCTUATION = r"([、。〈-】〔-〟｡-･・])"
+    _FULL_WIDTH_PUNCTUATION = r"([．，？：；！＂（）])"
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+    ) -> None:
+        self.normalize = normalize
+        self.no_punctuation = no_punctuation
+        self.lowercase = lowercase
+        self.asian_support = asian_support
+
+    def __call__(self, sentence: str) -> str:
+        if not sentence:
+            return ""
+        if self.lowercase:
+            sentence = sentence.lower()
+        if self.normalize:
+            sentence = self._normalize_general_and_western(sentence)
+            if self.asian_support:
+                sentence = self._normalize_asian(sentence)
+        if self.no_punctuation:
+            sentence = self._remove_punct(sentence)
+            if self.asian_support:
+                sentence = self._remove_asian_punct(sentence)
+        return " ".join(sentence.split())
+
+    @staticmethod
+    def _normalize_general_and_western(sentence: str) -> str:
+        sentence = f" {sentence} "
+        rules = [
+            (r"\n-", ""),
+            (r"\n", " "),
+            (r"&quot;", '"'),
+            (r"&amp;", "&"),
+            (r"&lt;", "<"),
+            (r"&gt;", ">"),
+            (r"([{-~[-` -&(-+:-@/])", r" \1 "),
+            (r"'s ", r" 's "),
+            (r"'s$", r" 's"),
+            (r"'ll ", r" 'll "),
+            (r"'ll$", r" 'll"),
+            (r"'re ", r" 're "),
+            (r"'re$", r" 're"),
+            (r"'ve ", r" 've "),
+            (r"'ve$", r" 've"),
+            (r"'d ", r" 'd "),
+            (r"'d$", r" 'd"),
+        ]
+        for pattern, replacement in rules:
+            sentence = re.sub(pattern, replacement, sentence)
+        return sentence
+
+    @classmethod
+    def _normalize_asian(cls, sentence: str) -> str:
+        sentence = re.sub(r"([一-鿿㐀-䶿])", r" \1 ", sentence)
+        sentence = re.sub(r"([㇀-㇯⺀-⻿])", r" \1 ", sentence)
+        sentence = re.sub(r"([㌀-㏿豈-﫿︰-﹏])", r" \1 ", sentence)
+        sentence = re.sub(cls._ASIAN_PUNCTUATION, r" \1 ", sentence)
+        return re.sub(cls._FULL_WIDTH_PUNCTUATION, r" \1 ", sentence)
+
+    @staticmethod
+    def _remove_punct(sentence: str) -> str:
+        return re.sub(f"[{re.escape(string.punctuation)}]", "", sentence)
+
+    @classmethod
+    def _remove_asian_punct(cls, sentence: str) -> str:
+        sentence = re.sub(cls._ASIAN_PUNCTUATION, "", sentence)
+        return re.sub(cls._FULL_WIDTH_PUNCTUATION, "", sentence)
+
+
+def _find_shifted_candidates(hyp: List[str], ref: List[str]) -> List[Tuple[int, int, int]]:
+    """Allowed shifts: (start, length, new_position) of hyp spans that occur in ref."""
+    ref_ngrams: Dict[Tuple[str, ...], List[int]] = {}
+    for length in range(1, _MAX_SHIFT_SIZE + 1):
+        for start in range(len(ref) - length + 1):
+            ref_ngrams.setdefault(tuple(ref[start:start + length]), []).append(start)
+
+    candidates = []
+    for length in range(1, min(_MAX_SHIFT_SIZE, len(hyp)) + 1):
+        for start in range(len(hyp) - length + 1):
+            span = tuple(hyp[start:start + length])
+            if span not in ref_ngrams:
+                continue
+            for new_pos in ref_ngrams[span]:
+                if abs(start - new_pos) > _MAX_SHIFT_DIST:
+                    continue
+                candidates.append((start, length, new_pos))
+    return candidates
+
+
+def _apply_shift(hyp: List[str], start: int, length: int, new_pos: int) -> List[str]:
+    span = hyp[start:start + length]
+    rest = hyp[:start] + hyp[start + length:]
+    pos = min(new_pos, len(rest))
+    return rest[:pos] + span + rest[pos:]
+
+
+def _ter_edits(hyp_words: List[str], ref_words: List[str]) -> float:
+    """Minimum tercom edits: greedy best-shift loop + final edit distance."""
+    hyp = list(hyp_words)
+    num_shifts = 0
+    current_dist = _edit_distance(hyp, ref_words)
+
+    # tercom greedy loop: apply the shift with the largest edit-distance
+    # reduction while any strictly positive reduction exists (each shift
+    # itself costs one edit); distance decreases every iteration, so this
+    # terminates
+    while current_dist > 0:
+        best_gain, best_shift = 0, None
+        for start, length, new_pos in _find_shifted_candidates(hyp, ref_words):
+            shifted = _apply_shift(hyp, start, length, new_pos)
+            if shifted == hyp:
+                continue
+            gain = current_dist - _edit_distance(shifted, ref_words)
+            if gain > best_gain:
+                best_gain, best_shift = gain, (start, length, new_pos)
+        if best_shift is None or best_gain <= 0:
+            break
+        hyp = _apply_shift(hyp, *best_shift)
+        num_shifts += 1
+        current_dist -= best_gain
+
+    return float(num_shifts + current_dist)
+
+
+def _ter_update(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    tokenizer: _TercomTokenizer,
+    total_num_edits: Array,
+    total_tgt_length: Array,
+    sentence_ter: Optional[List[Array]] = None,
+) -> Tuple[Array, Array, Optional[List[Array]]]:
+    """Accumulate best-reference edits + lengths (ref ter.py:414-470)."""
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+
+    num_edits_total, tgt_len_total = 0.0, 0.0
+    for pred, tgts in zip(preds_, target_):
+        pred_words = tokenizer(pred).split()
+        best_num_edits, best_tgt_len = float("inf"), 0.0
+        tgt_lengths = 0.0
+        for tgt in tgts:
+            tgt_words = tokenizer(tgt).split()
+            tgt_lengths += len(tgt_words)
+            num_edits = _ter_edits(pred_words, tgt_words)
+            if num_edits < best_num_edits:
+                best_num_edits = num_edits
+        avg_tgt_len = tgt_lengths / len(tgts)
+
+        num_edits_total += best_num_edits
+        tgt_len_total += avg_tgt_len
+        if sentence_ter is not None:
+            if avg_tgt_len > 0:
+                sentence_ter.append(jnp.asarray(best_num_edits / avg_tgt_len))
+            elif best_num_edits > 0:
+                sentence_ter.append(jnp.asarray(1.0))
+            else:
+                sentence_ter.append(jnp.asarray(0.0))
+
+    return total_num_edits + num_edits_total, total_tgt_length + tgt_len_total, sentence_ter
+
+
+def _ter_compute(total_num_edits: Array, total_tgt_length: Array) -> Array:
+    return total_num_edits / total_tgt_length
+
+
+def translation_edit_rate(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    normalize: bool = False,
+    no_punctuation: bool = False,
+    lowercase: bool = True,
+    asian_support: bool = False,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, List[Array]]]:
+    """TER (ref ter.py:497-587).
+
+    Example:
+        >>> from metrics_tpu.functional import translation_edit_rate
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> round(float(translation_edit_rate(preds, target)), 4)
+        0.1538
+    """
+    if not isinstance(normalize, bool):
+        raise ValueError(f"Expected argument `normalize` to be of type boolean but got {normalize}.")
+    if not isinstance(no_punctuation, bool):
+        raise ValueError(f"Expected argument `no_punctuation` to be of type boolean but got {no_punctuation}.")
+    if not isinstance(lowercase, bool):
+        raise ValueError(f"Expected argument `lowercase` to be of type boolean but got {lowercase}.")
+    if not isinstance(asian_support, bool):
+        raise ValueError(f"Expected argument `asian_support` to be of type boolean but got {asian_support}.")
+
+    tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+    total_num_edits = jnp.asarray(0.0)
+    total_tgt_length = jnp.asarray(0.0)
+    sentence_ter: Optional[List[Array]] = [] if return_sentence_level_score else None
+
+    total_num_edits, total_tgt_length, sentence_ter = _ter_update(
+        preds, target, tokenizer, total_num_edits, total_tgt_length, sentence_ter
+    )
+    total_ter = _ter_compute(total_num_edits, total_tgt_length)
+    if sentence_ter is not None:
+        return total_ter, sentence_ter
+    return total_ter

@@ -1,0 +1,169 @@
+"""Word/char/match error rates and word-information metrics.
+
+Behavioral parity:
+- /root/reference/torchmetrics/functional/text/wer.py (83 LoC)
+- cer.py (83), mer.py (90), wil.py (93), wip.py (92)
+All host-side tokenization + edit distance feeding scalar device states.
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+
+def _as_list(x: Union[str, List[str]]) -> List[str]:
+    return [x] if isinstance(x, str) else list(x)
+
+
+def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Edit operations + reference word count (ref wer.py:23-48)."""
+    preds, target = _as_list(preds), _as_list(target)
+    errors, total = 0, 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += len(tgt_tokens)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """WER (ref wer.py:64-83).
+
+    Example:
+        >>> from metrics_tpu.functional import word_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> float(word_error_rate(preds, target))
+        0.5
+    """
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)
+
+
+def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Char-level edit operations + reference char count (ref cer.py:23-48)."""
+    preds, target = _as_list(preds), _as_list(target)
+    errors, total = 0, 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred
+        tgt_tokens = tgt
+        errors += _edit_distance(list(pred_tokens), list(tgt_tokens))
+        total += len(tgt_tokens)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """CER (ref cer.py:64-83).
+
+    Example:
+        >>> from metrics_tpu.functional import char_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(char_error_rate(preds, target)), 4)
+        0.3415
+    """
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)
+
+
+def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Edit operations + max(len) count (ref mer.py:23-49)."""
+    preds, target = _as_list(preds), _as_list(target)
+    errors, total = 0, 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """MER (ref mer.py:65-90).
+
+    Example:
+        >>> from metrics_tpu.functional import match_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(match_error_rate(preds, target)), 4)
+        0.4444
+    """
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)
+
+
+def _wil_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array, Array]:
+    """Returns (errors - total, target_total, preds_total) — the reference's
+    state convention where ``total - errors`` is the hit count (ref wil.py:22-53)."""
+    preds, target = _as_list(preds), _as_list(target)
+    errors, total, target_total, preds_total = 0, 0, 0, 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        target_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, target_tokens)
+        target_total += len(target_tokens)
+        preds_total += len(pred_tokens)
+        total += max(len(target_tokens), len(pred_tokens))
+    return jnp.asarray(float(errors - total)), jnp.asarray(float(target_total)), jnp.asarray(float(preds_total))
+
+
+def _wil_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return 1 - ((errors / target_total) * (errors / preds_total))
+
+
+def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """WIL (ref wil.py:70-93).
+
+    Example:
+        >>> from metrics_tpu.functional import word_information_lost
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(word_information_lost(preds, target)), 4)
+        0.6528
+    """
+    errors, target_total, preds_total = _wil_update(preds, target)
+    return _wil_compute(errors, target_total, preds_total)
+
+
+def _wip_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array, Array]:
+    """Parity: ref wip.py:22-53."""
+    return _wil_update(preds, target)
+
+
+def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """WIP (ref wip.py:69-92).
+
+    Example:
+        >>> from metrics_tpu.functional import word_information_preserved
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(word_information_preserved(preds, target)), 4)
+        0.3472
+    """
+    errors, target_total, preds_total = _wip_update(preds, target)
+    return _wip_compute(errors, target_total, preds_total)

@@ -1,0 +1,70 @@
+"""BERTScore module (ref /root/reference/torchmetrics/text/bert.py, 235 LoC).
+
+Accumulates raw sentences on host (the reference stores tokenized
+input_ids/attention_mask list states); embedding + matching run at compute.
+The embedder is injectable — see
+:func:`metrics_tpu.functional.text.bert.transformers_flax_embedder`.
+"""
+from typing import Any, Dict, List, Optional, Union
+
+import jax
+
+from metrics_tpu.functional.text.bert import EmbedderType, bert_score
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BERTScore(Metric):
+    """BERTScore P/R/F1 over accumulated sentence pairs.
+
+    Note: sentences accumulate as host-side strings (plain Python lists, not
+    device states); cross-process sync of raw strings is not supported —
+    compute per process or pre-gather the text.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        embedder: Optional[EmbedderType] = None,
+        model_name_or_path: Optional[str] = None,
+        idf: bool = False,
+        rescale_with_baseline: bool = False,
+        baseline: Optional[Dict[str, float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.embedder = embedder
+        self.model_name_or_path = model_name_or_path
+        self.idf = idf
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline = baseline
+        self._preds: List[str] = []
+        self._target: List[str] = []
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        preds = [preds] if isinstance(preds, str) else list(preds)
+        target = [target] if isinstance(target, str) else list(target)
+        if len(preds) != len(target):
+            raise ValueError("Number of predicted and reference sentences must be the same!")
+        self._preds.extend(preds)
+        self._target.extend(target)
+
+    def compute(self) -> Dict[str, Array]:
+        return bert_score(
+            self._preds,
+            self._target,
+            embedder=self.embedder,
+            model_name_or_path=self.model_name_or_path,
+            idf=self.idf,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline=self.baseline,
+        )
+
+    def reset(self) -> None:
+        super().reset()
+        self._preds = []
+        self._target = []

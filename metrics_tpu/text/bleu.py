@@ -1,0 +1,88 @@
+"""BLEUScore and SacreBLEUScore modules.
+
+Behavioral parity: /root/reference/torchmetrics/text/bleu.py (107 LoC) and
+sacre_bleu.py module (113 LoC).
+"""
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
+from metrics_tpu.functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BLEUScore(Metric):
+    """Corpus BLEU with n-gram count states (sum reduce).
+
+    Example:
+        >>> from metrics_tpu import BLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> bleu = BLEUScore()
+        >>> round(float(bleu(preds, target)), 4)
+        0.7598
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, n_gram: int = 4, smooth: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        self.tokenizer = _tokenize_fn
+
+        self.add_state("preds_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else preds
+        target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+        self.numerator, self.denominator, self.preds_len, self.target_len = _bleu_score_update(
+            preds_,
+            target_,
+            self.numerator,
+            self.denominator,
+            self.preds_len,
+            self.target_len,
+            self.n_gram,
+            self.tokenizer,
+        )
+
+    def compute(self) -> Array:
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator, self.n_gram, self.smooth
+        )
+
+
+class SacreBLEUScore(BLEUScore):
+    """BLEU with WMT tokenizers (ref text/sacre_bleu.py:24-113).
+
+    Example:
+        >>> from metrics_tpu import SacreBLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> sacre_bleu = SacreBLEUScore()
+        >>> round(float(sacre_bleu(preds, target)), 4)
+        0.7598
+    """
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, **kwargs)
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize}.")
+        self.tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)

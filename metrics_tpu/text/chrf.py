@@ -1,0 +1,98 @@
+"""CHRFScore module (ref /root/reference/torchmetrics/text/chrf.py, 209 LoC)."""
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.chrf import _char_and_word_ngrams, _chrf_f_score, _order_f_scores
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CHRFScore(Metric):
+    """chrF/chrF++ with per-order statistic states (sum reduce).
+
+    Example:
+        >>> from metrics_tpu import CHRFScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat']]
+        >>> chrf = CHRFScore()
+        >>> round(float(chrf(preds, target)), 4)
+        0.8159
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+
+        n_orders = n_char_order + n_word_order
+        self.add_state("matching", jnp.zeros(n_orders), dist_reduce_fx="sum")
+        self.add_state("pred_total", jnp.zeros(n_orders), dist_reduce_fx="sum")
+        self.add_state("tgt_total", jnp.zeros(n_orders), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf_score", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+
+        for pred, tgts in zip(preds_, target_):
+            p_char, p_word = _char_and_word_ngrams(
+                pred, self.n_char_order, self.n_word_order, self.lowercase, self.whitespace
+            )
+            best = None
+            for tgt in tgts:
+                t_char, t_word = _char_and_word_ngrams(
+                    tgt, self.n_char_order, self.n_word_order, self.lowercase, self.whitespace
+                )
+                m_c, p_c, t_c = _order_f_scores(p_char, t_char)
+                m_w, p_w, t_w = _order_f_scores(p_word, t_word)
+                matching, pred_total, tgt_total = m_c + m_w, p_c + p_w, t_c + t_w
+                f = _chrf_f_score(matching, pred_total, tgt_total, self.beta)
+                if best is None or f > best[0]:
+                    best = (f, matching, pred_total, tgt_total)
+            f, matching, pred_total, tgt_total = best
+            self.matching = self.matching + jnp.asarray(matching)
+            self.pred_total = self.pred_total + jnp.asarray(pred_total)
+            self.tgt_total = self.tgt_total + jnp.asarray(tgt_total)
+            if self.return_sentence_level_score:
+                self.sentence_chrf_score.append(jnp.asarray(f).reshape(1))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = jnp.asarray(
+            _chrf_f_score(
+                [float(x) for x in self.matching],
+                [float(x) for x in self.pred_total],
+                [float(x) for x in self.tgt_total],
+                self.beta,
+            )
+        )
+        if self.return_sentence_level_score:
+            return score, dim_zero_cat(self.sentence_chrf_score)
+        return score

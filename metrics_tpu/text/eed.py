@@ -1,0 +1,65 @@
+"""ExtendedEditDistance module (ref /root/reference/torchmetrics/text/eed.py, 126 LoC)."""
+from typing import Any, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.eed import _eed_compute, _eed_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class ExtendedEditDistance(Metric):
+    """EED over an accumulated corpus (lower is better).
+
+    Example:
+        >>> from metrics_tpu import ExtendedEditDistance
+        >>> preds = ["this is the prediction", "here is an other sample"]
+        >>> target = ["this is the reference", "here is another one"]
+        >>> eed = ExtendedEditDistance()
+        >>> round(float(eed(preds, target)), 4)
+        0.3078
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        for param, name in [(alpha, "alpha"), (rho, "rho"), (deletion, "deletion"), (insertion, "insertion")]:
+            if not isinstance(param, float) or param < 0:
+                raise ValueError(f"Parameter `{name}` is expected to be a non-negative float.")
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+
+        self.add_state("sentence_eed", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        scores = _eed_update(
+            preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion
+        )
+        self.sentence_eed.extend(s.reshape(1) for s in scores)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        average = _eed_compute(self.sentence_eed)
+        if self.return_sentence_level_score:
+            return average, dim_zero_cat(self.sentence_eed)
+        return average

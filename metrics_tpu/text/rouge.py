@@ -1,0 +1,111 @@
+"""ROUGEScore module (ref /root/reference/torchmetrics/text/rouge.py, 189 LoC)."""
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.rouge import (
+    ALLOWED_ACCUMULATE_VALUES,
+    ALLOWED_ROUGE_KEYS,
+    _rouge_score_compute,
+    _rouge_score_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.imports import _NLTK_AVAILABLE
+
+Array = jax.Array
+
+
+class ROUGEScore(Metric):
+    """ROUGE-1/2/L/Lsum over accumulated samples; one list state per output key.
+
+    Example:
+        >>> from metrics_tpu import ROUGEScore
+        >>> preds = "My name is John"
+        >>> target = "Is your name John"
+        >>> rouge = ROUGEScore(rouge_keys="rouge1")
+        >>> round(float(rouge(preds, target)["rouge1_fmeasure"]), 4)
+        0.75
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        normalizer: Optional[Callable[[str], str]] = None,
+        tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if use_stemmer or "rougeLsum" in rouge_keys:
+            if not _NLTK_AVAILABLE:
+                raise ModuleNotFoundError(
+                    "Stemmer and/or `rougeLsum` requires that `nltk` is installed. Use `pip install nltk`."
+                )
+        if not isinstance(rouge_keys, tuple):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS.keys())}")
+        if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+            raise ValueError(
+                f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+            )
+
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+        if use_stemmer:
+            import nltk
+
+            self.stemmer = nltk.stem.porter.PorterStemmer()
+        else:
+            self.stemmer = None
+        self.normalizer = normalizer
+        self.tokenizer = tokenizer
+        self.accumulate = accumulate
+
+        for rouge_key in self.rouge_keys:
+            for score in ("fmeasure", "precision", "recall"):
+                self.add_state(f"{rouge_key}_{score}", [], dist_reduce_fx="cat")
+
+    def update(
+        self,
+        preds: Union[str, Sequence[str]],
+        target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    ) -> None:
+        if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+            target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+
+        output = _rouge_score_update(
+            preds,
+            target,
+            self.rouge_keys_values,
+            accumulate=self.accumulate,
+            stemmer=self.stemmer,
+            normalizer=self.normalizer,
+            tokenizer=self.tokenizer,
+        )
+        for rouge_key, metrics in output.items():
+            for metric in metrics:
+                for tp, value in metric.items():
+                    getattr(self, f"rouge{rouge_key}_{tp}").append(value.reshape(1))
+
+    def compute(self) -> Dict[str, Array]:
+        update_output = {
+            f"rouge{rouge_key}_{tp}": getattr(self, f"rouge{rouge_key}_{tp}")
+            for rouge_key in self.rouge_keys_values
+            for tp in ("fmeasure", "precision", "recall")
+        }
+        return _rouge_score_compute(update_output)
+
+    def __hash__(self) -> int:
+        return super().__hash__()

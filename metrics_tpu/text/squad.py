@@ -1,0 +1,49 @@
+"""SQuAD module (ref /root/reference/torchmetrics/text/squad.py, 124 LoC)."""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.squad import (
+    PREDS_TYPE,
+    TARGETS_TYPE,
+    _squad_compute,
+    _squad_input_check,
+    _squad_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class SQuAD(Metric):
+    """SQuAD EM/F1 over accumulated QA pairs.
+
+    Example:
+        >>> from metrics_tpu import SQuAD
+        >>> preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+        >>> target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+        >>> squad = SQuAD()
+        >>> {k: float(v) for k, v in squad(preds, target).items()}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("f1_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("exact_match", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: PREDS_TYPE, target: TARGETS_TYPE) -> None:
+        preds_dict, target_list = _squad_input_check(preds, target)
+        f1, exact_match, total = _squad_update(preds_dict, target_list)
+        self.f1_score = self.f1_score + f1
+        self.exact_match = self.exact_match + exact_match
+        self.total = self.total + total
+
+    def compute(self) -> Dict[str, Array]:
+        return _squad_compute(self.f1_score, self.exact_match, self.total)

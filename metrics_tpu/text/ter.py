@@ -1,0 +1,72 @@
+"""TranslationEditRate module (ref /root/reference/torchmetrics/text/ter.py, 119 LoC)."""
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class TranslationEditRate(Metric):
+    """TER over an accumulated corpus.
+
+    Example:
+        >>> from metrics_tpu import TranslationEditRate
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> ter = TranslationEditRate()
+        >>> round(float(ter(preds, target)), 4)
+        0.1538
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Expected argument `normalize` to be of type boolean but got {normalize}.")
+        if not isinstance(no_punctuation, bool):
+            raise ValueError(f"Expected argument `no_punctuation` to be of type boolean but got {no_punctuation}.")
+        if not isinstance(lowercase, bool):
+            raise ValueError(f"Expected argument `lowercase` to be of type boolean but got {lowercase}.")
+        if not isinstance(asian_support, bool):
+            raise ValueError(f"Expected argument `asian_support` to be of type boolean but got {asian_support}.")
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("total_num_edits", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_tgt_length", jnp.asarray(0.0), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_ter", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        self.total_num_edits, self.total_tgt_length, sentence = _ter_update(
+            preds,
+            target,
+            self.tokenizer,
+            self.total_num_edits,
+            self.total_tgt_length,
+            [] if self.return_sentence_level_score else None,
+        )
+        if self.return_sentence_level_score and sentence:
+            self.sentence_ter.extend(s.reshape(1) for s in sentence)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        ter = _ter_compute(self.total_num_edits, self.total_tgt_length)
+        if self.return_sentence_level_score:
+            return ter, dim_zero_cat(self.sentence_ter)
+        return ter

@@ -1,0 +1,267 @@
+"""Text metric tests vs sacrebleu / rouge_score / nltk oracles (translation of ref tests/text/)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+from metrics_tpu.functional import (
+    bleu_score,
+    char_error_rate,
+    chrf_score,
+    extended_edit_distance,
+    rouge_score,
+    sacre_bleu_score,
+    squad,
+    translation_edit_rate,
+    word_error_rate,
+)
+
+PREDS = ["this is the prediction", "there is an other sample"]
+TARGETS = ["this is the reference", "there is another one"]
+
+BLEU_PREDS = ["the cat is on the mat", "the fast brown fox jumped"]
+BLEU_TARGETS = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["the quick brown fox jumped over", "a quick brown fox leaped"],
+]
+
+
+class TestErrorRates:
+    def test_wer(self):
+        assert float(word_error_rate(PREDS, TARGETS)) == 0.5
+        m = WordErrorRate()
+        m.update(PREDS[:1], TARGETS[:1])
+        m.update(PREDS[1:], TARGETS[1:])
+        assert float(m.compute()) == 0.5
+
+    def test_cer(self):
+        np.testing.assert_allclose(float(char_error_rate(PREDS, TARGETS)), 0.3415, atol=1e-4)
+        m = CharErrorRate()
+        m.update(PREDS, TARGETS)
+        np.testing.assert_allclose(float(m.compute()), 0.3415, atol=1e-4)
+
+    def test_mer(self):
+        m = MatchErrorRate()
+        m.update(PREDS, TARGETS)
+        np.testing.assert_allclose(float(m.compute()), 0.4444, atol=1e-4)
+
+    def test_wil_wip(self):
+        wil = WordInfoLost()
+        wip = WordInfoPreserved()
+        wil.update(PREDS, TARGETS)
+        wip.update(PREDS, TARGETS)
+        np.testing.assert_allclose(float(wil.compute()) + float(wip.compute()), 1.0, atol=1e-6)
+
+
+class TestBLEU:
+    def test_vs_nltk_corpus_bleu(self):
+        from nltk.translate.bleu_score import corpus_bleu
+
+        refs = [[t.split() for t in tgt] for tgt in BLEU_TARGETS]
+        hyps = [p.split() for p in BLEU_PREDS]
+        expected = corpus_bleu(refs, hyps)
+        ours = float(bleu_score(BLEU_PREDS, BLEU_TARGETS))
+        np.testing.assert_allclose(ours, expected, atol=1e-5)
+
+    def test_module_accumulates(self):
+        m = BLEUScore()
+        m.update(BLEU_PREDS[:1], BLEU_TARGETS[:1])
+        m.update(BLEU_PREDS[1:], BLEU_TARGETS[1:])
+        np.testing.assert_allclose(float(m.compute()), float(bleu_score(BLEU_PREDS, BLEU_TARGETS)), atol=1e-6)
+
+    def test_smooth(self):
+        # smoothing lifts the higher-order precisions; matched 1-grams keep score > 0
+        val = bleu_score(["the cat is on mat"], [["the cat is on the mat"]], smooth=True)
+        no_smooth = bleu_score(["the cat is on mat"], [["the cat is on the mat"]], smooth=False)
+        assert 0 < float(val) < 1
+        assert float(val) >= float(no_smooth)
+
+
+class TestSacreBLEU:
+    @pytest.mark.parametrize("tokenize", ["13a", "char", "intl", "none"])
+    @pytest.mark.parametrize("lowercase", [False, True])
+    def test_vs_sacrebleu(self, tokenize, lowercase):
+        from sacrebleu.metrics import BLEU
+
+        sb = BLEU(tokenize=tokenize, lowercase=lowercase)
+        # sacrebleu expects refs transposed: list over references of list over sentences
+        refs_t = list(map(list, zip(*BLEU_TARGETS)))
+        expected = sb.corpus_score(BLEU_PREDS, refs_t).score / 100
+        ours = float(sacre_bleu_score(BLEU_PREDS, BLEU_TARGETS, tokenize=tokenize, lowercase=lowercase))
+        np.testing.assert_allclose(ours, expected, atol=1e-4)
+
+    def test_module(self):
+        m = SacreBLEUScore()
+        m.update(BLEU_PREDS, BLEU_TARGETS)
+        np.testing.assert_allclose(float(m.compute()), float(sacre_bleu_score(BLEU_PREDS, BLEU_TARGETS)), atol=1e-6)
+
+
+class TestCHRF:
+    @pytest.mark.parametrize("n_word_order", [0, 2])
+    def test_vs_sacrebleu(self, n_word_order):
+        from sacrebleu.metrics import CHRF
+
+        sb = CHRF(word_order=n_word_order)
+        refs_t = list(map(list, zip(*BLEU_TARGETS)))
+        expected = sb.corpus_score(BLEU_PREDS, refs_t).score / 100
+        ours = float(chrf_score(BLEU_PREDS, BLEU_TARGETS, n_word_order=n_word_order))
+        np.testing.assert_allclose(ours, expected, atol=1e-3)
+
+    def test_module(self):
+        m = CHRFScore()
+        m.update(BLEU_PREDS[:1], BLEU_TARGETS[:1])
+        m.update(BLEU_PREDS[1:], BLEU_TARGETS[1:])
+        assert 0 < float(m.compute()) < 1
+
+
+class TestTER:
+    def test_vs_sacrebleu(self):
+        from sacrebleu.metrics import TER as SBTER
+
+        sb = SBTER()
+        refs_t = list(map(list, zip(*BLEU_TARGETS)))
+        expected = sb.corpus_score(BLEU_PREDS, refs_t).score / 100
+        ours = float(translation_edit_rate(BLEU_PREDS, BLEU_TARGETS))
+        np.testing.assert_allclose(ours, expected, atol=1e-3)
+
+    def test_module(self):
+        m = TranslationEditRate()
+        m.update(BLEU_PREDS, BLEU_TARGETS)
+        np.testing.assert_allclose(
+            float(m.compute()), float(translation_edit_rate(BLEU_PREDS, BLEU_TARGETS)), atol=1e-6
+        )
+
+    def test_identical_is_zero(self):
+        assert float(translation_edit_rate(["a b c"], [["a b c"]])) == 0.0
+
+
+class TestEED:
+    def test_identical_is_small(self):
+        # even identical sentences score slightly above 0: the coverage term
+        # counts never-visited grid positions (same behavior as the reference)
+        assert float(extended_edit_distance(["nice sentence"], [["nice sentence"]])) < 0.05
+
+    def test_range_and_module(self):
+        val = float(extended_edit_distance(PREDS, TARGETS))
+        assert 0 < val <= 1
+        m = ExtendedEditDistance()
+        m.update(PREDS, TARGETS)
+        np.testing.assert_allclose(float(m.compute()), val, atol=1e-6)
+
+
+class TestSQuAD:
+    def test_exact(self):
+        preds = [{"prediction_text": "1976", "id": "id1"}]
+        target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "id1"}]
+        out = squad(preds, target)
+        assert float(out["exact_match"]) == 100.0
+        assert float(out["f1"]) == 100.0
+
+    def test_partial_f1(self):
+        preds = [{"prediction_text": "the cat sat", "id": "a"}]
+        target = [{"answers": {"answer_start": [0], "text": ["the cat"]}, "id": "a"}]
+        out = squad(preds, target)
+        assert float(out["exact_match"]) == 0.0
+        assert 0 < float(out["f1"]) < 100.0
+
+    def test_module_accumulates(self):
+        m = SQuAD()
+        m.update({"prediction_text": "yes", "id": "1"}, {"answers": {"text": ["yes"]}, "id": "1"})
+        m.update({"prediction_text": "no", "id": "2"}, {"answers": {"text": ["maybe"]}, "id": "2"})
+        out = m.compute()
+        assert float(out["exact_match"]) == 50.0
+
+
+class TestROUGE:
+    @pytest.mark.parametrize("use_stemmer", [False, True])
+    def test_vs_rouge_score_package(self, use_stemmer):
+        from rouge_score.rouge_scorer import RougeScorer
+
+        keys = ("rouge1", "rouge2", "rougeL")
+        scorer = RougeScorer(list(keys), use_stemmer=use_stemmer)
+        pred, tgt = "My name is John", "Is your name John"
+        expected = scorer.score(tgt, pred)
+        ours = rouge_score(pred, tgt, rouge_keys=keys, use_stemmer=use_stemmer)
+        for k in keys:
+            np.testing.assert_allclose(float(ours[f"{k}_fmeasure"]), expected[k].fmeasure, atol=1e-5, err_msg=k)
+            np.testing.assert_allclose(float(ours[f"{k}_precision"]), expected[k].precision, atol=1e-5)
+            np.testing.assert_allclose(float(ours[f"{k}_recall"]), expected[k].recall, atol=1e-5)
+
+    def test_rouge_lsum(self):
+        from rouge_score.rouge_scorer import RougeScorer
+
+        scorer = RougeScorer(["rougeLsum"], use_stemmer=False)
+        pred = "The cat sat. The dog ran away quickly."
+        tgt = "A cat sat down. The dog sprinted off."
+        expected = scorer.score("\n".join(tgt.replace(". ", ".\n").split("\n")), "\n".join(pred.replace(". ", ".\n").split("\n")))
+        ours = rouge_score(pred, tgt, rouge_keys="rougeLsum")
+        np.testing.assert_allclose(float(ours["rougeLsum_fmeasure"]), expected["rougeLsum"].fmeasure, atol=1e-5)
+
+    def test_module(self):
+        m = ROUGEScore(rouge_keys=("rouge1", "rougeL"))
+        m.update(PREDS, [[t] for t in TARGETS])
+        out = m.compute()
+        assert set(out.keys()) == {
+            "rouge1_fmeasure", "rouge1_precision", "rouge1_recall",
+            "rougeL_fmeasure", "rougeL_precision", "rougeL_recall",
+        }
+
+
+class TestBERTScore:
+    @staticmethod
+    def _toy_embedder(sents):
+        import jax
+
+        max_len = max(len(s.split()) for s in sents)
+        ids = jnp.asarray(
+            [[(hash(w) % 97) + 1 for w in s.split()] + [0] * (max_len - len(s.split())) for s in sents]
+        )
+        emb = jax.nn.one_hot(ids, 98)
+        mask = (ids > 0).astype(jnp.int32)
+        return emb, mask, ids
+
+    def test_identical_is_one(self):
+        from metrics_tpu.functional import bert_score
+
+        out = bert_score(["hello world"], ["hello world"], embedder=self._toy_embedder)
+        np.testing.assert_allclose(float(out["f1"][0]), 1.0, atol=1e-6)
+
+    def test_overlap_f1(self):
+        from metrics_tpu.functional import bert_score
+
+        # one-hot embeddings -> BERTScore reduces to token-overlap P/R
+        out = bert_score(["a b c d"], ["a b x y"], embedder=self._toy_embedder)
+        np.testing.assert_allclose(float(out["precision"][0]), 0.5, atol=1e-6)
+        np.testing.assert_allclose(float(out["recall"][0]), 0.5, atol=1e-6)
+
+    def test_module_and_requires_embedder(self):
+        from metrics_tpu import BERTScore
+
+        m = BERTScore(embedder=self._toy_embedder)
+        m.update(["a b"], ["a b"])
+        out = m.compute()  # module compute squeezes size-1 results to scalars
+        np.testing.assert_allclose(float(out["f1"]), 1.0, atol=1e-6)
+
+        m2 = BERTScore()
+        m2.update(["x"], ["x"])
+        with pytest.raises(ValueError, match="embedding model"):
+            m2.compute()
+
+    def test_idf(self):
+        from metrics_tpu.functional import bert_score
+
+        out = bert_score(["a b", "a c"], ["a b", "a d"], embedder=self._toy_embedder, idf=True)
+        assert np.all(np.isfinite(np.asarray(out["f1"])))
