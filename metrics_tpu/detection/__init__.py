@@ -1,0 +1,2 @@
+from metrics_tpu.detection.helpers import box_area, box_convert, box_iou  # noqa: F401
+from metrics_tpu.detection.mean_ap import MeanAveragePrecision  # noqa: F401

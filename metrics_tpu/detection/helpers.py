@@ -1,0 +1,51 @@
+"""Box utilities for detection metrics — pure jnp (the reference delegates to
+torchvision's C++ ops, mean_ap.py:24)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str) -> Array:
+    """Convert between 'xyxy', 'xywh' and 'cxcywh' box formats."""
+    allowed = ("xyxy", "xywh", "cxcywh")
+    if in_fmt not in allowed or out_fmt not in allowed:
+        raise ValueError(f"Unsupported box format conversion {in_fmt} -> {out_fmt}")
+    if in_fmt == out_fmt:
+        return boxes
+
+    if in_fmt == "xywh":
+        x, y, w, h = jnp.split(boxes, 4, axis=-1)
+        xyxy = jnp.concatenate([x, y, x + w, y + h], axis=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+        xyxy = jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    else:
+        xyxy = boxes
+
+    if out_fmt == "xyxy":
+        return xyxy
+    x1, y1, x2, y2 = jnp.split(xyxy, 4, axis=-1)
+    if out_fmt == "xywh":
+        return jnp.concatenate([x1, y1, x2 - x1, y2 - y1], axis=-1)
+    return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+
+
+def box_area(boxes: Array) -> Array:
+    """Area of xyxy boxes."""
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """Pairwise IoU matrix between two xyxy box sets — one fused (N, M) op."""
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.where(union > 0, union, 1.0), 0.0)

