@@ -1,0 +1,15 @@
+# parity with the reference's Makefile targets (test / doctest / clean)
+.PHONY: test doctest bench clean
+
+test:
+	python -m pytest tests/ -q
+
+doctest:
+	JAX_PLATFORMS=cpu python -m pytest --doctest-modules metrics_tpu/ -q
+
+bench:
+	python bench.py
+
+clean:
+	rm -rf .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

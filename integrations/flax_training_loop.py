@@ -1,0 +1,90 @@
+"""Training-loop integration example — the framework's loop protocol.
+
+The reference's L4 layer is PyTorch Lightning interop
+(/root/reference/integrations/test_lightning.py:30-258): a metric object
+usable standalone *and* driven by an external loop (forward returns the
+batch value; compute/reset at epoch boundaries). This example shows the
+same contract inside an idiomatic JAX/Flax training loop, including the
+fully-jitted distributed variant.
+
+Run: JAX_PLATFORMS=cpu python integrations/flax_training_loop.py
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import Accuracy, F1Score, MeanMetric, MetricCollection
+
+NUM_CLASSES = 4
+
+
+def host_driven_loop() -> None:
+    """Eager loop: metrics driven like Lightning drives them (forward per step,
+    compute/reset per epoch)."""
+    rng = np.random.RandomState(0)
+    metrics = MetricCollection(
+        {"acc": Accuracy(num_classes=NUM_CLASSES, average="macro"),
+         "f1": F1Score(num_classes=NUM_CLASSES, average="macro")}
+    )
+    train_loss = MeanMetric()
+
+    for epoch in range(2):
+        for _step in range(5):
+            logits = jnp.asarray(rng.rand(32, NUM_CLASSES).astype(np.float32))
+            target = jnp.asarray(rng.randint(0, NUM_CLASSES, 32))
+            loss = jnp.mean((logits.argmax(-1) != target).astype(jnp.float32))
+
+            batch_vals = metrics(logits, target)  # per-step value, accumulates
+            train_loss.update(loss)
+            del batch_vals
+
+        epoch_vals = {k: float(v) for k, v in metrics.compute().items()}
+        print(f"epoch {epoch}: loss={float(train_loss.compute()):.3f} {epoch_vals}")
+        metrics.reset()
+        train_loss.reset()
+
+
+def jitted_distributed_loop() -> None:
+    """Fully-jitted data-parallel epoch: each device scans its shard of the
+    step stream through the pure reducer, then one XLA collective syncs the
+    states — the whole epoch is a single compiled program."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n_dev = len(jax.devices())
+    steps, per_dev_batch = 4, 8
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    metric = Accuracy(num_classes=NUM_CLASSES, average="micro")
+
+    def epoch(state, logits_steps, target_steps):
+        # logits_steps: (steps, per_dev_batch, C) — this device's shard
+        def body(carry, xs):
+            logits, target = xs
+            return metric.pure_update(carry, logits, target), None
+
+        state, _ = jax.lax.scan(body, state, (logits_steps, target_steps))
+        return metric.pure_sync(state, "dp")  # all_gather + reduce over ICI
+
+    run_epoch = jax.jit(
+        shard_map(
+            epoch,
+            mesh=mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(), metric.state()), P(None, "dp"), P(None, "dp")),
+            out_specs=jax.tree_util.tree_map(lambda _: P(), metric.state()),
+            check_vma=False,
+        )
+    )
+
+    rng = np.random.RandomState(1)
+    logits = jnp.asarray(rng.rand(steps, per_dev_batch * n_dev, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, (steps, per_dev_batch * n_dev)))
+
+    synced = run_epoch(metric.state(), logits, target)
+    print("distributed accuracy:", float(metric.pure_compute(synced)))
+
+
+if __name__ == "__main__":
+    host_driven_loop()
+    jitted_distributed_loop()

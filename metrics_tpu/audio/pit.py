@@ -21,7 +21,7 @@ class PermutationInvariantTraining(Metric):
         >>> target = jnp.asarray([[[ 1.0958, -0.1648,  0.5228], [-0.4100,  1.1942, -0.5103]]])
         >>> pit = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, 'max')
         >>> round(float(pit(preds, target)), 4)
-        -2.1065
+        -5.1092
     """
 
     is_differentiable = True

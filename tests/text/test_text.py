@@ -221,15 +221,24 @@ class TestROUGE:
 
 
 class TestBERTScore:
-    @staticmethod
-    def _toy_embedder(sents):
+    _vocab = {}
+
+    @classmethod
+    def _tok_id(cls, w):
+        # deterministic token ids (hash() is randomized per process)
+        if w not in cls._vocab:
+            cls._vocab[w] = len(cls._vocab) + 1
+        return cls._vocab[w]
+
+    @classmethod
+    def _toy_embedder(cls, sents):
         import jax
 
         max_len = max(len(s.split()) for s in sents)
         ids = jnp.asarray(
-            [[(hash(w) % 97) + 1 for w in s.split()] + [0] * (max_len - len(s.split())) for s in sents]
+            [[cls._tok_id(w) for w in s.split()] + [0] * (max_len - len(s.split())) for s in sents]
         )
-        emb = jax.nn.one_hot(ids, 98)
+        emb = jax.nn.one_hot(ids, 128)
         mask = (ids > 0).astype(jnp.int32)
         return emb, mask, ids
 
