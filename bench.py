@@ -77,13 +77,88 @@ def _bench_torch_baseline() -> float:
     return (time.perf_counter() - t0) / ITERS * 1e6
 
 
+def _bench_detail() -> dict:
+    """Extra BASELINE.md configs; written to BENCH_DETAIL.json with BENCH_ALL=1."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    detail = {}
+    rng = np.random.RandomState(0)
+
+    # MetricCollection(Accuracy, F1, BinnedAveragePrecision) forward loop
+    from metrics_tpu import Accuracy, BinnedAveragePrecision, F1Score, MetricCollection
+
+    logits = rng.rand(256, 32).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, 32, 256))
+    mc = MetricCollection(
+        {"acc": Accuracy(num_classes=32), "f1": F1Score(num_classes=32, average="macro"),
+         "ap": BinnedAveragePrecision(num_classes=32, thresholds=64)},
+        compute_groups=False,
+    )
+    mc.update(preds, target)  # warm
+    t0 = time.perf_counter()
+    for _ in range(50):
+        mc.update(preds, target)
+    jax.block_until_ready(mc["ap"].TPs)
+    detail["collection_update_us"] = round((time.perf_counter() - t0) / 50 * 1e6, 1)
+
+    # RetrievalMAP: MSLR-style grouped ranking
+    from metrics_tpu import RetrievalMAP
+
+    n_queries, docs = 1000, 100
+    indexes = jnp.asarray(np.repeat(np.arange(n_queries), docs))
+    scores = jnp.asarray(rng.rand(n_queries * docs).astype(np.float32))
+    rel = jnp.asarray(rng.randint(0, 2, n_queries * docs))
+    rmap = RetrievalMAP()
+    rmap.update(scores, rel, indexes)
+    t0 = time.perf_counter()
+    val = rmap.compute()
+    jax.block_until_ready(val)
+    detail["retrieval_map_compute_ms_100k_rows"] = round((time.perf_counter() - t0) * 1e3, 1)
+
+    # COCO mAP: 100 images x 20 dets/gts
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    m = MeanAveragePrecision()
+    for _ in range(100):
+        boxes = rng.rand(20, 4).astype(np.float32) * 100
+        boxes[:, 2:] += boxes[:, :2] + 5
+        m.update(
+            [dict(boxes=jnp.asarray(boxes), scores=jnp.asarray(rng.rand(20).astype(np.float32)),
+                  labels=jnp.asarray(rng.randint(0, 10, 20)))],
+            [dict(boxes=jnp.asarray(boxes + rng.randn(20, 4).astype(np.float32) * 3),
+                  labels=jnp.asarray(rng.randint(0, 10, 20)))],
+        )
+    t0 = time.perf_counter()
+    m.compute()
+    detail["coco_map_compute_s_100_images"] = round(time.perf_counter() - t0, 2)
+
+    return detail
+
+
 def main() -> None:
+    import os
+
     ours_us = _bench_ours()
     try:
         base_us = _bench_torch_baseline()
         vs_baseline = base_us / ours_us
     except Exception:
         vs_baseline = float("nan")
+
+    if os.environ.get("BENCH_ALL"):
+        try:
+            detail = _bench_detail()
+            detail["accuracy_update_us"] = round(ours_us, 2)
+            detail["torch_cpu_baseline_us"] = round(base_us, 2)
+            with open("BENCH_DETAIL.json", "w") as f:
+                json.dump(detail, f, indent=2)
+        except Exception as err:  # detail bench must never break the headline
+            print(f"# detail bench failed: {err}")
+
     print(
         json.dumps(
             {

@@ -1,0 +1,14 @@
+"""Repo-level pytest config: pin pytest (incl. --doctest-modules runs) to the
+CPU backend so expected float values are deterministic across machines.
+
+The env-var route (JAX_PLATFORMS=cpu) is overridden by the site's platform
+plugin, so the config API is used instead. Must run before jax initializes
+its backends.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

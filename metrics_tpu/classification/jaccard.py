@@ -23,7 +23,7 @@ class JaccardIndex(ConfusionMatrix):
         >>> pred = jnp.asarray([[0, 1, 0], [1, 1, 1]])
         >>> jaccard = JaccardIndex(num_classes=2)
         >>> round(float(jaccard(pred, target)), 4)
-        0.5833
+        0.4667
     """
 
     is_differentiable = False

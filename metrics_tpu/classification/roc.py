@@ -23,8 +23,8 @@ class ROC(Metric):
         >>> target = jnp.asarray([0, 1, 1, 1])
         >>> roc = ROC(pos_label=1)
         >>> fpr, tpr, thresholds = roc(pred, target)
-        >>> fpr
-        Array([0., 0., 0., 0., 1.], dtype=float32)
+        >>> [round(float(x), 4) for x in fpr]
+        [0.0, 0.0, 0.0, 0.0, 1.0]
     """
 
     is_differentiable = False

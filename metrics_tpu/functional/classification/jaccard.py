@@ -56,7 +56,7 @@ def jaccard_index(
         >>> target = jnp.asarray([[0, 1, 1], [1, 1, 0]])
         >>> pred = jnp.asarray([[0, 1, 0], [1, 1, 1]])
         >>> round(float(jaccard_index(pred, target, num_classes=2)), 4)
-        0.5833
+        0.4667
     """
     confmat = _jaccard_update(preds, target, num_classes, threshold)
     return _jaccard_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

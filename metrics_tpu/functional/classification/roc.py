@@ -115,10 +115,10 @@ def roc(
         >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
         >>> target = jnp.asarray([0, 1, 1, 1])
         >>> fpr, tpr, thresholds = roc(pred, target, pos_label=1)
-        >>> fpr
-        Array([0., 0., 0., 0., 1.], dtype=float32)
-        >>> tpr
-        Array([0.       , 0.3333333, 0.6666667, 1.       , 1.       ],      dtype=float32)
+        >>> [round(float(x), 4) for x in fpr]
+        [0.0, 0.0, 0.0, 0.0, 1.0]
+        >>> [round(float(x), 4) for x in tpr]
+        [0.0, 0.3333, 0.6667, 1.0, 1.0]
     """
     preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
     return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -74,10 +74,11 @@ def pairwise_cosine_similarity(
         >>> from metrics_tpu.functional import pairwise_cosine_similarity
         >>> x = jnp.asarray([[2.0, 3], [3, 5], [5, 8]])
         >>> y = jnp.asarray([[1.0, 0], [2, 1]])
-        >>> pairwise_cosine_similarity(x, y)
-        Array([[0.5547002 , 0.8682431 ],
-               [0.5144958 , 0.8437501 ],
-               [0.5300315 , 0.85580385]], dtype=float32)
+        >>> import numpy as np
+        >>> np.round(np.asarray(pairwise_cosine_similarity(x, y)), 4)
+        array([[0.5547, 0.8682],
+               [0.5145, 0.8437],
+               [0.53  , 0.8533]], dtype=float32)
     """
     distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)
@@ -108,10 +109,11 @@ def pairwise_euclidean_distance(
         >>> from metrics_tpu.functional import pairwise_euclidean_distance
         >>> x = jnp.asarray([[2.0, 3], [3, 5], [5, 8]])
         >>> y = jnp.asarray([[1.0, 0], [2, 1]])
-        >>> pairwise_euclidean_distance(x, y)
-        Array([[3.1622777, 2.       ],
-               [5.3851647, 4.1231055],
-               [8.944272 , 7.6157737]], dtype=float32)
+        >>> import numpy as np
+        >>> np.round(np.asarray(pairwise_euclidean_distance(x, y)), 4)
+        array([[3.1623, 2.    ],
+               [5.3852, 4.1231],
+               [8.9443, 7.6158]], dtype=float32)
     """
     distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)
@@ -159,8 +161,9 @@ def pairwise_manhattan_distance(
         >>> from metrics_tpu.functional import pairwise_manhattan_distance
         >>> x = jnp.asarray([[2.0, 3], [3, 5], [5, 8]])
         >>> y = jnp.asarray([[1.0, 0], [2, 1]])
-        >>> pairwise_manhattan_distance(x, y)
-        Array([[ 4.,  2.],
+        >>> import numpy as np
+        >>> np.round(np.asarray(pairwise_manhattan_distance(x, y)), 4)
+        array([[ 4.,  2.],
                [ 7.,  5.],
                [12., 10.]], dtype=float32)
     """

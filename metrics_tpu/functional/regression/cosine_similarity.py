@@ -36,8 +36,8 @@ def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "s
         >>> from metrics_tpu.functional import cosine_similarity
         >>> target = jnp.asarray([[1.0, 2, 3, 4], [1, 2, 3, 4]])
         >>> preds = jnp.asarray([[1.0, 2, 3, 4], [-1, -2, -3, -4]])
-        >>> cosine_similarity(preds, target, 'none')
-        Array([ 1.0000001, -1.0000001], dtype=float32)
+        >>> [round(float(x), 4) for x in cosine_similarity(preds, target, 'none')]
+        [1.0, -1.0]
     """
     preds, target = _cosine_similarity_update(preds, target)
     return _cosine_similarity_compute(preds, target, reduction)

@@ -30,7 +30,7 @@ def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Arr
         >>> target = jnp.asarray([1.0, 10, 1e6])
         >>> preds = jnp.asarray([0.9, 15, 1.2e6])
         >>> round(float(symmetric_mean_absolute_percentage_error(preds, target)), 4)
-        0.2291
+        0.229
     """
     sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
     return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

@@ -68,7 +68,7 @@ def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> 
         >>> targets = jnp.asarray([1.0, 2.0, 3.0, 4.0])
         >>> preds = jnp.asarray([4.0, 3.0, 2.0, 1.0])
         >>> round(float(tweedie_deviance_score(preds, targets, power=2)), 4)
-        4.8333
+        1.2083
     """
     sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
     return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

@@ -110,17 +110,15 @@ def bert_score(
 ) -> Dict[str, Array]:
     """BERTScore P/R/F1 (ref bert.py:364-629).
 
-    Example (with a toy embedder):
-        >>> import jax.numpy as jnp
+    Example (with a toy one-hot embedder):
+        >>> import jax, jax.numpy as jnp
+        >>> vocab = {"hello": 1, "there": 2}
         >>> def toy_embedder(sents):
-        ...     ids = jnp.asarray([[hash(w) % 97 for w in s.split()] + [0] * (4 - len(s.split())) for s in sents])
-        ...     emb = jax.nn.one_hot(ids, 97)
-        ...     mask = (jnp.arange(4)[None, :] < jnp.asarray([[len(s.split())] for s in sents])).astype(jnp.int32)
-        ...     return emb, mask, ids
-        >>> import jax
+        ...     ids = jnp.asarray([[vocab[w] for w in s.split()] for s in sents])
+        ...     return jax.nn.one_hot(ids, 8), jnp.ones_like(ids), ids
         >>> from metrics_tpu.functional.text.bert import bert_score
         >>> out = bert_score(["hello there"], ["hello there"], embedder=toy_embedder)
-        >>> float(out["f1"])
+        >>> float(out["f1"][0])
         1.0
     """
     if isinstance(preds, str):

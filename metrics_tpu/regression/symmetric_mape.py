@@ -23,7 +23,7 @@ class SymmetricMeanAbsolutePercentageError(Metric):
         >>> preds = jnp.asarray([0.9, 15, 1.2e6])
         >>> smape = SymmetricMeanAbsolutePercentageError()
         >>> round(float(smape(preds, target)), 4)
-        0.2291
+        0.229
     """
 
     is_differentiable = True

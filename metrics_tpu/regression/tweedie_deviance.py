@@ -23,7 +23,7 @@ class TweedieDevianceScore(Metric):
         >>> preds = jnp.asarray([4.0, 3.0, 2.0, 1.0])
         >>> deviance_score = TweedieDevianceScore(power=2)
         >>> round(float(deviance_score(preds, targets)), 4)
-        4.8333
+        1.2083
     """
 
     is_differentiable = True

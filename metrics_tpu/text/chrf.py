@@ -20,7 +20,7 @@ class CHRFScore(Metric):
         >>> target = [['there is a cat on the mat']]
         >>> chrf = CHRFScore()
         >>> round(float(chrf(preds, target)), 4)
-        0.8159
+        0.4942
     """
 
     is_differentiable = False

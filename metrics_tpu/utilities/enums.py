@@ -12,8 +12,13 @@ class EnumStr(str, Enum):
 
     @classmethod
     def from_str(cls, value: str) -> Optional["EnumStr"]:
+        normalized = value.replace("-", "_").upper()
         try:
-            return cls[value.replace("-", "_").upper()]
+            return cls[normalized]
+        except KeyError:
+            pass
+        try:  # e.g. 'multi-class' -> MULTICLASS
+            return cls[normalized.replace("_", "")]
         except KeyError:
             return None
 

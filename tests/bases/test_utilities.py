@@ -1,0 +1,148 @@
+"""L0 utility tests (translation of ref tests/utilities/)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.data import (
+    _bincount,
+    _flatten,
+    _flatten_dict,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    get_group_indexes,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from metrics_tpu.utilities.distributed import class_reduce, reduce
+from metrics_tpu.utilities.enums import AverageMethod, DataType
+
+
+class TestReductions:
+    def test_dim_zero(self):
+        x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(np.asarray(dim_zero_sum(x)), [4.0, 6.0])
+        np.testing.assert_allclose(np.asarray(dim_zero_mean(x)), [2.0, 3.0])
+        np.testing.assert_allclose(np.asarray(dim_zero_max(x)), [3.0, 4.0])
+        np.testing.assert_allclose(np.asarray(dim_zero_min(x)), [1.0, 2.0])
+
+    def test_cat_list_and_tensor(self):
+        out = dim_zero_cat([jnp.asarray([1.0]), jnp.asarray([2.0, 3.0])])
+        np.testing.assert_allclose(np.asarray(out), [1.0, 2.0, 3.0])
+        passthrough = dim_zero_cat(jnp.asarray([1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(passthrough), [1.0, 2.0])
+        with pytest.raises(ValueError, match="No samples"):
+            dim_zero_cat([])
+
+    def test_reduce(self):
+        x = jnp.asarray([1.0, 2.0, 3.0])
+        assert float(reduce(x, "elementwise_mean")) == 2.0
+        assert float(reduce(x, "sum")) == 6.0
+        np.testing.assert_allclose(np.asarray(reduce(x, "none")), np.asarray(x))
+        with pytest.raises(ValueError):
+            reduce(x, "bad")
+
+    def test_class_reduce(self):
+        num = jnp.asarray([2.0, 0.0, 6.0])
+        denom = jnp.asarray([4.0, 0.0, 8.0])
+        weights = jnp.asarray([10.0, 0.0, 30.0])
+        np.testing.assert_allclose(float(class_reduce(num, denom, weights, "micro")), 8 / 12)
+        np.testing.assert_allclose(
+            np.asarray(class_reduce(num, denom, weights, "none")), [0.5, 0.0, 0.75]
+        )
+        np.testing.assert_allclose(float(class_reduce(num, denom, weights, "macro")), np.mean([0.5, 0.0, 0.75]))
+
+
+class TestDataHelpers:
+    def test_to_onehot(self):
+        labels = jnp.asarray([0, 2, 1])
+        onehot = to_onehot(labels, 3)
+        assert onehot.shape == (3, 3)
+        np.testing.assert_array_equal(np.asarray(onehot), np.eye(3, dtype=int)[[0, 2, 1]])
+
+    def test_to_onehot_multidim(self):
+        labels = jnp.asarray([[0, 1], [2, 0]])
+        onehot = to_onehot(labels, 3)
+        assert onehot.shape == (2, 3, 2)
+
+    def test_select_topk(self):
+        probs = jnp.asarray([[0.1, 0.6, 0.3], [0.8, 0.1, 0.1]])
+        top1 = select_topk(probs, 1)
+        np.testing.assert_array_equal(np.asarray(top1), [[0, 1, 0], [1, 0, 0]])
+        top2 = select_topk(probs, 2)
+        np.testing.assert_array_equal(np.asarray(top2), [[0, 1, 1], [1, 1, 0]])
+
+    def test_to_categorical(self):
+        probs = jnp.asarray([[0.1, 0.9], [0.7, 0.3]])
+        np.testing.assert_array_equal(np.asarray(to_categorical(probs)), [1, 0])
+
+    def test_bincount_jit(self):
+        x = jnp.asarray([0, 1, 1, 2, 2, 2])
+        out = jax.jit(lambda v: _bincount(v, minlength=4))(x)
+        np.testing.assert_array_equal(np.asarray(out), [1, 2, 3, 0])
+
+    def test_flatten(self):
+        assert _flatten([[1, 2], [3]]) == [1, 2, 3]
+        assert _flatten_dict({"a": {"x": 1}, "b": 2}) == {"x": 1, "b": 2}
+
+    def test_get_group_indexes(self):
+        indexes = jnp.asarray([0, 0, 1, 1, 0])
+        groups = get_group_indexes(indexes)
+        np.testing.assert_array_equal(np.asarray(groups[0]), [0, 1, 4])
+        np.testing.assert_array_equal(np.asarray(groups[1]), [2, 3])
+
+
+class TestEnums:
+    def test_case_insensitive(self):
+        assert AverageMethod.from_str("MICRO") == AverageMethod.MICRO
+        assert AverageMethod.MICRO == "micro"
+        assert DataType.from_str("multi-class") == DataType.MULTICLASS
+
+    def test_from_str_or_raise(self):
+        with pytest.raises(ValueError):
+            AverageMethod.from_str_or_raise("bogus")
+
+
+class TestInputFormatting:
+    def test_binary_prob(self):
+        preds = jnp.asarray([0.3, 0.7])
+        target = jnp.asarray([0, 1])
+        p, t, case = _input_format_classification(preds, target, threshold=0.5)
+        assert case == DataType.BINARY
+        np.testing.assert_array_equal(np.asarray(p).reshape(-1), [0, 1])
+
+    def test_multiclass_labels(self):
+        preds = jnp.asarray([0, 2, 1])
+        target = jnp.asarray([0, 1, 2])
+        p, t, case = _input_format_classification(preds, target)
+        assert case == DataType.MULTICLASS
+        assert p.shape == (3, 3)
+
+    def test_multiclass_probs(self):
+        preds = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
+        target = jnp.asarray([1, 0])
+        p, t, case = _input_format_classification(preds, target)
+        assert case == DataType.MULTICLASS
+        np.testing.assert_array_equal(np.asarray(p), [[0, 1], [1, 0]])
+
+    def test_float_target_rejected(self):
+        with pytest.raises(ValueError, match="has to be an integer tensor"):
+            _input_format_classification(jnp.asarray([0.5]), jnp.asarray([0.5]))
+
+    def test_jit_requires_num_classes_for_int_multiclass(self):
+        preds = jnp.asarray([0, 2, 1])
+        target = jnp.asarray([0, 1, 2])
+
+        def fmt(p, t):
+            return _input_format_classification(p, t)[0]
+
+        with pytest.raises(ValueError, match="num_classes"):
+            jax.jit(fmt)(preds, target)
+
+        out = jax.jit(lambda p, t: _input_format_classification(p, t, num_classes=3)[0])(preds, target)
+        assert out.shape == (3, 3)
