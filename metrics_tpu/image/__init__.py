@@ -2,6 +2,10 @@ from metrics_tpu.image.d_lambda import SpectralDistortionIndex  # noqa: F401
 from metrics_tpu.image.ergas import ErrorRelativeGlobalDimensionlessSynthesis  # noqa: F401
 from metrics_tpu.image.fid import FrechetInceptionDistance  # noqa: F401
 from metrics_tpu.image.inception import InceptionScore  # noqa: F401
+from metrics_tpu.utilities.imports import _FLAX_AVAILABLE
+
+if _FLAX_AVAILABLE:
+    from metrics_tpu.image.inception_net import InceptionV3, InceptionV3FeatureExtractor  # noqa: F401
 from metrics_tpu.image.kid import KernelInceptionDistance  # noqa: F401
 from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity  # noqa: F401
 from metrics_tpu.image.psnr import PeakSignalNoiseRatio  # noqa: F401

@@ -11,10 +11,11 @@ TPU-first departures:
   symmetric product ``sqrt(S1) S2 sqrt(S1)`` — pure jnp, jit-able,
   differentiable.
 * The feature extractor is injectable: any callable mapping an image batch
-  to ``(N, D)`` features (e.g. a Flax InceptionV3 with loaded weights; the
-  reference hardcodes ``torch_fidelity``'s InceptionV3, fid.py:27-57).
-  Pretrained weights are an asset, not code, so the framework does not
-  bundle them.
+  to ``(N, D)`` features (the reference hardcodes ``torch_fidelity``'s
+  InceptionV3, fid.py:27-57). The bundled Flax port of that network is
+  :class:`metrics_tpu.image.InceptionV3FeatureExtractor` (2048-d pool
+  features; weights load from a local ``.npz`` — pretrained weights are an
+  asset, not code).
 """
 from typing import Any, Callable, Optional
 

@@ -1,0 +1,41 @@
+"""Dice score tests — same cases as the reference's test_dice.py:20-31."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import dice_score
+
+
+@pytest.mark.parametrize(
+    ["pred", "target", "expected"],
+    [
+        ([[0, 0], [1, 1]], [[0, 0], [1, 1]], 1.0),
+        ([[1, 1], [0, 0]], [[0, 0], [1, 1]], 0.0),
+        ([[1, 1], [1, 1]], [[1, 1], [0, 0]], 2 / 3),
+        ([[1, 1], [0, 0]], [[1, 1], [0, 0]], 1.0),
+    ],
+)
+def test_dice_score(pred, target, expected):
+    score = dice_score(jnp.asarray(pred), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(score), expected, atol=1e-6)
+
+
+def test_dice_score_from_probabilities():
+    """(N, C) probability input takes the argmax path (ref dice.py:96-99)."""
+    pred = jnp.asarray(
+        [[0.85, 0.05, 0.05, 0.05],
+         [0.05, 0.85, 0.05, 0.05],
+         [0.05, 0.05, 0.85, 0.05],
+         [0.05, 0.05, 0.05, 0.85]]
+    )
+    target = jnp.asarray([0, 1, 3, 2])
+    np.testing.assert_allclose(np.asarray(dice_score(pred, target)), 1 / 3, atol=1e-6)
+
+
+def test_dice_score_bg_and_reduction():
+    pred = jnp.asarray([[0, 0], [1, 1]])
+    target = jnp.asarray([[0, 0], [1, 1]])
+    assert float(dice_score(pred, target, bg=True)) == pytest.approx(1.0)
+    none_scores = dice_score(pred, target, bg=True, reduction="none")
+    assert none_scores.shape == (2,)
+    np.testing.assert_allclose(np.asarray(none_scores), [1.0, 1.0], atol=1e-6)

@@ -1,0 +1,70 @@
+"""Flax InceptionV3 feature-network tests (architecture, weights IO, wiring).
+
+Mirrors the role of the reference's feature-extractor plumbing in
+tests/image/test_fid.py / test_inception.py (shape + determinism checks;
+pretrained-weight equivalence is a weight-asset concern, not testable
+without network egress).
+"""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.image import FrechetInceptionDistance, InceptionScore, InceptionV3FeatureExtractor
+from metrics_tpu.image.inception_net import load_params, save_params
+
+# 75x75 is the smallest valid input; keeps CPU compile time low.
+IMGS = (np.random.RandomState(0).rand(2, 3, 75, 75) * 255).astype(np.uint8)
+
+
+@pytest.fixture(scope="module")
+def extractor():
+    return InceptionV3FeatureExtractor()
+
+
+def test_pool_features_shape(extractor):
+    feats = extractor(jnp.asarray(IMGS))
+    assert feats.shape == (2, 2048)
+    assert feats.dtype == jnp.float32
+
+
+def test_logits_shape():
+    ext = InceptionV3FeatureExtractor(output="logits", num_classes=1008)
+    assert ext(jnp.asarray(IMGS)).shape == (2, 1008)
+
+
+def test_nhwc_and_float_inputs_accepted(extractor):
+    nchw = extractor(jnp.asarray(IMGS))
+    nhwc = extractor(jnp.asarray(IMGS.transpose(0, 2, 3, 1)))
+    np.testing.assert_allclose(np.asarray(nchw), np.asarray(nhwc), atol=1e-5)
+
+
+def test_save_load_roundtrip(tmp_path, extractor):
+    path = os.path.join(tmp_path, "inception.npz")
+    save_params(path, extractor.variables)
+    restored = InceptionV3FeatureExtractor(weights_path=path)
+    a = np.asarray(extractor(jnp.asarray(IMGS)))
+    b = np.asarray(restored(jnp.asarray(IMGS)))
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_invalid_output_raises():
+    with pytest.raises(ValueError, match="output"):
+        InceptionV3FeatureExtractor(output="bogus")
+
+
+def test_fid_with_extractor(extractor):
+    fid = FrechetInceptionDistance(feature_extractor=extractor)
+    fid.update(jnp.asarray(IMGS), real=True)
+    fid.update(jnp.asarray(IMGS), real=False)
+    # identical real/fake batches -> FID ~ 0
+    assert float(fid.compute()) == pytest.approx(0.0, abs=1e-3)
+
+
+def test_inception_score_with_extractor():
+    ext = InceptionV3FeatureExtractor(output="logits")
+    inception = InceptionScore(logits_extractor=ext, splits=2)
+    inception.update(jnp.asarray((np.random.RandomState(1).rand(4, 3, 75, 75) * 255).astype(np.uint8)))
+    mean, std = inception.compute()
+    assert float(mean) >= 1.0  # exp(KL) >= 1
