@@ -229,6 +229,13 @@ class InceptionV3FeatureExtractor:
         if weights_path is not None:
             self.variables = load_params(weights_path)
         else:
+            from metrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                "InceptionV3FeatureExtractor built without `weights_path`: the network is"
+                " randomly initialized, so FID/IS/KID values are NOT comparable to published"
+                " numbers. Load pretrained weights (see docs/pretrained_weights.md)."
+            )
             self.variables = self.net.init(
                 jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3), jnp.float32)
             )

@@ -1,10 +1,11 @@
-"""Learned Perceptual Image Patch Similarity with an injectable net.
+"""Learned Perceptual Image Patch Similarity with a Flax LPIPS net.
 
 Behavioral parity: /root/reference/torchmetrics/image/lpip.py (149 LoC). The
 reference wraps the ``lpips`` package's pretrained AlexNet/VGG/SqueezeNet
-(lpip.py:25-40); pretrained perceptual nets are weight assets, so here the
-similarity network is injectable: any callable ``(img1, img2) -> (N,)``
-per-pair distances (e.g. a Flax port of LPIPS with loaded weights).
+(lpip.py:25-40). Here ``net_type='alex'|'vgg'`` builds the bundled Flax
+LPIPS network (:class:`metrics_tpu.image.lpips_net.LPIPSNet`; pretrained
+weights load from a local ``.npz`` via ``weights_path``), and ``net`` stays
+injectable for any callable ``(img1, img2) -> (N,)`` per-pair distances.
 """
 from typing import Any, Callable, Optional
 
@@ -20,7 +21,11 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
     """Average learned perceptual distance over batches (ref lpip.py:43-149).
 
     Args:
-        net: callable ``(img1, img2) -> (N,)`` perceptual distances.
+        net: callable ``(img1, img2) -> (N,)`` perceptual distances; takes
+            precedence over ``net_type`` when given.
+        net_type: 'alex' | 'vgg' — builds the bundled Flax LPIPS network
+            (requires flax).
+        weights_path: local ``.npz`` of LPIPS weights for ``net_type``.
         reduction: 'mean' | 'sum' over the accumulated per-pair scores.
 
     Example:
@@ -42,15 +47,23 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
     def __init__(
         self,
         net: Optional[Callable[[Array, Array], Array]] = None,
+        net_type: str = "alex",
+        weights_path: Optional[str] = None,
         reduction: str = "mean",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         if net is None:
-            raise ValueError(
-                "LPIPS requires a perceptual network: pass `net=callable(img1, img2) -> (N,) distances`"
-                " (e.g. a Flax LPIPS port with loaded weights)."
-            )
+            from metrics_tpu.utilities.imports import _FLAX_AVAILABLE
+
+            if not _FLAX_AVAILABLE:
+                raise ValueError(
+                    "LPIPS needs flax for the bundled network; either install flax or pass"
+                    " `net=callable(img1, img2) -> (N,) distances`."
+                )
+            from metrics_tpu.image.lpips_net import LPIPSNet
+
+            net = LPIPSNet(net_type=net_type, weights_path=weights_path)
         self.net = net
         valid_reduction = ("mean", "sum")
         if reduction not in valid_reduction:

@@ -1,0 +1,145 @@
+"""Flax LPIPS perceptual network (AlexNet / VGG16 backbones).
+
+TPU-native replacement for the ``lpips`` package the reference wraps
+(/root/reference/torchmetrics/image/lpip.py:23-36). Same computation as
+LPIPS: images in [-1, 1] are passed through the ImageNet scaling layer,
+through a conv backbone tapped at five ReLU stages, each tap is unit-
+normalized over channels, the squared difference is projected to one
+channel by a learned 1x1 conv ("lin" head), spatially averaged, and the
+five layer scores are summed.
+
+Weight assets: no network egress here, so pretrained backbone/lin weights
+load from a local ``.npz`` (``save_params`` layout shared with
+``inception_net``); without one the net is deterministically initialized —
+the computation, shapes, and timings are identical (see inception_net's
+module docstring for the same caveat).
+"""
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from metrics_tpu.image.inception_net import load_params, save_params  # noqa: F401  (shared weight IO)
+
+Array = jax.Array
+
+# ImageNet scaling layer constants (lpips.ScalingLayer)
+_SHIFT = (-0.030, -0.088, -0.188)
+_SCALE = (0.458, 0.448, 0.450)
+
+
+class AlexNetFeatures(nn.Module):
+    """AlexNet trunk tapped after each of the five ReLU stages."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        taps = []
+        x = nn.relu(nn.Conv(64, (11, 11), strides=(4, 4), padding=((2, 2), (2, 2)), dtype=self.dtype)(x))
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(192, (5, 5), padding=((2, 2), (2, 2)), dtype=self.dtype)(x))
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype)(x))
+        taps.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype)(x))
+        taps.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype)(x))
+        taps.append(x)
+        return taps
+
+
+class VGG16Features(nn.Module):
+    """VGG16 trunk tapped at relu1_2, relu2_2, relu3_3, relu4_3, relu5_3."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        taps = []
+        for stage, (width, convs) in enumerate(((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))):
+            if stage:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            for _ in range(convs):
+                x = nn.relu(nn.Conv(width, (3, 3), padding="SAME", dtype=self.dtype)(x))
+            taps.append(x)
+        return taps
+
+
+class _LPIPSModule(nn.Module):
+    """Scaling layer + backbone + per-tap lin heads on normalized sq-diffs."""
+
+    net_type: str = "alex"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, img1: Array, img2: Array) -> Array:
+        shift = jnp.asarray(_SHIFT).reshape(1, 1, 1, 3)
+        scale = jnp.asarray(_SCALE).reshape(1, 1, 1, 3)
+        backbone = (AlexNetFeatures if self.net_type == "alex" else VGG16Features)(dtype=self.dtype)
+        taps1 = backbone((img1 - shift) / scale)
+        taps2 = backbone((img2 - shift) / scale)
+
+        def _unit_normalize(t: Array) -> Array:
+            return t * jax.lax.rsqrt(jnp.sum(t**2, axis=-1, keepdims=True) + 1e-10)
+
+        total = 0.0
+        for i, (t1, t2) in enumerate(zip(taps1, taps2)):
+            diff = (_unit_normalize(t1) - _unit_normalize(t2)) ** 2
+            score = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}", dtype=self.dtype)(diff)
+            total = total + jnp.mean(score, axis=(1, 2, 3))
+        return total.astype(jnp.float32)
+
+
+class LPIPSNet:
+    """Jitted callable ``(img1, img2) -> (N,) perceptual distances``.
+
+    Drop-in for ``LearnedPerceptualImagePatchSimilarity(net=...)``. Inputs
+    are NCHW or NHWC float images in [-1, 1] (the reference's input
+    contract, lpip.py:39-41).
+
+    Args:
+        net_type: 'alex' (fast, LPIPS default for eval) or 'vgg'.
+        weights_path: local ``.npz`` of flax variables; ``None`` ->
+            deterministic random init.
+        dtype: compute dtype for the backbone (``jnp.bfloat16`` for MXU-
+            native precision; scores return float32).
+    """
+
+    def __init__(
+        self,
+        net_type: str = "alex",
+        weights_path: Optional[str] = None,
+        dtype: Any = jnp.float32,
+    ) -> None:
+        if net_type not in ("alex", "vgg"):
+            raise ValueError(f"Argument `net_type` must be 'alex' or 'vgg', got {net_type}")
+        self.net = _LPIPSModule(net_type=net_type, dtype=dtype)
+        init_hw = 64 if net_type == "alex" else 32
+        if weights_path is not None:
+            self.variables = load_params(weights_path)
+        else:
+            from metrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                "LPIPSNet built without `weights_path`: the backbone is randomly initialized,"
+                " so distances are NOT calibrated perceptual scores. Load pretrained weights"
+                " for publishable LPIPS values (see docs/pretrained_weights.md)."
+            )
+            dummy = jnp.zeros((1, init_hw, init_hw, 3), jnp.float32)
+            self.variables = self.net.init(jax.random.PRNGKey(0), dummy, dummy)
+
+        def _forward(variables, img1, img2):
+            if img1.shape[1] == 3 and img1.shape[-1] != 3:  # NCHW -> NHWC
+                img1 = jnp.transpose(img1, (0, 2, 3, 1))
+                img2 = jnp.transpose(img2, (0, 2, 3, 1))
+            return self.net.apply(variables, img1, img2)
+
+        self._forward = jax.jit(_forward)
+
+    def __call__(self, img1: Array, img2: Array) -> Array:
+        return self._forward(self.variables, img1, img2)

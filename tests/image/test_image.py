@@ -233,9 +233,15 @@ class TestGenerativeMetrics:
         with pytest.raises(ValueError, match="subset_size"):
             kid.compute()
 
-    def test_lpips_requires_net(self):
-        with pytest.raises(ValueError, match="perceptual network"):
-            LearnedPerceptualImagePatchSimilarity()
+    def test_lpips_default_builds_bundled_net(self):
+        from metrics_tpu.image.lpips_net import LPIPSNet
+
+        lpips = LearnedPerceptualImagePatchSimilarity()
+        assert isinstance(lpips.net, LPIPSNet)
+
+    def test_lpips_bad_net_type(self):
+        with pytest.raises(ValueError, match="net_type"):
+            LearnedPerceptualImagePatchSimilarity(net_type="squeeze")
 
     def test_lpips_with_net(self):
         l2_net = lambda a, b: jnp.square(a - b).mean(axis=(1, 2, 3))

@@ -1,0 +1,54 @@
+"""Flax LPIPS network tests (shape, symmetry-of-zero, net_type wiring)."""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+from metrics_tpu.image.lpips_net import LPIPSNet, save_params
+
+IMGS = np.random.RandomState(0).rand(2, 3, 64, 64).astype(np.float32) * 2 - 1
+
+
+def test_alex_shape_and_zero_self_distance():
+    net = LPIPSNet(net_type="alex")
+    d = net(jnp.asarray(IMGS), jnp.asarray(IMGS))
+    assert d.shape == (2,)
+    np.testing.assert_allclose(np.asarray(d), 0.0, atol=1e-6)
+
+
+def test_vgg_positive_distance():
+    net = LPIPSNet(net_type="vgg")
+    other = jnp.asarray(-IMGS)
+    d = net(jnp.asarray(IMGS), other)
+    assert d.shape == (2,)
+    assert (np.asarray(d) != 0).all()
+
+
+def test_nhwc_matches_nchw():
+    net = LPIPSNet(net_type="alex")
+    a = net(jnp.asarray(IMGS), jnp.asarray(-IMGS))
+    b = net(jnp.asarray(IMGS.transpose(0, 2, 3, 1)), jnp.asarray(-IMGS.transpose(0, 2, 3, 1)))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_weights_roundtrip(tmp_path):
+    net = LPIPSNet(net_type="alex")
+    path = os.path.join(tmp_path, "lpips.npz")
+    save_params(path, net.variables)
+    restored = LPIPSNet(net_type="alex", weights_path=path)
+    a = np.asarray(net(jnp.asarray(IMGS), jnp.asarray(-IMGS)))
+    b = np.asarray(restored(jnp.asarray(IMGS), jnp.asarray(-IMGS)))
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_invalid_net_type_raises():
+    with pytest.raises(ValueError, match="net_type"):
+        LPIPSNet(net_type="squeeze")
+
+
+def test_metric_builds_bundled_net():
+    lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+    lpips.update(jnp.asarray(IMGS), jnp.asarray(-IMGS))
+    assert float(lpips.compute()) >= 0.0
