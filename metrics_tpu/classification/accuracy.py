@@ -38,6 +38,7 @@ class Accuracy(StatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    _aux_attributes = ("mode", "subset_accuracy")
 
     def __init__(
         self,
@@ -73,7 +74,7 @@ class Accuracy(StatScores):
         self.threshold = threshold
         self.top_k = top_k
         self.subset_accuracy = subset_accuracy
-        self.mode: Optional[DataType] = None
+        self.mode: Optional[DataType] = None  # checkpointed via _aux_attributes
         self.multiclass = multiclass
         self.ignore_index = ignore_index
 

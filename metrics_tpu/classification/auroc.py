@@ -31,6 +31,7 @@ class AUROC(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    _aux_attributes = ('mode', 'num_classes', 'pos_label')
 
     def __init__(
         self,

@@ -33,6 +33,7 @@ class AveragePrecision(Metric):
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
+    _aux_attributes = ('num_classes', 'pos_label')
 
     def __init__(
         self,

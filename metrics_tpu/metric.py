@@ -29,7 +29,8 @@ import inspect
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
-from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
+from enum import Enum
+from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -89,6 +90,10 @@ class Metric(ABC):
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = True
+    # Auxiliary (non-array) attributes that belong in checkpoints but not in
+    # the jit-able ``state()`` pytree — e.g. a lazily-inferred input mode.
+    # Subclasses extend; values must be None or plain str/int/float/bool.
+    _aux_attributes: Tuple[str, ...] = ()
 
     def __init__(
         self,
@@ -629,6 +634,10 @@ class Metric(ABC):
                 destination[prefix + key] = [np.asarray(v) for v in current]
             else:
                 destination[prefix + key] = np.asarray(current)
+        for name in self._aux_attributes:
+            value = getattr(self, name, None)
+            if value is not None:
+                destination[f"{prefix}aux:{name}"] = value.value if isinstance(value, Enum) else value
         for name, child in self._children():
             child.state_dict(destination, prefix=f"{prefix}{name}.")
         return destination
@@ -646,6 +655,10 @@ class Metric(ABC):
                 self._update_count = max(self._update_count, 1)
             elif strict and self._persistent[key]:
                 raise KeyError(f"Missing key {name!r} in state_dict")
+        for name in self._aux_attributes:
+            key = f"{prefix}aux:{name}"
+            if key in state_dict:
+                setattr(self, name, state_dict[key])
         for name, child in self._children():
             child.load_state_dict(state_dict, prefix=f"{prefix}{name}.", strict=strict)
 

@@ -1,0 +1,110 @@
+"""Checkpoint/resume via orbax — metric state is a plain pytree.
+
+Counterpart of the reference's nn.Module state_dict persistence
+(tests/bases/test_metric.py state_dict round-trip + test_ddp.py
+test_state_dict_is_synced); here the same guarantee is shown through
+orbax, the TPU-native checkpoint library (SURVEY.md §5.4).
+"""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import Accuracy, MeanMetric, MetricCollection
+
+
+def _ckpt(tmp_path, name, tree):
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(tmp_path, name)
+    ocp.PyTreeCheckpointer().save(path, tree)
+    return ocp.PyTreeCheckpointer().restore(path)
+
+
+def test_metric_state_dict_orbax_roundtrip(tmp_path):
+    """state_dict carries aux attributes (Accuracy's lazily-inferred mode)."""
+    metric = Accuracy(num_classes=3, average="macro")
+    metric.persistent(True)  # states default to persistent=False like the reference
+    preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6]])
+    target = jnp.asarray([0, 1, 0])
+    metric.update(preds, target)
+
+    restored = _ckpt(tmp_path, "acc", metric.state_dict())
+
+    resumed = Accuracy(num_classes=3, average="macro")
+    resumed.load_state_dict(restored)
+    np.testing.assert_allclose(np.asarray(resumed.compute()), np.asarray(metric.compute()), atol=1e-7)
+
+    # resume must keep accumulating, not just reproduce the value
+    resumed.update(preds, target)
+    metric.update(preds, target)
+    np.testing.assert_allclose(np.asarray(resumed.compute()), np.asarray(metric.compute()), atol=1e-7)
+
+
+def test_collection_orbax_roundtrip(tmp_path):
+    mc = MetricCollection({"acc": Accuracy(num_classes=3), "loss": MeanMetric()})
+    mc.persistent(True)
+    preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]])
+    target = jnp.asarray([0, 1])
+    mc["acc"].update(preds, target)
+    mc["loss"].update(jnp.asarray(0.5))
+
+    restored = _ckpt(tmp_path, "collection", mc.state_dict())
+
+    mc2 = MetricCollection({"acc": Accuracy(num_classes=3), "loss": MeanMetric()})
+    mc2.load_state_dict(restored)
+
+    a, b = mc.compute(), mc2.compute()
+    for key in a:
+        np.testing.assert_allclose(np.asarray(a[key]), np.asarray(b[key]), atol=1e-7)
+
+
+def test_subset_accuracy_flag_resumes(tmp_path):
+    """update() may flip subset_accuracy off; the flag must ride the checkpoint."""
+    m = Accuracy(num_classes=3, subset_accuracy=True)
+    m.persistent(True)
+    preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6]])
+    target = jnp.asarray([0, 1, 0])
+    m.update(preds, target)  # multiclass input -> subset_accuracy auto-disabled
+
+    restored = _ckpt(tmp_path, "subset", m.state_dict())
+    m2 = Accuracy(num_classes=3, subset_accuracy=True)
+    m2.load_state_dict(restored)
+    assert m2.subset_accuracy == m.subset_accuracy
+    np.testing.assert_allclose(np.asarray(m2.compute()), np.asarray(m.compute()), atol=1e-7)
+
+
+def test_curve_metrics_mode_resumes(tmp_path):
+    """AUROC / PR-curve / AveragePrecision infer mode/num_classes lazily in
+    update; compute() after a state_dict resume must not raise."""
+    from metrics_tpu import AUROC, AveragePrecision, PrecisionRecallCurve, ROC
+
+    preds = jnp.asarray([0.1, 0.8, 0.4, 0.6])
+    target = jnp.asarray([0, 1, 1, 0])
+    for i, cls in enumerate((AUROC, AveragePrecision, PrecisionRecallCurve, ROC)):
+        m = cls()
+        m.persistent(True)
+        m.update(preds, target)
+        restored = _ckpt(tmp_path, f"curve{i}", m.state_dict())
+        m2 = cls()
+        m2.load_state_dict(restored)
+        a, b = m.compute(), m2.compute()
+        for x, y in zip(jnp.asarray(a).ravel() if not isinstance(a, (tuple, list)) else np.concatenate([np.ravel(v) for v in a]),
+                        jnp.asarray(b).ravel() if not isinstance(b, (tuple, list)) else np.concatenate([np.ravel(v) for v in b])):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-7)
+
+
+def test_list_state_orbax_roundtrip(tmp_path):
+    """Appendable (cat) states serialize as a list-of-arrays pytree."""
+    from metrics_tpu import PrecisionRecallCurve
+
+    pr = PrecisionRecallCurve(num_classes=1)
+    pr.update(jnp.asarray([0.1, 0.8, 0.4]), jnp.asarray([0, 1, 1]))
+    pr.update(jnp.asarray([0.6, 0.3]), jnp.asarray([1, 0]))
+
+    restored = _ckpt(tmp_path, "pr", pr.state())
+    pr2 = PrecisionRecallCurve(num_classes=1)
+    pr2._load_state(restored)
+
+    for ours, theirs in zip(pr.compute(), pr2.compute()):
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(theirs), atol=1e-7)
