@@ -26,6 +26,7 @@ design is re-thought for JAX/XLA rather than translated:
 """
 import functools
 import inspect
+import operator
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
@@ -685,106 +686,106 @@ class Metric(ABC):
 
     # metric arithmetic (ref metric.py:616-719)
     def __add__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.add, self, other)
+        return CompositionalMetric(operator.add, self, other)
 
     def __radd__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.add, other, self)
+        return CompositionalMetric(operator.add, other, self)
 
     def __sub__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.subtract, self, other)
+        return CompositionalMetric(operator.sub, self, other)
 
     def __rsub__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.subtract, other, self)
+        return CompositionalMetric(operator.sub, other, self)
 
     def __mul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.multiply, self, other)
+        return CompositionalMetric(operator.mul, self, other)
 
     def __rmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.multiply, other, self)
+        return CompositionalMetric(operator.mul, other, self)
 
     def __truediv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.true_divide, self, other)
+        return CompositionalMetric(operator.truediv, self, other)
 
     def __rtruediv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.true_divide, other, self)
+        return CompositionalMetric(operator.truediv, other, self)
 
     def __floordiv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.floor_divide, self, other)
+        return CompositionalMetric(operator.floordiv, self, other)
 
     def __rfloordiv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.floor_divide, other, self)
+        return CompositionalMetric(operator.floordiv, other, self)
 
     def __mod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, self, other)
+        return CompositionalMetric(operator.mod, self, other)
 
     def __rmod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, other, self)
+        return CompositionalMetric(operator.mod, other, self)
 
     def __pow__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.power, self, other)
+        return CompositionalMetric(operator.pow, self, other)
 
     def __rpow__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.power, other, self)
+        return CompositionalMetric(operator.pow, other, self)
 
     def __matmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.matmul, self, other)
+        return CompositionalMetric(operator.matmul, self, other)
 
     def __rmatmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.matmul, other, self)
+        return CompositionalMetric(operator.matmul, other, self)
 
     def __and__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_and, self, other)
+        return CompositionalMetric(operator.and_, self, other)
 
     def __rand__(self, other: Any) -> "CompositionalMetric":
         # swap the order to preserve the reference's quirk (ref metric.py:691)
-        return CompositionalMetric(jnp.bitwise_and, self, other)
+        return CompositionalMetric(operator.and_, self, other)
 
     def __or__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_or, self, other)
+        return CompositionalMetric(operator.or_, self, other)
 
     def __ror__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_or, self, other)
+        return CompositionalMetric(operator.or_, self, other)
 
     def __xor__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_xor, self, other)
+        return CompositionalMetric(operator.xor, self, other)
 
     def __rxor__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_xor, self, other)
+        return CompositionalMetric(operator.xor, self, other)
 
     def __lt__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.less, self, other)
+        return CompositionalMetric(operator.lt, self, other)
 
     def __le__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.less_equal, self, other)
+        return CompositionalMetric(operator.le, self, other)
 
     def __gt__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.greater, self, other)
+        return CompositionalMetric(operator.gt, self, other)
 
     def __ge__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.greater_equal, self, other)
+        return CompositionalMetric(operator.ge, self, other)
 
     def __eq__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
-        return CompositionalMetric(jnp.equal, self, other)
+        return CompositionalMetric(operator.eq, self, other)
 
     def __ne__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
-        return CompositionalMetric(jnp.not_equal, self, other)
+        return CompositionalMetric(operator.ne, self, other)
 
     def __abs__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.abs, self, None)
+        return CompositionalMetric(operator.abs, self, None)
 
     def __neg__(self) -> "CompositionalMetric":
         return CompositionalMetric(_neg, self, None)
 
     def __pos__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.abs, self, None)
+        return CompositionalMetric(operator.abs, self, None)
 
     def __inv__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_not, self, None)
+        return CompositionalMetric(operator.inv, self, None)
 
     __invert__ = __inv__
 
     def __getitem__(self, idx: Any) -> "CompositionalMetric":
-        return CompositionalMetric(lambda x: x[idx], self, None)
+        return CompositionalMetric(functools.partial(_getitem, idx=idx), self, None)
 
     def __getnewargs__(self):
         return tuple()
@@ -792,6 +793,10 @@ class Metric(ABC):
 
 def _neg(x: Array) -> Array:
     return -jnp.abs(x)
+
+
+def _getitem(x: Array, idx: Any) -> Array:
+    return x[idx]
 
 
 class CompositionalMetric(Metric):

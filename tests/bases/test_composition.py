@@ -102,3 +102,91 @@ def test_nested_composition():
     comp = ((a + 1) * 2) - 1
     a.update(jnp.asarray(3.0))
     assert np.asarray(comp.compute()) == 7.0
+
+
+# ---- systematic operator matrix (ref test_composition.py parametrizes every
+# dunder against scalar / Array / Metric operands, both directions) ----
+
+_OPS_ARITH = [
+    ("add", lambda a, b: a + b, lambda a, b: a + b),
+    ("radd", lambda a, b: b + a, lambda a, b: b + a),
+    ("sub", lambda a, b: a - b, lambda a, b: a - b),
+    ("rsub", lambda a, b: b - a, lambda a, b: b - a),
+    ("mul", lambda a, b: a * b, lambda a, b: a * b),
+    ("rmul", lambda a, b: b * a, lambda a, b: b * a),
+    ("truediv", lambda a, b: a / b, lambda a, b: a / b),
+    ("rtruediv", lambda a, b: b / a, lambda a, b: b / a),
+    ("floordiv", lambda a, b: a // b, lambda a, b: a // b),
+    ("rfloordiv", lambda a, b: b // a, lambda a, b: b // a),
+    ("mod", lambda a, b: a % b, lambda a, b: a % b),
+    ("rmod", lambda a, b: b % a, lambda a, b: b % a),
+    ("pow", lambda a, b: a**b, lambda a, b: a**b),
+    ("rpow", lambda a, b: b**a, lambda a, b: b**a),
+]
+
+
+@pytest.mark.parametrize("name,metric_op,ref_op", _OPS_ARITH, ids=[o[0] for o in _OPS_ARITH])
+@pytest.mark.parametrize("operand", [3.0, jnp.asarray(3.0)], ids=["scalar", "array"])
+def test_operator_matrix_scalar_operands(name, metric_op, ref_op, operand):
+    metric = DummyMetricSum()
+    comp = metric_op(metric, operand)
+    assert isinstance(comp, CompositionalMetric)
+    metric.update(jnp.asarray(5.0))
+    np.testing.assert_allclose(np.asarray(comp.compute()), ref_op(5.0, 3.0), atol=1e-6)
+
+
+@pytest.mark.parametrize("name,metric_op,ref_op", _OPS_ARITH[:8], ids=[o[0] for o in _OPS_ARITH[:8]])
+def test_operator_matrix_metric_operands(name, metric_op, ref_op):
+    a, b = DummyMetricSum(), DummyMetricSum()
+    comp = metric_op(a, b)
+    a.update(jnp.asarray(6.0))
+    b.update(jnp.asarray(2.0))
+    np.testing.assert_allclose(np.asarray(comp.compute()), ref_op(6.0, 2.0), atol=1e-6)
+
+
+def test_bitwise_ops():
+    class IntSum(DummyMetricSum):
+        def __init__(self):
+            super().__init__()
+            self.x = jnp.asarray(0, dtype=jnp.int32)  # bitwise needs int state
+
+    a = IntSum()
+    a.update(jnp.asarray(6))  # 0b110
+    assert int((a & 3).compute()) == 2
+    assert int((a | 3).compute()) == 7
+    assert int((a ^ 3).compute()) == 5
+    assert int((3 & a).compute()) == 2
+    assert int((3 | a).compute()) == 7
+    assert int((3 ^ a).compute()) == 5
+
+
+def test_matmul_composition():
+    a = DummyMetricSum()
+    a.update(jnp.asarray([1.0, 2.0, 3.0]))
+    out = (a @ jnp.asarray([1.0, 1.0, 1.0])).compute()
+    np.testing.assert_allclose(np.asarray(out), 6.0, atol=1e-6)
+
+
+def test_composition_kwarg_routing():
+    """_filter_kwargs routes update kwargs to the matching operand metric."""
+    from metrics_tpu import MeanMetric
+
+    class KwargMetric(MeanMetric):
+        def update(self, special_value):  # noqa: D102
+            super().update(special_value)
+
+    a = KwargMetric()
+    b = MeanMetric()
+    comp = a + b
+    comp.update(special_value=jnp.asarray(2.0), value=jnp.asarray(4.0))
+    np.testing.assert_allclose(np.asarray(comp.compute()), 6.0, atol=1e-6)
+
+
+def test_composition_persists_through_pickle():
+    import pickle
+
+    a = DummyMetricSum()
+    comp = a * 2
+    a.update(jnp.asarray(4.0))
+    restored = pickle.loads(pickle.dumps(comp))
+    np.testing.assert_allclose(np.asarray(restored.compute()), 8.0, atol=1e-6)
