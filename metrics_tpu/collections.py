@@ -160,6 +160,39 @@ class MetricCollection:
         for _, m in self.items(keep_base=True):
             m.reset()
 
+    # ------------------------------------------------------------- pure API
+    # Fused pure reducers over every member metric. One jitted call updates
+    # the whole collection; XLA's common-subexpression elimination dedups
+    # shared work (e.g. the input-format pass shared by Accuracy/F1) inside
+    # the single compiled program — the compiler-native counterpart of the
+    # host-side compute groups above.
+    def state(self) -> Dict[str, Dict[str, Any]]:
+        """Per-metric state pytree ``{name: metric_state}``."""
+        self._compute_groups_create_state_ref()  # non-leader states may be stale
+        return {name: m.state() for name, m in self.items(keep_base=True)}
+
+    def pure_update(self, states: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
+        """Pure fused reducer: next state for every metric (kwargs routed per metric)."""
+        return {
+            name: m.pure_update(states[name], *args, **m._filter_kwargs(**kwargs))
+            for name, m in self.items(keep_base=True)
+        }
+
+    def pure_compute(self, states: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+        """Values for every metric from a state pytree (prefix/postfix applied)."""
+        res = _flatten_dict({name: m.pure_compute(states[name]) for name, m in self.items(keep_base=True)})
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def pure_sync(self, states: Dict[str, Dict[str, Any]], axis_name: str) -> Dict[str, Dict[str, Any]]:
+        """Cross-device sync of every metric's state over a mesh axis."""
+        return {name: m.pure_sync(states[name], axis_name) for name, m in self.items(keep_base=True)}
+
+    def load_pure_state(self, states: Dict[str, Dict[str, Any]]) -> None:
+        """Adopt a state pytree produced by the pure API into the stateful shell."""
+        for name, m in self.items(keep_base=True):
+            m._load_state(states[name])
+            m._update_count = max(m._update_count, 1)
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         mc = deepcopy(self)
         if prefix:

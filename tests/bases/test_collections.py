@@ -173,3 +173,97 @@ def test_multioutput_flattened():
     mc.update(jnp.asarray(2.0))
     out = mc.compute()
     assert "multi" in out
+
+
+# ---- fused pure API ----
+
+def _pure_suite():
+    from metrics_tpu import Accuracy, ConfusionMatrix, F1Score
+
+    return MetricCollection(
+        {"acc": Accuracy(num_classes=3), "f1": F1Score(num_classes=3, average="macro"),
+         "cm": ConfusionMatrix(num_classes=3)},
+        compute_groups=False,
+    )
+
+
+def test_collection_pure_update_matches_stateful():
+    import jax
+
+    preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+    target = jnp.asarray([0, 1, 2, 2])
+
+    stateful = _pure_suite()
+    stateful.update(preds, target)
+    stateful.update(preds, target)
+
+    pure = _pure_suite()
+    step = jax.jit(pure.pure_update)
+    states = pure.state()
+    states = step(states, preds, target)
+    states = step(states, preds, target)
+
+    a, b = stateful.compute(), pure.pure_compute(states)
+    assert a.keys() == b.keys()
+    for key in a:
+        np.testing.assert_allclose(np.asarray(a[key]), np.asarray(b[key]), atol=1e-6)
+
+
+def test_collection_pure_sync_over_mesh():
+    import jax
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n = len(jax.devices())
+    preds = jnp.asarray(np.tile([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]], (n, 1)))
+    target = jnp.asarray(np.tile([0, 1], n))
+
+    suite = _pure_suite()
+    states = suite.state()
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def worker(states, p, t):
+        return suite.pure_sync(suite.pure_update(states, p, t), "dp")
+
+    specs = jax.tree_util.tree_map(lambda _: P(), states)
+    step = jax.jit(shard_map(worker, mesh=mesh, in_specs=(specs, P("dp"), P("dp")),
+                             out_specs=specs, check_vma=False))
+    synced = step(states, preds, target)
+
+    # synced result over n shards == single-device update on the full batch
+    single = _pure_suite()
+    single.update(preds, target)
+    a, b = single.compute(), suite.pure_compute(synced)
+    for key in a:
+        np.testing.assert_allclose(np.asarray(a[key]), np.asarray(b[key]), atol=1e-5)
+
+
+def test_collection_load_pure_state():
+    preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]])
+    target = jnp.asarray([0, 1])
+
+    pure = _pure_suite()
+    states = pure.pure_update(pure.state(), preds, target)
+    pure.load_pure_state(states)
+
+    stateful = _pure_suite()
+    stateful.update(preds, target)
+    a, b = stateful.compute(), pure.compute()
+    for key in a:
+        np.testing.assert_allclose(np.asarray(a[key]), np.asarray(b[key]), atol=1e-6)
+
+
+def test_state_syncs_compute_group_members():
+    """state() must copy leader state to group members before export."""
+    from metrics_tpu import Accuracy, F1Score
+
+    preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]])
+    target = jnp.asarray([0, 1])
+    mc = MetricCollection([Accuracy(num_classes=3, average="macro"),
+                           F1Score(num_classes=3, average="macro")])  # groups on
+    mc.update(preds, target)   # groups merge here
+    mc.update(preds, target)   # only the leader updates
+    states = mc.state()
+    np.testing.assert_allclose(np.asarray(states["Accuracy"]["tp"]),
+                               np.asarray(states["F1Score"]["tp"]), atol=0)
+    assert int(np.asarray(states["F1Score"]["tp"]).sum()) == 4  # both batches
