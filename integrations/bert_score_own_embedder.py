@@ -1,0 +1,41 @@
+"""BERTScore with your own embedding model — counterpart of
+tm_examples/bert_score-own_model.py.
+
+The reference plugs a custom torch model + tokenizer into BERTScore; here
+any callable ``sentences -> (embeddings, mask, ids)`` works. This demo
+uses a deterministic hash one-hot embedder (no weights needed); swap in
+``transformers_flax_embedder("roberta-large")`` for a real model from a
+local HF cache. Run: ``python integrations/bert_score_own_embedder.py``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.text import BERTScore
+
+_VOCAB: dict = {}
+
+
+def hash_embedder(sentences):
+    """Tokenize on whitespace, embed as one-hot of a growing vocab."""
+    max_len = max(len(s.split()) for s in sentences)
+    ids = []
+    for sentence in sentences:
+        row = [_VOCAB.setdefault(word, len(_VOCAB) + 1) for word in sentence.split()]
+        ids.append(row + [0] * (max_len - len(row)))
+    ids = jnp.asarray(ids)
+    return jax.nn.one_hot(ids, 4096), (ids > 0).astype(jnp.int32), ids
+
+
+def main() -> None:
+    preds = ["the quick brown fox jumps over the lazy dog", "hello there world"]
+    target = ["a quick brown fox jumped over a lazy dog", "hello world"]
+
+    score = BERTScore(embedder=hash_embedder, idf=False)
+    score.update(preds, target)
+    result = score.compute()
+    for key in ("precision", "recall", "f1"):
+        print(key, [round(float(v), 4) for v in result[key]])
+
+
+if __name__ == "__main__":
+    main()

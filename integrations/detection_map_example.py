@@ -1,0 +1,40 @@
+"""COCO mAP end to end — counterpart of tm_examples/detection_map.py.
+
+Two images with detections and groundtruths; prints the 12-entry COCO
+result dict. Run: ``python integrations/detection_map_example.py``.
+"""
+import jax.numpy as jnp
+
+from metrics_tpu.detection import MeanAveragePrecision
+
+
+def main() -> None:
+    metric = MeanAveragePrecision(box_format="xyxy", class_metrics=False)
+
+    preds = [
+        dict(  # image 1: two detections, one good, one off-class
+            boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0], [300.0, 100.0, 400.0, 200.0]]),
+            scores=jnp.asarray([0.536, 0.41]),
+            labels=jnp.asarray([0, 1]),
+        ),
+        dict(  # image 2: one detection, slightly shifted
+            boxes=jnp.asarray([[61.0, 22.8, 565.0, 632.6]]),
+            scores=jnp.asarray([0.9]),
+            labels=jnp.asarray([3]),
+        ),
+    ]
+    target = [
+        dict(boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.asarray([0])),
+        dict(boxes=jnp.asarray([[13.0, 22.8, 522.0, 632.6]]), labels=jnp.asarray([3])),
+    ]
+
+    metric.update(preds, target)
+    for key, value in metric.compute().items():
+        if value.ndim == 0:
+            print(f"{key}: {float(value):.4f}")
+        else:  # per-class entries are vectors
+            print(f"{key}: {[round(float(v), 4) for v in value]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""ROUGE with a custom normalizer and tokenizer — counterpart of
+tm_examples/rouge_score-own_normalizer_and_tokenizer.py.
+
+Run: ``python integrations/rouge_custom_tokenizer.py``.
+"""
+import re
+
+from metrics_tpu.text import ROUGEScore
+
+
+def lowercase_alnum_normalizer(text: str) -> str:
+    """Keep alphanumerics and spaces only, lowercased."""
+    return re.sub(r"[^a-z0-9 ]", "", text.lower())
+
+
+def whitespace_tokenizer(text: str):
+    return text.split()
+
+
+def main() -> None:
+    rouge = ROUGEScore(
+        normalizer=lowercase_alnum_normalizer,
+        tokenizer=whitespace_tokenizer,
+        rouge_keys=("rouge1", "rouge2", "rougeL"),
+    )
+    rouge.update(
+        ["Is your name John?!"],
+        [["Is your name John or Paul?"]],
+    )
+    for key, value in rouge.compute().items():
+        print(f"{key}: {float(value):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -192,6 +192,8 @@ class MetricCollection:
         for name, m in self.items(keep_base=True):
             m._load_state(states[name])
             m._update_count = max(m._update_count, 1)
+            m._computed = None  # drop the memoized compute of the old state
+            m._forward_cache = None
 
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         mc = deepcopy(self)
@@ -206,6 +208,7 @@ class MetricCollection:
             m.persistent(mode)
 
     def state_dict(self, prefix: str = "") -> Dict[str, Any]:
+        self._compute_groups_create_state_ref()  # non-leader states may be stale
         destination: Dict[str, Any] = {}
         for name, m in self.items(keep_base=True):
             m.state_dict(destination, prefix=f"{prefix}{name}.")

@@ -267,3 +267,24 @@ def test_state_syncs_compute_group_members():
     np.testing.assert_allclose(np.asarray(states["Accuracy"]["tp"]),
                                np.asarray(states["F1Score"]["tp"]), atol=0)
     assert int(np.asarray(states["F1Score"]["tp"]).sum()) == 4  # both batches
+
+
+def test_state_dict_syncs_compute_group_members():
+    """state_dict() must also copy leader state to members (checkpoint path)."""
+    from metrics_tpu import Accuracy, F1Score
+
+    preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]])
+    target = jnp.asarray([0, 1])
+    mc = MetricCollection([Accuracy(num_classes=3, average="macro"),
+                           F1Score(num_classes=3, average="macro")])
+    mc.persistent(True)
+    mc.update(preds, target)
+    mc.update(preds, target)  # leader-only update
+    sd = mc.state_dict()
+
+    mc2 = MetricCollection([Accuracy(num_classes=3, average="macro"),
+                            F1Score(num_classes=3, average="macro")])
+    mc2.load_state_dict(sd)
+    a, b = mc.compute(), mc2.compute()
+    for key in a:
+        np.testing.assert_allclose(np.asarray(a[key]), np.asarray(b[key]), atol=1e-7)
