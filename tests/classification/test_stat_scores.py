@@ -1,0 +1,204 @@
+"""StatScores module/functional vs sklearn's multilabel_confusion_matrix.
+
+Mirrors /root/reference/tests/classification/test_stat_scores.py: the oracle
+canonicalizes inputs with the framework's own ``_input_format_classification``
+(whose behavior is itself pinned by tests/bases/test_utilities.py) and then
+computes TP/FP/TN/FN with sklearn, covering binary / multilabel / multiclass /
+multidim-multiclass inputs under every reduce / mdmc_reduce combination.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import multilabel_confusion_matrix
+
+from metrics_tpu import StatScores
+from metrics_tpu.functional import stat_scores
+from metrics_tpu.utilities.checks import _input_format_classification
+from tests.classification.inputs import (
+    _binary_inputs,
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multidim_multiclass_inputs,
+    _multidim_multiclass_prob_inputs,
+    _multilabel_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers import seed_all
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+seed_all(42)
+
+
+def _sk_stat_scores(preds, target, reduce, num_classes, multiclass, ignore_index, top_k, threshold, mdmc_reduce=None):
+    """Reference oracle (ref test_stat_scores.py:40-76): canonicalize then sklearn."""
+    preds, target, _ = _input_format_classification(
+        np.asarray(preds), np.asarray(target), threshold=threshold, num_classes=num_classes,
+        multiclass=multiclass, top_k=top_k,
+    )
+    sk_preds, sk_target = np.asarray(preds), np.asarray(target)
+
+    if reduce != "macro" and ignore_index is not None and sk_preds.shape[1] > 1:
+        sk_preds = np.delete(sk_preds, ignore_index, 1)
+        sk_target = np.delete(sk_target, ignore_index, 1)
+
+    n_cols = sk_preds.shape[1]
+    if n_cols == 1 and reduce == "samples":
+        sk_target = sk_target.T
+        sk_preds = sk_preds.T
+
+    sk_stats = multilabel_confusion_matrix(
+        sk_target, sk_preds, samplewise=(reduce == "samples") and n_cols != 1
+    )
+
+    if n_cols == 1 and reduce != "samples":
+        sk_stats = sk_stats[[1]].reshape(-1, 4)[:, [3, 1, 0, 2]]
+    else:
+        sk_stats = sk_stats.reshape(-1, 4)[:, [3, 1, 0, 2]]
+
+    if reduce == "micro":
+        sk_stats = sk_stats.sum(axis=0, keepdims=True)
+
+    sk_stats = np.concatenate([sk_stats, sk_stats[:, [3]] + sk_stats[:, [0]]], 1)
+
+    if reduce == "micro":
+        sk_stats = sk_stats[0]
+
+    if reduce == "macro" and ignore_index is not None and sk_preds.shape[1]:
+        sk_stats[ignore_index, :] = -1
+
+    return sk_stats
+
+
+def _sk_stat_scores_mdmc(preds, target, reduce, mdmc_reduce, num_classes, multiclass, ignore_index, top_k, threshold):
+    """MDMC oracle (ref test_stat_scores.py:79-103)."""
+    preds, target, _ = _input_format_classification(
+        np.asarray(preds), np.asarray(target), threshold=threshold, num_classes=num_classes,
+        multiclass=multiclass, top_k=top_k,
+    )
+    preds, target = np.asarray(preds), np.asarray(target)
+
+    if mdmc_reduce == "global":
+        preds = np.transpose(preds, (0, 2, 1)).reshape(-1, preds.shape[1])
+        target = np.transpose(target, (0, 2, 1)).reshape(-1, target.shape[1])
+        return _sk_stat_scores(preds, target, reduce, None, False, ignore_index, top_k, threshold)
+
+    scores = []
+    for i in range(preds.shape[0]):
+        scores_i = _sk_stat_scores(preds[i].T, target[i].T, reduce, None, False, ignore_index, top_k, threshold)
+        scores.append(np.expand_dims(scores_i, 0))
+    return np.concatenate(scores)
+
+
+@pytest.mark.parametrize(
+    "reduce, mdmc_reduce, num_classes, inputs, ignore_index",
+    [
+        ["unknown", None, None, _binary_inputs, None],
+        ["micro", "unknown", None, _binary_inputs, None],
+        ["macro", None, None, _binary_inputs, None],
+        ["micro", None, None, _multidim_multiclass_prob_inputs, None],
+        ["micro", None, None, _binary_prob_inputs, 0],
+        ["micro", None, None, _multiclass_prob_inputs, NUM_CLASSES],
+        ["micro", None, NUM_CLASSES, _multiclass_prob_inputs, NUM_CLASSES],
+    ],
+)
+def test_wrong_params(reduce, mdmc_reduce, num_classes, inputs, ignore_index):
+    """Invalid parameter combinations raise (ref test_stat_scores.py:105-135)."""
+    with pytest.raises(ValueError):
+        m = StatScores(
+            reduce=reduce, mdmc_reduce=mdmc_reduce, num_classes=num_classes, ignore_index=ignore_index
+        )
+        m.update(np.asarray(inputs.preds[0]), np.asarray(inputs.target[0]))
+
+    with pytest.raises(ValueError):
+        stat_scores(
+            np.asarray(inputs.preds[0]), np.asarray(inputs.target[0]),
+            reduce=reduce, mdmc_reduce=mdmc_reduce, num_classes=num_classes, ignore_index=ignore_index,
+        )
+
+
+@pytest.mark.parametrize("reduce", ["micro", "macro", "samples"])
+@pytest.mark.parametrize(
+    "preds, target, sk_fn, mdmc_reduce, num_classes, multiclass, top_k",
+    [
+        (_binary_prob_inputs.preds, _binary_prob_inputs.target, _sk_stat_scores, None, 1, None, None),
+        (_binary_inputs.preds, _binary_inputs.target, _sk_stat_scores, None, 1, False, None),
+        (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, _sk_stat_scores, None, NUM_CLASSES, None, None),
+        (_multilabel_inputs.preds, _multilabel_inputs.target, _sk_stat_scores, None, NUM_CLASSES, False, None),
+        (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, _sk_stat_scores, None, NUM_CLASSES, None, None),
+        (_multiclass_inputs.preds, _multiclass_inputs.target, _sk_stat_scores, None, NUM_CLASSES, None, None),
+        (
+            _multidim_multiclass_prob_inputs.preds, _multidim_multiclass_prob_inputs.target,
+            _sk_stat_scores_mdmc, "samplewise", NUM_CLASSES, None, None,
+        ),
+        (
+            _multidim_multiclass_inputs.preds, _multidim_multiclass_inputs.target,
+            _sk_stat_scores_mdmc, "samplewise", NUM_CLASSES, None, None,
+        ),
+        (
+            _multidim_multiclass_prob_inputs.preds, _multidim_multiclass_prob_inputs.target,
+            _sk_stat_scores_mdmc, "global", NUM_CLASSES, None, None,
+        ),
+        (
+            _multidim_multiclass_inputs.preds, _multidim_multiclass_inputs.target,
+            _sk_stat_scores_mdmc, "global", NUM_CLASSES, None, None,
+        ),
+        (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, _sk_stat_scores, None, NUM_CLASSES, None, 2),
+    ],
+)
+@pytest.mark.parametrize("ignore_index", [None, 0])
+class TestStatScores(MetricTester):
+    def test_stat_scores_class(
+        self, reduce, preds, target, sk_fn, mdmc_reduce, num_classes, multiclass, top_k, ignore_index
+    ):
+        if ignore_index is not None and np.asarray(preds).ndim == 2:
+            pytest.skip("ignore_index is not valid for binary inputs")
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=StatScores,
+            reference_metric=partial(
+                sk_fn, reduce=reduce, mdmc_reduce=mdmc_reduce, num_classes=num_classes,
+                multiclass=multiclass, ignore_index=ignore_index, top_k=top_k, threshold=0.5,
+            ),
+            metric_args={
+                "num_classes": num_classes, "reduce": reduce, "mdmc_reduce": mdmc_reduce,
+                "threshold": 0.5, "multiclass": multiclass, "ignore_index": ignore_index, "top_k": top_k,
+            },
+        )
+
+    def test_stat_scores_fn(
+        self, reduce, preds, target, sk_fn, mdmc_reduce, num_classes, multiclass, top_k, ignore_index
+    ):
+        if ignore_index is not None and np.asarray(preds).ndim == 2:
+            pytest.skip("ignore_index is not valid for binary inputs")
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=stat_scores,
+            reference_metric=partial(
+                sk_fn, reduce=reduce, mdmc_reduce=mdmc_reduce, num_classes=num_classes,
+                multiclass=multiclass, ignore_index=ignore_index, top_k=top_k, threshold=0.5,
+            ),
+            metric_args={
+                "num_classes": num_classes, "reduce": reduce, "mdmc_reduce": mdmc_reduce,
+                "threshold": 0.5, "multiclass": multiclass, "ignore_index": ignore_index, "top_k": top_k,
+            },
+        )
+
+
+def test_stat_scores_dist():
+    """8-device mesh sync produces the same totals as single-device (macro)."""
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        preds=_multiclass_prob_inputs.preds,
+        target=_multiclass_prob_inputs.target,
+        metric_class=StatScores,
+        reference_metric=partial(
+            _sk_stat_scores, reduce="macro", num_classes=NUM_CLASSES, multiclass=None,
+            ignore_index=None, top_k=None, threshold=0.5,
+        ),
+        dist=True,
+        metric_args={"num_classes": NUM_CLASSES, "reduce": "macro"},
+    )
