@@ -1,0 +1,11 @@
+"""TPU-native fused kernels (Pallas) for hot metric ops.
+
+Every kernel here is bit-exact with the plain XLA formulation that the
+metrics dispatch by default (measured faster — see binned_stats.py module
+docstring for numbers). Set ``METRICS_TPU_FORCE_PALLAS=1`` to opt in to the
+Pallas path on TPU backends; off-TPU the kernels run in interpret mode for
+parity testing.
+"""
+from metrics_tpu.ops.binned_stats import binned_stat_scores, pallas_enabled
+
+__all__ = ["binned_stat_scores", "pallas_enabled"]

@@ -1,0 +1,141 @@
+"""Fused binned TP/FP/FN statistics as a Pallas TPU kernel.
+
+The binned curve metrics (ref binned_precision_recall.py:116-164) accumulate,
+for every class ``c`` and threshold ``t``::
+
+    TP[c, t] = sum_n target[n, c] * (preds[n, c] >= thr[t])
+    FP[c, t] = sum_n (1 - target[n, c]) * (preds[n, c] >= thr[t])
+    FN[c, t] = sum_n target[n, c] * (preds[n, c] <  thr[t])
+
+This kernel tiles the batch dimension and keeps the compare tile plus the
+``(C, T)`` accumulators in VMEM. Only ``TP`` and the per-(c,t)
+prediction-positive count ``P`` are reduced in the kernel; ``FP = P - TP``
+and ``FN = pos_count - TP`` follow from the per-class positive count.
+
+**Measured result (v5 single chip, N=8192 C=64 T=128, 100 amortized reps):**
+XLA's fused broadcast-compare+reduce runs ~390 us/op; this kernel ~600 us/op
+(grid-revisited accumulators lose to XLA's fusion pipeline); a scatter-based
+histogram+suffix-cumsum O(N*C*logT) reformulation runs ~42 ms/op (TPU scatter
+serializes). The XLA formulation is therefore the production default — the
+TPU-first answer here is to let the compiler fuse. The kernel stays available
+via ``METRICS_TPU_FORCE_PALLAS=1`` (or ``force_pallas=True``) and is kept
+bit-exact with the XLA path by tests/classification/test_pallas_binned.py.
+"""
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on builds with mosaic support
+    from jax.experimental.pallas import tpu as pltpu
+except (ImportError, ModuleNotFoundError):  # pragma: no cover
+    pltpu = None
+
+_BN = 128  # batch tile (sublane-friendly)
+
+
+def pallas_enabled() -> bool:
+    """Whether the Pallas path is dispatched by default.
+
+    Off by default: the measured XLA fusion is faster for this op (see module
+    docstring). Set ``METRICS_TPU_FORCE_PALLAS=1`` to opt in on TPU backends.
+    """
+    if pltpu is None:
+        return False
+    return os.environ.get("METRICS_TPU_FORCE_PALLAS", "0") == "1"
+
+
+def _binned_kernel(preds_ref, target_ref, thr_ref, tp_ref, p_ref, pos_ref):
+    """One batch tile: accumulate TP, positive-prediction and positive-target counts."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        tp_ref[:] = jnp.zeros_like(tp_ref)
+        p_ref[:] = jnp.zeros_like(p_ref)
+        pos_ref[:] = jnp.zeros_like(pos_ref)
+
+    preds = preds_ref[:]            # (BN, C) f32
+    tgt = target_ref[:]             # (BN, C) f32 (0/1; padding rows are 0 with preds=-inf)
+    thr = thr_ref[:]                # (1, T) f32
+
+    # (BN, C, T) compare lives only in VMEM/registers for this tile
+    hit = (preds[:, :, None] >= thr[0][None, None, :]).astype(jnp.float32)
+    tp_ref[:] += jnp.sum(tgt[:, :, None] * hit, axis=0)
+    p_ref[:] += jnp.sum(hit, axis=0)
+    pos_ref[:] += jnp.sum(tgt, axis=0, keepdims=True).T
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _binned_stat_scores_pallas(preds, target, thresholds, interpret=False):
+    n, c = preds.shape
+    t = thresholds.shape[0]
+
+    n_pad = (-n) % _BN
+    if n_pad:
+        # padding rows: preds below every threshold, target 0 → contribute nothing
+        preds = jnp.pad(preds, ((0, n_pad), (0, 0)), constant_values=-jnp.inf)
+        target = jnp.pad(target, ((0, n_pad), (0, 0)))
+    grid = (preds.shape[0] // _BN,)
+
+    kernel = pl.pallas_call(
+        _binned_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BN, c), lambda i: (i, 0)),
+            pl.BlockSpec((_BN, c), lambda i: (i, 0)),
+            pl.BlockSpec((1, t), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((c, t), lambda i: (0, 0)),
+            pl.BlockSpec((c, t), lambda i: (0, 0)),
+            pl.BlockSpec((c, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((c, t), jnp.float32),
+            jax.ShapeDtypeStruct((c, t), jnp.float32),
+            jax.ShapeDtypeStruct((c, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )
+    tp, p, pos = kernel(preds.astype(jnp.float32), target.astype(jnp.float32), thresholds.reshape(1, -1).astype(jnp.float32))
+    fp = p - tp
+    fn = pos - tp
+    return tp, fp, fn
+
+
+def _binned_stat_scores_xla(preds, target, thresholds):
+    """Reference XLA path: one broadcast compare + three reductions."""
+    tgt = target[:, :, None]
+    hit = preds[:, :, None] >= thresholds[None, None, :]
+    tp = (tgt & hit).sum(axis=0).astype(jnp.float32)
+    fp = ((~tgt) & hit).sum(axis=0).astype(jnp.float32)
+    fn = (tgt & (~hit)).sum(axis=0).astype(jnp.float32)
+    return tp, fp, fn
+
+
+def binned_stat_scores(preds, target, thresholds, force_pallas=None):
+    """Fused binned TP/FP/FN over ``(N, C)`` scores and ``(T,)`` thresholds.
+
+    ``target`` is canonicalized to ``target == 1`` before either backend runs,
+    so both share one contract for non-binary inputs.
+
+    ``force_pallas``: None → env-gated (``METRICS_TPU_FORCE_PALLAS=1``);
+    True → Pallas (interpret-mode off-TPU, for parity tests); False → plain
+    XLA path. Shapes whose compare tile would exceed VMEM always take XLA.
+    """
+    target = target == 1  # one canonicalization shared by both backends
+    use_pallas = pallas_enabled() if force_pallas is None else force_pallas
+    # compare tile (BN, C, T) f32 + two (C, T) accumulators must fit VMEM;
+    # an empty batch would give Mosaic a zero-size grid — XLA returns zeros
+    if use_pallas and (
+        preds.shape[0] == 0
+        or (_BN + 2) * preds.shape[1] * thresholds.shape[0] * 4 > 12 * 2**20
+    ):
+        use_pallas = False
+    if not use_pallas:
+        return _binned_stat_scores_xla(preds, target, thresholds)
+    interpret = jax.default_backend() != "tpu"
+    return _binned_stat_scores_pallas(preds, target, thresholds, interpret=interpret)
