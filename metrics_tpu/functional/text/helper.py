@@ -2,14 +2,30 @@
 
 Behavioral parity: /root/reference/torchmetrics/functional/text/helper.py
 (_edit_distance :333-350). Host-side string processing — strings never enter
-XLA; only the integer statistics land on device.
+XLA; only the integer statistics land on device. The O(n*m) dynamic program
+runs in the in-repo C++ core (metrics_tpu/native/edit_distance.cpp) when the
+toolchain is available, with this numpy implementation as the fallback.
 """
-from typing import List, Sequence, Union
+from typing import Dict, List, Sequence, Tuple, Union
 
 import numpy as np
 
+from metrics_tpu.native import levenshtein_batch_ids, levenshtein_ids, native_available
 
-def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence) -> int:
+
+def _tokens_to_ids(*seqs: Sequence) -> List[np.ndarray]:
+    """Map token sequences to shared int32 ids (identity-preserving)."""
+    vocab: Dict = {}
+    out = []
+    for seq in seqs:
+        ids = np.empty(len(seq), dtype=np.int32)
+        for i, tok in enumerate(seq):
+            ids[i] = vocab.setdefault(tok, len(vocab))
+        out.append(ids)
+    return out
+
+
+def _edit_distance_py(prediction_tokens: Sequence, reference_tokens: Sequence) -> int:
     """Levenshtein distance between two token sequences (numpy row DP)."""
     n, m = len(prediction_tokens), len(reference_tokens)
     if n == 0:
@@ -29,3 +45,31 @@ def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence) -> i
             cur[j] = min(best[j - 1], cur[j - 1] + 1)
         prev = cur
     return int(prev[m])
+
+
+def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence) -> int:
+    """Levenshtein distance between two token sequences (native when available)."""
+    if native_available():
+        try:
+            a, b = _tokens_to_ids(prediction_tokens, reference_tokens)
+        except TypeError:
+            pass  # unhashable tokens — the ==-based numpy DP still applies
+        else:
+            dist = levenshtein_ids(a, b)
+            if dist is not None:
+                return dist
+    return _edit_distance_py(prediction_tokens, reference_tokens)
+
+
+def _edit_distances(pairs: Sequence[Tuple[Sequence, Sequence]]) -> List[int]:
+    """Edit distances for many pairs — one native call for the whole batch."""
+    if native_available() and pairs:
+        try:
+            seqs = _tokens_to_ids(*(s for pair in pairs for s in pair))
+        except TypeError:
+            pass
+        else:
+            out = levenshtein_batch_ids(seqs[0::2], seqs[1::2])
+            if out is not None:
+                return [int(v) for v in out]
+    return [_edit_distance_py(a, b) for a, b in pairs]

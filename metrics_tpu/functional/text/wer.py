@@ -10,7 +10,7 @@ from typing import List, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _edit_distance
+from metrics_tpu.functional.text.helper import _edit_distances
 
 Array = jax.Array
 
@@ -22,12 +22,9 @@ def _as_list(x: Union[str, List[str]]) -> List[str]:
 def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
     """Edit operations + reference word count (ref wer.py:23-48)."""
     preds, target = _as_list(preds), _as_list(target)
-    errors, total = 0, 0
-    for pred, tgt in zip(preds, target):
-        pred_tokens = pred.split()
-        tgt_tokens = tgt.split()
-        errors += _edit_distance(pred_tokens, tgt_tokens)
-        total += len(tgt_tokens)
+    pairs = [(pred.split(), tgt.split()) for pred, tgt in zip(preds, target)]
+    errors = sum(_edit_distances(pairs))
+    total = sum(len(tgt_tokens) for _, tgt_tokens in pairs)
     return jnp.asarray(float(errors)), jnp.asarray(float(total))
 
 
@@ -52,12 +49,9 @@ def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]])
 def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
     """Char-level edit operations + reference char count (ref cer.py:23-48)."""
     preds, target = _as_list(preds), _as_list(target)
-    errors, total = 0, 0
-    for pred, tgt in zip(preds, target):
-        pred_tokens = pred
-        tgt_tokens = tgt
-        errors += _edit_distance(list(pred_tokens), list(tgt_tokens))
-        total += len(tgt_tokens)
+    pairs = [(list(pred), list(tgt)) for pred, tgt in zip(preds, target)]
+    errors = sum(_edit_distances(pairs))
+    total = sum(len(tgt_tokens) for _, tgt_tokens in pairs)
     return jnp.asarray(float(errors)), jnp.asarray(float(total))
 
 
@@ -82,12 +76,9 @@ def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]])
 def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
     """Edit operations + max(len) count (ref mer.py:23-49)."""
     preds, target = _as_list(preds), _as_list(target)
-    errors, total = 0, 0
-    for pred, tgt in zip(preds, target):
-        pred_tokens = pred.split()
-        tgt_tokens = tgt.split()
-        errors += _edit_distance(pred_tokens, tgt_tokens)
-        total += max(len(tgt_tokens), len(pred_tokens))
+    pairs = [(pred.split(), tgt.split()) for pred, tgt in zip(preds, target)]
+    errors = sum(_edit_distances(pairs))
+    total = sum(max(len(t), len(p)) for p, t in pairs)
     return jnp.asarray(float(errors)), jnp.asarray(float(total))
 
 
@@ -115,14 +106,11 @@ def _wil_update(
     """Returns (errors - total, target_total, preds_total) — the reference's
     state convention where ``total - errors`` is the hit count (ref wil.py:22-53)."""
     preds, target = _as_list(preds), _as_list(target)
-    errors, total, target_total, preds_total = 0, 0, 0, 0
-    for pred, tgt in zip(preds, target):
-        pred_tokens = pred.split()
-        target_tokens = tgt.split()
-        errors += _edit_distance(pred_tokens, target_tokens)
-        target_total += len(target_tokens)
-        preds_total += len(pred_tokens)
-        total += max(len(target_tokens), len(pred_tokens))
+    pairs = [(pred.split(), tgt.split()) for pred, tgt in zip(preds, target)]
+    errors = sum(_edit_distances(pairs))
+    target_total = sum(len(t) for _, t in pairs)
+    preds_total = sum(len(p) for p, _ in pairs)
+    total = sum(max(len(t), len(p)) for p, t in pairs)
     return jnp.asarray(float(errors - total)), jnp.asarray(float(target_total)), jnp.asarray(float(preds_total))
 
 

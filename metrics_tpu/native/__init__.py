@@ -1,0 +1,132 @@
+"""Native (C++) host-side runtime pieces, built lazily with the system g++.
+
+The reference delegates all native performance to third-party wheels (SURVEY
+§2.15: zero in-repo native files); here the text-metric hot loop — the
+Levenshtein dynamic program — is an in-repo C++ core. The shared library is
+compiled on first use into ``_build/`` (one-time, ~1 s, atomic rename so
+concurrent processes race safely) and loaded via ctypes; every entry point
+has a pure-numpy fallback so the package works without a toolchain
+(``METRICS_TPU_DISABLE_NATIVE=1`` forces the fallback).
+"""
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional, Sequence
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "edit_distance.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+
+
+def _lib_path() -> str:
+    """Library name is keyed on the source hash so edits never load stale binaries."""
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()[:12]
+    return os.path.join(_BUILD_DIR, f"libeditdist-{digest}.so")
+
+
+_LIB_PATH = _lib_path()
+
+_lib: Optional[ctypes.CDLL] = None
+_tried_build = False
+
+
+def _compile() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, _LIB_PATH)  # atomic: concurrent builders converge
+        return _LIB_PATH
+    except (subprocess.SubprocessError, OSError, FileNotFoundError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None → use fallbacks."""
+    global _lib, _tried_build
+    if _lib is not None:
+        return _lib
+    if os.environ.get("METRICS_TPU_DISABLE_NATIVE", "0") == "1":
+        return None
+    if not os.path.exists(_LIB_PATH):
+        if _tried_build:
+            return None
+        _tried_build = True
+        if _compile() is None:
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.tm_levenshtein.restype = ctypes.c_int64
+    lib.tm_levenshtein.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+    ]
+    lib.tm_levenshtein_batch.restype = None
+    lib.tm_levenshtein_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+    ]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _as_i32(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x, dtype=np.int32)
+
+
+def levenshtein_ids(a: np.ndarray, b: np.ndarray) -> Optional[int]:
+    """Edit distance between two int id arrays; None if native unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    a = _as_i32(a)
+    b = _as_i32(b)
+    return int(lib.tm_levenshtein(
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(a),
+        b.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(b),
+    ))
+
+
+def levenshtein_batch_ids(
+    a_seqs: Sequence[np.ndarray], b_seqs: Sequence[np.ndarray]
+) -> Optional[np.ndarray]:
+    """Edit distances for N id-sequence pairs in one native call."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(a_seqs)
+    a_off = np.zeros(n + 1, dtype=np.int64)
+    b_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(s) for s in a_seqs], out=a_off[1:])
+    np.cumsum([len(s) for s in b_seqs], out=b_off[1:])
+    a_flat = _as_i32(np.concatenate([np.asarray(s, dtype=np.int32) for s in a_seqs]) if n else np.empty(0))
+    b_flat = _as_i32(np.concatenate([np.asarray(s, dtype=np.int32) for s in b_seqs]) if n else np.empty(0))
+    out = np.empty(n, dtype=np.int64)
+    lib.tm_levenshtein_batch(
+        a_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        a_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        b_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        b_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out

@@ -1,0 +1,56 @@
+// Native edit-distance core for the text metrics.
+//
+// Replaces the host-side Python/numpy dynamic program behind
+// WER/CER/MER/WIL/WIP (ref functional/text/helper.py:333-350 — there a pure
+// Python DP). Tokens are mapped to int32 ids in Python (strings never cross
+// the boundary); the O(n*m) DP runs here over two rolling rows.
+//
+// Built lazily by metrics_tpu/native/__init__.py with:
+//   g++ -O3 -shared -fPIC -o _build/libeditdist.so edit_distance.cpp
+// and loaded via ctypes. No Python.h dependency.
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+// Levenshtein distance between id sequences a[0:n) and b[0:m).
+int64_t tm_levenshtein(const int32_t* a, int64_t n, const int32_t* b, int64_t m) {
+    if (n == 0) return m;
+    if (m == 0) return n;
+    // iterate over the shorter sequence in the inner loop for cache locality
+    if (m > n) {
+        std::swap(a, b);
+        std::swap(n, m);
+    }
+    std::vector<int64_t> row(static_cast<size_t>(m) + 1);
+    for (int64_t j = 0; j <= m; ++j) row[static_cast<size_t>(j)] = j;
+    for (int64_t i = 1; i <= n; ++i) {
+        int64_t diag = row[0];  // row[i-1][j-1]
+        row[0] = i;
+        const int32_t ai = a[i - 1];
+        for (int64_t j = 1; j <= m; ++j) {
+            const int64_t up = row[static_cast<size_t>(j)];  // row[i-1][j]
+            const int64_t sub = diag + (ai != b[j - 1] ? 1 : 0);
+            const int64_t del = up + 1;
+            const int64_t ins = row[static_cast<size_t>(j - 1)] + 1;
+            row[static_cast<size_t>(j)] = std::min(sub, std::min(del, ins));
+            diag = up;
+        }
+    }
+    return row[static_cast<size_t>(m)];
+}
+
+// Batched form: sequences are concatenated in a_flat/b_flat with CSR-style
+// offset arrays of length num_pairs+1; distances land in out[0:num_pairs).
+void tm_levenshtein_batch(const int32_t* a_flat, const int64_t* a_offsets,
+                          const int32_t* b_flat, const int64_t* b_offsets,
+                          int64_t num_pairs, int64_t* out) {
+    for (int64_t p = 0; p < num_pairs; ++p) {
+        out[p] = tm_levenshtein(a_flat + a_offsets[p], a_offsets[p + 1] - a_offsets[p],
+                                b_flat + b_offsets[p], b_offsets[p + 1] - b_offsets[p]);
+    }
+}
+
+}  // extern "C"
