@@ -21,20 +21,26 @@ _SRC = os.path.join(os.path.dirname(__file__), "edit_distance.cpp")
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
 
 
-def _lib_path() -> str:
-    """Library name is keyed on the source hash so edits never load stale binaries."""
-    with open(_SRC, "rb") as f:
-        digest = hashlib.sha1(f.read()).hexdigest()[:12]
+def _lib_path() -> Optional[str]:
+    """Library name is keyed on the source hash so edits never load stale binaries.
+
+    None when the .cpp is absent (e.g. an installation that stripped non-Python
+    files) — callers then use the numpy fallback.
+    """
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha1(f.read()).hexdigest()[:12]
+    except OSError:
+        return None
     return os.path.join(_BUILD_DIR, f"libeditdist-{digest}.so")
 
 
-_LIB_PATH = _lib_path()
-
 _lib: Optional[ctypes.CDLL] = None
+_load_failed = False
 _tried_build = False
 
 
-def _compile() -> Optional[str]:
+def _compile(lib_path: str) -> Optional[str]:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
     os.close(fd)
@@ -43,9 +49,9 @@ def _compile() -> Optional[str]:
             ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120,
         )
-        os.replace(tmp, _LIB_PATH)  # atomic: concurrent builders converge
-        return _LIB_PATH
-    except (subprocess.SubprocessError, OSError, FileNotFoundError):
+        os.replace(tmp, lib_path)  # atomic: concurrent builders converge
+        return lib_path
+    except (subprocess.SubprocessError, OSError):
         try:
             os.unlink(tmp)
         except OSError:
@@ -55,20 +61,28 @@ def _compile() -> Optional[str]:
 
 def _load() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native library; None → use fallbacks."""
-    global _lib, _tried_build
+    global _lib, _load_failed, _tried_build
     if _lib is not None:
         return _lib
+    if _load_failed:
+        return None
     if os.environ.get("METRICS_TPU_DISABLE_NATIVE", "0") == "1":
         return None
-    if not os.path.exists(_LIB_PATH):
+    lib_path = _lib_path()
+    if lib_path is None:
+        _load_failed = True
+        return None
+    if not os.path.exists(lib_path):
         if _tried_build:
             return None
         _tried_build = True
-        if _compile() is None:
+        if _compile(lib_path) is None:
+            _load_failed = True
             return None
     try:
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib = ctypes.CDLL(lib_path)
     except OSError:
+        _load_failed = True  # don't re-dlopen a broken library on the hot path
         return None
     lib.tm_levenshtein.restype = ctypes.c_int64
     lib.tm_levenshtein.argtypes = [

@@ -61,8 +61,11 @@ def to_onehot(label_tensor: Array, num_classes: int) -> Array:
     """Convert ``(N, ...)`` integer labels to one-hot ``(N, C, ...)``.
 
     Parity: ref data.py:68-99. ``num_classes`` must be a static Python int
-    (XLA needs the output shape at trace time).
+    (XLA needs the output shape at trace time). Bool labels are accepted like
+    the reference's torch implementation (cast to int before one-hot).
     """
+    if label_tensor.dtype == jnp.bool_:
+        label_tensor = label_tensor.astype(jnp.int32)
     onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
     # one_hot appends the class axis last; the reference layout puts it at dim 1.
     return jnp.moveaxis(onehot, -1, 1)

@@ -57,3 +57,13 @@ _multidim_multiclass_inputs = Input(
     preds=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
     target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
 )
+
+_multilabel_multidim_prob_inputs = Input(
+    preds=np.random.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM).astype(np.float32),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+
+_multilabel_multidim_inputs = Input(
+    preds=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)

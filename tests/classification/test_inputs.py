@@ -1,0 +1,275 @@
+"""Input-format canonicalization matrix.
+
+Port of /root/reference/tests/classification/test_inputs.py (312 LoC): every
+accepted (input layout × num_classes × multiclass × top_k) combination must
+canonicalize to the exact binary int tensors the reference produces, and
+every rejected combination must raise ValueError.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.data import select_topk, to_onehot
+from metrics_tpu.utilities.enums import DataType
+from tests.classification.inputs import (
+    Input,
+    _binary_inputs as _bin,
+    _binary_prob_inputs as _bin_prob,
+    _multiclass_inputs as _mc,
+    _multiclass_prob_inputs as _mc_prob,
+    _multidim_multiclass_inputs as _mdmc,
+    _multidim_multiclass_prob_inputs as _mdmc_prob,
+    _multilabel_inputs as _ml,
+    _multilabel_multidim_inputs as _mlmd,
+    _multilabel_multidim_prob_inputs as _mlmd_prob,
+    _multilabel_prob_inputs as _ml_prob,
+)
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES, THRESHOLD
+
+seed_all(42)
+
+# Additional special-case inputs (ref test_inputs.py:38-55)
+_ml_prob_half = Input(np.asarray(_ml_prob.preds, dtype=np.float16), _ml_prob.target)
+
+_rng = np.random.RandomState(42)
+_mc_prob_2cls_preds = _rng.rand(NUM_BATCHES, BATCH_SIZE, 2).astype(np.float32)
+_mc_prob_2cls_preds /= _mc_prob_2cls_preds.sum(axis=2, keepdims=True)
+_mc_prob_2cls = Input(_mc_prob_2cls_preds, _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+
+_mdmc_prob_many_dims_preds = _rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM, EXTRA_DIM).astype(np.float32)
+_mdmc_prob_many_dims_preds /= _mdmc_prob_many_dims_preds.sum(axis=2, keepdims=True)
+_mdmc_prob_many_dims = Input(
+    _mdmc_prob_many_dims_preds,
+    _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM, EXTRA_DIM)),
+)
+
+_mdmc_prob_2cls_preds = _rng.rand(NUM_BATCHES, BATCH_SIZE, 2, EXTRA_DIM).astype(np.float32)
+_mdmc_prob_2cls_preds /= _mdmc_prob_2cls_preds.sum(axis=2, keepdims=True)
+_mdmc_prob_2cls = Input(_mdmc_prob_2cls_preds, _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)))
+
+
+# Expected-output transformations (ref test_inputs.py:57-118), numpy/jnp forms
+def _idn(x):
+    return jnp.asarray(x)
+
+
+def _usq(x):
+    return jnp.expand_dims(jnp.asarray(x), -1)
+
+
+def _thrs(x):
+    return jnp.asarray(x) >= THRESHOLD
+
+
+def _rshp1(x):
+    x = jnp.asarray(x)
+    return x.reshape(x.shape[0], -1)
+
+
+def _rshp2(x):
+    x = jnp.asarray(x)
+    return x.reshape(x.shape[0], x.shape[1], -1)
+
+
+def _onehot(x):
+    return to_onehot(jnp.asarray(x), NUM_CLASSES)
+
+
+def _onehot2(x):
+    return to_onehot(jnp.asarray(x), 2)
+
+
+def _top1(x):
+    return select_topk(jnp.asarray(x), 1)
+
+
+def _top2(x):
+    return select_topk(jnp.asarray(x), 2)
+
+
+def _ml_preds_tr(x):
+    return _rshp1(_thrs(x))
+
+
+def _onehot_rshp1(x):
+    return _onehot(_rshp1(x))
+
+
+def _onehot2_rshp1(x):
+    return _onehot2(_rshp1(x))
+
+
+def _top1_rshp2(x):
+    return _top1(_rshp2(x))
+
+
+def _top2_rshp2(x):
+    return _top2(_rshp2(x))
+
+
+def _probs_to_mc_preds_tr(x):
+    return _onehot2(_thrs(x))
+
+
+def _mlmd_prob_to_mc_preds_tr(x):
+    return _onehot2(_rshp1(_thrs(x)))
+
+
+@pytest.mark.parametrize(
+    "inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target",
+    [
+        # usual expected cases (ref test_inputs.py:127-147)
+        (_bin, None, False, None, DataType.MULTICLASS, _usq, _usq),
+        (_bin, 1, False, None, DataType.MULTICLASS, _usq, _usq),
+        (_bin_prob, None, None, None, DataType.BINARY, lambda x: _usq(_thrs(x)), _usq),
+        (_ml_prob, None, None, None, DataType.MULTILABEL, _thrs, _idn),
+        (_ml, None, False, None, DataType.MULTIDIM_MULTICLASS, _idn, _idn),
+        (_ml_prob, None, None, None, DataType.MULTILABEL, _ml_preds_tr, _rshp1),
+        (_ml_prob, None, None, 2, DataType.MULTILABEL, _top2, _rshp1),
+        (_mlmd, None, False, None, DataType.MULTIDIM_MULTICLASS, _rshp1, _rshp1),
+        (_mc, NUM_CLASSES, None, None, DataType.MULTICLASS, _onehot, _onehot),
+        (_mc_prob, None, None, None, DataType.MULTICLASS, _top1, _onehot),
+        (_mc_prob, None, None, 2, DataType.MULTICLASS, _top2, _onehot),
+        (_mdmc, NUM_CLASSES, None, None, DataType.MULTIDIM_MULTICLASS, _onehot, _onehot),
+        (_mdmc_prob, None, None, None, DataType.MULTIDIM_MULTICLASS, _top1_rshp2, _onehot),
+        (_mdmc_prob, None, None, 2, DataType.MULTIDIM_MULTICLASS, _top2_rshp2, _onehot),
+        (_mdmc_prob_many_dims, None, None, None, DataType.MULTIDIM_MULTICLASS, _top1_rshp2, _onehot_rshp1),
+        (_mdmc_prob_many_dims, None, None, 2, DataType.MULTIDIM_MULTICLASS, _top2_rshp2, _onehot_rshp1),
+        # special cases (ref test_inputs.py:148-170)
+        (_ml_prob_half, None, None, None, DataType.MULTILABEL, lambda x: _ml_preds_tr(np.asarray(x, np.float32)), _rshp1),
+        (_bin, None, None, None, DataType.MULTICLASS, _onehot2, _onehot2),
+        (_bin_prob, None, True, None, DataType.BINARY, _probs_to_mc_preds_tr, _onehot2),
+        (_ml, None, True, None, DataType.MULTIDIM_MULTICLASS, _onehot2, _onehot2),
+        (_ml_prob, None, True, None, DataType.MULTILABEL, _probs_to_mc_preds_tr, _onehot2),
+        (_mlmd, None, True, None, DataType.MULTIDIM_MULTICLASS, _onehot2_rshp1, _onehot2_rshp1),
+        (_mlmd_prob, None, True, None, DataType.MULTILABEL, _mlmd_prob_to_mc_preds_tr, _onehot2_rshp1),
+        (_mc_prob_2cls, None, False, None, DataType.MULTICLASS, lambda x: _top1(x)[:, [1]], _usq),
+        (_mdmc_prob_2cls, None, False, None, DataType.MULTIDIM_MULTICLASS, lambda x: _top1(x)[:, 1], _idn),
+    ],
+)
+def test_usual_cases(inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target):
+    """Canonical outputs match the reference transformation exactly."""
+    for batch_slice in (slice(None), slice(0, 1)):  # full batch and batch_size=1
+        preds_in = np.asarray(inputs.preds[0])[batch_slice]
+        target_in = np.asarray(inputs.target[0])[batch_slice]
+        preds_out, target_out, mode = _input_format_classification(
+            preds=jnp.asarray(preds_in),
+            target=jnp.asarray(target_in),
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            top_k=top_k,
+        )
+        assert mode == exp_mode
+        np.testing.assert_array_equal(
+            np.asarray(preds_out), np.asarray(post_preds(preds_in), dtype=np.int32).reshape(np.asarray(preds_out).shape)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(target_out), np.asarray(post_target(target_in), dtype=np.int32).reshape(np.asarray(target_out).shape)
+        )
+
+
+def test_threshold():
+    """Scores exactly at the threshold count as positive (ref :205-212)."""
+    target = jnp.asarray([1, 1, 1])
+    preds_probs = jnp.asarray([0.5 - 1e-5, 0.5, 0.5 + 1e-5])
+    preds_probs_out, _, _ = _input_format_classification(preds_probs, target, threshold=0.5)
+    np.testing.assert_array_equal(np.asarray(preds_probs_out).reshape(-1), [0, 1, 1])
+
+
+def _randint(low, high, size):
+    return _rng.randint(low, high, size)
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass",
+    [
+        # Target not integer
+        (_randint(0, 2, (7,)), _randint(0, 2, (7,)).astype(np.float32), None, None),
+        # Target negative
+        (_randint(0, 2, (7,)), -1 - _randint(0, 2, (7,)), None, None),
+        # Preds negative integers
+        (-1 - _randint(0, 2, (7,)), _randint(0, 2, (7,)), None, None),
+        # multiclass=False and target > 1
+        (_rng.rand(7).astype(np.float32), _randint(2, 4, (7,)), None, False),
+        # multiclass=False and preds integers with > 1
+        (_randint(2, 4, (7,)), _randint(0, 2, (7,)), None, False),
+        # Wrong batch size
+        (_randint(0, 2, (8,)), _randint(0, 2, (7,)), None, None),
+        # Completely wrong shape
+        (_randint(0, 2, (7,)), _randint(0, 2, (7, 4)), None, None),
+        # Same #dims, different shape
+        (_randint(0, 2, (7, 3)), _randint(0, 2, (7, 4)), None, None),
+        # Same shape and preds floats, target not binary
+        (_rng.rand(7, 3).astype(np.float32), _randint(2, 4, (7, 3)), None, None),
+        # #dims in preds = 1 + #dims in target, C shape not second or last
+        (_rng.rand(7, 3, 4, 3).astype(np.float32), _randint(0, 4, (7, 3, 3)), None, None),
+        # #dims in preds = 1 + #dims in target, preds not float
+        (_randint(0, 2, (7, 3, 3, 4)), _randint(0, 4, (7, 3, 3)), None, None),
+        # multiclass=False, with C dimension > 2
+        (np.asarray(_mc_prob.preds[0]), _randint(0, 2, (BATCH_SIZE,)), None, False),
+        # Max target larger or equal to C dimension
+        (np.asarray(_mc_prob.preds[0]), _randint(NUM_CLASSES + 1, 100, (BATCH_SIZE,)), None, None),
+        # C dimension not equal to num_classes
+        (np.asarray(_mc_prob.preds[0]), np.asarray(_mc_prob.target[0]), NUM_CLASSES + 1, None),
+        # Max target larger than num_classes (with #dim preds = 1 + #dims target)
+        (np.asarray(_mc_prob.preds[0]), _randint(NUM_CLASSES + 1, 100, (BATCH_SIZE, NUM_CLASSES)), 4, None),
+        # Max target larger than num_classes (with #dim preds = #dims target)
+        (_randint(0, 4, (7, 3)), _randint(5, 7, (7, 3)), 4, None),
+        # Num_classes=1, but multiclass not false
+        (_randint(0, 2, (7,)), _randint(0, 2, (7,)), 1, None),
+        # multiclass=False, but implied class dimension != num_classes
+        (_randint(0, 2, (7, 3, 3)), _randint(0, 2, (7, 3, 3)), 4, False),
+        # Multilabel input with implied class dimension != num_classes
+        (_rng.rand(7, 3, 3).astype(np.float32), _randint(0, 2, (7, 3, 3)), 4, False),
+        # Multilabel input with multiclass=True, but num_classes != 2 (or None)
+        (_rng.rand(7, 3).astype(np.float32), _randint(0, 2, (7, 3)), 4, True),
+        # Binary input, num_classes > 2
+        (_rng.rand(7).astype(np.float32), _randint(0, 2, (7,)), 4, None),
+        # Binary input, num_classes == 2 and multiclass not True
+        (_rng.rand(7).astype(np.float32), _randint(0, 2, (7,)), 2, None),
+        (_rng.rand(7).astype(np.float32), _randint(0, 2, (7,)), 2, False),
+        # Binary input, num_classes == 1 and multiclass=True
+        (_rng.rand(7).astype(np.float32), _randint(0, 2, (7,)), 1, True),
+    ],
+)
+def test_incorrect_inputs(preds, target, num_classes, multiclass):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=jnp.asarray(preds), target=jnp.asarray(target),
+            threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass,
+        )
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass, top_k",
+    [
+        # Topk set with non (md)mc or ml prob data
+        (_bin.preds[0], _bin.target[0], None, None, 2),
+        (_bin_prob.preds[0], _bin_prob.target[0], None, None, 2),
+        (_mc.preds[0], _mc.target[0], None, None, 2),
+        (_ml.preds[0], _ml.target[0], None, None, 2),
+        (_mlmd.preds[0], _mlmd.target[0], None, None, 2),
+        (_mdmc.preds[0], _mdmc.target[0], None, None, 2),
+        # top_k = 0
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, None, 0),
+        # top_k = float
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, None, 0.123),
+        # top_k = 2 with 2 classes, multiclass=False
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, False, 2),
+        # top_k = number of classes (C dimension)
+        (_mc_prob.preds[0], _mc_prob.target[0], None, None, NUM_CLASSES),
+        # multiclass = True for ml prob inputs, top_k set
+        (_ml_prob.preds[0], _ml_prob.target[0], None, True, 2),
+        # top_k = num_classes for ml prob inputs
+        (_ml_prob.preds[0], _ml_prob.target[0], None, True, NUM_CLASSES),
+    ],
+)
+def test_incorrect_inputs_topk(preds, target, num_classes, multiclass, top_k):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=jnp.asarray(np.asarray(preds)), target=jnp.asarray(np.asarray(target)),
+            threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass, top_k=top_k,
+        )
