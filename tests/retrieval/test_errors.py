@@ -1,0 +1,132 @@
+"""Retrieval argument/input error matrix.
+
+Compact port of the reference's error harnesses
+(/root/reference/tests/retrieval/helpers.py:375-427 plus the per-metric
+`_errors_test_*_metric_parameters_*` matrices): every metric class and
+functional must reject malformed indexes/preds/target and bad constructor
+arguments with ValueError.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+
+ALL_CLASSES = [
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+]
+
+BINARY_FUNCTIONALS = [
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+]
+
+_preds = jnp.asarray([0.2, 0.7, 0.4])
+_target = jnp.asarray([0, 1, 0])
+_indexes = jnp.asarray([0, 0, 0])
+
+
+@pytest.mark.parametrize("metric_class", ALL_CLASSES)
+class TestClassErrors:
+    def test_wrong_empty_target_action(self, metric_class):
+        with pytest.raises(ValueError, match="wrong value"):
+            metric_class(empty_target_action="casual_argument")
+
+    def test_wrong_ignore_index(self, metric_class):
+        with pytest.raises(ValueError, match="must be an integer"):
+            metric_class(ignore_index="not-an-int")
+
+    def test_indexes_none(self, metric_class):
+        metric = metric_class()
+        with pytest.raises(ValueError, match="cannot be None"):
+            metric.update(_preds, _target, None)
+
+    def test_mismatched_shapes(self, metric_class):
+        metric = metric_class()
+        with pytest.raises(ValueError, match="same shape"):
+            metric.update(_preds, _target, jnp.asarray([0, 0]))
+
+    def test_float_indexes(self, metric_class):
+        metric = metric_class()
+        with pytest.raises(ValueError, match="integers"):
+            metric.update(_preds, _target, jnp.asarray([0.0, 0.0, 0.0]))
+
+    def test_int_preds(self, metric_class):
+        metric = metric_class()
+        with pytest.raises(ValueError, match="floats"):
+            metric.update(jnp.asarray([1, 2, 3]), _target, _indexes)
+
+    def test_empty_inputs(self, metric_class):
+        metric = metric_class()
+        with pytest.raises(ValueError, match="non-empty"):
+            metric.update(jnp.asarray([]), jnp.asarray([], dtype=jnp.int32), jnp.asarray([], dtype=jnp.int32))
+
+
+@pytest.mark.parametrize(
+    "metric_class", [RetrievalFallOut, RetrievalHitRate, RetrievalPrecision, RetrievalRecall]
+)
+def test_wrong_k(metric_class):
+    for bad_k in (-2, 0, 3.2, "fast"):
+        with pytest.raises(ValueError, match="positive integer"):
+            metric_class(k=bad_k)
+
+
+def test_non_binary_target_rejected_for_binary_metrics():
+    """Binary-relevance metrics must reject graded targets (NDCG accepts them)."""
+    m = RetrievalMAP()
+    with pytest.raises(ValueError, match="binary values"):
+        m.update(_preds, jnp.asarray([0, 2, 4]), _indexes)
+    # NDCG allows non-binary relevance grades
+    ndcg = RetrievalNormalizedDCG()
+    ndcg.update(_preds, jnp.asarray([0, 2, 4]), _indexes)
+    assert float(ndcg.compute()) > 0
+
+
+@pytest.mark.parametrize("fn", BINARY_FUNCTIONALS)
+class TestFunctionalErrors:
+    def test_int_preds(self, fn):
+        with pytest.raises(ValueError, match="floats"):
+            fn(jnp.asarray([1, 2, 3]), _target)
+
+    def test_float_target(self, fn):
+        with pytest.raises(ValueError, match="booleans or integers"):
+            fn(_preds, jnp.asarray([0.0, 1.0, 0.0]))
+
+    def test_non_binary_target(self, fn):
+        with pytest.raises(ValueError, match="binary values"):
+            fn(_preds, jnp.asarray([0, 2, 4]))
+
+
+@pytest.mark.parametrize("fn", [retrieval_fall_out, retrieval_hit_rate, retrieval_precision, retrieval_recall])
+def test_functional_wrong_k(fn):
+    with pytest.raises(ValueError, match="positive integer"):
+        fn(_preds, _target, k=-1)
