@@ -174,49 +174,37 @@ def test_default_env_single_process():
     assert not default_env().is_distributed()
 
 
-class _FakeMultihost:
-    """Simulates N-host process_allgather for ProcessEnv unit tests.
-
-    Each "host" calls all_gather with its own local array; the fake returns
-    the stacked result a real multihost_utils.process_allgather would produce
-    for the set of locals registered for the current exchange step.
-    """
-
-    def __init__(self, locals_per_step):
-        self.locals_per_step = [list(step) for step in locals_per_step]
-        self.rank = 0
-        self.step = 0
-
-    def process_allgather(self, x):
-        step_locals = self.locals_per_step[self.step]
-        self.step += 1
-        # pad to the max leading dim like jax would require equal shapes:
-        # callers (ProcessEnv) guarantee equal shapes per exchange
-        return np.stack([np.asarray(v) for v in step_locals])
-
-
 def test_process_env_uneven_gather(monkeypatch):
-    """ProcessEnv pads to the max leading dim and trims per-rank (ref distributed.py:139-151)."""
+    """ProcessEnv pads to the max leading dim and trims per-rank (ref distributed.py:139-151).
+
+    The calling "host" holds the SHORT rank so the pad branch
+    (dist_env.py:97-99) actually runs on the code under test; the fake
+    captures what the caller hands to the data exchange to assert the pad.
+    """
     from jax.experimental import multihost_utils
 
     from metrics_tpu.parallel import dist_env as de
 
-    rank0 = jnp.asarray([1.0, 2.0, 3.0])          # size 3
-    rank1 = jnp.asarray([4.0])                    # size 1 — uneven
-    padded1 = jnp.pad(rank1, (0, 2))
+    rank0 = jnp.asarray([4.0])                    # caller: size 1 — must be padded
+    rank1 = jnp.asarray([1.0, 2.0, 3.0])          # peer: size 3 (the max)
 
-    fake = _FakeMultihost(
-        locals_per_step=[
-            [np.asarray([3]), np.asarray([1])],   # size exchange
-            [np.asarray(rank0), np.asarray(padded1)],  # padded data exchange
-        ]
-    )
-    monkeypatch.setattr(multihost_utils, "process_allgather", fake.process_allgather)
+    sent = []
+
+    def fake_allgather(x):
+        sent.append(np.asarray(x))
+        if len(sent) == 1:  # size exchange
+            return np.stack([np.asarray([1]), np.asarray([3])])
+        # data exchange: caller's (padded) x plus the peer's max-size data
+        return np.stack([np.asarray(x), np.asarray(rank1)])
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
 
     env = de.ProcessEnv.__new__(de.ProcessEnv)
     env._world = 2
-    out = env.all_gather(rank0)  # this "host" holds rank0's data
+    out = env.all_gather(rank0)
 
+    # the caller padded its local array to the max size before the exchange
+    np.testing.assert_allclose(sent[1], [4.0, 0.0, 0.0])
     assert len(out) == 2
-    np.testing.assert_allclose(np.asarray(out[0]), [1.0, 2.0, 3.0])
-    np.testing.assert_allclose(np.asarray(out[1]), [4.0])  # trimmed back to size 1
+    np.testing.assert_allclose(np.asarray(out[0]), [4.0])  # trimmed back to size 1
+    np.testing.assert_allclose(np.asarray(out[1]), [1.0, 2.0, 3.0])

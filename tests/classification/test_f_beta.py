@@ -106,3 +106,50 @@ def test_f1_binary():
         metric_args={"threshold": THRESHOLD},
         atol=1e-5,
     )
+
+
+@pytest.mark.parametrize("beta", [0.5, 1.0, 2.0])
+def test_fbeta_average_none(beta):
+    """Per-class F-beta vs sklearn average=None."""
+    def _sk(p, t):
+        p, t = np.asarray(p), np.asarray(t)
+        preds = np.argmax(p, axis=1).reshape(-1)
+        return sk_fbeta_score(
+            t.reshape(-1), preds, beta=beta, average=None, labels=list(range(NUM_CLASSES)), zero_division=0
+        )
+
+    MetricTester().run_class_metric_test(
+        preds=_multiclass_prob_inputs.preds,
+        target=_multiclass_prob_inputs.target,
+        metric_class=FBetaScore,
+        reference_metric=_sk,
+        metric_args={"average": "none", "num_classes": NUM_CLASSES, "beta": beta},
+        atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
+def test_f1_mdmc(mdmc_average):
+    from tests.classification.inputs import _multidim_multiclass_prob_inputs as _mdmc_prob
+
+    def _sk(p, t):
+        p, t = np.asarray(p), np.asarray(t)
+        preds = np.argmax(p, axis=1)
+        if mdmc_average == "global":
+            return sk_f1_score(
+                t.reshape(-1), preds.reshape(-1), average="macro", labels=list(range(NUM_CLASSES)), zero_division=0
+            )
+        vals = [
+            sk_f1_score(t[i], preds[i], average="macro", labels=list(range(NUM_CLASSES)), zero_division=0)
+            for i in range(p.shape[0])
+        ]
+        return np.mean(vals)
+
+    MetricTester().run_class_metric_test(
+        preds=_mdmc_prob.preds,
+        target=_mdmc_prob.target,
+        metric_class=F1Score,
+        reference_metric=_sk,
+        metric_args={"average": "macro", "num_classes": NUM_CLASSES, "mdmc_average": mdmc_average},
+        atol=1e-5,
+    )

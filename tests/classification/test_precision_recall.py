@@ -108,3 +108,125 @@ def test_binary_precision():
         metric_args={"threshold": THRESHOLD},
         atol=1e-5,
     )
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn, sk_fn",
+    [(Precision, precision, sk_precision_score), (Recall, recall, sk_recall_score)],
+)
+class TestExtendedAverages:
+    """average=None (per-class) and average='samples' (ref test file rows)."""
+
+    def test_average_none_multiclass(self, metric_class, metric_fn, sk_fn):
+        def _sk(p, t):
+            preds, target = _canon(p, t)
+            return sk_fn(target, preds, average=None, labels=list(range(NUM_CLASSES)), zero_division=0)
+
+        args = {"average": "none", "num_classes": NUM_CLASSES}
+        MetricTester().run_class_metric_test(
+            preds=_multiclass_prob_inputs.preds,
+            target=_multiclass_prob_inputs.target,
+            metric_class=metric_class,
+            reference_metric=_sk,
+            metric_args=args,
+            atol=1e-5,
+        )
+        MetricTester().run_functional_metric_test(
+            _multiclass_prob_inputs.preds,
+            _multiclass_prob_inputs.target,
+            metric_functional=metric_fn,
+            reference_metric=_sk,
+            metric_args=args,
+            atol=1e-5,
+        )
+
+    def test_average_samples_multilabel(self, metric_class, metric_fn, sk_fn):
+        def _sk(p, t):
+            pb = (np.asarray(p) >= THRESHOLD).astype(int).reshape(-1, np.asarray(p).shape[-1])
+            tb = np.asarray(t).reshape(-1, np.asarray(t).shape[-1])
+            return sk_fn(tb, pb, average="samples", zero_division=0)
+
+        args = {"average": "samples", "num_classes": NUM_CLASSES, "multiclass": False}
+        MetricTester().run_class_metric_test(
+            preds=_multilabel_prob_inputs.preds,
+            target=_multilabel_prob_inputs.target,
+            metric_class=metric_class,
+            reference_metric=_sk,
+            metric_args=args,
+            atol=1e-5,
+        )
+        MetricTester().run_functional_metric_test(
+            _multilabel_prob_inputs.preds,
+            _multilabel_prob_inputs.target,
+            metric_functional=metric_fn,
+            reference_metric=_sk,
+            metric_args=args,
+            atol=1e-5,
+        )
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn, sk_fn",
+    [(Precision, precision, sk_precision_score), (Recall, recall, sk_recall_score)],
+)
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+class TestMdmcAverages:
+    """Multidim-multiclass reductions vs per-sample / flattened sklearn oracles."""
+
+    def test_mdmc_global(self, metric_class, metric_fn, sk_fn, average):
+        from tests.classification.inputs import _multidim_multiclass_prob_inputs as _mdmc_prob
+
+        def _sk(p, t):
+            p = np.asarray(p)  # (N, C, X) probs
+            preds = np.argmax(p, axis=1).reshape(-1)
+            target = np.asarray(t).reshape(-1)
+            return sk_fn(target, preds, average=average, labels=list(range(NUM_CLASSES)), zero_division=0)
+
+        args = {"average": average, "num_classes": NUM_CLASSES, "mdmc_average": "global"}
+        MetricTester().run_class_metric_test(
+            preds=_mdmc_prob.preds,
+            target=_mdmc_prob.target,
+            metric_class=metric_class,
+            reference_metric=_sk,
+            metric_args=args,
+            atol=1e-5,
+        )
+        MetricTester().run_functional_metric_test(
+            _mdmc_prob.preds,
+            _mdmc_prob.target,
+            metric_functional=metric_fn,
+            reference_metric=_sk,
+            metric_args=args,
+            atol=1e-5,
+        )
+
+    def test_mdmc_samplewise(self, metric_class, metric_fn, sk_fn, average):
+        from tests.classification.inputs import _multidim_multiclass_prob_inputs as _mdmc_prob
+
+        def _sk(p, t):
+            p = np.asarray(p)  # (N, C, X)
+            t = np.asarray(t)  # (N, X)
+            preds = np.argmax(p, axis=1)
+            vals = [
+                sk_fn(t[i], preds[i], average=average, labels=list(range(NUM_CLASSES)), zero_division=0)
+                for i in range(p.shape[0])
+            ]
+            return np.mean(vals)
+
+        args = {"average": average, "num_classes": NUM_CLASSES, "mdmc_average": "samplewise"}
+        MetricTester().run_class_metric_test(
+            preds=_mdmc_prob.preds,
+            target=_mdmc_prob.target,
+            metric_class=metric_class,
+            reference_metric=_sk,
+            metric_args=args,
+            atol=1e-5,
+        )
+        MetricTester().run_functional_metric_test(
+            _mdmc_prob.preds,
+            _mdmc_prob.target,
+            metric_functional=metric_fn,
+            reference_metric=_sk,
+            metric_args=args,
+            atol=1e-5,
+        )

@@ -6,7 +6,6 @@ Compact port of the reference's error harnesses
 functional must reject malformed indexes/preds/target and bad constructor
 arguments with ValueError.
 """
-import numpy as np
 import pytest
 import jax.numpy as jnp
 
@@ -126,7 +125,9 @@ class TestFunctionalErrors:
             fn(_preds, jnp.asarray([0, 2, 4]))
 
 
-@pytest.mark.parametrize("fn", [retrieval_fall_out, retrieval_hit_rate, retrieval_precision, retrieval_recall])
+@pytest.mark.parametrize(
+    "fn", [retrieval_fall_out, retrieval_hit_rate, retrieval_normalized_dcg, retrieval_precision, retrieval_recall]
+)
 def test_functional_wrong_k(fn):
     with pytest.raises(ValueError, match="positive integer"):
         fn(_preds, _target, k=-1)
