@@ -12,6 +12,7 @@ update) measured in-process — lower is better; ``vs_baseline`` is the
 speedup factor (baseline_time / our_time).
 """
 import json
+import os
 import time
 
 import numpy as np
@@ -90,7 +91,11 @@ def _bench_torch_baseline() -> float:
 
 def _bench_detail() -> dict:
     """Extra BASELINE.md configs; written to BENCH_DETAIL.json with BENCH_ALL=1."""
+    import sys
     import time
+
+    def _mark(key):
+        print(f"# detail: {key}", file=sys.stderr, flush=True)
 
     import jax
     import jax.numpy as jnp
@@ -115,6 +120,7 @@ def _bench_detail() -> dict:
         mc.update(preds, target)
     jax.block_until_ready(mc["ap"].TPs)
     detail["collection_update_us"] = round((time.perf_counter() - t0) / 50 * 1e6, 1)
+    _mark("collection_update_us")
 
     # RetrievalMAP: MSLR-style grouped ranking
     from metrics_tpu import RetrievalMAP
@@ -129,6 +135,7 @@ def _bench_detail() -> dict:
     val = rmap.compute()
     jax.block_until_ready(val)
     detail["retrieval_map_compute_ms_100k_rows"] = round((time.perf_counter() - t0) * 1e3, 1)
+    _mark("retrieval_map_compute_ms_100k_rows")
 
     # COCO mAP: 100 images x 20 dets/gts
     from metrics_tpu.detection import MeanAveragePrecision
@@ -146,6 +153,7 @@ def _bench_detail() -> dict:
     t0 = time.perf_counter()
     m.compute()
     detail["coco_map_compute_s_100_images"] = round(time.perf_counter() - t0, 2)
+    _mark("coco_map_compute_s_100_images")
 
     # FID with the bundled Flax InceptionV3 (BASELINE.md config #5)
     from metrics_tpu.image import FrechetInceptionDistance, InceptionV3FeatureExtractor
@@ -160,9 +168,11 @@ def _bench_detail() -> dict:
         fid.update(imgs, real=False)
     jax.block_until_ready(fid.fake_features[-1])
     detail["fid_update_ms_batch8_299px"] = round((time.perf_counter() - t0) / 5 * 1e3, 1)
+    _mark("fid_update_ms_batch8_299px")
     t0 = time.perf_counter()
     jax.block_until_ready(fid.compute())
     detail["fid_compute_s"] = round(time.perf_counter() - t0, 2)
+    _mark("fid_compute_s")
 
     # BERTScore: host tokenize + greedy cosine matching on device; the
     # embedder is a deterministic hash one-hot (the embedding model itself is
@@ -187,16 +197,107 @@ def _bench_detail() -> dict:
     t0 = time.perf_counter()
     bs.update(sents, sents)
     detail["bertscore_update_ms_256_sents"] = round((time.perf_counter() - t0) * 1e3, 1)
+    _mark("bertscore_update_ms_256_sents")
     t0 = time.perf_counter()
     jax.block_until_ready(bs.compute()["f1"])
     detail["bertscore_compute_s_256_sents"] = round(time.perf_counter() - t0, 2)
+    _mark("bertscore_compute_s_256_sents")
+
+    # WER over a 1k-pair corpus: host-side native C++ edit-distance core
+    from metrics_tpu import WordErrorRate
+    from metrics_tpu.native import native_available
+
+    words = [f"word{i}" for i in range(200)]
+    corpus_p = [" ".join(rng.choice(words, 25)) for _ in range(1000)]
+    corpus_t = [" ".join(rng.choice(words, 25)) for _ in range(1000)]
+    wer = WordErrorRate()
+    wer.update(corpus_p[:8], corpus_t[:8])  # warm (jit of the scalar add)
+    t0 = time.perf_counter()
+    wer.update(corpus_p, corpus_t)
+    detail["wer_update_ms_1k_pairs"] = round((time.perf_counter() - t0) * 1e3, 1)
+    _mark("wer_update_ms_1k_pairs")
+    detail["wer_native_core"] = native_available()
+
+    # BASELINE.md config #2: collection forward incl. cross-device sync on an
+    # 8-device mesh. Runs in a subprocess on 8 forced host (CPU) devices —
+    # the same collective program that rides ICI on a real slice.
+    detail["collection_dist_sync_8dev_us"] = _bench_dist_subprocess()
+    _mark("collection_dist_sync_8dev_us")
 
     return detail
 
 
-def main() -> None:
+def _bench_dist_subprocess():
+    """Time the fused 8-device collection step (psum sync) on host devices."""
     import os
+    import subprocess
+    import sys
 
+    code = r"""
+import os, time
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.getcwd(), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import numpy as np, jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+from metrics_tpu import Accuracy, F1Score, MetricCollection
+
+mc = MetricCollection({"acc": Accuracy(num_classes=32), "f1": F1Score(num_classes=32, average="macro")}, compute_groups=False)
+states = mc.state()
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+def step(states, preds, target):
+    states = mc.pure_update(states, preds, target)
+    return mc.pure_sync(states, axis_name="dp")
+sharded = jax.jit(shard_map(step, mesh=mesh,
+    in_specs=(P(), P("dp"), P("dp")), out_specs=P(),
+    check_vma=False))
+rng = np.random.RandomState(0)
+logits = rng.rand(256, 32).astype(np.float32)
+preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+target = jnp.asarray(rng.randint(0, 32, 256))
+out = sharded(states, preds, target)
+jax.block_until_ready(jax.tree_util.tree_leaves(out))
+t0 = time.perf_counter()
+for _ in range(100):
+    out = sharded(states, preds, target)
+jax.block_until_ready(jax.tree_util.tree_leaves(out))
+print((time.perf_counter() - t0) / 100 * 1e6)
+"""
+    proc = None
+    try:
+        env = dict(os.environ)
+        # the TPU tunnel is single-client: the parent process holds the chip,
+        # so the subprocess must not load the axon site hook at all — an empty
+        # PYTHONPATH drops it; cwd puts the repo back on sys.path for -c
+        env["PYTHONPATH"] = ""
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=300,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return round(float(proc.stdout.strip().splitlines()[-1]), 1)
+    except Exception as err:
+        stderr = proc.stderr if proc is not None else ""
+        print(f"# dist subprocess bench failed: {err}\n{stderr}", file=sys.stderr, flush=True)
+        return None
+
+
+def _enable_compile_cache() -> None:
+    """Persist XLA compilations across bench runs (first TPU compile is ~20-40 s)."""
+    try:
+        import jax
+
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization only
+
+
+def main() -> None:
+    _enable_compile_cache()
     ours_us = _bench_ours()
     base_us = float("nan")
     try:
