@@ -92,7 +92,6 @@ def _bench_torch_baseline() -> float:
 def _bench_detail() -> dict:
     """Extra BASELINE.md configs; written to BENCH_DETAIL.json with BENCH_ALL=1."""
     import sys
-    import time
 
     def _mark(key):
         print(f"# detail: {key}", file=sys.stderr, flush=True)
@@ -121,6 +120,21 @@ def _bench_detail() -> dict:
     jax.block_until_ready(mc["ap"].TPs)
     detail["collection_update_us"] = round((time.perf_counter() - t0) / 50 * 1e6, 1)
     _mark("collection_update_us")
+
+    # same suite through the fused single-jit dispatch (one XLA program,
+    # CSE-deduplicated across metrics)
+    mcf = MetricCollection(
+        {"acc": Accuracy(num_classes=32), "f1": F1Score(num_classes=32, average="macro"),
+         "ap": BinnedAveragePrecision(num_classes=32, thresholds=64)},
+        fused_update=True,
+    )
+    mcf.update(preds, target)  # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(50):
+        mcf.update(preds, target)
+    jax.block_until_ready(mcf["ap"].TPs)
+    detail["collection_update_fused_us"] = round((time.perf_counter() - t0) / 50 * 1e6, 1)
+    _mark("collection_update_fused_us")
 
     # RetrievalMAP: MSLR-style grouped ranking
     from metrics_tpu import RetrievalMAP
@@ -229,7 +243,6 @@ def _bench_detail() -> dict:
 
 def _bench_dist_subprocess():
     """Time the fused 8-device collection step (psum sync) on host devices."""
-    import os
     import subprocess
     import sys
 

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.utilities.data import _flatten_dict
+from metrics_tpu.utilities.data import _flatten_dict, _squeeze_if_scalar
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 
@@ -38,15 +38,32 @@ class MetricCollection:
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
+        fused_update: bool = False,
     ) -> None:
         self._modules: "OrderedDict[str, Metric]" = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
+        # compute groups stay configured as requested: while fused dispatch is
+        # active they are simply never consulted (XLA CSE does the dedup), but
+        # if fusion falls back to the eager loop they engage as normal
         self._enable_compute_groups = compute_groups
         self._groups_checked: bool = False
         self._groups: Dict[int, List[str]] = {}
+        self._fused_update = fused_update
+        self._fuse_failed: bool = False
+        self._fused_update_fn = None
+        self._fused_forward_fn = None
 
         self.add_metrics(metrics, *additional_metrics)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # jitted dispatchers hold unpicklable callables; rebuilt lazily
+        return {k: v for k, v in self.__dict__.items() if k not in ("_fused_update_fn", "_fused_forward_fn")}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._fused_update_fn = None
+        self._fused_forward_fn = None
 
     # --------------------------------------------------------------- mapping
     def __getitem__(self, key: str) -> Metric:
@@ -78,6 +95,10 @@ class MetricCollection:
     # ----------------------------------------------------------------- calls
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Call forward on every metric; kwargs filtered per metric (ref :128-136)."""
+        if self._fused_update and not self._fuse_failed:
+            fused = self._try_fused_forward(*args, **kwargs)
+            if fused is not None:
+                return fused
         res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
@@ -86,6 +107,8 @@ class MetricCollection:
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Update each metric, or only group leaders once groups are formed (ref :138-157)."""
+        if self._fused_update and not self._fuse_failed and self._try_fused_update(*args, **kwargs):
+            return
         if self._groups_checked:
             for _, cg in self._groups.items():
                 m0 = self._modules[cg[0]]
@@ -96,6 +119,82 @@ class MetricCollection:
             if self._enable_compute_groups:
                 self._merge_compute_groups()
                 self._groups_checked = True
+
+    # ---------------------------------------------------------- fused calls
+    # Opt-in (``fused_update=True``): the whole collection's update/forward
+    # dispatches as ONE jitted XLA program built from the pure API below.
+    # XLA's CSE dedups work shared between metrics (input formatting, stat
+    # scores) inside the compiled program — the compiler-native counterpart
+    # of the host-side compute groups. Opt-in because value-dependent input
+    # validation (e.g. label-range checks) is skipped while tracing; any
+    # failure to fuse (list states, non-array inputs, host-side metrics)
+    # falls back to the eager loop permanently for this collection.
+    def _fusable(self, args: tuple, kwargs: dict) -> bool:
+        import numpy as _np
+
+        for m in self._modules.values():
+            if m.compute_on_cpu or m.dist_sync_on_step:
+                return False
+            if any(isinstance(d, list) for d in m._defaults.values()):
+                return False  # growing list states change the pytree per step
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        return all(isinstance(x, (jax.Array, _np.ndarray, int, float, bool, _np.number)) for x in leaves)
+
+    def _try_fused_update(self, *args: Any, **kwargs: Any) -> bool:
+        try:
+            if not self._fusable(args, kwargs):
+                self._fuse_failed = True
+                return False
+            if self._fused_update_fn is None:
+                self._fused_update_fn = jax.jit(self.pure_update)
+            new_states = self._fused_update_fn(self.state(), *args, **kwargs)
+        except Exception as err:
+            rank_zero_warn(
+                f"MetricCollection(fused_update=True) could not fuse `update` "
+                f"({type(err).__name__}: {err}); falling back to eager dispatch."
+            )
+            self._fuse_failed = True
+            return False
+        self.load_pure_state(new_states, increment=True)
+        return True
+
+    def _fused_forward_impl(self, states, counts, *args: Any, **kwargs: Any):
+        new_states, batch_vals = {}, {}
+        for name, m in self.items(keep_base=True):
+            kw = m._filter_kwargs(**kwargs)
+            batch_state = m.pure_update(m.default_state(), *args, **kw)
+            if m.full_state_update or m.full_state_update is None:
+                new_states[name] = m.pure_update(states[name], *args, **kw)
+            else:
+                new_states[name] = m.pure_merge(states[name], batch_state, count=counts[name])
+            batch_vals[name] = _squeeze_if_scalar(m.pure_compute(batch_state))
+        return new_states, batch_vals
+
+    def _try_fused_forward(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
+        try:
+            if not self._fusable(args, kwargs):
+                self._fuse_failed = True
+                return None
+            if self._fused_forward_fn is None:
+                self._fused_forward_fn = jax.jit(self._fused_forward_impl)
+            # merge counts ride as traced leaves so growing counts don't retrace
+            counts = {
+                name: jnp.asarray(m._update_count + 1, dtype=jnp.float32)
+                for name, m in self.items(keep_base=True)
+            }
+            new_states, batch_vals = self._fused_forward_fn(self.state(), counts, *args, **kwargs)
+        except Exception as err:
+            rank_zero_warn(
+                f"MetricCollection(fused_update=True) could not fuse `forward` "
+                f"({type(err).__name__}: {err}); falling back to eager dispatch."
+            )
+            self._fuse_failed = True
+            return None
+        self.load_pure_state(new_states, increment=True)
+        for name, m in self.items(keep_base=True):
+            m._forward_cache = batch_vals[name]
+        res = _flatten_dict(batch_vals)
+        return {self._set_name(k): v for k, v in res.items()}
 
     def _merge_compute_groups(self) -> None:
         """Merge groups whose leader states are equal (ref :159-192)."""
@@ -128,12 +227,14 @@ class MetricCollection:
             state2 = getattr(metric2, key)
             if type(state1) != type(state2):
                 return False
-            if isinstance(state1, jax.Array) and isinstance(state2, jax.Array):
-                return state1.shape == state2.shape and bool(jnp.allclose(state1, state2))
-            if isinstance(state1, list) and isinstance(state2, list):
-                return len(state1) == len(state2) and all(
+            if isinstance(state1, jax.Array):
+                if state1.shape != state2.shape or not bool(jnp.allclose(state1, state2)):
+                    return False
+            elif isinstance(state1, list):
+                if len(state1) != len(state2) or not all(
                     s1.shape == s2.shape and bool(jnp.allclose(s1, s2)) for s1, s2 in zip(state1, state2)
-                )
+                ):
+                    return False
         return True
 
     def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
@@ -187,11 +288,15 @@ class MetricCollection:
         """Cross-device sync of every metric's state over a mesh axis."""
         return {name: m.pure_sync(states[name], axis_name) for name, m in self.items(keep_base=True)}
 
-    def load_pure_state(self, states: Dict[str, Dict[str, Any]]) -> None:
-        """Adopt a state pytree produced by the pure API into the stateful shell."""
+    def load_pure_state(self, states: Dict[str, Dict[str, Any]], increment: bool = False) -> None:
+        """Adopt a state pytree produced by the pure API into the stateful shell.
+
+        ``increment=True`` counts the adoption as one more update (the fused
+        dispatch path); otherwise the count is only clamped to ≥1.
+        """
         for name, m in self.items(keep_base=True):
             m._load_state(states[name])
-            m._update_count = max(m._update_count, 1)
+            m._update_count = m._update_count + 1 if increment else max(m._update_count, 1)
             m._computed = None  # drop the memoized compute of the old state
             m._forward_cache = None
 

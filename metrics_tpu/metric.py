@@ -201,6 +201,12 @@ class Metric(ABC):
         return {k: list(v) if isinstance(v, list) else v for k, v in ((k, getattr(self, k)) for k in self._defaults)}
 
     # ------------------------------------------------------------- pure API
+    def default_state(self) -> Dict[str, StateType]:
+        """A fresh default state pytree (the state ``reset()`` would install)."""
+        return {
+            k: ([] if isinstance(v, list) else jnp.array(v)) for k, v in self._defaults.items()
+        }
+
     def pure_update(self, state: Dict[str, StateType], *args: Any, **kwargs: Any) -> Dict[str, StateType]:
         """Pure reducer ``(state, batch) -> state``; jit/scan/shard_map-safe
         when the metric has no list states and no value-dependent logic."""
@@ -222,16 +228,25 @@ class Metric(ABC):
             self._load_state(saved)
 
     def pure_merge(
-        self, state_a: Dict[str, StateType], state_b: Dict[str, StateType]
+        self,
+        state_a: Dict[str, StateType],
+        state_b: Dict[str, StateType],
+        count: Any = 2,
     ) -> Dict[str, StateType]:
-        """Merge two partial states via the declared reductions."""
+        """Merge two partial states via the declared reductions.
+
+        ``count`` is the total number of updates the merged state represents —
+        it only matters for ``mean``-reduced states, where the merge is the
+        running mean ``((count-1)*a + b)/count``. It may be a traced array so
+        fused/jitted callers don't retrace as the count grows.
+        """
         saved = self._copy_state()
         try:
             self._load_state(state_b)
-            count = self._update_count
-            self._update_count = 2
-            self._reduce_states(state_a)
+            saved_count = self._update_count
             self._update_count = count
+            self._reduce_states(state_a)
+            self._update_count = saved_count
             return self._copy_state()
         finally:
             self._load_state(saved)

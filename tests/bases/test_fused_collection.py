@@ -1,0 +1,203 @@
+"""Fused (single-jit) MetricCollection dispatch vs the eager loop.
+
+``MetricCollection(..., fused_update=True)`` must produce identical batch
+values, accumulated states, and epoch computes as the default eager path,
+and must fall back to eager dispatch for unfusable members (list states,
+string inputs) without corrupting state.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    Accuracy,
+    BinnedAveragePrecision,
+    ConfusionMatrix,
+    F1Score,
+    MetricCollection,
+    PrecisionRecallCurve,
+)
+from metrics_tpu.metric import Metric
+from tests.helpers import seed_all
+
+seed_all(11)
+
+NUM_CLASSES = 7
+
+
+def _suite(fused):
+    return MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="macro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "confmat": ConfusionMatrix(num_classes=NUM_CLASSES),
+            "binned_ap": BinnedAveragePrecision(num_classes=NUM_CLASSES, thresholds=16),
+        },
+        fused_update=fused,
+    )
+
+
+def _batches(n=4, b=32, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        logits = rng.rand(b, NUM_CLASSES).astype(np.float32)
+        preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, b))
+        out.append((preds, target))
+    return out
+
+
+def _assert_tree_close(a, b, atol=1e-6):
+    assert set(a.keys()) == set(b.keys())
+    for k in a:
+        np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]), atol=atol, rtol=1e-5, err_msg=k)
+
+
+def test_fused_update_matches_eager():
+    eager, fused = _suite(False), _suite(True)
+    for preds, target in _batches():
+        eager.update(preds, target)
+        fused.update(preds, target)
+    assert not fused._fuse_failed
+    _assert_tree_close(eager.compute(), fused.compute())
+
+
+def test_fused_forward_matches_eager():
+    eager, fused = _suite(False), _suite(True)
+    for preds, target in _batches(seed=1):
+        ev = eager(preds, target)
+        fv = fused(preds, target)
+        assert not fused._fuse_failed
+        _assert_tree_close(ev, fv)
+    _assert_tree_close(eager.compute(), fused.compute())
+
+
+def test_fused_forward_full_state_update_member():
+    """full_state_update=True members take the update-on-global path."""
+
+    class RunningMax(Metric):
+        full_state_update = True
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("m", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+        def update(self, x, target=None):
+            self.m = jnp.maximum(self.m, jnp.max(x))
+
+        def compute(self):
+            return self.m
+
+    eager = MetricCollection({"mx": RunningMax()})
+    fused = MetricCollection({"mx": RunningMax()}, fused_update=True)
+    for preds, target in _batches(seed=2):
+        ev = eager(preds, target)
+        fv = fused(preds, target)
+        _assert_tree_close(ev, fv)
+    _assert_tree_close(eager.compute(), fused.compute())
+
+
+def test_list_state_member_falls_back_to_eager():
+    """A curve metric (growing list state) is unfusable — eager fallback, same results."""
+    fused = MetricCollection(
+        {"acc": Accuracy(num_classes=NUM_CLASSES), "pr": PrecisionRecallCurve(num_classes=NUM_CLASSES)},
+        fused_update=True,
+    )
+    eager = MetricCollection(
+        {"acc": Accuracy(num_classes=NUM_CLASSES), "pr": PrecisionRecallCurve(num_classes=NUM_CLASSES)},
+    )
+    for preds, target in _batches(n=2, seed=3):
+        fused.update(preds, target)
+        eager.update(preds, target)
+    assert fused._fuse_failed  # fell back, permanently
+    e, f = eager.compute(), fused.compute()
+
+    def _cmp(ea, fa):
+        if isinstance(ea, (tuple, list)):
+            assert len(ea) == len(fa)
+            for x, y in zip(ea, fa):
+                _cmp(x, y)
+        else:
+            np.testing.assert_allclose(np.asarray(ea), np.asarray(fa), atol=1e-6)
+
+    for key in e:
+        _cmp(e[key], f[key])
+
+
+def test_string_inputs_fall_back_to_eager():
+    from metrics_tpu import WordErrorRate
+
+    fused = MetricCollection({"wer": WordErrorRate()}, fused_update=True)
+    fused.update(["hello there"], ["hello world"])
+    assert fused._fuse_failed
+    assert float(fused.compute()["wer"]) == 0.5
+
+
+def test_fused_reset_and_reuse():
+    fused = _suite(True)
+    batches = _batches(seed=4)
+    for preds, target in batches:
+        fused.update(preds, target)
+    first = fused.compute()
+    fused.reset()
+    for preds, target in batches:
+        fused.update(preds, target)
+    _assert_tree_close(first, fused.compute())
+
+
+def test_fused_forward_mean_state_running_count():
+    """mean-reduced states must accumulate as a running mean, not (a+b)/2."""
+
+    class MeanState(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("v", jnp.asarray(0.0), dist_reduce_fx="mean")
+
+        def update(self, x, target=None):
+            self.v = jnp.mean(x)
+
+        def compute(self):
+            return self.v
+
+    eager = MetricCollection({"m": MeanState()})
+    fused = MetricCollection({"m": MeanState()}, fused_update=True)
+    rng = np.random.RandomState(5)
+    for _ in range(3):
+        x = jnp.asarray(rng.rand(8).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 2, 8))
+        ev, fv = eager(x, t), fused(x, t)
+        np.testing.assert_allclose(np.asarray(ev["m"]), np.asarray(fv["m"]), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(eager.compute()["m"]), np.asarray(fused.compute()["m"]), atol=1e-6
+    )
+
+
+def test_fused_collection_pickles_after_dispatch():
+    import pickle
+
+    fused = _suite(True)
+    for preds, target in _batches(n=2, seed=6):
+        fused.update(preds, target)
+    assert not fused._fuse_failed
+    restored = pickle.loads(pickle.dumps(fused))
+    _assert_tree_close(fused.compute(), restored.compute())
+    # restored collection can keep updating through the fused path
+    preds, target = _batches(n=1, seed=7)[0]
+    restored.update(preds, target)
+    assert not restored._fuse_failed
+
+
+def test_fused_fallback_reengages_compute_groups():
+    """On fallback, an explicitly configured compute-group setup still works."""
+    fused = MetricCollection(
+        {"acc": Accuracy(num_classes=NUM_CLASSES), "pr": PrecisionRecallCurve(num_classes=NUM_CLASSES)},
+        fused_update=True,
+    )
+    assert fused._enable_compute_groups  # not discarded by fused_update
+    for preds, target in _batches(n=2, seed=8):
+        fused.update(preds, target)
+    assert fused._fuse_failed
+    assert fused._groups_checked  # eager path formed groups after fallback
