@@ -241,14 +241,14 @@ class Metric(ABC):
         fused/jitted callers don't retrace as the count grows.
         """
         saved = self._copy_state()
+        saved_count = self._update_count
         try:
             self._load_state(state_b)
-            saved_count = self._update_count
             self._update_count = count
             self._reduce_states(state_a)
-            self._update_count = saved_count
             return self._copy_state()
         finally:
+            self._update_count = saved_count  # may be a traced count on error
             self._load_state(saved)
 
     def pure_sync(self, state: Dict[str, StateType], axis_name: str) -> Dict[str, StateType]:
@@ -516,11 +516,8 @@ class Metric(ABC):
         self._update_count = 0
         self._forward_cache = None
         self._computed = None
-        for attr, default in self._defaults.items():
-            if isinstance(default, list):
-                object.__setattr__(self, attr, [])
-            else:
-                object.__setattr__(self, attr, jnp.array(default))
+        for attr, default in self.default_state().items():
+            object.__setattr__(self, attr, default)
         # reset internal sync state
         self._cache = None
         self._is_synced = False

@@ -201,3 +201,21 @@ def test_fused_fallback_reengages_compute_groups():
         fused.update(preds, target)
     assert fused._fuse_failed
     assert fused._groups_checked  # eager path formed groups after fallback
+
+
+def test_wrapper_members_fall_back_to_eager():
+    """Wrapper metrics hold child state outside _defaults — must not fuse."""
+    from metrics_tpu import MinMaxMetric
+
+    fused = MetricCollection(
+        {"mm": MinMaxMetric(Accuracy(num_classes=NUM_CLASSES))}, fused_update=True
+    )
+    eager = MetricCollection({"mm": MinMaxMetric(Accuracy(num_classes=NUM_CLASSES))})
+    for preds, target in _batches(n=3, seed=9):
+        fused.update(preds, target)
+        eager.update(preds, target)
+    assert fused._fuse_failed
+    ec, fc = eager.compute(), fused.compute()
+    assert set(ec.keys()) == set(fc.keys())
+    for k in ec:  # flattened {mm_raw, mm_min, mm_max} scalars
+        np.testing.assert_allclose(np.asarray(ec[k]), np.asarray(fc[k]), atol=1e-6)

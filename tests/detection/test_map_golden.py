@@ -1,0 +1,135 @@
+"""COCO golden-value test for MeanAveragePrecision.
+
+Port of the reference's pycocotools-verified fixture
+(/root/reference/tests/detection/test_map.py:26-197): four real COCO images'
+detections with the expected metric values produced by pycocotools itself.
+Passing this pins pycocotools-equivalence without the C dependency.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from metrics_tpu.detection import MeanAveragePrecision
+
+
+def _preds():
+    return [
+        [
+            dict(
+                boxes=jnp.asarray([[258.15, 41.29, 606.41, 285.07]]),
+                scores=jnp.asarray([0.236]),
+                labels=jnp.asarray([4]),
+            ),  # coco image id 42
+            dict(
+                boxes=jnp.asarray([[61.00, 22.75, 565.00, 632.42], [12.66, 3.32, 281.26, 275.23]]),
+                scores=jnp.asarray([0.318, 0.726]),
+                labels=jnp.asarray([3, 2]),
+            ),  # coco image id 73
+        ],
+        [
+            dict(
+                boxes=jnp.asarray(
+                    [
+                        [87.87, 276.25, 384.29, 379.43],
+                        [0.00, 3.66, 142.15, 316.06],
+                        [296.55, 93.96, 314.97, 152.79],
+                        [328.94, 97.05, 342.49, 122.98],
+                        [356.62, 95.47, 372.33, 147.55],
+                        [464.08, 105.09, 495.74, 146.99],
+                        [276.11, 103.84, 291.44, 150.72],
+                    ]
+                ),
+                scores=jnp.asarray([0.546, 0.3, 0.407, 0.611, 0.335, 0.805, 0.953]),
+                labels=jnp.asarray([4, 1, 0, 0, 0, 0, 0]),
+            ),  # coco image id 74
+            dict(
+                boxes=jnp.asarray([[0.00, 2.87, 601.00, 421.52]]),
+                scores=jnp.asarray([0.699]),
+                labels=jnp.asarray([5]),
+            ),  # coco image id 133
+        ],
+    ]
+
+
+def _target():
+    return [
+        [
+            dict(
+                boxes=jnp.asarray([[214.1500, 41.2900, 562.4100, 285.0700]]),
+                labels=jnp.asarray([4]),
+            ),
+            dict(
+                boxes=jnp.asarray([[13.00, 22.75, 548.98, 632.42], [1.66, 3.32, 270.26, 275.23]]),
+                labels=jnp.asarray([2, 2]),
+            ),
+        ],
+        [
+            dict(
+                boxes=jnp.asarray(
+                    [
+                        [61.87, 276.25, 358.29, 379.43],
+                        [2.75, 3.66, 162.15, 316.06],
+                        [295.55, 93.96, 313.97, 152.79],
+                        [326.94, 97.05, 340.49, 122.98],
+                        [356.62, 95.47, 372.33, 147.55],
+                        [462.08, 105.09, 493.74, 146.99],
+                        [277.11, 103.84, 292.44, 150.72],
+                    ]
+                ),
+                labels=jnp.asarray([4, 1, 0, 0, 0, 0, 0]),
+            ),
+            dict(
+                boxes=jnp.asarray([[13.99, 2.87, 640.00, 421.52]]),
+                labels=jnp.asarray([5]),
+            ),
+        ],
+    ]
+
+
+# pycocotools reference output for the fixture (ref test_map.py:140-197)
+EXPECTED = {
+    "map": 0.706,
+    "map_50": 0.901,
+    "map_75": 0.846,
+    "map_small": 0.689,
+    "map_medium": 0.800,
+    "map_large": 0.701,
+    "mar_1": 0.592,
+    "mar_10": 0.716,
+    "mar_100": 0.716,
+    "mar_small": 0.767,
+    "mar_medium": 0.800,
+    "mar_large": 0.700,
+    "map_per_class": [0.725, 0.800, 0.454, -1.000, 0.650, 0.900],
+    "mar_100_per_class": [0.780, 0.800, 0.450, -1.000, 0.650, 0.900],
+}
+
+
+def test_map_matches_pycocotools_golden():
+    metric = MeanAveragePrecision(class_metrics=True)
+    for preds_batch, target_batch in zip(_preds(), _target()):
+        metric.update(preds_batch, target_batch)
+    result = metric.compute()
+    for key, expected in EXPECTED.items():
+        got = np.asarray(result[key]).reshape(-1)
+        np.testing.assert_allclose(
+            got, np.asarray(expected, dtype=np.float64).reshape(-1), atol=0.01, err_msg=key
+        )
+
+
+def test_map_issue_943_regression():
+    """Duplicated prediction against one GT + empty GT image (ref test_map.py:104-135)."""
+    metric = MeanAveragePrecision()
+    metric.update(
+        [
+            dict(boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.asarray([0.536]), labels=jnp.asarray([0])),
+            dict(boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.asarray([0.536]), labels=jnp.asarray([0])),
+        ],
+        [
+            dict(boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.asarray([0])),
+            dict(boxes=jnp.asarray([]).reshape(0, 4), labels=jnp.asarray([], dtype=jnp.int32)),
+        ],
+    )
+    result = metric.compute()
+    # pycocotools: map_50 == 1.0 for the matched image, the empty-GT image is ignored
+    np.testing.assert_allclose(np.asarray(result["map_50"]), 1.0, atol=1e-6)
