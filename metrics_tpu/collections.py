@@ -129,19 +129,6 @@ class MetricCollection:
     # validation (e.g. label-range checks) is skipped while tracing; any
     # failure to fuse (list states, non-array inputs, host-side metrics)
     # falls back to the eager loop permanently for this collection.
-    @staticmethod
-    def _has_child_metrics(m: Metric) -> bool:
-        """Wrapper/compositional metrics hold state outside ``_defaults`` —
-        the pure save/restore cannot cover it, so they are unfusable."""
-        for v in m.__dict__.values():
-            if isinstance(v, Metric):
-                return True
-            if isinstance(v, (list, tuple)) and any(isinstance(x, Metric) for x in v):
-                return True
-            if isinstance(v, dict) and any(isinstance(x, Metric) for x in v.values()):
-                return True
-        return False
-
     def _fusable(self, args: tuple, kwargs: dict) -> bool:
         import numpy as _np
 
@@ -150,7 +137,9 @@ class MetricCollection:
                 return False
             if any(isinstance(d, list) for d in m._defaults.values()):
                 return False  # growing list states change the pytree per step
-            if self._has_child_metrics(m):
+            if m._children():
+                # wrapper/compositional metrics hold state outside _defaults —
+                # the pure save/restore cannot cover it
                 return False
         leaves = jax.tree_util.tree_leaves((args, kwargs))
         return all(isinstance(x, (jax.Array, _np.ndarray, int, float, bool, _np.number)) for x in leaves)

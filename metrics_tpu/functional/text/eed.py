@@ -27,7 +27,17 @@ def _eed_function(
     deletion: float = 0.2,
     insertion: float = 1.0,
 ) -> float:
-    """EED via the CDER grid with long jumps (paper §2; ref eed.py:121-166)."""
+    """EED via the CDER grid with long jumps (paper §2; ref eed.py:121-166).
+
+    The O(|hyp|·|ref|) grid runs in the native C++ core when available
+    (metrics_tpu/native/edit_distance.cpp:tm_eed); this numpy implementation
+    is the fallback and the parity reference.
+    """
+    from metrics_tpu.native import eed_score
+
+    native = eed_score(hyp, ref, alpha, rho, deletion, insertion)
+    if native is not None:
+        return native
     n = len(hyp)
     visits = np.full(n + 1, -1, dtype=np.int64)
     hyp_chars = np.array(list(hyp)) if n else np.empty(0, dtype="<U1")

@@ -95,6 +95,12 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
     ]
+    lib.tm_eed.restype = ctypes.c_double
+    lib.tm_eed.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+    ]
     _lib = lib
     return _lib
 
@@ -117,6 +123,22 @@ def levenshtein_ids(a: np.ndarray, b: np.ndarray) -> Optional[int]:
     return int(lib.tm_levenshtein(
         a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(a),
         b.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(b),
+    ))
+
+
+def eed_score(
+    hyp: str, ref: str, alpha: float, rho: float, deletion: float, insertion: float
+) -> Optional[float]:
+    """Extended Edit Distance for one sentence pair; None if native unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    h = np.fromiter((ord(c) for c in hyp), dtype=np.int32, count=len(hyp))
+    r = np.fromiter((ord(c) for c in ref), dtype=np.int32, count=len(ref))
+    return float(lib.tm_eed(
+        h.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(h),
+        r.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(r),
+        ord(" "), alpha, rho, deletion, insertion,
     ))
 
 

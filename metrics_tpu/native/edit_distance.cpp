@@ -42,6 +42,54 @@ int64_t tm_levenshtein(const int32_t* a, int64_t n, const int32_t* b, int64_t m)
     return row[static_cast<size_t>(m)];
 }
 
+// Extended Edit Distance (Stanchev et al., WMT 2019) over codepoint ids.
+// Same CDER-grid-with-long-jumps dynamic program as the Python reference
+// (metrics_tpu/functional/text/eed.py:_eed_function); hyp/ref are unicode
+// codepoints, space_id marks word boundaries where long jumps are allowed.
+double tm_eed(const int32_t* hyp, int64_t n, const int32_t* ref, int64_t m,
+              int32_t space_id, double alpha, double rho, double deletion,
+              double insertion) {
+    const double INF = 1e300;
+    std::vector<double> row(static_cast<size_t>(n) + 1, 1.0);
+    std::vector<double> next_row(static_cast<size_t>(n) + 1);
+    std::vector<int64_t> visits(static_cast<size_t>(n) + 1, -1);
+    row[0] = 0.0;
+
+    for (int64_t w = 1; w <= m; ++w) {
+        const int32_t ref_char = ref[w - 1];
+        next_row[0] = row[0] + 1.0;
+        for (int64_t i = 1; i <= n; ++i) {
+            const double sub = row[static_cast<size_t>(i - 1)] + (hyp[i - 1] != ref_char ? 1.0 : 0.0);
+            const double ins = row[static_cast<size_t>(i)] + insertion;
+            const double del = next_row[static_cast<size_t>(i - 1)] + deletion;
+            const double base = sub < ins ? sub : ins;
+            next_row[static_cast<size_t>(i)] = del < base ? del : base;
+        }
+        int64_t min_index = 0;
+        double min_val = INF;
+        for (int64_t i = 0; i <= n; ++i) {
+            if (next_row[static_cast<size_t>(i)] < min_val) {
+                min_val = next_row[static_cast<size_t>(i)];
+                min_index = i;
+            }
+        }
+        visits[static_cast<size_t>(min_index)] += 1;
+        if (ref_char == space_id) {
+            const double jump = alpha + min_val;
+            for (int64_t i = 0; i <= n; ++i) {
+                if (jump < next_row[static_cast<size_t>(i)]) next_row[static_cast<size_t>(i)] = jump;
+            }
+        }
+        row.swap(next_row);
+    }
+
+    int64_t visit_sum = 0;
+    for (int64_t i = 0; i <= n; ++i) visit_sum += visits[static_cast<size_t>(i)] >= 0 ? visits[static_cast<size_t>(i)] : 1;
+    const double coverage = rho * static_cast<double>(visit_sum);
+    const double score = (row[static_cast<size_t>(n)] + coverage) / (static_cast<double>(m) + coverage);
+    return score < 1.0 ? score : 1.0;
+}
+
 // Batched form: sequences are concatenated in a_flat/b_flat with CSR-style
 // offset arrays of length num_pairs+1; distances land in out[0:num_pairs).
 void tm_levenshtein_batch(const int32_t* a_flat, const int64_t* a_offsets,

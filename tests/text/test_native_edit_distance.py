@@ -80,3 +80,35 @@ def test_disable_env_falls_back(monkeypatch):
     assert native_mod.levenshtein_ids(np.asarray([1, 2]), np.asarray([1, 3])) is None
     # the public helper still answers through the numpy fallback
     assert _edit_distance(["a", "b"], ["a", "c"]) == 1
+
+
+def test_eed_native_matches_python(monkeypatch):
+    """tm_eed reproduces the numpy CDER-grid DP exactly."""
+    import metrics_tpu.native as native_mod
+    from metrics_tpu.functional.text import eed as eed_mod
+
+    if not native_available():
+        pytest.skip("native core unavailable")
+    rng = np.random.RandomState(3)
+    words = ["alpha", "beta", "gamma", "x", "commonword"]
+    cases = [
+        (" ".join(rng.choice(words, rng.randint(0, 12))), " ".join(rng.choice(words, rng.randint(1, 12))))
+        for _ in range(25)
+    ]
+    native_scores = [native_mod.eed_score(h, r, 2.0, 0.3, 0.2, 1.0) for h, r in cases]
+
+    # force the numpy fallback inside _eed_function for the comparison pass
+    monkeypatch.setenv("METRICS_TPU_DISABLE_NATIVE", "1")
+    monkeypatch.setattr(native_mod, "_lib", None)
+    py_scores = [eed_mod._eed_function(h, r) for h, r in cases]
+
+    np.testing.assert_allclose(native_scores, py_scores, atol=1e-12)
+
+
+def test_extended_edit_distance_end_to_end():
+    """The public metric rides the native path and matches its doctest value."""
+    from metrics_tpu.functional import extended_edit_distance
+
+    preds = ["this is the prediction", "here is an other sample"]
+    target = ["this is the reference", "here is another one"]
+    assert round(float(extended_edit_distance(preds, target)), 4) == pytest.approx(0.3078, abs=1e-4)
