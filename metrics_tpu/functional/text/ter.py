@@ -13,7 +13,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _edit_distance
+from metrics_tpu.functional.text.helper import _edit_distance, _edit_distances
 
 Array = jax.Array
 
@@ -135,15 +135,29 @@ def _ter_edits(hyp_words: List[str], ref_words: List[str]) -> float:
     # reduction while any strictly positive reduction exists (each shift
     # itself costs one edit); distance decreases every iteration, so this
     # terminates
+    _SHIFT_CHUNK = 2048  # bound candidate materialization on degenerate corpora
     while current_dist > 0:
         best_gain, best_shift = 0, None
+        shifts, shifted_hyps = [], []
+
+        def _score_chunk():
+            nonlocal best_gain, best_shift
+            for shift, dist in zip(shifts, _edit_distances([(s, ref_words) for s in shifted_hyps])):
+                gain = current_dist - dist
+                if gain > best_gain:
+                    best_gain, best_shift = gain, shift
+            shifts.clear()
+            shifted_hyps.clear()
+
         for start, length, new_pos in _find_shifted_candidates(hyp, ref_words):
             shifted = _apply_shift(hyp, start, length, new_pos)
             if shifted == hyp:
                 continue
-            gain = current_dist - _edit_distance(shifted, ref_words)
-            if gain > best_gain:
-                best_gain, best_shift = gain, (start, length, new_pos)
+            shifts.append((start, length, new_pos))
+            shifted_hyps.append(shifted)
+            if len(shifts) >= _SHIFT_CHUNK:
+                _score_chunk()  # candidate shifts scored in (native) batched calls
+        _score_chunk()
         if best_shift is None or best_gain <= 0:
             break
         hyp = _apply_shift(hyp, *best_shift)

@@ -1,0 +1,102 @@
+"""SSIM vs an independent pure-numpy oracle.
+
+The reference validates SSIM against pytorch_msssim / scikit-image (not in
+this image); this hand-written numpy implementation of Wang et al.'s SSIM
+(gaussian- and uniform-window variants, valid-convolution like the product
+code) serves the same role: an implementation sharing no code with the
+product path.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from metrics_tpu import StructuralSimilarityIndexMeasure
+from metrics_tpu.functional import structural_similarity_index_measure
+from tests.helpers import seed_all
+
+seed_all(13)
+
+
+def _np_gaussian_kernel(size, sigma):
+    coords = np.arange(size, dtype=np.float64) - (size - 1) / 2.0
+    g = np.exp(-(coords**2) / (2 * sigma**2))
+    g /= g.sum()
+    return np.outer(g, g)
+
+
+def _np_uniform_kernel(size):
+    return np.full((size, size), 1.0 / (size * size))
+
+
+def _np_conv_valid(img, kernel):
+    kh, kw = kernel.shape
+    h, w = img.shape
+    out = np.empty((h - kh + 1, w - kw + 1))
+    for i in range(out.shape[0]):
+        for j in range(out.shape[1]):
+            out[i, j] = (img[i : i + kh, j : j + kw] * kernel).sum()
+    return out
+
+
+def _np_ssim(preds, target, kernel, data_range, k1=0.01, k2=0.03):
+    """Per-image, per-channel SSIM averaged over the valid window positions."""
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    vals = []
+    for n in range(preds.shape[0]):
+        for c in range(preds.shape[1]):
+            x = preds[n, c].astype(np.float64)
+            y = target[n, c].astype(np.float64)
+            mu_x = _np_conv_valid(x, kernel)
+            mu_y = _np_conv_valid(y, kernel)
+            sigma_x = _np_conv_valid(x * x, kernel) - mu_x**2
+            sigma_y = _np_conv_valid(y * y, kernel) - mu_y**2
+            sigma_xy = _np_conv_valid(x * y, kernel) - mu_x * mu_y
+            ssim_map = ((2 * mu_x * mu_y + c1) * (2 * sigma_xy + c2)) / (
+                (mu_x**2 + mu_y**2 + c1) * (sigma_x + sigma_y + c2)
+            )
+            vals.append(ssim_map.mean())
+    return float(np.mean(vals))
+
+
+@pytest.mark.parametrize("gaussian", [True, False])
+@pytest.mark.parametrize("kernel_size, sigma", [(11, 1.5), (7, 1.0)])
+def test_ssim_matches_numpy_oracle(gaussian, kernel_size, sigma):
+    rng = np.random.RandomState(kernel_size)
+    preds = rng.rand(3, 2, 24, 24).astype(np.float32)
+    target = np.clip(preds + rng.randn(3, 2, 24, 24).astype(np.float32) * 0.1, 0, 1)
+
+    got = float(
+        structural_similarity_index_measure(
+            jnp.asarray(preds), jnp.asarray(target),
+            gaussian_kernel=gaussian, kernel_size=kernel_size, sigma=sigma, data_range=1.0,
+        )
+    )
+    kernel = _np_gaussian_kernel(kernel_size, sigma) if gaussian else _np_uniform_kernel(kernel_size)
+    expected = _np_ssim(preds, target, kernel, data_range=1.0)
+    # product path runs float32 (E[x^2]-mu^2 cancellation); oracle is float64
+    np.testing.assert_allclose(got, expected, atol=2e-3)
+
+
+def test_ssim_identical_images_is_one():
+    rng = np.random.RandomState(0)
+    img = jnp.asarray(rng.rand(2, 1, 16, 16).astype(np.float32))
+    assert float(structural_similarity_index_measure(img, img, data_range=1.0)) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_ssim_module_accumulates_like_functional():
+    rng = np.random.RandomState(1)
+    metric = StructuralSimilarityIndexMeasure(data_range=1.0)
+    batches = []
+    for _ in range(3):
+        p = rng.rand(2, 1, 16, 16).astype(np.float32)
+        t = np.clip(p + rng.randn(2, 1, 16, 16).astype(np.float32) * 0.05, 0, 1)
+        batches.append((p, t))
+        metric.update(jnp.asarray(p), jnp.asarray(t))
+    all_p = jnp.asarray(np.concatenate([p for p, _ in batches]))
+    all_t = jnp.asarray(np.concatenate([t for _, t in batches]))
+    np.testing.assert_allclose(
+        float(metric.compute()),
+        float(structural_similarity_index_measure(all_p, all_t, data_range=1.0)),
+        atol=1e-5,
+    )
