@@ -7,6 +7,12 @@ uses a deterministic hash one-hot embedder (no weights needed); swap in
 ``transformers_flax_embedder("roberta-large")`` for a real model from a
 local HF cache. Run: ``python integrations/bert_score_own_embedder.py``.
 """
+
+# allow running uninstalled: put the repo root on sys.path
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 

@@ -3,6 +3,12 @@
 Two images with detections and groundtruths; prints the 12-entry COCO
 result dict. Run: ``python integrations/detection_map_example.py``.
 """
+
+# allow running uninstalled: put the repo root on sys.path
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax.numpy as jnp
 
 from metrics_tpu.detection import MeanAveragePrecision

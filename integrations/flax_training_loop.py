@@ -9,6 +9,12 @@ fully-jitted distributed variant.
 
 Run: JAX_PLATFORMS=cpu python integrations/flax_training_loop.py
 """
+
+# allow running uninstalled: put the repo root on sys.path
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from functools import partial
 
 import jax

@@ -3,7 +3,12 @@ counterpart of the reference's state_dict persistence contract.
 
 Run: ``python integrations/orbax_resume.py``.
 """
+
+# allow running uninstalled: put the repo root on sys.path
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import tempfile
 
 import jax.numpy as jnp

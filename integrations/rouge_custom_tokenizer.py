@@ -3,6 +3,12 @@ tm_examples/rouge_score-own_normalizer_and_tokenizer.py.
 
 Run: ``python integrations/rouge_custom_tokenizer.py``.
 """
+
+# allow running uninstalled: put the repo root on sys.path
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import re
 
 from metrics_tpu.text import ROUGEScore

@@ -354,13 +354,19 @@ class Metric(ABC):
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
-            if self._jit_update_requested and not any(isinstance(v, list) for v in self._defaults.values()):
-                if self._jitted_update is None:
-                    self._jitted_update = jax.jit(self.pure_update)
-                new_state = self._jitted_update(self.state(), *args, **kwargs)
-                self._load_state(new_state)
-            else:
-                update(*args, **kwargs)
+            # named scope surfaces per-metric regions in jax profiler traces
+            # (the SURVEY §5.1 observability analogue of the reference's
+            # one-line construction telemetry, metric.py:85)
+            with jax.named_scope(f"metrics_tpu.{type(self).__name__}.update"):
+                if self._jit_update_requested and not any(
+                    isinstance(v, list) for v in self._defaults.values()
+                ):
+                    if self._jitted_update is None:
+                        self._jitted_update = jax.jit(self.pure_update)
+                    new_state = self._jitted_update(self.state(), *args, **kwargs)
+                    self._load_state(new_state)
+                else:
+                    update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -494,7 +500,7 @@ class Metric(ABC):
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
-            ):
+            ), jax.named_scope(f"metrics_tpu.{type(self).__name__}.compute"):
                 value = compute(*args, **kwargs)
                 self._computed = _squeeze_if_scalar(value)
             return self._computed

@@ -72,10 +72,16 @@ def test_ssim_matches_numpy_oracle(gaussian, kernel_size, sigma):
             gaussian_kernel=gaussian, kernel_size=kernel_size, sigma=sigma, data_range=1.0,
         )
     )
-    kernel = _np_gaussian_kernel(kernel_size, sigma) if gaussian else _np_uniform_kernel(kernel_size)
+    if gaussian:
+        # the gaussian window's size is derived from sigma, like the
+        # reference (ssim.py: int(3.5*sigma+0.5)*2+1); kernel_size applies
+        # only to the uniform window
+        gauss_size = int(3.5 * sigma + 0.5) * 2 + 1
+        kernel = _np_gaussian_kernel(gauss_size, sigma)
+    else:
+        kernel = _np_uniform_kernel(kernel_size)
     expected = _np_ssim(preds, target, kernel, data_range=1.0)
-    # product path runs float32 (E[x^2]-mu^2 cancellation); oracle is float64
-    np.testing.assert_allclose(got, expected, atol=2e-3)
+    np.testing.assert_allclose(got, expected, atol=1e-5)
 
 
 def test_ssim_identical_images_is_one():
