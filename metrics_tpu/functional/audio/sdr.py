@@ -68,28 +68,37 @@ def signal_distortion_ratio(
     """
     _check_same_shape(preds, target)
     preds_dtype = preds.dtype
-    # double precision is required for a well-conditioned Toeplitz solve
-    with jax.enable_x64(True):
-        preds = jnp.asarray(preds, dtype=jnp.float64)
-        target = jnp.asarray(target, dtype=jnp.float64)
+    # The reference always solves the Toeplitz system in float64 (torch CPU);
+    # TPUs have no native f64, so we compute in the ambient precision: f64
+    # when the user enabled x64, else f32 — which also keeps the whole
+    # pipeline differentiable (an enable_x64 context inside grad breaks the
+    # FFT vjp's dtype bookkeeping).
+    work_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    preds = jnp.asarray(preds, dtype=work_dtype)
+    target = jnp.asarray(target, dtype=work_dtype)
 
-        if zero_mean:
-            preds = preds - preds.mean(axis=-1, keepdims=True)
-            target = target - target.mean(axis=-1, keepdims=True)
+    if zero_mean:
+        preds = preds - preds.mean(axis=-1, keepdims=True)
+        target = target - target.mean(axis=-1, keepdims=True)
 
-        target = target / jnp.clip(jnp.linalg.norm(target, axis=-1, keepdims=True), min=1e-6)
-        preds = preds / jnp.clip(jnp.linalg.norm(preds, axis=-1, keepdims=True), min=1e-6)
+    target = target / jnp.clip(jnp.linalg.norm(target, axis=-1, keepdims=True), min=1e-6)
+    preds = preds / jnp.clip(jnp.linalg.norm(preds, axis=-1, keepdims=True), min=1e-6)
 
-        r_0, b = _compute_autocorr_crosscorr(target, preds, corr_len=filter_length)
-        if load_diag is not None:
-            r_0 = r_0.at[..., 0].add(load_diag)
+    r_0, b = _compute_autocorr_crosscorr(target, preds, corr_len=filter_length)
+    if load_diag is not None:
+        r_0 = r_0.at[..., 0].add(load_diag)
+    elif work_dtype == jnp.float32:
+        # relative Tikhonov loading re-establishes the conditioning the f64
+        # solve had: near-singular autocorrelations (tonal signals) would
+        # otherwise give coh >= 1 -> NaN in single precision
+        r_0 = r_0.at[..., 0].mul(1.0 + 1e-6)
 
-        r = _symmetric_toeplitz(r_0)
-        sol = jnp.linalg.solve(r, b[..., None])[..., 0]
+    r = _symmetric_toeplitz(r_0)
+    sol = jnp.linalg.solve(r, b[..., None])[..., 0]
 
-        coh = jnp.einsum("...l,...l->...", b, sol)
-        ratio = coh / (1 - coh)
-        val = 10.0 * jnp.log10(ratio)
+    coh = jnp.einsum("...l,...l->...", b, sol)
+    ratio = coh / (1 - coh)
+    val = 10.0 * jnp.log10(ratio)
 
     if preds_dtype == jnp.float64:
         return val

@@ -51,6 +51,9 @@ def _depthwise_conv(x: Array, kernel: Array) -> Array:
         padding="VALID",
         dimension_numbers=dn_str,
         feature_group_count=kernel.shape[0],
+        # metric statistics need full f32: the TPU default runs convs at
+        # bf16 internal precision, ~1e-3 error in the window moments
+        precision=jax.lax.Precision.HIGHEST,
     )
 
 

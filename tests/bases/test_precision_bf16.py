@@ -1,0 +1,79 @@
+"""Reduced-precision (bfloat16) agreement tests.
+
+The reference runs fp16 precision tests per metric
+(tests/helpers/testers.py:472-528 run_precision_test_cpu/gpu); on TPU the
+reduced precision that matters is bfloat16 — MXU-native. Each functional
+must produce values within tolerance of its float32 result when fed bf16
+inputs.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from metrics_tpu.functional import (
+    accuracy,
+    cosine_similarity,
+    explained_variance,
+    f1_score,
+    mean_absolute_error,
+    mean_squared_error,
+    peak_signal_noise_ratio,
+    precision,
+    r2_score,
+    recall,
+    structural_similarity_index_measure,
+)
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, MetricTester
+
+seed_all(17)
+
+_rng = np.random.RandomState(17)
+_reg_preds = _rng.rand(4, 64).astype(np.float32)
+_reg_target = _rng.rand(4, 64).astype(np.float32)
+
+# class probabilities with a guaranteed 0.4 top-2 margin, so bf16 rounding
+# (eps ~4e-3) can never flip the argmax and perturb the metric discretely
+_cls_labels = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_cls_preds = np.full((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES), 0.4 / (NUM_CLASSES - 1), np.float32)
+np.put_along_axis(_cls_preds, _cls_labels[..., None], 0.6, axis=2)
+_cls_target = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+
+
+@pytest.mark.parametrize(
+    "fn, args",
+    [
+        (accuracy, {"num_classes": NUM_CLASSES}),
+        (precision, {"num_classes": NUM_CLASSES, "average": "macro"}),
+        (recall, {"num_classes": NUM_CLASSES, "average": "macro"}),
+        (f1_score, {"num_classes": NUM_CLASSES, "average": "macro"}),
+    ],
+)
+def test_classification_bf16(fn, args):
+    MetricTester().run_precision_test(_cls_preds, _cls_target, fn, args)
+
+
+@pytest.mark.parametrize(
+    "fn",
+    [mean_squared_error, mean_absolute_error, cosine_similarity, explained_variance, r2_score],
+)
+def test_regression_bf16(fn):
+    MetricTester().run_precision_test(_reg_preds, _reg_target, fn, atol=5e-2)
+
+
+def test_psnr_bf16():
+    MetricTester().run_precision_test(
+        _reg_preds.reshape(4, 1, 8, 8),
+        _reg_target.reshape(4, 1, 8, 8),
+        peak_signal_noise_ratio,
+        {"data_range": 1.0},
+        atol=0.5,  # log-scale metric: half a dB
+    )
+
+
+def test_ssim_bf16():
+    imgs = _rng.rand(2, 2, 1, 16, 16).astype(np.float32)
+    noisy = np.clip(imgs + _rng.randn(2, 2, 1, 16, 16).astype(np.float32) * 0.05, 0, 1)
+    MetricTester().run_precision_test(
+        imgs, noisy, structural_similarity_index_measure, {"data_range": 1.0}, atol=5e-2
+    )

@@ -269,6 +269,34 @@ class MetricTester:
         grad = jax.grad(scalar_fn)(p)
         assert np.all(np.isfinite(np.asarray(grad))), "gradient contains non-finite values"
 
+    # ------------------------------------------------------------- precision
+    def run_precision_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+        atol: float = 1e-2,
+        dtype=jnp.bfloat16,
+    ) -> None:
+        """bfloat16 inputs must agree with float32 within tolerance.
+
+        The TPU-native analogue of the reference's fp16
+        ``run_precision_test_cpu/gpu`` (ref testers.py:472-528): bf16 is the
+        reduced precision that matters on the MXU.
+        """
+        fn = partial(metric_functional, **(metric_args or {}))
+        p32 = jnp.asarray(np.asarray(preds[0]), jnp.float32)
+        t = jnp.asarray(np.asarray(target[0]))
+        t_half = t.astype(dtype) if jnp.issubdtype(t.dtype, jnp.floating) else t
+        full = fn(p32, t)
+        half = fn(p32.astype(dtype), t_half)
+        _assert_allclose(
+            jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.float32), half),
+            full,
+            atol=atol,
+        )
+
 
 class DummyMetric(Metric):
     """Scalar-sum dummy metric for base-class tests (ref testers.py:567-583)."""
