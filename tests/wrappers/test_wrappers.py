@@ -122,3 +122,37 @@ class TestTracker:
         tracker = MetricTracker(Accuracy())
         with pytest.raises(ValueError, match="cannot be called before"):
             tracker.update(jnp.asarray([1]), jnp.asarray([1]))
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+def test_bootstrap_sampler_properties(sampling_strategy):
+    """Sampler draws valid indices with replacement (ref test_bootstrapping.py:49-66)."""
+    from metrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
+
+    rng = np.random.RandomState(0)
+    idx = np.asarray(_bootstrap_sampler(50, sampling_strategy, rng=rng))
+    assert idx.min() >= 0 and idx.max() < 50
+    if sampling_strategy == "multinomial":
+        assert len(idx) == 50
+    # resampling must actually repeat/drop elements (with-replacement signature)
+    draws = [np.asarray(_bootstrap_sampler(50, sampling_strategy, rng=rng)) for _ in range(10)]
+    assert any(len(np.unique(draw)) < 50 for draw in draws)
+
+
+def test_bootstrap_quantile_and_raw():
+    from metrics_tpu import BootStrapper, MeanSquaredError
+
+    rng = np.random.RandomState(1)
+    bs = BootStrapper(
+        MeanSquaredError(), num_bootstraps=20, quantile=jnp.asarray([0.05, 0.95]), raw=True,
+        sampling_strategy="poisson",
+    )
+    for _ in range(4):
+        p = jnp.asarray(rng.rand(32).astype(np.float32))
+        t = jnp.asarray(rng.rand(32).astype(np.float32))
+        bs.update(p, t)
+    out = bs.compute()
+    assert set(out) >= {"mean", "std", "quantile", "raw"}
+    lo, hi = np.asarray(out["quantile"])
+    assert lo <= float(out["mean"]) <= hi
+    assert np.asarray(out["raw"]).shape == (20,)
