@@ -1,0 +1,119 @@
+"""Systematic jit-cleanliness matrix.
+
+The reference scripts every metric through TorchScript
+(tests/helpers/testers.py:163-164); the TPU-native equivalent contract is
+that every array-in/array-out functional traces and compiles under
+``jax.jit`` (static shapes, no value-dependent Python branching) and agrees
+with its eager result. Metrics whose eager form needs concrete values
+(data-dependent class inference, list growth) must instead document the
+pure API route — they are listed here explicitly so the contract is
+visible.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu.functional as F
+from tests.helpers import seed_all
+from tests.helpers.testers import NUM_CLASSES
+
+seed_all(23)
+_rng = np.random.RandomState(23)
+
+_B = 32
+_probs = _rng.rand(_B, NUM_CLASSES).astype(np.float32)
+_probs /= _probs.sum(-1, keepdims=True)
+_labels = _rng.randint(0, NUM_CLASSES, _B)
+_binary_scores = _rng.rand(_B).astype(np.float32)
+_binary_labels = _rng.randint(0, 2, _B)
+_reg_a = _rng.rand(_B).astype(np.float32)
+_reg_b = _rng.rand(_B).astype(np.float32)
+_img_a = _rng.rand(2, 3, 16, 16).astype(np.float32)
+_img_b = _rng.rand(2, 3, 16, 16).astype(np.float32)
+_audio_a = _rng.randn(2, 256).astype(np.float32)
+_audio_b = _rng.randn(2, 256).astype(np.float32)
+_pair_x = _rng.randn(6, 8).astype(np.float32)
+_pair_y = _rng.randn(4, 8).astype(np.float32)
+
+# (functional, kwargs, example args) — every entry must jit and match eager
+JIT_MATRIX = [
+    # classification (num_classes given: all shape decisions are static)
+    (F.accuracy, {"num_classes": NUM_CLASSES}, (_probs, _labels)),
+    (F.precision, {"num_classes": NUM_CLASSES, "average": "macro"}, (_probs, _labels)),
+    (F.recall, {"num_classes": NUM_CLASSES, "average": "macro"}, (_probs, _labels)),
+    (F.specificity, {"num_classes": NUM_CLASSES, "average": "macro"}, (_probs, _labels)),
+    (F.f1_score, {"num_classes": NUM_CLASSES, "average": "macro"}, (_probs, _labels)),
+    (F.fbeta_score, {"num_classes": NUM_CLASSES, "average": "macro", "beta": 0.5}, (_probs, _labels)),
+    (F.stat_scores, {"num_classes": NUM_CLASSES, "reduce": "macro"}, (_probs, _labels)),
+    (F.hamming_distance, {}, (_probs, _labels)),
+    (F.confusion_matrix, {"num_classes": NUM_CLASSES}, (_probs, _labels)),
+    (F.cohen_kappa, {"num_classes": NUM_CLASSES}, (_probs, _labels)),
+    (F.matthews_corrcoef, {"num_classes": NUM_CLASSES}, (_probs, _labels)),
+    (F.jaccard_index, {"num_classes": NUM_CLASSES}, (_probs, _labels)),
+    (F.hinge_loss, {}, (_probs, _labels)),
+    (F.kl_divergence, {}, (_probs, _probs[::-1])),
+    (F.calibration_error, {}, (_binary_scores, _binary_labels)),
+    (F.coverage_error, {}, (_probs, np.eye(NUM_CLASSES, dtype=np.int32)[_labels])),
+    (F.label_ranking_average_precision, {}, (_probs, np.eye(NUM_CLASSES, dtype=np.int32)[_labels])),
+    (F.label_ranking_loss, {}, (_probs, np.eye(NUM_CLASSES, dtype=np.int32)[_labels])),
+    # regression
+    (F.mean_squared_error, {}, (_reg_a, _reg_b)),
+    (F.mean_absolute_error, {}, (_reg_a, _reg_b)),
+    (F.mean_squared_log_error, {}, (_reg_a, _reg_b)),
+    (F.mean_absolute_percentage_error, {}, (_reg_a, _reg_b)),
+    (F.symmetric_mean_absolute_percentage_error, {}, (_reg_a, _reg_b)),
+    (F.weighted_mean_absolute_percentage_error, {}, (_reg_a, _reg_b)),
+    (F.cosine_similarity, {}, (_reg_a.reshape(4, 8), _reg_b.reshape(4, 8))),
+    (F.explained_variance, {}, (_reg_a, _reg_b)),
+    (F.r2_score, {}, (_reg_a, _reg_b)),
+    (F.pearson_corrcoef, {}, (_reg_a, _reg_b)),
+    (F.spearman_corrcoef, {}, (_reg_a, _reg_b)),
+    (F.tweedie_deviance_score, {"power": 1.5}, (_reg_a + 0.1, _reg_b + 0.1)),
+    # retrieval (single query, concrete k)
+    (F.retrieval_average_precision, {}, (_binary_scores, _binary_labels)),
+    (F.retrieval_reciprocal_rank, {}, (_binary_scores, _binary_labels)),
+    (F.retrieval_precision, {"k": 5}, (_binary_scores, _binary_labels)),
+    (F.retrieval_recall, {"k": 5}, (_binary_scores, _binary_labels)),
+    (F.retrieval_hit_rate, {"k": 5}, (_binary_scores, _binary_labels)),
+    (F.retrieval_fall_out, {"k": 5}, (_binary_scores, _binary_labels)),
+    (F.retrieval_normalized_dcg, {"k": 5}, (_binary_scores, _binary_labels)),
+    # image
+    (F.peak_signal_noise_ratio, {"data_range": 1.0}, (_img_a, _img_b)),
+    (F.structural_similarity_index_measure, {"data_range": 1.0}, (_img_a, _img_b)),
+    (F.universal_image_quality_index, {}, (_img_a, _img_b)),
+    (F.error_relative_global_dimensionless_synthesis, {}, (_img_a, _img_b)),
+    (F.spectral_angle_mapper, {}, (_img_a, _img_b)),
+    (F.spectral_distortion_index, {}, (_img_a, _img_b)),
+    (F.image_gradients, {}, (_img_a,)),
+    # audio
+    (F.signal_noise_ratio, {}, (_audio_a, _audio_b)),
+    (F.scale_invariant_signal_noise_ratio, {}, (_audio_a, _audio_b)),
+    (F.scale_invariant_signal_distortion_ratio, {}, (_audio_a, _audio_b)),
+    (F.signal_distortion_ratio, {"filter_length": 32}, (_audio_a, _audio_b)),
+    # pairwise
+    (F.pairwise_cosine_similarity, {}, (_pair_x, _pair_y)),
+    (F.pairwise_euclidean_distance, {}, (_pair_x, _pair_y)),
+    (F.pairwise_linear_similarity, {}, (_pair_x, _pair_y)),
+    (F.pairwise_manhattan_distance, {}, (_pair_x, _pair_y)),
+]
+
+
+def _close(a, b):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "fn, kwargs, args", JIT_MATRIX, ids=[f[0].__name__ for f in JIT_MATRIX]
+)
+def test_functional_is_jit_clean(fn, kwargs, args):
+    eager = partial(fn, **kwargs)
+    jitted = jax.jit(eager)
+    inputs = tuple(jnp.asarray(a) for a in args)
+    _close(jitted(*inputs), eager(*inputs))
