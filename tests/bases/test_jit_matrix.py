@@ -101,19 +101,29 @@ JIT_MATRIX = [
 ]
 
 
-def _close(a, b):
-    fa = jax.tree_util.tree_leaves(a)
-    fb = jax.tree_util.tree_leaves(b)
-    assert len(fa) == len(fb)
-    for x, y in zip(fa, fb):
-        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-5, rtol=1e-4)
+# Functionals deliberately NOT in the matrix — each needs concrete values
+# or non-array inputs, and is jittable only through the pure API / with
+# static hints (documented in docs/overview.md):
+#   - curve metrics without `num_classes` (infer class count from data):
+#     roc, auroc, average_precision, precision_recall_curve, auc (variable
+#     thresholds count -> dynamic output shape; binned_* variants are the
+#     static-shape route and are exercised via BinnedPrecisionRecallCurve)
+#   - retrieval module forms with `indexes` (ragged per-query grouping)
+#   - dice_score (class presence filtering on values)
+#   - all text metrics (host-side string processing by design)
+#   - permutation_invariant_training (returns data-dependent permutation)
+#   - detection mAP (ragged per-image boxes; padded internally per batch)
+#   - feature-extractor metrics (FID/IS/KID/LPIPS/BERTScore: the extractor
+#     itself is jitted, list states accumulate outside)
 
 
 @pytest.mark.parametrize(
     "fn, kwargs, args", JIT_MATRIX, ids=[f[0].__name__ for f in JIT_MATRIX]
 )
 def test_functional_is_jit_clean(fn, kwargs, args):
+    from tests.helpers.testers import _assert_allclose
+
     eager = partial(fn, **kwargs)
     jitted = jax.jit(eager)
     inputs = tuple(jnp.asarray(a) for a in args)
-    _close(jitted(*inputs), eager(*inputs))
+    _assert_allclose(jitted(*inputs), eager(*inputs), atol=1e-5)

@@ -109,9 +109,10 @@ class TestMulticlassCurves:
     preds = _multiclass_prob_inputs.preds
     target = _multiclass_prob_inputs.target
 
-    def test_auroc_multiclass(self):
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_auroc_multiclass(self, average):
         def _sk(p, t):
-            return sk_roc_auc(np.asarray(t), np.asarray(p), multi_class="ovr", average="macro",
+            return sk_roc_auc(np.asarray(t), np.asarray(p), multi_class="ovr", average=average,
                               labels=list(range(NUM_CLASSES)))
 
         MetricTester().run_class_metric_test(
@@ -119,7 +120,7 @@ class TestMulticlassCurves:
             target=self.target,
             metric_class=AUROC,
             reference_metric=_sk,
-            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
             atol=1e-5,
         )
 
@@ -145,6 +146,19 @@ class TestMulticlassCurves:
         for c in range(NUM_CLASSES):
             sk_val = sk_average_precision(t_oh[:, c], p_all[:, c])
             np.testing.assert_allclose(np.asarray(res[c]), sk_val, atol=1e-5)
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_average_precision_multiclass_averaged(self, average):
+        p_all, t_all = _cat(self.preds), _cat(self.target)
+        res = average_precision(jnp.asarray(p_all), jnp.asarray(t_all), num_classes=NUM_CLASSES, average=average)
+        t_oh = np.eye(NUM_CLASSES)[t_all]
+        per_class = np.asarray([sk_average_precision(t_oh[:, c], p_all[:, c]) for c in range(NUM_CLASSES)])
+        if average == "macro":
+            expected = per_class.mean()
+        else:
+            weights = t_oh.sum(0) / t_oh.sum()
+            expected = (per_class * weights).sum()
+        np.testing.assert_allclose(float(res), expected, atol=1e-5)
 
     def test_pr_curve_multiclass(self):
         p_all, t_all = _cat(self.preds), _cat(self.target)

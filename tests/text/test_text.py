@@ -274,3 +274,39 @@ class TestBERTScore:
 
         out = bert_score(["a b", "a c"], ["a b", "a d"], embedder=self._toy_embedder, idf=True)
         assert np.all(np.isfinite(np.asarray(out["f1"])))
+
+
+class TestSentenceLevelScores:
+    """return_sentence_level_score paths vs per-sentence sacrebleu scores."""
+
+    def test_ter_sentence_level(self):
+        from sacrebleu.metrics import TER as SBTER
+
+        corpus, sentences = translation_edit_rate(
+            BLEU_PREDS, BLEU_TARGETS, return_sentence_level_score=True
+        )
+        assert len(sentences) == len(BLEU_PREDS)
+        sb = SBTER()
+        refs_t = list(map(list, zip(*BLEU_TARGETS)))
+        np.testing.assert_allclose(
+            float(corpus), sb.corpus_score(BLEU_PREDS, refs_t).score / 100, atol=1e-3
+        )
+        for pred, tgts, ours in zip(BLEU_PREDS, BLEU_TARGETS, sentences):
+            expected = sb.sentence_score(pred, list(tgts)).score / 100
+            np.testing.assert_allclose(float(ours), expected, atol=1e-3)
+
+    def test_chrf_sentence_level(self):
+        from sacrebleu.metrics import CHRF
+
+        corpus, sentences = chrf_score(
+            BLEU_PREDS, BLEU_TARGETS, return_sentence_level_score=True
+        )
+        assert len(sentences) == len(BLEU_PREDS)
+        sb = CHRF(word_order=2)  # our default is chrF++ (n_word_order=2)
+        refs_t = list(map(list, zip(*BLEU_TARGETS)))
+        np.testing.assert_allclose(
+            float(corpus), sb.corpus_score(BLEU_PREDS, refs_t).score / 100, atol=1e-3
+        )
+        for pred, tgts, ours in zip(BLEU_PREDS, BLEU_TARGETS, sentences):
+            expected = sb.sentence_score(pred, list(tgts)).score / 100
+            np.testing.assert_allclose(float(ours), expected, atol=2e-2)
