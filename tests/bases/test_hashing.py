@@ -1,0 +1,22 @@
+"""Metric hashing (port of ref tests/bases/test_hashing.py).
+
+Distinct instances must hash differently (id-based), so that containers
+holding several copies of the same metric class treat them as distinct
+children.
+"""
+import pytest
+
+from tests.helpers.testers import DummyListMetric, DummyMetric
+
+
+@pytest.mark.parametrize("metric_cls", [DummyMetric, DummyListMetric])
+def test_metric_hashing(metric_cls):
+    instance_1 = metric_cls()
+    instance_2 = metric_cls()
+
+    assert hash(instance_1) != hash(instance_2)
+    assert id(instance_1) != id(instance_2)
+    # hash is stable across state updates for dict/set membership
+    h = hash(instance_1)
+    instance_1.update()
+    assert hash(instance_1) == h
