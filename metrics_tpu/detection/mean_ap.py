@@ -1,11 +1,13 @@
 """Mean Average Precision (COCO-style) for object detection.
 
 Behavioral parity: /root/reference/torchmetrics/detection/mean_ap.py (790
-LoC), which reimplements the pycocotools evaluation protocol. Here the IoU
-matrices are one fused jnp op per image/class (the reference calls
-torchvision's C++ `box_iou`) and the greedy GT matching is vectorized over
-all IoU thresholds at once (the reference loops Python-side per threshold,
-mean_ap.py:421-539); ranking/accumulation run in numpy on host.
+LoC), which reimplements the pycocotools evaluation protocol. Here the
+greedy GT matching runs in the native C++ core across all IoU thresholds at
+once (the reference loops Python-side per threshold, mean_ap.py:421-539),
+matching is done once per (image, class, area) at the largest detection cap
+with smaller caps sliced as prefixes, and the tiny per-image IoU matrices
+are computed host-side in numpy (the reference calls torchvision's C++
+`box_iou` per pair); ranking/accumulation run in numpy on host.
 
 Default protocol: IoU thresholds 0.50:0.05:0.95, recall grid 0:0.01:1,
 max detections (1, 10, 100), area ranges all/small/medium/large.
@@ -13,13 +15,31 @@ max detections (1, 10, 100), area ranges all/small/medium/large.
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.detection.helpers import box_area, box_convert, box_iou
+from metrics_tpu import native
+from metrics_tpu.detection.helpers import box_convert
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
+
+
+def _box_iou_np(boxes1: np.ndarray, boxes2: np.ndarray) -> np.ndarray:
+    """Pairwise IoU on host — same semantics as ``helpers.box_iou``.
+
+    Evaluation sees many tiny (n_det, n_gt) matrices per (image, class);
+    computing them in numpy avoids one device dispatch per matrix.
+    """
+    if boxes1.shape[0] == 0 or boxes2.shape[0] == 0:
+        return np.zeros((boxes1.shape[0], boxes2.shape[0]))
+    area1 = (boxes1[:, 2] - boxes1[:, 0]) * (boxes1[:, 3] - boxes1[:, 1])
+    area2 = (boxes2[:, 2] - boxes2[:, 0]) * (boxes2[:, 3] - boxes2[:, 1])
+    lt = np.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = np.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return np.where(union > 0, inter / np.where(union > 0, union, 1.0), 0.0)
 
 
 def _input_validator(preds: Sequence[Dict[str, Array]], targets: Sequence[Dict[str, Array]]) -> None:
@@ -109,8 +129,27 @@ class MeanAveragePrecision(Metric):
             self.groundtruth_labels.append(item["labels"])
 
     # -------------------------------------------------------------- internals
-    def _get_classes(self) -> List[int]:
-        all_labels = [np.asarray(x) for x in self.detection_labels + self.groundtruth_labels if x.size]
+    def _host_states(self) -> Dict[str, List[np.ndarray]]:
+        """All accumulated list states as host numpy, in one batched fetch.
+
+        ``jax.device_get`` starts an async copy for every array before
+        blocking on any of them, so the device→host latency is paid once for
+        the whole evaluation instead of once per (image, state) — on a
+        tunneled TPU that is the difference between seconds and minutes.
+        """
+        return jax.device_get(
+            {
+                "det_boxes": list(self.detection_boxes),
+                "det_scores": list(self.detection_scores),
+                "det_labels": list(self.detection_labels),
+                "gt_boxes": list(self.groundtruth_boxes),
+                "gt_labels": list(self.groundtruth_labels),
+            }
+        )
+
+    @staticmethod
+    def _get_classes(host: Dict[str, List[np.ndarray]]) -> List[int]:
+        all_labels = [np.asarray(x) for x in host["det_labels"] + host["gt_labels"] if x.size]
         if not all_labels:
             return []
         return sorted(set(np.concatenate(all_labels).astype(int).tolist()))
@@ -121,7 +160,6 @@ class MeanAveragePrecision(Metric):
         det_scores: np.ndarray,
         gt_boxes: np.ndarray,
         area_rng: Tuple[float, float],
-        max_det: int,
         ious: np.ndarray,
     ) -> Optional[Dict[str, np.ndarray]]:
         """Greedy GT matching for one (image, class) — all IoU thresholds at once.
@@ -130,6 +168,11 @@ class MeanAveragePrecision(Metric):
         score order claim the best still-free GT with IoU above the
         threshold; ignored GTs (outside the area range) can only be claimed
         when no valid GT qualifies and never count as true positives.
+
+        Always evaluated at the largest max-detection cap: greedy matching
+        never looks ahead past the current detection, so results for a
+        smaller cap are exactly the score-order prefix — callers slice
+        instead of re-matching.
         """
         n_det, n_gt = det_boxes.shape[0], gt_boxes.shape[0]
         if n_det == 0 and n_gt == 0:
@@ -142,34 +185,38 @@ class MeanAveragePrecision(Metric):
         gt_order = np.argsort(gt_ignore, kind="stable")
         gt_ignore_sorted = gt_ignore[gt_order]
 
-        order = np.argsort(-det_scores, kind="stable")[:max_det]
+        order = np.argsort(-det_scores, kind="stable")[: self.max_detection_thresholds[-1]]
         det_boxes = det_boxes[order]
         det_scores = det_scores[order]
         n_det = det_boxes.shape[0]
         ious_sorted = ious[order][:, gt_order] if n_gt and n_det else np.zeros((n_det, n_gt))
 
         n_thr = len(self.iou_thresholds)
-        det_matched = np.zeros((n_thr, n_det), dtype=bool)
-        det_matched_ignored = np.zeros((n_thr, n_det), dtype=bool)
-        gt_matched = np.zeros((n_thr, n_gt), dtype=bool)
-
-        for t, thr in enumerate(self.iou_thresholds):
-            for d in range(n_det):
-                best_iou = min(thr, 1 - 1e-10)
-                best_g = -1
-                for g in range(n_gt):
-                    if gt_matched[t, g]:
-                        continue
-                    # once we hit ignored gts, stop if a valid match exists
-                    if best_g > -1 and not gt_ignore_sorted[best_g] and gt_ignore_sorted[g]:
-                        break
-                    if ious_sorted[d, g] >= best_iou:
-                        best_iou = ious_sorted[d, g]
-                        best_g = g
-                if best_g > -1:
-                    det_matched[t, d] = True
-                    gt_matched[t, best_g] = True
-                    det_matched_ignored[t, d] = gt_ignore_sorted[best_g]
+        thrs = np.asarray(self.iou_thresholds, dtype=np.float64)
+        matched = native.coco_match(ious_sorted, gt_ignore_sorted, thrs)
+        if matched is not None:
+            det_matched, det_matched_ignored = matched
+        else:  # pure-numpy fallback (METRICS_TPU_DISABLE_NATIVE / no toolchain)
+            det_matched = np.zeros((n_thr, n_det), dtype=bool)
+            det_matched_ignored = np.zeros((n_thr, n_det), dtype=bool)
+            gt_matched = np.zeros((n_thr, n_gt), dtype=bool)
+            for t, thr in enumerate(self.iou_thresholds):
+                for d in range(n_det):
+                    best_iou = min(thr, 1 - 1e-10)
+                    best_g = -1
+                    for g in range(n_gt):
+                        if gt_matched[t, g]:
+                            continue
+                        # once we hit ignored gts, stop if a valid match exists
+                        if best_g > -1 and not gt_ignore_sorted[best_g] and gt_ignore_sorted[g]:
+                            break
+                        if ious_sorted[d, g] >= best_iou:
+                            best_iou = ious_sorted[d, g]
+                            best_g = g
+                    if best_g > -1:
+                        det_matched[t, d] = True
+                        gt_matched[t, best_g] = True
+                        det_matched_ignored[t, d] = gt_ignore_sorted[best_g]
 
         det_areas = (det_boxes[:, 2] - det_boxes[:, 0]) * (det_boxes[:, 3] - det_boxes[:, 1])
         det_out_of_range = (det_areas < area_rng[0]) | (det_areas > area_rng[1])
@@ -182,13 +229,13 @@ class MeanAveragePrecision(Metric):
             "n_gt": int((~gt_ignore).sum()),
         }
 
-    def _calculate(self, class_ids: List[int]):
+    def _calculate(self, class_ids: List[int], host: Dict[str, List[np.ndarray]]):
         """Precision/recall grids over (thr, rec, class, area, maxdet) (ref mean_ap.py:586-670)."""
-        det_boxes = [np.asarray(x, dtype=np.float64) for x in self.detection_boxes]
-        det_scores = [np.asarray(x, dtype=np.float64) for x in self.detection_scores]
-        det_labels = [np.asarray(x).astype(int) for x in self.detection_labels]
-        gt_boxes = [np.asarray(x, dtype=np.float64) for x in self.groundtruth_boxes]
-        gt_labels = [np.asarray(x).astype(int) for x in self.groundtruth_labels]
+        det_boxes = [np.asarray(x, dtype=np.float64) for x in host["det_boxes"]]
+        det_scores = [np.asarray(x, dtype=np.float64) for x in host["det_scores"]]
+        det_labels = [np.asarray(x).astype(int) for x in host["det_labels"]]
+        gt_boxes = [np.asarray(x, dtype=np.float64) for x in host["gt_boxes"]]
+        gt_labels = [np.asarray(x).astype(int) for x in host["gt_labels"]]
 
         n_imgs = len(gt_boxes)
         n_thr = len(self.iou_thresholds)
@@ -204,34 +251,31 @@ class MeanAveragePrecision(Metric):
         rec_thrs = np.asarray(self.rec_thresholds)
 
         for c_idx, cls in enumerate(class_ids):
-            # per-image detections/gts of this class + device IoU matrices
+            # per-image detections/gts of this class; IoU on host — the
+            # matrices are tiny, so numpy beats a per-call device dispatch
             per_img = []
             for i in range(n_imgs):
                 dmask = det_labels[i] == cls
                 gmask = gt_labels[i] == cls
                 db, ds = det_boxes[i][dmask], det_scores[i][dmask]
                 gb = gt_boxes[i][gmask]
-                if db.shape[0] and gb.shape[0]:
-                    iou = np.asarray(box_iou(jnp.asarray(db), jnp.asarray(gb)), dtype=np.float64)
-                else:
-                    iou = np.zeros((db.shape[0], gb.shape[0]))
-                per_img.append((db, ds, gb, iou))
+                per_img.append((db, ds, gb, _box_iou_np(db, gb)))
 
             for a_idx, area_rng in enumerate(self.bbox_area_ranges.values()):
+                # one greedy match per image at the largest cap; smaller caps
+                # reuse score-order prefixes of the same match
+                results = [self._evaluate_image(db, ds, gb, area_rng, iou) for db, ds, gb, iou in per_img]
+                results = [r for r in results if r is not None]
                 for m_idx, max_det in enumerate(self.max_detection_thresholds):
-                    results = [
-                        self._evaluate_image(db, ds, gb, area_rng, max_det, iou) for db, ds, gb, iou in per_img
-                    ]
-                    results = [r for r in results if r is not None]
                     if not results:
                         continue
                     npig = sum(r["n_gt"] for r in results)
                     if npig == 0:
                         continue
 
-                    scores = np.concatenate([r["scores"] for r in results])
-                    matched = np.concatenate([r["matched"] for r in results], axis=1)
-                    ignored = np.concatenate([r["ignored"] for r in results], axis=1)
+                    scores = np.concatenate([r["scores"][:max_det] for r in results])
+                    matched = np.concatenate([r["matched"][:, :max_det] for r in results], axis=1)
+                    ignored = np.concatenate([r["ignored"][:, :max_det] for r in results], axis=1)
 
                     order = np.argsort(-scores, kind="mergesort")
                     matched = matched[:, order]
@@ -291,8 +335,9 @@ class MeanAveragePrecision(Metric):
 
     def compute(self) -> Dict[str, Array]:
         """COCO metric dict (ref mean_ap.py:737-790)."""
-        classes = self._get_classes()
-        precision, recall = self._calculate(classes)
+        host = self._host_states()
+        classes = self._get_classes(host)
+        precision, recall = self._calculate(classes, host)
         map_val, mar_val = self._summarize_results(precision, recall)
 
         map_per_class = [-1.0]
@@ -306,8 +351,9 @@ class MeanAveragePrecision(Metric):
                 map_per_class.append(cls_map["map"])
                 mar_per_class.append(cls_mar[f"mar_{self.max_detection_thresholds[-1]}"])
 
-        metrics: Dict[str, Array] = {k: jnp.asarray(v) for k, v in {**map_val, **mar_val}.items()}
-        metrics["map_per_class"] = jnp.asarray(map_per_class)
-        metrics[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray(mar_per_class)
-        metrics["classes"] = jnp.asarray(classes if classes else [-1])
-        return metrics
+        metrics = {k: np.asarray(v) for k, v in {**map_val, **mar_val}.items()}
+        metrics["map_per_class"] = np.asarray(map_per_class)
+        metrics[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = np.asarray(mar_per_class)
+        metrics["classes"] = np.asarray(classes if classes else [-1])
+        # one batched host→device transfer for the whole result dict
+        return jax.device_put(metrics)

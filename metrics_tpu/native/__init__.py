@@ -95,6 +95,13 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
     ]
+    lib.tm_coco_match.restype = None
+    lib.tm_coco_match.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+    ]
     lib.tm_eed.restype = ctypes.c_double
     lib.tm_eed.argtypes = [
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
@@ -166,3 +173,32 @@ def levenshtein_batch_ids(
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return out
+
+
+def coco_match(
+    ious: np.ndarray, gt_ignore: np.ndarray, thresholds: np.ndarray
+) -> "Optional[tuple]":
+    """Greedy COCO GT matching over all IoU thresholds for one (image, class).
+
+    ``ious`` is (n_det, n_gt) with detections sorted by score desc and gts
+    sorted ignored-last. Returns (det_matched, det_matched_ignored), both
+    (n_thr, n_det) bool; None if the native core is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    n_det, n_gt = ious.shape
+    n_thr = len(thresholds)
+    ious = np.ascontiguousarray(ious, dtype=np.float64)
+    gt_ig = np.ascontiguousarray(gt_ignore, dtype=np.uint8)
+    thrs = np.ascontiguousarray(thresholds, dtype=np.float64)
+    det_matched = np.zeros((n_thr, n_det), dtype=np.uint8)
+    det_matched_ig = np.zeros((n_thr, n_det), dtype=np.uint8)
+    lib.tm_coco_match(
+        ious.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n_det, n_gt,
+        gt_ig.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        thrs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n_thr,
+        det_matched.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        det_matched_ig.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return det_matched.astype(bool), det_matched_ig.astype(bool)

@@ -101,4 +101,34 @@ void tm_levenshtein_batch(const int32_t* a_flat, const int64_t* a_offsets,
     }
 }
 
+// COCO greedy GT matching for one (image, class) across all IoU thresholds
+// (ref detection/mean_ap.py:421-539 — there a per-threshold Python loop).
+// `ious` is row-major (n_det x n_gt) with detections pre-sorted by score desc
+// and gts pre-sorted ignored-last; outputs are row-major (n_thr x n_det).
+void tm_coco_match(const double* ious, int64_t n_det, int64_t n_gt,
+                   const uint8_t* gt_ignore, const double* thrs, int64_t n_thr,
+                   uint8_t* det_matched, uint8_t* det_matched_ignored) {
+    std::vector<uint8_t> gt_matched(static_cast<size_t>(n_gt));
+    for (int64_t t = 0; t < n_thr; ++t) {
+        std::fill(gt_matched.begin(), gt_matched.end(), 0);
+        for (int64_t d = 0; d < n_det; ++d) {
+            double best_iou = std::min(thrs[t], 1.0 - 1e-10);
+            int64_t best_g = -1;
+            for (int64_t g = 0; g < n_gt; ++g) {
+                if (gt_matched[g]) continue;
+                // gts are sorted valid-first: once a valid match exists,
+                // stop before claiming an ignored gt
+                if (best_g > -1 && !gt_ignore[best_g] && gt_ignore[g]) break;
+                double v = ious[d * n_gt + g];
+                if (v >= best_iou) { best_iou = v; best_g = g; }
+            }
+            if (best_g > -1) {
+                det_matched[t * n_det + d] = 1;
+                gt_matched[static_cast<size_t>(best_g)] = 1;
+                det_matched_ignored[t * n_det + d] = gt_ignore[best_g];
+            }
+        }
+    }
+}
+
 }  // extern "C"
