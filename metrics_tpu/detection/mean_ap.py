@@ -243,7 +243,6 @@ class MeanAveragePrecision(Metric):
         n_cls = len(class_ids)
         n_area = len(self.bbox_area_ranges)
         n_mdet = len(self.max_detection_thresholds)
-        max_det_cap = self.max_detection_thresholds[-1]
 
         precision = -np.ones((n_thr, n_rec, n_cls, n_area, n_mdet))
         recall = -np.ones((n_thr, n_cls, n_area, n_mdet))
@@ -266,13 +265,10 @@ class MeanAveragePrecision(Metric):
                 # reuse score-order prefixes of the same match
                 results = [self._evaluate_image(db, ds, gb, area_rng, iou) for db, ds, gb, iou in per_img]
                 results = [r for r in results if r is not None]
+                npig = sum(r["n_gt"] for r in results)
+                if npig == 0:
+                    continue
                 for m_idx, max_det in enumerate(self.max_detection_thresholds):
-                    if not results:
-                        continue
-                    npig = sum(r["n_gt"] for r in results)
-                    if npig == 0:
-                        continue
-
                     scores = np.concatenate([r["scores"][:max_det] for r in results])
                     matched = np.concatenate([r["matched"][:, :max_det] for r in results], axis=1)
                     ignored = np.concatenate([r["ignored"][:, :max_det] for r in results], axis=1)

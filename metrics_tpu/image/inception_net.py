@@ -184,8 +184,41 @@ def load_params(npz_path: str) -> Any:
     """Load flax params saved as a flat ``{'/'.join(path): array}`` .npz."""
     from flax.traverse_util import unflatten_dict
 
-    flat = {k: jnp.asarray(v) for k, v in np.load(npz_path).items()}
-    return unflatten_dict(flat, sep="/")
+    flat = {k: v for k, v in np.load(npz_path).items()}
+    # single batched host->device transfer for the whole tree
+    return jax.device_put(unflatten_dict(flat, sep="/"))
+
+
+def cached_random_init(cache_key: str, init_fn: Any) -> Any:
+    """Deterministic random init for a big flax trunk, cached on disk.
+
+    Eager flax ``init`` compiles one XLA executable per op — ~1 min on CPU
+    for an InceptionV3-sized network, minutes over a tunneled TPU. The init
+    is therefore run once on the host CPU backend, saved to
+    ``$XDG_CACHE_HOME/metrics_tpu/<cache_key>.npz``, and every later
+    construction is a file load + one batched device transfer.
+    """
+    import os
+
+    cache_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "metrics_tpu"
+    )
+    path = os.path.join(cache_dir, cache_key + ".npz")
+    if os.path.exists(path):
+        try:
+            return load_params(path)
+        except Exception:  # noqa: BLE001 — corrupt cache (BadZipFile/EOFError/OSError...): rebuild
+            pass
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        variables = init_fn()
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = path[: -len(".npz")] + f".tmp-{os.getpid()}.npz"
+        save_params(tmp, variables)
+        os.replace(tmp, path)  # atomic: concurrent initializers converge
+    except OSError:
+        pass
+    return jax.device_put(variables)
 
 
 def save_params(npz_path: str, variables: Any) -> None:
@@ -236,8 +269,11 @@ class InceptionV3FeatureExtractor:
                 " randomly initialized, so FID/IS/KID values are NOT comparable to published"
                 " numbers. Load pretrained weights (see docs/pretrained_weights.md)."
             )
-            self.variables = self.net.init(
-                jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3), jnp.float32)
+            self.variables = cached_random_init(
+                f"inception_v3_init_c{num_classes}",
+                lambda: self.net.init(
+                    jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3), jnp.float32)
+                ),
             )
 
         def _forward(variables, imgs):

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 import flax.linen as nn
 
-from metrics_tpu.image.inception_net import load_params, save_params  # noqa: F401  (shared weight IO)
+from metrics_tpu.image.inception_net import cached_random_init, load_params, save_params  # noqa: F401  (shared weight IO)
 
 Array = jax.Array
 
@@ -131,7 +131,10 @@ class LPIPSNet:
                 " for publishable LPIPS values (see docs/pretrained_weights.md)."
             )
             dummy = jnp.zeros((1, init_hw, init_hw, 3), jnp.float32)
-            self.variables = self.net.init(jax.random.PRNGKey(0), dummy, dummy)
+            self.variables = cached_random_init(
+                f"lpips_{net_type}_init",
+                lambda: self.net.init(jax.random.PRNGKey(0), dummy, dummy),
+            )
 
         def _forward(variables, img1, img2):
             if img1.shape[1] == 3 and img1.shape[-1] != 3:  # NCHW -> NHWC
