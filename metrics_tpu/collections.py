@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import _flatten_dict, _squeeze_if_scalar
+from metrics_tpu.utilities.exceptions import MetricsUserError
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 
@@ -291,6 +292,31 @@ class MetricCollection:
     def pure_sync(self, states: Dict[str, Dict[str, Any]], axis_name: str) -> Dict[str, Dict[str, Any]]:
         """Cross-device sync of every metric's state over a mesh axis."""
         return {name: m.pure_sync(states[name], axis_name) for name, m in self.items(keep_base=True)}
+
+    def scan_update(self, states: Dict[str, Dict[str, Any]], *batched_args: Any, **batched_kwargs: Any) -> Dict[str, Dict[str, Any]]:
+        """Fold a stack of batches into every metric's state in ONE ``lax.scan``.
+
+        Collection counterpart of :meth:`Metric.scan_update`: the scan body
+        is the fused :meth:`pure_update`, so the whole suite advances over
+        the entire batch stack in a single compiled program (shared work
+        CSE-deduped by XLA, one device dispatch total). All members must be
+        scan-safe (fixed-shape states).
+        """
+        for name, m in self.items(keep_base=True):
+            for state_name, default in m._defaults.items():
+                if isinstance(default, list):
+                    raise MetricsUserError(
+                        f"`scan_update` requires fixed-shape states, but state `{state_name}` of"
+                        f" collection member `{name}` is a list state. Use the per-batch"
+                        " `pure_update` loop (or a Binned* variant) instead."
+                    )
+
+        def body(sts: Dict[str, Dict[str, Any]], batch: Any) -> Any:
+            args, kwargs = batch
+            return self.pure_update(sts, *args, **kwargs), None
+
+        states, _ = jax.lax.scan(body, states, (batched_args, batched_kwargs))
+        return states
 
     def load_pure_state(self, states: Dict[str, Dict[str, Any]], increment: bool = False) -> None:
         """Adopt a state pytree produced by the pure API into the stateful shell.

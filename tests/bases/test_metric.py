@@ -246,6 +246,65 @@ def test_pure_update_jit_and_scan():
     assert np.asarray(m.x) == 0  # shell state untouched
 
 
+def test_scan_update_matches_update_loop():
+    """scan_update folds a batch stack in one program, same result as the loop."""
+    from metrics_tpu import Accuracy
+
+    rng = np.random.RandomState(3)
+    preds = rng.rand(6, 16, 4).astype(np.float32)
+    target = rng.randint(0, 4, (6, 16))
+
+    m = Accuracy(num_classes=4, average="macro")
+    looped = m.state()
+    for i in range(6):
+        looped = m.pure_update(looped, jnp.asarray(preds[i]), jnp.asarray(target[i]))
+
+    scanned = m.scan_update(m.state(), jnp.asarray(preds), jnp.asarray(target))
+    jax.tree_util.tree_map(lambda a, b: np.testing.assert_allclose(a, b), looped, scanned)
+
+    # jitted form and compute parity
+    jscanned = jax.jit(m.scan_update)(m.state(), jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(
+        np.asarray(m.pure_compute(jscanned)), np.asarray(m.pure_compute(looped)), rtol=1e-6
+    )
+
+
+def test_scan_update_rejects_list_states():
+    m = DummyListMetric()
+    with pytest.raises(MetricsUserError, match="fixed-shape"):
+        m.scan_update(m.state(), jnp.zeros((3, 2)))
+
+
+def test_collection_scan_update_rejects_list_state_member():
+    from metrics_tpu import Accuracy, MetricCollection, PrecisionRecallCurve
+
+    mc = MetricCollection(
+        {"acc": Accuracy(num_classes=3), "prc": PrecisionRecallCurve(num_classes=3)},
+        compute_groups=False,
+    )
+    with pytest.raises(MetricsUserError, match="member `prc`"):
+        mc.scan_update(mc.state(), jnp.zeros((2, 4, 3)), jnp.zeros((2, 4), dtype=jnp.int32))
+
+
+def test_collection_scan_update():
+    from metrics_tpu import Accuracy, ConfusionMatrix, MetricCollection
+
+    rng = np.random.RandomState(5)
+    preds = rng.rand(4, 8, 3).astype(np.float32)
+    target = rng.randint(0, 3, (4, 8))
+
+    mc = MetricCollection(
+        {"acc": Accuracy(num_classes=3), "cm": ConfusionMatrix(num_classes=3)},
+        compute_groups=False,
+    )
+    states = mc.state()
+    looped = states
+    for i in range(4):
+        looped = mc.pure_update(looped, jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    scanned = jax.jit(mc.scan_update)(states, jnp.asarray(preds), jnp.asarray(target))
+    jax.tree_util.tree_map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), looped, scanned)
+
+
 def test_jit_update_option():
     m = DummyMetricSum(jit_update=True)
     m.update(jnp.asarray(2.0))
