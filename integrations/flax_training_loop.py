@@ -7,7 +7,7 @@ batch value; compute/reset at epoch boundaries). This example shows the
 same contract inside an idiomatic JAX/Flax training loop, including the
 fully-jitted distributed variant.
 
-Run: JAX_PLATFORMS=cpu python integrations/flax_training_loop.py
+Run: python integrations/flax_training_loop.py
 """
 
 # allow running uninstalled: put the repo root on sys.path
@@ -17,7 +17,15 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from functools import partial
 
+# CPU mesh demo; the config API (not the JAX_PLATFORMS env var, which site
+# platform plugins can override — see conftest.py) pins the backend, and
+# must run before jax initializes.
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+
 import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 

@@ -1,0 +1,111 @@
+"""Whole-epoch evaluation in ONE compiled program — scan_update patterns.
+
+The reference evaluates a dataset with one ``update()`` call per batch
+(/root/reference/torchmetrics/metric.py:270-280 driven by a host loop);
+every step pays a Python->device dispatch. On TPU the idiomatic form is to
+stack the batches and fold them into the metric state with ``lax.scan``
+inside a single jitted program — ``Metric.scan_update`` — so the epoch
+costs one dispatch. Combined with ``shard_map`` the same program also
+shards the batch axis over the device mesh and syncs states with XLA
+collectives at the end: a full distributed evaluation pass, compiled once.
+
+Run: python integrations/scan_eval_loop.py
+"""
+
+# allow running uninstalled: put the repo root on sys.path
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from functools import partial
+
+# 8 virtual CPU devices for the mesh demo; the config API (not the
+# JAX_PLATFORMS env var, which site platform plugins can override — see
+# conftest.py) pins the backend, and must run before jax initializes.
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, MetricCollection
+
+NUM_CLASSES = 6
+NUM_BATCHES = 32
+BATCH = 64
+
+
+def _fake_epoch(rng: np.random.RandomState):
+    logits = rng.rand(NUM_BATCHES, BATCH, NUM_CLASSES).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)))
+    return preds, target
+
+
+def single_device_scan() -> None:
+    """Entire eval epoch for a 3-metric suite: one jitted dispatch."""
+    suite = MetricCollection(
+        {"acc": Accuracy(num_classes=NUM_CLASSES, average="macro"),
+         "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+         "cm": ConfusionMatrix(num_classes=NUM_CLASSES)},
+        compute_groups=False,
+    )
+    preds, target = _fake_epoch(np.random.RandomState(0))
+
+    epoch_state = jax.jit(suite.scan_update)(suite.state(), preds, target)
+    values = suite.pure_compute(epoch_state)
+    print("single-device scan:", {k: np.round(np.asarray(v), 4).tolist() if np.asarray(v).ndim else round(float(v), 4)
+                                  for k, v in values.items() if k != "cm"})
+
+    # the stateful shell can adopt the scanned state (checkpointing, logging)
+    suite.load_pure_state(epoch_state, increment=True)
+    assert np.allclose(np.asarray(suite.compute()["acc"]), np.asarray(values["acc"]))
+
+
+def sharded_scan() -> None:
+    """Same epoch, batch axis sharded over an 8-device mesh.
+
+    Each device scans its shard of the batches, then states sync once via
+    XLA collectives (``pure_sync``) — the whole thing is one compiled SPMD
+    program. This is the TPU-native counterpart of the reference's
+    DDP loop + ``gather_all_tensors`` at compute time.
+    """
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n_dev = len(jax.devices())
+    metric = Accuracy(num_classes=NUM_CLASSES, average="macro")
+    preds, target = _fake_epoch(np.random.RandomState(0))
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    state_specs = jax.tree_util.tree_map(lambda _: P(), metric.state())
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(state_specs, P("dp"), P("dp")),
+        out_specs=state_specs,
+        check_vma=False,
+    )
+    def eval_epoch(state, preds_shard, target_shard):
+        state = metric.scan_update(state, preds_shard, target_shard)
+        return metric.pure_sync(state, "dp")
+
+    state = eval_epoch(metric.state(), preds, target)
+    dist_val = float(metric.pure_compute(state))
+
+    # reference value: plain scan over the full epoch on one device
+    full = metric.scan_update(metric.state(), preds, target)
+    full_val = float(metric.pure_compute(full))
+    print(f"sharded scan over {n_dev} devices: {dist_val:.6f} (single-device: {full_val:.6f})")
+    assert abs(dist_val - full_val) < 1e-6
+
+
+if __name__ == "__main__":
+    single_device_scan()
+    sharded_scan()
+    print("ok")

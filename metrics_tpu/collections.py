@@ -15,9 +15,8 @@ from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tupl
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, _raise_if_list_state, _scan_fold
 from metrics_tpu.utilities.data import _flatten_dict, _squeeze_if_scalar
-from metrics_tpu.utilities.exceptions import MetricsUserError
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 
@@ -303,20 +302,8 @@ class MetricCollection:
         scan-safe (fixed-shape states).
         """
         for name, m in self.items(keep_base=True):
-            for state_name, default in m._defaults.items():
-                if isinstance(default, list):
-                    raise MetricsUserError(
-                        f"`scan_update` requires fixed-shape states, but state `{state_name}` of"
-                        f" collection member `{name}` is a list state. Use the per-batch"
-                        " `pure_update` loop (or a Binned* variant) instead."
-                    )
-
-        def body(sts: Dict[str, Dict[str, Any]], batch: Any) -> Any:
-            args, kwargs = batch
-            return self.pure_update(sts, *args, **kwargs), None
-
-        states, _ = jax.lax.scan(body, states, (batched_args, batched_kwargs))
-        return states
+            _raise_if_list_state(m._defaults, f"collection member `{name}`")
+        return _scan_fold(self.pure_update, states, batched_args, batched_kwargs)
 
     def load_pure_state(self, states: Dict[str, Dict[str, Any]], increment: bool = False) -> None:
         """Adopt a state pytree produced by the pure API into the stateful shell.

@@ -65,6 +65,29 @@ def jit_distributed_available() -> bool:
     return default_env().is_distributed()
 
 
+def _raise_if_list_state(defaults: Dict[str, Any], owner: str) -> None:
+    """Scan-safety guard shared by Metric/MetricCollection ``scan_update``."""
+    for name, default in defaults.items():
+        if isinstance(default, list):
+            raise MetricsUserError(
+                f"`scan_update` requires fixed-shape states, but state `{name}` of"
+                f" {owner} is a list state. Use the per-batch `pure_update` loop"
+                " (or a Binned* variant) instead."
+            )
+
+
+def _scan_fold(update_fn: Callable, state: Any, batched_args: Tuple, batched_kwargs: Dict) -> Any:
+    """``lax.scan`` of a pure ``(state, *args, **kwargs) -> state`` reducer
+    over the leading batch axis of the given arg/kwarg pytrees."""
+
+    def body(st: Any, batch: Tuple[Tuple, Dict]) -> Tuple[Any, None]:
+        args, kwargs = batch
+        return update_fn(st, *args, **kwargs), None
+
+    state, _ = jax.lax.scan(body, state, (batched_args, batched_kwargs))
+    return state
+
+
 class Metric(ABC):
     """Base class for all metrics.
 
@@ -282,20 +305,8 @@ class Metric(ABC):
         Requires a scan-safe metric: fixed-shape array states (no list
         states) and no value-dependent Python control flow in ``update``.
         """
-        for name, default in self._defaults.items():
-            if isinstance(default, list):
-                raise MetricsUserError(
-                    f"`scan_update` requires fixed-shape states, but state `{name}` of"
-                    f" {self.__class__.__name__} is a list state. Use the per-batch"
-                    " `pure_update` loop (or a Binned* variant) instead."
-                )
-
-        def body(st: Dict[str, StateType], batch: Tuple[Tuple, Dict]) -> Tuple[Dict[str, StateType], None]:
-            args, kwargs = batch
-            return self.pure_update(st, *args, **kwargs), None
-
-        state, _ = jax.lax.scan(body, state, (batched_args, batched_kwargs))
-        return state
+        _raise_if_list_state(self._defaults, f"{self.__class__.__name__}")
+        return _scan_fold(self.pure_update, state, batched_args, batched_kwargs)
 
     # ------------------------------------------------------------ fwd/update
     def forward(self, *args: Any, **kwargs: Any) -> Any:
