@@ -281,8 +281,9 @@ def _bench_detail() -> dict:
     _mark("wer_update_ms_1k_pairs")
     detail["wer_native_core"] = native_available()
 
-    # baseline: the reference's own algorithm — a pure-Python rolling-row DP
-    # per pair (ref functional/text/helper.py) over the same corpus
+    # baseline: the reference's own algorithm — the pure-Python two-row
+    # Levenshtein DP (ref functional/text/helper.py:333-350), which is also
+    # this repo's no-toolchain fallback (_edit_distance_py)
     from metrics_tpu.functional.text.helper import _edit_distance_py
 
     pairs = [(p.split(), t.split()) for p, t in zip(corpus_p, corpus_t)]

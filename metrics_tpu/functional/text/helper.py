@@ -4,7 +4,7 @@ Behavioral parity: /root/reference/torchmetrics/functional/text/helper.py
 (_edit_distance :333-350). Host-side string processing — strings never enter
 XLA; only the integer statistics land on device. The O(n*m) dynamic program
 runs in the in-repo C++ core (metrics_tpu/native/edit_distance.cpp) when the
-toolchain is available, with this numpy implementation as the fallback.
+toolchain is available, with a pure-Python two-row DP as the fallback.
 """
 from typing import Dict, List, Sequence, Tuple, Union
 
@@ -26,25 +26,24 @@ def _tokens_to_ids(*seqs: Sequence) -> List[np.ndarray]:
 
 
 def _edit_distance_py(prediction_tokens: Sequence, reference_tokens: Sequence) -> int:
-    """Levenshtein distance between two token sequences (numpy row DP)."""
+    """Levenshtein distance between two token sequences (two-row DP).
+
+    Plain-Python rows beat a numpy-vectorized row at every size (the
+    cur[j-1] dependency forces a Python inner loop either way), measured
+    2-2.5x across L=10..800.
+    """
     n, m = len(prediction_tokens), len(reference_tokens)
     if n == 0:
         return m
     if m == 0:
         return n
-    prev = np.arange(m + 1, dtype=np.int64)
-    for i in range(1, n + 1):
-        cur = np.empty(m + 1, dtype=np.int64)
-        cur[0] = i
-        p_tok = prediction_tokens[i - 1]
-        sub_cost = prev[:-1] + np.asarray([p_tok != r for r in reference_tokens], dtype=np.int64)
-        # cur[j] = min(prev[j] + 1, cur[j-1] + 1, sub_cost[j-1]) — resolve the
-        # cur[j-1] dependency with a running minimum scan
-        best = np.minimum(prev[1:] + 1, sub_cost)
-        for j in range(1, m + 1):
-            cur[j] = min(best[j - 1], cur[j - 1] + 1)
-        prev = cur
-    return int(prev[m])
+    prev_row = list(range(m + 1))
+    for i, p_tok in enumerate(prediction_tokens, 1):
+        cur = [i]
+        for j, r_tok in enumerate(reference_tokens, 1):
+            cur.append(min(prev_row[j] + 1, cur[j - 1] + 1, prev_row[j - 1] + (p_tok != r_tok)))
+        prev_row = cur
+    return prev_row[m]
 
 
 def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence) -> int:
