@@ -13,7 +13,12 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# demo runs on CPU; the config API pins the backend regardless of ambient
+# JAX_PLATFORMS (see conftest.py), and must run before jax initializes
 import jax
+
+jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 
 from metrics_tpu.text import BERTScore

@@ -52,7 +52,7 @@ def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence) -> i
         try:
             a, b = _tokens_to_ids(prediction_tokens, reference_tokens)
         except TypeError:
-            pass  # unhashable tokens — the ==-based numpy DP still applies
+            pass  # unhashable tokens — the ==-based Python DP still applies
         else:
             dist = levenshtein_ids(a, b)
             if dist is not None:

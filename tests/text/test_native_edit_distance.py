@@ -66,7 +66,7 @@ def test_batch_matches_single():
 
 
 def test_unhashable_tokens_use_equality_fallback():
-    """Tokens only need ``==`` for the numpy DP; hashing failures must not raise."""
+    """Tokens only need ``==`` for the Python DP; hashing failures must not raise."""
     assert _edit_distance([[1, 2]], [[1, 2]]) == 0
     assert _edit_distances([([[1]], [[2]]), ([[3]], [[3]])]) == [1, 0]
 
@@ -84,7 +84,7 @@ def test_disable_env_falls_back(monkeypatch):
     monkeypatch.setenv("METRICS_TPU_DISABLE_NATIVE", "1")
     monkeypatch.setattr(native_mod, "_lib", None)
     assert native_mod.levenshtein_ids(np.asarray([1, 2]), np.asarray([1, 3])) is None
-    # the public helper still answers through the numpy fallback
+    # the public helper still answers through the Python fallback
     assert _edit_distance(["a", "b"], ["a", "c"]) == 1
 
 
@@ -103,7 +103,7 @@ def test_eed_native_matches_python(monkeypatch):
     ]
     native_scores = [native_mod.eed_score(h, r, 2.0, 0.3, 0.2, 1.0) for h, r in cases]
 
-    # force the numpy fallback inside _eed_function for the comparison pass
+    # force the pure-Python fallback inside _eed_function for the comparison pass
     monkeypatch.setenv("METRICS_TPU_DISABLE_NATIVE", "1")
     monkeypatch.setattr(native_mod, "_lib", None)
     py_scores = [eed_mod._eed_function(h, r) for h, r in cases]
