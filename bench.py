@@ -296,6 +296,9 @@ def _bench_detail() -> dict:
     # 8-device mesh. Runs in a subprocess on 8 forced host (CPU) devices —
     # the same collective program that rides ICI on a real slice.
     detail["collection_dist_sync_8dev_us"] = _bench_dist_subprocess()
+    # unlike the other keys this one is always measured on 8 forced host-CPU
+    # devices in a subprocess, regardless of the main process's device
+    detail["collection_dist_sync_8dev_device"] = "8 virtual CPU host devices (subprocess)"
     _mark("collection_dist_sync_8dev_us")
 
     return detail
@@ -384,6 +387,9 @@ def main() -> None:
             detail = _bench_detail()
             detail["accuracy_update_us"] = round(ours_us, 2)
             detail["torch_cpu_baseline_us"] = round(base_us, 2)
+            import jax
+
+            detail["device"] = str(jax.devices()[0])
             with open("BENCH_DETAIL.json", "w") as f:
                 json.dump(detail, f, indent=2)
         except Exception as err:  # detail bench must never break the headline

@@ -103,6 +103,18 @@ class TestSacreBLEU:
         ours = float(sacre_bleu_score(BLEU_PREDS, BLEU_TARGETS, tokenize=tokenize, lowercase=lowercase))
         np.testing.assert_allclose(ours, expected, atol=1e-4)
 
+    def test_zh_tokenizer_vs_sacrebleu(self):
+        """CJK segmentation path ('zh' splits Chinese chars before the 13a pass)."""
+        from sacrebleu.metrics import BLEU
+
+        preds = ["猫坐在垫子上", "今天天气很好 it is sunny"]
+        targets = [["猫坐在垫子上面"], ["今天天气真好 it is sunny"]]
+        sb = BLEU(tokenize="zh")
+        refs_t = list(map(list, zip(*targets)))
+        expected = sb.corpus_score(preds, refs_t).score / 100
+        ours = float(sacre_bleu_score(preds, targets, tokenize="zh"))
+        np.testing.assert_allclose(ours, expected, atol=1e-4)
+
     def test_module(self):
         m = SacreBLEUScore()
         m.update(BLEU_PREDS, BLEU_TARGETS)
