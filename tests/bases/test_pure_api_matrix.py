@@ -1,0 +1,119 @@
+"""Module-metric pure-API matrix: jit(pure_update) + pure_compute parity.
+
+The functional jit matrix (test_jit_matrix.py) covers L2; this is the L3
+contract: for every fixed-shape-state module metric, the pure reducer
+compiles under ``jax.jit`` and the (jitted pure_update → pure_compute)
+route produces the same value as the stateful eager update/compute path.
+This is the property that makes metrics usable inside pjit/shard_map/scan
+training steps (SURVEY.md §7's architectural translation).
+
+Intentionally absent (growing list states, so not scan/pjit-safe; use the
+Binned* forms or host-driven updates): curve metrics
+(PrecisionRecallCurve/ROC/AUROC/AveragePrecision/AUC), CalibrationError,
+CosineSimilarity, SpearmanCorrCoef, CatMetric, the image SSIM family
+(preds/target accumulation like the reference), retrieval, text, and
+detection (host-side inputs).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu as M
+import metrics_tpu.functional as F
+from tests.helpers import seed_all
+
+seed_all(41)
+_rng = np.random.RandomState(41)
+
+_B, _C = 24, 5
+_probs = _rng.rand(_B, _C).astype(np.float32)
+_probs /= _probs.sum(-1, keepdims=True)
+_labels = _rng.randint(0, _C, _B)
+_bin_scores = _rng.rand(_B).astype(np.float32)
+_bin_labels = _rng.randint(0, 2, _B)
+_ml_scores = _rng.rand(_B, _C).astype(np.float32)
+_ml_labels = _rng.randint(0, 2, (_B, _C))
+_reg_p = _rng.rand(_B).astype(np.float32)
+_reg_t = _rng.rand(_B).astype(np.float32)
+_audio_p = _rng.randn(4, 200).astype(np.float32)
+_audio_t = _rng.randn(4, 200).astype(np.float32)
+_pit_p = _rng.randn(3, 2, 100).astype(np.float32)
+_pit_t = _rng.randn(3, 2, 100).astype(np.float32)
+
+# (name, ctor, update args) — every fixed-shape-state module metric
+MATRIX = [
+    ("Accuracy", lambda: M.Accuracy(num_classes=_C), (_probs, _labels)),
+    ("Accuracy-macro", lambda: M.Accuracy(num_classes=_C, average="macro"), (_probs, _labels)),
+    ("Precision", lambda: M.Precision(num_classes=_C, average="macro"), (_probs, _labels)),
+    ("Recall", lambda: M.Recall(num_classes=_C, average="macro"), (_probs, _labels)),
+    ("Specificity", lambda: M.Specificity(num_classes=_C, average="macro"), (_probs, _labels)),
+    ("F1Score", lambda: M.F1Score(num_classes=_C, average="macro"), (_probs, _labels)),
+    ("FBetaScore", lambda: M.FBetaScore(num_classes=_C, beta=2.0, average="macro"), (_probs, _labels)),
+    ("StatScores", lambda: M.StatScores(num_classes=_C, reduce="macro"), (_probs, _labels)),
+    ("HammingDistance", lambda: M.HammingDistance(), (_ml_scores, _ml_labels)),
+    ("ConfusionMatrix", lambda: M.ConfusionMatrix(num_classes=_C), (_probs, _labels)),
+    ("CohenKappa", lambda: M.CohenKappa(num_classes=_C), (_probs, _labels)),
+    ("MatthewsCorrCoef", lambda: M.MatthewsCorrCoef(num_classes=_C), (_probs, _labels)),
+    ("JaccardIndex", lambda: M.JaccardIndex(num_classes=_C), (_probs, _labels)),
+    ("BinnedPrecisionRecallCurve", lambda: M.BinnedPrecisionRecallCurve(num_classes=_C, thresholds=8), (_probs, _ml_labels)),
+    ("BinnedAveragePrecision", lambda: M.BinnedAveragePrecision(num_classes=_C, thresholds=8), (_probs, _ml_labels)),
+    ("KLDivergence", lambda: M.KLDivergence(), (_probs, _probs[::-1].copy())),
+    ("HingeLoss", lambda: M.HingeLoss(), (_bin_scores, _bin_labels)),
+    # CalibrationError is intentionally absent: it keeps growing list states
+    # (confidences/accuracies, cat-reduced) and is not scan/pjit-safe.
+    ("CoverageError", lambda: M.CoverageError(), (_ml_scores, _ml_labels)),
+    ("LabelRankingAveragePrecision", lambda: M.LabelRankingAveragePrecision(), (_ml_scores, _ml_labels)),
+    ("LabelRankingLoss", lambda: M.LabelRankingLoss(), (_ml_scores, _ml_labels)),
+    ("MeanSquaredError", lambda: M.MeanSquaredError(), (_reg_p, _reg_t)),
+    ("MeanAbsoluteError", lambda: M.MeanAbsoluteError(), (_reg_p, _reg_t)),
+    ("MeanSquaredLogError", lambda: M.MeanSquaredLogError(), (_reg_p, _reg_t)),
+    ("MeanAbsolutePercentageError", lambda: M.MeanAbsolutePercentageError(), (_reg_p, _reg_t)),
+    ("SymmetricMeanAbsolutePercentageError", lambda: M.SymmetricMeanAbsolutePercentageError(), (_reg_p, _reg_t)),
+    ("WeightedMeanAbsolutePercentageError", lambda: M.WeightedMeanAbsolutePercentageError(), (_reg_p, _reg_t)),
+    ("ExplainedVariance", lambda: M.ExplainedVariance(), (_reg_p, _reg_t)),
+    ("R2Score", lambda: M.R2Score(), (_reg_p, _reg_t)),
+    ("TweedieDevianceScore", lambda: M.TweedieDevianceScore(power=1.5), (np.abs(_reg_p) + 0.1, np.abs(_reg_t) + 0.1)),
+    ("PearsonCorrCoef", lambda: M.PearsonCorrCoef(), (_reg_p, _reg_t)),
+    ("PeakSignalNoiseRatio", lambda: M.PeakSignalNoiseRatio(data_range=1.0), (_ml_scores, _ml_scores * 0.9)),
+    ("SignalNoiseRatio", lambda: M.SignalNoiseRatio(), (_audio_p, _audio_t)),
+    ("ScaleInvariantSignalNoiseRatio", lambda: M.ScaleInvariantSignalNoiseRatio(), (_audio_p, _audio_t)),
+    # SDR solves an ill-conditioned Toeplitz system in f32 (see
+    # functional/audio/sdr.py precision note): jit's op reordering moves the
+    # result by ~0.5%, so it gets a looser tolerance below.
+    ("SignalDistortionRatio", lambda: M.SignalDistortionRatio(), (_audio_p, _audio_t)),
+    ("ScaleInvariantSignalDistortionRatio", lambda: M.ScaleInvariantSignalDistortionRatio(), (_audio_p, _audio_t)),
+    ("PermutationInvariantTraining",
+     lambda: M.PermutationInvariantTraining(F.scale_invariant_signal_noise_ratio),
+     (_pit_p, _pit_t)),
+    ("MaxMetric", lambda: M.MaxMetric(), (_reg_p,)),
+    ("MinMetric", lambda: M.MinMetric(), (_reg_p,)),
+    ("SumMetric", lambda: M.SumMetric(), (_reg_p,)),
+    ("MeanMetric", lambda: M.MeanMetric(), (_reg_p,)),
+]
+
+
+_LOOSE_RTOL = {"SignalDistortionRatio": 1e-2}
+
+
+@pytest.mark.parametrize("name,ctor,args", MATRIX, ids=[m[0] for m in MATRIX])
+def test_jitted_pure_route_matches_stateful(name, ctor, args):
+    args = tuple(jnp.asarray(a) for a in args)
+    rtol = _LOOSE_RTOL.get(name, 1e-5)
+
+    stateful = ctor()
+    stateful.update(*args)
+    stateful.update(*args)
+    expected = stateful.compute()
+
+    pure = ctor()
+    step = jax.jit(pure.pure_update)
+    state = step(pure.state(), *args)
+    state = step(state, *args)
+    got = pure.pure_compute(state)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=1e-6),
+        expected,
+        got,
+    )
