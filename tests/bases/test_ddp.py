@@ -208,3 +208,29 @@ def test_process_env_uneven_gather(monkeypatch):
     assert len(out) == 2
     np.testing.assert_allclose(np.asarray(out[0]), [4.0])  # trimmed back to size 1
     np.testing.assert_allclose(np.asarray(out[1]), [1.0, 2.0, 3.0])
+
+
+def test_scan_update_inside_shard_map():
+    """Epoch scan + collective sync as one SPMD program (the scan_eval pattern)."""
+    from metrics_tpu import Accuracy
+
+    num_classes = 4
+    metric = Accuracy(num_classes=num_classes, average="macro")
+    rng = np.random.RandomState(7)
+    n_batches, per_batch = 16, 8
+    logits = rng.rand(n_batches, per_batch, num_classes).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, num_classes, (n_batches, per_batch)))
+
+    run = shard_map(
+        lambda st, p, t: metric.pure_sync(metric.scan_update(st, p, t), "r"),
+        mesh=_mesh(),
+        in_specs=(P(), P("r"), P("r")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    state = jax.jit(run)(metric.state(), preds, target)
+    dist_val = float(metric.pure_compute(state))
+
+    full = metric.scan_update(metric.state(), preds, target)
+    np.testing.assert_allclose(dist_val, float(metric.pure_compute(full)), rtol=1e-6)
