@@ -8,7 +8,7 @@ unlike the reference, which loops over thresholds in Python
 (binned_precision_recall.py:155-160), here all thresholds are evaluated in
 a single fused XLA reduction.
 """
-from typing import Any, List, Optional, Tuple, Union
+from typing import Any, List, Tuple, Union
 
 import jax
 import jax.numpy as jnp

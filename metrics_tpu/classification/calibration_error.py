@@ -3,7 +3,7 @@
 Behavioral parity: /root/reference/torchmetrics/classification/
 calibration_error.py (105 LoC).
 """
-from typing import Any, List
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -1,7 +1,5 @@
 """Box utilities for detection metrics — pure jnp (the reference delegates to
 torchvision's C++ ops, mean_ap.py:24)."""
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 

@@ -6,7 +6,7 @@ compare + axis-sum — trivially fused by XLA. Shape-changing options
 (``ignore_index`` with boolean masking) run eagerly; the common static paths
 (micro/macro/samples reduces, column-drop ignore) are jit-clean.
 """
-from typing import List, Optional, Tuple, Union
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp

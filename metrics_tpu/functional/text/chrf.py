@@ -5,8 +5,8 @@ Behavioral parity: /root/reference/torchmetrics/functional/text/chrf.py
 (order 6) plus optional word n-grams (chrF++), F-beta with beta=2,
 micro-averaged over the corpus (or returned per sentence).
 """
-from collections import Counter, defaultdict
-from typing import Dict, List, Optional, Sequence, Tuple, Union
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp

@@ -6,7 +6,7 @@ XLA; only the integer statistics land on device. The O(n*m) dynamic program
 runs in the in-repo C++ core (metrics_tpu/native/edit_distance.cpp) when the
 toolchain is available, with a pure-Python two-row DP as the fallback.
 """
-from typing import Dict, List, Sequence, Tuple, Union
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 

@@ -7,7 +7,7 @@ the 13a pass. Builds on the BLEU n-gram machinery.
 """
 import re
 from functools import partial
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

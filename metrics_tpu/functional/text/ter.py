@@ -7,7 +7,6 @@ per sentence, micro-averaged over the corpus.
 """
 import re
 import string
-from functools import lru_cache
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax

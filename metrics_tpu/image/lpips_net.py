@@ -14,7 +14,7 @@ load from a local ``.npz`` (``save_params`` layout shared with
 the computation, shapes, and timings are identical (see inception_net's
 module docstring for the same caveat).
 """
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp

@@ -31,13 +31,13 @@ from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
 from enum import Enum
-from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, Generator, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, NoOpEnv, default_env
+from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, default_env
 from metrics_tpu.utilities.data import (
     _flatten,
     _squeeze_if_scalar,

@@ -23,7 +23,7 @@ one small abstraction, :class:`DistEnv`:
 ``process_group`` in the reference maps to the mesh-axis name in
 :class:`AxisEnv`.
 """
-from typing import Any, Callable, List, Optional, Sequence
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp

@@ -2,7 +2,6 @@
 from typing import Any
 
 import jax
-import jax.numpy as jnp
 
 from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
 from metrics_tpu.metric import Metric

@@ -3,7 +3,7 @@
 Behavioral parity: /root/reference/torchmetrics/text/bleu.py (107 LoC) and
 sacre_bleu.py module (113 LoC).
 """
-from typing import Any, Optional, Sequence
+from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -1,8 +1,7 @@
 """ExtendedEditDistance module (ref /root/reference/torchmetrics/text/eed.py, 126 LoC)."""
-from typing import Any, List, Sequence, Tuple, Union
+from typing import Any, Sequence, Tuple, Union
 
 import jax
-import jax.numpy as jnp
 
 from metrics_tpu.functional.text.eed import _eed_compute, _eed_update
 from metrics_tpu.metric import Metric

@@ -2,7 +2,6 @@
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
-import jax.numpy as jnp
 
 from metrics_tpu.functional.text.rouge import (
     ALLOWED_ACCUMULATE_VALUES,

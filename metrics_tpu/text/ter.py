@@ -1,5 +1,5 @@
 """TranslationEditRate module (ref /root/reference/torchmetrics/text/ter.py, 119 LoC)."""
-from typing import Any, List, Optional, Sequence, Tuple, Union
+from typing import Any, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
