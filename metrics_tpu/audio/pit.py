@@ -29,11 +29,15 @@ class PermutationInvariantTraining(Metric):
     full_state_update = False
 
     def __init__(self, metric_func: Callable, eval_func: str = "max", **kwargs: Any) -> None:
-        base_kwargs = {
-            k: kwargs.pop(k)
-            for k in list(kwargs)
-            if k in ("compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn", "sync_env", "jit_update")
-        }
+        # split Metric's own ctor kwargs (derived from its signature, so new
+        # base kwargs are never silently forwarded to metric_func) from the
+        # kwargs destined for the wrapped functional
+        import inspect
+
+        base_names = tuple(
+            p for p in inspect.signature(Metric.__init__).parameters if p not in ("self", "kwargs")
+        )
+        base_kwargs = {k: kwargs.pop(k) for k in list(kwargs) if k in base_names}
         super().__init__(**base_kwargs)
         self.metric_func = metric_func
         self.eval_func = eval_func

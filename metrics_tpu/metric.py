@@ -103,6 +103,13 @@ class Metric(ABC):
             region; the analogue of a torch process group (ref metric.py:101).
         dist_sync_fn: custom gather callable ``(x, env) -> List[Array]``
             (ref metric.py:103).
+        sync_dtype: optional float dtype (e.g. ``jnp.bfloat16``) in which
+            float states cross the interconnect during sync — a
+            reduced-precision collective in the spirit of EQuARX
+            (PAPERS.md) that halves ICI/DCN bytes for large states
+            (binned curves, confusion matrices). Integer/bool states
+            always sync exact; the reduced result is cast back to the
+            state dtype.
         sync_env: explicit :class:`DistEnv`; default is auto-detected
             (multi-process if ``jax.distributed`` is initialized, else no-op).
         jit_update: compile the whole ``(state, batch) -> state`` reducer
@@ -127,6 +134,7 @@ class Metric(ABC):
         dist_sync_fn: Optional[Callable] = None,
         sync_env: Optional[DistEnv] = None,
         jit_update: bool = False,
+        sync_dtype: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         # Unknown kwargs are swallowed for drop-in compatibility with the
@@ -147,6 +155,9 @@ class Metric(ABC):
         if dist_sync_fn is not None and not callable(dist_sync_fn):
             raise ValueError(f"Expected keyword argument `dist_sync_fn` to be a callable but got {dist_sync_fn}")
         self.dist_sync_fn = dist_sync_fn
+        if sync_dtype is not None and not jnp.issubdtype(jnp.dtype(sync_dtype), jnp.floating):
+            raise ValueError(f"Expected keyword argument `sync_dtype` to be a float dtype but got {sync_dtype}")
+        self.sync_dtype = None if sync_dtype is None else jnp.dtype(sync_dtype)
         self._sync_env = sync_env
         self._jit_update_requested = jit_update
         self._jitted_update: Optional[Callable] = None
@@ -427,7 +438,21 @@ class Metric(ABC):
     ) -> None:
         """Gather every state across participants and reduce (ref metric.py:243-268)."""
         env = env or self._resolve_env()
-        gather = dist_sync_fn or (lambda x: env.all_gather(x))
+        # documented custom-gather contract: (state_tensor, env) -> List[Array]
+        base_gather = (lambda x: dist_sync_fn(x, env)) if dist_sync_fn is not None else (lambda x: env.all_gather(x))
+
+        if self.sync_dtype is not None and env.is_distributed():
+            # Reduced-precision collective in the spirit of EQuARX
+            # (PAPERS.md): float states cross the interconnect in the
+            # compressed dtype and the reduced result is cast back.
+            # Integer/bool states are never compressed, and nothing is
+            # quantized when no collective will actually run.
+            def gather(x):
+                if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != self.sync_dtype:
+                    return [g.astype(x.dtype) for g in base_gather(x.astype(self.sync_dtype))]
+                return base_gather(x)
+        else:
+            gather = base_gather
 
         input_dict = {attr: getattr(self, attr) for attr in self._reductions}
 

@@ -234,3 +234,67 @@ def test_scan_update_inside_shard_map():
 
     full = metric.scan_update(metric.state(), preds, target)
     np.testing.assert_allclose(dist_val, float(metric.pure_compute(full)), rtol=1e-6)
+
+
+def test_sync_dtype_compressed_collective():
+    """sync_dtype=bf16: float states cross the wire compressed, ints exact."""
+    import pytest
+
+    class _Mixed(Metric):
+        full_state_update = False
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("fsum", jnp.zeros(64), dist_reduce_fx="sum")
+            self.add_state("count", jnp.asarray(0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.fsum = self.fsum + x
+            self.count = self.count + 1
+
+        def compute(self):
+            return self.fsum.sum() / self.count
+
+    vals = np.random.RandomState(0).rand(WORLD, 64).astype(np.float32)
+    m = _Mixed(sync_dtype=jnp.bfloat16)
+
+    def worker(state, x):
+        st = m.pure_update(state, x[0])
+        return m.pure_sync(st, "r")
+
+    run = shard_map(worker, mesh=_mesh(), in_specs=(P(), P("r")), out_specs=P(), check_vma=False)
+    out = run(m.state(), jnp.asarray(vals))
+    # integer count stayed exact; float sum is bf16-accurate
+    assert np.asarray(out["count"]).item() == WORLD
+    np.testing.assert_allclose(np.asarray(out["fsum"]), vals.sum(0), rtol=1e-2)
+
+    with pytest.raises(ValueError, match="sync_dtype"):
+        _Mixed(sync_dtype=jnp.int32)
+
+
+def test_custom_dist_sync_fn_receives_env():
+    """The documented custom-gather contract is (state_tensor, env)."""
+    seen = []
+
+    def my_gather(x, env):
+        seen.append(type(env).__name__)
+        return [x, x]  # pretend two identical ranks
+
+    class M(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__(dist_sync_fn=my_gather)
+            self.add_state("v", jnp.asarray(3.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.v = self.v + x
+
+        def compute(self):
+            return self.v
+
+    m = M()
+    m.update(jnp.asarray(1.0))
+    m._sync_dist(m.dist_sync_fn, env=NoOpEnv())
+    assert seen == ["NoOpEnv"]
+    np.testing.assert_allclose(float(m.v), 8.0)  # (3+1) gathered twice, summed
