@@ -441,14 +441,22 @@ class Metric(ABC):
         # documented custom-gather contract: (state_tensor, env) -> List[Array]
         base_gather = (lambda x: dist_sync_fn(x, env)) if dist_sync_fn is not None else (lambda x: env.all_gather(x))
 
-        if self.sync_dtype is not None and env.is_distributed():
+        # a collective actually runs when the env is distributed OR the user
+        # supplied their own gather (which may communicate regardless)
+        will_communicate = env.is_distributed() or dist_sync_fn is not None
+        if self.sync_dtype is not None and will_communicate:
             # Reduced-precision collective in the spirit of EQuARX
             # (PAPERS.md): float states cross the interconnect in the
             # compressed dtype and the reduced result is cast back.
-            # Integer/bool states are never compressed, and nothing is
-            # quantized when no collective will actually run.
+            # Integer/bool states are never compressed; nothing is quantized
+            # when no collective will run or when the state is already as
+            # narrow as the compressed dtype (no bytes would be saved).
             def gather(x):
-                if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != self.sync_dtype:
+                if (
+                    jnp.issubdtype(x.dtype, jnp.floating)
+                    and x.dtype != self.sync_dtype
+                    and jnp.dtype(x.dtype).itemsize > self.sync_dtype.itemsize
+                ):
                     return [g.astype(x.dtype) for g in base_gather(x.astype(self.sync_dtype))]
                 return base_gather(x)
         else:
@@ -658,6 +666,19 @@ class Metric(ABC):
             self._cache = {k: ([_put(x) for x in v] if isinstance(v, list) else _put(v)) for k, v in self._cache.items()}
         for _, child in self._children():
             child.to_device(device)
+        return self
+
+    def float(self) -> "Metric":
+        """No-op, like the reference (metric.py:462-488): only
+        :meth:`set_dtype` changes state dtype."""
+        return self
+
+    def double(self) -> "Metric":
+        """No-op (ref metric.py:462-488); use :meth:`set_dtype`."""
+        return self
+
+    def half(self) -> "Metric":
+        """No-op (ref metric.py:462-488); use :meth:`set_dtype`."""
         return self
 
     def set_dtype(self, dst_type) -> "Metric":

@@ -298,3 +298,35 @@ def test_custom_dist_sync_fn_receives_env():
     m._sync_dist(m.dist_sync_fn, env=NoOpEnv())
     assert seen == ["NoOpEnv"]
     np.testing.assert_allclose(float(m.v), 8.0)  # (3+1) gathered twice, summed
+
+
+def test_sync_dtype_actually_compresses_on_the_wire():
+    """A recording gather proves f32 states cross as bf16, ints as-is, and
+    f16 states (no bytes saved) stay untouched."""
+    seen = {}
+
+    def recording_gather(x, env):
+        seen[str(x.dtype)] = seen.get(str(x.dtype), 0) + 1
+        return [x, x]
+
+    class M(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__(dist_sync_fn=recording_gather, sync_dtype=jnp.bfloat16)
+            self.add_state("f32", jnp.ones(8), dist_reduce_fx="sum")
+            self.add_state("f16", jnp.ones(8, dtype=jnp.float16), dist_reduce_fx="sum")
+            self.add_state("count", jnp.asarray(1), dist_reduce_fx="sum")
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return self.count
+
+    m = M()
+    m._sync_dist(m.dist_sync_fn, env=NoOpEnv())
+    assert seen == {"bfloat16": 1, "float16": 1, "int32": 1}
+    # reduced results cast back to the original state dtypes
+    assert m.f32.dtype == jnp.float32 and m.f16.dtype == jnp.float16
+    np.testing.assert_allclose(np.asarray(m.f32), 2.0 * np.ones(8))

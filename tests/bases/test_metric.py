@@ -322,3 +322,12 @@ def test_compute_on_cpu_moves_list_states():
     m = L(compute_on_cpu=True)
     m.update(jnp.ones(4))
     assert all(next(iter(v.devices())).platform == "cpu" for v in m.x)
+
+
+def test_float_half_double_are_noops():
+    """Parity with the reference: plain casts never change state dtype (ref metric.py:462-488)."""
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    for cast in (m.float, m.double, m.half):
+        assert cast() is m
+        assert m.x.dtype == jnp.float32
