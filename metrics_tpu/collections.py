@@ -350,6 +350,18 @@ class MetricCollection:
             m.set_dtype(dst_type)
         return self
 
+    def float(self) -> "MetricCollection":
+        """No-op, like ``Metric.float`` (ref metric.py:462-488)."""
+        return self
+
+    def double(self) -> "MetricCollection":
+        """No-op; use :meth:`set_dtype`."""
+        return self
+
+    def half(self) -> "MetricCollection":
+        """No-op; use :meth:`set_dtype`."""
+        return self
+
     # --------------------------------------------------------------- adding
     def add_metrics(
         self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric

@@ -452,11 +452,7 @@ class Metric(ABC):
             # when no collective will run or when the state is already as
             # narrow as the compressed dtype (no bytes would be saved).
             def gather(x):
-                if (
-                    jnp.issubdtype(x.dtype, jnp.floating)
-                    and x.dtype != self.sync_dtype
-                    and jnp.dtype(x.dtype).itemsize > self.sync_dtype.itemsize
-                ):
+                if jnp.issubdtype(x.dtype, jnp.floating) and jnp.dtype(x.dtype).itemsize > self.sync_dtype.itemsize:
                     return [g.astype(x.dtype) for g in base_gather(x.astype(self.sync_dtype))]
                 return base_gather(x)
         else:
