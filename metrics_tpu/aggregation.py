@@ -73,7 +73,16 @@ class BaseAggregator(Metric):
 
 
 class MaxMetric(BaseAggregator):
-    """Running maximum of all seen values (ref aggregation.py:101-157)."""
+    """Running maximum of all seen values (ref aggregation.py:101-157).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MaxMetric
+        >>> m = MaxMetric()
+        >>> m.update(jnp.asarray([1.0, 3.0, 2.0]))
+        >>> float(m.compute())
+        3.0
+    """
 
     full_state_update = True
 
@@ -87,7 +96,16 @@ class MaxMetric(BaseAggregator):
 
 
 class MinMetric(BaseAggregator):
-    """Running minimum of all seen values (ref aggregation.py:160-214)."""
+    """Running minimum of all seen values (ref aggregation.py:160-214).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MinMetric
+        >>> m = MinMetric()
+        >>> m.update(jnp.asarray([1.0, 3.0, 2.0]))
+        >>> float(m.compute())
+        1.0
+    """
 
     full_state_update = True
 
@@ -101,7 +119,16 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum of all seen values (ref aggregation.py:217-270)."""
+    """Running sum of all seen values (ref aggregation.py:217-270).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric
+        >>> m = SumMetric()
+        >>> m.update(jnp.asarray([1.0, 3.0, 2.0]))
+        >>> float(m.compute())
+        6.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
@@ -112,7 +139,17 @@ class SumMetric(BaseAggregator):
 
 
 class CatMetric(BaseAggregator):
-    """Concatenate all seen values (ref aggregation.py:273-324)."""
+    """Concatenate all seen values (ref aggregation.py:273-324).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CatMetric
+        >>> m = CatMetric()
+        >>> m.update(jnp.asarray([1.0, 2.0]))
+        >>> m.update(jnp.asarray(3.0))
+        >>> [float(v) for v in m.compute()]
+        [1.0, 2.0, 3.0]
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("cat", [], nan_strategy, **kwargs)
@@ -129,7 +166,16 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean (ref aggregation.py:327-402)."""
+    """Weighted running mean (ref aggregation.py:327-402).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric
+        >>> m = MeanMetric()
+        >>> m.update(jnp.asarray([1.0, 3.0, 2.0]))
+        >>> float(m.compute())
+        2.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)

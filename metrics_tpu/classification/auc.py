@@ -14,7 +14,16 @@ Array = jax.Array
 
 
 class AUC(Metric):
-    """Area Under the Curve from accumulated (x, y) pairs (ref auc.py:22-75)."""
+    """Area Under the Curve from accumulated (x, y) pairs (ref auc.py:22-75).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUC
+        >>> m = AUC()
+        >>> m.update(jnp.asarray([0.0, 0.5, 1.0]), jnp.asarray([0.0, 0.8, 1.0]))
+        >>> round(float(m.compute()), 4)
+        0.65
+    """
 
     is_differentiable = False
     higher_is_better = None

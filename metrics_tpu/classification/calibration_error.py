@@ -17,7 +17,16 @@ Array = jax.Array
 
 class CalibrationError(Metric):
     """Top-label calibration error: ECE ('l1'), MCE ('max'), RMSCE ('l2')
-    (ref calibration_error.py:24-105)."""
+    (ref calibration_error.py:24-105).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CalibrationError
+        >>> m = CalibrationError(n_bins=3)
+        >>> m.update(jnp.asarray([[0.9, 0.1], [0.6, 0.4], [0.2, 0.8]]), jnp.asarray([0, 0, 1]))
+        >>> round(float(m.compute()), 4)
+        0.2333
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -22,7 +22,16 @@ Array = jax.Array
 
 
 class CoverageError(Metric):
-    """Multilabel coverage error (ref ranking.py:26-85)."""
+    """Multilabel coverage error (ref ranking.py:26-85).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CoverageError
+        >>> m = CoverageError()
+        >>> m.update(jnp.asarray([[0.8, 0.3, 0.6], [0.2, 0.7, 0.4]]), jnp.asarray([[1, 0, 1], [0, 1, 0]]))
+        >>> float(m.compute())
+        1.5
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -46,7 +55,16 @@ class CoverageError(Metric):
 
 
 class LabelRankingAveragePrecision(Metric):
-    """Label ranking average precision (ref ranking.py:88-141)."""
+    """Label ranking average precision (ref ranking.py:88-141).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import LabelRankingAveragePrecision
+        >>> m = LabelRankingAveragePrecision()
+        >>> m.update(jnp.asarray([[0.8, 0.3, 0.6], [0.2, 0.7, 0.4]]), jnp.asarray([[1, 0, 1], [0, 1, 0]]))
+        >>> float(m.compute())
+        1.0
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -72,7 +90,16 @@ class LabelRankingAveragePrecision(Metric):
 
 
 class LabelRankingLoss(Metric):
-    """Label ranking loss (ref ranking.py:144-192)."""
+    """Label ranking loss (ref ranking.py:144-192).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import LabelRankingLoss
+        >>> m = LabelRankingLoss()
+        >>> m.update(jnp.asarray([[0.8, 0.3, 0.6], [0.2, 0.7, 0.4]]), jnp.asarray([[1, 0, 1], [0, 1, 0]]))
+        >>> float(m.compute())
+        0.0
+    """
 
     is_differentiable = False
     higher_is_better = False

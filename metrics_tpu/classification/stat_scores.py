@@ -18,7 +18,16 @@ Array = jax.Array
 
 
 class StatScores(Metric):
-    """Accumulate TP/FP/TN/FN counts (ref stat_scores.py:24-242)."""
+    """Accumulate TP/FP/TN/FN counts (ref stat_scores.py:24-242).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import StatScores
+        >>> m = StatScores(num_classes=3, reduce="micro")
+        >>> m.update(jnp.asarray([1, 0, 2, 1]), jnp.asarray([1, 1, 2, 0]))
+        >>> [int(v) for v in m.compute()]  # tp, fp, tn, fn, support
+        [2, 2, 6, 2, 4]
+    """
 
     is_differentiable = False
     higher_is_better = None

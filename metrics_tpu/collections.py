@@ -23,6 +23,14 @@ from metrics_tpu.utilities.prints import rank_zero_warn
 class MetricCollection:
     """Dict-like collection of metrics updated/computed together.
 
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MaxMetric, MetricCollection, SumMetric
+        >>> mc = MetricCollection([SumMetric(), MaxMetric()])
+        >>> mc.update(jnp.asarray([1.0, 2.0]))
+        >>> {k: float(v) for k, v in mc.compute().items()}
+        {'SumMetric': 3.0, 'MaxMetric': 2.0}
+
     Args:
         metrics: a single metric, a sequence (keys become class names), or a
             dict of metrics.

@@ -11,7 +11,18 @@ Array = jax.Array
 
 
 class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
-    """ERGAS over accumulated image batches."""
+    """ERGAS over accumulated image batches.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu import ErrorRelativeGlobalDimensionlessSynthesis
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
+        >>> target = preds * 0.9
+        >>> m = ErrorRelativeGlobalDimensionlessSynthesis()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 2)
+        51.35
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -11,7 +11,18 @@ Array = jax.Array
 
 
 class SpectralAngleMapper(Metric):
-    """SAM over accumulated image batches."""
+    """SAM over accumulated image batches.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu import SpectralAngleMapper
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
+        >>> target = preds * 0.9
+        >>> m = SpectralAngleMapper()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.0001
+    """
 
     is_differentiable = True
     higher_is_better = False

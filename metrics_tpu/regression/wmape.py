@@ -14,7 +14,16 @@ Array = jax.Array
 
 
 class WeightedMeanAbsolutePercentageError(Metric):
-    """WMAPE."""
+    """WMAPE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import WeightedMeanAbsolutePercentageError
+        >>> m = WeightedMeanAbsolutePercentageError()
+        >>> m.update(jnp.asarray([1.2, 2.5, 6.0]), jnp.asarray([1.0, 3.0, 5.0]))
+        >>> round(float(m.compute()), 4)
+        0.1889
+    """
 
     is_differentiable = True
     higher_is_better = False

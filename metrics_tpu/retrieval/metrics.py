@@ -52,7 +52,18 @@ class RetrievalMAP(RetrievalMetric):
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean Reciprocal Rank (ref retrieval/reciprocal_rank.py)."""
+    """Mean Reciprocal Rank (ref retrieval/reciprocal_rank.py)
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMRR
+        >>> m = RetrievalMRR()
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> m.update(preds, target, indexes=jnp.asarray([0, 0, 0, 1, 1, 1, 1]))
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_reciprocal_rank(preds, target)
@@ -83,7 +94,18 @@ class _TopKRetrievalMetric(RetrievalMetric):
 
 
 class RetrievalPrecision(_TopKRetrievalMetric):
-    """Precision@k averaged over queries (ref retrieval/precision.py)."""
+    """Precision@k averaged over queries (ref retrieval/precision.py)
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalPrecision
+        >>> m = RetrievalPrecision(k=2)
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> m.update(preds, target, indexes=jnp.asarray([0, 0, 0, 1, 1, 1, 1]))
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
 
     def __init__(
         self,
@@ -119,7 +141,18 @@ class RetrievalPrecision(_TopKRetrievalMetric):
 
 
 class RetrievalRecall(_TopKRetrievalMetric):
-    """Recall@k averaged over queries (ref retrieval/recall.py)."""
+    """Recall@k averaged over queries (ref retrieval/recall.py)
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRecall
+        >>> m = RetrievalRecall(k=2)
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> m.update(preds, target, indexes=jnp.asarray([0, 0, 0, 1, 1, 1, 1]))
+        >>> round(float(m.compute()), 4)
+        0.75
+    """
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_recall(preds, target, k=self.k)
@@ -133,7 +166,18 @@ class RetrievalRecall(_TopKRetrievalMetric):
 
 
 class RetrievalHitRate(_TopKRetrievalMetric):
-    """HitRate@k averaged over queries (ref retrieval/hit_rate.py)."""
+    """HitRate@k averaged over queries (ref retrieval/hit_rate.py)
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalHitRate
+        >>> m = RetrievalHitRate(k=2)
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> m.update(preds, target, indexes=jnp.asarray([0, 0, 0, 1, 1, 1, 1]))
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_hit_rate(preds, target, k=self.k)
@@ -146,7 +190,18 @@ class RetrievalHitRate(_TopKRetrievalMetric):
 
 class RetrievalFallOut(_TopKRetrievalMetric):
     """FallOut@k averaged over queries; empty = no *negative* target
-    (ref retrieval/fall_out.py:80-131)."""
+    (ref retrieval/fall_out.py:80-131).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalFallOut
+        >>> m = RetrievalFallOut(k=2)
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> m.update(preds, target, indexes=jnp.asarray([0, 0, 0, 1, 1, 1, 1]))
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
 
     higher_is_better = False
 
@@ -176,7 +231,18 @@ class RetrievalFallOut(_TopKRetrievalMetric):
 
 
 class RetrievalNormalizedDCG(_TopKRetrievalMetric):
-    """nDCG@k averaged over queries (ref retrieval/ndcg.py)."""
+    """nDCG@k averaged over queries (ref retrieval/ndcg.py)
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalNormalizedDCG
+        >>> m = RetrievalNormalizedDCG()
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> m.update(preds, target, indexes=jnp.asarray([0, 0, 0, 1, 1, 1, 1]))
+        >>> round(float(m.compute()), 4)
+        0.9599
+    """
 
     def __init__(
         self,
@@ -205,7 +271,18 @@ class RetrievalNormalizedDCG(_TopKRetrievalMetric):
 
 
 class RetrievalRPrecision(RetrievalMetric):
-    """R-precision averaged over queries (ref retrieval/r_precision.py)."""
+    """R-precision averaged over queries (ref retrieval/r_precision.py)
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRPrecision
+        >>> m = RetrievalRPrecision()
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> m.update(preds, target, indexes=jnp.asarray([0, 0, 0, 1, 1, 1, 1]))
+        >>> round(float(m.compute()), 4)
+        0.75
+    """
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_r_precision(preds, target)

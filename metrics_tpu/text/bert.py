@@ -21,6 +21,18 @@ class BERTScore(Metric):
     Note: sentences accumulate as host-side strings (plain Python lists, not
     device states); cross-process sync of raw strings is not supported —
     compute per process or pre-gather the text.
+
+    Example (toy embedder; use ``transformers_flax_embedder`` for real runs):
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu import BERTScore
+        >>> def toy_embedder(sents):
+        ...     ids = jnp.asarray([[ord(w[0]) % 64 for w in s.split()] + [0] * (4 - len(s.split()))
+        ...                        for s in sents])
+        ...     return jax.nn.one_hot(ids, 64), (ids > 0).astype(jnp.int32), ids
+        >>> m = BERTScore(embedder=toy_embedder)
+        >>> m.update(["the cat sat"], ["the cat sat"])
+        >>> {k: round(float(v.mean()), 2) for k, v in sorted(m.compute().items())}
+        {'f1': 1.0, 'precision': 1.0, 'recall': 1.0}
     """
 
     is_differentiable = False

@@ -86,7 +86,15 @@ class CharErrorRate(Metric):
 
 
 class MatchErrorRate(Metric):
-    """MER over accumulated samples."""
+    """MER over accumulated samples.
+
+    Example:
+        >>> from metrics_tpu import MatchErrorRate
+        >>> m = MatchErrorRate()
+        >>> m.update(["the cat sat"], ["the cat sat on the mat"])
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -107,7 +115,15 @@ class MatchErrorRate(Metric):
 
 
 class WordInfoLost(Metric):
-    """WIL over accumulated samples."""
+    """WIL over accumulated samples.
+
+    Example:
+        >>> from metrics_tpu import WordInfoLost
+        >>> m = WordInfoLost()
+        >>> m.update(["the cat sat"], ["the cat sat on the mat"])
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -130,7 +146,15 @@ class WordInfoLost(Metric):
 
 
 class WordInfoPreserved(Metric):
-    """WIP over accumulated samples."""
+    """WIP over accumulated samples.
+
+    Example:
+        >>> from metrics_tpu import WordInfoPreserved
+        >>> m = WordInfoPreserved()
+        >>> m.update(["the cat sat"], ["the cat sat on the mat"])
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -29,7 +29,16 @@ def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optio
 
 class BootStrapper(Metric):
     """Keep ``num_bootstraps`` metric copies, each fed a resampled batch
-    (ref bootstrapping.py:48-161)."""
+    (ref bootstrapping.py:48-161).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BootStrapper, MeanMetric
+        >>> b = BootStrapper(MeanMetric(), num_bootstraps=10)
+        >>> b.update(jnp.asarray([1.0, 2.0, 3.0, 4.0]))
+        >>> sorted(b.compute().keys())
+        ['mean', 'std']
+    """
 
     full_state_update: Optional[bool] = True
 

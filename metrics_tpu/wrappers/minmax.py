@@ -13,7 +13,18 @@ Array = jax.Array
 
 
 class MinMaxMetric(Metric):
-    """Track min/max of the base metric's computed value (ref minmax.py:23-109)."""
+    """Track min/max of the base metric's computed value (ref minmax.py:23-109).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric, MinMaxMetric
+        >>> m = MinMaxMetric(MeanMetric())
+        >>> m.update(jnp.asarray(2.0))
+        >>> _ = m.compute()
+        >>> m.update(jnp.asarray(4.0))
+        >>> {k: round(float(v), 1) for k, v in m.compute().items()}
+        {'max': 3.0, 'min': 2.0, 'raw': 3.0}
+    """
 
     full_state_update: Optional[bool] = True
 
