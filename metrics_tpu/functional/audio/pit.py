@@ -92,5 +92,14 @@ def permutation_invariant_training(
 
 
 def pit_permutate(preds: Array, perm: Array) -> Array:
-    """Reorder speakers by the best permutation (ref pit.py:163-181)."""
+    """Reorder speakers by the best permutation (ref pit.py:163-181).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pit_permutate
+        >>> preds = jnp.arange(8.0).reshape(1, 2, 4)
+        >>> perm = jnp.asarray([[1, 0]])  # swap the two speakers
+        >>> pit_permutate(preds, perm)[0, 0, 0].item()
+        4.0
+    """
     return jnp.take_along_axis(preds, perm[(...,) + (None,) * (preds.ndim - 2)], axis=1)

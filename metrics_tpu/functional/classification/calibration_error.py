@@ -85,6 +85,13 @@ def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str =
     """Top-label calibration error (ref :164-208).
 
     L1 norm = ECE, max norm = MCE, L2 norm = RMSCE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import calibration_error
+        >>> preds = jnp.asarray([[0.9, 0.1], [0.6, 0.4], [0.2, 0.8]])
+        >>> round(float(calibration_error(preds, jnp.asarray([0, 0, 1]), n_bins=3)), 4)
+        0.2333
     """
     if norm not in ("l1", "l2", "max"):
         raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")

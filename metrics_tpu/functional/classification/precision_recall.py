@@ -170,7 +170,15 @@ def precision_recall(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Tuple[Array, Array]:
-    """Both precision and recall from one stat-scores pass (ref precision_recall.py:407-552)."""
+    """Both precision and recall from one stat-scores pass (ref precision_recall.py:407-552).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision_recall
+        >>> p, r = precision_recall(jnp.asarray([1, 0, 2, 1]), jnp.asarray([1, 1, 2, 0]), num_classes=3, average='micro')
+        >>> (float(p), float(r))
+        (0.5, 0.5)
+    """
     _check_avg_arguments(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ("weighted", "none", None) else average
     tp, fp, _, fn = _stat_scores_update(

@@ -62,7 +62,16 @@ def _coverage_error_compute(coverage: Array, n_elements: int, sample_weight: Opt
 
 
 def coverage_error(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
-    """Multilabel coverage error (ref ranking.py:73-100)."""
+    """Multilabel coverage error (ref ranking.py:73-100).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import coverage_error
+        >>> preds = jnp.asarray([[0.8, 0.3, 0.6], [0.2, 0.7, 0.4]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0]])
+        >>> float(coverage_error(preds, target))
+        1.5
+    """
     coverage, n_elements, sample_weight = _coverage_error_update(preds, target, sample_weight)
     return _coverage_error_compute(coverage, n_elements, sample_weight)
 
@@ -107,7 +116,16 @@ def _label_ranking_average_precision_compute(
 
 
 def label_ranking_average_precision(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
-    """Label ranking average precision for multilabel data (ref ranking.py:141-169)."""
+    """Label ranking average precision for multilabel data (ref ranking.py:141-169).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import label_ranking_average_precision
+        >>> preds = jnp.asarray([[0.8, 0.3, 0.6], [0.2, 0.7, 0.4]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0]])
+        >>> float(label_ranking_average_precision(preds, target))
+        1.0
+    """
     score, n_elements, sample_weight = _label_ranking_average_precision_update(preds, target, sample_weight)
     return _label_ranking_average_precision_compute(score, n_elements, sample_weight)
 
@@ -144,6 +162,15 @@ def _label_ranking_loss_compute(loss: Array, n_elements: int, sample_weight: Opt
 
 
 def label_ranking_loss(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
-    """Label ranking loss for multilabel data (ref ranking.py:212-242)."""
+    """Label ranking loss for multilabel data (ref ranking.py:212-242).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import label_ranking_loss
+        >>> preds = jnp.asarray([[0.8, 0.3, 0.6], [0.2, 0.7, 0.4]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0]])
+        >>> float(label_ranking_loss(preds, target))
+        0.0
+    """
     loss, n_element, sample_weight = _label_ranking_loss_update(preds, target, sample_weight)
     return _label_ranking_loss_compute(loss, n_element, sample_weight)

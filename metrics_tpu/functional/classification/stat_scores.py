@@ -191,7 +191,15 @@ def stat_scores(
     ignore_index: Optional[int] = None,
 ) -> Array:
     """Number of TP/FP/TN/FN (+support) for classification inputs
-    (ref stat_scores.py:289-438)."""
+    (ref stat_scores.py:289-438).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import stat_scores
+        >>> scores = stat_scores(jnp.asarray([1, 0, 2, 1]), jnp.asarray([1, 1, 2, 0]), num_classes=3, reduce='micro')
+        >>> [int(v) for v in scores]  # tp, fp, tn, fn, support
+        [2, 2, 6, 2, 4]
+    """
     if reduce not in ["micro", "macro", "samples"]:
         raise ValueError(f"The `reduce` {reduce} is not valid.")
     if mdmc_reduce not in [None, "samplewise", "global"]:

@@ -74,7 +74,15 @@ def spectral_distortion_index(
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
     """D_lambda spectral distortion between two multispectral images
-    (ref d_lambda.py:92-132)."""
+    (ref d_lambda.py:92-132).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional import spectral_distortion_index
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
+        >>> round(float(spectral_distortion_index(preds, preds * 0.9)), 4)
+        0.0
+    """
     if not isinstance(p, int) or p <= 0:
         raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
     preds, target = _spectral_distortion_index_update(preds, target)

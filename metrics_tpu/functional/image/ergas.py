@@ -56,6 +56,14 @@ def error_relative_global_dimensionless_synthesis(
     ratio: Union[int, float] = 4,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """ERGAS (ref ergas.py:99-126)."""
+    """ERGAS (ref ergas.py:99-126).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional import error_relative_global_dimensionless_synthesis
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
+        >>> round(float(error_relative_global_dimensionless_synthesis(preds, preds * 0.9)), 2)
+        51.35
+    """
     preds, target = _ergas_update(preds, target)
     return _ergas_compute(preds, target, ratio, reduction)

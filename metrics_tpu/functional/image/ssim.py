@@ -278,7 +278,15 @@ def multiscale_structural_similarity_index_measure(
     betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
     normalize: Optional[str] = "relu",
 ) -> Array:
-    """Multi-scale SSIM (ref ssim.py:416-487)."""
+    """Multi-scale SSIM (ref ssim.py:416-487).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional import multiscale_structural_similarity_index_measure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (1, 1, 192, 192))
+        >>> round(float(multiscale_structural_similarity_index_measure(preds, preds * 0.9, data_range=1.0)), 4)
+        0.9948
+    """
     if not isinstance(betas, tuple):
         raise ValueError("Argument `betas` is expected to be of a type tuple")
     if isinstance(betas, tuple) and not all(isinstance(beta, float) for beta in betas):

@@ -134,7 +134,16 @@ def pairwise_linear_similarity(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Pairwise inner-product similarity (ref linear.py:39-83)."""
+    """Pairwise inner-product similarity (ref linear.py:39-83).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_linear_similarity
+        >>> x = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+        >>> y = jnp.asarray([[1.0, 1.0]])
+        >>> pairwise_linear_similarity(x, y).ravel().tolist()
+        [1.0, 1.0]
+    """
     distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)
 

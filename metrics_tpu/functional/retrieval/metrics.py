@@ -55,7 +55,16 @@ def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
 
 
 def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, adaptive_k: bool = False) -> Array:
-    """Precision@k for one query (ref precision.py:18-66)."""
+    """Precision@k for one query (ref precision.py:18-66).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([False, True, True])
+        >>> float(retrieval_precision(preds, target, k=2))
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not isinstance(adaptive_k, bool):
         raise ValueError("`adaptive_k` has to be a boolean")
@@ -69,7 +78,16 @@ def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, ad
 
 
 def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """Recall@k for one query (ref recall.py:18-60)."""
+    """Recall@k for one query (ref recall.py:18-60).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_recall
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([False, True, True])
+        >>> float(retrieval_recall(preds, target, k=2))
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if k is None:
         k = preds.shape[-1]
@@ -82,7 +100,16 @@ def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Ar
 
 
 def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """HitRate@k for one query (ref hit_rate.py:18-57)."""
+    """HitRate@k for one query (ref hit_rate.py:18-57).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_hit_rate
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([False, True, True])
+        >>> float(retrieval_hit_rate(preds, target, k=2))
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if k is None:
         k = preds.shape[-1]
@@ -93,7 +120,16 @@ def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> 
 
 
 def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """FallOut@k for one query (ref fall_out.py:18-62)."""
+    """FallOut@k for one query (ref fall_out.py:18-62).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_fall_out
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([False, True, True])
+        >>> float(retrieval_fall_out(preds, target, k=2))
+        0.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     k = preds.shape[-1] if k is None else k
     if not (isinstance(k, int) and k > 0):
@@ -133,7 +169,16 @@ def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = Non
 
 
 def retrieval_r_precision(preds: Array, target: Array) -> Array:
-    """R-precision for one query (ref r_precision.py:18-49)."""
+    """R-precision for one query (ref r_precision.py:18-49).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_r_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([False, True, True])
+        >>> float(retrieval_r_precision(preds, target))
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     relevant_number = int(target.sum()) if not isinstance(target, jax.core.Tracer) else None
     if relevant_number is None:
