@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, Precision, Recall
 from metrics_tpu.collections import MetricCollection
 from metrics_tpu.metric import Metric
 from tests.helpers.testers import DummyMetricDiff, DummyMetricMultiOutput, DummyMetricSum
@@ -288,3 +289,42 @@ def test_state_dict_syncs_compute_group_members():
     a, b = mc.compute(), mc2.compute()
     for key in a:
         np.testing.assert_allclose(np.asarray(a[key]), np.asarray(b[key]), atol=1e-7)
+
+
+@pytest.mark.parametrize(
+    "metrics, expected_groups",
+    [
+        # stat-scores family shares tp/fp/tn/fn states -> one group
+        (lambda: [Accuracy(num_classes=3), Precision(num_classes=3), Recall(num_classes=3)],
+         [{"Accuracy", "Precision", "Recall"}]),
+        # confusion matrix state differs from stat-scores states
+        (lambda: [Precision(num_classes=3), Recall(num_classes=3), ConfusionMatrix(num_classes=3)],
+         [{"Precision", "Recall"}, {"ConfusionMatrix"}]),
+        # same stat-scores states with matching args -> merged
+        (lambda: [Accuracy(num_classes=3, average="macro"), F1Score(num_classes=3, average="macro")],
+         [{"Accuracy", "F1Score"}]),
+        # same class, different args -> state shapes diverge, must NOT merge
+        (lambda: {"micro": Accuracy(num_classes=3, average="micro"),
+                  "macro": Accuracy(num_classes=3, average="macro")},
+         [{"micro"}, {"macro"}]),
+    ],
+)
+def test_real_metric_compute_group_matrix(metrics, expected_groups):
+    """Compute-group detection over real metric families (ref test_collections.py:313)."""
+    mc = MetricCollection(metrics(), compute_groups=True)
+    rng = np.random.RandomState(0)
+    logits = rng.rand(16, 3).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, 3, 16))
+    mc.update(preds, target)
+    groups = {frozenset(v) for v in mc.compute_groups.values()}
+    assert groups == {frozenset(g) for g in expected_groups}
+
+    # values after grouping match a group-disabled collection
+    mc_off = MetricCollection(metrics(), compute_groups=False)
+    mc.update(preds, target)
+    mc_off.update(preds, target)
+    mc_off.update(preds, target)
+    on, off = mc.compute(), mc_off.compute()
+    for k in on:
+        np.testing.assert_allclose(np.asarray(on[k]), np.asarray(off[k]), rtol=1e-6)
