@@ -331,3 +331,10 @@ def test_float_half_double_are_noops():
     for cast in (m.float, m.double, m.half):
         assert cast() is m
         assert m.x.dtype == jnp.float32
+
+
+def test_compute_before_update_warns():
+    """Parity with ref metric.py:384: compute before any update warns."""
+    m = DummyMetricSum()
+    with pytest.warns(UserWarning, match="was called before the ``update`` method"):
+        m.compute()
