@@ -1,4 +1,6 @@
 """Metric arithmetic tests (translation of ref tests/bases/test_composition.py, 555 LoC)."""
+import pickle
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -183,10 +185,23 @@ def test_composition_kwarg_routing():
 
 
 def test_composition_persists_through_pickle():
-    import pickle
-
     a = DummyMetricSum()
     comp = a * 2
     a.update(jnp.asarray(4.0))
     restored = pickle.loads(pickle.dumps(comp))
     np.testing.assert_allclose(np.asarray(restored.compute()), 8.0, atol=1e-6)
+
+
+def test_compositional_repr_and_higher_order():
+    """Composed metrics stay composable and picklable at depth (ref metric.py:726-836)."""
+    a = DummyMetricSum()
+    b = DummyMetricSum()
+    combo = abs((a + b) * 2 - 1) ** 2
+    a.update(jnp.asarray(1.0))
+    b.update(jnp.asarray(2.0))
+    # ((1+2)*2 - 1)^2 = 25
+    assert float(combo.compute()) == 25.0
+    restored = pickle.loads(pickle.dumps(combo))
+    assert float(restored.compute()) == 25.0
+    # repr renders the nested op tree without raising (ref metric.py:830-836)
+    assert "CompositionalMetric" in repr(combo)
