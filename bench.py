@@ -13,6 +13,7 @@ speedup factor (baseline_time / our_time).
 """
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -372,28 +373,31 @@ def _enable_compile_cache() -> None:
         pass  # cache is an optimization only
 
 
-def main() -> None:
+def _worker_main() -> None:
+    """Run the benchmark on whatever backend this process initializes."""
     _enable_compile_cache()
     ours_us = _bench_ours()
-    base_us = float("nan")
+    import jax
+
+    device = str(jax.devices()[0])
+    base_us = None
+    vs_baseline = None
     try:
-        base_us = _bench_torch_baseline()
-        vs_baseline = base_us / ours_us
+        base_us = round(_bench_torch_baseline(), 2)
+        vs_baseline = round(base_us / ours_us, 3)
     except Exception:
-        vs_baseline = float("nan")
+        pass  # vs_baseline stays null — keep the JSON line strict-parseable
 
     if os.environ.get("BENCH_ALL"):
         try:
             detail = _bench_detail()
             detail["accuracy_update_us"] = round(ours_us, 2)
-            detail["torch_cpu_baseline_us"] = round(base_us, 2)
-            import jax
-
-            detail["device"] = str(jax.devices()[0])
+            detail["torch_cpu_baseline_us"] = base_us
+            detail["device"] = device
             with open("BENCH_DETAIL.json", "w") as f:
                 json.dump(detail, f, indent=2)
         except Exception as err:  # detail bench must never break the headline
-            print(f"# detail bench failed: {err}")
+            print(f"# detail bench failed: {err}", file=sys.stderr)
 
     print(
         json.dumps(
@@ -401,10 +405,86 @@ def main() -> None:
                 "metric": f"Accuracy.update (multiclass B={BATCH} C={NUM_CLASSES}, jitted) latency",
                 "value": round(ours_us, 2),
                 "unit": "us/call",
-                "vs_baseline": round(vs_baseline, 3),
+                "vs_baseline": vs_baseline,
+                "device": device,
             }
         )
     )
+
+
+def _run_worker(env: dict, timeout: float):
+    """Run ``bench.py --worker``; return the parsed JSON line or None."""
+    import subprocess
+    import time as _time
+
+    t0 = _time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired as err:
+        tail = err.stderr or ""
+        if isinstance(tail, bytes):
+            tail = tail.decode(errors="replace")
+        print(f"# bench worker timed out after {timeout:.0f}s: {tail[-800:]}",
+              file=sys.stderr, flush=True)
+        return None, float("inf")  # a timeout is never a "fast failure"
+    if proc.stderr:
+        print(proc.stderr[-2000:], file=sys.stderr, flush=True)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed, _time.perf_counter() - t0
+    print(f"# bench worker rc={proc.returncode}, no JSON line in output: "
+          f"{proc.stdout[-400:]}", file=sys.stderr, flush=True)
+    return None, _time.perf_counter() - t0
+
+
+def main() -> None:
+    """Orchestrator: TPU attempt (with one retry on fast failure) then CPU fallback.
+
+    The parent process never imports jax — a hung/crashed TPU backend init
+    (the round-1 failure: axon tunnel UNAVAILABLE / hang) is confined to the
+    worker subprocess and bounded by the watchdog, so this script always
+    exits 0 with one honest JSON line.
+    """
+    if "--worker" in sys.argv:
+        _worker_main()
+        return
+
+    # BENCH_ALL runs the full detail suite (several model compiles, a nested
+    # 300s dist sub-bench) — the watchdog must cover it or a healthy mid-run
+    # TPU worker gets killed and silently replaced by CPU numbers.
+    default_timeout = "1800" if os.environ.get("BENCH_ALL") else "480"
+    tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", default_timeout))
+    result, elapsed = _run_worker(dict(os.environ), tpu_timeout)
+    if result is None and elapsed < 60:
+        # fast failure smells like a transient backend-init crash: retry once
+        print("# retrying TPU bench after fast failure", file=sys.stderr, flush=True)
+        result, _ = _run_worker(dict(os.environ), tpu_timeout)
+
+    if result is None:
+        print("# falling back to CPU backend", file=sys.stderr, flush=True)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ""  # drop any site hook routing jax at the TPU tunnel
+        env["JAX_PLATFORMS"] = "cpu"
+        cpu_default = "1800" if os.environ.get("BENCH_ALL") else "600"
+        result, _ = _run_worker(env, float(os.environ.get("BENCH_CPU_TIMEOUT", cpu_default)))
+
+    if result is None:  # even CPU failed: still print a parseable line, rc 0
+        result = {
+            "metric": f"Accuracy.update (multiclass B={BATCH} C={NUM_CLASSES}, jitted) latency",
+            "value": None,
+            "unit": "us/call",
+            "vs_baseline": None,
+            "device": "unavailable (all backends failed; see stderr)",
+        }
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
