@@ -394,7 +394,10 @@ def _worker_main() -> None:
             detail["accuracy_update_us"] = round(ours_us, 2)
             detail["torch_cpu_baseline_us"] = base_us
             detail["device"] = device
-            with open("BENCH_DETAIL.json", "w") as f:
+            # always next to this script (the worker's cwd is forced there;
+            # keep the artifact location independent of the invoker's cwd)
+            out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+            with open(out_path, "w") as f:
                 json.dump(detail, f, indent=2)
         except Exception as err:  # detail bench must never break the headline
             print(f"# detail bench failed: {err}", file=sys.stderr)

@@ -370,6 +370,10 @@ class MetricCollection:
         """No-op; use :meth:`set_dtype`."""
         return self
 
+    def type(self, dst_type=None) -> "MetricCollection":
+        """No-op, like ``Metric.type`` (ref metric.py:462-488)."""
+        return self
+
     # --------------------------------------------------------------- adding
     def add_metrics(
         self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric

@@ -197,16 +197,39 @@ def cached_random_init(cache_key: str, init_fn: Any) -> Any:
     is therefore run once on the host CPU backend, saved to
     ``$XDG_CACHE_HOME/metrics_tpu/<cache_key>.npz``, and every later
     construction is a file load + one batched device transfer.
+
+    The expected parameter pytree (names/shapes/dtypes via ``eval_shape`` —
+    an abstract trace, no compilation) plus the package version are hashed
+    into the filename, and a loaded tree is validated against that spec, so
+    a stale cache from an older revision of the network definition can
+    never load silently.
     """
+    import hashlib
     import os
+
+    from flax.traverse_util import flatten_dict
+
+    from metrics_tpu.__about__ import __version__
+
+    spec = {
+        k: (tuple(v.shape), str(v.dtype))
+        for k, v in flatten_dict(jax.eval_shape(init_fn), sep="/").items()
+    }
+    fp = hashlib.sha1(repr((__version__, sorted(spec.items()))).encode()).hexdigest()[:10]
 
     cache_dir = os.path.join(
         os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "metrics_tpu"
     )
-    path = os.path.join(cache_dir, cache_key + ".npz")
+    path = os.path.join(cache_dir, f"{cache_key}-{fp}.npz")
     if os.path.exists(path):
         try:
-            return load_params(path)
+            loaded = load_params(path)
+            got = {
+                k: (tuple(v.shape), str(v.dtype))
+                for k, v in flatten_dict(loaded, sep="/").items()
+            }
+            if got == spec:
+                return loaded
         except Exception:  # noqa: BLE001 — corrupt cache (BadZipFile/EOFError/OSError...): rebuild
             pass
     with jax.default_device(jax.local_devices(backend="cpu")[0]):
@@ -216,6 +239,21 @@ def cached_random_init(cache_key: str, init_fn: Any) -> Any:
         tmp = path[: -len(".npz")] + f".tmp-{os.getpid()}.npz"
         save_params(tmp, variables)
         os.replace(tmp, path)  # atomic: concurrent initializers converge
+        # prune entries for this key with other fingerprints (each is ~90 MB
+        # for an InceptionV3 tree — without this the cache grows unboundedly
+        # across network revisions / version bumps); after the replace so a
+        # concurrent initializer's tmp file is never swept
+        for name in os.listdir(cache_dir):
+            if (
+                name.startswith(cache_key + "-")
+                and name.endswith(".npz")
+                and ".tmp-" not in name
+                and name != os.path.basename(path)
+            ):
+                try:
+                    os.remove(os.path.join(cache_dir, name))
+                except OSError:
+                    pass
     except OSError:
         pass
     return jax.device_put(variables)

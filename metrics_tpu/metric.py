@@ -84,6 +84,11 @@ def _scan_fold(update_fn: Callable, state: Any, batched_args: Tuple, batched_kwa
         args, kwargs = batch
         return update_fn(st, *args, **kwargs), None
 
+    if not jax.tree_util.tree_leaves((batched_args, batched_kwargs)):
+        raise MetricsUserError(
+            "scan_update needs at least one batched argument (leading axis = "
+            "num_batches); got none, so the scan length cannot be inferred"
+        )
     state, _ = jax.lax.scan(body, state, (batched_args, batched_kwargs))
     return state
 
@@ -467,10 +472,16 @@ class Metric(ABC):
 
         output_dict: Dict[str, Any] = {}
         for attr, value in input_dict.items():
+            # Never compress sample-accumulating states (list states and
+            # tensor states with a `cat` reduction): those hold raw samples
+            # (CatMetric values, curve preds) that would stay quantized
+            # permanently, not just transiently during a reduction.
+            samples = isinstance(value, list) or self._reductions[attr] is dim_zero_cat
+            attr_gather = base_gather if samples else gather
             if isinstance(value, list):
-                output_dict[attr] = [gather(v) for v in value]  # list of lists-of-rank-tensors
+                output_dict[attr] = [attr_gather(v) for v in value]  # list of lists-of-rank-tensors
             else:
-                output_dict[attr] = gather(value)
+                output_dict[attr] = attr_gather(value)
 
         for attr, reduction_fn in self._reductions.items():
             out = output_dict[attr]
@@ -675,6 +686,12 @@ class Metric(ABC):
 
     def half(self) -> "Metric":
         """No-op (ref metric.py:462-488); use :meth:`set_dtype`."""
+        return self
+
+    def type(self, dst_type=None) -> "Metric":
+        """No-op, like the reference (metric.py:462-488): migrated code may
+        call ``metric.type(dtype)``; only :meth:`set_dtype` changes state
+        dtype."""
         return self
 
     def set_dtype(self, dst_type) -> "Metric":

@@ -330,3 +330,35 @@ def test_sync_dtype_actually_compresses_on_the_wire():
     # reduced results cast back to the original state dtypes
     assert m.f32.dtype == jnp.float32 and m.f16.dtype == jnp.float16
     np.testing.assert_allclose(np.asarray(m.f32), 2.0 * np.ones(8))
+
+
+def test_sync_dtype_never_compresses_sample_states():
+    """Raw accumulated samples (list states, `cat` tensor states) must cross
+    at full precision — quantization would persist in the merged state."""
+    seen = []
+
+    def recording_gather(x, env):
+        seen.append(str(x.dtype))
+        return [x, x]
+
+    class M(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__(dist_sync_fn=recording_gather, sync_dtype=jnp.bfloat16)
+            self.add_state("samples", [], dist_reduce_fx="cat")
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.samples.append(x)
+            self.total = self.total + x.sum()
+
+        def compute(self):
+            return self.total
+
+    m = M()
+    m.update(jnp.full(4, 1000.5))  # 1000.5 is not bf16-representable
+    m._sync_dist(m.dist_sync_fn, env=NoOpEnv())
+    # list state crossed as f32; scalar sum state compressed to bf16
+    assert sorted(seen) == ["bfloat16", "float32"]
+    np.testing.assert_allclose(np.asarray(m.samples), np.full(8, 1000.5))

@@ -331,6 +331,23 @@ def test_float_half_double_are_noops():
     for cast in (m.float, m.double, m.half):
         assert cast() is m
         assert m.x.dtype == jnp.float32
+    # .type(dtype) is the fourth reference no-op cast (ref metric.py:462-488)
+    assert m.type(jnp.float16) is m and m.type() is m
+    assert m.x.dtype == jnp.float32
+
+
+def test_collection_type_is_noop():
+    from metrics_tpu import MetricCollection
+
+    mc = MetricCollection({"s": DummyMetricSum()})
+    assert mc.type(jnp.float16) is mc
+    assert mc["s"].x.dtype == jnp.float32
+
+
+def test_scan_update_without_batched_args_raises():
+    m = DummyMetricSum()
+    with pytest.raises(MetricsUserError, match="at least one batched argument"):
+        m.scan_update(m.state())
 
 
 def test_compute_before_update_warns():

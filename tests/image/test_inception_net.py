@@ -29,6 +29,60 @@ def test_pool_features_shape(extractor):
     assert feats.dtype == jnp.float32
 
 
+def test_cached_random_init_rejects_stale_cache(tmp_path, monkeypatch):
+    """The disk cache key fingerprints the module definition: a cache entry
+    whose tree no longer matches the network's expected shapes is rebuilt,
+    never loaded silently (advisor finding r1)."""
+    import flax.linen as nn
+    import jax
+
+    from metrics_tpu.image.inception_net import cached_random_init
+
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+
+    class Tiny(nn.Module):
+        features: int = 4
+
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(self.features)(x)
+
+    def init_a():
+        return Tiny(4).init(jax.random.PRNGKey(0), jnp.zeros((1, 3)))
+
+    def init_b():  # different shapes -> different fingerprint -> cache miss
+        return Tiny(5).init(jax.random.PRNGKey(0), jnp.zeros((1, 3)))
+
+    va = cached_random_init("tiny_test", init_a)
+    cache_dir = tmp_path / "metrics_tpu"
+    files_after_a = set(os.listdir(cache_dir))
+    assert len(files_after_a) == 1
+
+    vb = cached_random_init("tiny_test", init_b)
+    assert vb["params"]["Dense_0"]["kernel"].shape == (3, 5)
+    files_after_b = set(os.listdir(cache_dir))
+    # the old fingerprint was pruned (cache stays bounded per key)
+    assert len(files_after_b) == 1 and files_after_b != files_after_a
+
+    # same definition again: deterministic rebuild, values identical
+    va2 = cached_random_init("tiny_test", init_a)
+    np.testing.assert_array_equal(
+        np.asarray(va["params"]["Dense_0"]["kernel"]),
+        np.asarray(va2["params"]["Dense_0"]["kernel"]),
+    )
+
+    # a second cached key is untouched by the first key's pruning
+    cached_random_init("tiny_other", init_a)
+    assert len(set(os.listdir(cache_dir))) == 2
+
+    # corrupt the entry in place: structure validation forces a rebuild
+    (entry,) = [f for f in os.listdir(cache_dir) if f.startswith("tiny_test-")]
+    stale = cache_dir / entry
+    np.savez(stale, **{"params/Dense_0/kernel": np.zeros((2, 2), np.float32)})
+    va3 = cached_random_init("tiny_test", init_a)
+    assert va3["params"]["Dense_0"]["kernel"].shape == (3, 4)
+
+
 def test_logits_shape():
     ext = InceptionV3FeatureExtractor(output="logits", num_classes=1008)
     assert ext(jnp.asarray(IMGS)).shape == (2, 1008)
