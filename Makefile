@@ -1,8 +1,12 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test doctest bench clean
+.PHONY: test doctest bench tpu-smoke clean
 
 test:
 	python -m pytest tests/ -q
+
+# on-device smoke suite: needs a live TPU backend (skips itself otherwise)
+tpu-smoke:
+	METRICS_TPU_SMOKE=1 python -m pytest tests/tpu_smoke/ -q
 
 doctest:
 	JAX_PLATFORMS=cpu python -m pytest --doctest-modules metrics_tpu/ -q

@@ -2,13 +2,31 @@
 CPU backend so expected float values are deterministic across machines.
 
 The env-var route (JAX_PLATFORMS=cpu) is overridden by the site's platform
-plugin, so the config API is used instead. Must run before jax initializes
-its backends.
+plugin, so the config API is used instead. Setting the config in
+``pytest_configure`` is early enough: jax reads XLA_FLAGS and the platform
+at first backend use, which happens inside tests, after configure.
+
+Exception: a DEDICATED tpu-smoke invocation (``make tpu-smoke``:
+``METRICS_TPU_SMOKE=1 pytest tests/tpu_smoke``) keeps the ambient
+accelerator backend. The unpin never leaks into a broader run — with other
+test paths on the command line the suite stays CPU-pinned and
+tests/tpu_smoke skips itself.
 """
 import os
 
-os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 
-import jax
+def _tpu_smoke_only_invocation(config) -> bool:
+    if not os.environ.get("METRICS_TPU_SMOKE"):
+        return False
+    args = list(config.args)  # positional test paths (testpaths when empty)
+    return bool(args) and all("tpu_smoke" in a for a in args)
 
-jax.config.update("jax_platforms", "cpu")
+
+def pytest_configure(config):
+    if _tpu_smoke_only_invocation(config):
+        return
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -34,7 +34,8 @@ def _r2_score_compute(
     multioutput: str = "uniform_average",
 ) -> Array:
     """Parity: ref r2.py:49-113."""
-    if n_obs < 2:
+    # eager-only guard: under jit the count is traced and cannot be checked
+    if not isinstance(n_obs, jax.core.Tracer) and n_obs < 2:
         raise ValueError("Needs at least two samples to calculate r2 score.")
 
     mean_obs = sum_obs / n_obs
