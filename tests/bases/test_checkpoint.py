@@ -9,6 +9,9 @@ import os
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+pytest.importorskip("orbax.checkpoint")  # core-only CI runs without orbax
 
 from metrics_tpu import Accuracy, MeanMetric, MetricCollection
 
