@@ -7,3 +7,4 @@ from metrics_tpu.functional.audio.snr import (  # noqa: F401
     scale_invariant_signal_noise_ratio,
     signal_noise_ratio,
 )
+from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility  # noqa: F401

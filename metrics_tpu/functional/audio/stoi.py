@@ -1,0 +1,261 @@
+"""Short-Time Objective Intelligibility — native jnp implementation.
+
+The reference wraps the ``pystoi`` package on the host CPU, one Python call
+per signal (/root/reference/torchmetrics/functional/audio/stoi.py:29-103,
+/root/reference/torchmetrics/audio/stoi.py:125). Here the whole measure —
+polyphase resampling to 10 kHz, silent-frame removal, STFT, third-octave
+band analysis, short-time segment correlation (standard) or row/column
+normalized correlation (extended) — is expressed as ONE static-shape XLA
+program, so it jits, vmaps over batches, and runs on device.
+
+The TPU-first trick is silent-frame *compaction instead of removal*: the
+frame count is static; kept frames are stably permuted to the front,
+overlap-added at their new positions, and a traced valid-count ``K`` masks
+every downstream reduction. That reproduces pystoi's dynamic-shape
+remove-then-reassemble semantics without any data-dependent shapes.
+
+Algorithm constants and step order follow the published algorithm
+(Taal et al. 2011 for standard, Jensen & Taal 2016 for extended), which is
+also what pystoi implements; parity is pinned by the recorded pystoi value
+in the reference's own doctest (tensor(-0.0100) — tests/audio/test_stoi.py).
+"""
+import math
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+_FS = 10_000  # internal sample rate (Hz)
+_N_FRAME = 256  # analysis window length at 10 kHz (25.6 ms)
+_HOP = _N_FRAME // 2
+_NFFT = 512
+_NUM_BANDS = 15  # third-octave bands
+_MIN_FREQ = 150.0  # center frequency of the lowest band (Hz)
+_SEG = 30  # frames per short-time segment (384 ms)
+_BETA = -15.0  # lower signal-to-distortion bound (dB)
+_DYN_RANGE = 40.0  # silent-frame energy range (dB)
+_EPS = np.finfo(np.float64).eps
+
+
+def _third_octave_matrix(fs: int, nfft: int, num_bands: int, min_freq: float) -> np.ndarray:
+    """(num_bands, nfft//2+1) 0/1 matrix grouping rfft bins into bands.
+
+    Band edges are snapped to the nearest bin like pystoi's ``thirdoct`` so
+    the recorded oracle values match exactly.
+    """
+    f = np.linspace(0, fs, nfft + 1)[: nfft // 2 + 1]
+    k = np.arange(num_bands, dtype=np.float64)
+    freq_low = min_freq * 2.0 ** ((2 * k - 1) / 6)
+    freq_high = min_freq * 2.0 ** ((2 * k + 1) / 6)
+    obm = np.zeros((num_bands, len(f)), dtype=np.float32)
+    for i in range(num_bands):
+        lo = int(np.argmin(np.square(f - freq_low[i])))
+        hi = int(np.argmin(np.square(f - freq_high[i])))
+        obm[i, lo:hi] = 1.0
+    return obm
+
+
+def _hann_inner(n: int) -> np.ndarray:
+    """np.hanning(n + 2)[1:-1] — the window pystoi applies to every frame."""
+    return np.hanning(n + 2)[1:-1].astype(np.float32)
+
+
+def _octave_resample_filter(up: int, down: int, n: int = 32) -> np.ndarray:
+    """The Octave-compatible anti-alias FIR pystoi resamples with: odd
+    symmetric kaiser(beta=5) windowed sinc of L = 2*n*max(up,down)+1 taps
+    (Octave's ``fir1(L-1, ...)`` returns L taps), cutoff 1/(2*max(up,down))
+    of Nyquist, scaled by ``up``. The recorded-oracle test pins this: the
+    even L-1-tap variant shifts STOI by ~2e-4 (half-sample phase)."""
+    pqmax = max(up, down)
+    cutoff = 1.0 / pqmax  # firwin cutoff, Nyquist-normalized (2 * (1/2)/pqmax)
+    numtaps = 2 * n * pqmax + 1
+    try:
+        from scipy.signal import firwin
+
+        h = firwin(numtaps, cutoff, window=("kaiser", 5.0))
+    except ImportError:  # pragma: no cover — hand-rolled equivalent
+        m = np.arange(numtaps, dtype=np.float64) - (numtaps - 1) / 2.0
+        h = cutoff * np.sinc(cutoff * m)
+        x = 2.0 * np.arange(numtaps) / (numtaps - 1) - 1.0
+        h *= np.i0(5.0 * np.sqrt(np.maximum(0.0, 1.0 - x**2))) / np.i0(5.0)
+        h /= h.sum()
+    return (h * up).astype(np.float32)
+
+
+def _resample_to_10k(x: Array, fs: int) -> Array:
+    """Polyphase resample ``x`` (1-D) from ``fs`` to 10 kHz, jnp end to end."""
+    if fs == _FS:
+        return x
+    g = math.gcd(int(fs), _FS)
+    up, down = _FS // g, fs // g
+    h = jnp.asarray(_octave_resample_filter(up, down))
+    half_len = (h.shape[0] - 1) // 2
+    n_in = x.shape[0]
+    # zero-stuff upsample
+    x_up = jnp.zeros(n_in * up, x.dtype).at[::up].set(x)
+    y = jnp.convolve(x_up, h, mode="full")[half_len : half_len + n_in * up]
+    n_out = -(-n_in * up // down)  # ceil
+    return y[::down][:n_out]
+
+
+def _frame(x: Array, framelen: int, hop: int) -> Array:
+    """(F, framelen) frames at ``hop`` spacing — static frame count.
+
+    Frame starts replicate pystoi's ``range(0, len(x) - framelen, hop)``:
+    the frame that would start exactly at ``len - framelen`` is dropped.
+    """
+    n_frames = max(-(-(x.shape[0] - framelen) // hop), 0)
+    idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(framelen)[None, :]
+    return x[idx]
+
+
+def _compact_loud_frames(
+    x: Array, y: Array, framelen: int, hop: int
+) -> Tuple[Array, Array, Array]:
+    """Silent-frame removal with static shapes.
+
+    Frames of the CLEAN signal ``x`` whose windowed energy is more than
+    ``_DYN_RANGE`` dB below the loudest frame are dropped from both
+    signals. Kept frames are stably moved to the front and overlap-added at
+    their compacted positions; returns the two rebuilt signals plus the
+    traced kept-count ``K`` (frames past ``K`` in the rebuilt signals are
+    silence and must be masked downstream).
+    """
+    w = jnp.asarray(_hann_inner(framelen))
+    xf = _frame(x, framelen, hop) * w
+    yf = _frame(y, framelen, hop) * w
+    energies = 20.0 * jnp.log10(jnp.linalg.norm(xf, axis=1) + _EPS)
+    keep = energies > (jnp.max(energies) - _DYN_RANGE)
+    k_count = keep.sum()
+    # stable partition: kept frames first, original order preserved
+    order = jnp.argsort(~keep, stable=True)
+    xf = xf[order] * keep[order][:, None]
+    yf = yf[order] * keep[order][:, None]
+    n_frames = xf.shape[0]
+    out_len = (n_frames - 1) * hop + framelen if n_frames else framelen
+    pos = jnp.arange(n_frames)[:, None] * hop + jnp.arange(framelen)[None, :]
+    x_sil = jnp.zeros(out_len, x.dtype).at[pos].add(xf)
+    y_sil = jnp.zeros(out_len, y.dtype).at[pos].add(yf)
+    return x_sil, y_sil, k_count
+
+
+def _band_spectrogram(x: Array, obm: Array) -> Array:
+    """(bands, F) third-octave magnitudes of the windowed rfft frames."""
+    w = jnp.asarray(_hann_inner(_N_FRAME))
+    frames = _frame(x, _N_FRAME, _HOP) * w
+    spec = jnp.fft.rfft(frames, n=_NFFT, axis=-1)
+    power = jnp.square(jnp.abs(spec)).astype(jnp.float32)  # (F, nfft//2+1)
+    return jnp.sqrt(power @ obm.T).T  # (bands, F)
+
+
+def _segments(tob: Array) -> Array:
+    """(S, bands, _SEG) sliding short-time segments (stride 1 frame)."""
+    n_frames = tob.shape[1]
+    s = max(n_frames - _SEG + 1, 0)
+    idx = jnp.arange(s)[:, None] + jnp.arange(_SEG)[None, :]
+    return jnp.transpose(tob[:, idx], (1, 0, 2))
+
+
+def _stoi_d(x_seg: Array, y_seg: Array, seg_mask: Array) -> Array:
+    """Standard STOI: masked mean of per-(segment, band) correlations."""
+    norm_x = jnp.linalg.norm(x_seg, axis=2, keepdims=True)
+    norm_y = jnp.linalg.norm(y_seg, axis=2, keepdims=True)
+    y_n = y_seg * (norm_x / (norm_y + _EPS))
+    clip_value = 10.0 ** (-_BETA / 20.0)
+    y_p = jnp.minimum(y_n, x_seg * (1.0 + clip_value))
+    y_p = y_p - jnp.mean(y_p, axis=2, keepdims=True)
+    x_c = x_seg - jnp.mean(x_seg, axis=2, keepdims=True)
+    y_p = y_p / (jnp.linalg.norm(y_p, axis=2, keepdims=True) + _EPS)
+    x_c = x_c / (jnp.linalg.norm(x_c, axis=2, keepdims=True) + _EPS)
+    corr = jnp.sum(y_p * x_c, axis=2)  # (S, bands)
+    corr = corr * seg_mask[:, None]
+    denom = jnp.maximum(seg_mask.sum(), 1.0) * corr.shape[1]
+    return jnp.sum(corr) / denom
+
+
+def _row_col_normalize(seg: Array) -> Array:
+    """Zero-mean unit-norm rows, then zero-mean unit-norm columns
+    (Jensen & Taal 2016; pystoi's row_col_normalize without the random
+    jitter — deterministic epsilon guards instead)."""
+    seg = seg - jnp.mean(seg, axis=-1, keepdims=True)
+    seg = seg / (jnp.linalg.norm(seg, axis=-1, keepdims=True) + _EPS)
+    seg = seg - jnp.mean(seg, axis=1, keepdims=True)
+    seg = seg / (jnp.linalg.norm(seg, axis=1, keepdims=True) + _EPS)
+    return seg
+
+
+def _estoi_d(x_seg: Array, y_seg: Array, seg_mask: Array) -> Array:
+    """Extended STOI: masked mean of per-segment normalized inner products."""
+    x_n = _row_col_normalize(x_seg)
+    y_n = _row_col_normalize(y_seg)
+    per_seg = jnp.sum(x_n * y_n, axis=(1, 2)) / _SEG  # (S,)
+    per_seg = per_seg * seg_mask
+    return jnp.sum(per_seg) / jnp.maximum(seg_mask.sum(), 1.0)
+
+
+@partial(jax.jit, static_argnames=("fs", "extended"))
+def _stoi_single(target: Array, preds: Array, fs: int, extended: bool) -> Array:
+    """STOI of one (clean, degraded) pair — one compiled program."""
+    x = _resample_to_10k(target.astype(jnp.float32), fs)
+    y = _resample_to_10k(preds.astype(jnp.float32), fs)
+    x, y, k_count = _compact_loud_frames(x, y, _N_FRAME, _HOP)
+
+    obm = jnp.asarray(_third_octave_matrix(_FS, _NFFT, _NUM_BANDS, _MIN_FREQ))
+    x_tob = _band_spectrogram(x, obm)
+    y_tob = _band_spectrogram(y, obm)
+
+    x_seg = _segments(x_tob)
+    y_seg = _segments(y_tob)
+    n_segments = x_seg.shape[0]
+    if n_segments == 0:  # static: signal too short for even one segment
+        return jnp.asarray(1e-5, jnp.float32)
+    # after compacting K kept frames the rebuilt signal re-frames into K-1
+    # valid STFT frames (the boundary frame is dropped — see _frame);
+    # segment s spans frames [s, s+_SEG) and must lie fully inside them
+    # (pystoi's "not enough frames" → 1e-5 when none do)
+    seg_mask = (jnp.arange(n_segments) + _SEG <= k_count - 1).astype(jnp.float32)
+    d = _estoi_d(x_seg, y_seg, seg_mask) if extended else _stoi_d(x_seg, y_seg, seg_mask)
+    return jnp.where(seg_mask.sum() > 0, d, jnp.asarray(1e-5, jnp.float32))
+
+
+def short_time_objective_intelligibility(
+    preds: Array, target: Array, fs: int, extended: bool = False, keep_same_device: bool = False
+) -> Array:
+    """STOI, natively on device (ref functional/audio/stoi.py:29-103).
+
+    Args:
+        preds: degraded speech, shape ``[..., time]``
+        target: clean speech, shape ``[..., time]``
+        fs: sampling frequency of the inputs (Hz); internally resampled to
+            10 kHz like the published algorithm
+        extended: use the extended STOI (Jensen & Taal 2016)
+        keep_same_device: accepted for drop-in parity; the value already
+            lives on the compute device (the reference computes on host CPU
+            and optionally moves back)
+
+    Returns:
+        STOI value(s) of shape ``[...]``
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> rng = np.random.RandomState(42)
+        >>> preds = jnp.asarray(rng.randn(8000), jnp.float32)
+        >>> target = jnp.asarray(rng.randn(8000), jnp.float32)
+        >>> float(short_time_objective_intelligibility(preds, target, 8000)) < 0.1
+        True
+    """
+    _check_same_shape(preds, target)
+    del keep_same_device  # device-resident by construction
+    if preds.ndim == 1:
+        return _stoi_single(target, preds, fs, extended)
+    flat_p = preds.reshape(-1, preds.shape[-1])
+    flat_t = target.reshape(-1, target.shape[-1])
+    vals = jax.vmap(lambda t, p: _stoi_single(t, p, fs, extended))(flat_t, flat_p)
+    return vals.reshape(preds.shape[:-1])

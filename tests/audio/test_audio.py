@@ -168,17 +168,13 @@ class TestPIT:
             )
 
 
-def test_pesq_stoi_gated():
-    """PESQ/STOI require optional host packages; classes raise cleanly if absent."""
-    from metrics_tpu.utilities.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+def test_pesq_gated():
+    """PESQ still wraps the optional host package; raises cleanly if absent.
+    (STOI is native as of r2 — tests/audio/test_stoi.py.)"""
+    from metrics_tpu.utilities.imports import _PESQ_AVAILABLE
 
     if not _PESQ_AVAILABLE:
         from metrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality
 
         with pytest.raises(ModuleNotFoundError):
             PerceptualEvaluationSpeechQuality(16000, "wb")
-    if not _PYSTOI_AVAILABLE:
-        from metrics_tpu.audio.stoi import ShortTimeObjectiveIntelligibility
-
-        with pytest.raises(ModuleNotFoundError):
-            ShortTimeObjectiveIntelligibility(16000)

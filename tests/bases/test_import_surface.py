@@ -83,12 +83,13 @@ def test_functional_subpackage_exports(subpackage, names):
 
 
 def test_audio_optional_exports_follow_availability_flags():
-    """PESQ/STOI exports are gated like the reference (audio/__init__.py:6-11)."""
+    """PESQ is gated like the reference (audio/__init__.py:6-11); STOI is
+    native as of r2 and always exported."""
     import metrics_tpu.audio as audio
-    from metrics_tpu.utilities.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+    from metrics_tpu.utilities.imports import _PESQ_AVAILABLE
 
     assert hasattr(audio, "PerceptualEvaluationSpeechQuality") == _PESQ_AVAILABLE
-    assert hasattr(audio, "ShortTimeObjectiveIntelligibility") == _PYSTOI_AVAILABLE
+    assert hasattr(audio, "ShortTimeObjectiveIntelligibility")
 
 
 def test_utilities_exports():
