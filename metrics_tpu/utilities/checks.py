@@ -11,7 +11,9 @@ notes:
   [0,1], labels < num_classes) runs only when inputs are concrete arrays;
   under tracing it is skipped (XLA cannot branch on data).
 * Value-dependent *inference* of ``num_classes`` (from max label) likewise
-  only happens eagerly; inside jit the caller must pass ``num_classes``.
+  only happens eagerly; inside jit the caller must pass ``num_classes`` —
+  except with ``multiclass=False``, which certifies binary {0,1} data and
+  fixes the class count at 2 statically.
 """
 from typing import Optional, Tuple
 
@@ -249,7 +251,8 @@ def _input_format_classification(
     detected :class:`DataType`. Semantics follow the decision table of ref
     checks.py:310-449. Under jit, ``num_classes`` must be given whenever a
     one-hot expansion of integer labels is needed (the eager path infers it
-    from the data like the reference does).
+    from the data like the reference does) — unless ``multiclass=False``,
+    which certifies binary data and pins the class count to 2.
     """
     preds, target = _input_squeeze(preds, target)
     if preds.dtype == jnp.bfloat16 or preds.dtype == jnp.float16:
@@ -278,12 +281,17 @@ def _input_format_classification(
             preds = select_topk(preds, top_k or 1)
         else:
             if num_classes is None:
-                if _is_traced(preds, target):
+                if multiclass is False:
+                    # multiclass=False certifies binary {0,1} data, so the
+                    # class count is statically 2 — works under jit too
+                    num_classes = 2
+                elif _is_traced(preds, target):
                     raise ValueError(
                         "`num_classes` must be given when formatting integer multi-class "
                         "inputs under jit (cannot infer the class count from traced values)."
                     )
-                num_classes = int(max(preds.max(), target.max())) + 1
+                else:
+                    num_classes = int(max(preds.max(), target.max())) + 1
             preds = to_onehot(preds, max(2, num_classes))
 
         target = to_onehot(target, max(2, int(num_classes)))

@@ -67,3 +67,56 @@ _multilabel_multidim_inputs = Input(
     preds=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
     target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
 )
+
+# ---- remaining reference modes (ref inputs.py:33-35, 49-51, 63-67, 77-79,
+# 105-133) — appended so the RNG stream of the fixtures above is unchanged
+
+_binary_logits_inputs = Input(
+    preds=np.random.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_multilabel_logits_inputs = Input(
+    preds=np.random.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+# multilabel edge case where nothing matches (scores are undefined)
+_no_match_preds = np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+_multilabel_no_match_inputs = Input(preds=_no_match_preds, target=np.abs(_no_match_preds - 1))
+
+_mc_logits_raw = 10 * np.random.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)
+_multiclass_logits_inputs = Input(
+    preds=_mc_logits_raw.astype(np.float32),
+    target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+
+def generate_plausible_inputs_multilabel(num_classes=NUM_CLASSES, num_batches=NUM_BATCHES, batch_size=BATCH_SIZE):
+    """Probabilities biased toward the true class (ref inputs.py:105-118)."""
+    correct = np.random.randint(0, num_classes, (num_batches, batch_size))
+    preds = np.random.rand(num_batches, batch_size, num_classes)
+    targets = np.zeros_like(preds, dtype=np.int64)
+    for i in range(num_batches):
+        for j in range(batch_size):
+            targets[i, j, correct[i, j]] = 1
+    preds += np.random.rand(num_batches, batch_size, num_classes) * targets / 3
+    preds = preds / preds.sum(axis=2, keepdims=True)
+    return Input(preds=preds.astype(np.float32), target=targets)
+
+
+def generate_plausible_inputs_binary(num_batches=NUM_BATCHES, batch_size=BATCH_SIZE):
+    targets = np.random.randint(0, 2, (num_batches, batch_size))
+    preds = np.random.rand(num_batches, batch_size) + np.random.rand(num_batches, batch_size) * targets / 3
+    return Input(preds=(preds / (preds.max() + 0.01)).astype(np.float32), target=targets)
+
+
+_multilabel_prob_plausible_inputs = generate_plausible_inputs_multilabel()
+
+_binary_prob_plausible_inputs = generate_plausible_inputs_binary()
+
+# one class randomly absent from both preds and target (ref inputs.py:128-133)
+_mc_missing = np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_class_remove, _class_replace = np.random.choice(NUM_CLASSES, size=2, replace=False)
+_mc_missing[_mc_missing == _class_remove] = _class_replace
+_multiclass_with_missing_class_inputs = Input(preds=_mc_missing.copy(), target=_mc_missing.copy())
