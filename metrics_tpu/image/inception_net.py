@@ -50,7 +50,10 @@ class BasicConv(nn.Module):
 
 
 def _avg_pool_same(x: Array) -> Array:
-    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+    # count_include_pad=False matches the FID network's branch pools
+    # (torch_fidelity FIDInceptionA/C/E patches over torchvision's default):
+    # border windows divide by the number of REAL elements, not 9.
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME", count_include_pad=False)
 
 
 class InceptionA(nn.Module):
@@ -118,6 +121,14 @@ class InceptionD(nn.Module):
 
 
 class InceptionE(nn.Module):
+    """Last-stage mixed block.
+
+    ``pool="max"`` reproduces the FID network's quirk: its second E block
+    (Mixed_7c) uses max pooling in the branch-pool path where torchvision
+    uses average pooling (torch_fidelity FIDInceptionE_2).
+    """
+
+    pool: str = "avg"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -140,7 +151,12 @@ class InceptionE(nn.Module):
             ],
             axis=-1,
         )
-        bp = BasicConv(192, (1, 1), dtype=self.dtype)(_avg_pool_same(x))
+        pooled = (
+            nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            if self.pool == "max"
+            else _avg_pool_same(x)
+        )
+        bp = BasicConv(192, (1, 1), dtype=self.dtype)(pooled)
         return jnp.concatenate([b1, b3, bd, bp], axis=-1)
 
 
@@ -174,7 +190,7 @@ class InceptionV3(nn.Module):
         x = InceptionC(192, dtype=self.dtype)(x)
         x = InceptionD(dtype=self.dtype)(x)
         x = InceptionE(dtype=self.dtype)(x)
-        x = InceptionE(dtype=self.dtype)(x)
+        x = InceptionE(pool="max", dtype=self.dtype)(x)  # Mixed_7c, FID variant
         features = jnp.mean(x, axis=(1, 2))  # global average pool -> (N, 2048)
         logits = nn.Dense(self.num_classes, dtype=self.dtype)(features.astype(self.dtype))
         return features.astype(jnp.float32), logits.astype(jnp.float32)

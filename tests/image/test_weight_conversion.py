@@ -1,0 +1,219 @@
+"""Weight-converter tests: torch state dicts → flax, verified numerically.
+
+No pretrained weights exist in this image (zero egress), so correctness is
+pinned three ways without them:
+
+1. **Structural**: a synthetic state dict with the full torchvision/
+   torch_fidelity InceptionV3 naming converts into exactly the flax
+   module's expected tree (every key consumed, every shape right) — the
+   tool itself aborts otherwise.
+2. **Numeric**: the converted stem / fc / first LPIPS conv reproduce
+   ``torch.nn.functional`` outputs on the same inputs, catching any
+   OIHW→HWIO / transpose / BN-parameter routing error.
+3. **Golden pipeline**: a fixed-seed synthetic checkpoint converted and
+   run through the public extractor yields recorded pool3 values, pinning
+   the conversion+forward pipeline against regressions.
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+
+from convert_inception_weights import convert_state_dict, validate_against_module  # noqa: E402
+from convert_lpips_weights import _BACKBONE_CONVS, convert as convert_lpips, validate as validate_lpips  # noqa: E402
+
+
+def _inverse_top():
+    from convert_inception_weights import _BRANCH, _PARAM, _TOP
+
+    return _TOP, _BRANCH, _PARAM
+
+
+def _make_inception_state(seed=0, num_classes=1008):
+    """Synthetic torch state dict with the real network's names and shapes,
+    derived from the flax module's eval_shape through the inverse mapping."""
+    from flax.traverse_util import flatten_dict
+
+    from metrics_tpu.image.inception_net import InceptionV3
+
+    _TOP, _BRANCH, _PARAM = _inverse_top()
+    inv_top = {v: k for k, v in _TOP.items()}
+    inv_param = {(col, leaf): tail for tail, (col, leaf) in _PARAM.items()}
+
+    net = InceptionV3(num_classes=num_classes)
+    expected = jax.eval_shape(lambda: net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3))))
+    rng = np.random.RandomState(seed)
+    state = {}
+    for path, spec in flatten_dict(expected, sep="/").items():
+        shape = spec.shape
+        parts = path.split("/")
+        if parts[1] == "Dense_0":
+            if parts[2] == "kernel":
+                state["fc.weight"] = torch.from_numpy(
+                    rng.randn(shape[1], shape[0]).astype(np.float32)
+                )
+            else:
+                state["fc.bias"] = torch.from_numpy(rng.randn(*shape).astype(np.float32))
+            continue
+        torch_top = inv_top[parts[1]]
+        if parts[2].startswith("BasicConv_"):
+            block_kind = parts[1].rsplit("_", 1)[0]
+            idx = int(parts[2].split("_")[1])
+            branch = {v: k for k, v in _BRANCH[block_kind].items()}[idx]
+            leaf = (parts[0],) + tuple(parts[3:])
+            prefix = f"{torch_top}.{branch}"
+        else:
+            leaf = (parts[0],) + tuple(parts[2:])
+            prefix = torch_top
+        tail = inv_param[(leaf[0], "/".join(leaf[1:]))]  # e.g. conv.weight
+        # well-conditioned values: a 20-layer net of unconstrained randoms
+        # overflows float32; keep convs small and BN near identity
+        if tail == "conv.weight":  # HWIO spec -> torch OIHW values
+            value = 0.05 * rng.randn(shape[3], shape[2], shape[0], shape[1])
+        elif tail == "bn.weight":
+            value = 1.0 + 0.1 * rng.randn(*shape)
+        elif tail == "bn.running_var":
+            value = 1.0 + 0.1 * np.abs(rng.randn(*shape))
+        else:  # bn.bias / bn.running_mean
+            value = 0.1 * rng.randn(*shape)
+        state[f"{prefix}.{tail}"] = torch.from_numpy(value.astype(np.float32))
+    # entries the converter must skip
+    state["AuxLogits.conv0.conv.weight"] = torch.zeros(128, 768, 1, 1)
+    state["Conv2d_1a_3x3.bn.num_batches_tracked"] = torch.tensor(0)
+    return state
+
+
+def _apply_converted(flat, num_classes, x_nhwc):
+    from flax.traverse_util import unflatten_dict
+
+    from metrics_tpu.image.inception_net import InceptionV3
+
+    variables = unflatten_dict({k: jnp.asarray(v) for k, v in flat.items()}, sep="/")
+    net = InceptionV3(num_classes=num_classes)
+    return net.apply(variables, x_nhwc, capture_intermediates=True)
+
+
+def test_inception_conversion_structure():
+    state = _make_inception_state()
+    flat = convert_state_dict(state)
+    validate_against_module(flat, 1008)  # raises on any key/shape mismatch
+
+
+def test_inception_conversion_rejects_unknown_layout():
+    with pytest.raises(ValueError, match="unrecognized"):
+        convert_state_dict({"features.0.weight": torch.zeros(3, 3, 3, 3)})
+
+
+def test_inception_stem_matches_torch_functional():
+    """Converted stem conv+bn+relu == torch ops on the same NCHW input."""
+    state = _make_inception_state(seed=1)
+    flat = convert_state_dict(state)
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 3, 96, 96).astype(np.float32)
+
+    (_, _), inter = _apply_converted(flat, 1008, jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
+    got = np.asarray(inter["intermediates"]["BasicConv_0"]["__call__"][0])
+
+    with torch.no_grad():
+        t = torch.nn.functional.conv2d(
+            torch.from_numpy(x), state["Conv2d_1a_3x3.conv.weight"], stride=2
+        )
+        t = torch.nn.functional.batch_norm(
+            t,
+            state["Conv2d_1a_3x3.bn.running_mean"],
+            state["Conv2d_1a_3x3.bn.running_var"],
+            state["Conv2d_1a_3x3.bn.weight"],
+            state["Conv2d_1a_3x3.bn.bias"],
+            training=False,
+            eps=1e-3,
+        )
+        t = torch.relu(t).numpy()
+    np.testing.assert_allclose(got, np.transpose(t, (0, 2, 3, 1)), atol=2e-3)
+
+
+def test_inception_fc_matches_torch_linear():
+    state = _make_inception_state(seed=3)
+    flat = convert_state_dict(state)
+    rng = np.random.RandomState(4)
+    x = rng.rand(2, 3, 96, 96).astype(np.float32)
+    (features, logits), _ = _apply_converted(flat, 1008, jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
+    with torch.no_grad():
+        expect = torch.nn.functional.linear(
+            torch.from_numpy(np.asarray(features)), state["fc.weight"], state["fc.bias"]
+        ).numpy()
+    np.testing.assert_allclose(np.asarray(logits), expect, atol=5e-3, rtol=1e-4)
+
+
+def test_golden_pipeline_features():
+    """Fixed-seed checkpoint → converter → public extractor: recorded values."""
+    import tempfile
+
+    from metrics_tpu.image import InceptionV3FeatureExtractor
+
+    state = _make_inception_state(seed=7)
+    flat = convert_state_dict(state)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "w.npz")
+        np.savez(path, **flat)
+        ext = InceptionV3FeatureExtractor(weights_path=path)
+        imgs = (np.random.RandomState(8).rand(1, 3, 75, 75) * 255).astype(np.uint8)
+        feats = np.asarray(ext(jnp.asarray(imgs)))
+    assert feats.shape == (1, 2048)
+    # recorded pool3 values for the seed-7 checkpoint: any change to the
+    # conversion mapping OR the forward pass (branch routing, pooling
+    # semantics like count_include_pad / the Mixed_7c max pool) shifts these
+    np.testing.assert_allclose(
+        feats[0, :8],
+        [0.302166, 0.250966, 0.981654, 0.0, 0.698015, 0.0, 0.0, 0.0],
+        atol=1e-4,
+    )
+    np.testing.assert_allclose(float(feats.mean()), 0.190674, atol=1e-4)
+    np.testing.assert_allclose(float(feats.std()), 0.285031, atol=1e-4)
+
+
+def test_lpips_conversion_and_first_conv():
+    net = "alex"
+    rng = np.random.RandomState(5)
+    backbone = {}
+    for conv_idx, (o, i, k) in zip(_BACKBONE_CONVS[net], [(64, 3, 11), (192, 64, 5), (384, 192, 3), (256, 384, 3), (256, 256, 3)]):
+        backbone[f"{conv_idx}.weight"] = torch.from_numpy(rng.randn(o, i, k, k).astype(np.float32))
+        backbone[f"{conv_idx}.bias"] = torch.from_numpy(rng.randn(o).astype(np.float32))
+    lins = {}
+    for li, c in enumerate([64, 192, 384, 256, 256]):
+        lins[f"lin{li}.model.1.weight"] = torch.from_numpy(
+            np.abs(rng.randn(1, c, 1, 1)).astype(np.float32)
+        )
+    flat = convert_lpips(backbone, lins, net)
+    validate_lpips(flat, net)
+
+    # first tap == torch conv(stride 4, pad 2) + relu on the scaled input
+    from flax.traverse_util import unflatten_dict
+
+    from metrics_tpu.image.lpips_net import _LPIPSModule, _SCALE, _SHIFT
+
+    variables = unflatten_dict({k: jnp.asarray(v) for k, v in flat.items()}, sep="/")
+    img = np.random.RandomState(6).rand(1, 64, 64, 3).astype(np.float32) * 2 - 1
+    _, inter = _LPIPSModule(net_type=net).apply(
+        variables, jnp.asarray(img), jnp.asarray(img), capture_intermediates=True
+    )
+    taps = inter["intermediates"]["AlexNetFeatures_0"]["__call__"][0]
+    got = np.asarray(taps[0])
+
+    scaled = ((img - np.asarray(_SHIFT).reshape(1, 1, 1, 3)) / np.asarray(_SCALE).reshape(1, 1, 1, 3)).astype(np.float32)
+    with torch.no_grad():
+        t = torch.nn.functional.conv2d(
+            torch.from_numpy(np.transpose(scaled, (0, 3, 1, 2))),
+            backbone["0.weight"],
+            backbone["0.bias"],
+            stride=4,
+            padding=2,
+        )
+        expect = torch.relu(t).numpy()
+    np.testing.assert_allclose(got, np.transpose(expect, (0, 2, 3, 1)), atol=2e-3)

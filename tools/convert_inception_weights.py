@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Convert a torch InceptionV3 state dict to metrics_tpu flax weights.
+
+Accepts the state-dict layout shared by torchvision's ``inception_v3`` and
+``torch_fidelity``'s FID InceptionV3 (the network the reference wraps,
+/root/reference/torchmetrics/image/fid.py:27-57): keys like
+``Conv2d_1a_3x3.conv.weight``, ``Mixed_5b.branch1x1.bn.running_mean``,
+``fc.weight``. Produces the flat ``.npz`` that
+``metrics_tpu.image.inception_net.load_params`` reads.
+
+Offline usage (this environment has no egress; obtain the .pth elsewhere):
+
+    python tools/convert_inception_weights.py pt_inception.pth inception.npz
+    python - <<'PY'
+    from metrics_tpu.image import InceptionV3FeatureExtractor
+    ext = InceptionV3FeatureExtractor(weights_path="inception.npz")
+    PY
+
+Transforms applied per layer:
+  conv.weight  (O, I, H, W)  ->  Conv_0/kernel        (H, W, I, O)
+  bn.weight / bn.bias        ->  BatchNorm_0/scale / bias
+  bn.running_mean / _var     ->  batch_stats .../mean / var
+  fc.weight    (O, I)        ->  Dense_0/kernel       (I, O)
+``num_batches_tracked`` and ``AuxLogits.*`` entries are dropped (the aux
+head is not part of the inference network). The converted tree is
+validated key-by-key and shape-by-shape against the flax module's
+``eval_shape`` before saving; any mismatch aborts with the full diff.
+"""
+import argparse
+import sys
+
+import numpy as np
+
+# top-level torch module name -> flax submodule name (call order of
+# InceptionV3.__call__, metrics_tpu/image/inception_net.py)
+_TOP = {
+    "Conv2d_1a_3x3": "BasicConv_0",
+    "Conv2d_2a_3x3": "BasicConv_1",
+    "Conv2d_2b_3x3": "BasicConv_2",
+    "Conv2d_3b_1x1": "BasicConv_3",
+    "Conv2d_4a_3x3": "BasicConv_4",
+    "Mixed_5b": "InceptionA_0",
+    "Mixed_5c": "InceptionA_1",
+    "Mixed_5d": "InceptionA_2",
+    "Mixed_6a": "InceptionB_0",
+    "Mixed_6b": "InceptionC_0",
+    "Mixed_6c": "InceptionC_1",
+    "Mixed_6d": "InceptionC_2",
+    "Mixed_6e": "InceptionC_3",
+    "Mixed_7a": "InceptionD_0",
+    "Mixed_7b": "InceptionE_0",
+    "Mixed_7c": "InceptionE_1",
+}
+
+# branch name -> BasicConv index within each flax block (call order)
+_BRANCH = {
+    "InceptionA": {
+        "branch1x1": 0,
+        "branch5x5_1": 1,
+        "branch5x5_2": 2,
+        "branch3x3dbl_1": 3,
+        "branch3x3dbl_2": 4,
+        "branch3x3dbl_3": 5,
+        "branch_pool": 6,
+    },
+    "InceptionB": {
+        "branch3x3": 0,
+        "branch3x3dbl_1": 1,
+        "branch3x3dbl_2": 2,
+        "branch3x3dbl_3": 3,
+    },
+    "InceptionC": {
+        "branch1x1": 0,
+        "branch7x7_1": 1,
+        "branch7x7_2": 2,
+        "branch7x7_3": 3,
+        "branch7x7dbl_1": 4,
+        "branch7x7dbl_2": 5,
+        "branch7x7dbl_3": 6,
+        "branch7x7dbl_4": 7,
+        "branch7x7dbl_5": 8,
+        "branch_pool": 9,
+    },
+    "InceptionD": {
+        "branch3x3_1": 0,
+        "branch3x3_2": 1,
+        "branch7x7x3_1": 2,
+        "branch7x7x3_2": 3,
+        "branch7x7x3_3": 4,
+        "branch7x7x3_4": 5,
+    },
+    "InceptionE": {
+        "branch1x1": 0,
+        "branch3x3_1": 1,
+        "branch3x3_2a": 2,
+        "branch3x3_2b": 3,
+        "branch3x3dbl_1": 4,
+        "branch3x3dbl_2": 5,
+        "branch3x3dbl_3a": 6,
+        "branch3x3dbl_3b": 7,
+        "branch_pool": 8,
+    },
+}
+
+_PARAM = {  # torch tail -> (collection, flax leaf)
+    "conv.weight": ("params", "Conv_0/kernel"),
+    "bn.weight": ("params", "BatchNorm_0/scale"),
+    "bn.bias": ("params", "BatchNorm_0/bias"),
+    "bn.running_mean": ("batch_stats", "BatchNorm_0/mean"),
+    "bn.running_var": ("batch_stats", "BatchNorm_0/var"),
+}
+
+
+def convert_state_dict(state: dict) -> dict:
+    """torch name->tensor dict  ->  flat {'params/...': np.ndarray} dict."""
+    flat = {}
+    unused = []
+    for key, value in state.items():
+        value = np.asarray(value, dtype=np.float32)
+        if key.startswith("AuxLogits.") or key.endswith("num_batches_tracked"):
+            continue
+        if key == "fc.weight":
+            flat["params/Dense_0/kernel"] = value.T.copy()  # (O, I) -> (I, O)
+            continue
+        if key == "fc.bias":
+            flat["params/Dense_0/bias"] = value
+            continue
+        parts = key.split(".")
+        if parts[0] not in _TOP:
+            unused.append(key)
+            continue
+        flax_top = _TOP[parts[0]]
+        tail = ".".join(parts[-2:])
+        if tail not in _PARAM:
+            unused.append(key)
+            continue
+        collection, leaf = _PARAM[tail]
+        if len(parts) == 3:  # stem: Conv2d_1a_3x3.conv.weight
+            path = f"{collection}/{flax_top}/{leaf}"
+        else:  # block: Mixed_5b.branch1x1.conv.weight
+            block_kind = flax_top.rsplit("_", 1)[0]
+            branch = parts[1]
+            idx = _BRANCH[block_kind].get(branch)
+            if idx is None:
+                unused.append(key)
+                continue
+            path = f"{collection}/{flax_top}/BasicConv_{idx}/{leaf}"
+        if leaf.endswith("kernel"):
+            value = np.transpose(value, (2, 3, 1, 0)).copy()  # OIHW -> HWIO
+        flat[path] = value
+    if unused:
+        raise ValueError(f"unrecognized state-dict keys (wrong layout?): {unused[:10]}")
+    return flat
+
+
+def validate_against_module(flat: dict, num_classes: int) -> None:
+    """Abort unless the converted tree matches the flax module exactly."""
+    import jax
+    import jax.numpy as jnp
+    from flax.traverse_util import flatten_dict
+
+    from metrics_tpu.image.inception_net import InceptionV3
+
+    net = InceptionV3(num_classes=num_classes)
+    expected = jax.eval_shape(
+        lambda: net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    )
+    exp = {k: v.shape for k, v in flatten_dict(expected, sep="/").items()}
+    got = {k: v.shape for k, v in flat.items()}
+    missing = sorted(set(exp) - set(got))
+    extra = sorted(set(got) - set(exp))
+    mismatched = sorted(k for k in set(exp) & set(got) if exp[k] != got[k])
+    if missing or extra or mismatched:
+        raise ValueError(
+            "converted tree does not match the flax InceptionV3:\n"
+            f"  missing: {missing[:8]}\n  extra: {extra[:8]}\n"
+            f"  shape mismatches: {[(k, got[k], exp[k]) for k in mismatched[:8]]}"
+        )
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("torch_weights", help=".pth/.pt state dict (torch.load-able)")
+    parser.add_argument("out_npz", help="output .npz for InceptionV3FeatureExtractor(weights_path=...)")
+    args = parser.parse_args(argv)
+
+    import torch
+
+    state = torch.load(args.torch_weights, map_location="cpu", weights_only=True)
+    if not isinstance(state, dict):
+        state = state.state_dict()
+    state = {k: v for k, v in state.items() if hasattr(v, "shape")}
+
+    flat = convert_state_dict(state)
+    num_classes = flat["params/Dense_0/kernel"].shape[1]
+    validate_against_module(flat, num_classes)
+    np.savez(args.out_npz, **flat)
+    print(f"wrote {args.out_npz}: {len(flat)} arrays, num_classes={num_classes}")
+    print("load with: InceptionV3FeatureExtractor(weights_path=%r)" % args.out_npz)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Convert torch LPIPS weights (backbone + lin heads) to metrics_tpu flax.
+
+The reference wraps the ``lpips`` package (/root/reference/torchmetrics/
+image/lpip.py:21-40), whose model = a torchvision backbone (alexnet or
+vgg16 ``features``) + five learned 1x1 "lin" heads shipped as a small
+checkpoint (``lpips/weights/v0.1/{alex,vgg}.pth``). This tool fuses both
+into one flax ``.npz`` for ``LPIPSNet(weights_path=...)``.
+
+Offline usage:
+
+    python tools/convert_lpips_weights.py --net alex \
+        --backbone alexnet_features.pth --lins lpips_alex.pth lpips_alex.npz
+
+``--backbone`` takes a torchvision ``alexnet().features.state_dict()`` /
+``vgg16().features.state_dict()`` file; ``--lins`` the lpips checkpoint
+(keys ``lin0.model.1.weight`` ... ``lin4.model.1.weight``).
+"""
+import argparse
+
+import numpy as np
+
+# torchvision features index of each conv, in tap order -> flax Conv_i
+_BACKBONE_CONVS = {
+    "alex": [0, 3, 6, 8, 10],
+    "vgg": [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28],
+}
+_TRUNK_NAME = {"alex": "AlexNetFeatures_0", "vgg": "VGG16Features_0"}
+
+
+def convert(backbone_state: dict, lins_state: dict, net: str) -> dict:
+    trunk = _TRUNK_NAME[net]
+    flat = {}
+    for i, conv_idx in enumerate(_BACKBONE_CONVS[net]):
+        w = np.asarray(backbone_state[f"{conv_idx}.weight"], dtype=np.float32)
+        b = np.asarray(backbone_state[f"{conv_idx}.bias"], dtype=np.float32)
+        flat[f"params/{trunk}/Conv_{i}/kernel"] = np.transpose(w, (2, 3, 1, 0)).copy()
+        flat[f"params/{trunk}/Conv_{i}/bias"] = b
+    for i in range(5):
+        w = np.asarray(lins_state[f"lin{i}.model.1.weight"], dtype=np.float32)
+        flat[f"params/lin{i}/kernel"] = np.transpose(w, (2, 3, 1, 0)).copy()
+    return flat
+
+
+def validate(flat: dict, net: str) -> None:
+    import jax
+    import jax.numpy as jnp
+    from flax.traverse_util import flatten_dict
+
+    from metrics_tpu.image.lpips_net import _LPIPSModule
+
+    hw = 64 if net == "alex" else 32
+    dummy = jnp.zeros((1, hw, hw, 3))
+    expected = jax.eval_shape(
+        lambda: _LPIPSModule(net_type=net).init(jax.random.PRNGKey(0), dummy, dummy)
+    )
+    exp = {k: v.shape for k, v in flatten_dict(expected, sep="/").items()}
+    got = {k: v.shape for k, v in flat.items()}
+    if exp != got:
+        missing = sorted(set(exp) - set(got))
+        extra = sorted(set(got) - set(exp))
+        mismatched = sorted(k for k in set(exp) & set(got) if exp[k] != got[k])
+        raise ValueError(
+            f"converted tree does not match flax LPIPS({net}):\n"
+            f"  missing: {missing[:8]}\n  extra: {extra[:8]}\n"
+            f"  shape mismatches: {[(k, got[k], exp[k]) for k in mismatched[:8]]}"
+        )
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--net", choices=("alex", "vgg"), required=True)
+    parser.add_argument("--backbone", required=True, help="torchvision features state dict (.pth)")
+    parser.add_argument("--lins", required=True, help="lpips v0.1 checkpoint (.pth)")
+    parser.add_argument("out_npz")
+    args = parser.parse_args(argv)
+
+    import torch
+
+    backbone = torch.load(args.backbone, map_location="cpu", weights_only=True)
+    lins = torch.load(args.lins, map_location="cpu", weights_only=True)
+
+    flat = convert(backbone, lins, args.net)
+    validate(flat, args.net)
+    np.savez(args.out_npz, **flat)
+    print(f"wrote {args.out_npz}: {len(flat)} arrays")
+    print("load with: LPIPSNet(net_type=%r, weights_path=%r)" % (args.net, args.out_npz))
+
+
+if __name__ == "__main__":
+    main()
