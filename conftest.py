@@ -30,3 +30,9 @@ def pytest_configure(config):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # Persistent compilation cache: the suite is compile-dominated on this
+    # single-core image (dozens of shard_map programs at 4-13 s each), so
+    # warm reruns drop from ~20 min to well under 10 (VERDICT r1 item 10).
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -116,7 +116,7 @@ def test_inception_stem_matches_torch_functional():
     state = _make_inception_state(seed=1)
     flat = convert_state_dict(state)
     rng = np.random.RandomState(2)
-    x = rng.rand(2, 3, 96, 96).astype(np.float32)
+    x = rng.rand(2, 3, 75, 75).astype(np.float32)
 
     (_, _), inter = _apply_converted(flat, 1008, jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
     got = np.asarray(inter["intermediates"]["BasicConv_0"]["__call__"][0])
@@ -142,7 +142,7 @@ def test_inception_fc_matches_torch_linear():
     state = _make_inception_state(seed=3)
     flat = convert_state_dict(state)
     rng = np.random.RandomState(4)
-    x = rng.rand(2, 3, 96, 96).astype(np.float32)
+    x = rng.rand(2, 3, 75, 75).astype(np.float32)
     (features, logits), _ = _apply_converted(flat, 1008, jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
     with torch.no_grad():
         expect = torch.nn.functional.linear(
@@ -217,3 +217,56 @@ def test_lpips_conversion_and_first_conv():
         )
         expect = torch.relu(t).numpy()
     np.testing.assert_allclose(got, np.transpose(expect, (0, 2, 3, 1)), atol=2e-3)
+
+
+def test_avg_pool_matches_torch_count_exclude_pad():
+    """The branch pools must reproduce torch avg_pool2d(count_include_pad=
+    False) — the FID network's semantics — including border windows."""
+    from metrics_tpu.image.inception_net import _avg_pool_same
+
+    x = np.random.RandomState(9).rand(2, 7, 7, 5).astype(np.float32)
+    got = np.asarray(_avg_pool_same(jnp.asarray(x)))
+    with torch.no_grad():
+        expect = torch.nn.functional.avg_pool2d(
+            torch.from_numpy(np.transpose(x, (0, 3, 1, 2))),
+            kernel_size=3, stride=1, padding=1, count_include_pad=False,
+        ).numpy()
+    np.testing.assert_allclose(got, np.transpose(expect, (0, 2, 3, 1)), atol=1e-6)
+
+
+def test_mixed_7c_uses_max_pool_branch():
+    """Exactly the SECOND InceptionE block (Mixed_7c) runs the FID max-pool
+    quirk: re-applying each captured block input through a standalone
+    InceptionE with pool='max'/'avg' must reproduce the captured outputs."""
+    from flax.core import freeze
+    from flax.traverse_util import unflatten_dict
+
+    from metrics_tpu.image.inception_net import InceptionE
+
+    state = _make_inception_state(seed=12)
+    flat = convert_state_dict(state)
+    # large enough that the E blocks see >1x1 spatial maps (pooling is
+    # degenerate at 1x1, where max == avg and the test would pass vacuously)
+    x = np.random.RandomState(13).rand(1, 3, 139, 139).astype(np.float32)
+    _, inter = _apply_converted(flat, 1008, jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
+    inter = inter["intermediates"]
+    e0_in = inter["InceptionD_0"]["__call__"][0]
+    e0_out = np.asarray(inter["InceptionE_0"]["__call__"][0])
+    e1_out = np.asarray(inter["InceptionE_1"]["__call__"][0])
+    assert e1_out.shape[1] > 1 and e1_out.shape[2] > 1  # non-degenerate pooling
+
+    variables = unflatten_dict({k: jnp.asarray(v) for k, v in flat.items()}, sep="/")
+
+    def sub(block, pool, x_in):
+        sub_vars = {
+            "params": variables["params"][block],
+            "batch_stats": variables["batch_stats"][block],
+        }
+        return np.asarray(InceptionE(pool=pool).apply(sub_vars, x_in))
+
+    # first E block is plain average pooling
+    np.testing.assert_allclose(sub("InceptionE_0", "avg", e0_in), e0_out, atol=1e-5)
+    assert not np.allclose(sub("InceptionE_0", "max", e0_in), e0_out, atol=1e-3)
+    # second E block (Mixed_7c) is the max-pool variant
+    np.testing.assert_allclose(sub("InceptionE_1", "max", jnp.asarray(e0_out)), e1_out, atol=1e-5)
+    assert not np.allclose(sub("InceptionE_1", "avg", jnp.asarray(e0_out)), e1_out, atol=1e-3)

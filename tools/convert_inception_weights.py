@@ -8,6 +8,12 @@ Accepts the state-dict layout shared by torchvision's ``inception_v3`` and
 ``fc.weight``. Produces the flat ``.npz`` that
 ``metrics_tpu.image.inception_net.load_params`` reads.
 
+NOTE: the flax network implements the FID variant's forward pass
+(count_include_pad=False branch pools; max pool in Mixed_7c). Convert the
+torch_fidelity FID state dict for published-comparable metric values;
+torchvision weights convert cleanly but run under FID pooling semantics
+(the tool warns when the 1000-logit torchvision head is detected).
+
 Offline usage (this environment has no egress; obtain the .pth elsewhere):
 
     python tools/convert_inception_weights.py pt_inception.pth inception.npz
@@ -194,6 +200,16 @@ def main(argv=None) -> None:
     flat = convert_state_dict(state)
     num_classes = flat["params/Dense_0/kernel"].shape[1]
     validate_against_module(flat, num_classes)
+    if num_classes != 1008:
+        print(
+            f"WARNING: {num_classes} logits suggests torchvision weights (FID "
+            "variant has 1008). The flax network applies the FID network's "
+            "pooling (count_include_pad=False branch pools, max pool in "
+            "Mixed_7c), so features will differ slightly from the torchvision "
+            "model these weights came from. For published-comparable FID/KID/"
+            "IS, convert the torch_fidelity pt_inception state dict instead.",
+            file=sys.stderr,
+        )
     np.savez(args.out_npz, **flat)
     print(f"wrote {args.out_npz}: {len(flat)} arrays, num_classes={num_classes}")
     print("load with: InceptionV3FeatureExtractor(weights_path=%r)" % args.out_npz)
