@@ -90,22 +90,14 @@ def _bench_torch_baseline() -> float:
     return best
 
 
-def _bench_detail() -> dict:
-    """Extra BASELINE.md configs; written to BENCH_DETAIL.json with BENCH_ALL=1."""
-    import sys
-
-    def _mark(key):
-        print(f"# detail: {key}", file=sys.stderr, flush=True)
-
+def _cfg_collection(detail: dict) -> None:
+    """Collection forward loop, eager vs fused single-jit dispatch."""
     import jax
     import jax.numpy as jnp
 
-    detail = {}
-    rng = np.random.RandomState(0)
-
-    # MetricCollection(Accuracy, F1, BinnedAveragePrecision) forward loop
     from metrics_tpu import Accuracy, BinnedAveragePrecision, F1Score, MetricCollection
 
+    rng = np.random.RandomState(0)
     logits = rng.rand(256, 32).astype(np.float32)
     preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
     target = jnp.asarray(rng.randint(0, 32, 256))
@@ -120,7 +112,6 @@ def _bench_detail() -> dict:
         mc.update(preds, target)
     jax.block_until_ready(mc["ap"].TPs)
     detail["collection_update_us"] = round((time.perf_counter() - t0) / 50 * 1e6, 1)
-    _mark("collection_update_us")
 
     # same suite through the fused single-jit dispatch (one XLA program,
     # CSE-deduplicated across metrics)
@@ -135,9 +126,19 @@ def _bench_detail() -> dict:
         mcf.update(preds, target)
     jax.block_until_ready(mcf["ap"].TPs)
     detail["collection_update_fused_us"] = round((time.perf_counter() - t0) / 50 * 1e6, 1)
-    _mark("collection_update_fused_us")
 
-    # whole-epoch scan: 100 updates in ONE compiled program vs 100 dispatches
+
+def _cfg_scan_epoch(detail: dict, reps: int = 5) -> None:
+    """Whole-epoch scan (one program) vs 100 jitted per-batch dispatches.
+
+    Both sides are best-of-``reps`` so the comparison shares one protocol
+    regardless of which suite (full or fast) produced the file."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+
+    rng = np.random.RandomState(1)
     acc = Accuracy(num_classes=32)
     ep_logits = rng.rand(100, 256, 32).astype(np.float32)
     ep_preds = jnp.asarray(ep_logits / ep_logits.sum(-1, keepdims=True))
@@ -146,19 +147,20 @@ def _bench_detail() -> dict:
     st = scan_step(acc.state(), ep_preds, ep_target)  # compile
     jax.block_until_ready(jax.tree_util.tree_leaves(st))
     best = float("inf")
-    for _ in range(5):
+    for _ in range(reps):
         t0 = time.perf_counter()
         st = scan_step(acc.state(), ep_preds, ep_target)
         jax.block_until_ready(jax.tree_util.tree_leaves(st))
         best = min(best, time.perf_counter() - t0)
     detail["scan_epoch_100_batches_ms"] = round(best * 1e3, 2)
+
     step = jax.jit(acc.pure_update)
     # pre-slice: a real per-batch loop receives batches individually
     batches = [(ep_preds[i], ep_target[i]) for i in range(100)]
     st2 = step(acc.state(), *batches[0])
     jax.block_until_ready(jax.tree_util.tree_leaves(st2))
     best = float("inf")
-    for _ in range(5):
+    for _ in range(reps):
         t0 = time.perf_counter()
         st2 = acc.state()
         for p, t in batches:
@@ -166,11 +168,16 @@ def _bench_detail() -> dict:
         jax.block_until_ready(jax.tree_util.tree_leaves(st2))
         best = min(best, time.perf_counter() - t0)
     detail["loop_epoch_100_batches_ms"] = round(best * 1e3, 2)
-    _mark("scan_epoch_100_batches_ms")
 
-    # RetrievalMAP: MSLR-style grouped ranking
+
+def _cfg_retrieval(detail: dict) -> None:
+    """RetrievalMAP: MSLR-style grouped ranking, 100k rows."""
+    import jax
+    import jax.numpy as jnp
+
     from metrics_tpu import RetrievalMAP
 
+    rng = np.random.RandomState(2)
     n_queries, docs = 1000, 100
     indexes = jnp.asarray(np.repeat(np.arange(n_queries), docs))
     scores = jnp.asarray(rng.rand(n_queries * docs).astype(np.float32))
@@ -181,13 +188,17 @@ def _bench_detail() -> dict:
     val = rmap.compute()
     jax.block_until_ready(val)
     detail["retrieval_map_compute_ms_100k_rows"] = round((time.perf_counter() - t0) * 1e3, 1)
-    _mark("retrieval_map_compute_ms_100k_rows")
 
-    # COCO mAP: 100 images x 100 dets / 30 gts (COCO maxDet density) —
-    # native matcher vs the numpy fallback loop (the reference's
-    # per-threshold Python-loop protocol)
+
+def _cfg_coco(detail: dict, python_baseline: bool = False) -> None:
+    """COCO mAP at maxDet density: 100 images x 100 dets / 30 gts; with
+    ``python_baseline`` also times the numpy-fallback matcher (the
+    reference's per-threshold Python-loop protocol)."""
+    import jax.numpy as jnp
+
     from metrics_tpu.detection import MeanAveragePrecision
 
+    rng = np.random.RandomState(3)
     coco_preds, coco_targs = [], []
     for _ in range(100):
         boxes = rng.rand(100, 4).astype(np.float32) * 100
@@ -204,8 +215,9 @@ def _bench_detail() -> dict:
     t0 = time.perf_counter()
     m.compute()
     detail["coco_map_compute_s_100_images"] = round(time.perf_counter() - t0, 2)
-    _mark("coco_map_compute_s_100_images")
 
+    if not python_baseline:
+        return
     import metrics_tpu.native as _native_mod
 
     _orig_match = _native_mod.coco_match
@@ -217,7 +229,27 @@ def _bench_detail() -> dict:
         detail["coco_map_python_matcher_baseline_s"] = round(time.perf_counter() - t0, 2)
     finally:
         _native_mod.coco_match = _orig_match
-    _mark("coco_map_python_matcher_baseline_s")
+
+
+def _bench_detail() -> dict:
+    """Extra BASELINE.md configs; written to BENCH_DETAIL.json with BENCH_ALL=1."""
+    import jax
+    import jax.numpy as jnp
+
+    def _mark(key):
+        print(f"# detail: {key}", file=sys.stderr, flush=True)
+
+    detail = {"suite": "full"}
+    rng = np.random.RandomState(0)
+
+    _cfg_collection(detail)
+    _mark("collection_update_us")
+    _cfg_scan_epoch(detail)
+    _mark("scan_epoch_100_batches_ms")
+    _cfg_retrieval(detail)
+    _mark("retrieval_map_compute_ms_100k_rows")
+    _cfg_coco(detail, python_baseline=True)
+    _mark("coco_map_compute_s_100_images")
 
     # FID with the bundled Flax InceptionV3 (BASELINE.md config #5)
     from metrics_tpu.image import FrechetInceptionDistance, InceptionV3FeatureExtractor
@@ -373,6 +405,56 @@ def _enable_compile_cache() -> None:
         pass  # cache is an optimization only
 
 
+def _bench_detail_fast() -> dict:
+    """The key BASELINE.md configs (same helpers as the full suite),
+    time-budgeted for the driver's plain end-of-round ``python bench.py``
+    run on the real chip: a config only STARTS while budget remains, so
+    the pass is bounded at budget + one config's runtime."""
+    budget = float(os.environ.get("BENCH_FAST_DETAIL_BUDGET", "240"))
+    t_start = time.perf_counter()
+    detail = {"suite": "fast"}
+    configs = [
+        ("collection", _cfg_collection),
+        ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),
+        ("retrieval", _cfg_retrieval),
+        ("coco_map", _cfg_coco),
+    ]
+    for key, fn in configs:
+        if time.perf_counter() - t_start > budget:
+            detail[f"{key}_skipped"] = "fast-detail budget exhausted"
+            continue
+        try:
+            fn(detail)
+        except Exception as err:  # one broken config must not lose the rest
+            detail[f"{key}_error"] = str(err)[:200]
+        print(f"# fast detail: {key}", file=sys.stderr, flush=True)
+    detail["fast_detail_elapsed_s"] = round(time.perf_counter() - t_start, 1)
+    return detail
+
+
+def _write_detail(detail: dict) -> None:
+    """Write BENCH_DETAIL.json next to this script — but never let a fast
+    subset clobber a full BENCH_ALL capture, unless the fast run is the
+    first one with real-accelerator numbers (CPU evidence is replaceable,
+    TPU evidence is the point — VERDICT r1 item 2)."""
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+    if detail.get("suite") == "fast" and os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                existing = json.load(f)
+        except Exception:
+            existing = {}
+        existing_full = existing.get("suite", "full") == "full"
+        existing_on_cpu = "CPU" in str(existing.get("device", "CPU")).upper()
+        ours_on_accel = "CPU" not in str(detail.get("device", "")).upper()
+        if existing_full and not (existing_on_cpu and ours_on_accel):
+            print("# keeping existing full BENCH_DETAIL.json (fast subset not written)",
+                  file=sys.stderr, flush=True)
+            return
+    with open(out_path, "w") as f:
+        json.dump(detail, f, indent=2)
+
+
 def _worker_main() -> None:
     """Run the benchmark on whatever backend this process initializes."""
     _enable_compile_cache()
@@ -388,20 +470,9 @@ def _worker_main() -> None:
     except Exception:
         pass  # vs_baseline stays null — keep the JSON line strict-parseable
 
-    if os.environ.get("BENCH_ALL"):
-        try:
-            detail = _bench_detail()
-            detail["accuracy_update_us"] = round(ours_us, 2)
-            detail["torch_cpu_baseline_us"] = base_us
-            detail["device"] = device
-            # always next to this script (the worker's cwd is forced there;
-            # keep the artifact location independent of the invoker's cwd)
-            out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
-            with open(out_path, "w") as f:
-                json.dump(detail, f, indent=2)
-        except Exception as err:  # detail bench must never break the headline
-            print(f"# detail bench failed: {err}", file=sys.stderr)
-
+    # headline FIRST: if a later detail pass overruns the parent watchdog,
+    # the orchestrator can still salvage this line from the killed worker's
+    # captured stdout instead of discarding healthy TPU numbers
     print(
         json.dumps(
             {
@@ -411,8 +482,26 @@ def _worker_main() -> None:
                 "vs_baseline": vs_baseline,
                 "device": device,
             }
-        )
+        ),
+        flush=True,
     )
+
+    on_accelerator = jax.devices()[0].platform != "cpu"
+    want_detail = os.environ.get("BENCH_ALL") or (
+        on_accelerator and not os.environ.get("BENCH_SKIP_DETAIL")
+    )
+    if want_detail:
+        try:
+            # full suite under BENCH_ALL; on a real chip the driver's plain
+            # run still captures the key configs (VERDICT r1 item 2) within
+            # a hard time budget
+            detail = _bench_detail() if os.environ.get("BENCH_ALL") else _bench_detail_fast()
+            detail["accuracy_update_us"] = round(ours_us, 2)
+            detail["torch_cpu_baseline_us"] = base_us
+            detail["device"] = device
+            _write_detail(detail)
+        except Exception as err:  # detail bench must never break the headline
+            print(f"# detail bench failed: {err}", file=sys.stderr)
 
 
 def _run_worker(env: dict, timeout: float):
@@ -433,6 +522,19 @@ def _run_worker(env: dict, timeout: float):
             tail = tail.decode(errors="replace")
         print(f"# bench worker timed out after {timeout:.0f}s: {tail[-800:]}",
               file=sys.stderr, flush=True)
+        # salvage: the worker prints the headline before any detail pass, so
+        # a mid-detail kill still yields valid (often TPU) numbers
+        out = err.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        for line in reversed(out.strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict) and "metric" in parsed:
+                print("# salvaged headline from timed-out worker", file=sys.stderr, flush=True)
+                return parsed, float("inf")
         return None, float("inf")  # a timeout is never a "fast failure"
     if proc.stderr:
         print(proc.stderr[-2000:], file=sys.stderr, flush=True)
@@ -462,8 +564,9 @@ def main() -> None:
 
     # BENCH_ALL runs the full detail suite (several model compiles, a nested
     # 300s dist sub-bench) — the watchdog must cover it or a healthy mid-run
-    # TPU worker gets killed and silently replaced by CPU numbers.
-    default_timeout = "1800" if os.environ.get("BENCH_ALL") else "480"
+    # TPU worker gets killed and silently replaced by CPU numbers. A plain
+    # TPU run also does the budgeted fast-detail pass (~240s + compiles).
+    default_timeout = "1800" if os.environ.get("BENCH_ALL") else "900"
     tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", default_timeout))
     result, elapsed = _run_worker(dict(os.environ), tpu_timeout)
     if result is None and elapsed < 60:
