@@ -438,16 +438,22 @@ def _write_detail(detail: dict) -> None:
     first one with real-accelerator numbers (CPU evidence is replaceable,
     TPU evidence is the point — VERDICT r1 item 2)."""
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
-    if detail.get("suite") == "fast" and os.path.exists(out_path):
+    if os.path.exists(out_path):
         try:
             with open(out_path) as f:
                 existing = json.load(f)
         except Exception:
             existing = {}
-        existing_full = existing.get("suite", "full") == "full"
-        existing_on_cpu = "CPU" in str(existing.get("device", "CPU")).upper()
+        existing_on_accel = "CPU" not in str(existing.get("device", "CPU")).upper()
         ours_on_accel = "CPU" not in str(detail.get("device", "")).upper()
-        if existing_full and not (existing_on_cpu and ours_on_accel):
+        existing_full = existing.get("suite", "full") == "full"
+        # accelerator evidence outranks CPU evidence; within the same device
+        # class, a full capture outranks a fast subset
+        if existing_on_accel and not ours_on_accel:
+            print("# keeping existing accelerator BENCH_DETAIL.json (CPU run not written)",
+                  file=sys.stderr, flush=True)
+            return
+        if detail.get("suite") == "fast" and existing_full and existing_on_accel == ours_on_accel:
             print("# keeping existing full BENCH_DETAIL.json (fast subset not written)",
                   file=sys.stderr, flush=True)
             return

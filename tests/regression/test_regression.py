@@ -245,3 +245,76 @@ def test_cosine_similarity():
         preds2, target2, metric_functional=cosine_similarity, reference_metric=_sk,
         metric_args={"reduction": "mean"}, atol=1e-5,
     )
+
+
+# ---- multi-target inputs (ref tests/regression: _multi_target_inputs drive
+# every metric alongside the single-target fixtures) ----
+
+_preds_mt = np.random.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32)
+_target_mt = np.random.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32)
+
+
+@pytest.mark.parametrize("metric_class,metric_fn,sk_fn,args", SIMPLE_CASES)
+class TestSimpleRegressionMultiTarget(MetricTester):
+    """The scalar-state metrics must treat (N, d) targets elementwise,
+    matching the sklearn oracle on the flattened data."""
+
+    def test_class_multi_target(self, metric_class, metric_fn, sk_fn, args):
+        flat_ref = lambda p, t: sk_fn(np.asarray(p).reshape(-1), np.asarray(t).reshape(-1))
+        self.run_class_metric_test(
+            preds=_preds_mt, target=_target_mt, metric_class=metric_class,
+            reference_metric=flat_ref, metric_args=args, atol=1e-5,
+        )
+
+    def test_fn_multi_target(self, metric_class, metric_fn, sk_fn, args):
+        flat_ref = lambda p, t: sk_fn(np.asarray(p).reshape(-1), np.asarray(t).reshape(-1))
+        self.run_functional_metric_test(
+            _preds_mt, _target_mt, metric_functional=metric_fn,
+            reference_metric=flat_ref, metric_args=args, atol=1e-5,
+        )
+
+    def test_jit_multi_target(self, metric_class, metric_fn, sk_fn, args):
+        self.run_jit_test(_preds_mt, _target_mt, metric_functional=metric_fn, metric_args=args)
+
+
+def test_mse_multi_target_dist():
+    """One representative multi-target metric through the 8-device path."""
+    flat_ref = lambda p, t: sk_mse(np.asarray(t, np.float64).reshape(-1), np.asarray(p, np.float64).reshape(-1))
+    MetricTester().run_class_metric_test(
+        preds=_preds_mt, target=_target_mt, metric_class=MeanSquaredError,
+        reference_metric=flat_ref, dist=True, atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("multioutput", ["uniform_average", "raw_values", "variance_weighted"])
+def test_r2_multioutput(multioutput):
+    """R2 multioutput modes vs sklearn on (N, d) data (ref test_r2.py)."""
+    def _sk(p, t):
+        return sk_r2(np.asarray(t, np.float64), np.asarray(p, np.float64), multioutput=multioutput)
+
+    MetricTester().run_functional_metric_test(
+        _preds_mt, _target_mt, metric_functional=r2_score, reference_metric=_sk,
+        metric_args={"multioutput": multioutput}, atol=1e-5,
+    )
+    MetricTester().run_class_metric_test(
+        preds=_preds_mt, target=_target_mt, metric_class=R2Score, reference_metric=_sk,
+        metric_args={"num_outputs": 5, "multioutput": multioutput}, atol=1e-5,
+    )
+
+
+def test_cosine_similarity_reductions():
+    """reduction in {sum, none} — 'mean' is covered by
+    test_cosine_similarity above (ref test_cosine_similarity.py)."""
+    preds2 = np.random.rand(NUM_BATCHES, BATCH_SIZE, 8).astype(np.float32)
+    target2 = np.random.rand(NUM_BATCHES, BATCH_SIZE, 8).astype(np.float32)
+
+    def _sim(p, t):
+        p, t = np.asarray(p, np.float64), np.asarray(t, np.float64)
+        return (p * t).sum(-1) / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1))
+
+    for reduction, agg in [("sum", np.sum), ("none", lambda x: x)]:
+        MetricTester().run_functional_metric_test(
+            preds2, target2, metric_functional=cosine_similarity,
+            reference_metric=lambda p, t, agg=agg: agg(_sim(p, t)),
+            metric_args={"reduction": reduction}, atol=1e-4,
+        )
