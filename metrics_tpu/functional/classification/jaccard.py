@@ -25,7 +25,9 @@ def _jaccard_from_confmat(
 ) -> Array:
     """Intersection-over-union from a confusion matrix (ref jaccard.py:24-68)."""
     if ignore_index is not None and 0 <= ignore_index < num_classes:
-        confmat = confmat.at[ignore_index].set(0.0)
+        # match the confmat dtype: a float literal into an int32 scatter is
+        # a FutureWarning today and a hard error in future jax releases
+        confmat = confmat.at[ignore_index].set(jnp.zeros((), confmat.dtype))
 
     intersection = jnp.diag(confmat)
     union = confmat.sum(axis=0) + confmat.sum(axis=1) - intersection

@@ -144,3 +144,82 @@ def test_jaccard():
         metric_args={"num_classes": NUM_CLASSES},
         atol=1e-5,
     )
+
+
+# ---- additional input modes + parameter axes (round-2 breadth) ----
+
+
+def test_cohen_kappa_binary_and_logits_modes():
+    """Kappa over binary-prob and logits fixtures (thresholded at 0.5/0.0
+    like the reference's own matrix)."""
+    from tests.classification.inputs import _binary_logits_inputs
+
+    for inputs, threshold, nc in [
+        (_binary_prob_inputs, 0.5, 2),
+        (_binary_logits_inputs, 0.0, 2),
+    ]:
+        def _sk(p, t, threshold=threshold):
+            p, t = np.asarray(p), np.asarray(t)
+            p = (p >= threshold).astype(int)
+            return sk_cohen_kappa(t.reshape(-1), p.reshape(-1))
+
+        MetricTester().run_functional_metric_test(
+            inputs.preds, inputs.target, metric_functional=cohen_kappa,
+            reference_metric=_sk, metric_args={"num_classes": nc, "threshold": threshold},
+            atol=1e-5,
+        )
+
+
+def test_matthews_binary_mode():
+    def _sk(p, t):
+        p, t = np.asarray(p), np.asarray(t)
+        return sk_matthews(t.reshape(-1), (p >= THRESHOLD).astype(int).reshape(-1))
+
+    MetricTester().run_class_metric_test(
+        preds=_binary_prob_inputs.preds, target=_binary_prob_inputs.target,
+        metric_class=MatthewsCorrCoef, reference_metric=_sk,
+        metric_args={"num_classes": 2, "threshold": THRESHOLD}, atol=1e-5,
+    )
+
+
+def test_jaccard_ignore_index_and_absent_score():
+    """ignore_index drops a class from the mean; absent_score fills classes
+    missing from both preds and target (ref functional/jaccard.py:22-66)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional import jaccard_index as jac
+
+    # classes: 0 and 1 present, 2 deliberately absent everywhere
+    preds = jnp.asarray([0, 0, 1, 1])
+    target = jnp.asarray([0, 1, 1, 1])
+
+    # per-class IoU: c0 = 1/2, c1 = 2/3, c2 absent -> absent_score
+    expect_with_absent = (0.5 + 2 / 3 + 0.9) / 3
+    got = jac(preds, target, num_classes=3, absent_score=0.9, reduction="elementwise_mean")
+    np.testing.assert_allclose(float(got), expect_with_absent, atol=1e-6)
+
+    # ignore_index=0: class 0 excluded from the average
+    got = jac(preds, target, num_classes=3, ignore_index=0, absent_score=0.9)
+    np.testing.assert_allclose(float(got), (2 / 3 + 0.9) / 2, atol=1e-6)
+
+    # reduction='none' exposes the per-class vector
+    got = jac(preds, target, num_classes=3, absent_score=0.9, reduction="none")
+    np.testing.assert_allclose(np.asarray(got), [0.5, 2 / 3, 0.9], atol=1e-6)
+
+
+def test_confusion_matrix_multilabel_mode():
+    """Multilabel CM: reference returns per-label 2x2 matrices
+    (ref confusion_matrix.py multilabel=True path)."""
+    from sklearn.metrics import multilabel_confusion_matrix as sk_mcm
+
+    from tests.classification.inputs import _multilabel_prob_inputs
+
+    p = np.concatenate(np.asarray(_multilabel_prob_inputs.preds))
+    t = np.concatenate(np.asarray(_multilabel_prob_inputs.target))
+    import jax.numpy as jnp
+
+    got = np.asarray(
+        confusion_matrix(jnp.asarray(p), jnp.asarray(t), num_classes=NUM_CLASSES, multilabel=True)
+    )
+    expect = sk_mcm(t, (p >= 0.5).astype(int))
+    np.testing.assert_allclose(got, expect, atol=1e-6)
