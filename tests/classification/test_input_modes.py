@@ -251,3 +251,43 @@ def test_macro_average_multiclass_modes():
         sk_r = recall_score(t, labels, average="macro", labels=present, zero_division=0)
         np.testing.assert_allclose(float(ours_p), sk_p, atol=1e-5)
         np.testing.assert_allclose(float(ours_r), sk_r, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "mode,inputs,num_classes",
+    [
+        ("multilabel_prob", _multilabel_prob_inputs, NUM_CLASSES),
+        ("multiclass_prob", _multiclass_prob_inputs, NUM_CLASSES),
+    ],
+    ids=["multilabel_prob", "multiclass_prob"],
+)
+def test_top_k_modes(mode, inputs, num_classes):
+    """top_k=2 rows of the reference matrix (ref test_stat_scores.py:142,146):
+    the top-2 scores per sample become positive predictions."""
+    p = np.concatenate(np.asarray(inputs.preds))
+    t = np.concatenate(np.asarray(inputs.target))
+    full = stat_scores(
+        jnp.asarray(p), jnp.asarray(t), reduce="micro", num_classes=num_classes, top_k=2
+    )
+
+    # oracle: top-2 one-hot via numpy argpartition + the same sklearn path
+    topk = np.zeros_like(p, dtype=int)
+    idx = np.argpartition(-p, 1, axis=-1)[:, :2]
+    np.put_along_axis(topk, idx, 1, axis=-1)
+    if p.ndim == t.ndim:  # multilabel: target already (N, C)
+        t_bin = np.asarray(t)
+    else:  # multiclass labels -> one-hot
+        t_bin = np.eye(num_classes, dtype=int)[t]
+    mcm = multilabel_confusion_matrix(t_bin, topk)
+    tp, fp = mcm[:, 1, 1].sum(), mcm[:, 0, 1].sum()
+    tn, fn = mcm[:, 0, 0].sum(), mcm[:, 1, 0].sum()
+    np.testing.assert_allclose(np.asarray(full), [tp, fp, tn, fn, tp + fn])
+
+    # accuracy with top_k: a sample counts as correct when the true class is
+    # in the top k (multiclass semantics, ref accuracy.py top_k)
+    if mode == "multiclass_prob":
+        from metrics_tpu.functional import accuracy
+
+        acc = accuracy(jnp.asarray(p), jnp.asarray(t), num_classes=num_classes, top_k=2)
+        expect = np.mean([t[i] in idx[i] for i in range(len(t))])
+        np.testing.assert_allclose(float(acc), expect, atol=1e-6)
