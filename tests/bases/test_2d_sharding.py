@@ -1,0 +1,115 @@
+"""2-D sharded metric evaluation: data-parallel × class-parallel.
+
+The reference's only parallelism axis is data-parallel state replication
+(SURVEY §2.16). On a TPU mesh the pure API composes further: metrics whose
+per-class statistics are elementwise in the class dimension (the binned
+curve family, multilabel stat scores) evaluate with the BATCH sharded over
+a `dp` axis and the CLASS axis sharded over a `cp` axis — per-device state
+is a (C/cp, T) slice, and sync is a collective over `dp` ONLY. This is the
+sharding story for huge-C workloads (recommendation, extreme multilabel)
+where a replicated (C, T) state would not fit one device.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import BinnedAveragePrecision, BinnedPrecisionRecallCurve, StatScores
+
+
+def _mesh_2d():
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 devices (root conftest forces 8 host devices)")
+    return Mesh(np.array(devices[:8]).reshape(2, 4), ("dp", "cp"))
+
+
+def _run_2d(metric, state_spec, preds, target, mesh):
+    def worker(st, p, t):
+        st = metric.pure_update(st, p, t)
+        return metric.pure_sync(st, "dp")  # collective over the data axis only
+
+    state = metric.state()
+    specs = jax.tree_util.tree_map(lambda _: state_spec, state)
+    step = jax.jit(
+        shard_map(
+            worker,
+            mesh=mesh,
+            in_specs=(specs, P("dp", "cp"), P("dp", "cp")),
+            out_specs=specs,
+            check_vma=False,
+        )
+    )
+    return step(state, preds, target)
+
+
+def test_binned_ap_class_parallel():
+    mesh = _mesh_2d()
+    C, T = 8, 16
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(64, C).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, (64, C)))
+
+    m = BinnedAveragePrecision(num_classes=C, thresholds=T)
+    synced = _run_2d(m, P("cp"), preds, target, mesh)
+    val = m.pure_compute(synced)
+
+    ref = BinnedAveragePrecision(num_classes=C, thresholds=T)
+    ref.update(preds, target)
+    np.testing.assert_allclose(np.asarray(val), np.asarray(ref.compute()), rtol=1e-6)
+
+
+def test_binned_pr_curve_class_parallel():
+    mesh = _mesh_2d()
+    C, T = 4, 8
+    rng = np.random.RandomState(1)
+    preds = jnp.asarray(rng.rand(32, C).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, (32, C)))
+
+    m = BinnedPrecisionRecallCurve(num_classes=C, thresholds=T)
+    synced = _run_2d(m, P("cp"), preds, target, mesh)
+    precision, recall, thresholds = m.pure_compute(synced)
+
+    ref = BinnedPrecisionRecallCurve(num_classes=C, thresholds=T)
+    ref.update(preds, target)
+    ref_p, ref_r, ref_t = ref.compute()
+    np.testing.assert_allclose(np.asarray(precision), np.asarray(ref_p), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(recall), np.asarray(ref_r), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(thresholds), np.asarray(ref_t), rtol=1e-6)
+
+
+def test_multilabel_stat_scores_class_parallel():
+    """StatScores with reduce='macro' keeps per-class tp/fp/tn/fn vectors —
+    elementwise in C for multilabel inputs, so they shard over cp too.
+
+    Pattern: the metric INSIDE the shard is constructed with the LOCAL
+    class count (each device owns C/cp classes and validates its own
+    slice); the global (C,) state lives outside and shards over `cp`.
+    """
+    mesh = _mesh_2d()
+    C, n_cp = 8, 4
+    rng = np.random.RandomState(2)
+    preds = jnp.asarray(rng.rand(64, C).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, (64, C)))
+
+    m_global = StatScores(reduce="macro", num_classes=C, multiclass=False)
+    m_local = StatScores(reduce="macro", num_classes=C // n_cp, multiclass=False)
+
+    def worker(st, p, t):
+        st = m_local.pure_update(st, p, t)
+        return m_local.pure_sync(st, "dp")
+
+    state = m_global.state()  # global (C,) vectors, sharded to (C/cp,) locals
+    specs = jax.tree_util.tree_map(lambda _: P("cp"), state)
+    step = jax.jit(
+        shard_map(worker, mesh=mesh, in_specs=(specs, P("dp", "cp"), P("dp", "cp")),
+                  out_specs=specs, check_vma=False)
+    )
+    synced = step(state, preds, target)
+    val = m_global.pure_compute(synced)
+
+    ref = StatScores(reduce="macro", num_classes=C, multiclass=False)
+    ref.update(preds, target)
+    np.testing.assert_allclose(np.asarray(val), np.asarray(ref.compute()))
