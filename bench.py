@@ -184,10 +184,15 @@ def _cfg_retrieval(detail: dict) -> None:
     rel = jnp.asarray(rng.randint(0, 2, n_queries * docs))
     rmap = RetrievalMAP()
     rmap.update(scores, rel, indexes)
-    t0 = time.perf_counter()
-    val = rmap.compute()
-    jax.block_until_ready(val)
-    detail["retrieval_map_compute_ms_100k_rows"] = round((time.perf_counter() - t0) * 1e3, 1)
+    rmap.compute()  # warm: one-time jit compile, like every other config
+    best = float("inf")
+    for _ in range(3):
+        rmap._computed = None  # drop the memoized result so compute() reruns
+        t0 = time.perf_counter()
+        val = rmap.compute()
+        jax.block_until_ready(val)
+        best = min(best, time.perf_counter() - t0)
+    detail["retrieval_map_compute_ms_100k_rows"] = round(best * 1e3, 1)
 
 
 def _cfg_coco(detail: dict, python_baseline: bool = False) -> None:
