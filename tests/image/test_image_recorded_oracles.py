@@ -1,0 +1,61 @@
+"""Image metrics vs the reference's RECORDED doctest values.
+
+The reference's docstrings embed outputs of its own torch implementation
+on exactly reproducible inputs (fixed literals or torch generators with
+explicit seeds). Matching them here cross-checks the jnp conv/pooling
+pipelines (gaussian SSIM kernels, MS-SSIM downsampling, UQI, SAM angles)
+against an oracle that shares no code with this package.
+
+Sources: /root/reference/torchmetrics/functional/image/{psnr.py:127-131,
+ssim.py:251-255,467-471, uqi.py:163-169, sam.py:106-112}.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import (
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    spectral_angle_mapper,
+    structural_similarity_index_measure,
+    universal_image_quality_index,
+)
+
+def _rand(shape, seed):
+    torch = pytest.importorskip("torch")  # only the seeded fixtures need torch
+    return jnp.asarray(torch.rand(shape, generator=torch.manual_seed(seed)).numpy())
+
+
+def test_psnr_recorded():
+    pred = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+    target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+    np.testing.assert_allclose(float(peak_signal_noise_ratio(pred, target)), 2.5527, atol=1e-4)
+
+
+def test_ssim_recorded():
+    preds = _rand([16, 1, 16, 16], 42)
+    np.testing.assert_allclose(
+        float(structural_similarity_index_measure(preds, preds * 0.75)), 0.9219, atol=1e-4
+    )
+
+
+def test_ms_ssim_recorded():
+    preds = _rand([1, 1, 256, 256], 42)
+    np.testing.assert_allclose(
+        float(multiscale_structural_similarity_index_measure(preds, preds * 0.75)),
+        0.9558,
+        atol=1e-4,
+    )
+
+
+def test_uqi_recorded():
+    preds = _rand([16, 1, 16, 16], 42)
+    np.testing.assert_allclose(
+        float(universal_image_quality_index(preds, preds * 0.75)), 0.9216, atol=1e-4
+    )
+
+
+def test_sam_recorded():
+    preds = _rand([16, 3, 16, 16], 42)
+    target = _rand([16, 3, 16, 16], 123)
+    np.testing.assert_allclose(float(spectral_angle_mapper(preds, target)), 0.5943, atol=1e-4)
