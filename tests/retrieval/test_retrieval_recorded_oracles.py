@@ -1,0 +1,49 @@
+"""Per-query retrieval functionals vs the reference's RECORDED doctest
+values (/root/reference/torchmetrics/functional/retrieval/*.py) — outputs
+of the reference's own implementation on fixed literal inputs, an oracle
+sharing no code with this package."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+
+PREDS = jnp.asarray([0.2, 0.3, 0.5])
+TARGET = jnp.asarray([True, False, True])
+
+
+@pytest.mark.parametrize(
+    "fn,kwargs,expected",
+    [
+        (retrieval_average_precision, {}, 0.8333),
+        (retrieval_fall_out, {"k": 2}, 1.0),
+        (retrieval_hit_rate, {"k": 2}, 1.0),
+        (retrieval_precision, {"k": 2}, 0.5),
+        (retrieval_r_precision, {}, 0.5),
+        (retrieval_recall, {"k": 2}, 0.5),
+    ],
+    ids=["map", "fall_out", "hit_rate", "precision", "r_precision", "recall"],
+)
+def test_recorded_literals(fn, kwargs, expected):
+    np.testing.assert_allclose(float(fn(PREDS, TARGET, **kwargs)), expected, atol=1e-4)
+
+
+def test_mrr_recorded():
+    preds = jnp.asarray([0.2, 0.3, 0.5])
+    target = jnp.asarray([False, True, False])
+    np.testing.assert_allclose(float(retrieval_reciprocal_rank(preds, target)), 0.5, atol=1e-4)
+
+
+def test_ndcg_recorded():
+    preds = jnp.asarray([0.1, 0.2, 0.3, 4.0, 70.0])
+    target = jnp.asarray([10, 0, 0, 1, 5])
+    np.testing.assert_allclose(float(retrieval_normalized_dcg(preds, target)), 0.6957, atol=1e-4)
