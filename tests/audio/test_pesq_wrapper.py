@@ -1,0 +1,86 @@
+"""PESQ wrapper logic under a stubbed ``pesq`` backend.
+
+The real ``pesq`` C extension is absent from this image (round-1 VERDICT:
+"only the import-gating is tested"). The wrapper's own responsibilities —
+argument validation, per-sample host loop, batch flattening, averaging,
+accumulation — are all testable by injecting a deterministic stub backend,
+which is what this module does. Behavioral parity target:
+/root/reference/torchmetrics/audio/pesq.py:86-122.
+"""
+import sys
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def pesq_stub(monkeypatch):
+    """Install a fake ``pesq`` module whose score is a deterministic
+    function of the inputs, and record every backend call."""
+    calls = []
+
+    def fake_pesq(fs, target, preds, mode):
+        calls.append((fs, mode, np.asarray(target).shape, np.asarray(preds).shape))
+        # deterministic, input-dependent, order-sensitive score
+        return float(2.0 + 0.5 * np.sign(np.sum(preds) - np.sum(target)))
+
+    module = types.ModuleType("pesq")
+    module.pesq = fake_pesq
+    monkeypatch.setitem(sys.modules, "pesq", module)
+    import metrics_tpu.audio.pesq as wrapper_mod
+
+    monkeypatch.setattr(wrapper_mod, "_PESQ_AVAILABLE", True)
+    return calls
+
+
+def _make(fs=16000, mode="wb"):
+    from metrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality
+
+    return PerceptualEvaluationSpeechQuality(fs, mode)
+
+
+def test_argument_validation(pesq_stub):
+    with pytest.raises(ValueError, match="fs.*8000 or 16000"):
+        _make(fs=44100)
+    with pytest.raises(ValueError, match="mode.*'wb' or 'nb'"):
+        _make(mode="ultra")
+
+
+def test_single_sample_call_shape(pesq_stub):
+    m = _make(fs=8000, mode="nb")
+    preds = jnp.asarray(np.ones(8000, np.float32))
+    target = jnp.asarray(np.zeros(8000, np.float32))
+    m.update(preds, target)
+    assert pesq_stub == [(8000, "nb", (8000,), (8000,))]
+    # preds > target -> stub returns 2.5
+    np.testing.assert_allclose(float(m.compute()), 2.5)
+
+
+def test_batch_flattening_and_mean(pesq_stub):
+    """(2, 3, T) flattens to 6 per-sample backend calls; compute averages."""
+    m = _make()
+    rng = np.random.RandomState(0)
+    preds = rng.rand(2, 3, 800).astype(np.float32)
+    target = rng.rand(2, 3, 800).astype(np.float32)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    assert len(pesq_stub) == 6
+    assert all(c[0] == 16000 and c[1] == "wb" and c[2] == (800,) for c in pesq_stub)
+    expect = np.mean(
+        [2.0 + 0.5 * np.sign(p.sum() - t.sum())
+         for p, t in zip(preds.reshape(-1, 800), target.reshape(-1, 800))]
+    )
+    np.testing.assert_allclose(float(m.compute()), expect, rtol=1e-6)
+
+
+def test_accumulates_across_updates(pesq_stub):
+    m = _make()
+    up = jnp.asarray(np.ones(800, np.float32))
+    down = jnp.asarray(-np.ones(800, np.float32))
+    m.update(up, down)   # score 2.5
+    m.update(down, up)   # score 1.5
+    np.testing.assert_allclose(float(m.compute()), 2.0)
+    m.reset()
+    m.update(up, down)
+    np.testing.assert_allclose(float(m.compute()), 2.5)
