@@ -561,29 +561,68 @@ def _run_worker(env: dict, timeout: float):
     return None, _time.perf_counter() - t0
 
 
+def _probe_ambient_backend(timeout: float) -> bool:
+    """Can the ambient (TPU) backend initialize at all?
+
+    A wedged device tunnel hangs ``jax.devices()`` indefinitely (observed
+    for an entire session in round 2), so the orchestrator asks a throwaway
+    subprocess first instead of burning the full worker watchdog — and with
+    it, possibly the driver's own time limit — on a doomed attempt. The
+    healthy path pays one extra backend init (~tens of seconds on real
+    hardware) — accepted: it buys a hard bound on the wedged case, and the
+    generous worker watchdog only applies once the backend proved alive.
+
+    A CRASH during probe init (round-1's transient 'UNAVAILABLE') gets one
+    retry — transient init crashes were recoverable seconds later. A HANG
+    is not retried: a wedged tunnel stays wedged for hours.
+    """
+    import subprocess
+
+    for attempt in (1, 2):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices(); print('BACKEND_OK')"],
+                capture_output=True, text=True, timeout=timeout, env=dict(os.environ),
+            )
+        except subprocess.TimeoutExpired:
+            print(f"# ambient backend probe hung >{timeout:.0f}s (tunnel wedged?)",
+                  file=sys.stderr, flush=True)
+            return False
+        if "BACKEND_OK" in proc.stdout:
+            return True
+        print(f"# ambient backend probe failed rc={proc.returncode} "
+              f"(attempt {attempt}): {proc.stderr[-400:]}", file=sys.stderr, flush=True)
+    return False
+
+
 def main() -> None:
-    """Orchestrator: TPU attempt (with one retry on fast failure) then CPU fallback.
+    """Orchestrator: backend probe, TPU attempt (with one retry on fast
+    failure), then CPU fallback.
 
     The parent process never imports jax — a hung/crashed TPU backend init
-    (the round-1 failure: axon tunnel UNAVAILABLE / hang) is confined to the
-    worker subprocess and bounded by the watchdog, so this script always
+    (the round-1 failure: axon tunnel UNAVAILABLE / hang) is confined to
+    probe/worker subprocesses bounded by watchdogs, so this script always
     exits 0 with one honest JSON line.
     """
     if "--worker" in sys.argv:
         _worker_main()
         return
 
-    # BENCH_ALL runs the full detail suite (several model compiles, a nested
-    # 300s dist sub-bench) — the watchdog must cover it or a healthy mid-run
-    # TPU worker gets killed and silently replaced by CPU numbers. A plain
-    # TPU run also does the budgeted fast-detail pass (~240s + compiles).
-    default_timeout = "1800" if os.environ.get("BENCH_ALL") else "900"
-    tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", default_timeout))
-    result, elapsed = _run_worker(dict(os.environ), tpu_timeout)
-    if result is None and elapsed < 60:
-        # fast failure smells like a transient backend-init crash: retry once
-        print("# retrying TPU bench after fast failure", file=sys.stderr, flush=True)
-        result, _ = _run_worker(dict(os.environ), tpu_timeout)
+    result = None
+    if _probe_ambient_backend(float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))):
+        # BENCH_ALL runs the full detail suite (several model compiles, a
+        # nested 300s dist sub-bench) — the watchdog must cover it or a
+        # healthy mid-run TPU worker gets killed and silently replaced by
+        # CPU numbers. A plain TPU run also does the budgeted fast-detail
+        # pass (~240s + compiles). Generous timeouts are safe here: the
+        # probe already proved the backend answers.
+        default_timeout = "1800" if os.environ.get("BENCH_ALL") else "900"
+        tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", default_timeout))
+        result, elapsed = _run_worker(dict(os.environ), tpu_timeout)
+        if result is None and elapsed < 60:
+            # fast failure smells like a transient backend-init crash: retry once
+            print("# retrying TPU bench after fast failure", file=sys.stderr, flush=True)
+            result, _ = _run_worker(dict(os.environ), tpu_timeout)
 
     if result is None:
         print("# falling back to CPU backend", file=sys.stderr, flush=True)
