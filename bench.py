@@ -579,6 +579,8 @@ def _probe_ambient_backend(timeout: float) -> bool:
     import subprocess
 
     for attempt in (1, 2):
+        if attempt == 2:
+            time.sleep(10)  # give a transient init crash a moment to clear
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices(); print('BACKEND_OK')"],
