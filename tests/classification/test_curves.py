@@ -255,9 +255,12 @@ def test_roc_per_class_vs_sklearn():
     for c in range(4):
         # the reference (and this package) keeps every distinct threshold;
         # sklearn's default drops collinear intermediate points
-        sk_fpr, sk_tpr, _ = sk_roc_curve(t_all[:, c], p_all[:, c], drop_intermediate=False)
+        sk_fpr, sk_tpr, sk_thr = sk_roc_curve(t_all[:, c], p_all[:, c], drop_intermediate=False)
         np.testing.assert_allclose(np.asarray(fprs[c]), sk_fpr, atol=1e-6)
         np.testing.assert_allclose(np.asarray(tprs[c]), sk_tpr, atol=1e-6)
+        # sklearn's leading threshold is an arbitrary sentinel (inf/max+1);
+        # the real decision thresholds must match exactly
+        np.testing.assert_allclose(np.asarray(thrs[c])[1:], sk_thr[1:], atol=1e-6)
 
 
 def test_pr_curve_per_class_vs_sklearn():
@@ -273,8 +276,7 @@ def test_pr_curve_per_class_vs_sklearn():
     t_all = rng.randint(0, 2, (128, 4))
     precs, recs, thrs = precision_recall_curve(jnp.asarray(p_all), jnp.asarray(t_all), num_classes=4)
     for c in range(4):
-        sk_p, sk_r, _ = sk_precision_recall_curve(t_all[:, c], p_all[:, c])
-        ours_p, ours_r = np.asarray(precs[c]), np.asarray(recs[c])
-        assert 0 < len(ours_p) <= len(sk_p)
-        np.testing.assert_allclose(ours_p, sk_p[-len(ours_p):], atol=1e-6)
-        np.testing.assert_allclose(ours_r, sk_r[-len(ours_r):], atol=1e-6)
+        sk_p, sk_r, sk_t = _sk_pr_curve_truncated(t_all[:, c], p_all[:, c])
+        np.testing.assert_allclose(np.asarray(precs[c]), sk_p, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(recs[c]), sk_r, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(thrs[c]), sk_t, atol=1e-6)

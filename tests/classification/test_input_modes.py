@@ -291,3 +291,52 @@ def test_top_k_modes(mode, inputs, num_classes):
         acc = accuracy(jnp.asarray(p), jnp.asarray(t), num_classes=num_classes, top_k=2)
         expect = np.mean([t[i] in idx[i] for i in range(len(t))])
         np.testing.assert_allclose(float(acc), expect, atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore_index", [0, 2])
+@pytest.mark.parametrize(
+    "inputs",
+    [_multiclass_prob_inputs, _multiclass_inputs],
+    ids=["multiclass_prob", "multiclass"],
+)
+def test_ignore_index_micro(inputs, ignore_index):
+    """ignore_index drops that class's column from the canonical binary
+    matrices before micro stats (the reference oracle's np.delete —
+    ref test_stat_scores.py:47-49)."""
+    p = np.concatenate(np.asarray(inputs.preds))
+    t = np.concatenate(np.asarray(inputs.target))
+    full = stat_scores(
+        jnp.asarray(p), jnp.asarray(t), reduce="micro",
+        num_classes=NUM_CLASSES, ignore_index=ignore_index,
+    )
+    cp, ct = _canonical(p, t, 0.5, NUM_CLASSES, None)
+    cp = np.delete(cp, ignore_index, axis=1)
+    ct = np.delete(ct, ignore_index, axis=1)
+    mcm = multilabel_confusion_matrix(ct, cp)
+    tp, fp = mcm[:, 1, 1].sum(), mcm[:, 0, 1].sum()
+    tn, fn = mcm[:, 0, 0].sum(), mcm[:, 1, 0].sum()
+    np.testing.assert_allclose(np.asarray(full), [tp, fp, tn, fn, tp + fn])
+
+    # precision/recall micro route through the same masked stats
+    got_p = precision(jnp.asarray(p), jnp.asarray(t), average="micro",
+                      num_classes=NUM_CLASSES, ignore_index=ignore_index)
+    got_r = recall(jnp.asarray(p), jnp.asarray(t), average="micro",
+                   num_classes=NUM_CLASSES, ignore_index=ignore_index)
+    np.testing.assert_allclose(float(got_p), tp / (tp + fp), atol=1e-6)
+    np.testing.assert_allclose(float(got_r), tp / (tp + fn), atol=1e-6)
+
+
+def test_samples_reduce_vs_sklearn_samplewise():
+    """reduce='samples': per-sample (tp, fp, tn, fn, sup) rows match
+    sklearn's samplewise multilabel confusion matrices."""
+    rng = np.random.RandomState(5)
+    p = rng.rand(32, NUM_CLASSES).astype(np.float32)
+    t = rng.randint(0, 2, (32, NUM_CLASSES))
+    out = stat_scores(
+        jnp.asarray(p), jnp.asarray(t), reduce="samples", num_classes=NUM_CLASSES, multiclass=False
+    )
+    mcm = multilabel_confusion_matrix(t, (p >= 0.5).astype(int), samplewise=True)
+    expect = np.stack(
+        [mcm[:, 1, 1], mcm[:, 0, 1], mcm[:, 0, 0], mcm[:, 1, 0], mcm[:, 1, 1] + mcm[:, 1, 0]], 1
+    )
+    np.testing.assert_allclose(np.asarray(out), expect)
