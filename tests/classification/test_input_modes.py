@@ -340,3 +340,18 @@ def test_samples_reduce_vs_sklearn_samplewise():
         [mcm[:, 1, 1], mcm[:, 0, 1], mcm[:, 0, 0], mcm[:, 1, 0], mcm[:, 1, 1] + mcm[:, 1, 0]], 1
     )
     np.testing.assert_allclose(np.asarray(out), expect)
+
+
+@pytest.mark.parametrize("metric_name", ["precision", "recall", "f1"])
+def test_weighted_average_multiclass(metric_name):
+    """average='weighted' (support-weighted per-class mean) vs sklearn."""
+    from sklearn.metrics import f1_score as skf, precision_score as skp, recall_score as skr
+
+    sk_fn = {"precision": skp, "recall": skr, "f1": skf}[metric_name]
+    fn = FUNCTIONALS[metric_name]
+    p = np.concatenate(np.asarray(_multiclass_prob_inputs.preds))
+    t = np.concatenate(np.asarray(_multiclass_prob_inputs.target))
+    labels = np.argmax(p, axis=-1)
+    ours = fn(jnp.asarray(p), jnp.asarray(t), average="weighted", num_classes=NUM_CLASSES)
+    sk = sk_fn(t, labels, average="weighted", zero_division=0)
+    np.testing.assert_allclose(float(ours), sk, atol=1e-5)

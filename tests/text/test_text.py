@@ -89,6 +89,17 @@ class TestBLEU:
         assert 0 < float(val) < 1
         assert float(val) >= float(no_smooth)
 
+    def test_ngram_orders_vs_nltk(self):
+        """n_gram in {1, 2, 3} vs nltk with matching uniform weights."""
+        from nltk.translate.bleu_score import corpus_bleu
+
+        refs = [[t.split() for t in tgt] for tgt in BLEU_TARGETS]
+        hyps = [p.split() for p in BLEU_PREDS]
+        for n in (1, 2, 3):
+            expected = corpus_bleu(refs, hyps, weights=tuple([1.0 / n] * n))
+            ours = float(bleu_score(BLEU_PREDS, BLEU_TARGETS, n_gram=n))
+            np.testing.assert_allclose(ours, expected, atol=1e-5, err_msg=f"n_gram={n}")
+
 
 class TestSacreBLEU:
     @pytest.mark.parametrize("tokenize", ["13a", "char", "intl", "none"])
@@ -322,3 +333,4 @@ class TestSentenceLevelScores:
         for pred, tgts, ours in zip(BLEU_PREDS, BLEU_TARGETS, sentences):
             expected = sb.sentence_score(pred, list(tgts)).score / 100
             np.testing.assert_allclose(float(ours), expected, atol=2e-2)
+
