@@ -113,3 +113,43 @@ def test_multilabel_stat_scores_class_parallel():
     ref = StatScores(reduce="macro", num_classes=C, multiclass=False)
     ref.update(preds, target)
     np.testing.assert_allclose(np.asarray(val), np.asarray(ref.compute()))
+
+
+def test_multi_step_loop_delta_merge():
+    """Multi-step accumulation on the 2-D mesh: syncing the CARRIED state
+    each step would re-add prior totals once per dp shard; the correct loop
+    syncs each batch's delta and pure_merges it (integrations/
+    class_parallel_eval.py). Pinned exactly against the single-device path."""
+    mesh = _mesh_2d()
+    C, T, steps = 8, 16, 4
+    rng = np.random.RandomState(3)
+    batches = [
+        (
+            jnp.asarray(rng.rand(32, C).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 2, (32, C))),
+        )
+        for _ in range(steps)
+    ]
+
+    m = BinnedAveragePrecision(num_classes=C, thresholds=T)
+
+    def worker(state, p, t):
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, state)
+        batch_state = m.pure_update(zeros, p, t)
+        return m.pure_merge(state, m.pure_sync(batch_state, "dp"))
+
+    specs = jax.tree_util.tree_map(lambda _: P("cp"), m.state())
+    step = jax.jit(
+        shard_map(worker, mesh=mesh, in_specs=(specs, P("dp", "cp"), P("dp", "cp")),
+                  out_specs=specs, check_vma=False)
+    )
+    state = m.state()
+    for p, t in batches:
+        state = step(state, p, t)
+
+    ref = BinnedAveragePrecision(num_classes=C, thresholds=T)
+    for p, t in batches:
+        ref.update(p, t)
+    np.testing.assert_allclose(
+        np.asarray(m.pure_compute(state)), np.asarray(jnp.asarray(ref.compute())), rtol=1e-6
+    )

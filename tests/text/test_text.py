@@ -333,4 +333,3 @@ class TestSentenceLevelScores:
         for pred, tgts, ours in zip(BLEU_PREDS, BLEU_TARGETS, sentences):
             expected = sb.sentence_score(pred, list(tgts)).score / 100
             np.testing.assert_allclose(float(ours), expected, atol=2e-2)
-
