@@ -229,8 +229,21 @@ class Metric(ABC):
         self._reductions[name] = dist_reduce_fx
 
     def state(self) -> Dict[str, StateType]:
-        """Current state as a dict pytree (lists copied shallowly)."""
-        return {k: list(getattr(self, k)) if isinstance(getattr(self, k), list) else getattr(self, k) for k in self._defaults}
+        """Current state as a dict pytree.
+
+        Array leaves are COPIES of the internal buffers (and lists are
+        shallow-copied), so the returned pytree is safe to hand to
+        ``jax.jit(..., donate_argnums=0)`` accumulation loops: donation
+        consumes the copy, never the metric's own state, which would
+        otherwise raise "Array has been deleted" on a real accelerator at
+        the next ``reset``/``update`` (CPU donation is a no-op, so only
+        device runs hit this).
+        """
+        out: Dict[str, StateType] = {}
+        for k in self._defaults:
+            v = getattr(self, k)
+            out[k] = list(v) if isinstance(v, list) else jnp.array(v)
+        return out
 
     def _load_state(self, state: Dict[str, StateType]) -> None:
         for k, v in state.items():

@@ -38,6 +38,8 @@ _reg_p = _rng.rand(_B).astype(np.float32)
 _reg_t = _rng.rand(_B).astype(np.float32)
 _audio_p = _rng.randn(4, 200).astype(np.float32)
 _audio_t = _rng.randn(4, 200).astype(np.float32)
+_stoi_t = _rng.randn(2, 12000).astype(np.float32)
+_stoi_p = (_stoi_t + 0.8 * _rng.randn(2, 12000)).astype(np.float32)
 _pit_p = _rng.randn(3, 2, 100).astype(np.float32)
 _pit_t = _rng.randn(3, 2, 100).astype(np.float32)
 
@@ -83,6 +85,9 @@ MATRIX = [
     # result by ~0.5%, so it gets a looser tolerance below.
     ("SignalDistortionRatio", lambda: M.SignalDistortionRatio(), (_audio_p, _audio_t)),
     ("ScaleInvariantSignalDistortionRatio", lambda: M.ScaleInvariantSignalDistortionRatio(), (_audio_p, _audio_t)),
+    # native as of r2: the whole STOI pipeline (resample, silent-frame
+    # compaction, STFT, band analysis, segment correlations) under one jit
+    ("ShortTimeObjectiveIntelligibility", lambda: M.ShortTimeObjectiveIntelligibility(10000), (_stoi_p, _stoi_t)),
     ("PermutationInvariantTraining",
      lambda: M.PermutationInvariantTraining(F.scale_invariant_signal_noise_ratio),
      (_pit_p, _pit_t)),

@@ -132,16 +132,27 @@ def test_checkpoint_roundtrips_through_numpy():
     np.testing.assert_allclose(float(m2.compute()), 2.0, rtol=1e-6)
 
 
+def _load_example(name):
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "..", "integrations", name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def test_example_script_protocol_runs():
     """The shipped integrations example exercises the same protocol end to
     end (host-driven + fully-jitted distributed variants) — it must at
     least import and expose both loop entry points."""
-    import importlib.util
-    import os
-
-    path = os.path.join(os.path.dirname(__file__), "..", "..", "integrations", "flax_training_loop.py")
-    spec = importlib.util.spec_from_file_location("flax_training_loop", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    mod = _load_example("flax_training_loop")
     assert callable(mod.host_driven_loop)
     mod.host_driven_loop()
+
+
+def test_class_parallel_example_runs():
+    """The 2-D mesh example must stay runnable and numerically pinned
+    (its delta+merge loop is also unit-pinned in tests/bases/test_2d_sharding.py)."""
+    _load_example("class_parallel_eval").main()
