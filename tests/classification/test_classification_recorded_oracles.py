@@ -1,0 +1,35 @@
+"""Classification functionals vs the reference's RECORDED doctest values
+on fixed literal inputs (outputs of the reference's own torch
+implementation — an oracle sharing no code with this package). Sources:
+/root/reference/torchmetrics/functional/classification/{kl_divergence.py:
+106-110, hinge.py:211-228, matthews_corrcoef.py:78-82}."""
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional import hinge_loss, kl_divergence, matthews_corrcoef
+
+
+def test_kl_divergence_recorded():
+    p = jnp.asarray([[0.36, 0.48, 0.16]])
+    q = jnp.asarray([[1 / 3, 1 / 3, 1 / 3]])
+    np.testing.assert_allclose(float(kl_divergence(p, q)), 0.0853, atol=1e-4)
+
+
+def test_hinge_binary_recorded():
+    target = jnp.asarray([0, 1, 1])
+    preds = jnp.asarray([-2.2, 2.4, 0.1])
+    np.testing.assert_allclose(float(hinge_loss(preds, target)), 0.3000, atol=1e-4)
+
+
+def test_hinge_multiclass_crammer_singer_recorded():
+    target = jnp.asarray([0, 1, 2])
+    preds = jnp.asarray([[-1.0, 0.9, 0.2], [0.5, -1.1, 0.8], [2.2, -0.5, 0.3]])
+    np.testing.assert_allclose(float(hinge_loss(preds, target)), 2.9000, atol=1e-4)
+
+
+def test_matthews_recorded():
+    target = jnp.asarray([1, 1, 0, 0])
+    preds = jnp.asarray([0, 1, 0, 0])
+    np.testing.assert_allclose(
+        float(matthews_corrcoef(preds, target, num_classes=2)), 0.5774, atol=1e-4
+    )

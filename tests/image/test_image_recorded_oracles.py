@@ -14,9 +14,11 @@ import numpy as np
 import pytest
 
 from metrics_tpu.functional import (
+    error_relative_global_dimensionless_synthesis,
     multiscale_structural_similarity_index_measure,
     peak_signal_noise_ratio,
     spectral_angle_mapper,
+    spectral_distortion_index,
     structural_similarity_index_measure,
     universal_image_quality_index,
 )
@@ -59,3 +61,23 @@ def test_sam_recorded():
     preds = _rand([16, 3, 16, 16], 42)
     target = _rand([16, 3, 16, 16], 123)
     np.testing.assert_allclose(float(spectral_angle_mapper(preds, target)), 0.5943, atol=1e-4)
+
+
+def test_ergas_recorded():
+    """ref functional/image/ergas.py:113-118: rounded ERGAS == 154."""
+    preds = _rand([16, 1, 16, 16], 42)
+    val = float(error_relative_global_dimensionless_synthesis(preds, preds * 0.75))
+    np.testing.assert_allclose(round(val), 154)
+
+
+def test_d_lambda_recorded():
+    """ref functional/image/d_lambda.py:66-71: tensor(0.0234) on the shared
+    seed-42 stream (preds then target drawn consecutively)."""
+    torch = pytest.importorskip("torch")
+
+    torch.manual_seed(42)
+    preds = jnp.asarray(torch.rand([16, 3, 16, 16]).numpy())
+    target = jnp.asarray(torch.rand([16, 3, 16, 16]).numpy())
+    np.testing.assert_allclose(
+        float(spectral_distortion_index(preds, target)), 0.0234, atol=1e-4
+    )
