@@ -67,3 +67,15 @@ def test_pit_sdr_recorded_seeded():
         np.asarray(best_metric), [-11.6375, -11.4358, -11.7148, -11.6325], atol=1e-3
     )
     np.testing.assert_array_equal(np.asarray(best_perm), [[1, 0], [0, 1], [1, 0], [0, 1]])
+
+
+def test_snr_zero_mean():
+    """zero_mean=True mean-centers both signals before the ratio
+    (ref functional/audio/snr.py zero_mean arg), vs a manual oracle."""
+    rng = np.random.RandomState(0)
+    p = rng.randn(200).astype(np.float32) + 3.0
+    t = rng.randn(200).astype(np.float32) + 3.0
+    got = float(signal_noise_ratio(jnp.asarray(p), jnp.asarray(t), zero_mean=True))
+    tz, pz = t - t.mean(), p - p.mean()
+    manual = 10 * np.log10((tz**2).sum() / ((tz - pz) ** 2).sum())
+    np.testing.assert_allclose(got, manual, rtol=1e-5)

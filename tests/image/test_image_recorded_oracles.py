@@ -81,3 +81,23 @@ def test_d_lambda_recorded():
     np.testing.assert_allclose(
         float(spectral_distortion_index(preds, target)), 0.0234, atol=1e-4
     )
+
+
+def test_psnr_dim_and_reductions():
+    """dim=(1,2,3) computes per-image PSNR; reduction 'none' exposes the
+    vector and 'elementwise_mean' averages it (ref functional/image/psnr.py
+    dim/reduction args), vs a manual per-image oracle."""
+    rng = np.random.RandomState(0)
+    img_p = rng.rand(4, 3, 8, 8).astype(np.float32)
+    img_t = rng.rand(4, 3, 8, 8).astype(np.float32)
+    per = np.asarray(
+        [10 * np.log10(1.0 / np.mean((img_p[i] - img_t[i]) ** 2)) for i in range(4)]
+    )
+    vec = peak_signal_noise_ratio(
+        jnp.asarray(img_p), jnp.asarray(img_t), data_range=1.0, dim=(1, 2, 3), reduction="none"
+    )
+    np.testing.assert_allclose(np.asarray(vec), per, atol=1e-4)
+    mean = peak_signal_noise_ratio(
+        jnp.asarray(img_p), jnp.asarray(img_t), data_range=1.0, dim=(1, 2, 3)
+    )
+    np.testing.assert_allclose(float(mean), per.mean(), atol=1e-4)
