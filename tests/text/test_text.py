@@ -333,3 +333,48 @@ class TestSentenceLevelScores:
         for pred, tgts, ours in zip(BLEU_PREDS, BLEU_TARGETS, sentences):
             expected = sb.sentence_score(pred, list(tgts)).score / 100
             np.testing.assert_allclose(float(ours), expected, atol=2e-2)
+
+
+def test_rouge_accumulate_modes():
+    """accumulate='best' takes the best-scoring reference per sample;
+    'avg' averages across references (ref functional/text/rouge.py)."""
+    preds = ["the cat sat on the mat"]
+    multi_refs = [["a cat sat on the mat", "completely unrelated sentence here"]]
+    best = rouge_score(preds, multi_refs, accumulate="best")
+    avg = rouge_score(preds, multi_refs, accumulate="avg")
+    # the best reference dominates the unrelated one; averaging drags it down
+    assert float(best["rouge1_fmeasure"]) > float(avg["rouge1_fmeasure"])
+    # single-reference inputs: both modes agree
+    one = [["a cat sat on the mat"]]
+    b1 = rouge_score(preds, one, accumulate="best")
+    a1 = rouge_score(preds, one, accumulate="avg")
+    np.testing.assert_allclose(float(b1["rouge1_fmeasure"]), float(a1["rouge1_fmeasure"]))
+
+
+def test_chrf_lowercase_and_whitespace_vs_sacrebleu():
+    """lowercase/whitespace axes vs sacrebleu on normal-length sentences
+    (on very short sentences the reference implementation itself diverges
+    from modern sacrebleu — pinned separately below)."""
+    from sacrebleu.metrics import CHRF
+
+    refs_t = list(map(list, zip(*BLEU_TARGETS)))
+    for lowercase in (False, True):
+        for whitespace in (False, True):
+            sb = CHRF(word_order=2, lowercase=lowercase, whitespace=whitespace)  # chrF++ like our default
+            expected = sb.corpus_score(BLEU_PREDS, refs_t).score / 100
+            ours = float(chrf_score(BLEU_PREDS, BLEU_TARGETS, lowercase=lowercase, whitespace=whitespace))
+            np.testing.assert_allclose(
+                ours, expected, atol=1e-3, err_msg=f"lowercase={lowercase} whitespace={whitespace}"
+            )
+
+
+def test_chrf_short_sentence_reference_parity():
+    """On very short case-differing sentences the reference deviates from
+    modern sacrebleu; this package matches the REFERENCE exactly (values
+    recorded by running the reference implementation on these inputs)."""
+    np.testing.assert_allclose(
+        float(chrf_score(["The QUICK brown fox"], [["the quick brown Fox"]])), 0.20800, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(chrf_score(["Hello World"], [["hello world"]])), 0.28155, atol=1e-4
+    )
