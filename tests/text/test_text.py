@@ -207,6 +207,22 @@ class TestSQuAD:
         out = m.compute()
         assert float(out["exact_match"]) == 50.0
 
+    def test_answer_normalization(self):
+        """The SQuAD normalizer lowercases, strips punctuation and the
+        articles a/an/the, and collapses whitespace before matching
+        (ref functional/text/squad.py normalize_text)."""
+        cases = [
+            ("The Cat!", ["cat"]),           # article + punctuation + case
+            ("an  apple   pie", ["Apple Pie"]),  # article + whitespace collapse
+            ("42", ["forty two", "42"]),     # best over multiple gold answers
+        ]
+        for i, (pred, answers) in enumerate(cases):
+            out = squad(
+                [{"prediction_text": pred, "id": str(i)}],
+                [{"answers": {"text": answers}, "id": str(i)}],
+            )
+            assert float(out["exact_match"]) == 100.0, (pred, answers)
+
 
 class TestROUGE:
     @pytest.mark.parametrize("use_stemmer", [False, True])
