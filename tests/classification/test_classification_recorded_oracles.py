@@ -58,3 +58,19 @@ def test_ranking_metrics_recorded():
         preds = jnp.asarray(torch.rand(10, 5).numpy())
         target = jnp.asarray(torch.randint(2, (10, 5)).numpy())
         np.testing.assert_allclose(float(fn(preds, target)), golden, atol=1e-4)
+
+
+def test_invalid_argument_errors():
+    """Argument-validation parity: bad parameter values raise ValueError
+    with the reference's guidance (ref tweedie_deviance.py / calibration_
+    error.py / hinge.py validation branches)."""
+    import pytest
+
+    from metrics_tpu.functional import calibration_error, tweedie_deviance_score
+
+    with pytest.raises(ValueError, match="not defined for power=0.5"):
+        tweedie_deviance_score(jnp.asarray([1.0]), jnp.asarray([1.0]), power=0.5)
+    with pytest.raises(ValueError, match="Norm l3 is not supported"):
+        calibration_error(jnp.asarray([0.5]), jnp.asarray([1]), norm="l3")
+    with pytest.raises(ValueError, match="multiclass_mode"):
+        hinge_loss(jnp.asarray([[0.5, 0.5]]), jnp.asarray([0]), multiclass_mode="bad")
