@@ -74,3 +74,19 @@ def test_invalid_argument_errors():
         calibration_error(jnp.asarray([0.5]), jnp.asarray([1]), norm="l3")
     with pytest.raises(ValueError, match="multiclass_mode"):
         hinge_loss(jnp.asarray([[0.5, 0.5]]), jnp.asarray([0]), multiclass_mode="bad")
+
+
+def test_dice_score_recorded():
+    """ref functional/classification/dice.py:88-95: tensor(0.3333)."""
+    from metrics_tpu.functional import dice_score
+
+    pred = jnp.asarray(
+        [
+            [0.85, 0.05, 0.05, 0.05],
+            [0.05, 0.85, 0.05, 0.05],
+            [0.05, 0.05, 0.85, 0.05],
+            [0.05, 0.05, 0.05, 0.85],
+        ]
+    )
+    target = jnp.asarray([0, 1, 3, 2])
+    np.testing.assert_allclose(float(dice_score(pred, target)), 0.3333, atol=1e-4)
