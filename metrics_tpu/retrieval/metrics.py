@@ -265,7 +265,10 @@ class RetrievalNormalizedDCG(_TopKRetrievalMetric):
         denom = jnp.log2(jnp.arange(max_len, dtype=jnp.float32) + 2.0)
         in_k = jnp.arange(max_len) < k
         dcg = (sorted_target / denom * in_k).sum(axis=1)
-        ideal = jnp.sort(target_f, axis=1)[:, ::-1]
+        # pads must sort BELOW any real grade (grades may be negative), so
+        # send invalid slots to -inf for the ideal ordering and zero them out
+        ideal = jnp.sort(jnp.where(valid, target_f, -jnp.inf), axis=1)[:, ::-1]
+        ideal = jnp.where(jnp.isfinite(ideal), ideal, 0.0)
         idcg = (ideal / denom * in_k).sum(axis=1)
         return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 0.0)
 

@@ -345,10 +345,14 @@ def _check_retrieval_functional_inputs(
     target: Array,
     allow_non_binary_target: bool = False,
 ) -> Tuple[Array, Array]:
-    """Validate retrieval functional inputs (ref checks.py:501-531)."""
+    """Validate retrieval functional inputs (ref checks.py:501-531).
+
+    Multi-dim inputs are accepted and flattened, matching the reference
+    (only empty or 0-d tensors are rejected).
+    """
     if preds.shape != target.shape:
         raise ValueError("`preds` and `target` must be of the same shape")
-    if preds.size == 0 or preds.ndim != 1:
+    if preds.size == 0 or preds.ndim == 0:
         raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
     return _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
 
@@ -372,8 +376,8 @@ def _check_retrieval_inputs(
             indexes = indexes[valid_np]
             preds = preds[valid_np]
             target = target[valid_np]
-    if indexes.size == 0:
-        raise ValueError("`indexes`, `preds` and `target` must be non-empty")
+    if indexes.size == 0 or indexes.ndim == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
     preds, target = _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
     return indexes.reshape(-1).astype(jnp.int32), preds, target
 
@@ -383,12 +387,24 @@ def _check_retrieval_target_and_prediction_types(
     target: Array,
     allow_non_binary_target: bool,
 ) -> Tuple[Array, Array]:
-    """Parity: ref checks.py:582-607."""
-    if _is_floating(target) and not allow_non_binary_target:
-        raise ValueError("`target` must be a tensor of booleans or integers")
+    """Parity: ref checks.py:582-607.
+
+    Float targets are accepted (kept floating); binary-relevance metrics
+    additionally require values within {0, 1} bounds — both checked the way
+    the reference does (max > 1 or min < 0 rejected). Non-numeric target
+    dtypes (e.g. complex) are rejected up front.
+    """
+    if not (
+        target.dtype == jnp.bool_
+        or jnp.issubdtype(target.dtype, jnp.integer)
+        or _is_floating(target)
+    ):
+        raise ValueError("`target` must be a tensor of booleans, integers or floats")
     if not _is_floating(preds):
         raise ValueError("`preds` must be a tensor of floats")
-    if not allow_non_binary_target and not _is_traced(target) and target.size and target.max() > 1:
-        raise ValueError("`target` must contain binary values")
+    if not allow_non_binary_target and not _is_traced(target) and target.size and (
+        target.max() > 1 or target.min() < 0
+    ):
+        raise ValueError("`target` must contain `binary` values")
     dtype = jnp.float64 if jax.config.jax_enable_x64 and preds.dtype == jnp.float64 else jnp.float32
     return preds.reshape(-1).astype(dtype), target.reshape(-1)

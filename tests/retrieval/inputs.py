@@ -1,0 +1,72 @@
+"""Retrieval input fixtures (translation of ref tests/retrieval/inputs.py).
+
+Same shapes and value distributions as the reference's fixture module:
+batched ``(NUM_BATCHES, BATCH_SIZE)`` bundles of (indexes, preds, target),
+including the extra-dim, adaptive-k, graded-target, ignore-index, and
+error-case variants.
+"""
+from collections import namedtuple
+
+import numpy as np
+
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES
+
+seed_all(42)
+_rng = np.random.RandomState(42)
+
+Input = namedtuple("InputMultiple", ["indexes", "preds", "target"])
+
+# correct
+_input_retrieval_scores = Input(
+    indexes=_rng.randint(0, 10, size=(NUM_BATCHES, BATCH_SIZE)),
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=_rng.randint(0, 2, size=(NUM_BATCHES, BATCH_SIZE)),
+)
+
+_input_retrieval_scores_for_adaptive_k = Input(
+    indexes=_rng.randint(0, NUM_BATCHES * BATCH_SIZE // 2, size=(NUM_BATCHES, BATCH_SIZE)),
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=_rng.randint(0, 2, size=(NUM_BATCHES, BATCH_SIZE)),
+)
+
+_input_retrieval_scores_extra = Input(
+    indexes=_rng.randint(0, 10, size=(NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE, EXTRA_DIM).astype(np.float32),
+    target=_rng.randint(0, 2, size=(NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)
+
+_input_retrieval_scores_int_target = Input(
+    indexes=_rng.randint(0, 10, size=(NUM_BATCHES, 2 * BATCH_SIZE)),
+    preds=_rng.rand(NUM_BATCHES, 2 * BATCH_SIZE).astype(np.float32),
+    target=_rng.randint(-1, 4, size=(NUM_BATCHES, 2 * BATCH_SIZE)),
+)
+
+_input_retrieval_scores_float_target = Input(
+    indexes=_rng.randint(0, 10, size=(NUM_BATCHES, 2 * BATCH_SIZE)),
+    preds=_rng.rand(NUM_BATCHES, 2 * BATCH_SIZE).astype(np.float32),
+    target=_rng.rand(NUM_BATCHES, 2 * BATCH_SIZE).astype(np.float32),
+)
+
+_input_retrieval_scores_with_ignore_index = Input(
+    indexes=_rng.randint(0, 10, size=(NUM_BATCHES, BATCH_SIZE)),
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=np.where(
+        _rng.randn(NUM_BATCHES, BATCH_SIZE) > 0.5,
+        -100,
+        _rng.randint(0, 2, size=(NUM_BATCHES, BATCH_SIZE)),
+    ),
+)
+
+# with errors
+_input_retrieval_scores_no_target = Input(
+    indexes=_rng.randint(0, 10, size=(NUM_BATCHES, BATCH_SIZE)),
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=np.zeros((NUM_BATCHES, BATCH_SIZE), dtype=np.int64),
+)
+
+_input_retrieval_scores_all_target = Input(
+    indexes=_rng.randint(0, 10, size=(NUM_BATCHES, BATCH_SIZE)),
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=np.ones((NUM_BATCHES, BATCH_SIZE), dtype=np.int64),
+)

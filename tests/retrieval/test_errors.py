@@ -86,8 +86,20 @@ class TestClassErrors:
 
     def test_empty_inputs(self, metric_class):
         metric = metric_class()
-        with pytest.raises(ValueError, match="non-empty"):
+        with pytest.raises(ValueError, match="non-empty and non-scalar"):
             metric.update(jnp.asarray([]), jnp.asarray([], dtype=jnp.int32), jnp.asarray([], dtype=jnp.int32))
+
+    def test_negative_target(self, metric_class):
+        if metric_class is RetrievalNormalizedDCG:
+            pytest.skip("NDCG allows graded (non-binary) targets")
+        metric = metric_class()
+        with pytest.raises(ValueError, match="binary"):
+            metric.update(_preds, jnp.asarray([0, -2, 1]), _indexes)
+
+    def test_float_binary_target_accepted(self, metric_class):
+        metric = metric_class()
+        metric.update(_preds, jnp.asarray([0.0, 1.0, 0.0]), _indexes)
+        assert jnp.isfinite(metric.compute())
 
 
 @pytest.mark.parametrize(
@@ -102,7 +114,7 @@ def test_wrong_k(metric_class):
 def test_non_binary_target_rejected_for_binary_metrics():
     """Binary-relevance metrics must reject graded targets (NDCG accepts them)."""
     m = RetrievalMAP()
-    with pytest.raises(ValueError, match="binary values"):
+    with pytest.raises(ValueError, match="`binary` values"):
         m.update(_preds, jnp.asarray([0, 2, 4]), _indexes)
     # NDCG allows non-binary relevance grades
     ndcg = RetrievalNormalizedDCG()
@@ -116,13 +128,29 @@ class TestFunctionalErrors:
         with pytest.raises(ValueError, match="floats"):
             fn(jnp.asarray([1, 2, 3]), _target)
 
-    def test_float_target(self, fn):
-        with pytest.raises(ValueError, match="booleans or integers"):
-            fn(_preds, jnp.asarray([0.0, 1.0, 0.0]))
+    def test_float_binary_target_accepted(self, fn):
+        # ref checks.py:582-607: float targets pass the dtype check and the
+        # {0,1}-bounds check, so binary metrics accept them
+        fn(_preds, jnp.asarray([0.0, 1.0, 0.0]))
 
     def test_non_binary_target(self, fn):
-        with pytest.raises(ValueError, match="binary values"):
+        with pytest.raises(ValueError, match="binary"):
             fn(_preds, jnp.asarray([0, 2, 4]))
+
+    def test_negative_target(self, fn):
+        with pytest.raises(ValueError, match="binary"):
+            fn(_preds, jnp.asarray([0, -1, 1]))
+
+    def test_scalar_inputs(self, fn):
+        with pytest.raises(ValueError, match="non-scalar"):
+            fn(jnp.asarray(0.5), jnp.asarray(1))
+
+    def test_multidim_inputs_flattened(self, fn):
+        # ref flattens multi-dim functional inputs rather than rejecting them
+        p = jnp.asarray([[0.2, 0.7], [0.4, 0.9]])
+        t = jnp.asarray([[0, 1], [1, 0]])
+        flat = fn(p.reshape(-1), t.reshape(-1))
+        assert float(fn(p, t)) == pytest.approx(float(flat))
 
 
 @pytest.mark.parametrize(

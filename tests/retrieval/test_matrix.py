@@ -1,0 +1,285 @@
+"""Retrieval metric matrix: fixtures x metrics x arguments vs a per-query oracle.
+
+Port of the reference's per-metric retrieval test files (tests/retrieval/
+test_{map,mrr,precision,recall,hit_rate,fallout,ndcg,r_precision}.py, all
+driven by helpers.py:71-123 `_compute_sklearn_metric`): every module metric
+runs over the shared fixture bundles with `empty_target_action`,
+`ignore_index`, `k`/`adaptive_k` sweeps, and a two-rank merge variant
+mirroring DDP list-state gather semantics.
+
+The oracle is an independent numpy per-query loop. Queries with no positive
+target follow the action semantics keyed on the presence of *positives*
+(`(target > 0).sum() == 0`; for FallOut, of negatives) — for binary targets
+this is identical to the reference's `target.sum() == 0` rule.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from tests.helpers.testers import NUM_BATCHES
+from tests.retrieval.inputs import (
+    _input_retrieval_scores as _irs,
+    _input_retrieval_scores_all_target as _irs_all_tgt,
+    _input_retrieval_scores_extra as _irs_extra,
+    _input_retrieval_scores_float_target as _irs_float_tgt,
+    _input_retrieval_scores_for_adaptive_k as _irs_adpt_k,
+    _input_retrieval_scores_int_target as _irs_int_tgt,
+    _input_retrieval_scores_no_target as _irs_no_tgt,
+    _input_retrieval_scores_with_ignore_index as _irs_ii,
+)
+
+# ----------------------------------------------------------- numpy oracles
+
+
+def _np_ap(t, p):
+    order = np.argsort(-p, kind="stable")
+    rel = t[order] > 0
+    prec = np.cumsum(rel) / np.arange(1, len(t) + 1)
+    return (prec * rel).sum() / rel.sum()
+
+
+def _np_mrr(t, p):
+    rel = t[np.argsort(-p, kind="stable")] > 0
+    pos = np.nonzero(rel)[0]
+    return 1.0 / (pos[0] + 1) if len(pos) else 0.0
+
+
+def _np_precision(t, p, k=None, adaptive_k=False):
+    if k is None or (adaptive_k and k > len(p)):
+        k = len(p)
+    rel = t[np.argsort(-p, kind="stable")][:k] > 0
+    return rel.sum() / k
+
+
+def _np_recall(t, p, k=None):
+    if k is None:
+        k = len(p)
+    rel = t[np.argsort(-p, kind="stable")][:k] > 0
+    return rel.sum() / (t > 0).sum()
+
+
+def _np_hit_rate(t, p, k=None):
+    if k is None:
+        k = len(p)
+    return float((t[np.argsort(-p, kind="stable")][:k] > 0).any())
+
+
+def _np_fall_out(t, p, k=None):
+    if k is None:
+        k = len(p)
+    neg = 1 - (t > 0)
+    retrieved_neg = neg[np.argsort(-p, kind="stable")][:k].sum()
+    return retrieved_neg / neg.sum()
+
+
+def _np_dcg(rels):
+    return (rels / np.log2(np.arange(2, len(rels) + 2))).sum()
+
+
+def _np_ndcg(t, p, k=None):
+    if k is None:
+        k = len(p)
+    t = t.astype(np.float64)
+    dcg = _np_dcg(t[np.argsort(-p, kind="stable")][:k])
+    idcg = _np_dcg(np.sort(t)[::-1][:k])
+    return dcg / idcg if idcg > 0 else 0.0
+
+
+def _np_r_precision(t, p):
+    r = int((t > 0).sum())
+    return (t[np.argsort(-p, kind="stable")][:r] > 0).sum() / r
+
+
+def _compute_reference_metric(
+    preds, target, indexes, metric, empty_target_action="neg", ignore_index=None, reverse=False, **kwargs
+):
+    """Per-query mean with empty-target handling (port of ref helpers.py:71-123)."""
+    indexes = np.asarray(indexes).flatten()
+    preds = np.asarray(preds).flatten()
+    target = np.asarray(target).flatten()
+    if ignore_index is not None:
+        keep = target != ignore_index
+        indexes, preds, target = indexes[keep], preds[keep], target[keep]
+
+    scores = []
+    for q in np.unique(indexes):
+        m = indexes == q
+        t, p = target[m], preds[m]
+        relevant = ((1 - (t > 0)) if reverse else (t > 0)).sum()
+        if relevant == 0:
+            if empty_target_action == "skip":
+                continue
+            scores.append(1.0 if empty_target_action == "pos" else 0.0)
+        else:
+            scores.append(metric(t, p, **kwargs))
+    return np.mean(scores) if scores else np.array(0.0)
+
+
+# ------------------------------------------------------------- matrix data
+
+_BINARY_FIXTURES = {
+    "default": _irs,
+    "extra_dim": _irs_extra,
+    "no_target": _irs_no_tgt,
+}
+
+_GRADED_FIXTURES = {
+    "default": _irs,
+    "extra_dim": _irs_extra,
+    "int_target": _irs_int_tgt,
+    "float_target": _irs_float_tgt,
+}
+
+_PLAIN_METRICS = [
+    (RetrievalMAP, _np_ap, False),
+    (RetrievalMRR, _np_mrr, False),
+    (RetrievalRPrecision, _np_r_precision, False),
+]
+
+_K_METRICS = [
+    (RetrievalPrecision, _np_precision, False),
+    (RetrievalRecall, _np_recall, False),
+    (RetrievalHitRate, _np_hit_rate, False),
+    (RetrievalFallOut, _np_fall_out, True),
+]
+
+
+def _run_module(metric, fixture, oracle, action, reverse, atol=1e-5, **metric_kwargs):
+    """NUM_BATCHES updates then compute, vs the full-data oracle."""
+    m = metric(empty_target_action=action, **metric_kwargs)
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(fixture.preds[i]), jnp.asarray(fixture.target[i]), jnp.asarray(fixture.indexes[i]))
+    oracle_kwargs = {k: v for k, v in metric_kwargs.items() if k in ("k", "adaptive_k")}
+    expected = _compute_reference_metric(
+        fixture.preds, fixture.target, fixture.indexes, oracle,
+        empty_target_action=action, reverse=reverse,
+        ignore_index=metric_kwargs.get("ignore_index"), **oracle_kwargs,
+    )
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=atol)
+
+
+@pytest.mark.parametrize("fixture_name", sorted(_BINARY_FIXTURES))
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+@pytest.mark.parametrize("metric,oracle,reverse", _PLAIN_METRICS, ids=lambda v: getattr(v, "__name__", ""))
+def test_plain_metrics_matrix(metric, oracle, reverse, fixture_name, action):
+    _run_module(metric, _BINARY_FIXTURES[fixture_name], oracle, action, reverse)
+
+
+@pytest.mark.parametrize("k", [None, 1, 4, 10])
+@pytest.mark.parametrize("fixture_name", sorted(_BINARY_FIXTURES))
+@pytest.mark.parametrize("metric,oracle,reverse", _K_METRICS, ids=lambda v: getattr(v, "__name__", ""))
+def test_topk_metrics_matrix(metric, oracle, reverse, fixture_name, k):
+    _run_module(metric, _BINARY_FIXTURES[fixture_name], oracle, "skip", reverse, k=k)
+
+
+@pytest.mark.parametrize("action", ["neg", "pos"])
+@pytest.mark.parametrize("metric,oracle,reverse", _K_METRICS, ids=lambda v: getattr(v, "__name__", ""))
+def test_topk_metrics_empty_actions(metric, oracle, reverse, action):
+    # reverse metrics (FallOut) treat "empty" as no NEGATIVE targets, so the
+    # all-positive fixture is what actually exercises their empty branch
+    _run_module(metric, _irs_all_tgt if reverse else _irs_no_tgt, oracle, action, reverse, k=3)
+
+
+@pytest.mark.parametrize("k", [None, 1, 4])
+@pytest.mark.parametrize("fixture_name", sorted(_GRADED_FIXTURES))
+def test_ndcg_matrix(fixture_name, k):
+    _run_module(RetrievalNormalizedDCG, _GRADED_FIXTURES[fixture_name], _np_ndcg, "skip", False, k=k)
+
+
+@pytest.mark.parametrize("adaptive_k", [False, True])
+@pytest.mark.parametrize("k", [1, 4, 10, 40])
+def test_precision_adaptive_k(k, adaptive_k):
+    _run_module(
+        RetrievalPrecision, _irs_adpt_k, _np_precision, "skip", False, k=k, adaptive_k=adaptive_k
+    )
+
+
+@pytest.mark.parametrize(
+    "metric,oracle,reverse",
+    _PLAIN_METRICS + _K_METRICS,
+    ids=lambda v: getattr(v, "__name__", ""),
+)
+def test_ignore_index_matrix(metric, oracle, reverse):
+    _run_module(metric, _irs_ii, oracle, "skip", reverse, ignore_index=-100)
+
+
+# ------------------------------------------------- functional fixture sweep
+
+_FUNCTIONALS = [
+    (retrieval_average_precision, _np_ap, {}),
+    (retrieval_reciprocal_rank, _np_mrr, {}),
+    (retrieval_precision, _np_precision, {"k": 3}),
+    (retrieval_recall, _np_recall, {"k": 3}),
+    (retrieval_hit_rate, _np_hit_rate, {"k": 3}),
+    (retrieval_fall_out, _np_fall_out, {"k": 3}),
+    (retrieval_r_precision, _np_r_precision, {}),
+]
+
+
+@pytest.mark.parametrize("fn,oracle,kwargs", _FUNCTIONALS, ids=lambda v: getattr(v, "__name__", ""))
+def test_functional_fixture_sweep(fn, oracle, kwargs):
+    """Each functional treats the whole input as ONE query (ref helpers.py:84)."""
+    preds = _irs.preds[0]
+    target = _irs.target[0]
+    if (target > 0).sum() == 0 or (fn is retrieval_fall_out and (target > 0).all()):
+        pytest.skip("degenerate fixture slice")
+    got = fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    np.testing.assert_allclose(np.asarray(got), oracle(target, preds, **kwargs), atol=1e-5)
+
+
+@pytest.mark.parametrize("k", [None, 1, 2, 5])
+def test_functional_ndcg_graded(k):
+    preds = _irs_float_tgt.preds[0]
+    target = _irs_float_tgt.target[0]
+    got = retrieval_normalized_dcg(jnp.asarray(preds), jnp.asarray(target), k=k)
+    np.testing.assert_allclose(np.asarray(got), _np_ndcg(target, preds, k=k), atol=1e-5)
+
+
+# ------------------------------------------------------ two-rank DDP merge
+
+
+@pytest.mark.parametrize(
+    "metric,oracle,reverse",
+    _PLAIN_METRICS + _K_METRICS,
+    ids=lambda v: getattr(v, "__name__", ""),
+)
+def test_two_rank_merge_matches_full_data(metric, oracle, reverse):
+    """Rank-strided updates + list-state merge == single-process full data.
+
+    Mirrors the reference's ddp=True retrieval tests (helpers.py:429-454):
+    DDP gathers every rank's accumulated rows before compute; here the
+    gather is `pure_merge` of the two rank states.
+    """
+    kwargs = {"k": 3} if (metric, oracle, reverse) in _K_METRICS else {}
+    ranks = [metric(**kwargs), metric(**kwargs)]
+    for i in range(NUM_BATCHES):
+        ranks[i % 2].update(
+            jnp.asarray(_irs.preds[i]), jnp.asarray(_irs.target[i]), jnp.asarray(_irs.indexes[i])
+        )
+    merged = ranks[0].pure_merge(ranks[0].state(), ranks[1].state())
+    got = ranks[0].pure_compute(merged)
+    expected = _compute_reference_metric(
+        _irs.preds, _irs.target, _irs.indexes, oracle,
+        empty_target_action="neg", reverse=reverse, **kwargs,
+    )
+    np.testing.assert_allclose(np.asarray(got), expected, atol=1e-5)
