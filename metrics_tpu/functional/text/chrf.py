@@ -49,11 +49,13 @@ def _chrf_f_score(matching, pred_total, tgt_total, beta: float) -> float:
     """Average F-beta over all n-gram orders (char + word)."""
     f_scores = []
     for m, p, t in zip(matching, pred_total, tgt_total):
-        prec = m / p if p > 0 else _EPS
-        rec = m / t if t > 0 else _EPS
-        denom = beta**2 * prec + rec
-        f = (1 + beta**2) * prec * rec / denom if denom > 0 else _EPS
-        f_scores.append(f)
+        # zero totals yield zero precision/recall exactly (ref chrf.py:264-279:
+        # only the denominator is eps-smoothed), so degenerate orders and
+        # empty corpora score 0, not eps
+        prec = m / p if p > 0 else 0.0
+        rec = m / t if t > 0 else 0.0
+        denom = max(beta**2 * prec + rec, _EPS)
+        f_scores.append((1 + beta**2) * prec * rec / denom)
     return sum(f_scores) / len(f_scores) if f_scores else 0.0
 
 

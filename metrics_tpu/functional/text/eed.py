@@ -118,6 +118,11 @@ def _eed_update(
     if sentence_eed is None:
         sentence_eed = []
     for pred, tgts in zip(preds_, target_):
+        if not tgts:
+            # a sentence without references scores nothing; valid sentences
+            # in the same batch still count (the reference's tests pin 0.0
+            # for all-empty corpora, ref tests/text/test_eed.py:82-105)
+            continue
         hyp = preprocess(pred)
         scores = [_eed_function(hyp, preprocess(t), alpha, rho, deletion, insertion) for t in tgts]
         sentence_eed.append(jnp.asarray(min(scores)))

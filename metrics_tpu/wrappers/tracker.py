@@ -105,7 +105,7 @@ class MetricTracker:
                 if return_step:
                     return best, idx
                 return best
-            except (ValueError, TypeError) as error:
+            except (ValueError, TypeError, IndexError) as error:
                 rank_zero_warn(
                     f"Encountered the following error when trying to get the best metric: {error}"
                     "this is probably due to the 'best' not being defined for this metric."
@@ -125,7 +125,7 @@ class MetricTracker:
                     best_i = int(v.argmax() if maximize[i] else v.argmin())
                     value[k] = float(v[best_i])
                     idx[k] = best_i
-                except (ValueError, TypeError) as error:
+                except (ValueError, TypeError, IndexError) as error:
                     rank_zero_warn(
                         f"Encountered the following error when trying to get the best metric for metric {k}:"
                         f"{error} this is probably due to the 'best' not being defined for this metric."

@@ -1,0 +1,362 @@
+"""Text metric parameter/edge matrix (translation of the per-metric axes in
+ref tests/text/test_{wer,cer,mer,wil,wip,ter,chrf,eed,bleu,rouge,squad}.py).
+
+The error-rate family is checked against an independent numpy alignment
+oracle (jiwer, the reference's oracle, is not in this image); TER/CHRF
+parameter axes are checked against the installed sacrebleu; empty-input
+semantics mirror the reference's tests exactly.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+from metrics_tpu.functional import (
+    bleu_score,
+    char_error_rate,
+    chrf_score,
+    extended_edit_distance,
+    match_error_rate,
+    rouge_score,
+    squad,
+    translation_edit_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+
+# A 12-sentence corpus with a spread of error patterns.
+CORPUS_PREDS = [
+    "the quick brown fox jumped over the lazy dog",
+    "hello world",
+    "this is a completely different sentence",
+    "one two three four",
+    "i am going to the store tomorrow morning",
+    "it rained all day yesterday",
+    "",
+    "exact match here",
+    "words in wrong order are",
+    "extra words were inserted into this short sentence",
+    "missing",
+    "punctuation, matters; sometimes!",
+]
+CORPUS_TARGETS = [
+    "the quick brown fox jumps over the lazy dog",
+    "hello there world",
+    "the expected sentence looks nothing like that",
+    "one two three four",
+    "i am going to the shop tomorrow",
+    "it rained all day",
+    "empty prediction",
+    "exact match here",
+    "are words in wrong order",
+    "short sentence",
+    "missing most of the words here",
+    "punctuation matters sometimes",
+]
+
+
+def _align_counts(ref_words, hyp_words):
+    """(hits, substitutions, deletions, insertions) via Levenshtein DP."""
+    R, H = len(ref_words), len(hyp_words)
+    # cost + backtrace over the (R+1, H+1) grid
+    dist = np.zeros((R + 1, H + 1), dtype=np.int64)
+    dist[:, 0] = np.arange(R + 1)
+    dist[0, :] = np.arange(H + 1)
+    for i in range(1, R + 1):
+        for j in range(1, H + 1):
+            sub = dist[i - 1, j - 1] + (ref_words[i - 1] != hyp_words[j - 1])
+            dist[i, j] = min(sub, dist[i - 1, j] + 1, dist[i, j - 1] + 1)
+    # backtrace to count operation types
+    i, j = R, H
+    hits = subs = dels = ins = 0
+    while i > 0 or j > 0:
+        if i > 0 and j > 0 and dist[i, j] == dist[i - 1, j - 1] + (ref_words[i - 1] != hyp_words[j - 1]):
+            if ref_words[i - 1] == hyp_words[j - 1]:
+                hits += 1
+            else:
+                subs += 1
+            i, j = i - 1, j - 1
+        elif i > 0 and dist[i, j] == dist[i - 1, j] + 1:
+            dels += 1
+            i -= 1
+        else:
+            ins += 1
+            j -= 1
+    return hits, subs, dels, ins
+
+
+def _corpus_counts(tokenize):
+    """(total_dist, total_max_len, n_ref, n_hyp) over the corpus — the
+    accumulators behind the reference's WER/MER/WIL/WIP formulas
+    (ref functional/text/{wer,mer,wil,wip}.py)."""
+    total_dist = total_max = n_ref = n_hyp = 0
+    for p, t in zip(CORPUS_PREDS, CORPUS_TARGETS):
+        rw, hw = tokenize(t), tokenize(p)
+        hits, subs, dels, ins = _align_counts(rw, hw)
+        total_dist += subs + dels + ins
+        total_max += max(len(rw), len(hw))
+        n_ref += len(rw)
+        n_hyp += len(hw)
+    return total_dist, total_max, n_ref, n_hyp
+
+
+@pytest.fixture(scope="module")
+def word_counts():
+    return _corpus_counts(str.split)
+
+
+@pytest.fixture(scope="module")
+def char_counts():
+    return _corpus_counts(list)
+
+
+def test_wer_corpus(word_counts):
+    dist, _, n_ref, _ = word_counts
+    np.testing.assert_allclose(float(word_error_rate(CORPUS_PREDS, CORPUS_TARGETS)), dist / n_ref, atol=1e-6)
+
+
+def test_cer_corpus(char_counts):
+    dist, _, n_ref, _ = char_counts
+    np.testing.assert_allclose(float(char_error_rate(CORPUS_PREDS, CORPUS_TARGETS)), dist / n_ref, atol=1e-6)
+
+
+def test_mer_corpus(word_counts):
+    # MER = total edit distance / total per-sentence max(ref, hyp) length
+    dist, total_max, _, _ = word_counts
+    np.testing.assert_allclose(
+        float(match_error_rate(CORPUS_PREDS, CORPUS_TARGETS)), dist / total_max, atol=1e-6
+    )
+
+
+def test_wil_wip_corpus(word_counts):
+    # WIP uses max(ref, hyp) - dist as the hit count proxy
+    dist, total_max, n_ref, n_hyp = word_counts
+    hits = total_max - dist
+    wip = (hits / n_ref) * (hits / n_hyp)
+    np.testing.assert_allclose(
+        float(word_information_preserved(CORPUS_PREDS, CORPUS_TARGETS)), wip, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(word_information_lost(CORPUS_PREDS, CORPUS_TARGETS)), 1 - wip, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize(
+    "metric_class,functional",
+    [
+        (WordErrorRate, word_error_rate),
+        (CharErrorRate, char_error_rate),
+        (MatchErrorRate, match_error_rate),
+        (WordInfoLost, word_information_lost),
+        (WordInfoPreserved, word_information_preserved),
+    ],
+)
+def test_error_rate_module_accumulation(metric_class, functional):
+    """Batched module updates == functional on the whole corpus; per-batch
+    forward values == functional on that batch (ref helpers.py TextTester)."""
+    m = metric_class()
+    for i in range(0, len(CORPUS_PREDS), 4):
+        batch_p, batch_t = CORPUS_PREDS[i: i + 4], CORPUS_TARGETS[i: i + 4]
+        batch_val = m(batch_p, batch_t)
+        np.testing.assert_allclose(float(batch_val), float(functional(batch_p, batch_t)), atol=1e-6)
+    np.testing.assert_allclose(
+        float(m.compute()), float(functional(CORPUS_PREDS, CORPUS_TARGETS)), atol=1e-6
+    )
+
+
+# ----------------------------------------------------------------- TER axes
+
+_TER_PREDS = ["the cat is on the mat, truly!", "A Fast Brown Fox jumped"]
+_TER_TARGETS = [
+    ["there is a cat on the mat.", "a cat is on the mat"],
+    ["the quick brown fox jumped over!", "A quick brown FOX leaped"],
+]
+
+
+@pytest.mark.parametrize("normalize", [False, True])
+@pytest.mark.parametrize("no_punctuation", [False, True])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_ter_flag_axes_vs_sacrebleu(normalize, no_punctuation, lowercase):
+    from sacrebleu.metrics import TER as SBTER
+
+    sb = SBTER(
+        normalized=normalize, no_punct=no_punctuation, asian_support=False,
+        case_sensitive=not lowercase,
+    )
+    refs_t = list(map(list, zip(*_TER_TARGETS)))
+    expected = sb.corpus_score(_TER_PREDS, refs_t).score / 100
+    ours = float(
+        translation_edit_rate(
+            _TER_PREDS, _TER_TARGETS,
+            normalize=normalize, no_punctuation=no_punctuation, lowercase=lowercase,
+        )
+    )
+    np.testing.assert_allclose(ours, expected, atol=1e-3)
+
+
+def test_ter_empty():
+    assert float(translation_edit_rate([], [])) == 0.0
+    assert float(translation_edit_rate(["python"], [[]])) == 0.0
+    m = TranslationEditRate()
+    assert float(m([], [])) == 0.0
+    m2 = TranslationEditRate()
+    assert float(m2(["python"], [[]])) == 0.0
+
+
+# ---------------------------------------------------------------- CHRF axes
+
+
+@pytest.mark.parametrize("n_char_order", [4, 6])
+@pytest.mark.parametrize("n_word_order", [0, 2])
+@pytest.mark.parametrize("beta", [1.0, 2.0, 3.0])
+def test_chrf_order_beta_axes_vs_sacrebleu(n_char_order, n_word_order, beta):
+    from sacrebleu.metrics import CHRF
+
+    sb = CHRF(char_order=n_char_order, word_order=n_word_order, beta=beta)
+    preds = ["the cat is on the mat", "the fast brown fox jumped over"]
+    targets = [["a cat is on the mat"], ["the quick brown fox jumped over"]]
+    refs_t = list(map(list, zip(*targets)))
+    expected = sb.corpus_score(preds, refs_t).score / 100
+    ours = float(
+        chrf_score(preds, targets, n_char_order=n_char_order, n_word_order=n_word_order, beta=beta)
+    )
+    np.testing.assert_allclose(ours, expected, atol=1e-3)
+
+
+def test_chrf_empty():
+    assert float(chrf_score([], [])) == 0.0
+    m = CHRFScore()
+    assert float(m([], [])) == 0.0
+
+
+def test_chrf_invalid_orders():
+    with pytest.raises(ValueError):
+        chrf_score(["a"], [["a"]], n_char_order=0)
+    with pytest.raises(ValueError):
+        chrf_score(["a"], [["a"]], beta=-1.0)
+
+
+# ----------------------------------------------------------------- EED axes
+
+
+def test_eed_empty():
+    assert float(extended_edit_distance([], [])) == 0.0
+    assert float(extended_edit_distance(["python"], [[]])) == 0.0
+    m = ExtendedEditDistance()
+    assert float(m([], [])) == 0.0
+    m2 = ExtendedEditDistance()
+    assert float(m2(["python"], [[]])) == 0.0
+
+
+def test_eed_mixed_batch_keeps_valid_sentences():
+    """A reference-less sentence is skipped; the rest still score."""
+    solo = float(extended_edit_distance(["hello world"], [["hello word"]]))
+    mixed = float(extended_edit_distance(["hello world", "x"], [["hello word"], []]))
+    np.testing.assert_allclose(mixed, solo, atol=1e-6)
+    assert mixed > 0.0
+    _, sentences = extended_edit_distance(
+        ["hello world", "x"], [["hello word"], []], return_sentence_level_score=True
+    )
+    assert len(np.asarray(sentences)) == 1
+
+
+def test_ter_pure_compute_jits():
+    """The three-branch TER score must stay jit-traceable."""
+    import jax
+
+    m = TranslationEditRate()
+    m.update(_TER_PREDS, _TER_TARGETS)
+    state = m.state()
+    jitted = jax.jit(m.pure_compute)(state)
+    np.testing.assert_allclose(float(jitted), float(m.pure_compute(state)), atol=1e-6)
+
+
+def test_eed_sentence_level():
+    corpus, sentences = extended_edit_distance(
+        _TER_PREDS, _TER_TARGETS, return_sentence_level_score=True
+    )
+    assert len(np.asarray(sentences)) == len(_TER_PREDS)
+    m = ExtendedEditDistance(return_sentence_level_score=True)
+    corpus_m, sentences_m = m(_TER_PREDS, _TER_TARGETS)
+    np.testing.assert_allclose(np.asarray(sentences_m), np.asarray(sentences), atol=1e-6)
+
+
+def test_eed_parameter_monotonicity():
+    """Higher deletion/insertion costs cannot lower the distance."""
+    base = float(extended_edit_distance(CORPUS_PREDS[:6], [[t] for t in CORPUS_TARGETS[:6]]))
+    costly = float(
+        extended_edit_distance(
+            CORPUS_PREDS[:6], [[t] for t in CORPUS_TARGETS[:6]], deletion=1.0, insertion=2.0
+        )
+    )
+    assert costly >= base
+    with pytest.raises(ValueError):
+        extended_edit_distance(["a"], [["a"]], alpha=-1.0)
+    with pytest.raises(ValueError):
+        extended_edit_distance(["a"], [["a"]], rho=-0.5)
+
+
+# ----------------------------------------------------------------- BLEU/ROUGE
+
+
+def test_bleu_empty():
+    assert float(bleu_score([], [])) == 0.0
+    m = BLEUScore()
+    assert float(m([], [])) == 0.0
+
+
+def test_bleu_no_4gram_overlap_is_zero():
+    # short sentences: no 4-grams at all -> precision 0 -> bleu 0 (no smooth)
+    assert float(bleu_score(["cat mat"], [["cat on mat"]])) == 0.0
+
+
+def test_rouge_corpus_average_vs_package():
+    """Multi-sample corpus scores equal the rouge_score per-sample average."""
+    from rouge_score.rouge_scorer import RougeScorer
+
+    preds = CORPUS_PREDS[:6]
+    targets = CORPUS_TARGETS[:6]
+    keys = ("rouge1", "rouge2", "rougeL")
+    scorer = RougeScorer(list(keys), use_stemmer=False)
+    expected = {k: np.mean([scorer.score(t, p)[k].fmeasure for p, t in zip(preds, targets)]) for k in keys}
+    ours = rouge_score(preds, [[t] for t in targets], rouge_keys=keys)
+    for k in keys:
+        np.testing.assert_allclose(float(ours[f"{k}_fmeasure"]), expected[k], atol=1e-5, err_msg=k)
+
+
+def test_rouge_invalid_key():
+    with pytest.raises(ValueError):
+        rouge_score("a", "a", rouge_keys="rouge99")
+
+
+def test_rouge_higher_order_keys():
+    from rouge_score.rouge_scorer import RougeScorer
+
+    pred = "the quick brown fox jumped over the lazy dog today"
+    tgt = "the quick brown fox leaped over the lazy dog"
+    for key in ("rouge3", "rouge4"):
+        scorer = RougeScorer([key], use_stemmer=False)
+        expected = scorer.score(tgt, pred)[key].fmeasure
+        ours = rouge_score(pred, tgt, rouge_keys=key)
+        np.testing.assert_allclose(float(ours[f"{key}_fmeasure"]), expected, atol=1e-5, err_msg=key)
+
+
+# ------------------------------------------------------------------- SQuAD
+
+
+def test_squad_input_validation():
+    with pytest.raises(KeyError):
+        squad([{"wrong_key": "x", "id": "1"}], [{"answers": {"text": ["x"]}, "id": "1"}])
+    with pytest.raises(KeyError):
+        squad([{"prediction_text": "x", "id": "1"}], [{"no_answers": {}, "id": "1"}])

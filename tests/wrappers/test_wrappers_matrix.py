@@ -1,0 +1,366 @@
+"""Wrapper matrix tests: exact bootstrap oracle, tracker/minmax/classwise/
+multioutput breadth (translation of ref tests/wrappers/test_bootstrapping.py,
+test_tracker.py, test_minmax.py, test_classwise.py, test_multioutput.py).
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+from sklearn.metrics import mean_squared_error as sk_mse
+from sklearn.metrics import precision_score as sk_precision
+from sklearn.metrics import recall_score as sk_recall
+
+from metrics_tpu import (
+    Accuracy,
+    ConfusionMatrix,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    MetricCollection,
+    Precision,
+    R2Score,
+    Recall,
+)
+from metrics_tpu.wrappers import (
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+)
+from metrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, NUM_CLASSES
+
+seed_all(42)
+
+_NB = 6  # batches for the wrapper sweeps
+
+
+# ------------------------------------------------------- exact bootstrap
+
+
+class _CapturingBootStrapper(BootStrapper):
+    """Record each bootstrap copy's resampled inputs so the per-copy scores
+    can be recomputed with sklearn (ref test_bootstrapping.py:35-46)."""
+
+    def update(self, *args):
+        self.out = []
+        for idx in range(self.num_bootstraps):
+            size = len(args[0])
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            new_args = [jnp.take(a, sample_idx, axis=0) for a in args]
+            self.metrics[idx].update(*new_args)
+            self.out.append(new_args)
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+@pytest.mark.parametrize(
+    "metric_fn,sk_fn",
+    [
+        (lambda: MeanSquaredError(), lambda t, p: sk_mse(t, p)),
+        (lambda: Precision(average="micro"), lambda t, p: sk_precision(t, p, average="micro")),
+        (lambda: Recall(average="micro"), lambda t, p: sk_recall(t, p, average="micro")),
+    ],
+    ids=["mse", "precision_micro", "recall_micro"],
+)
+def test_bootstrap_exact_oracle(sampling_strategy, metric_fn, sk_fn):
+    """Every bootstrap copy must equal sklearn on its captured resample, and
+    the summary stats must be exact over those per-copy scores."""
+    rng = np.random.RandomState(42)
+    preds = rng.randint(0, 10, (_NB, 32))
+    target = rng.randint(0, 10, (_NB, 32))
+
+    boot = _CapturingBootStrapper(
+        metric_fn(), num_bootstraps=7, mean=True, std=True, raw=True,
+        quantile=jnp.asarray([0.05, 0.95]), sampling_strategy=sampling_strategy,
+    )
+    collected = [([], []) for _ in range(boot.num_bootstraps)]
+    for p, t in zip(preds, target):
+        boot.update(jnp.asarray(p, dtype=jnp.float32) if "mse" in repr(metric_fn()) else jnp.asarray(p),
+                    jnp.asarray(t))
+        for i, (rp, rt) in enumerate(boot.out):
+            collected[i][0].append(np.asarray(rp))
+            collected[i][1].append(np.asarray(rt))
+
+    sk_scores = [sk_fn(np.concatenate(ct), np.concatenate(cp)) for cp, ct in collected]
+    out = boot.compute()
+    np.testing.assert_allclose(np.asarray(out["raw"]), sk_scores, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["mean"]), np.mean(sk_scores), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["std"]), np.std(sk_scores, ddof=1), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["quantile"][0]), np.quantile(sk_scores, 0.05), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["quantile"][1]), np.quantile(sk_scores, 0.95), atol=1e-5)
+
+
+def test_bootstrap_invalid_base():
+    with pytest.raises(ValueError, match="base metric"):
+        BootStrapper([1, 2, 3])
+
+
+# ------------------------------------------------------------- tracker
+
+
+def test_tracker_raises_on_wrong_input():
+    with pytest.raises(TypeError, match="Metric arg need to be an instance"):
+        MetricTracker([1, 2, 3])
+    with pytest.raises(ValueError, match="single bool or list of bool"):
+        MetricTracker(MeanAbsoluteError(), maximize=2)
+    with pytest.raises(ValueError, match="length of the metric collection"):
+        MetricTracker(MetricCollection([MeanAbsoluteError(), MeanSquaredError()]), maximize=[False, False, False])
+
+
+@pytest.mark.parametrize("method", ["update", "forward", "compute"])
+def test_tracker_raises_if_increment_not_called(method):
+    tracker = MetricTracker(Accuracy(num_classes=10))
+    with pytest.raises(ValueError, match=f"`{method}` cannot be called before"):
+        if method == "compute":
+            tracker.compute()
+        else:
+            getattr(tracker, method)(jnp.asarray([1, 2]), jnp.asarray([1, 2]))
+
+
+_CLS_INPUT = (jnp.asarray(np.random.RandomState(0).randint(0, 10, 50)),
+              jnp.asarray(np.random.RandomState(1).randint(0, 10, 50)))
+_REG_INPUT = (jnp.asarray(np.random.RandomState(2).randn(50).astype(np.float32)),
+              jnp.asarray(np.random.RandomState(3).randn(50).astype(np.float32)))
+
+
+@pytest.mark.parametrize(
+    "base_metric,metric_input,maximize",
+    [
+        (lambda: Accuracy(num_classes=10), _CLS_INPUT, True),
+        (lambda: Precision(num_classes=10), _CLS_INPUT, True),
+        (lambda: Recall(num_classes=10), _CLS_INPUT, True),
+        (lambda: MeanSquaredError(), _REG_INPUT, False),
+        (lambda: MeanAbsoluteError(), _REG_INPUT, False),
+        (lambda: MetricCollection([Accuracy(num_classes=10), Precision(num_classes=10), Recall(num_classes=10)]),
+         _CLS_INPUT, True),
+        (lambda: MetricCollection([Accuracy(num_classes=10), Precision(num_classes=10), Recall(num_classes=10)]),
+         _CLS_INPUT, [True, True, True]),
+        (lambda: MetricCollection([MeanSquaredError(), MeanAbsoluteError()]), _REG_INPUT, False),
+        (lambda: MetricCollection([MeanSquaredError(), MeanAbsoluteError()]), _REG_INPUT, [False, False]),
+    ],
+)
+def test_tracker_matrix(base_metric, metric_input, maximize):
+    """update+forward per step, per-step compute, compute_all stacking, and
+    best_metric honoring maximize (ref test_tracker.py:63-127)."""
+    tracker = MetricTracker(base_metric(), maximize=maximize)
+    n_epochs = 4
+    for i in range(n_epochs):
+        tracker.increment()
+        for _ in range(3):
+            tracker.update(*metric_input)
+        for _ in range(2):
+            tracker(*metric_input)
+        val = tracker.compute()
+        if isinstance(val, dict):
+            assert all(float(v) != 0.0 for v in val.values())
+        else:
+            assert float(val) != 0.0
+        assert tracker.n_steps == i + 1
+
+    all_computed = tracker.compute_all()
+    if isinstance(all_computed, dict):
+        assert all(np.asarray(v).size == n_epochs for v in all_computed.values())
+    else:
+        assert np.asarray(all_computed).size == n_epochs
+
+    val, idx = tracker.best_metric(return_step=True)
+    if isinstance(val, dict):
+        for v, i in zip(val.values(), idx.values()):
+            assert v != 0.0 and i in range(n_epochs)
+    else:
+        assert val != 0.0 and idx in range(n_epochs)
+
+
+@pytest.mark.parametrize(
+    "base_metric",
+    [
+        lambda: ConfusionMatrix(num_classes=3),
+        lambda: MetricCollection([ConfusionMatrix(num_classes=3), Accuracy(num_classes=3)]),
+    ],
+    ids=["confmat", "collection"],
+)
+def test_tracker_best_metric_undefined_returns_none(base_metric):
+    """Metrics without a scalar 'best' warn and yield None, without crashing
+    (ref test_tracker.py:129-160)."""
+    tracker = MetricTracker(base_metric())
+    for _ in range(3):
+        tracker.increment()
+        tracker.update(jnp.asarray([0, 1, 2, 2]), jnp.asarray([0, 1, 1, 2]))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        val, idx = tracker.best_metric(return_step=True)
+    if isinstance(val, dict):
+        assert val["ConfusionMatrix"] is None and idx["ConfusionMatrix"] is None
+        # the well-defined member still reports a best
+        assert val["Accuracy"] is not None and idx["Accuracy"] is not None
+    else:
+        assert val is None and idx is None
+
+
+# -------------------------------------------------------------- min/max
+
+
+@pytest.mark.parametrize(
+    "make_inputs,base_metric",
+    [
+        (
+            lambda rng: (
+                rng.rand(_NB, BATCH_SIZE, NUM_CLASSES).astype(np.float32),
+                rng.randint(0, NUM_CLASSES, (_NB, BATCH_SIZE)),
+            ),
+            lambda: Accuracy(num_classes=NUM_CLASSES),
+        ),
+        (
+            lambda rng: (
+                rng.randn(_NB, BATCH_SIZE).astype(np.float32),
+                rng.randn(_NB, BATCH_SIZE).astype(np.float32),
+            ),
+            lambda: MeanSquaredError(),
+        ),
+    ],
+    ids=["accuracy", "mse"],
+)
+def test_minmax_incremental(make_inputs, base_metric):
+    """min/max track the running extrema of the *cumulative* compute after
+    each update (ref test_minmax.py compare_fn)."""
+    rng = np.random.RandomState(7)
+    preds, target = make_inputs(rng)
+    softmax = preds.ndim == 3
+    if softmax:
+        preds = np.exp(preds) / np.exp(preds).sum(-1, keepdims=True)
+
+    mm = MinMaxMetric(base_metric())
+    oracle = base_metric()
+    v_min, v_max = np.inf, -np.inf
+    for i in range(_NB):
+        mm.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        out = mm.compute()
+        oracle.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        val = float(oracle.compute())
+        v_min, v_max = min(v_min, val), max(v_max, val)
+        np.testing.assert_allclose(float(out["raw"]), val, atol=1e-6)
+        np.testing.assert_allclose(float(out["min"]), v_min, atol=1e-6)
+        np.testing.assert_allclose(float(out["max"]), v_max, atol=1e-6)
+
+
+def test_minmax_invalid_base():
+    with pytest.raises(ValueError, match="base metric"):
+        MinMaxMetric([1, 2, 3])
+
+
+def test_minmax_nonscalar_base_raises():
+    mm = MinMaxMetric(ConfusionMatrix(num_classes=3))
+    mm.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+    with pytest.raises(RuntimeError, match="should be a scalar"):
+        mm.compute()
+
+
+# ------------------------------------------------------------ classwise
+
+
+def test_classwise_raises_on_wrong_input():
+    with pytest.raises(ValueError, match="Expected argument `metric`"):
+        ClasswiseWrapper([])
+    with pytest.raises(ValueError, match="Expected argument `labels`"):
+        ClasswiseWrapper(Accuracy(num_classes=3), "hest")
+
+
+@pytest.mark.parametrize("prefix", [None, "pre_"])
+@pytest.mark.parametrize("postfix", [None, "_post"])
+def test_classwise_in_collection(prefix, postfix):
+    """ClasswiseWrapper dicts merge through MetricCollection with prefix/
+    postfix renaming (ref test_classwise.py:41-77)."""
+    labels = ["horse", "fish", "cat"]
+    collection_kwargs = {}
+    if prefix is not None:
+        collection_kwargs["prefix"] = prefix
+    if postfix is not None:
+        collection_kwargs["postfix"] = postfix
+    metric = MetricCollection(
+        {
+            "accuracy": ClasswiseWrapper(Accuracy(num_classes=3, average="none"), labels=labels),
+            "recall": ClasswiseWrapper(Recall(num_classes=3, average="none"), labels=labels),
+        },
+        compute_groups=False,
+        **collection_kwargs,
+    )
+    rng = np.random.RandomState(11)
+    logits = rng.rand(10, 3).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, 3, 10))
+    val = metric(preds, target)
+    assert isinstance(val, dict) and len(val) == 6
+
+    def _name(base):
+        name = base if prefix is None else prefix + base
+        return name if postfix is None else name + postfix
+
+    for lab in labels:
+        assert _name(f"accuracy_{lab}") in val
+        assert _name(f"recall_{lab}") in val
+
+
+# ----------------------------------------------------------- multioutput
+
+
+def test_multioutput_classification():
+    """Accuracy over (N, C, outputs) preds slices per output column
+    (ref test_multioutput.py:59-104)."""
+    rng = np.random.RandomState(5)
+    n_outputs = 2
+    preds = rng.rand(_NB, BATCH_SIZE, NUM_CLASSES, n_outputs).astype(np.float32)
+    target = rng.randint(0, NUM_CLASSES, (_NB, BATCH_SIZE, n_outputs))
+
+    wrapper = MultioutputWrapper(Accuracy(num_classes=NUM_CLASSES), n_outputs, output_dim=-1)
+    for i in range(_NB):
+        wrapper.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    got = [float(v) for v in wrapper.compute()]
+
+    flat_preds = preds.reshape(-1, NUM_CLASSES, n_outputs)
+    flat_target = target.reshape(-1, n_outputs)
+    expected = [
+        sk_accuracy(flat_target[:, i], flat_preds[:, :, i].argmax(1)) for i in range(n_outputs)
+    ]
+    np.testing.assert_allclose(got, expected, atol=1e-6)
+
+
+def test_multioutput_forward_matches_update_compute():
+    rng = np.random.RandomState(6)
+    preds = jnp.asarray(rng.rand(16, 3).astype(np.float32))
+    target = jnp.asarray(rng.rand(16, 3).astype(np.float32))
+    w1 = MultioutputWrapper(MeanSquaredError(), 3)
+    fwd = w1(preds, target)
+    w2 = MultioutputWrapper(MeanSquaredError(), 3)
+    w2.update(preds, target)
+    np.testing.assert_allclose(
+        [float(v) for v in fwd], [float(v) for v in w2.compute()], atol=1e-6
+    )
+
+
+def test_multioutput_squeeze_and_nans():
+    """remove_nans drops a row only in the affected output column's slice."""
+    target = np.asarray([[0.5, 1.0], [-1.0, 1.0], [7.0, np.nan]], dtype=np.float32)
+    preds = np.asarray([[0.0, 2.0], [-1.0, 2.0], [8.0, -5.0]], dtype=np.float32)
+    w = MultioutputWrapper(MeanSquaredError(), 2)
+    out = w(jnp.asarray(preds), jnp.asarray(target))
+    # column 0 keeps all 3 rows; column 1 drops the nan row
+    np.testing.assert_allclose(float(out[0]), sk_mse(target[:, 0], preds[:, 0]), atol=1e-6)
+    np.testing.assert_allclose(float(out[1]), sk_mse(target[:2, 1], preds[:2, 1]), atol=1e-6)
+
+
+def test_multioutput_r2_matches_sklearn_raw():
+    from sklearn.metrics import r2_score as sk_r2
+
+    rng = np.random.RandomState(8)
+    preds = rng.rand(_NB, BATCH_SIZE, 2).astype(np.float32)
+    target = rng.rand(_NB, BATCH_SIZE, 2).astype(np.float32)
+    w = MultioutputWrapper(R2Score(), 2)
+    for i in range(_NB):
+        w.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    got = [float(v) for v in w.compute()]
+    expected = sk_r2(target.reshape(-1, 2), preds.reshape(-1, 2), multioutput="raw_values")
+    np.testing.assert_allclose(got, expected, atol=1e-5)
