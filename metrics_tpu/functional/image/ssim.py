@@ -90,6 +90,18 @@ def _ssim_compute(
         used_kernel_size = list(kernel_size)
 
     pads = [(k - 1) // 2 for k in used_kernel_size]
+    spatial = preds.shape[2:]
+    if any(dim < k for dim, k in zip(spatial, used_kernel_size)):
+        # the SSIM map is cropped by the pad on each side after the valid
+        # conv, so a window larger than the image leaves an EMPTY map whose
+        # mean is silently NaN (the reference's own size guard misses this
+        # because it checks the passed kernel_size, not the sigma-derived
+        # gaussian window). Fail loudly instead.
+        raise ValueError(
+            f"The effective SSIM window {used_kernel_size} cannot exceed the"
+            f" spatial dimensions {tuple(spatial)}; reduce `sigma`/"
+            f"`kernel_size` or use fewer `betas` scales."
+        )
     preds_p = _reflection_pad(preds, pads)
     target_p = _reflection_pad(target, pads)
 

@@ -97,6 +97,11 @@ def chrf_score(
     sentence_scores = []
 
     for pred, tgts in zip(preds_, target_):
+        if not tgts:
+            # no references: zero matches against zero totals — contributes
+            # nothing to the corpus totals and scores 0 at sentence level
+            sentence_scores.append(0.0)
+            continue
         p_char, p_word = _char_and_word_ngrams(pred, n_char_order, n_word_order, lowercase, whitespace)
         # pick the reference with the best sentence-level F score
         best = None

@@ -119,9 +119,12 @@ def _eed_update(
         sentence_eed = []
     for pred, tgts in zip(preds_, target_):
         if not tgts:
-            # a sentence without references scores nothing; valid sentences
-            # in the same batch still count (the reference's tests pin 0.0
-            # for all-empty corpora, ref tests/text/test_eed.py:82-105)
+            # a sentence without references has no defined score: a NaN
+            # placeholder keeps sentence_eed[i] aligned with preds[i] while
+            # the corpus mean (nanmean) excludes it — valid sentences in the
+            # same batch still count (the reference's tests pin 0.0 for
+            # all-empty corpora, ref tests/text/test_eed.py:82-105)
+            sentence_eed.append(jnp.asarray(jnp.nan))
             continue
         hyp = preprocess(pred)
         scores = [_eed_function(hyp, preprocess(t), alpha, rho, deletion, insertion) for t in tgts]
@@ -132,7 +135,8 @@ def _eed_update(
 def _eed_compute(sentence_level_scores: List[Array]) -> Array:
     if not sentence_level_scores:
         return jnp.asarray(0.0)
-    return jnp.stack(sentence_level_scores).mean()
+    stacked = jnp.stack(sentence_level_scores)
+    return jnp.where(jnp.isfinite(stacked).any(), jnp.nanmean(stacked), 0.0)
 
 
 def extended_edit_distance(

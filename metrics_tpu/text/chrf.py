@@ -63,6 +63,11 @@ class CHRFScore(Metric):
         target_ = [[t] if isinstance(t, str) else list(t) for t in target]
 
         for pred, tgts in zip(preds_, target_):
+            if not tgts:
+                # no references: nothing to accumulate; sentence score 0
+                if self.return_sentence_level_score:
+                    self.sentence_chrf_score.append(jnp.zeros(1))
+                continue
             p_char, p_word = _char_and_word_ngrams(
                 pred, self.n_char_order, self.n_word_order, self.lowercase, self.whitespace
             )

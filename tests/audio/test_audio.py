@@ -178,3 +178,70 @@ def test_pesq_gated():
 
         with pytest.raises(ModuleNotFoundError):
             PerceptualEvaluationSpeechQuality(16000, "wb")
+
+
+class TestSDRParameterAxes:
+    """SDR solver/parameter axes (ref tests/audio/test_sdr.py param rows)."""
+
+    def _signals(self, n=2, length=3000, seed=9):
+        rng = np.random.RandomState(seed)
+        target = rng.randn(n, length).astype(np.float32)
+        preds = (0.8 * target + 0.2 * rng.randn(n, length)).astype(np.float32)
+        return jnp.asarray(preds), jnp.asarray(target)
+
+    def test_cg_iter_matches_exact_solve(self):
+        """The conjugate-gradient path approximates the exact Toeplitz solve."""
+        preds, target = self._signals()
+        exact = np.asarray(signal_distortion_ratio(preds, target, filter_length=64))
+        cg = np.asarray(signal_distortion_ratio(preds, target, filter_length=64, use_cg_iter=50))
+        np.testing.assert_allclose(cg, exact, atol=0.1)
+
+    def test_zero_mean_removes_offsets(self):
+        preds, target = self._signals()
+        base = np.asarray(signal_distortion_ratio(preds, target, filter_length=64, zero_mean=True))
+        shifted = np.asarray(
+            signal_distortion_ratio(preds + 5.0, target - 3.0, filter_length=64, zero_mean=True)
+        )
+        np.testing.assert_allclose(shifted, base, atol=1e-2)
+
+    def test_load_diag_regularizes(self):
+        preds, target = self._signals()
+        plain = np.asarray(signal_distortion_ratio(preds, target, filter_length=64))
+        loaded = np.asarray(signal_distortion_ratio(preds, target, filter_length=64, load_diag=1e-3))
+        assert np.all(np.isfinite(loaded))
+        # light loading must not change the score much on well-conditioned data
+        np.testing.assert_allclose(loaded, plain, atol=0.5)
+
+    def test_filter_length_improves_fit(self):
+        """A longer distortion filter can only improve (or match) the fit on
+        a filtered signal."""
+        rng = np.random.RandomState(3)
+        target = rng.randn(1, 4000).astype(np.float32)
+        kernel = np.asarray([1.0, 0.6, -0.3, 0.2, -0.1], dtype=np.float32)
+        filtered = np.convolve(target[0], kernel, mode="same")[None].astype(np.float32)
+        short = float(np.asarray(signal_distortion_ratio(jnp.asarray(filtered), jnp.asarray(target), filter_length=16)).mean())
+        long = float(np.asarray(signal_distortion_ratio(jnp.asarray(filtered), jnp.asarray(target), filter_length=256)).mean())
+        assert long >= short - 0.1
+
+
+def test_pit_min_mode_picks_worst_is_best_for_losses():
+    """eval_func='min' treats the metric as a loss (ref functional/audio/pit.py)."""
+    from metrics_tpu.functional import permutation_invariant_training, pit_permutate
+
+    rng = np.random.RandomState(5)
+    target = rng.randn(3, 2, 1000).astype(np.float32)
+    # preds are the target with channels swapped
+    preds = target[:, ::-1, :].copy()
+
+    def neg_si_sdr(p, t):
+        from metrics_tpu.functional import scale_invariant_signal_distortion_ratio
+
+        return -scale_invariant_signal_distortion_ratio(p, t)
+
+    best_metric, best_perm = permutation_invariant_training(
+        jnp.asarray(preds), jnp.asarray(target), neg_si_sdr, eval_func="min"
+    )
+    # the minimizing permutation for the negated metric is the swap
+    assert np.all(np.asarray(best_perm)[:, 0] == 1)
+    restored = pit_permutate(jnp.asarray(preds), best_perm)
+    np.testing.assert_allclose(np.asarray(restored), target, atol=1e-6)

@@ -1,0 +1,226 @@
+"""SSIM/MS-SSIM/PSNR parameter-axis tests vs an independent numpy oracle
+(translation of the parameter sweeps in ref tests/image/test_ssim.py and
+test_psnr.py; skimage/pytorch_msssim are absent from this image, so the
+oracle is a direct numpy rendering of the published SSIM algorithm:
+reflect-pad, valid convolution, crop — as the reference computes it).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.signal import convolve
+
+from metrics_tpu import MultiScaleStructuralSimilarityIndexMeasure, StructuralSimilarityIndexMeasure
+from metrics_tpu.functional import (
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    structural_similarity_index_measure,
+)
+
+_rng = np.random.RandomState(42)
+_PREDS = _rng.rand(3, 2, 24, 24).astype(np.float32)
+_TARGET = (_PREDS * 0.75 + 0.25 * _rng.rand(3, 2, 24, 24)).astype(np.float32)
+
+
+def _np_gaussian_kernel(kernel_size, sigma):
+    kernels_1d = []
+    for ks, sg in zip(kernel_size, sigma):
+        x = np.arange(ks, dtype=np.float64) - (ks - 1) / 2
+        g = np.exp(-(x**2) / (2 * sg**2))
+        kernels_1d.append(g / g.sum())
+    kernel = kernels_1d[0]
+    for k1d in kernels_1d[1:]:
+        kernel = np.multiply.outer(kernel, k1d)
+    return kernel
+
+
+def _np_ssim(
+    preds, target, gaussian=True, kernel_size=(11, 11), sigma=(1.5, 1.5),
+    k1=0.01, k2=0.03, data_range=1.0, return_cs=False,
+):
+    """Per-batch-mean SSIM exactly as the reference computes it
+    (ref functional/image/ssim.py:137-196). For a gaussian window the
+    effective kernel size is derived from sigma (2*int(3.5*s+0.5)+1) and
+    the `kernel_size` argument is only used for uniform windows — the
+    reference's (undocumented) behavior, mirrored by this package."""
+    if gaussian:
+        kernel_size = tuple(2 * int(3.5 * s + 0.5) + 1 for s in sigma)
+        kernel = _np_gaussian_kernel(kernel_size, sigma)
+    else:
+        kernel = np.full(kernel_size, 1.0 / np.prod(kernel_size))
+    pads = [(k - 1) // 2 for k in kernel_size]
+    c1, c2 = (k1 * data_range) ** 2, (k2 * data_range) ** 2
+
+    batch_scores, batch_cs = [], []
+    for b in range(preds.shape[0]):
+        per_channel, per_channel_cs = [], []
+        for c in range(preds.shape[1]):
+            p = np.pad(preds[b, c].astype(np.float64), [(pd, pd) for pd in pads], mode="reflect")
+            t = np.pad(target[b, c].astype(np.float64), [(pd, pd) for pd in pads], mode="reflect")
+            mu_p = convolve(p, kernel, mode="valid")
+            mu_t = convolve(t, kernel, mode="valid")
+            s_pp = convolve(p * p, kernel, mode="valid") - mu_p**2
+            s_tt = convolve(t * t, kernel, mode="valid") - mu_t**2
+            s_pt = convolve(p * t, kernel, mode="valid") - mu_p * mu_t
+            upper = 2 * s_pt + c2
+            lower = s_pp + s_tt + c2
+            ssim_map = ((2 * mu_p * mu_t + c1) * upper) / ((mu_p**2 + mu_t**2 + c1) * lower)
+            crop = tuple(slice(pd, ssim_map.shape[i] - pd) for i, pd in enumerate(pads))
+            per_channel.append(ssim_map[crop])
+            per_channel_cs.append((upper / lower)[crop])
+        batch_scores.append(np.mean(per_channel))
+        batch_cs.append(np.mean(per_channel_cs))
+    if return_cs:
+        return np.mean(batch_scores), np.mean(batch_cs)
+    return np.mean(batch_scores)
+
+
+@pytest.mark.parametrize("sigma", [0.8, 1.0, 1.5, 2.0])
+def test_ssim_gaussian_axes(sigma):
+    ours = structural_similarity_index_measure(
+        jnp.asarray(_PREDS), jnp.asarray(_TARGET), sigma=sigma
+    )
+    expected = _np_ssim(_PREDS, _TARGET, sigma=(sigma,) * 2)
+    np.testing.assert_allclose(float(ours), expected, atol=1e-4)
+
+
+def test_ssim_uniform_kernel():
+    ours = structural_similarity_index_measure(
+        jnp.asarray(_PREDS), jnp.asarray(_TARGET), gaussian_kernel=False, kernel_size=9
+    )
+    expected = _np_ssim(_PREDS, _TARGET, gaussian=False, kernel_size=(9, 9))
+    np.testing.assert_allclose(float(ours), expected, atol=1e-4)
+
+
+@pytest.mark.parametrize("k1,k2", [(0.01, 0.03), (0.05, 0.1)])
+def test_ssim_k_constants(k1, k2):
+    ours = structural_similarity_index_measure(
+        jnp.asarray(_PREDS), jnp.asarray(_TARGET), k1=k1, k2=k2
+    )
+    expected = _np_ssim(_PREDS, _TARGET, k1=k1, k2=k2)
+    np.testing.assert_allclose(float(ours), expected, atol=1e-4)
+
+
+def test_ssim_3d():
+    preds = _rng.rand(2, 1, 12, 12, 12).astype(np.float32)
+    target = (preds * 0.8 + 0.2 * _rng.rand(2, 1, 12, 12, 12)).astype(np.float32)
+    ours = structural_similarity_index_measure(
+        jnp.asarray(preds), jnp.asarray(target), kernel_size=(5, 5, 5), sigma=(1.0, 1.0, 1.0)
+    )
+    expected = _np_ssim(preds, target, sigma=(1.0, 1.0, 1.0))
+    np.testing.assert_allclose(float(ours), expected, atol=1e-4)
+
+
+def test_ssim_contrast_sensitivity():
+    ours, cs = structural_similarity_index_measure(
+        jnp.asarray(_PREDS), jnp.asarray(_TARGET), return_contrast_sensitivity=True
+    )
+    exp_ssim, exp_cs = _np_ssim(_PREDS, _TARGET, return_cs=True)
+    np.testing.assert_allclose(float(ours), exp_ssim, atol=1e-4)
+    np.testing.assert_allclose(float(np.mean(np.asarray(cs))), exp_cs, atol=1e-4)
+
+
+def test_ssim_full_image_consistent():
+    # reduction="none" keeps the per-image map (the default reduction means
+    # it, exactly as the reference's `reduce(full_image, reduction)` does)
+    score, full = structural_similarity_index_measure(
+        jnp.asarray(_PREDS), jnp.asarray(_TARGET), return_full_image=True, reduction="none"
+    )
+    assert np.asarray(full).shape[0] == _PREDS.shape[0]
+    np.testing.assert_allclose(
+        float(np.mean(np.asarray(score))), _np_ssim(_PREDS, _TARGET), atol=1e-4
+    )
+
+
+def test_ssim_module_matches_functional():
+    m = StructuralSimilarityIndexMeasure(kernel_size=7)
+    half = len(_PREDS) // 2
+    m.update(jnp.asarray(_PREDS[:half]), jnp.asarray(_TARGET[:half]))
+    m.update(jnp.asarray(_PREDS[half:]), jnp.asarray(_TARGET[half:]))
+    np.testing.assert_allclose(
+        float(m.compute()),
+        float(structural_similarity_index_measure(jnp.asarray(_PREDS), jnp.asarray(_TARGET), kernel_size=7)),
+        atol=1e-6,
+    )
+
+
+def test_ssim_kernel_dim_errors():
+    with pytest.raises(ValueError, match="`kernel_size` has dimension"):
+        structural_similarity_index_measure(
+            jnp.asarray(_PREDS), jnp.asarray(_TARGET), kernel_size=(11, 11, 11)
+        )
+    with pytest.raises(ValueError, match="`sigma` has dimension"):
+        structural_similarity_index_measure(
+            jnp.asarray(_PREDS), jnp.asarray(_TARGET), sigma=(1.5, 1.5, 1.5)
+        )
+
+
+# ------------------------------------------------------------------ MS-SSIM
+
+
+def test_ms_ssim_betas_and_normalize():
+    preds = _rng.rand(2, 1, 96, 96).astype(np.float32)
+    target = (preds * 0.9 + 0.1 * _rng.rand(2, 1, 96, 96)).astype(np.float32)
+    # sigma sets the effective gaussian window: 0.5 -> 5px, small enough for
+    # the coarsest of 5 scales on a 96px image
+    kwargs = dict(kernel_size=5, sigma=0.5)
+    base = float(
+        multiscale_structural_similarity_index_measure(
+            jnp.asarray(preds), jnp.asarray(target), **kwargs
+        )
+    )
+    assert 0 < base <= 1
+    # fewer scales on a smaller pyramid still computes
+    short = float(
+        multiscale_structural_similarity_index_measure(
+            jnp.asarray(preds), jnp.asarray(target), betas=(0.3, 0.4, 0.3), **kwargs
+        )
+    )
+    assert 0 < short <= 1
+    relu = float(
+        multiscale_structural_similarity_index_measure(
+            jnp.asarray(preds), jnp.asarray(target), normalize="relu", **kwargs
+        )
+    )
+    assert 0 < relu <= 1
+
+
+def test_ms_ssim_window_exceeds_scale_raises():
+    """window larger than the coarsest scale errors loudly, not NaN."""
+    imgs = jnp.asarray(_rng.rand(1, 1, 96, 96).astype(np.float32))
+    with pytest.raises(ValueError, match="effective SSIM window"):
+        multiscale_structural_similarity_index_measure(imgs, imgs, kernel_size=5)
+
+
+def test_ms_ssim_too_small_image_raises():
+    small = jnp.asarray(_rng.rand(1, 1, 16, 16).astype(np.float32))
+    with pytest.raises(ValueError, match="image height and width"):
+        multiscale_structural_similarity_index_measure(small, small)
+
+
+def test_ms_ssim_identical_is_one():
+    imgs = jnp.asarray(_rng.rand(2, 1, 96, 96).astype(np.float32))
+    m = MultiScaleStructuralSimilarityIndexMeasure(kernel_size=5, sigma=0.5)
+    np.testing.assert_allclose(float(m(imgs, imgs)), 1.0, atol=1e-5)
+
+
+# -------------------------------------------------------------------- PSNR
+
+
+def test_psnr_base():
+    """PSNR in base b scales by ln(10)/ln(b) relative to base 10."""
+    p, t = jnp.asarray(_PREDS), jnp.asarray(_TARGET)
+    base10 = float(peak_signal_noise_ratio(p, t, data_range=1.0))
+    base_e = float(peak_signal_noise_ratio(p, t, data_range=1.0, base=np.e))
+    np.testing.assert_allclose(base_e, base10 * np.log(10), rtol=1e-5)
+    base2 = float(peak_signal_noise_ratio(p, t, data_range=1.0, base=2))
+    np.testing.assert_allclose(base2, base10 * np.log(10) / np.log(2), rtol=1e-5)
+
+
+def test_psnr_vs_numpy():
+    mse = np.mean((_PREDS.astype(np.float64) - _TARGET.astype(np.float64)) ** 2)
+    expected = 10 * np.log10(1.0 / mse)
+    np.testing.assert_allclose(
+        float(peak_signal_noise_ratio(jnp.asarray(_PREDS), jnp.asarray(_TARGET), data_range=1.0)),
+        expected,
+        rtol=1e-5,
+    )

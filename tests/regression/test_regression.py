@@ -318,3 +318,56 @@ def test_cosine_similarity_reductions():
             reference_metric=lambda p, t, agg=agg: agg(_sim(p, t)),
             metric_args={"reduction": reduction}, atol=1e-4,
         )
+
+
+# ---- error paths (ref tests/regression/test_{r2,pearson,spearman,
+# cosine_similarity,explained_variance,mean_error}.py tail sections) ----
+
+
+@pytest.mark.parametrize(
+    "metric_class",
+    [
+        MeanSquaredError, MeanAbsoluteError, MeanSquaredLogError, R2Score,
+        PearsonCorrCoef, SpearmanCorrCoef, ExplainedVariance, CosineSimilarity,
+    ],
+)
+def test_error_on_different_shape(metric_class):
+    metric = metric_class()
+    with pytest.raises(RuntimeError, match="Predictions and targets are expected to have the same shape"):
+        metric(jnp.zeros(100), jnp.zeros(50))
+
+
+@pytest.mark.parametrize("metric_class", [PearsonCorrCoef, SpearmanCorrCoef])
+def test_error_on_multidim_correlation(metric_class):
+    metric = metric_class()
+    with pytest.raises(ValueError, match="1 dimensional tensors"):
+        metric(jnp.zeros((10, 5)), jnp.zeros((10, 5)))
+
+
+def test_r2_error_on_multidim():
+    with pytest.raises(ValueError, match="1D or 2D"):
+        R2Score()(jnp.zeros((10, 20, 5)), jnp.zeros((10, 20, 5)))
+
+
+def test_r2_error_on_too_few_samples():
+    metric = R2Score()
+    with pytest.raises(ValueError, match="Needs at least two samples"):
+        metric(jnp.asarray([1.0]), jnp.asarray([1.0]))
+    metric.reset()
+    # two single-sample updates accumulate to a computable state
+    metric.update(jnp.asarray([1.0]), jnp.asarray([2.0]))
+    metric.update(jnp.asarray([2.0]), jnp.asarray([1.0]))
+    assert np.isfinite(float(metric.compute()))
+
+
+def test_r2_adjusted_warnings():
+    rng = np.random.RandomState(0)
+    with pytest.warns(UserWarning, match="More independent regressions"):
+        R2Score(adjusted=10)(jnp.asarray(rng.randn(10).astype(np.float32)),
+                             jnp.asarray(rng.randn(10).astype(np.float32)))
+    with pytest.warns(UserWarning, match="Division by zero in adjusted r2 score"):
+        R2Score(adjusted=10)(jnp.asarray(rng.randn(11).astype(np.float32)),
+                             jnp.asarray(rng.randn(11).astype(np.float32)))
+    with pytest.raises(ValueError, match="`adjusted` parameter"):
+        R2Score(adjusted=-1)(jnp.asarray(rng.randn(5).astype(np.float32)),
+                             jnp.asarray(rng.randn(5).astype(np.float32)))

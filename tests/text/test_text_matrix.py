@@ -260,7 +260,8 @@ def test_eed_empty():
 
 
 def test_eed_mixed_batch_keeps_valid_sentences():
-    """A reference-less sentence is skipped; the rest still score."""
+    """A reference-less sentence is excluded from the corpus mean but keeps
+    its (NaN) slot so sentence scores stay aligned with preds."""
     solo = float(extended_edit_distance(["hello world"], [["hello word"]]))
     mixed = float(extended_edit_distance(["hello world", "x"], [["hello word"], []]))
     np.testing.assert_allclose(mixed, solo, atol=1e-6)
@@ -268,7 +269,32 @@ def test_eed_mixed_batch_keeps_valid_sentences():
     _, sentences = extended_edit_distance(
         ["hello world", "x"], [["hello word"], []], return_sentence_level_score=True
     )
-    assert len(np.asarray(sentences)) == 1
+    sentences = np.asarray(sentences)
+    assert sentences.shape == (2,)
+    np.testing.assert_allclose(sentences[0], solo, atol=1e-6)
+    assert np.isnan(sentences[1])
+
+
+def test_chrf_empty_reference_list():
+    """A sentence with no references scores 0 and doesn't crash (functional
+    and module paths)."""
+    assert float(chrf_score(["python"], [[]])) == 0.0
+    mixed = chrf_score(["the cat is on the mat", "x"], [["a cat is on the mat"], []])
+    solo = chrf_score(["the cat is on the mat"], [["a cat is on the mat"]])
+    np.testing.assert_allclose(float(mixed), float(solo), atol=1e-6)
+    m = CHRFScore(return_sentence_level_score=True)
+    m.update(["the cat is on the mat", "x"], [["a cat is on the mat"], []])
+    corpus, sentences = m.compute()
+    np.testing.assert_allclose(float(corpus), float(solo), atol=1e-6)
+    assert np.asarray(sentences).shape == (2,) and float(np.asarray(sentences)[1]) == 0.0
+
+
+def test_eed_all_refless_sentence_level():
+    corpus, sentences = extended_edit_distance(
+        ["python"], [[]], return_sentence_level_score=True
+    )
+    assert float(corpus) == 0.0
+    assert np.isnan(np.asarray(sentences)).all()
 
 
 def test_ter_pure_compute_jits():

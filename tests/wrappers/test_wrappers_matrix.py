@@ -77,9 +77,10 @@ def test_bootstrap_exact_oracle(sampling_strategy, metric_fn, sk_fn):
         metric_fn(), num_bootstraps=7, mean=True, std=True, raw=True,
         quantile=jnp.asarray([0.05, 0.95]), sampling_strategy=sampling_strategy,
     )
+    is_mse = isinstance(metric_fn(), MeanSquaredError)
     collected = [([], []) for _ in range(boot.num_bootstraps)]
     for p, t in zip(preds, target):
-        boot.update(jnp.asarray(p, dtype=jnp.float32) if "mse" in repr(metric_fn()) else jnp.asarray(p),
+        boot.update(jnp.asarray(p, dtype=jnp.float32) if is_mse else jnp.asarray(p),
                     jnp.asarray(t))
         for i, (rp, rt) in enumerate(boot.out):
             collected[i][0].append(np.asarray(rp))
