@@ -6,10 +6,13 @@ TPU-first departures:
 * The reference computes the matrix square root with
   ``scipy.linalg.sqrtm`` on host CPU via a custom autograd Function
   (fid.py:60-94) — a device→host→device round trip per compute. Here the
-  FID trace term is computed entirely on device from eigenvalues:
-  ``tr(sqrtm(S1 S2)) = sum(sqrt(eigvals(S1 S2)))`` evaluated via the
-  symmetric product ``sqrt(S1) S2 sqrt(S1)`` — pure jnp, jit-able,
-  differentiable.
+  FID trace term is pure jax with a backend- and jit-aware algorithm
+  (``sqrtm_method``): exact eigh via the symmetric product
+  ``sqrt(S1) S2 sqrt(S1)`` — run on the host CPU backend when the
+  accelerator's sequential eigensolver would take minutes — for eager
+  computes, and an early-stopped coupled Newton–Schulz iteration
+  (matmul-only — tiles onto the MXU; approximate but always finite) as
+  the in-``jit`` accelerator path.
 * The feature extractor is injectable: any callable mapping an image batch
   to ``(N, D)`` features (the reference hardcodes ``torch_fidelity``'s
   InceptionV3, fid.py:27-57). The bundled Flax port of that network is
@@ -35,20 +38,129 @@ def _sym_sqrtm(mat: Array, eps: float = 1e-12) -> Array:
     return (vecs * jnp.sqrt(vals + eps)) @ vecs.T
 
 
-def _trace_sqrtm_product(sigma1: Array, sigma2: Array) -> Array:
-    """tr(sqrtm(sigma1 @ sigma2)) for PSD inputs, fully on device."""
+def _trace_sqrtm_eigh(sigma1: Array, sigma2: Array) -> Array:
+    """tr(sqrtm(sigma1 @ sigma2)) via two symmetric eigendecompositions."""
     s1_half = _sym_sqrtm(sigma1)
     m = s1_half @ sigma2 @ s1_half  # similar to sigma1 @ sigma2, symmetric PSD
     vals = jnp.linalg.eigvalsh(m)
     return jnp.sqrt(jnp.clip(vals, min=0.0)).sum()
 
 
-def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
+def _trace_sqrtm_eigh_host(sigma1: Array, sigma2: Array) -> Array:
+    """Exact eigh path executed on the host CPU jax backend.
+
+    TPU ``eigh`` lowers to a sequential QR-iteration path that takes
+    minutes at FID's 2048² covariances; LAPACK on the host takes seconds.
+    Two 16 MB device→host copies + one scalar back is the whole cost —
+    the same trade the reference makes with its scipy hop
+    (ref image/fid.py:60-94), but staying inside jax.
+    """
+    cpu = jax.local_devices(backend="cpu")[0]
+    val = _trace_sqrtm_eigh(jax.device_put(sigma1, cpu), jax.device_put(sigma2, cpu))
+    return jax.device_put(val, list(sigma1.devices())[0])
+
+
+def _trace_sqrtm_newton_schulz(
+    sigma1: Array, sigma2: Array, max_iters: int = 60, growth: float = 1.2
+) -> Array:
+    """tr(sqrtm(sigma1 @ sigma2)) via the coupled Newton–Schulz iteration.
+
+    Matmul-only, so it runs on the MXU instead of the accelerator's slow
+    sequential eigensolver, and it is the only jit-compatible option on
+    accelerators. The product of two PSD matrices has non-negative real
+    spectrum; after scaling by the Frobenius norm the coupled iteration
+
+        Y_{k+1} = Y_k (3I - Z_k Y_k) / 2,   Z_{k+1} = (3I - Z_k Y_k) Z_k / 2
+
+    converges with Y_k -> sqrtm(A/||A||_F) — but in float32 it converges
+    *then explodes* once rounding noise around near-zero eigenvalues takes
+    over (typical FID covariances are near-singular). The loop therefore
+    monitors the residual ||Z Y - I||_F and freezes the iterate as soon as
+    the residual grows by more than ``growth`` or goes non-finite,
+    returning the last converging iterate's trace. Measured accuracy vs
+    float64 scipy: ~2e-3 relative on well-conditioned covariances, ~1e-2
+    worst-case on rank-deficient ones (tests/image/test_image.py).
+    """
+    a = sigma1 @ sigma2
+    norm = jnp.linalg.norm(a)  # Frobenius
+    norm = jnp.where(norm > 0, norm, 1.0)
+    dim = a.shape[0]
+    eye = jnp.eye(dim, dtype=a.dtype)
+
+    def cond(carry):
+        _, _, _, _, k, done = carry
+        return (k < max_iters) & ~done
+
+    def body(carry):
+        # zy (= z @ y) is carried: the residual's z2 @ y2 is exactly the
+        # next iteration's z @ y, so each step costs 3 matmuls, not 4
+        y, z, zy, prev_res, k, _ = carry
+        t = 0.5 * (3.0 * eye - zy)
+        y2, z2 = y @ t, t @ z
+        zy2 = z2 @ y2
+        res = jnp.linalg.norm(zy2 - eye)
+        diverged = ~jnp.isfinite(res) | (res > growth * prev_res)
+        y3 = jnp.where(diverged, y, y2)
+        z3 = jnp.where(diverged, z, z2)
+        zy3 = jnp.where(diverged, zy, zy2)
+        return y3, z3, zy3, jnp.where(diverged, prev_res, res), k + 1, diverged
+
+    y0 = a / norm
+    init = (y0, eye, y0, jnp.asarray(jnp.inf, a.dtype), jnp.asarray(0), jnp.asarray(False))
+    y, _, _, _, _, _ = jax.lax.while_loop(cond, body, init)
+    return jnp.sqrt(norm) * jnp.trace(y)
+
+
+def _trace_sqrtm_product(sigma1: Array, sigma2: Array, method: Optional[str] = None) -> Array:
+    """tr(sqrtm(sigma1 @ sigma2)), device- and jit-aware.
+
+    ``method=None`` picks the best available algorithm:
+
+    * CPU backend — ``eigh`` in place (LAPACK).
+    * accelerator, eager values — exact ``eigh`` on the host CPU backend
+      (``eigh_host``): robust for the near-singular covariances real FID
+      produces, and seconds instead of the accelerator eigensolver's
+      minutes.
+    * accelerator, traced values (inside ``jit``) — early-stopped
+      Newton–Schulz, the only in-graph option that doesn't hit the
+      accelerator's sequential eigensolver; approximate (see its
+      docstring) but always finite.
+
+    Pass ``"eigh"``, ``"eigh_host"``, or ``"newton_schulz"`` to pin the
+    algorithm regardless of backend.
+    """
+    if method is None:
+        traced = isinstance(sigma1, jax.core.Tracer) or isinstance(sigma2, jax.core.Tracer)
+        if jax.default_backend() == "cpu":
+            method = "eigh"
+        elif traced:
+            method = "newton_schulz"
+        else:
+            method = "eigh_host"
+    if method == "eigh":
+        return _trace_sqrtm_eigh(sigma1, sigma2)
+    if method == "eigh_host":
+        if isinstance(sigma1, jax.core.Tracer) or isinstance(sigma2, jax.core.Tracer):
+            raise ValueError(
+                "`sqrtm_method='eigh_host'` moves values to the host CPU backend and cannot"
+                " run inside `jit`; use 'eigh' or 'newton_schulz' in jitted code"
+            )
+        return _trace_sqrtm_eigh_host(sigma1, sigma2)
+    if method == "newton_schulz":
+        return _trace_sqrtm_newton_schulz(sigma1, sigma2)
+    raise ValueError(
+        f"Expected `sqrtm_method` to be one of ['eigh', 'eigh_host', 'newton_schulz', None] but got {method}"
+    )
+
+
+def _compute_fid(
+    mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, sqrtm_method: Optional[str] = None
+) -> Array:
     """FID from feature means/covariances (semantics of ref fid.py:97-124)."""
     diff = mu1 - mu2
     a = (diff * diff).sum()
     b = jnp.trace(sigma1) + jnp.trace(sigma2)
-    c = _trace_sqrtm_product(sigma1, sigma2)
+    c = _trace_sqrtm_product(sigma1, sigma2, method=sqrtm_method)
     return a + b - 2 * c
 
 
@@ -69,6 +181,12 @@ class FrechetInceptionDistance(Metric):
             features (``feature_extractor=None`` passes inputs through).
         reset_real_features: keep real features across ``reset()`` calls
             (ref fid.py:289).
+        sqrtm_method: ``"eigh"``, ``"eigh_host"``, ``"newton_schulz"``, or
+            ``None`` (default) for automatic selection — exact eigh (on the
+            host CPU backend when the accelerator's own eigensolver would be
+            slow) for eager computes, early-stopped Newton–Schulz
+            (matmul-only, MXU-friendly, approximate) inside ``jit``. See
+            :func:`_trace_sqrtm_product`.
 
     Example (pre-extracted features):
         >>> import jax, jax.numpy as jnp
@@ -89,6 +207,7 @@ class FrechetInceptionDistance(Metric):
         self,
         feature_extractor: Optional[Callable[[Array], Array]] = None,
         reset_real_features: bool = True,
+        sqrtm_method: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -96,6 +215,12 @@ class FrechetInceptionDistance(Metric):
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features
+        if sqrtm_method not in (None, "eigh", "eigh_host", "newton_schulz"):
+            raise ValueError(
+                f"Expected `sqrtm_method` to be one of ['eigh', 'eigh_host', 'newton_schulz', None]"
+                f" but got {sqrtm_method}"
+            )
+        self.sqrtm_method = sqrtm_method
 
         self.add_state("real_features", [], dist_reduce_fx=None)
         self.add_state("fake_features", [], dist_reduce_fx=None)
@@ -116,7 +241,7 @@ class FrechetInceptionDistance(Metric):
         fake_features = dim_zero_cat(self.fake_features)
         mu1, sigma1 = _mean_cov(real_features.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32))
         mu2, sigma2 = _mean_cov(fake_features.astype(mu1.dtype))
-        return _compute_fid(mu1, sigma1, mu2, sigma2)
+        return _compute_fid(mu1, sigma1, mu2, sigma2, sqrtm_method=self.sqrtm_method)
 
     def reset(self) -> None:
         """Optionally preserve real features across resets (ref fid.py:289-296)."""

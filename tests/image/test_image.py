@@ -198,6 +198,75 @@ class TestGenerativeMetrics:
         expected = diff @ diff + np.trace(sigma1) + np.trace(sigma2) - 2 * np.trace(covmean)
         np.testing.assert_allclose(ours, expected, rtol=1e-2)
 
+    @pytest.mark.parametrize("dim,cond", [(16, 1.0), (64, 50.0), (128, 1000.0)])
+    def test_newton_schulz_matches_eigh(self, dim, cond):
+        """The MXU-friendly sqrtm (in-jit TPU path) must agree with eigh/scipy.
+
+        Covariance conditioning is swept because Newton–Schulz convergence
+        degrades with spread spectra — FID-scale feature covariances are
+        covered by the high-cond case.
+        """
+        from scipy import linalg
+
+        from metrics_tpu.image.fid import _trace_sqrtm_eigh, _trace_sqrtm_newton_schulz
+
+        rng = np.random.RandomState(7)
+        def _rand_cov(scale):
+            f = rng.randn(4 * dim, dim) * np.linspace(1.0, scale, dim) ** 0.5
+            return np.cov(f, rowvar=False)
+
+        s1 = jnp.asarray(_rand_cov(cond), dtype=jnp.float32)
+        s2 = jnp.asarray(_rand_cov(cond), dtype=jnp.float32)
+        ns = float(_trace_sqrtm_newton_schulz(s1, s2))
+        eigh = float(_trace_sqrtm_eigh(s1, s2))
+        scipy_val = float(np.trace(linalg.sqrtm(np.asarray(s1, np.float64) @ np.asarray(s2, np.float64)).real))
+        np.testing.assert_allclose(ns, eigh, rtol=2e-3)
+        np.testing.assert_allclose(ns, scipy_val, rtol=2e-3)
+
+    @pytest.mark.parametrize("n,dim", [(100, 256), (600, 512)])
+    def test_newton_schulz_rank_deficient_stays_finite(self, n, dim):
+        """float32 NS converges-then-explodes on the near-singular covariances
+        real FID produces (fewer samples than feature dims); the early-stop
+        residual monitor must freeze the converging iterate instead of
+        returning NaN — under jit too, since that's the in-graph TPU path.
+        """
+        from scipy import linalg
+
+        from metrics_tpu.image.fid import _trace_sqrtm_newton_schulz
+
+        rng = np.random.RandomState(11)
+        f1 = rng.randn(n, dim).astype(np.float32)
+        f2 = (rng.randn(n, dim) * 1.5 + 0.4).astype(np.float32)
+        s1 = jnp.asarray(np.cov(f1, rowvar=False), jnp.float32)
+        s2 = jnp.asarray(np.cov(f2, rowvar=False), jnp.float32)
+        scipy_val = float(np.trace(linalg.sqrtm(np.asarray(s1, np.float64) @ np.asarray(s2, np.float64)).real))
+        for fn in (_trace_sqrtm_newton_schulz, jax.jit(_trace_sqrtm_newton_schulz)):
+            ns = float(fn(s1, s2))
+            assert np.isfinite(ns)
+            np.testing.assert_allclose(ns, scipy_val, rtol=2e-2)
+
+    def test_fid_sqrtm_method_kwarg(self):
+        rng = np.random.RandomState(3)
+        real = rng.randn(128, 8).astype(np.float32)
+        fake = (rng.randn(128, 8) + 0.3).astype(np.float32)
+        vals = {}
+        for method in ("eigh", "eigh_host", "newton_schulz"):
+            fid = FrechetInceptionDistance(sqrtm_method=method)
+            fid.update(jnp.asarray(real), real=True)
+            fid.update(jnp.asarray(fake), real=False)
+            vals[method] = float(fid.compute())
+        np.testing.assert_allclose(vals["eigh"], vals["newton_schulz"], rtol=1e-3)
+        np.testing.assert_allclose(vals["eigh"], vals["eigh_host"], rtol=1e-6)
+        with pytest.raises(ValueError, match="sqrtm_method"):
+            FrechetInceptionDistance(sqrtm_method="cholesky")
+
+    def test_sqrtm_eigh_host_rejects_tracers(self):
+        from metrics_tpu.image.fid import _trace_sqrtm_product
+
+        s = jnp.eye(4)
+        with pytest.raises(ValueError, match="eigh_host"):
+            jax.jit(lambda a, b: _trace_sqrtm_product(a, b, method="eigh_host"))(s, s)
+
     def test_fid_reset_real(self):
         fid = FrechetInceptionDistance(reset_real_features=False)
         fid.update(jnp.asarray(np.random.randn(8, 4), dtype=jnp.float32), real=True)
