@@ -22,14 +22,22 @@ def _tpu_smoke_only_invocation(config) -> bool:
     return bool(args) and all("tpu_smoke" in a for a in args)
 
 
+NUM_DEVICES = 8
+
+
 def pytest_configure(config):
     if _tpu_smoke_only_invocation(config):
         return
-    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={NUM_DEVICES}"
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses tests may spawn
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    assert jax.device_count() == NUM_DEVICES, f"expected {NUM_DEVICES} forced host devices, got {jax.devices()}"
     # Persistent compilation cache: the suite is compile-dominated on this
     # single-core image (dozens of shard_map programs at 4-13 s each), so
     # warm reruns drop from ~20 min to well under 10 (VERDICT r1 item 10).

@@ -1,21 +1,16 @@
-"""Test configuration: force an 8-virtual-device CPU platform.
+"""Test fixtures shared by the whole suite.
 
-Translation of the reference's Pool+gloo multi-process trick
-(/root/reference/tests/helpers/testers.py:47-59): instead of spawning
-processes, we ask XLA for 8 host devices in one process and test the
-distributed paths with real collectives over a ``jax.sharding.Mesh``.
-Must run before jax initializes its backends.
+Backend pinning (8 forced host CPU devices — the translation of the
+reference's Pool+gloo multi-process trick, /root/reference/tests/helpers/
+testers.py:47-59) lives in the REPO-ROOT ``conftest.py``: pytest loads it
+for every repo-internal invocation, including dedicated
+``tests/tpu_smoke`` runs, which it deliberately leaves unpinned on the
+ambient accelerator. Keeping a second pinning copy here is exactly the
+bug the first real-chip smoke run caught — import-time pinning in this
+file applied to smoke runs too, so every on-device placement assert saw
+8 forced host CPUs.
 """
-import os
-
-os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-
-import pytest  # noqa: E402
+import pytest
 
 
 @pytest.fixture(autouse=True)
@@ -24,10 +19,3 @@ def _seeded():
 
     np.random.seed(42)
     yield
-
-
-NUM_DEVICES = 8
-
-
-def pytest_configure(config):
-    assert jax.device_count() == NUM_DEVICES, f"expected {NUM_DEVICES} forced host devices, got {jax.devices()}"

@@ -23,7 +23,10 @@ def _assert_on_accelerator(x) -> None:
     leaf = jax.tree_util.tree_leaves(x)[0]
     platform = next(iter(leaf.devices())).platform
     if _EXPECT_ACCELERATOR:
-        assert platform != "cpu", f"state landed on {platform}, expected the TPU backend"
+        assert platform != "cpu", (
+            f"state landed on {platform}, expected the TPU backend"
+            f" (default_backend={jax.default_backend()}, devices={jax.devices()})"
+        )
 
 
 @pytest.fixture(scope="module")
@@ -167,3 +170,31 @@ def test_pallas_binned_matches_xla_on_device():
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)), xla, pal
     )
+
+
+def test_fid_sqrtm_paths_on_device():
+    """FID's two accelerator sqrtm paths on the real chip: eager compute
+    routes to the host-CPU eigh (exact), jitted Newton–Schulz stays
+    in-graph on the MXU — both must be finite and agree on the
+    near-singular covariances real FID produces (n < feature dim)."""
+    from metrics_tpu.image.fid import FrechetInceptionDistance, _trace_sqrtm_newton_schulz
+
+    n, dim = 200, 256
+    real = RNG.randn(n, dim).astype(np.float32)
+    fake = (RNG.randn(n, dim) * 1.3 + 0.4).astype(np.float32)
+
+    fid = FrechetInceptionDistance()
+    fid.update(jnp.asarray(real), real=True)
+    fid.update(jnp.asarray(fake), real=False)
+    eager = float(fid.compute())  # auto -> eigh on host CPU backend
+    assert np.isfinite(eager)
+
+    s1 = jnp.asarray(np.cov(real, rowvar=False), jnp.float32)
+    s2 = jnp.asarray(np.cov(fake, rowvar=False), jnp.float32)
+    ns = float(jax.jit(_trace_sqrtm_newton_schulz)(s1, s2))
+    assert np.isfinite(ns)
+
+    mu1, mu2 = real.mean(0), fake.mean(0)
+    diff = mu1 - mu2
+    fid_ns = float(diff @ diff + np.trace(np.asarray(s1)) + np.trace(np.asarray(s2)) - 2 * ns)
+    np.testing.assert_allclose(eager, fid_ns, rtol=2e-2)
