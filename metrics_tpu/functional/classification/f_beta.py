@@ -121,6 +121,7 @@ def fbeta_score(
 def f1_score(
     preds: Array,
     target: Array,
+    beta: float = 1.0,
     average: Optional[str] = "micro",
     mdmc_average: Optional[str] = None,
     ignore_index: Optional[int] = None,
@@ -131,6 +132,13 @@ def f1_score(
 ) -> Array:
     """F1 score = F-beta with beta=1 (ref f_beta.py:234-354).
 
+    ``beta`` is accepted in the reference's positional slot but ignored —
+    exactly like the reference, whose ``f1_score`` hardcodes ``1.0`` when
+    delegating to ``fbeta_score`` (ref f_beta.py:352-354) — so migrated
+    positional call sites keep their meaning. Non-numeric values raise, so
+    a pre-slot call site like ``f1_score(preds, target, "macro")`` fails
+    loudly instead of silently computing the micro average.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu.functional import f1_score
@@ -139,4 +147,9 @@ def f1_score(
         >>> round(float(f1_score(preds, target)), 4)
         0.3333
     """
+    if not isinstance(beta, (int, float)) or isinstance(beta, bool):
+        raise ValueError(
+            f"Expected argument `beta` to be a float but got {beta!r} — note `f1_score` ignores `beta`"
+            f" (it is fixed to 1.0); pass `average`/`num_classes` by keyword"
+        )
     return fbeta_score(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)

@@ -1,0 +1,230 @@
+"""Signature-level parity against the reference API (recorded snapshot).
+
+``test_parity.py`` pins the export *names*; this module pins the *call
+signatures*. The tables below are a recorded snapshot of
+``inspect.signature`` over every public functional (ref
+functional/__init__.py:14-168) and every module-class ``__init__`` (ref
+__init__.py:14-190) of the reference, parameter names in positional
+order (``self``/``*args``/``**kwargs`` and the deprecated
+``compute_on_step`` excluded).
+
+Two guarantees, per name:
+
+1. every reference parameter exists here too (keyword-migration safety),
+2. the shared parameters appear in the same positional order
+   (positional-call-migration safety).
+
+Known, documented exception: ``bert_score``/``BERTScore`` replace the
+reference's torch-loop embedding stack (model/device/num_threads/...)
+with an injectable Flax embedder — see metrics_tpu/functional/text/bert.py.
+"""
+import inspect
+
+import pytest
+
+import metrics_tpu
+import metrics_tpu.functional
+
+# names whose embedding-stack parameters were deliberately redesigned
+SIGNATURE_EXCEPTIONS = {"bert_score", "BERTScore"}
+
+REFERENCE_FUNCTIONAL_PARAMS = {
+    'accuracy': ['preds', 'target', 'average', 'mdmc_average', 'threshold', 'top_k', 'subset_accuracy', 'num_classes', 'multiclass', 'ignore_index'],
+    'auc': ['x', 'y', 'reorder'],
+    'auroc': ['preds', 'target', 'num_classes', 'pos_label', 'average', 'max_fpr', 'sample_weights'],
+    'average_precision': ['preds', 'target', 'num_classes', 'pos_label', 'average', 'sample_weights'],
+    'bert_score': ['preds', 'target', 'model_name_or_path', 'num_layers', 'all_layers', 'model', 'user_tokenizer', 'user_forward_fn', 'verbose', 'idf', 'device', 'max_length', 'batch_size', 'num_threads', 'return_hash', 'lang', 'rescale_with_baseline', 'baseline_path', 'baseline_url'],
+    'bleu_score': ['preds', 'target', 'n_gram', 'smooth'],
+    'calibration_error': ['preds', 'target', 'n_bins', 'norm'],
+    'char_error_rate': ['preds', 'target'],
+    'chrf_score': ['preds', 'target', 'n_char_order', 'n_word_order', 'beta', 'lowercase', 'whitespace', 'return_sentence_level_score'],
+    'cohen_kappa': ['preds', 'target', 'num_classes', 'weights', 'threshold'],
+    'confusion_matrix': ['preds', 'target', 'num_classes', 'normalize', 'threshold', 'multilabel'],
+    'cosine_similarity': ['preds', 'target', 'reduction'],
+    'coverage_error': ['preds', 'target', 'sample_weight'],
+    'dice_score': ['preds', 'target', 'bg', 'nan_score', 'no_fg_score', 'reduction'],
+    'error_relative_global_dimensionless_synthesis': ['preds', 'target', 'ratio', 'reduction'],
+    'explained_variance': ['preds', 'target', 'multioutput'],
+    'extended_edit_distance': ['preds', 'target', 'language', 'return_sentence_level_score', 'alpha', 'rho', 'deletion', 'insertion'],
+    'f1_score': ['preds', 'target', 'beta', 'average', 'mdmc_average', 'ignore_index', 'num_classes', 'threshold', 'top_k', 'multiclass'],
+    'fbeta_score': ['preds', 'target', 'beta', 'average', 'mdmc_average', 'ignore_index', 'num_classes', 'threshold', 'top_k', 'multiclass'],
+    'hamming_distance': ['preds', 'target', 'threshold'],
+    'hinge_loss': ['preds', 'target', 'squared', 'multiclass_mode'],
+    'image_gradients': ['img'],
+    'jaccard_index': ['preds', 'target', 'num_classes', 'ignore_index', 'absent_score', 'threshold', 'reduction'],
+    'kl_divergence': ['p', 'q', 'log_prob', 'reduction'],
+    'label_ranking_average_precision': ['preds', 'target', 'sample_weight'],
+    'label_ranking_loss': ['preds', 'target', 'sample_weight'],
+    'match_error_rate': ['preds', 'target'],
+    'matthews_corrcoef': ['preds', 'target', 'num_classes', 'threshold'],
+    'mean_absolute_error': ['preds', 'target'],
+    'mean_absolute_percentage_error': ['preds', 'target'],
+    'mean_squared_error': ['preds', 'target', 'squared'],
+    'mean_squared_log_error': ['preds', 'target'],
+    'multiscale_structural_similarity_index_measure': ['preds', 'target', 'gaussian_kernel', 'sigma', 'kernel_size', 'reduction', 'data_range', 'k1', 'k2', 'betas', 'normalize'],
+    'pairwise_cosine_similarity': ['x', 'y', 'reduction', 'zero_diagonal'],
+    'pairwise_euclidean_distance': ['x', 'y', 'reduction', 'zero_diagonal'],
+    'pairwise_linear_similarity': ['x', 'y', 'reduction', 'zero_diagonal'],
+    'pairwise_manhattan_distance': ['x', 'y', 'reduction', 'zero_diagonal'],
+    'peak_signal_noise_ratio': ['preds', 'target', 'data_range', 'base', 'reduction', 'dim'],
+    'pearson_corrcoef': ['preds', 'target'],
+    'permutation_invariant_training': ['preds', 'target', 'metric_func', 'eval_func'],
+    'pit_permutate': ['preds', 'perm'],
+    'precision': ['preds', 'target', 'average', 'mdmc_average', 'ignore_index', 'num_classes', 'threshold', 'top_k', 'multiclass'],
+    'precision_recall': ['preds', 'target', 'average', 'mdmc_average', 'ignore_index', 'num_classes', 'threshold', 'top_k', 'multiclass'],
+    'precision_recall_curve': ['preds', 'target', 'num_classes', 'pos_label', 'sample_weights'],
+    'r2_score': ['preds', 'target', 'adjusted', 'multioutput'],
+    'recall': ['preds', 'target', 'average', 'mdmc_average', 'ignore_index', 'num_classes', 'threshold', 'top_k', 'multiclass'],
+    'retrieval_average_precision': ['preds', 'target'],
+    'retrieval_fall_out': ['preds', 'target', 'k'],
+    'retrieval_hit_rate': ['preds', 'target', 'k'],
+    'retrieval_normalized_dcg': ['preds', 'target', 'k'],
+    'retrieval_precision': ['preds', 'target', 'k', 'adaptive_k'],
+    'retrieval_r_precision': ['preds', 'target'],
+    'retrieval_recall': ['preds', 'target', 'k'],
+    'retrieval_reciprocal_rank': ['preds', 'target'],
+    'roc': ['preds', 'target', 'num_classes', 'pos_label', 'sample_weights'],
+    'rouge_score': ['preds', 'target', 'accumulate', 'use_stemmer', 'normalizer', 'tokenizer', 'rouge_keys'],
+    'sacre_bleu_score': ['preds', 'target', 'n_gram', 'smooth', 'tokenize', 'lowercase'],
+    'scale_invariant_signal_distortion_ratio': ['preds', 'target', 'zero_mean'],
+    'scale_invariant_signal_noise_ratio': ['preds', 'target'],
+    'signal_distortion_ratio': ['preds', 'target', 'use_cg_iter', 'filter_length', 'zero_mean', 'load_diag'],
+    'signal_noise_ratio': ['preds', 'target', 'zero_mean'],
+    'spearman_corrcoef': ['preds', 'target'],
+    'specificity': ['preds', 'target', 'average', 'mdmc_average', 'ignore_index', 'num_classes', 'threshold', 'top_k', 'multiclass'],
+    'spectral_angle_mapper': ['preds', 'target', 'reduction'],
+    'spectral_distortion_index': ['preds', 'target', 'p', 'reduction'],
+    'squad': ['preds', 'target'],
+    'stat_scores': ['preds', 'target', 'reduce', 'mdmc_reduce', 'num_classes', 'top_k', 'threshold', 'multiclass', 'ignore_index'],
+    'structural_similarity_index_measure': ['preds', 'target', 'gaussian_kernel', 'sigma', 'kernel_size', 'reduction', 'data_range', 'k1', 'k2', 'return_full_image', 'return_contrast_sensitivity'],
+    'symmetric_mean_absolute_percentage_error': ['preds', 'target'],
+    'translation_edit_rate': ['preds', 'target', 'normalize', 'no_punctuation', 'lowercase', 'asian_support', 'return_sentence_level_score'],
+    'tweedie_deviance_score': ['preds', 'targets', 'power'],
+    'universal_image_quality_index': ['preds', 'target', 'kernel_size', 'sigma', 'reduction', 'data_range'],
+    'weighted_mean_absolute_percentage_error': ['preds', 'target'],
+    'word_error_rate': ['preds', 'target'],
+    'word_information_lost': ['preds', 'target'],
+    'word_information_preserved': ['preds', 'target'],
+}
+
+REFERENCE_CLASS_INIT_PARAMS = {
+    'AUC': ['reorder'],
+    'AUROC': ['num_classes', 'pos_label', 'average', 'max_fpr'],
+    'Accuracy': ['threshold', 'num_classes', 'average', 'mdmc_average', 'ignore_index', 'top_k', 'multiclass', 'subset_accuracy'],
+    'AveragePrecision': ['num_classes', 'pos_label', 'average'],
+    'BLEUScore': ['n_gram', 'smooth'],
+    'BinnedAveragePrecision': ['num_classes', 'thresholds'],
+    'BinnedPrecisionRecallCurve': ['num_classes', 'thresholds'],
+    'BinnedRecallAtFixedPrecision': ['num_classes', 'min_precision', 'thresholds'],
+    'BootStrapper': ['base_metric', 'num_bootstraps', 'mean', 'std', 'quantile', 'raw', 'sampling_strategy'],
+    'CHRFScore': ['n_char_order', 'n_word_order', 'beta', 'lowercase', 'whitespace', 'return_sentence_level_score'],
+    'CalibrationError': ['n_bins', 'norm'],
+    'CatMetric': ['nan_strategy'],
+    'CharErrorRate': [],
+    'ClasswiseWrapper': ['metric', 'labels'],
+    'CohenKappa': ['num_classes', 'weights', 'threshold'],
+    'ConfusionMatrix': ['num_classes', 'normalize', 'threshold', 'multilabel'],
+    'CosineSimilarity': ['reduction'],
+    'CoverageError': [],
+    'ErrorRelativeGlobalDimensionlessSynthesis': ['ratio', 'reduction'],
+    'ExplainedVariance': ['multioutput'],
+    'ExtendedEditDistance': ['language', 'return_sentence_level_score', 'alpha', 'rho', 'deletion', 'insertion'],
+    'F1Score': ['num_classes', 'threshold', 'average', 'mdmc_average', 'ignore_index', 'top_k', 'multiclass'],
+    'FBetaScore': ['num_classes', 'beta', 'threshold', 'average', 'mdmc_average', 'ignore_index', 'top_k', 'multiclass'],
+    'HammingDistance': ['threshold'],
+    'HingeLoss': ['squared', 'multiclass_mode'],
+    'JaccardIndex': ['num_classes', 'ignore_index', 'absent_score', 'threshold', 'multilabel', 'reduction'],
+    'KLDivergence': ['log_prob', 'reduction'],
+    'LabelRankingAveragePrecision': [],
+    'LabelRankingLoss': [],
+    'MatchErrorRate': [],
+    'MatthewsCorrCoef': ['num_classes', 'threshold'],
+    'MaxMetric': ['nan_strategy'],
+    'MeanAbsoluteError': [],
+    'MeanAbsolutePercentageError': [],
+    'MeanMetric': ['nan_strategy'],
+    'MeanSquaredError': ['squared'],
+    'MeanSquaredLogError': [],
+    'Metric': [],
+    'MetricCollection': ['metrics', 'additional_metrics', 'prefix', 'postfix', 'compute_groups'],
+    'MetricTracker': ['metric', 'maximize'],
+    'MinMaxMetric': ['base_metric'],
+    'MinMetric': ['nan_strategy'],
+    'MultiScaleStructuralSimilarityIndexMeasure': ['gaussian_kernel', 'kernel_size', 'sigma', 'reduction', 'data_range', 'k1', 'k2', 'betas', 'normalize'],
+    'MultioutputWrapper': ['base_metric', 'num_outputs', 'output_dim', 'remove_nans', 'squeeze_outputs'],
+    'PeakSignalNoiseRatio': ['data_range', 'base', 'reduction', 'dim'],
+    'PearsonCorrCoef': [],
+    'PermutationInvariantTraining': ['metric_func', 'eval_func'],
+    'Precision': ['num_classes', 'threshold', 'average', 'mdmc_average', 'ignore_index', 'top_k', 'multiclass'],
+    'PrecisionRecallCurve': ['num_classes', 'pos_label'],
+    'R2Score': ['num_outputs', 'adjusted', 'multioutput'],
+    'ROC': ['num_classes', 'pos_label'],
+    'Recall': ['num_classes', 'threshold', 'average', 'mdmc_average', 'ignore_index', 'top_k', 'multiclass'],
+    'RetrievalFallOut': ['empty_target_action', 'ignore_index', 'k'],
+    'RetrievalHitRate': ['empty_target_action', 'ignore_index', 'k'],
+    'RetrievalMAP': ['empty_target_action', 'ignore_index'],
+    'RetrievalMRR': ['empty_target_action', 'ignore_index'],
+    'RetrievalNormalizedDCG': ['empty_target_action', 'ignore_index', 'k'],
+    'RetrievalPrecision': ['empty_target_action', 'ignore_index', 'k', 'adaptive_k'],
+    'RetrievalRPrecision': ['empty_target_action', 'ignore_index'],
+    'RetrievalRecall': ['empty_target_action', 'ignore_index', 'k'],
+    'SQuAD': [],
+    'SacreBLEUScore': ['n_gram', 'smooth', 'tokenize', 'lowercase'],
+    'ScaleInvariantSignalDistortionRatio': ['zero_mean'],
+    'ScaleInvariantSignalNoiseRatio': [],
+    'SignalDistortionRatio': ['use_cg_iter', 'filter_length', 'zero_mean', 'load_diag'],
+    'SignalNoiseRatio': ['zero_mean'],
+    'SpearmanCorrCoef': [],
+    'Specificity': ['num_classes', 'threshold', 'average', 'mdmc_average', 'ignore_index', 'top_k', 'multiclass'],
+    'SpectralAngleMapper': ['reduction'],
+    'SpectralDistortionIndex': ['p', 'reduction'],
+    'StatScores': ['threshold', 'top_k', 'reduce', 'num_classes', 'ignore_index', 'mdmc_reduce', 'multiclass'],
+    'StructuralSimilarityIndexMeasure': ['gaussian_kernel', 'sigma', 'kernel_size', 'reduction', 'data_range', 'k1', 'k2', 'return_full_image', 'return_contrast_sensitivity'],
+    'SumMetric': ['nan_strategy'],
+    'SymmetricMeanAbsolutePercentageError': [],
+    'TranslationEditRate': ['normalize', 'no_punctuation', 'lowercase', 'asian_support', 'return_sentence_level_score'],
+    'TweedieDevianceScore': ['power'],
+    'UniversalImageQualityIndex': ['kernel_size', 'sigma', 'reduction', 'data_range'],
+    'WeightedMeanAbsolutePercentageError': [],
+    'WordErrorRate': [],
+    'WordInfoLost': [],
+    'WordInfoPreserved': [],
+}
+
+
+def _params(obj, *, init=False):
+    fn = obj.__init__ if init else obj
+    return [
+        p for p in inspect.signature(fn).parameters
+        if p not in ("self", "kwargs", "args", "compute_on_step")
+    ]
+
+
+@pytest.mark.parametrize("name", sorted(REFERENCE_FUNCTIONAL_PARAMS))
+def test_functional_signature_parity(name):
+    if name in SIGNATURE_EXCEPTIONS:
+        pytest.skip("documented embedding-stack redesign")
+    fn = getattr(metrics_tpu.functional, name)
+    ref_ps, my_ps = REFERENCE_FUNCTIONAL_PARAMS[name], _params(fn)
+    missing = [p for p in ref_ps if p not in my_ps]
+    assert not missing, f"{name} is missing reference parameters {missing}"
+    shared_ref = [p for p in ref_ps if p in my_ps]
+    shared_my = [p for p in my_ps if p in ref_ps]
+    assert shared_ref == shared_my, (
+        f"{name} positional order diverges: ref {shared_ref} vs {shared_my}"
+    )
+
+
+@pytest.mark.parametrize("name", sorted(REFERENCE_CLASS_INIT_PARAMS))
+def test_class_init_signature_parity(name):
+    if name in SIGNATURE_EXCEPTIONS:
+        pytest.skip("documented embedding-stack redesign")
+    cls = getattr(metrics_tpu, name)
+    ref_ps, my_ps = REFERENCE_CLASS_INIT_PARAMS[name], _params(cls, init=True)
+    missing = [p for p in ref_ps if p not in my_ps]
+    assert not missing, f"{name}.__init__ is missing reference parameters {missing}"
+    shared_ref = [p for p in ref_ps if p in my_ps]
+    shared_my = [p for p in my_ps if p in ref_ps]
+    assert shared_ref == shared_my, (
+        f"{name}.__init__ positional order diverges: ref {shared_ref} vs {shared_my}"
+    )

@@ -153,3 +153,21 @@ def test_f1_mdmc(mdmc_average):
         metric_args={"average": "macro", "num_classes": NUM_CLASSES, "mdmc_average": mdmc_average},
         atol=1e-5,
     )
+
+
+def test_f1_score_beta_slot_guards_positional_misuse():
+    """`beta` occupies the reference's (ignored) third positional slot; a
+    string there means a pre-slot call site passing `average` positionally —
+    fail loudly instead of silently computing the micro average."""
+    import jax.numpy as jnp
+    import pytest
+
+    from metrics_tpu.functional import f1_score
+
+    preds = jnp.asarray([0, 1, 1])
+    target = jnp.asarray([0, 1, 0])
+    np.testing.assert_allclose(
+        np.asarray(f1_score(preds, target, 1.0)), np.asarray(f1_score(preds, target))
+    )
+    with pytest.raises(ValueError, match="ignores `beta`"):
+        f1_score(preds, target, "macro")
