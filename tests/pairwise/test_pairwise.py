@@ -61,3 +61,53 @@ def test_pairwise_jit():
     )
 
 
+@pytest.mark.parametrize("tpu_fn,sk_fn", CASES)
+def test_pairwise_x_only_keep_diagonal(tpu_fn, sk_fn):
+    """Explicit zero_diagonal=False overrides the x-only default (ref helpers.py:19-43).
+
+    The euclidean diagonal is only *near* zero under zero_diagonal=False: like
+    the reference (euclidean.py:33-38) the distance uses the ||x||²+||y||²-2x·y
+    quadratic form, whose float32 cancellation noise on the diagonal survives
+    the sqrt (sklearn instead hard-zeroes the x-vs-x diagonal). Off-diagonal
+    entries must match sklearn exactly; the diagonal to sqrt(eps) tolerance.
+    """
+    res = np.asarray(tpu_fn(jnp.asarray(_x), zero_diagonal=False))
+    expected = sk_fn(_x, _x)
+    off_diag = ~np.eye(len(_x), dtype=bool)
+    np.testing.assert_allclose(res[off_diag], expected[off_diag], atol=1e-5)
+    np.testing.assert_allclose(np.diag(res), np.diag(expected), atol=0.1)
+
+
+@pytest.mark.parametrize("tpu_fn,sk_fn", CASES)
+def test_pairwise_xy_zero_diagonal(tpu_fn, sk_fn):
+    """zero_diagonal applies to the square upper-left block even with distinct y."""
+    res = tpu_fn(jnp.asarray(_x), jnp.asarray(_y), zero_diagonal=True)
+    expected = sk_fn(_x, _y)
+    np.fill_diagonal(expected, 0)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("tpu_fn,sk_fn", CASES)
+def test_pairwise_bf16(tpu_fn, sk_fn):
+    """Reduced-precision inputs follow the same path (ref run_precision_test_cpu)."""
+    res = tpu_fn(jnp.asarray(_x, jnp.bfloat16), jnp.asarray(_y, jnp.bfloat16))
+    assert res.shape == (_x.shape[0], _y.shape[0])
+    np.testing.assert_allclose(np.asarray(res, np.float64), sk_fn(_x, _y), atol=0.2)
+
+
+@pytest.mark.parametrize("tpu_fn,sk_fn", CASES, ids=lambda v: getattr(v, "__name__", ""))
+def test_pairwise_error_on_wrong_shapes(tpu_fn, sk_fn):
+    """Port of ref test_pairwise_distance.py:109-121."""
+    with pytest.raises(ValueError, match="Expected argument `x`"):
+        tpu_fn(jnp.ones((10,)))
+    with pytest.raises(ValueError, match="Expected argument `y`"):
+        tpu_fn(jnp.ones((10, 5)), jnp.ones((10, 3)))
+    with pytest.raises(ValueError, match="Expected reduction"):
+        tpu_fn(jnp.ones((10, 5)), reduction="abc")
+
+
+def test_pairwise_reduction_none_is_identity():
+    full = pairwise_manhattan_distance(jnp.asarray(_x), jnp.asarray(_y), reduction=None)
+    np.testing.assert_allclose(np.asarray(full), sk_manhattan(_x, _y), atol=1e-5)
+
+

@@ -112,7 +112,7 @@ def test_rmse():
     )
 
 
-@pytest.mark.parametrize("power", [1.0, 2.0, 1.5, 3.0])
+@pytest.mark.parametrize("power", [-0.5, 1.0, 2.0, 1.5, 3.0])
 def test_tweedie_powers(power):
     preds = np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) + 0.1
     target = np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) + 0.1
@@ -217,6 +217,38 @@ def test_spearman():
     )
     MetricTester().run_functional_metric_test(
         _preds, _target, metric_functional=spearman_corrcoef, reference_metric=_sk, atol=1e-4
+    )
+
+
+_preds_gauss = np.random.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_target_gauss = (0.5 * _preds_gauss + np.random.randn(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "metric_class,metric_fn,sk_fn",
+    [
+        (PearsonCorrCoef, pearson_corrcoef,
+         lambda p, t: pearsonr(np.asarray(t, np.float64).reshape(-1), np.asarray(p, np.float64).reshape(-1))[0]),
+        (SpearmanCorrCoef, spearman_corrcoef,
+         lambda p, t: spearmanr(np.asarray(t, np.float64).reshape(-1), np.asarray(p, np.float64).reshape(-1))[0]),
+        (ExplainedVariance, explained_variance, _ref(sk_explained_variance)),
+        (R2Score, r2_score, _ref(sk_r2)),
+    ],
+    ids=["pearson", "spearman", "explained_variance", "r2"],
+)
+def test_correlation_family_gaussian_fixture(metric_class, metric_fn, sk_fn):
+    """Negative-valued, correlated gaussian inputs (ref _single_target_inputs2 axis).
+
+    The uniform [0, 1) fixtures never exercise sign handling in the streaming
+    moment accumulators; the reference runs every correlation-family metric
+    over a second randn fixture for exactly this reason.
+    """
+    MetricTester().run_class_metric_test(
+        preds=_preds_gauss, target=_target_gauss, metric_class=metric_class,
+        reference_metric=sk_fn, atol=1e-4,
+    )
+    MetricTester().run_functional_metric_test(
+        _preds_gauss, _target_gauss, metric_functional=metric_fn, reference_metric=sk_fn, atol=1e-4
     )
 
 

@@ -386,3 +386,19 @@ def test_squad_input_validation():
         squad([{"wrong_key": "x", "id": "1"}], [{"answers": {"text": ["x"]}, "id": "1"}])
     with pytest.raises(KeyError):
         squad([{"prediction_text": "x", "id": "1"}], [{"no_answers": {}, "id": "1"}])
+
+
+@pytest.mark.parametrize("asian_support", [False, True])
+@pytest.mark.parametrize("normalize", [False, True])
+def test_ter_asian_support_vs_sacrebleu(asian_support, normalize):
+    """CJK tokenization axis of TER (ref functional/text/ter.py tercom port)."""
+    from sacrebleu.metrics import TER as SBTER
+
+    preds = ["猫はマットの上に座った", "hello 世界 again"]
+    targets = [["猫がマットの上に座っていた"], ["hello 世界 my friend"]]
+    sb = SBTER(asian_support=asian_support, normalized=normalize)
+    expected = sb.corpus_score(preds, list(map(list, zip(*targets)))).score / 100.0
+    ours = float(translation_edit_rate(
+        preds, targets, asian_support=asian_support, normalize=normalize
+    ))
+    np.testing.assert_allclose(ours, expected, atol=1e-4)
