@@ -99,8 +99,9 @@ def _ssim_compute(
         # gaussian window). Fail loudly instead.
         raise ValueError(
             f"The effective SSIM window {used_kernel_size} cannot exceed the"
-            f" spatial dimensions {tuple(spatial)}; reduce `sigma`/"
-            f"`kernel_size` or use fewer `betas` scales."
+            f" spatial dimensions {tuple(spatial)}; reduce `sigma` or"
+            f" `kernel_size` (for multi-scale SSIM, each `betas` scale"
+            f" halves the spatial dimensions, so fewer scales also help)."
         )
     preds_p = _reflection_pad(preds, pads)
     target_p = _reflection_pad(target, pads)
