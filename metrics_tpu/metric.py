@@ -635,7 +635,16 @@ class Metric(ABC):
         return {
             k: v
             for k, v in self.__dict__.items()
-            if k not in ("update", "compute", "_update_impl", "_compute_impl", "_update_signature", "_jitted_update")
+            if k
+            not in (
+                "update",
+                "compute",
+                "_update_impl",
+                "_compute_impl",
+                "_update_signature",
+                "_jitted_update",
+                "_batched_compute_jit",
+            )
         }
 
     def __setstate__(self, state: Dict[str, Any]) -> None:

@@ -109,6 +109,57 @@ class RetrievalMetric(Metric, ABC):
         """Queries considered 'empty' — no positive target by default."""
         return ((padded_target > 0) & valid).sum(axis=1) == 0
 
+    def _fold_static_key(self) -> tuple:
+        """Every static instance attribute the traced compute reads.
+
+        Keys the per-instance jit cache in :meth:`_folded_compute_fn` so
+        mutating these after a compute picks up a freshly traced program.
+        Subclasses whose ``_metric_batched`` reads additional attributes
+        must extend this tuple.
+        """
+        return (self.empty_target_action, getattr(self, "k", None), getattr(self, "adaptive_k", None))
+
+    def _folded_compute_fn(self):
+        """One jitted program: per-query scores + empty-action folding.
+
+        Device-side scoring runs as a SINGLE dispatch per padded shape —
+        the eager form paid ~20 per-op dispatches per compute, which
+        dominates on high-latency device links (tunneled TPU). Lazily
+        built and cached per instance keyed on :meth:`_fold_static_key`;
+        dropped on pickle (see ``Metric.__getstate__``) and rebuilt on
+        demand.
+        """
+        key = self._fold_static_key()
+        cache = self.__dict__.get("_batched_compute_jit")
+        if cache is not None and cache[0] == key:
+            return cache[1]
+        action = self.empty_target_action  # static at trace time
+
+        def _folded(padded_preds: Array, padded_target: Array, valid: Array):
+            scores = self._metric_batched(padded_preds, padded_target, valid)  # (Q,)
+            empty = self._empty_query_mask(padded_target, valid)
+            if action == "pos":
+                scores = jnp.where(empty, 1.0, scores)
+            elif action == "neg":
+                scores = jnp.where(empty, 0.0, scores)
+            elif action == "skip":
+                kept = ~empty
+                n_kept = kept.sum()
+                folded = jnp.where(
+                    n_kept > 0, jnp.where(kept, scores, 0.0).sum() / jnp.maximum(n_kept, 1), 0.0
+                )
+                return folded, empty.any()
+            result = scores.mean() if scores.size else jnp.asarray(0.0)
+            return result, empty.any()
+
+        # the default _metric_batched is a documented host-loop fallback over
+        # `_metric` (third-party subclasses may implement only that) — it
+        # cannot be traced, so such subclasses keep the eager path
+        if type(self)._metric_batched is not RetrievalMetric._metric_batched:
+            _folded = jax.jit(_folded)
+        object.__setattr__(self, "_batched_compute_jit", (key, _folded))
+        return _folded
+
     def compute(self) -> Array:
         """Batched multi-query evaluation (semantics of ref base.py:113-143)."""
         indexes = dim_zero_cat(self.indexes)
@@ -116,20 +167,10 @@ class RetrievalMetric(Metric, ABC):
         target = dim_zero_cat(self.target)
 
         padded_preds, padded_target, valid = _pad_by_query(indexes, preds, target)
-        scores = self._metric_batched(padded_preds, padded_target, valid)  # (Q,)
-
-        empty = self._empty_query_mask(padded_target, valid)
-        if self.empty_target_action == "error" and bool(empty.any()):
+        result, any_empty = self._folded_compute_fn()(padded_preds, padded_target, valid)
+        if self.empty_target_action == "error" and bool(any_empty):
             raise ValueError("`compute` method was provided with a query with no positive target.")
-        if self.empty_target_action == "pos":
-            scores = jnp.where(empty, 1.0, scores)
-        elif self.empty_target_action == "neg":
-            scores = jnp.where(empty, 0.0, scores)
-        elif self.empty_target_action == "skip":
-            kept = ~empty
-            n_kept = kept.sum()
-            return jnp.where(n_kept > 0, jnp.where(kept, scores, 0.0).sum() / jnp.maximum(n_kept, 1), 0.0)
-        return scores.mean() if scores.size else jnp.asarray(0.0)
+        return result
 
     @abstractmethod
     def _metric(self, preds: Array, target: Array) -> Array:

@@ -237,3 +237,42 @@ def test_batched_matches_loop():
         batched_scores = np.asarray(m._metric_batched(*padded))
         looped_scores = np.asarray(_Base._metric_batched(m, *padded))
         np.testing.assert_allclose(batched_scores, looped_scores, atol=1e-5, err_msg=str(cls))
+
+
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+
+class _MetricOnlySubclass(RetrievalMetric):
+    """Third-party-style subclass implementing only the documented
+    per-query `_metric` extension point (host-loop fallback path)."""
+
+    def _metric(self, preds, target):
+        rel = target[jnp.argsort(-preds, stable=True)] > 0
+        return rel[:1].astype(jnp.float32).sum()  # precision@1
+
+
+def test_metric_only_subclass_uses_eager_fallback():
+    m = _MetricOnlySubclass()
+    m.update(jnp.asarray([0.9, 0.1, 0.8, 0.7]), jnp.asarray([1, 0, 0, 1]), jnp.asarray([0, 0, 1, 1]))
+    got = float(m.compute())
+    np.testing.assert_allclose(got, 0.5)  # q0 hit, q1 miss
+
+
+def test_mutating_fold_attrs_invalidates_cached_program():
+    """empty_target_action / k are traced as static values; mutating them
+    after a compute must re-trace, not reuse the stale program."""
+    from metrics_tpu import RetrievalMAP as _RM, RetrievalPrecision as _RP
+
+    m = _RM(empty_target_action="neg")
+    m.update(jnp.asarray([0.9, 0.2]), jnp.asarray([0, 0]), jnp.asarray([0, 0]))
+    assert float(m.compute()) == 0.0
+    m.empty_target_action = "pos"
+    m._computed = None
+    assert float(m.compute()) == 1.0
+
+    p = _RP(k=1)
+    p.update(jnp.asarray([0.9, 0.8, 0.1]), jnp.asarray([1, 1, 0]), jnp.asarray([0, 0, 0]))
+    assert float(p.compute()) == 1.0  # top-1 is relevant
+    p.k = 3
+    p._computed = None
+    np.testing.assert_allclose(float(p.compute()), 2 / 3)
