@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.utilities.data import bucket_pow2
+
 Array = jax.Array
 
 EmbedderType = Callable[[List[str]], Tuple[Array, Array, Array]]
@@ -151,20 +153,25 @@ def bert_score(
         pred_weights = _idf_weights(pred_ids, pred_mask, idf_dict)
         tgt_weights = _idf_weights(tgt_ids, tgt_mask, idf_dict)
 
-    # pad to a common token length so one einsum covers the batch
+    # pad both sides to a common BUCKETED token length (next power of two):
+    # one einsum covers the batch, and the jitted matcher compiles once per
+    # bucket instead of once per distinct tokenizer padding length —
+    # variable-length eval loops would otherwise recompile nearly every call
     lp, lt = pred_emb.shape[1], tgt_emb.shape[1]
-    if lp != lt:
-        pad = abs(lp - lt)
-        if lp < lt:
-            pred_emb = jnp.pad(pred_emb, ((0, 0), (0, pad), (0, 0)))
-            pred_mask = jnp.pad(pred_mask, ((0, 0), (0, pad)))
-            if pred_weights is not None:
-                pred_weights = jnp.pad(pred_weights, ((0, 0), (0, pad)))
-        else:
-            tgt_emb = jnp.pad(tgt_emb, ((0, 0), (0, pad), (0, 0)))
-            tgt_mask = jnp.pad(tgt_mask, ((0, 0), (0, pad)))
-            if tgt_weights is not None:
-                tgt_weights = jnp.pad(tgt_weights, ((0, 0), (0, pad)))
+    bucket = bucket_pow2(max(lp, lt))
+
+    def _pad_to(emb, mask, weights, length):
+        pad = length - emb.shape[1]
+        if pad == 0:
+            return emb, mask, weights
+        emb = jnp.pad(emb, ((0, 0), (0, pad), (0, 0)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+        if weights is not None:
+            weights = jnp.pad(weights, ((0, 0), (0, pad)))
+        return emb, mask, weights
+
+    pred_emb, pred_mask, pred_weights = _pad_to(pred_emb, pred_mask, pred_weights, bucket)
+    tgt_emb, tgt_mask, tgt_weights = _pad_to(tgt_emb, tgt_mask, tgt_weights, bucket)
 
     precision, recall, f1 = _greedy_cosine_match(pred_emb, pred_mask, tgt_emb, tgt_mask, pred_weights, tgt_weights)
 

@@ -17,7 +17,7 @@ import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.checks import _check_retrieval_inputs
-from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.data import bucket_pow2, dim_zero_cat
 
 Array = jax.Array
 
@@ -26,6 +26,9 @@ def _pad_by_query(indexes: Array, preds: Array, target: Array) -> Tuple[Array, A
     """Scatter flat rows into padded (Q, L) matrices grouped by query id.
 
     Returns (padded_preds [-inf pad], padded_target [0 pad], valid mask).
+    Q and L are bucketed to powers of two (``bucket_pow2``) so the jitted
+    fold compiles O(log) times across a streaming evaluation; fully-padded
+    query rows carry ``valid=False`` everywhere and are masked out.
     """
     # one batched device->host fetch (async copies overlap) instead of three
     # sequential transfers — matters on high-latency device links
@@ -33,7 +36,7 @@ def _pad_by_query(indexes: Array, preds: Array, target: Array) -> Tuple[Array, A
 
     _, inverse = np.unique(idx_np, return_inverse=True)
     counts = np.bincount(inverse)
-    num_queries, max_len = counts.size, int(counts.max())
+    num_queries, max_len = bucket_pow2(counts.size), bucket_pow2(int(counts.max()))
 
     order = np.argsort(inverse, kind="stable")
     offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
@@ -115,9 +118,19 @@ class RetrievalMetric(Metric, ABC):
         Keys the per-instance jit cache in :meth:`_folded_compute_fn` so
         mutating these after a compute picks up a freshly traced program.
         Subclasses whose ``_metric_batched`` reads additional attributes
-        must extend this tuple.
+        may extend this tuple, but staleness is also guarded at the
+        mechanism level: ``__setattr__`` drops the cached program on any
+        public attribute write.
         """
         return (self.empty_target_action, getattr(self, "k", None), getattr(self, "adaptive_k", None))
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        super().__setattr__(name, value)
+        # any public attribute write may change what the traced fold reads
+        # (e.g. a third-party subclass's threshold) -> drop the cached
+        # program; list states mutate by append and never pass through here
+        if not name.startswith("_") and name not in ("indexes", "preds", "target"):
+            self.__dict__.pop("_batched_compute_jit", None)
 
     def _folded_compute_fn(self):
         """One jitted program: per-query scores + empty-action folding.
@@ -137,19 +150,26 @@ class RetrievalMetric(Metric, ABC):
 
         def _folded(padded_preds: Array, padded_target: Array, valid: Array):
             scores = self._metric_batched(padded_preds, padded_target, valid)  # (Q,)
-            empty = self._empty_query_mask(padded_target, valid)
+            # bucketed padding adds fully-invalid query rows: exclude them
+            # from empty-handling and from the average (their scores may be
+            # 0/0 garbage — `where` selection never propagates it)
+            real = valid.any(axis=1)
+            empty = self._empty_query_mask(padded_target, valid) & real
             if action == "pos":
                 scores = jnp.where(empty, 1.0, scores)
             elif action == "neg":
                 scores = jnp.where(empty, 0.0, scores)
             elif action == "skip":
-                kept = ~empty
+                kept = ~empty & real
                 n_kept = kept.sum()
                 folded = jnp.where(
                     n_kept > 0, jnp.where(kept, scores, 0.0).sum() / jnp.maximum(n_kept, 1), 0.0
                 )
                 return folded, empty.any()
-            result = scores.mean() if scores.size else jnp.asarray(0.0)
+            n_real = real.sum()
+            result = jnp.where(
+                n_real > 0, jnp.where(real, scores, 0.0).sum() / jnp.maximum(n_real, 1), 0.0
+            )
             return result, empty.any()
 
         # the default _metric_batched is a documented host-loop fallback over
@@ -185,5 +205,7 @@ class RetrievalMetric(Metric, ABC):
         scores = []
         for q in range(padded_preds.shape[0]):
             m = np.asarray(valid[q])
-            scores.append(self._metric(padded_preds[q][m], padded_target[q][m]))
+            # bucketed padding adds fully-invalid rows; the fold masks them
+            # out, so any placeholder value works
+            scores.append(self._metric(padded_preds[q][m], padded_target[q][m]) if m.any() else jnp.asarray(0.0))
         return jnp.stack(scores)

@@ -40,6 +40,18 @@ def dim_zero_min(x: Array) -> Array:
     return jnp.min(x, axis=0)
 
 
+def bucket_pow2(n: int, minimum: int = 8) -> int:
+    """Round up to the next power of two (>= ``minimum``).
+
+    Shared shape-bucketing policy for padded arrays that feed jitted
+    programs (retrieval's (Q, L) matrices, BERTScore's token length):
+    power-of-two buckets bound recompilation to O(log n) distinct shapes
+    across a streaming evaluation.
+    """
+    n = max(n, minimum)
+    return 1 << (n - 1).bit_length()
+
+
 def _flatten(x: Sequence) -> list:
     """Flatten one level of nesting (ref data.py:59)."""
     return [item for sublist in x for item in sublist]

@@ -77,3 +77,73 @@ def test_nan_impute(metric_cls, expected):
 def test_invalid_nan_strategy():
     with pytest.raises(ValueError, match="Arg `nan_strategy` should"):
         SumMetric(nan_strategy="invalid")
+
+
+# ---- full reference nan matrix (ref tests/bases/test_aggregation.py:100-147)
+
+_case_all_nan = [float("nan")] * 5
+_case_mixed = [1.0, 2.0, float("nan"), 4.0, 5.0]
+
+
+@pytest.mark.parametrize("value", [_case_all_nan, _case_mixed], ids=["all_nan", "mixed"])
+@pytest.mark.parametrize("metric_cls", [MinMetric, MaxMetric, SumMetric, MeanMetric, CatMetric])
+def test_nan_warn(metric_cls, value):
+    m = metric_cls(nan_strategy="warn")
+    with pytest.warns(UserWarning, match="Encounted `nan` values"):
+        m.update(jnp.asarray(value))
+
+
+@pytest.mark.parametrize("value", [_case_all_nan, _case_mixed], ids=["all_nan", "mixed"])
+@pytest.mark.parametrize("metric_cls", [CatMetric])
+def test_nan_error_cat(metric_cls, value):
+    m = metric_cls(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="Encounted `nan` values"):
+        m.update(jnp.asarray(value))
+
+
+@pytest.mark.parametrize(
+    "metric_cls,nan_strategy,value,expected",
+    [
+        (MinMetric, "ignore", _case_all_nan, float("inf")),
+        (MinMetric, 2.0, _case_all_nan, 2.0),
+        (MinMetric, "ignore", _case_mixed, 1.0),
+        (MinMetric, 2.0, _case_mixed, 1.0),
+        (MaxMetric, "ignore", _case_all_nan, -float("inf")),
+        (MaxMetric, 2.0, _case_all_nan, 2.0),
+        (MaxMetric, "ignore", _case_mixed, 5.0),
+        (MaxMetric, 2.0, _case_mixed, 5.0),
+        (SumMetric, "ignore", _case_all_nan, 0.0),
+        (SumMetric, 2.0, _case_all_nan, 10.0),
+        (SumMetric, "ignore", _case_mixed, 12.0),
+        (SumMetric, 2.0, _case_mixed, 14.0),
+        (MeanMetric, "ignore", _case_all_nan, float("nan")),
+        (MeanMetric, 2.0, _case_all_nan, 2.0),
+        (MeanMetric, "ignore", _case_mixed, 3.0),
+        (MeanMetric, 2.0, _case_mixed, 2.8),
+        (CatMetric, "ignore", _case_all_nan, []),
+        (CatMetric, 2.0, _case_all_nan, [2.0] * 5),
+        (CatMetric, "ignore", _case_mixed, [1.0, 2.0, 4.0, 5.0]),
+        (CatMetric, 2.0, _case_mixed, [1.0, 2.0, 2.0, 4.0, 5.0]),
+    ],
+)
+def test_nan_expected_matrix(metric_cls, nan_strategy, value, expected):
+    """Every (aggregator, strategy, fixture) cell of the reference matrix."""
+    m = metric_cls(nan_strategy=nan_strategy)
+    m.update(jnp.asarray(value))
+    out = np.asarray(m.compute())
+    np.testing.assert_allclose(out, np.asarray(expected, dtype=np.float32), equal_nan=True)
+
+
+@pytest.mark.parametrize(
+    "weights,expected",
+    [
+        (1, 11.5),
+        (jnp.ones((2, 1, 1)), 11.5),
+        (jnp.asarray([1.0, 2.0]).reshape(2, 1, 1), 13.5),
+    ],
+)
+def test_mean_metric_broadcasting(weights, expected):
+    """Weight broadcasting over multi-dim values (ref :158-166)."""
+    values = jnp.arange(24.0).reshape(2, 3, 4)
+    m = MeanMetric()
+    assert float(m(values, weights)) == expected

@@ -205,3 +205,35 @@ def test_compositional_repr_and_higher_order():
     assert float(restored.compute()) == 25.0
     # repr renders the nested op tree without raising (ref metric.py:830-836)
     assert "CompositionalMetric" in repr(combo)
+
+
+def test_reflected_matmul():
+    """rmatmul puts the plain operand on the left (ref :350-364)."""
+    m = DummyMetricSum()
+    comp = jnp.asarray([1.0, 2.0]) @ (m + jnp.asarray([0.0, 0.0]))
+    m.update(jnp.asarray([2.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(comp.compute()), 1 * 2 + 2 * 3, atol=1e-6)
+
+
+def test_unary_pos_invert_and_ne():
+    """__pos__ / __invert__ / __ne__ compositions (ref :278-295, :502-532)."""
+
+    class IntSum(DummyMetricSum):
+        def __init__(self):
+            super().__init__()
+            self.x = jnp.asarray(0, dtype=jnp.int32)
+
+    i = IntSum()
+    inv = ~i
+    i.update(jnp.asarray(6))
+    assert int(np.asarray(inv.compute())) == ~6
+
+    m = DummyMetricDiff()  # update SUBTRACTS: update(2.0) -> value -2.0
+    pos = +m
+    neq_hit = m != -2.0
+    neq_miss = m != 0.0
+    m.update(jnp.asarray(2.0))
+    # the reference defines __pos__ as abs (ref metric.py:715-716) — parity
+    np.testing.assert_allclose(np.asarray(pos.compute()), 2.0)
+    assert bool(np.asarray(neq_hit.compute())) is False
+    assert bool(np.asarray(neq_miss.compute())) is True

@@ -280,3 +280,27 @@ def test_pr_curve_per_class_vs_sklearn():
         np.testing.assert_allclose(np.asarray(precs[c]), sk_p, atol=1e-6)
         np.testing.assert_allclose(np.asarray(recs[c]), sk_r, atol=1e-6)
         np.testing.assert_allclose(np.asarray(thrs[c]), sk_t, atol=1e-6)
+
+
+class TestCurveMinorAxes:
+    """sample_weights / pos_label axes vs sklearn (ref functional
+    classification/{auroc,average_precision,precision_recall_curve}.py)."""
+
+    _p = np.random.RandomState(17).rand(128).astype(np.float32)
+    _t = np.random.RandomState(18).randint(0, 2, 128)
+    _w = np.random.RandomState(19).rand(128).astype(np.float32)
+
+    def test_auroc_sample_weights(self):
+        got = float(auroc(jnp.asarray(self._p), jnp.asarray(self._t), sample_weights=jnp.asarray(self._w)))
+        np.testing.assert_allclose(got, sk_roc_auc(self._t, self._p, sample_weight=self._w), atol=1e-5)
+
+    def test_average_precision_pos_label(self):
+        got = float(average_precision(jnp.asarray(self._p), jnp.asarray(self._t), pos_label=0))
+        np.testing.assert_allclose(got, sk_average_precision((self._t == 0).astype(int), self._p), atol=1e-5)
+
+    def test_pr_curve_pos_label(self):
+        prec, rec, thr = precision_recall_curve(jnp.asarray(self._p), jnp.asarray(self._t), pos_label=0)
+        sk_prec, sk_rec, sk_thr = _sk_pr_curve_truncated((self._t == 0).astype(int), self._p)
+        np.testing.assert_allclose(np.asarray(prec), sk_prec, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rec), sk_rec, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(thr), sk_thr, atol=1e-6)

@@ -106,3 +106,21 @@ def test_idf_weighting_changes_scores(hf_embedder):
     # "the" appears in every target sentence -> its IDF weight drops, so
     # scores must actually move
     assert not np.allclose(np.asarray(plain["f1"]), np.asarray(idf["f1"]))
+
+
+def test_variable_length_batches_reuse_compiled_matcher(hf_embedder):
+    """Token lengths bucket to powers of two, so a variable-length eval
+    loop hits the jitted matcher's cache instead of recompiling per call."""
+    from metrics_tpu.functional import bert_score
+    from metrics_tpu.functional.text.bert import _greedy_cosine_match
+
+    # _cache_size is a private jit API; fall back to a value-only check
+    cache_size = getattr(_greedy_cosine_match, "_cache_size", lambda: None)
+    base = cache_size()
+    outs = []
+    for n_words in (2, 3, 4, 5, 6):  # all bucket to the same padded length
+        sent = " ".join(["hello"] * n_words)
+        outs.append(float(bert_score([sent], [sent], embedder=hf_embedder)["f1"][0]))
+    np.testing.assert_allclose(outs, 1.0, atol=1e-5)
+    if base is not None:
+        assert cache_size() - base <= 1
