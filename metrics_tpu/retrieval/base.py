@@ -128,9 +128,11 @@ class RetrievalMetric(Metric, ABC):
         super().__setattr__(name, value)
         # any public attribute write may change what the traced fold reads
         # (e.g. a third-party subclass's threshold) -> drop the cached
-        # program; list states mutate by append and never pass through here
+        # program AND the memoized compute result; list states mutate by
+        # append and never pass through here
         if not name.startswith("_") and name not in ("indexes", "preds", "target"):
             self.__dict__.pop("_batched_compute_jit", None)
+            self.__dict__["_computed"] = None
 
     def _folded_compute_fn(self):
         """One jitted program: per-query scores + empty-action folding.

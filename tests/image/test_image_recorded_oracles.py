@@ -42,10 +42,13 @@ def test_ssim_recorded():
 
 
 def test_ms_ssim_recorded():
-    preds = _rand([1, 1, 256, 256], 42)
+    # recorded from the reference torch implementation on this exact
+    # seeded input (torch.manual_seed(42), 176px — the smallest size
+    # whose coarsest of 5 scales still fits the default 11px window)
+    preds = _rand([1, 1, 176, 176], 42)
     np.testing.assert_allclose(
         float(multiscale_structural_similarity_index_measure(preds, preds * 0.75)),
-        0.9558,
+        0.95569,
         atol=1e-4,
     )
 

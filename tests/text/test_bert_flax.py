@@ -34,8 +34,8 @@ def tiny_checkpoint(tmp_path_factory):
         f.write("\n".join(_VOCAB))
     tokenizer = BertTokenizerFast(vocab_file=os.path.join(d, "vocab.txt"), do_lower_case=True)
     config = BertConfig(
-        vocab_size=len(_VOCAB), hidden_size=16, num_hidden_layers=2,
-        num_attention_heads=2, intermediate_size=32, max_position_embeddings=64,
+        vocab_size=len(_VOCAB), hidden_size=8, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=16, max_position_embeddings=64,
     )
     model = FlaxBertModel(config, seed=0)
     tokenizer.save_pretrained(d)
@@ -53,7 +53,7 @@ def hf_embedder(tiny_checkpoint):
 def test_embedder_shapes(hf_embedder):
     emb, mask, ids = hf_embedder(["hello there", "the cat sat on the mat"])
     assert emb.shape[0] == 2 and emb.shape[1] == mask.shape[1] == ids.shape[1]
-    assert emb.shape[2] == 16  # hidden_size
+    assert emb.shape[2] == 8  # hidden_size
     # padding: the short sentence's tail must be masked out
     assert int(mask[0].sum()) < int(mask[1].sum())
 
@@ -118,7 +118,7 @@ def test_variable_length_batches_reuse_compiled_matcher(hf_embedder):
     cache_size = getattr(_greedy_cosine_match, "_cache_size", lambda: None)
     base = cache_size()
     outs = []
-    for n_words in (2, 3, 4, 5, 6):  # all bucket to the same padded length
+    for n_words in (2, 4, 6):  # all bucket to the same padded length
         sent = " ".join(["hello"] * n_words)
         outs.append(float(bert_score([sent], [sent], embedder=hf_embedder)["f1"][0]))
     np.testing.assert_allclose(outs, 1.0, atol=1e-5)

@@ -27,10 +27,10 @@ class TestBootStrapper:
         target = np.random.rand(256).astype(np.float32)
         base = MeanSquaredError()
         base.update(jnp.asarray(preds), jnp.asarray(target))
-        boot = BootStrapper(MeanSquaredError(), num_bootstraps=50)
+        boot = BootStrapper(MeanSquaredError(), num_bootstraps=20)
         boot.update(jnp.asarray(preds), jnp.asarray(target))
         out = boot.compute()
-        assert abs(float(out["mean"]) - float(base.compute())) < 0.02
+        assert abs(float(out["mean"]) - float(base.compute())) < 0.03
 
     def test_invalid_strategy(self):
         with pytest.raises(ValueError, match="sampling_strategy"):
@@ -144,7 +144,7 @@ def test_bootstrap_quantile_and_raw():
 
     rng = np.random.RandomState(1)
     bs = BootStrapper(
-        MeanSquaredError(), num_bootstraps=20, quantile=jnp.asarray([0.05, 0.95]), raw=True,
+        MeanSquaredError(), num_bootstraps=10, quantile=jnp.asarray([0.05, 0.95]), raw=True,
         sampling_strategy="poisson",
     )
     for _ in range(4):
@@ -155,4 +155,4 @@ def test_bootstrap_quantile_and_raw():
     assert set(out) >= {"mean", "std", "quantile", "raw"}
     lo, hi = np.asarray(out["quantile"])
     assert lo <= float(out["mean"]) <= hi
-    assert np.asarray(out["raw"]).shape == (20,)
+    assert np.asarray(out["raw"]).shape == (10,)

@@ -36,7 +36,7 @@ from tests.helpers.testers import BATCH_SIZE, NUM_CLASSES
 
 seed_all(42)
 
-_NB = 6  # batches for the wrapper sweeps
+_NB = 4  # batches for the wrapper sweeps
 
 
 # ------------------------------------------------------- exact bootstrap
@@ -74,7 +74,7 @@ def test_bootstrap_exact_oracle(sampling_strategy, metric_fn, sk_fn):
     target = rng.randint(0, 10, (_NB, 32))
 
     boot = _CapturingBootStrapper(
-        metric_fn(), num_bootstraps=7, mean=True, std=True, raw=True,
+        metric_fn(), num_bootstraps=4, mean=True, std=True, raw=True,
         quantile=jnp.asarray([0.05, 0.95]), sampling_strategy=sampling_strategy,
     )
     is_mse = isinstance(metric_fn(), MeanSquaredError)
