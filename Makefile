@@ -1,8 +1,14 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test doctest bench tpu-smoke clean
+.PHONY: test parity doctest bench tpu-smoke clean
 
 test:
 	python -m pytest tests/ -q
+
+# live-oracle parity only: this framework's functionals vs the actual
+# reference implementation on shared random inputs (skips itself when the
+# reference checkout or torch is absent; included in `make test` too)
+parity:
+	python -m pytest tests/parity/ -q
 
 # on-device smoke suite: needs a live TPU backend (skips itself otherwise)
 tpu-smoke:
