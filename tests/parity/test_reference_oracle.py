@@ -190,3 +190,77 @@ def test_text_matches_reference(reference, case):
             )
     else:
         np.testing.assert_allclose(np.asarray(mine, np.float64), float(ref), rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+# ----------------------------------------------------- module-class parity
+
+_B, _NBATCH = 24, 4
+_mod_probs = _RNG.rand(_NBATCH, _B, _C).astype(np.float32)
+_mod_probs /= _mod_probs.sum(-1, keepdims=True)
+_mod_labels = _RNG.randint(0, _C, (_NBATCH, _B))
+_mod_reg_p = _RNG.rand(_NBATCH, _B).astype(np.float32)
+_mod_reg_t = (_RNG.rand(_NBATCH, _B) + 0.1).astype(np.float32)
+_mdmc_preds = _RNG.randint(0, _C, (_NBATCH, _B, 6))
+_mdmc_target = _RNG.randint(0, _C, (_NBATCH, _B, 6))
+
+MODULE_CASES = [
+    ("Accuracy", dict(num_classes=_C, average="macro"), "cls"),
+    ("Accuracy", dict(num_classes=_C, top_k=2), "cls"),
+    ("Precision", dict(num_classes=_C, average="weighted"), "cls"),
+    ("Recall", dict(num_classes=_C, average="none"), "cls"),
+    ("F1Score", dict(num_classes=_C, average="macro"), "cls"),
+    ("Specificity", dict(num_classes=_C, average="micro"), "cls"),
+    ("StatScores", dict(num_classes=_C, reduce="macro"), "cls"),
+    ("ConfusionMatrix", dict(num_classes=_C), "cls"),
+    ("CohenKappa", dict(num_classes=_C), "cls"),
+    ("MatthewsCorrCoef", dict(num_classes=_C), "cls"),
+    ("JaccardIndex", dict(num_classes=_C), "cls"),
+    ("AUROC", dict(num_classes=_C, average="macro"), "cls"),
+    ("Accuracy", dict(num_classes=_C, mdmc_average="global"), "mdmc"),
+    ("Accuracy", dict(num_classes=_C, mdmc_average="samplewise", average="micro"), "mdmc"),
+    ("Precision", dict(num_classes=_C, mdmc_average="global", average="macro"), "mdmc"),
+    ("MeanSquaredError", {}, "reg"),
+    ("MeanAbsoluteError", {}, "reg"),
+    ("PearsonCorrCoef", {}, "reg"),
+    ("SpearmanCorrCoef", {}, "reg"),
+    ("R2Score", {}, "reg"),
+    ("ExplainedVariance", {}, "reg"),
+]
+
+
+def _module_id(case):
+    name, kwargs, kind = case
+    suffix = "-".join(f"{k}={v}" for k, v in kwargs.items())
+    return f"{name}{'-' + suffix if suffix else ''}-{kind}"
+
+
+@pytest.mark.parametrize("case", MODULE_CASES, ids=_module_id)
+def test_module_accumulation_matches_reference(reference, case):
+    """Stateful parity: N batch updates then compute, both frameworks.
+
+    This exercises state declaration, accumulation, and the compute
+    reduction — the full module lifecycle — against the live reference."""
+    import torch
+
+    import metrics_tpu
+
+    name, kwargs, kind = case
+    mine = getattr(metrics_tpu, name)(**kwargs)
+    ref = getattr(reference, name)(**kwargs)
+
+    if kind == "cls":
+        batches = [(_mod_probs[i], _mod_labels[i]) for i in range(_NBATCH)]
+    elif kind == "mdmc":
+        batches = [(_mdmc_preds[i], _mdmc_target[i]) for i in range(_NBATCH)]
+    else:
+        batches = [(_mod_reg_p[i], _mod_reg_t[i]) for i in range(_NBATCH)]
+
+    for p, t in batches:
+        mine.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.from_numpy(p), torch.from_numpy(t))
+
+    got, expected = mine.compute(), ref.compute()
+    np.testing.assert_allclose(
+        np.asarray(got, np.float64), np.asarray(expected.numpy(), np.float64),
+        rtol=1e-4, atol=1e-4, err_msg=_module_id(case),
+    )
