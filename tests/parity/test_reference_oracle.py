@@ -103,6 +103,12 @@ PAIRWISE_CASES = [
     ("pairwise_manhattan_distance", (_ml_probs[:12], _ml_probs[12:20]), {}),
 ]
 
+CURVE_CASES = [
+    ("precision_recall_curve", (_binary_probs, _binary_labels), {}),
+    ("roc", (_binary_probs, _binary_labels), {}),
+    ("auc", (np.sort(_reg_preds), _reg_target), dict(reorder=False)),
+]
+
 RETRIEVAL_CASES = [
     ("retrieval_average_precision", (_binary_probs[:16], _binary_labels[:16]), {}),
     ("retrieval_reciprocal_rank", (_binary_probs[:16], _binary_labels[:16]), {}),
@@ -133,7 +139,7 @@ AUDIO_CASES = [
 ]
 
 ALL_CASES = (
-    CLASSIFICATION_CASES + REGRESSION_CASES + PAIRWISE_CASES + RETRIEVAL_CASES + IMAGE_CASES + AUDIO_CASES
+    CLASSIFICATION_CASES + REGRESSION_CASES + CURVE_CASES + PAIRWISE_CASES + RETRIEVAL_CASES + IMAGE_CASES + AUDIO_CASES
 )
 
 
