@@ -270,3 +270,60 @@ def test_module_accumulation_matches_reference(reference, case):
         np.asarray(got, np.float64), np.asarray(expected.numpy(), np.float64),
         rtol=1e-4, atol=1e-4, err_msg=_module_id(case),
     )
+
+
+# NOTE: no live ROUGE case — the REFERENCE's rouge_score functional calls
+# nltk sentence tokenization unconditionally and the punkt data is absent
+# from this zero-egress image; our ROUGE is pinned against the rouge_score
+# package itself in tests/text/test_text.py (a stronger oracle).
+def test_sacre_bleu_matches_reference(reference):
+    preds = ["the cat is on the mat", "hello there general kenobi"]
+    targets = [["there is a cat on the mat"], ["hello there general kenobi"]]
+    for tokenize in ("13a", "char", "intl"):
+        mine = F.sacre_bleu_score(preds, targets, tokenize=tokenize)
+        ref = reference.functional.sacre_bleu_score(preds, targets, tokenize=tokenize)
+        np.testing.assert_allclose(np.asarray(mine, np.float64), float(ref), atol=1e-4, err_msg=tokenize)
+
+
+def test_wrapper_modules_match_reference(reference):
+    """MinMaxMetric / MultioutputWrapper / MetricTracker lifecycles."""
+    import torch
+
+    import metrics_tpu
+
+    vals = [_mod_reg_p[i] for i in range(_NBATCH)]
+    tgts = [_mod_reg_t[i] for i in range(_NBATCH)]
+
+    mine = metrics_tpu.MinMaxMetric(metrics_tpu.MeanSquaredError())
+    ref = reference.MinMaxMetric(reference.MeanSquaredError())
+    for p, t in zip(vals, tgts):
+        mine.update(jnp.asarray(p), jnp.asarray(t))
+        mine.compute()  # min/max track compute() calls
+        ref.update(torch.from_numpy(p), torch.from_numpy(t))
+        ref.compute()
+    got, exp = mine.compute(), ref.compute()
+    for k in ("raw", "min", "max"):
+        np.testing.assert_allclose(float(got[k]), float(exp[k]), rtol=1e-5, err_msg=k)
+
+    mo_p = _RNG.rand(_NBATCH, _B, 3).astype(np.float32)
+    mo_t = _RNG.rand(_NBATCH, _B, 3).astype(np.float32)
+    mine = metrics_tpu.MultioutputWrapper(metrics_tpu.MeanSquaredError(), num_outputs=3)
+    ref = reference.MultioutputWrapper(reference.MeanSquaredError(), num_outputs=3)
+    for i in range(_NBATCH):
+        mine.update(jnp.asarray(mo_p[i]), jnp.asarray(mo_t[i]))
+        ref.update(torch.from_numpy(mo_p[i]), torch.from_numpy(mo_t[i]))
+    np.testing.assert_allclose(
+        np.asarray(mine.compute()), np.asarray([float(x) for x in ref.compute()]), rtol=1e-5
+    )
+
+    mine = metrics_tpu.MetricTracker(metrics_tpu.MeanSquaredError(), maximize=False)
+    ref = reference.MetricTracker(reference.MeanSquaredError(), maximize=False)
+    for p, t in zip(vals, tgts):
+        mine.increment()
+        ref.increment()
+        mine.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.from_numpy(p), torch.from_numpy(t))
+    best_mine, step_mine = mine.best_metric(return_step=True)
+    best_ref, step_ref = ref.best_metric(return_step=True)
+    assert step_mine == step_ref
+    np.testing.assert_allclose(float(best_mine), float(best_ref), rtol=1e-5)
