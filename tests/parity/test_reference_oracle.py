@@ -120,8 +120,21 @@ RETRIEVAL_CASES = [
     ("retrieval_r_precision", (_binary_probs[:16], _binary_labels[:16]), {}),
 ]
 
+_img_a = _RNG.rand(2, 3, 64, 64).astype(np.float32)
+_img_b = np.clip(_img_a + 0.08 * _RNG.randn(2, 3, 64, 64).astype(np.float32), 0, 1)
+_img_big_a = _RNG.rand(1, 1, 176, 176).astype(np.float32)
+_img_big_b = np.clip(_img_big_a + 0.05 * _RNG.randn(1, 1, 176, 176).astype(np.float32), 0, 1)
+
 IMAGE_CASES = [
     ("peak_signal_noise_ratio", (_RNG.rand(2, 3, 24, 24).astype(np.float32),) * 2, dict(data_range=1.0)),
+    ("structural_similarity_index_measure", (_img_a, _img_b), dict(data_range=1.0)),
+    # single-channel only: the REFERENCE's uniform-kernel path crashes on
+    # multi-channel input (builds a 1-out-channel kernel but convolves
+    # with groups=C, ref functional/image/ssim.py:152-160) — ours doesn't
+    ("structural_similarity_index_measure", (_img_a[:, :1], _img_b[:, :1]),
+     dict(data_range=1.0, gaussian_kernel=False, kernel_size=7)),
+    ("multiscale_structural_similarity_index_measure", (_img_big_a, _img_big_b), dict(data_range=1.0)),
+    ("dice_score", (_probs, _labels), {}),
     ("universal_image_quality_index",
      (_RNG.rand(2, 3, 48, 48).astype(np.float32), _RNG.rand(2, 3, 48, 48).astype(np.float32)), {}),
     ("error_relative_global_dimensionless_synthesis",
