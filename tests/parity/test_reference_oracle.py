@@ -340,3 +340,100 @@ def test_wrapper_modules_match_reference(reference):
     best_ref, step_ref = ref.best_metric(return_step=True)
     assert step_mine == step_ref
     np.testing.assert_allclose(float(best_mine), float(best_ref), rtol=1e-5)
+
+
+def test_aggregation_modules_match_reference(reference):
+    """Max/Min/Sum/Mean/Cat aggregators over mixed scalar/vector updates."""
+    import torch
+
+    import metrics_tpu
+
+    updates = [np.asarray([1.0, 5.0, 3.0], np.float32), np.asarray(2.5, np.float32),
+               np.asarray([-1.0, 0.5], np.float32)]
+    for name in ("MaxMetric", "MinMetric", "SumMetric", "MeanMetric", "CatMetric"):
+        mine = getattr(metrics_tpu, name)()
+        ref = getattr(reference, name)()
+        for u in updates:
+            mine.update(jnp.asarray(u))
+            ref.update(torch.from_numpy(np.atleast_1d(u)))
+        np.testing.assert_allclose(
+            np.asarray(mine.compute(), np.float64).reshape(-1),
+            np.asarray(ref.compute().numpy(), np.float64).reshape(-1),
+            rtol=1e-5, err_msg=name,
+        )
+
+
+def test_binned_curve_modules_match_reference(reference):
+    """Fixed-threshold binned PR curve / AP: the TPU-default formulation
+    must agree with the reference's binned classes bin-for-bin."""
+    import torch
+
+    import metrics_tpu
+
+    thresholds = 25
+    for cls_name, kwargs in [
+        ("BinnedPrecisionRecallCurve", dict(num_classes=_C, thresholds=thresholds)),
+        ("BinnedAveragePrecision", dict(num_classes=_C, thresholds=thresholds)),
+    ]:
+        mine = getattr(metrics_tpu, cls_name)(**kwargs)
+        ref = getattr(reference, cls_name)(**kwargs)
+        for i in range(_NBATCH):
+            onehot = (np.arange(_C)[None, :] == _mod_labels[i][:, None]).astype(np.int64)
+            mine.update(jnp.asarray(_mod_probs[i]), jnp.asarray(onehot))
+            ref.update(torch.from_numpy(_mod_probs[i]), torch.from_numpy(onehot))
+        got, exp = mine.compute(), ref.compute()
+        flat_got = [np.asarray(x) for part in (got if isinstance(got, (list, tuple)) else [got])
+                    for x in (part if isinstance(part, (list, tuple)) else [part])]
+        flat_exp = [np.asarray(x.numpy()) for part in (exp if isinstance(exp, (list, tuple)) else [exp])
+                    for x in (part if isinstance(part, (list, tuple)) else [part])]
+        assert len(flat_got) == len(flat_exp), cls_name
+        for a, b in zip(flat_got, flat_exp):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4, err_msg=cls_name)
+
+
+def test_metric_collection_matches_reference(reference):
+    import torch
+
+    import metrics_tpu
+
+    mine = metrics_tpu.MetricCollection(
+        [metrics_tpu.Accuracy(num_classes=_C, average="macro"),
+         metrics_tpu.F1Score(num_classes=_C, average="macro"),
+         metrics_tpu.ConfusionMatrix(num_classes=_C)]
+    )
+    ref = reference.MetricCollection(
+        [reference.Accuracy(num_classes=_C, average="macro"),
+         reference.F1Score(num_classes=_C, average="macro"),
+         reference.ConfusionMatrix(num_classes=_C)]
+    )
+    for i in range(_NBATCH):
+        mine.update(jnp.asarray(_mod_probs[i]), jnp.asarray(_mod_labels[i]))
+        ref.update(torch.from_numpy(_mod_probs[i]), torch.from_numpy(_mod_labels[i]))
+    got, exp = mine.compute(), ref.compute()
+    assert set(got) == set(exp)
+    for k in got:
+        np.testing.assert_allclose(
+            np.asarray(got[k], np.float64), np.asarray(exp[k].numpy(), np.float64),
+            rtol=1e-4, atol=1e-4, err_msg=k,
+        )
+
+
+def test_compositional_arithmetic_matches_reference(reference):
+    import torch
+
+    import metrics_tpu
+
+    mine_a = metrics_tpu.MeanSquaredError()
+    mine_b = metrics_tpu.MeanAbsoluteError()
+    ref_a = reference.MeanSquaredError()
+    ref_b = reference.MeanAbsoluteError()
+    mine_comp = 2.0 * mine_a + mine_b / 2.0 - 0.1
+    ref_comp = 2.0 * ref_a + ref_b / 2.0 - 0.1
+    for i in range(_NBATCH):
+        for m in (mine_a, mine_b):
+            m.update(jnp.asarray(_mod_reg_p[i]), jnp.asarray(_mod_reg_t[i]))
+        for m in (ref_a, ref_b):
+            m.update(torch.from_numpy(_mod_reg_p[i]), torch.from_numpy(_mod_reg_t[i]))
+    np.testing.assert_allclose(
+        float(mine_comp.compute()), float(ref_comp.compute()), rtol=1e-5
+    )
