@@ -11,6 +11,7 @@ argmax → one-hot → stat-score sums, the same math TorchMetrics executes per
 update) measured in-process — lower is better; ``vs_baseline`` is the
 speedup factor (baseline_time / our_time).
 """
+import datetime
 import json
 import os
 import sys
@@ -466,6 +467,41 @@ def _write_detail(detail: dict) -> None:
         json.dump(detail, f, indent=2)
 
 
+def _git_rev() -> str:
+    """Best-effort HEAD hash so capture records pin the code they measured."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "describe", "--always", "--dirty"], capture_output=True, text=True,
+            timeout=10, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return proc.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _record_capture(kind: str, device: str, payload: dict) -> None:
+    """Append a timestamped record to TPU_CAPTURES.jsonl for any run that
+    landed on a real accelerator — the audit trail VERDICT r2 asked for:
+    every TPU claim in the repo should trace to a committed (ISO time,
+    device, code rev) artifact. CPU runs are not recorded (replaceable)."""
+    if "CPU" in device.upper():
+        return
+    rec = {"kind": kind, "device": device}
+    rec.update(payload)
+    # fill stamps only when the caller didn't supply its own shared ones
+    rec.setdefault("ts_utc", datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds"))
+    rec.setdefault("git_rev", _git_rev())
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "TPU_CAPTURES.jsonl")
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except Exception as err:  # the record is evidence, not a dependency
+        print(f"# capture record write failed: {err}", file=sys.stderr, flush=True)
+
+
 def _worker_main() -> None:
     """Run the benchmark on whatever backend this process initializes."""
     _enable_compile_cache()
@@ -498,6 +534,16 @@ def _worker_main() -> None:
     )
 
     on_accelerator = jax.devices()[0].platform != "cpu"
+    # one (timestamp, rev) stamp shared by every artifact this run writes,
+    # so BENCH_DETAIL.json and the capture log correlate exactly
+    ts_utc = datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds")
+    git_rev = _git_rev()
+    _record_capture("bench_headline", device, {
+        "ts_utc": ts_utc,
+        "git_rev": git_rev,
+        "accuracy_update_us": round(ours_us, 2),
+        "torch_cpu_baseline_us": base_us,
+    })
     want_detail = os.environ.get("BENCH_ALL") or (
         on_accelerator and not os.environ.get("BENCH_SKIP_DETAIL")
     )
@@ -510,7 +556,12 @@ def _worker_main() -> None:
             detail["accuracy_update_us"] = round(ours_us, 2)
             detail["torch_cpu_baseline_us"] = base_us
             detail["device"] = device
+            detail["captured_at_utc"] = ts_utc
+            detail["git_rev"] = git_rev
             _write_detail(detail)
+            _record_capture("bench_detail", device, {
+                "ts_utc": ts_utc, "git_rev": git_rev, "suite": detail.get("suite"),
+            })
         except Exception as err:  # detail bench must never break the headline
             print(f"# detail bench failed: {err}", file=sys.stderr)
 
@@ -561,7 +612,7 @@ def _run_worker(env: dict, timeout: float):
     return None, _time.perf_counter() - t0
 
 
-def _probe_ambient_backend(timeout: float) -> bool:
+def _probe_ambient_backend(timeout: float, attempts: int = 2) -> str:
     """Can the ambient (TPU) backend initialize at all?
 
     A wedged device tunnel hangs ``jax.devices()`` indefinitely (observed
@@ -575,10 +626,14 @@ def _probe_ambient_backend(timeout: float) -> bool:
     A CRASH during probe init (round-1's transient 'UNAVAILABLE') gets one
     retry — transient init crashes were recoverable seconds later. A HANG
     is not retried: a wedged tunnel stays wedged for hours.
+
+    Returns ``"ok"``, ``"hang"``, or ``"crash"`` — callers that only care
+    whether the backend answered can test truthiness via ``== "ok"``; the
+    recovery loop uses the failure kind to size its budget.
     """
     import subprocess
 
-    for attempt in (1, 2):
+    for attempt in range(1, attempts + 1):
         if attempt == 2:
             time.sleep(10)  # give a transient init crash a moment to clear
         try:
@@ -589,12 +644,55 @@ def _probe_ambient_backend(timeout: float) -> bool:
         except subprocess.TimeoutExpired:
             print(f"# ambient backend probe hung >{timeout:.0f}s (tunnel wedged?)",
                   file=sys.stderr, flush=True)
-            return False
+            return "hang"
         if "BACKEND_OK" in proc.stdout:
-            return True
+            return "ok"
         print(f"# ambient backend probe failed rc={proc.returncode} "
               f"(attempt {attempt}): {proc.stderr[-400:]}", file=sys.stderr, flush=True)
-    return False
+    return "crash"
+
+
+def _probe_with_recovery(probe_timeout: float) -> bool:
+    """Probe the ambient backend; on failure, hold a budgeted recovery window.
+
+    Round 2's end-of-round capture fell back to CPU because the tunnel was
+    wedged at the exact moment the driver ran — a one-shot probe converts a
+    transient wedge into a round-long evidence gap. So instead of giving up,
+    re-probe every BENCH_RECOVERY_INTERVAL (default 60 s) until
+    BENCH_RECOVERY_BUDGET (default 600 s) is spent; each probe is logged to
+    stderr. Set BENCH_RECOVERY_BUDGET=0 for the old fail-fast behavior
+    (used by local iteration; the driver's run keeps the window).
+    """
+    first = _probe_ambient_backend(probe_timeout)
+    if first == "ok":
+        return True
+    budget = float(os.environ.get("BENCH_RECOVERY_BUDGET", "600"))
+    if first == "crash" and "BENCH_RECOVERY_BUDGET" not in os.environ:
+        # a deterministic init crash (libtpu missing, bad config) fails the
+        # same way every time — the long window is for wedged-tunnel hangs;
+        # crashes get a short one covering only the transient-UNAVAILABLE case
+        budget = min(budget, 120.0)
+    interval = float(os.environ.get("BENCH_RECOVERY_INTERVAL", "60"))
+    t0 = time.perf_counter()
+    n = 0
+    while True:
+        elapsed = time.perf_counter() - t0
+        wait = min(interval, max(budget - elapsed, 0.0))
+        if budget - elapsed - wait <= 5:  # no room left for a probe after the sleep
+            print(f"# tunnel recovery budget ({budget:.0f}s) exhausted "
+                  f"after {n} re-probes", file=sys.stderr, flush=True)
+            return False
+        n += 1
+        print(f"# tunnel recovery: sleeping {wait:.0f}s before re-probe #{n} "
+              f"({budget - elapsed:.0f}s of budget left)", file=sys.stderr, flush=True)
+        time.sleep(wait)
+        # cap each probe by the remaining budget so a hung probe can't
+        # overshoot the window, and skip the internal crash-retry — the
+        # outer loop IS the retry here
+        remaining = budget - (time.perf_counter() - t0)
+        if _probe_ambient_backend(min(probe_timeout, remaining), attempts=1) == "ok":
+            print(f"# tunnel recovered on re-probe #{n}", file=sys.stderr, flush=True)
+            return True
 
 
 def main() -> None:
@@ -611,7 +709,7 @@ def main() -> None:
         return
 
     result = None
-    if _probe_ambient_backend(float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))):
+    if _probe_with_recovery(float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))):
         # BENCH_ALL runs the full detail suite (several model compiles, a
         # nested 300s dist sub-bench) — the watchdog must cover it or a
         # healthy mid-run TPU worker gets killed and silently replaced by
