@@ -13,6 +13,7 @@ test paths on the command line the suite stays CPU-pinned and
 tests/tpu_smoke skips itself.
 """
 import os
+import re
 
 
 def _tpu_smoke_only_invocation(config) -> bool:
@@ -28,10 +29,12 @@ NUM_DEVICES = 8
 def pytest_configure(config):
     if _tpu_smoke_only_invocation(config):
         return
-    if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={NUM_DEVICES}"
-        )
+    # the suite's meshes are built for exactly NUM_DEVICES, so any
+    # pre-existing device-count flag is replaced, not respected — honoring
+    # a caller's different count would only trip the assert below
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count={NUM_DEVICES}"
     os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses tests may spawn
 
     import jax

@@ -3,7 +3,10 @@
 Behavioral parity: /root/reference/torchmetrics/functional/classification/
 f_beta.py (354 LoC).
 """
+import numbers
 from typing import Optional
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -147,7 +150,10 @@ def f1_score(
         >>> round(float(f1_score(preds, target)), 4)
         0.3333
     """
-    if not isinstance(beta, (int, float)) or isinstance(beta, bool):
+    # numbers.Real admits numpy/jax scalar floats a migrated call site may
+    # pass positionally; the guard exists to catch *strings* (average etc.)
+    # landing in the reference's ignored beta slot, not to police dtypes
+    if isinstance(beta, bool) or not isinstance(beta, (numbers.Real, jnp.ndarray, np.ndarray)):
         raise ValueError(
             f"Expected argument `beta` to be a float but got {beta!r} — note `f1_score` ignores `beta`"
             f" (it is fixed to 1.0); pass `average`/`num_classes` by keyword"

@@ -55,9 +55,14 @@ def _trace_sqrtm_eigh_host(sigma1: Array, sigma2: Array) -> Array:
     the same trade the reference makes with its scipy hop
     (ref image/fid.py:60-94), but staying inside jax.
     """
+    sigma1, sigma2 = jnp.asarray(sigma1), jnp.asarray(sigma2)
     cpu = jax.local_devices(backend="cpu")[0]
     val = _trace_sqrtm_eigh(jax.device_put(sigma1, cpu), jax.device_put(sigma2, cpu))
-    return jax.device_put(val, list(sigma1.devices())[0])
+    devices = sigma1.devices()
+    # a sharded covariance has several devices and a scalar can't take its
+    # sharding — land the result on the default device deterministically
+    target = next(iter(devices)) if len(devices) == 1 else jax.devices()[0]
+    return jax.device_put(val, target)
 
 
 def _trace_sqrtm_newton_schulz(

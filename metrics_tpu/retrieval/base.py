@@ -121,6 +121,14 @@ class RetrievalMetric(Metric, ABC):
         may extend this tuple, but staleness is also guarded at the
         mechanism level: ``__setattr__`` drops the cached program on any
         public attribute write.
+
+        Contract for subclasses: fold-relevant attributes must be
+        **reassigned, not mutated in place** — ``self.thresholds = [...]``
+        invalidates the cache via ``__setattr__``, but
+        ``self.thresholds.append(x)`` bypasses it and the cached traced
+        program keeps the stale constant. Attributes holding mutable
+        containers should either be reassigned wholesale or contribute a
+        content hash to this tuple.
         """
         return (self.empty_target_action, getattr(self, "k", None), getattr(self, "adaptive_k", None))
 
