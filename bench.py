@@ -237,6 +237,44 @@ def _cfg_coco(detail: dict, python_baseline: bool = False) -> None:
         _native_mod.coco_match = _orig_match
 
 
+def _cfg_fid_stream(detail: dict) -> None:
+    """List-state vs streaming-moment FID at compute(), 5k×2048 features
+    per distribution (10k rows total).
+
+    The list path concatenates the 10k feature rows and ships them toward
+    the host eigensolver at compute; the moment path (``feature_dim=``)
+    reduced them to (n, Σx, Σxxᵀ) at update time, so compute moves two
+    2048² mats regardless of the stream length. Same value
+    (tolerance-pinned in tests/image/test_streaming_moments.py).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.image import FrechetInceptionDistance
+
+    rng = np.random.RandomState(1)
+    d, batch, nb = 2048, 500, 10
+    reals = [jnp.asarray(rng.rand(batch, d).astype(np.float32)) for _ in range(nb)]
+    fakes = [jnp.asarray(rng.rand(batch, d).astype(np.float32) + 0.05) for _ in range(nb)]
+
+    fid_list = FrechetInceptionDistance()
+    fid_mom = FrechetInceptionDistance(feature_dim=d)
+    for r, f in zip(reals, fakes):
+        fid_list.update(r, real=True)
+        fid_list.update(f, real=False)
+        fid_mom.update(r, real=True)
+        fid_mom.update(f, real=False)
+    jax.block_until_ready(fid_mom.real_outer_sum)
+
+    t0 = time.perf_counter()
+    v_list = float(fid_list.compute())
+    detail["fid_compute_s_list_5k_feats"] = round(time.perf_counter() - t0, 2)
+    t0 = time.perf_counter()
+    v_mom = float(fid_mom.compute())
+    detail["fid_compute_s_moments_5k_feats"] = round(time.perf_counter() - t0, 2)
+    detail["fid_stream_vs_list_reldiff"] = round(abs(v_mom - v_list) / max(abs(v_list), 1e-9), 6)
+
+
 def _bench_detail() -> dict:
     """Extra BASELINE.md configs; written to BENCH_DETAIL.json with BENCH_ALL=1."""
     import jax
@@ -256,6 +294,8 @@ def _bench_detail() -> dict:
     _mark("retrieval_map_compute_ms_100k_rows")
     _cfg_coco(detail, python_baseline=True)
     _mark("coco_map_compute_s_100_images")
+    _cfg_fid_stream(detail)
+    _mark("fid_compute_s_moments_5k_feats")
 
     # FID with the bundled Flax InceptionV3 (BASELINE.md config #5)
     from metrics_tpu.image import FrechetInceptionDistance, InceptionV3FeatureExtractor
@@ -424,6 +464,7 @@ def _bench_detail_fast() -> dict:
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),
         ("retrieval", _cfg_retrieval),
         ("coco_map", _cfg_coco),
+        ("fid_stream", _cfg_fid_stream),
     ]
     for key, fn in configs:
         if time.perf_counter() - t_start > budget:

@@ -177,6 +177,20 @@ def _mean_cov(features: Array) -> tuple:
     return mu, sigma
 
 
+def _moments_to_mean_cov(num: Array, feat_sum: Array, outer_sum: Array) -> tuple:
+    """(n, Σx, Σxxᵀ) -> (μ, unbiased Σ).
+
+    The one-pass covariance ``(Σxxᵀ - n μμᵀ)/(n-1)`` is algebraically the
+    two-pass value; in float32 the subtraction costs a few ulps of the
+    *mean-scale* magnitude, which the bit-compatibility test bounds
+    (tests/image/test_streaming_moments.py).
+    """
+    n = num.astype(feat_sum.dtype)
+    mu = feat_sum / n
+    sigma = (outer_sum - n * jnp.outer(mu, mu)) / (n - 1.0)
+    return mu, sigma
+
+
 class FrechetInceptionDistance(Metric):
     """FID between accumulated real and generated feature distributions.
 
@@ -192,6 +206,14 @@ class FrechetInceptionDistance(Metric):
             slow) for eager computes, early-stopped Newton–Schulz
             (matmul-only, MXU-friendly, approximate) inside ``jit``. See
             :func:`_trace_sqrtm_product`.
+        feature_dim: when given, the metric keeps **fixed-shape running
+            moments** ``(n, Σx, Σxxᵀ)`` per distribution instead of a
+            growing feature list (the reference keeps lists,
+            ref fid.py:251-252). O(1) memory in the stream length,
+            ``dist_reduce_fx="sum"`` so states merge/sync/shard trivially,
+            fully jit/scan-compatible updates, and ``compute()`` reduces
+            two ``(D, D)`` matrices instead of shipping ``N×D`` features
+            off-device. ``None`` (default) keeps the list-state path.
 
     Example (pre-extracted features):
         >>> import jax, jax.numpy as jnp
@@ -213,6 +235,7 @@ class FrechetInceptionDistance(Metric):
         feature_extractor: Optional[Callable[[Array], Array]] = None,
         reset_real_features: bool = True,
         sqrtm_method: Optional[str] = None,
+        feature_dim: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -226,33 +249,59 @@ class FrechetInceptionDistance(Metric):
                 f" but got {sqrtm_method}"
             )
         self.sqrtm_method = sqrtm_method
+        if feature_dim is not None and not (isinstance(feature_dim, int) and feature_dim > 0):
+            raise ValueError("Argument `feature_dim` expected to be `None` or a positive integer")
+        self.feature_dim = feature_dim
 
-        self.add_state("real_features", [], dist_reduce_fx=None)
-        self.add_state("fake_features", [], dist_reduce_fx=None)
+        if feature_dim is None:
+            self.add_state("real_features", [], dist_reduce_fx=None)
+            self.add_state("fake_features", [], dist_reduce_fx=None)
+        else:
+            dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            for prefix in ("real", "fake"):
+                self.add_state(f"{prefix}_num_samples", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+                self.add_state(f"{prefix}_features_sum", jnp.zeros(feature_dim, dtype), dist_reduce_fx="sum")
+                self.add_state(f"{prefix}_outer_sum", jnp.zeros((feature_dim, feature_dim), dtype), dist_reduce_fx="sum")
 
-    def update(self, imgs: Array, real: bool) -> None:
-        """Extract features (or pass through) and accumulate (ref fid.py:254-266)."""
+    def _extract(self, imgs: Array) -> Array:
         features = self.feature_extractor(imgs) if self.feature_extractor is not None else imgs
         if features.ndim != 2:
             raise ValueError(f"Expected extracted features to be 2d (N, D), got shape {features.shape}")
-        if real:
+        if self.feature_dim is not None and features.shape[1] != self.feature_dim:
+            raise ValueError(
+                f"Expected extracted features to have dim {self.feature_dim}, got shape {features.shape}"
+            )
+        return features
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Extract features (or pass through) and accumulate (ref fid.py:254-266)."""
+        features = self._extract(imgs)
+        if self.feature_dim is not None:
+            prefix = "real" if real else "fake"
+            f = features.astype(getattr(self, f"{prefix}_features_sum").dtype)
+            setattr(self, f"{prefix}_num_samples", getattr(self, f"{prefix}_num_samples") + f.shape[0])
+            setattr(self, f"{prefix}_features_sum", getattr(self, f"{prefix}_features_sum") + f.sum(axis=0))
+            setattr(self, f"{prefix}_outer_sum", getattr(self, f"{prefix}_outer_sum") + f.T @ f)
+        elif real:
             self.real_features.append(features)
         else:
             self.fake_features.append(features)
 
     def compute(self) -> Array:
         """FID over the accumulated features (ref fid.py:268-287)."""
-        real_features = dim_zero_cat(self.real_features)
-        fake_features = dim_zero_cat(self.fake_features)
-        mu1, sigma1 = _mean_cov(real_features.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32))
-        mu2, sigma2 = _mean_cov(fake_features.astype(mu1.dtype))
+        if self.feature_dim is not None:
+            mu1, sigma1 = _moments_to_mean_cov(self.real_num_samples, self.real_features_sum, self.real_outer_sum)
+            mu2, sigma2 = _moments_to_mean_cov(self.fake_num_samples, self.fake_features_sum, self.fake_outer_sum)
+        else:
+            real_features = dim_zero_cat(self.real_features)
+            fake_features = dim_zero_cat(self.fake_features)
+            mu1, sigma1 = _mean_cov(real_features.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32))
+            mu2, sigma2 = _mean_cov(fake_features.astype(mu1.dtype))
         return _compute_fid(mu1, sigma1, mu2, sigma2, sqrtm_method=self.sqrtm_method)
 
     def reset(self) -> None:
-        """Optionally preserve real features across resets (ref fid.py:289-296)."""
+        """Optionally preserve real features/moments across resets (ref fid.py:289-296)."""
         if not self.reset_real_features:
-            real_features = self.real_features
-            super().reset()
-            object.__setattr__(self, "real_features", real_features)
+            self._reset_preserving("real")
         else:
             super().reset()

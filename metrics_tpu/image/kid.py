@@ -46,6 +46,20 @@ def poly_mmd(
 class KernelInceptionDistance(Metric):
     """KID: polynomial MMD over random feature subsets (ref kid.py:67-282).
 
+    Args:
+        feature_dim: together with ``max_samples``, switches the states from
+            growing feature **lists** (the reference's design) to a
+            **fixed-capacity preallocated buffer** ``(max_samples,
+            feature_dim)`` plus a fill count. Same accumulated features, so
+            ``compute()`` is bit-identical to the list path — but the state
+            pytree has a static shape: updates jit/scan without
+            per-update-count recompiles, states donate cleanly, and sync
+            stacks a single buffer per device instead of a ragged list.
+            Eager updates past capacity raise; traced updates clamp to the
+            tail (XLA ``dynamic_update_slice`` semantics), so size
+            ``max_samples`` to bound the stream.
+        max_samples: buffer capacity (rows) for the fixed-shape path.
+
     Example (pre-extracted features):
         >>> import jax, jax.numpy as jnp
         >>> from metrics_tpu.image.kid import KernelInceptionDistance
@@ -71,6 +85,8 @@ class KernelInceptionDistance(Metric):
         gamma: Optional[float] = None,
         coef: float = 1.0,
         reset_real_features: bool = True,
+        feature_dim: Optional[int] = None,
+        max_samples: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -94,21 +110,92 @@ class KernelInceptionDistance(Metric):
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features
+        if (feature_dim is None) != (max_samples is None):
+            raise ValueError("Arguments `feature_dim` and `max_samples` must be given together")
+        if feature_dim is not None and not (isinstance(feature_dim, int) and feature_dim > 0):
+            raise ValueError("Argument `feature_dim` expected to be `None` or a positive integer")
+        if max_samples is not None and not (isinstance(max_samples, int) and max_samples > 0):
+            raise ValueError("Argument `max_samples` expected to be `None` or a positive integer")
+        self.feature_dim = feature_dim
+        self.max_samples = max_samples
 
-        self.add_state("real_features", [], dist_reduce_fx=None)
-        self.add_state("fake_features", [], dist_reduce_fx=None)
+        if feature_dim is None:
+            self.add_state("real_features", [], dist_reduce_fx=None)
+            self.add_state("fake_features", [], dist_reduce_fx=None)
+        else:
+            dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            for prefix in ("real", "fake"):
+                self.add_state(f"{prefix}_buffer", jnp.zeros((max_samples, feature_dim), dtype), dist_reduce_fx=None)
+                self.add_state(f"{prefix}_count", jnp.zeros((), jnp.int32), dist_reduce_fx=None)
+            # raw sample rows: exempt from sync_dtype compression (permanent)
+            self._sample_state_names = {"real_buffer", "fake_buffer"}
 
     def update(self, imgs: Array, real: bool) -> None:
         features = self.feature_extractor(imgs) if self.feature_extractor is not None else imgs
-        if real:
+        if self.feature_dim is not None:
+            if features.ndim != 2 or features.shape[1] != self.feature_dim:
+                raise ValueError(
+                    f"Expected extracted features of shape (N, {self.feature_dim}), got {features.shape}"
+                )
+            prefix = "real" if real else "fake"
+            buf, count = getattr(self, f"{prefix}_buffer"), getattr(self, f"{prefix}_count")
+            if not isinstance(count, jax.core.Tracer) and int(count) + features.shape[0] > self.max_samples:
+                raise ValueError(
+                    f"KID buffer overflow: {int(count)} + {features.shape[0]} samples exceed"
+                    f" `max_samples={self.max_samples}`"
+                )
+            buf = jax.lax.dynamic_update_slice(
+                buf, features.astype(buf.dtype), (count, jnp.zeros((), count.dtype))
+            )
+            setattr(self, f"{prefix}_buffer", buf)
+            setattr(self, f"{prefix}_count", count + features.shape[0])
+        elif real:
             self.real_features.append(features)
         else:
             self.fake_features.append(features)
 
+    def _reduce_states(self, incoming_state) -> None:
+        """Merge an incoming buffer-mode state by compaction, not stacking.
+
+        The base class stacks ``dist_reduce_fx=None`` tensor states (the
+        cross-device sync layout); for ``pure_merge``/``forward`` that would
+        corrupt the fixed-capacity buffers. Rows at or past each buffer's
+        fill count are zero by construction (zero-initialised, updates write
+        contiguously from the front, eager overflow raises), so shifting the
+        local buffer to start at the incoming count and adding merges the
+        two streams in order. Merged totals must fit ``max_samples``.
+        """
+        if self.feature_dim is None:
+            return super()._reduce_states(incoming_state)
+        for prefix in ("real", "fake"):
+            g_buf = incoming_state[f"{prefix}_buffer"]
+            g_cnt = incoming_state[f"{prefix}_count"]
+            l_buf = getattr(self, f"{prefix}_buffer")
+            l_cnt = getattr(self, f"{prefix}_count")
+            if not isinstance(g_cnt, jax.core.Tracer) and not isinstance(l_cnt, jax.core.Tracer):
+                if int(g_cnt) + int(l_cnt) > self.max_samples:
+                    raise ValueError(
+                        f"KID buffer overflow on merge: {int(g_cnt)} + {int(l_cnt)} samples"
+                        f" exceed `max_samples={self.max_samples}`"
+                    )
+            object.__setattr__(self, f"{prefix}_buffer", g_buf + jnp.roll(l_buf, g_cnt, axis=0))
+            object.__setattr__(self, f"{prefix}_count", g_cnt + l_cnt)
+
+    def _buffered(self, prefix: str) -> Array:
+        """Valid rows of a fixed-capacity buffer; flattens a synced stack."""
+        buf, count = getattr(self, f"{prefix}_buffer"), getattr(self, f"{prefix}_count")
+        if buf.ndim == 3:  # dist-synced: (world, capacity, D) + (world,) counts
+            return jnp.concatenate([buf[i, : int(count[i])] for i in range(buf.shape[0])])
+        return buf[: int(count)]
+
     def compute(self) -> Tuple[Array, Array]:
         """Mean/std of per-subset MMD (ref kid.py:244-275)."""
-        real_features = dim_zero_cat(self.real_features)
-        fake_features = dim_zero_cat(self.fake_features)
+        if self.feature_dim is not None:
+            real_features = self._buffered("real")
+            fake_features = self._buffered("fake")
+        else:
+            real_features = dim_zero_cat(self.real_features)
+            fake_features = dim_zero_cat(self.fake_features)
 
         n_samples_real = real_features.shape[0]
         if n_samples_real < self.subset_size:
@@ -129,8 +216,6 @@ class KernelInceptionDistance(Metric):
 
     def reset(self) -> None:
         if not self.reset_real_features:
-            real_features = self.real_features
-            super().reset()
-            object.__setattr__(self, "real_features", real_features)
+            self._reset_preserving("real")
         else:
             super().reset()

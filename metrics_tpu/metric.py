@@ -76,13 +76,44 @@ def _raise_if_list_state(defaults: Dict[str, Any], owner: str) -> None:
             )
 
 
+def _is_static_scalar(v: Any, numeric: bool = False) -> bool:
+    """Is ``v`` a flag-like value to close over statically (not trace/scan)?
+
+    bool/str/None always; numpy 0-d bools too (common from array
+    comparisons); int/float only when ``numeric`` — keeping them dynamic in
+    the jit path so a per-batch numeric kwarg doesn't mint a fresh
+    jit-cache entry per value.
+    """
+    if isinstance(v, (bool, str, np.bool_)) or v is None:
+        return True
+    return numeric and isinstance(v, (int, float))
+
+
+def _split_static_kwargs(kwargs: Dict, numeric_static: bool) -> Tuple[Dict, Dict]:
+    """Partition kwargs into (static, dynamic) by :func:`_is_static_scalar`;
+    numpy bools are canonicalised to Python bools so cache keys hash
+    consistently."""
+    static = {
+        k: (bool(v) if isinstance(v, np.bool_) else v)
+        for k, v in kwargs.items()
+        if _is_static_scalar(v, numeric_static)
+    }
+    return static, {k: v for k, v in kwargs.items() if k not in static}
+
+
 def _scan_fold(update_fn: Callable, state: Any, batched_args: Tuple, batched_kwargs: Dict) -> Any:
     """``lax.scan`` of a pure ``(state, *args, **kwargs) -> state`` reducer
-    over the leading batch axis of the given arg/kwarg pytrees."""
+    over the leading batch axis of the given arg/kwarg pytrees.
+
+    Keyword arguments whose value is a plain Python scalar are treated as
+    **static flags** shared by every step rather than scanned over, since
+    they carry no batch axis (see :func:`_split_static_kwargs`).
+    """
+    static_kwargs, batched_kwargs = _split_static_kwargs(batched_kwargs, numeric_static=True)
 
     def body(st: Any, batch: Tuple[Tuple, Dict]) -> Tuple[Any, None]:
         args, kwargs = batch
-        return update_fn(st, *args, **kwargs), None
+        return update_fn(st, *args, **kwargs, **static_kwargs), None
 
     if not jax.tree_util.tree_leaves((batched_args, batched_kwargs)):
         raise MetricsUserError(
@@ -165,7 +196,8 @@ class Metric(ABC):
         self.sync_dtype = None if sync_dtype is None else jnp.dtype(sync_dtype)
         self._sync_env = sync_env
         self._jit_update_requested = jit_update
-        self._jitted_update: Optional[Callable] = None
+        # None = empty cache; populated lazily as {static-kwarg-key: jitted fn}
+        self._jitted_update: Optional[Dict] = None
 
         self._update_signature = inspect.signature(self.update)
         self._update_impl: Callable = self.update
@@ -335,6 +367,7 @@ class Metric(ABC):
         states) and no value-dependent Python control flow in ``update``.
         """
         _raise_if_list_state(self._defaults, f"{self.__class__.__name__}")
+        batched_args, batched_kwargs = self._normalize_update_args(batched_args, batched_kwargs)
         return _scan_fold(self.pure_update, state, batched_args, batched_kwargs)
 
     # ------------------------------------------------------------ fwd/update
@@ -419,6 +452,29 @@ class Metric(ABC):
                 reduced = reduce_fn(jnp.stack([global_state, local_state]))
             object.__setattr__(self, attr, reduced)
 
+    def _normalize_update_args(self, args: Tuple, kwargs: Dict) -> Tuple[Tuple, Dict]:
+        """Bind ``update(*args, **kwargs)`` to the update signature, moving
+        named positionals into kwargs (so flag args like FID's ``real`` are
+        recognised however they were passed). Falls back to the raw pair if
+        binding fails — the real call will raise the right TypeError."""
+        try:
+            bound = self._update_signature.bind(*args, **kwargs)
+        except TypeError:
+            return args, kwargs
+        out_args: list = []
+        out_kwargs: Dict[str, Any] = {}
+        for name, val in bound.arguments.items():
+            param = self._update_signature.parameters[name]
+            if param.kind is param.VAR_POSITIONAL:
+                out_args.extend(val)
+            elif param.kind is param.VAR_KEYWORD:
+                out_kwargs.update(val)
+            elif param.kind is param.POSITIONAL_ONLY:
+                out_args.append(val)
+            else:
+                out_kwargs[name] = val
+        return tuple(out_args), out_kwargs
+
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
@@ -431,9 +487,29 @@ class Metric(ABC):
                 if self._jit_update_requested and not any(
                     isinstance(v, list) for v in self._defaults.values()
                 ):
+                    # Flag args (e.g. FID's ``real=True``) select Python
+                    # control flow inside ``update`` — close over them
+                    # statically (one jit cache entry per combination)
+                    # instead of tracing them. Positionals are bound through
+                    # the update signature first so a positionally-passed
+                    # flag gets the same treatment. Numeric kwargs stay
+                    # dynamic so a varying value can't grow the cache, and
+                    # the flag scan short-circuits so the common
+                    # arrays-only metrics skip signature binding entirely.
+                    if any(_is_static_scalar(v) for v in args) or any(
+                        _is_static_scalar(v) for v in kwargs.values()
+                    ):
+                        args, kwargs = self._normalize_update_args(args, kwargs)
+                        static, dynamic = _split_static_kwargs(kwargs, numeric_static=False)
+                        key = tuple(sorted(static.items()))
+                    else:
+                        static, dynamic, key = {}, kwargs, ()
                     if self._jitted_update is None:
-                        self._jitted_update = jax.jit(self.pure_update)
-                    new_state = self._jitted_update(self.state(), *args, **kwargs)
+                        self._jitted_update = {}
+                    fn = self._jitted_update.get(key)
+                    if fn is None:
+                        fn = self._jitted_update[key] = jax.jit(functools.partial(self.pure_update, **static))
+                    new_state = fn(self.state(), *args, **dynamic)
                     self._load_state(new_state)
                 else:
                     update(*args, **kwargs)
@@ -489,7 +565,14 @@ class Metric(ABC):
             # tensor states with a `cat` reduction): those hold raw samples
             # (CatMetric values, curve preds) that would stay quantized
             # permanently, not just transiently during a reduction.
-            samples = isinstance(value, list) or self._reductions[attr] is dim_zero_cat
+            samples = (
+                isinstance(value, list)
+                or self._reductions[attr] is dim_zero_cat
+                # states a subclass marked as holding raw sample rows (e.g.
+                # KID's fixed-capacity feature buffers): the gathered stack
+                # IS the retained state, so quantization would be permanent
+                or attr in getattr(self, "_sample_state_names", ())
+            )
             attr_gather = base_gather if samples else gather
             if isinstance(value, list):
                 output_dict[attr] = [attr_gather(v) for v in value]  # list of lists-of-rank-tensors
@@ -621,6 +704,15 @@ class Metric(ABC):
         # reset internal sync state
         self._cache = None
         self._is_synced = False
+
+    def _reset_preserving(self, prefix: str) -> None:
+        """Base reset, then restore every state whose name starts with
+        ``prefix`` — the FID/KID ``reset_real_features=False`` contract
+        (ref image/fid.py:289-296)."""
+        saved = {k: getattr(self, k) for k in self._defaults if k.startswith(prefix)}
+        Metric.reset(self)
+        for k, v in saved.items():
+            object.__setattr__(self, k, v)
 
     def clone(self) -> "Metric":
         """Deep copy of the metric (ref metric.py:437-439)."""
