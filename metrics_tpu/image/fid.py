@@ -290,6 +290,12 @@ class FrechetInceptionDistance(Metric):
     def compute(self) -> Array:
         """FID over the accumulated features (ref fid.py:268-287)."""
         if self.feature_dim is not None:
+            for n in (self.real_num_samples, self.fake_num_samples):
+                # match the list path's eager failure on an empty side
+                # (dim_zero_cat's error); traced computes can't raise and
+                # produce NaN from the 0/0 instead
+                if not isinstance(n, jax.core.Tracer) and int(n) == 0:
+                    raise ValueError("No samples to concatenate")
             mu1, sigma1 = _moments_to_mean_cov(self.real_num_samples, self.real_features_sum, self.real_outer_sum)
             mu2, sigma2 = _moments_to_mean_cov(self.fake_num_samples, self.fake_features_sum, self.fake_outer_sum)
         else:

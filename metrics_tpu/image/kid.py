@@ -163,7 +163,12 @@ class KernelInceptionDistance(Metric):
         fill count are zero by construction (zero-initialised, updates write
         contiguously from the front, eager overflow raises), so shifting the
         local buffer to start at the incoming count and adding merges the
-        two streams in order. Merged totals must fit ``max_samples``.
+        two streams in order. The shift masks rather than wraps, so local
+        rows past capacity can never alias onto valid incoming rows.
+        Merged totals must fit ``max_samples``: eagerly that raises; under
+        ``jit`` (where raising is impossible) the merged buffer is
+        NaN-poisoned so ``compute()`` surfaces NaN instead of a silently
+        truncated value.
         """
         if self.feature_dim is None:
             return super()._reduce_states(incoming_state)
@@ -172,13 +177,22 @@ class KernelInceptionDistance(Metric):
             g_cnt = incoming_state[f"{prefix}_count"]
             l_buf = getattr(self, f"{prefix}_buffer")
             l_cnt = getattr(self, f"{prefix}_count")
-            if not isinstance(g_cnt, jax.core.Tracer) and not isinstance(l_cnt, jax.core.Tracer):
-                if int(g_cnt) + int(l_cnt) > self.max_samples:
-                    raise ValueError(
-                        f"KID buffer overflow on merge: {int(g_cnt)} + {int(l_cnt)} samples"
-                        f" exceed `max_samples={self.max_samples}`"
-                    )
-            object.__setattr__(self, f"{prefix}_buffer", g_buf + jnp.roll(l_buf, g_cnt, axis=0))
+            traced = isinstance(g_cnt, jax.core.Tracer) or isinstance(l_cnt, jax.core.Tracer)
+            if not traced and int(g_cnt) + int(l_cnt) > self.max_samples:
+                raise ValueError(
+                    f"KID buffer overflow on merge: {int(g_cnt)} + {int(l_cnt)} samples"
+                    f" exceed `max_samples={self.max_samples}`"
+                )
+            idx = jnp.arange(self.max_samples) - g_cnt
+            shifted = jnp.where(
+                ((idx >= 0) & (idx < l_cnt))[:, None],
+                l_buf[jnp.clip(idx, 0, self.max_samples - 1)],
+                jnp.zeros((), l_buf.dtype),
+            )
+            merged = g_buf + shifted
+            overflow = (g_cnt + l_cnt) > self.max_samples
+            merged = merged + jnp.where(overflow, jnp.asarray(jnp.nan, merged.dtype), 0)
+            object.__setattr__(self, f"{prefix}_buffer", merged)
             object.__setattr__(self, f"{prefix}_count", g_cnt + l_cnt)
 
     def _buffered(self, prefix: str) -> Array:

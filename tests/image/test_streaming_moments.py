@@ -107,6 +107,18 @@ class TestStreamingFID:
             ref.update(f, real=False)
         assert float(fid.compute()) == pytest.approx(float(ref.compute()), rel=1e-5)
 
+    def test_numpy_bool_flag_jit_update(self):
+        fid = FrechetInceptionDistance(sqrtm_method="eigh", feature_dim=D, jit_update=True)
+        fid.update(_feature_stream(43, n_batches=1)[0], real=np.bool_(True))
+        fid.update(_feature_stream(44, n_batches=1)[0], real=np.bool_(False))
+        assert int(fid.real_num_samples) == 32 and int(fid.fake_num_samples) == 32
+
+    def test_empty_side_raises_like_list_path(self):
+        fid = FrechetInceptionDistance(sqrtm_method="eigh", feature_dim=D)
+        fid.update(_feature_stream(45, n_batches=1)[0], real=True)
+        with pytest.raises(ValueError, match="No samples"):
+            fid.compute()
+
     def test_jit_update_positional_real_flag(self):
         # the flag must be recognised as static when passed positionally too
         fid = FrechetInceptionDistance(sqrtm_method="eigh", feature_dim=D, jit_update=True)
@@ -191,6 +203,25 @@ class TestStreamingKID:
         with pytest.raises(ValueError, match="together"):
             KernelInceptionDistance(max_samples=100)
 
+    def test_jit_merge_overflow_poisons_with_nan(self):
+        # raising is impossible under jit; a silent wrap-around would
+        # corrupt valid rows, so overflow must surface as NaN instead
+        a = KernelInceptionDistance(feature_dim=D, max_samples=48)
+        b = KernelInceptionDistance(feature_dim=D, max_samples=48)
+        a.update(jnp.ones((30, D)), real=True)
+        b.update(jnp.full((30, D), 2.0), real=True)
+        merged = jax.jit(a.pure_merge)(a.state(), b.state())
+        assert bool(jnp.isnan(merged["real_buffer"]).all())
+        # a fitting jitted merge stays exact and un-poisoned
+        c = KernelInceptionDistance(feature_dim=D, max_samples=64)
+        d = KernelInceptionDistance(feature_dim=D, max_samples=64)
+        c.update(jnp.ones((30, D)), real=True)
+        d.update(jnp.full((30, D), 2.0), real=True)
+        merged = jax.jit(c.pure_merge)(c.state(), d.state())
+        np.testing.assert_array_equal(np.asarray(merged["real_buffer"][:30]), np.ones((30, D)))
+        np.testing.assert_array_equal(np.asarray(merged["real_buffer"][30:60]), np.full((30, D), 2.0))
+        assert int(merged["real_count"]) == 60
+
     def test_x64_buffer_update(self):
         # regression: int32 count vs int64 literal index crashed under x64,
         # and the buffer must follow x64 so f64 features aren't downcast
@@ -202,11 +233,6 @@ class TestStreamingKID:
             assert kid.real_buffer.dtype == jnp.float64
             np.testing.assert_array_equal(np.asarray(kid.real_buffer[:8]), np.asarray(feats))
 
-    def test_numpy_bool_flag_jit_update(self):
-        fid = FrechetInceptionDistance(sqrtm_method="eigh", feature_dim=D, jit_update=True)
-        fid.update(_feature_stream(43, n_batches=1)[0], real=np.bool_(True))
-        fid.update(_feature_stream(44, n_batches=1)[0], real=np.bool_(False))
-        assert int(fid.real_num_samples) == 32 and int(fid.fake_num_samples) == 32
 
     def test_merge_compacts_buffers(self):
         # pure_merge must interleave buffers by fill count, not stack them
