@@ -147,6 +147,11 @@ class KernelInceptionDistance(Metric):
             buf = jax.lax.dynamic_update_slice(
                 buf, features.astype(buf.dtype), (count, jnp.zeros((), count.dtype))
             )
+            # under jit the eager raise above is skipped and the clamped
+            # write would silently overwrite the tail — NaN-poison instead
+            # so compute() surfaces the overflow (same policy as merge)
+            overflow = count + features.shape[0] > self.max_samples
+            buf = buf + jnp.where(overflow, jnp.asarray(jnp.nan, buf.dtype), 0)
             setattr(self, f"{prefix}_buffer", buf)
             setattr(self, f"{prefix}_count", count + features.shape[0])
         elif real:

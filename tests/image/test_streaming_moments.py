@@ -203,6 +203,15 @@ class TestStreamingKID:
         with pytest.raises(ValueError, match="together"):
             KernelInceptionDistance(max_samples=100)
 
+    def test_jit_update_overflow_poisons_with_nan(self):
+        kid = KernelInceptionDistance(feature_dim=D, max_samples=48)
+        step = jax.jit(lambda s, b: kid.pure_update(s, b, real=True))
+        state = kid.state()
+        state = step(state, jnp.ones((30, D)))
+        state = step(state, jnp.full((30, D), 2.0))  # overflows under jit
+        assert bool(jnp.isnan(state["real_buffer"]).all())
+        assert int(state["real_count"]) == 60
+
     def test_jit_merge_overflow_poisons_with_nan(self):
         # raising is impossible under jit; a silent wrap-around would
         # corrupt valid rows, so overflow must surface as NaN instead
