@@ -13,10 +13,18 @@ import jax.numpy as jnp
 from metrics_tpu.functional.classification.confusion_matrix import (
     _confusion_matrix_compute,
     _confusion_matrix_update,
+    _confusion_matrix_update_matmul,
 )
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
+
+
+def _validate_update_method(update_method: str) -> None:
+    if update_method not in ("bincount", "matmul"):
+        raise ValueError(
+            f"Argument `update_method` must be 'bincount' or 'matmul', got {update_method}"
+        )
 
 
 class ConfusionMatrix(Metric):
@@ -43,6 +51,7 @@ class ConfusionMatrix(Metric):
         normalize: Optional[str] = None,
         threshold: float = 0.5,
         multilabel: bool = False,
+        update_method: str = "bincount",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -54,6 +63,15 @@ class ConfusionMatrix(Metric):
         allowed_normalize = ("true", "pred", "all", "none", None)
         if normalize not in allowed_normalize:
             raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+        _validate_update_method(update_method)
+        if update_method == "matmul" and multilabel:
+            raise ValueError("`update_method='matmul'` does not support `multilabel=True`")
+        # 'matmul' computes the identical counts as a one-hot contraction
+        # that GSPMD row-shards over a class-parallel mesh axis (each
+        # device holds a (C/cp, C) block) — the layout for huge-C
+        # workloads; see docs/distributed.md and
+        # functional/classification/confusion_matrix.py:_confusion_matrix_update_matmul
+        self.update_method = update_method
 
         default = jnp.zeros((num_classes, 2, 2), dtype=jnp.int32) if multilabel else jnp.zeros(
             (num_classes, num_classes), dtype=jnp.int32
@@ -61,7 +79,10 @@ class ConfusionMatrix(Metric):
         self.add_state("confmat", default=default, dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        confmat = _confusion_matrix_update(preds, target, self.num_classes, self.threshold, self.multilabel)
+        if self.update_method == "matmul":
+            confmat = _confusion_matrix_update_matmul(preds, target, self.num_classes, self.threshold)
+        else:
+            confmat = _confusion_matrix_update(preds, target, self.num_classes, self.threshold, self.multilabel)
         self.confmat = self.confmat + confmat
 
     def compute(self) -> Array:

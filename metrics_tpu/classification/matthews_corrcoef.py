@@ -8,6 +8,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.classification.confusion_matrix import _validate_update_method
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update_matmul
 from metrics_tpu.functional.classification.matthews_corrcoef import (
     _matthews_corrcoef_compute,
     _matthews_corrcoef_update,
@@ -38,15 +40,22 @@ class MatthewsCorrCoef(Metric):
         self,
         num_classes: int,
         threshold: float = 0.5,
+        update_method: str = "bincount",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.threshold = threshold
+        _validate_update_method(update_method)
+        # 'matmul' = class-shardable one-hot contraction (docs/distributed.md)
+        self.update_method = update_method
         self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        confmat = _matthews_corrcoef_update(preds, target, self.num_classes, self.threshold)
+        if self.update_method == "matmul":
+            confmat = _confusion_matrix_update_matmul(preds, target, self.num_classes, self.threshold)
+        else:
+            confmat = _matthews_corrcoef_update(preds, target, self.num_classes, self.threshold)
         self.confmat = self.confmat + confmat
 
     def compute(self) -> Array:

@@ -18,18 +18,27 @@ from metrics_tpu.utilities.prints import rank_zero_warn
 Array = jax.Array
 
 
-def _confusion_matrix_update(
-    preds: Array, target: Array, num_classes: int, threshold: float = 0.5, multilabel: bool = False
-) -> Array:
-    """Unnormalized confusion matrix for a batch (ref confusion_matrix.py:25-54)."""
-    # pass num_classes through only for integer-label inputs (needed for the
-    # one-hot expansion under jit); float/binary layouts infer C from shape and
-    # the reference's num_classes consistency checks would reject it there
+def _canonicalize_confmat_labels(preds: Array, target: Array, num_classes: int, threshold: float):
+    """Shared input canonicalization for both confmat update formulations.
+
+    ``num_classes`` passes through only for integer-label inputs (needed
+    for the one-hot expansion under jit); float/binary layouts infer C
+    from shape and the reference's num_classes consistency checks would
+    reject it there. Multiclass layouts come back as class indices.
+    """
     nc = num_classes if (preds.ndim == target.ndim and not jnp.issubdtype(preds.dtype, jnp.floating)) else None
     preds, target, mode = _input_format_classification(preds, target, threshold, num_classes=nc)
     if mode not in (DataType.BINARY, DataType.MULTILABEL):
         preds = preds.argmax(axis=1)
         target = target.argmax(axis=1)
+    return preds, target
+
+
+def _confusion_matrix_update(
+    preds: Array, target: Array, num_classes: int, threshold: float = 0.5, multilabel: bool = False
+) -> Array:
+    """Unnormalized confusion matrix for a batch (ref confusion_matrix.py:25-54)."""
+    preds, target = _canonicalize_confmat_labels(preds, target, num_classes, threshold)
     if multilabel:
         unique_mapping = ((2 * target + preds) + 4 * jnp.arange(num_classes)).reshape(-1)
         minlength = 4 * num_classes
@@ -41,6 +50,27 @@ def _confusion_matrix_update(
     if multilabel:
         return bins.reshape(num_classes, 2, 2)
     return bins.reshape(num_classes, num_classes)
+
+
+def _confusion_matrix_update_matmul(
+    preds: Array, target: Array, num_classes: int, threshold: float = 0.5
+) -> Array:
+    """One-hot matmul formulation of the (C, C) batch confusion matrix.
+
+    Identical counts to the bincount path, expressed as
+    ``onehot(target)ᵀ @ onehot(preds)`` — a (C, B) × (B, C) contraction
+    that rides the MXU and, under GSPMD with the output constrained to
+    ``P("cp", None)``, partitions **row-wise** over a class-parallel mesh
+    axis: each device materialises only its (B, C/cp) one-hot slice and
+    its (C/cp, C) output block, never the full matrix (the bincount
+    scatter has no such partitioning). float32 accumulation is exact for
+    per-batch counts below 2^24. Layout contract: docs/distributed.md.
+    """
+    preds, target = _canonicalize_confmat_labels(preds, target, num_classes, threshold)
+    classes = jnp.arange(num_classes)
+    oh_t = (target.reshape(-1)[:, None] == classes[None, :]).astype(jnp.float32)
+    oh_p = (preds.reshape(-1)[:, None] == classes[None, :]).astype(jnp.float32)
+    return (oh_t.T @ oh_p).astype(jnp.int32)
 
 
 def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:

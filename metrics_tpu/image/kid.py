@@ -149,9 +149,12 @@ class KernelInceptionDistance(Metric):
             )
             # under jit the eager raise above is skipped and the clamped
             # write would silently overwrite the tail — NaN-poison instead
-            # so compute() surfaces the overflow (same policy as merge)
-            overflow = count + features.shape[0] > self.max_samples
-            buf = buf + jnp.where(overflow, jnp.asarray(jnp.nan, buf.dtype), 0)
+            # so compute() surfaces the overflow (same policy as merge);
+            # eagerly the raise already fired, so skip the dead full-buffer
+            # add there
+            if isinstance(count, jax.core.Tracer):
+                overflow = count + features.shape[0] > self.max_samples
+                buf = buf + jnp.where(overflow, jnp.asarray(jnp.nan, buf.dtype), 0)
             setattr(self, f"{prefix}_buffer", buf)
             setattr(self, f"{prefix}_count", count + features.shape[0])
         elif real:

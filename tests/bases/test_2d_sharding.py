@@ -153,3 +153,83 @@ def test_multi_step_loop_delta_merge():
     np.testing.assert_allclose(
         np.asarray(m.pure_compute(state)), np.asarray(jnp.asarray(ref.compute())), rtol=1e-6
     )
+
+
+# ------------------------------------------------- confusion-matrix family
+# The (C, C) confmat is NOT elementwise in C, so the shard_map pattern
+# above doesn't apply; instead the `update_method="matmul"` formulation
+# (onehot(target)ᵀ @ onehot(preds)) lets GSPMD row-shard the state over
+# `cp` directly from jit sharding annotations — each device computes its
+# (C/cp, C) block from its (B, C/cp) one-hot slice, and batch sharding
+# over `dp` turns the contraction into a psum. Layout contract:
+# docs/distributed.md.
+from jax.sharding import NamedSharding  # noqa: E402
+
+from metrics_tpu import ConfusionMatrix, JaccardIndex, MatthewsCorrCoef  # noqa: E402
+
+
+def _run_confmat_family_2d(make_metric):
+    mesh = _mesh_2d()
+    C = 8
+    rng = np.random.RandomState(7)
+    preds = jnp.asarray(rng.randint(0, C, 256))
+    target = jnp.asarray(rng.randint(0, C, 256))
+
+    m = make_metric(update_method="matmul")
+    state_shard = {"confmat": NamedSharding(mesh, P("cp", None))}
+    batch_shard = NamedSharding(mesh, P("dp"))
+    step = jax.jit(
+        m.pure_update,
+        in_shardings=(state_shard, batch_shard, batch_shard),
+        out_shardings=state_shard,
+    )
+    state = step(m.state(), preds, target)
+    # the state really is row-sharded over cp (and a second step composes)
+    assert state["confmat"].sharding.spec == P("cp", None)
+    state = step(state, target, preds)  # swapped → transposed counts add in
+
+    val = jax.jit(m.pure_compute)(state)
+
+    ref = make_metric(update_method="bincount")
+    ref.update(preds, target)
+    ref.update(target, preds)
+    return np.asarray(val), np.asarray(ref.compute())
+
+
+def test_confusion_matrix_class_parallel():
+    got, want = _run_confmat_family_2d(lambda **kw: ConfusionMatrix(num_classes=8, **kw))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_jaccard_class_parallel():
+    got, want = _run_confmat_family_2d(lambda **kw: JaccardIndex(num_classes=8, **kw))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_matthews_corrcoef_class_parallel():
+    got, want = _run_confmat_family_2d(lambda **kw: MatthewsCorrCoef(num_classes=8, **kw))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_matmul_update_matches_bincount_all_modes():
+    """The matmul formulation is count-identical to bincount across the
+    confmat input modes (int labels; probability rows, which subsume
+    one-hot floats — int one-hots parse as multidim labels in both
+    frameworks and are not a confmat input mode)."""
+    from metrics_tpu.functional.classification.confusion_matrix import (
+        _confusion_matrix_update,
+        _confusion_matrix_update_matmul,
+    )
+
+    rng = np.random.RandomState(8)
+    C = 5
+    onehot_float_preds = jnp.asarray(np.eye(C, dtype=np.float32)[rng.randint(0, C, 64)])
+    cases = [
+        (jnp.asarray(rng.randint(0, C, 64)), jnp.asarray(rng.randint(0, C, 64))),
+        (jnp.asarray(rng.rand(64, C).astype(np.float32)), jnp.asarray(rng.randint(0, C, 64))),
+        (onehot_float_preds, jnp.asarray(rng.randint(0, C, 64))),
+    ]
+    for preds, target in cases:
+        a = _confusion_matrix_update(preds, target, C)
+        b = _confusion_matrix_update_matmul(preds, target, C)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
