@@ -5,6 +5,7 @@ Behavioral parity: /root/reference/torchmetrics/functional/text/rouge.py
 tokenization ([a-z0-9]+ on lowercased text, optional Porter stemming) and
 precision/recall/F-measure outputs.
 """
+import functools
 import re
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -33,24 +34,48 @@ ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
 
 
 def _add_newline_to_end_of_each_sentence(x: str) -> str:
-    """nltk sentence splitting for rougeLsum (ref rouge.py:64-72)."""
+    """Sentence splitting for rougeLsum (ref rouge.py:64-72).
+
+    The reference uses nltk's trained punkt model; when nltk (or its
+    downloadable punkt data) is unavailable, the vendored punkt-style
+    splitter (:mod:`.sentence_split`, pinned against a recorded punkt
+    corpus) takes over instead of raising, so rougeLsum works in
+    egress-free environments.
+    """
+    x = re.sub("<n>", "", x)
+    if _punkt_usable():
+        import nltk
+
+        try:
+            return "\n".join(nltk.sent_tokenize(x))
+        except LookupError:  # pragma: no cover — data vanished mid-process
+            pass
+    from metrics_tpu.functional.text.sentence_split import split_sentences
+
+    return "\n".join(split_sentences(x))
+
+
+@functools.lru_cache(maxsize=1)
+def _punkt_usable() -> bool:
+    """Probe (once per process) whether nltk's punkt data can be used —
+    the download attempt is a network call that fails slowly and noisily
+    in egress-free environments, so it must not run per rougeLsum call."""
     if not _NLTK_AVAILABLE:
-        raise ModuleNotFoundError("ROUGE-Lsum calculation requires that `nltk` is installed. Use `pip install nltk`.")
+        return False
     import nltk
 
     try:
         nltk.data.find("tokenizers/punkt_tab")
-    except LookupError:  # pragma: no cover
-        try:
-            nltk.download("punkt_tab", quiet=True)
-        except Exception:
-            pass
-    re.sub("<n>", "", x)
-    try:
-        return "\n".join(nltk.sent_tokenize(x))
+        return True
     except LookupError:
-        # offline fallback: naive sentence split on terminal punctuation
-        return "\n".join(s.strip() for s in re.split(r"(?<=[.!?])\s+", x) if s.strip())
+        pass
+    try:
+        if not nltk.download("punkt_tab", quiet=True):
+            return False
+        nltk.data.find("tokenizers/punkt_tab")
+        return True
+    except Exception:
+        return False
 
 
 def _normalize_and_tokenize_text(text: str, stemmer: Optional[object] = None) -> List[str]:

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Re-record the rougeLsum sentence-split oracle with REAL trained punkt.
+
+Run in any environment where nltk can load/download its punkt data:
+
+    python tools/record_punkt_goldens.py
+
+Rewrites ``tests/text/punkt_goldens.json``'s ``sentences`` fields with
+``nltk.sent_tokenize`` output for every case and prints a diff against
+the vendored splitter (``metrics_tpu.functional.text.sentence_split``),
+so discrepancies between the vendored rules and the learned model are
+visible before committing the refreshed goldens. (The committed file was
+authored offline from punkt's documented behavior — this tool exists so
+the oracle can be tightened to the real model the moment egress allows.)
+"""
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GOLDENS = os.path.join(HERE, "..", "tests", "text", "punkt_goldens.json")
+
+
+def main() -> int:
+    import nltk
+
+    try:
+        nltk.data.find("tokenizers/punkt_tab")
+    except LookupError:
+        nltk.download("punkt_tab")
+
+    sys.path.insert(0, os.path.join(HERE, ".."))
+    from metrics_tpu.functional.text.sentence_split import split_sentences
+
+    with open(GOLDENS) as f:
+        doc = json.load(f)
+
+    drift = 0
+    for case in doc["cases"]:
+        recorded = nltk.sent_tokenize(case["text"])
+        vendored = split_sentences(case["text"])
+        if recorded != case["sentences"]:
+            print(f"UPDATED golden: {case['text']!r}\n  was: {case['sentences']}\n  now: {recorded}")
+        if recorded != vendored:
+            drift += 1
+            print(f"VENDORED SPLITTER DRIFT: {case['text']!r}\n  punkt:    {recorded}\n  vendored: {vendored}")
+        case["sentences"] = recorded
+
+    with open(GOLDENS, "w") as f:
+        json.dump(doc, f, indent=2, ensure_ascii=False)
+        f.write("\n")
+    print(f"wrote {GOLDENS} ({len(doc['cases'])} cases, {drift} vendored-splitter drifts)")
+    return 1 if drift else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
