@@ -4,8 +4,5 @@ from metrics_tpu.audio.sdr import (  # noqa: F401
     SignalDistortionRatio,
 )
 from metrics_tpu.audio.snr import ScaleInvariantSignalNoiseRatio, SignalNoiseRatio  # noqa: F401
+from metrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality  # noqa: F401
 from metrics_tpu.audio.stoi import ShortTimeObjectiveIntelligibility  # noqa: F401
-from metrics_tpu.utilities.imports import _PESQ_AVAILABLE
-
-if _PESQ_AVAILABLE:
-    from metrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality  # noqa: F401

@@ -1,9 +1,10 @@
-"""PerceptualEvaluationSpeechQuality: host-side wrapper over the ``pesq`` C extension.
+"""PerceptualEvaluationSpeechQuality: host-side PESQ accumulation.
 
-Behavioral parity: /root/reference/torchmetrics/audio/pesq.py (122 LoC). Like
-the reference, the per-sample PESQ computation runs on host in numpy via the
-``pesq`` package (a C extension — strings/DSP reference code, not XLA work);
-only the scalar accumulators live on device.
+Behavioral parity: /root/reference/torchmetrics/audio/pesq.py (122 LoC).
+Per-sample PESQ runs on host in numpy — via the ``pesq`` package when
+installed (the reference's backend), otherwise the native P.862-structure
+core (metrics_tpu/functional/audio/_pesq_core.py; the reference raises
+instead). Only the scalar accumulators live on device.
 """
 from typing import Any
 
@@ -12,13 +13,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.utilities.imports import _PESQ_AVAILABLE
 
 Array = jax.Array
 
 
 class PerceptualEvaluationSpeechQuality(Metric):
-    """PESQ in 'wb'/'nb' mode (requires the ``pesq`` package)."""
+    """Average PESQ MOS-LQO in 'wb'/'nb' mode over accumulated samples."""
 
     is_differentiable = False
     higher_is_better = True
@@ -26,11 +26,6 @@ class PerceptualEvaluationSpeechQuality(Metric):
 
     def __init__(self, fs: int, mode: str, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        if not _PESQ_AVAILABLE:
-            raise ModuleNotFoundError(
-                "PerceptualEvaluationSpeechQuality metric requires that `pesq` is installed."
-                " Install it with `pip install pesq`."
-            )
         if fs not in (8000, 16000):
             raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
         self.fs = fs
@@ -42,19 +37,13 @@ class PerceptualEvaluationSpeechQuality(Metric):
         self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        import pesq as pesq_backend
+        from metrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality
 
-        preds_np = np.asarray(preds, dtype=np.float32)
-        target_np = np.asarray(target, dtype=np.float32)
-        if preds_np.ndim == 1:
-            scores = [pesq_backend.pesq(self.fs, target_np, preds_np, self.mode)]
-        else:
-            preds_np = preds_np.reshape(-1, preds_np.shape[-1])
-            target_np = target_np.reshape(-1, target_np.shape[-1])
-            scores = [pesq_backend.pesq(self.fs, t, p, self.mode) for t, p in zip(target_np, preds_np)]
-
-        self.sum_pesq = self.sum_pesq + float(np.sum(scores))
-        self.total = self.total + len(scores)
+        scores = np.atleast_1d(
+            np.asarray(perceptual_evaluation_speech_quality(preds, target, self.fs, self.mode))
+        )
+        self.sum_pesq = self.sum_pesq + float(scores.sum())
+        self.total = self.total + scores.size
 
     def compute(self) -> Array:
         return self.sum_pesq / self.total

@@ -1,3 +1,4 @@
+from metrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality  # noqa: F401
 from metrics_tpu.functional.audio.pit import permutation_invariant_training, pit_permutate  # noqa: F401
 from metrics_tpu.functional.audio.sdr import (  # noqa: F401
     scale_invariant_signal_distortion_ratio,

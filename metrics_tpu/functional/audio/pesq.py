@@ -1,0 +1,81 @@
+"""PESQ functional.
+
+Behavioral parity: /root/reference/torchmetrics/functional/audio/pesq.py
+(30-126). The reference is a host-side wrapper over the compiled ``pesq``
+package and raises when it is absent; here the backend is selected at call
+time — the ``pesq`` package when importable (exact reference parity),
+otherwise the native P.862-structure core (:mod:`._pesq_core`), so the
+metric produces values in egress-free environments. See the core's module
+docstring for its calibration status.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.imports import _PESQ_AVAILABLE
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+_warned_native = False
+
+
+def _backend_pesq(fs: int, target: np.ndarray, preds: np.ndarray, mode: str) -> float:
+    if _PESQ_AVAILABLE:
+        import pesq as pesq_backend
+
+        return float(pesq_backend.pesq(fs, target, preds, mode))
+    global _warned_native
+    if not _warned_native:
+        _warned_native = True
+        rank_zero_warn(
+            "The `pesq` package is not installed; PESQ is computed by the native"
+            " P.862-structure core. Scores follow the ITU pipeline's behavior but"
+            " are not bit-calibrated to the ITU implementation — see"
+            " metrics_tpu/functional/audio/_pesq_core.py for the calibration story."
+        )
+    from metrics_tpu.functional.audio._pesq_core import pesq_native
+
+    return pesq_native(fs, target, preds, mode)
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array, target: Array, fs: int, mode: str, keep_same_device: bool = False, **kwargs: Any
+) -> Array:
+    """PESQ MOS-LQO of ``preds`` against ``target`` (ref pesq.py:30-126).
+
+    Args:
+        preds: degraded signal, shape ``[..., time]``.
+        target: reference signal, shape ``[..., time]``.
+        fs: sampling frequency — 8000 or 16000 Hz.
+        mode: ``'nb'`` (narrow-band) or ``'wb'`` (wide-band; 16 kHz only
+            in the ITU algorithm, matching the ``pesq`` package).
+        keep_same_device: accepted for signature parity; values are host
+            scalars either way (the reference moves inputs to CPU too).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional import perceptual_evaluation_speech_quality
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(1))
+        >>> preds = jax.random.normal(key1, (8000,))
+        >>> target = jax.random.normal(key2, (8000,))
+        >>> float(perceptual_evaluation_speech_quality(preds, target, 8000, 'nb')) > 0
+        True
+    """
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    preds_np = np.asarray(preds, dtype=np.float32)
+    target_np = np.asarray(target, dtype=np.float32)
+    if preds_np.shape != target_np.shape:
+        raise RuntimeError(f"Predictions and targets are expected to have the same shape, got {preds_np.shape} and {target_np.shape}")
+
+    if preds_np.ndim == 1:
+        return jnp.asarray(_backend_pesq(fs, target_np, preds_np, mode), jnp.float32)
+    flat_p = preds_np.reshape(-1, preds_np.shape[-1])
+    flat_t = target_np.reshape(-1, target_np.shape[-1])
+    vals = np.array([_backend_pesq(fs, t, p, mode) for t, p in zip(flat_t, flat_p)], np.float32)
+    return jnp.asarray(vals.reshape(preds_np.shape[:-1]))

@@ -41,6 +41,11 @@ def _add_newline_to_end_of_each_sentence(x: str) -> str:
     splitter (:mod:`.sentence_split`, pinned against a recorded punkt
     corpus) takes over instead of raising, so rougeLsum works in
     egress-free environments.
+
+    Deliberate divergence: the reference's ``re.sub("<n>", "", x)``
+    discards its result (an upstream no-op, ref rouge.py:70), so
+    torchmetrics keeps literal ``<n>`` markers in rougeLsum inputs; here
+    the scrub is applied as evidently intended.
     """
     x = re.sub("<n>", "", x)
     if _punkt_usable():

@@ -168,16 +168,15 @@ class TestPIT:
             )
 
 
-def test_pesq_gated():
-    """PESQ still wraps the optional host package; raises cleanly if absent.
-    (STOI is native as of r2 — tests/audio/test_stoi.py.)"""
-    from metrics_tpu.utilities.imports import _PESQ_AVAILABLE
+def test_pesq_constructs_without_package():
+    """PESQ no longer requires the optional host package: the native
+    P.862-structure core backs it when `pesq` is absent (r3; STOI went
+    native in r2 — tests/audio/test_stoi.py). Numeric coverage:
+    tests/audio/test_pesq_native.py."""
+    from metrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality
 
-    if not _PESQ_AVAILABLE:
-        from metrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality
-
-        with pytest.raises(ModuleNotFoundError):
-            PerceptualEvaluationSpeechQuality(16000, "wb")
+    m = PerceptualEvaluationSpeechQuality(16000, "wb")
+    assert m.mode == "wb" and m.fs == 16000
 
 
 class TestSDRParameterAxes:

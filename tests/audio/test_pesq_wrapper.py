@@ -29,9 +29,9 @@ def pesq_stub(monkeypatch):
     module = types.ModuleType("pesq")
     module.pesq = fake_pesq
     monkeypatch.setitem(sys.modules, "pesq", module)
-    import metrics_tpu.audio.pesq as wrapper_mod
+    import metrics_tpu.functional.audio.pesq as functional_mod
 
-    monkeypatch.setattr(wrapper_mod, "_PESQ_AVAILABLE", True)
+    monkeypatch.setattr(functional_mod, "_PESQ_AVAILABLE", True)
     return calls
 
 

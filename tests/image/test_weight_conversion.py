@@ -280,7 +280,7 @@ def test_mixed_7c_uses_max_pool_branch():
     flat = convert_state_dict(state)
     # large enough that the E blocks see >1x1 spatial maps (pooling is
     # degenerate at 1x1, where max == avg and the test would pass vacuously)
-    x = np.random.RandomState(13).rand(1, 3, 139, 139).astype(np.float32)
+    x = np.random.RandomState(13).rand(1, 3, 111, 111).astype(np.float32)
     _, inter = _apply_converted(flat, 1008, jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
     inter = inter["intermediates"]
     e0_in = inter["InceptionD_0"]["__call__"][0]
