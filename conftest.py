@@ -40,6 +40,11 @@ def pytest_configure(config):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # Measured dead end, recorded so it isn't retried: running the suite
+    # with jax_disable_most_optimizations=True trades faster compiles for
+    # slower execution and came out net-NEGATIVE on this image (12m48 vs
+    # 12m19 full-suite; docs/test_timing.md) — the suite is
+    # execution-bound, not compile-bound.
     assert jax.device_count() == NUM_DEVICES, f"expected {NUM_DEVICES} forced host devices, got {jax.devices()}"
     # Persistent compilation cache: the suite is compile-dominated on this
     # single-core image (dozens of shard_map programs at 4-13 s each), so

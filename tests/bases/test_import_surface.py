@@ -82,13 +82,13 @@ def test_functional_subpackage_exports(subpackage, names):
     assert not missing, f"metrics_tpu.functional.{subpackage} missing exports: {missing}"
 
 
-def test_audio_optional_exports_follow_availability_flags():
-    """PESQ is gated like the reference (audio/__init__.py:6-11); STOI is
-    native as of r2 and always exported."""
+def test_audio_exports_unconditional():
+    """PESQ and STOI are always exported: STOI is native as of r2, and
+    PESQ is backed by the native P.862-structure core as of r3 when the
+    optional `pesq` package is absent (the reference gates the export)."""
     import metrics_tpu.audio as audio
-    from metrics_tpu.utilities.imports import _PESQ_AVAILABLE
 
-    assert hasattr(audio, "PerceptualEvaluationSpeechQuality") == _PESQ_AVAILABLE
+    assert hasattr(audio, "PerceptualEvaluationSpeechQuality")
     assert hasattr(audio, "ShortTimeObjectiveIntelligibility")
 
 

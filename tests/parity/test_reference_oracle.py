@@ -7,6 +7,8 @@ drop-in-parity evidence available: no recorded constants, no
 re-implemented oracles. Skipped wholesale when the reference checkout or
 torch is absent (see conftest). Run via ``make parity``.
 """
+import sys
+
 import numpy as np
 import pytest
 
@@ -266,6 +268,45 @@ TEXT_CASES = [
     ("squad", ([{"prediction_text": "the cat", "id": "1"}],
                [{"answers": {"answer_start": [0], "text": ["the cat sat"]}, "id": "1"}]), {}),
 ]
+
+
+@pytest.mark.parametrize("use_stemmer", [False, True], ids=["plain", "stemmer"])
+def test_rouge_matches_reference_with_shared_splitter(reference, use_stemmer, monkeypatch):
+    """ROUGE joins the live-oracle regime (VERDICT r2 weak #5).
+
+    The reference splits sentences with nltk's punkt data unconditionally
+    (even for non-Lsum keys, ref functional/text/rouge.py:318-321), and
+    that data cannot be downloaded here — so the SAME vendored splitter is
+    injected into both frameworks, making every other stage (rouge_score
+    normalization/tokenization, n-gram and LCS scoring, union-LCS for
+    Lsum, stemming, batch aggregation) a live comparison. The splitter
+    itself is pinned separately against the recorded punkt corpus
+    (tests/text/test_sentence_split.py).
+    """
+    from metrics_tpu.functional.text import rouge as our_rouge_mod
+    from metrics_tpu.functional.text.sentence_split import split_sentences
+
+    ref_rouge_mod = sys.modules[reference.functional.rouge_score.__module__]
+    monkeypatch.setattr(ref_rouge_mod, "_split_sentence", split_sentences)
+    # force our side onto the vendored splitter even if punkt data appears
+    monkeypatch.setattr(our_rouge_mod, "_punkt_usable", lambda: False)
+
+    preds = [
+        "Mr. Smith visited Washington. He gave a speech. The crowd cheered loudly.",
+        "The quick brown foxes jumped over lazy dogs. It rained later.",
+    ]
+    targets = [
+        ["Mr. Smith went to Washington. He delivered a speech. The crowd was loud."],
+        ["Quick brown dogs jumped over the lazy cat. Rain followed."],
+    ]
+    keys = ("rouge1", "rouge2", "rougeL", "rougeLsum")
+    mine = F.rouge_score(preds, targets, rouge_keys=keys, use_stemmer=use_stemmer)
+    ref = reference.functional.rouge_score(preds, targets, rouge_keys=keys, use_stemmer=use_stemmer)
+    assert set(mine) == set(ref)
+    for k in mine:
+        np.testing.assert_allclose(
+            np.asarray(mine[k], np.float64), float(ref[k]), rtol=1e-4, atol=1e-4, err_msg=k
+        )
 
 
 @pytest.mark.parametrize("case", TEXT_CASES, ids=_case_id)
