@@ -275,6 +275,26 @@ def _cfg_fid_stream(detail: dict) -> None:
     detail["fid_stream_vs_list_reldiff"] = round(abs(v_mom - v_list) / max(abs(v_list), 1e-9), 6)
 
 
+def _cfg_kid_compute(detail: dict) -> None:
+    """KID compute: 100 poly-MMD subsets as ONE lax.map program (the
+    per-subset eager loop paid 2 gathers + a dispatch per subset — ~200
+    tunnel round trips at ~100-200 ms each on this link)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.image import KernelInceptionDistance
+
+    rng = np.random.RandomState(2)
+    kid = KernelInceptionDistance(subsets=100, subset_size=500)
+    kid.update(jnp.asarray(rng.rand(2000, 768).astype(np.float32)), real=True)
+    kid.update(jnp.asarray(rng.rand(2000, 768).astype(np.float32) + 0.1), real=False)
+    np.random.seed(0)
+    t0 = time.perf_counter()
+    mean, _ = kid.compute()
+    jax.block_until_ready(mean)
+    detail["kid_compute_s_100_subsets"] = round(time.perf_counter() - t0, 2)
+
+
 def _bench_detail() -> dict:
     """Extra BASELINE.md configs; written to BENCH_DETAIL.json with BENCH_ALL=1."""
     import jax
@@ -296,6 +316,8 @@ def _bench_detail() -> dict:
     _mark("coco_map_compute_s_100_images")
     _cfg_fid_stream(detail)
     _mark("fid_compute_s_moments_5k_feats")
+    _cfg_kid_compute(detail)
+    _mark("kid_compute_s_100_subsets")
 
     # FID with the bundled Flax InceptionV3 (BASELINE.md config #5)
     from metrics_tpu.image import FrechetInceptionDistance, InceptionV3FeatureExtractor
