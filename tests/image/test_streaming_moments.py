@@ -84,6 +84,41 @@ class TestStreamingFID:
         merged = a.pure_merge(a.state(), b.state())
         assert float(a.pure_compute(merged)) == pytest.approx(float(whole.compute()), rel=1e-5)
 
+    def test_pure_sync_over_mesh(self):
+        # sum-reduced moment states sync with ONE collective per state
+        # over a mesh axis; the synced state equals single-device totals
+        import jax
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        devices = jax.devices()
+        if len(devices) < 8:
+            pytest.skip("needs 8 virtual devices")
+        mesh = Mesh(np.array(devices[:8]), ("dp",))
+        fid = FrechetInceptionDistance(sqrtm_method="eigh", feature_dim=D)
+        preds = jnp.asarray(np.random.RandomState(50).rand(8 * 16, D).astype(np.float32))
+
+        def worker(st, p):
+            st = fid.pure_update(st, p, real=True)
+            return fid.pure_sync(st, "dp")
+
+        state = fid.state()
+        specs = jax.tree_util.tree_map(lambda _: P(), state)
+        step = jax.jit(shard_map(worker, mesh=mesh, in_specs=(specs, P("dp")),
+                                 out_specs=specs, check_vma=False))
+        synced = step(state, preds)
+        # scalar states come back (1,)-shaped from the gather+reduce (the
+        # Pearson-style stacked layout); downstream math broadcasts over it
+        assert int(np.asarray(synced["real_num_samples"]).sum()) == 128
+        ref = FrechetInceptionDistance(sqrtm_method="eigh", feature_dim=D)
+        ref.update(preds, real=True)
+        np.testing.assert_allclose(
+            np.asarray(synced["real_features_sum"]), np.asarray(ref.real_features_sum), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(synced["real_outer_sum"]), np.asarray(ref.real_outer_sum), rtol=1e-5
+        )
+
     def test_reset_real_features_preserves_moments(self):
         fid = FrechetInceptionDistance(sqrtm_method="eigh", feature_dim=D, reset_real_features=False)
         for f in _feature_stream(7):

@@ -156,3 +156,9 @@ def test_class_parallel_example_runs():
     """The 2-D mesh example must stay runnable and numerically pinned
     (its delta+merge loop is also unit-pinned in tests/bases/test_2d_sharding.py)."""
     _load_example("class_parallel_eval").main()
+
+
+def test_streaming_perceptual_example_runs():
+    """The streaming FID/KID/IS example (fixed-shape states, scan epochs,
+    single-program KID subsets, moment merges) must stay runnable."""
+    _load_example("streaming_perceptual_eval").main()
