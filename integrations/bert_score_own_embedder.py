@@ -41,7 +41,9 @@ def main() -> None:
     preds = ["the quick brown fox jumps over the lazy dog", "hello there world"]
     target = ["a quick brown fox jumped over a lazy dog", "hello world"]
 
-    score = BERTScore(embedder=hash_embedder, idf=False)
+    # the hash embedder emits bare word tokens (no [CLS]/[SEP]), so the
+    # default special-token exclusion must be off
+    score = BERTScore(embedder=hash_embedder, idf=False, exclude_special_tokens=False)
     score.update(preds, target)
     result = score.compute()
     for key in ("precision", "recall", "f1"):

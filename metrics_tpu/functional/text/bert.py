@@ -64,9 +64,14 @@ def _greedy_cosine_match(
     tgt_emb = tgt_emb / jnp.clip(jnp.linalg.norm(tgt_emb, axis=-1, keepdims=True), min=1e-12)
 
     sim = jnp.einsum("nld,nmd->nlm", pred_emb, tgt_emb)  # (N, Lp, Lt)
-    big_neg = -1e9
-    sim = jnp.where(pred_mask[:, :, None] > 0, sim, big_neg)
-    sim = jnp.where(tgt_mask[:, None, :] > 0, sim, big_neg)
+    # masked positions contribute similarity 0 — the reference's exact
+    # semantics (it multiplies embeddings by the mask, so sims against
+    # masked positions are 0 and participate in the max, flooring it at
+    # 0; ref bert.py:309-311). A -1e9 fill would also leak the sentinel
+    # into P/R whenever one side has no attended tokens (e.g. a
+    # two-token sequence after special-token exclusion).
+    sim = jnp.where(pred_mask[:, :, None] > 0, sim, 0.0)
+    sim = jnp.where(tgt_mask[:, None, :] > 0, sim, 0.0)
 
     best_for_pred = sim.max(axis=2)  # (N, Lp)
     best_for_tgt = sim.max(axis=1)  # (N, Lt)
@@ -106,6 +111,23 @@ def transformers_flax_embedder(
     return _embed
 
 
+def _exclude_special_tokens(mask: Array) -> Array:
+    """Zero the [CLS] (first) and [SEP] (last attended) positions.
+
+    BERTScore matches CONTENT tokens only — the reference zeroes both
+    specials out of the attention mask before matching and length
+    normalization, with this same POSITIONAL rule (ref bert.py:86-101):
+    it assumes a CLS-first, right-padded layout, which is what
+    ``transformers`` tokenizers (and :func:`transformers_flax_embedder`)
+    produce. A left-padding or CLS-less custom embedder should pass
+    ``exclude_special_tokens=False`` and mask its specials itself.
+    """
+    mask = jnp.asarray(mask)
+    mask = mask.at[:, 0].set(0)
+    sep_pos = (mask - 0.1).cumsum(-1).argmax(-1)  # last attended position
+    return mask.at[jnp.arange(mask.shape[0]), sep_pos].set(0)
+
+
 def bert_score(
     preds: Union[str, List[str]],
     target: Union[str, List[str]],
@@ -114,9 +136,15 @@ def bert_score(
     idf: bool = False,
     rescale_with_baseline: bool = False,
     baseline: Optional[Dict[str, float]] = None,
+    exclude_special_tokens: bool = True,
     **kwargs: Any,
 ) -> Dict[str, Array]:
     """BERTScore P/R/F1 (ref bert.py:364-629).
+
+    ``exclude_special_tokens`` applies the reference's rule of dropping
+    the [CLS]/[SEP] positions from matching and length normalization
+    (live-parity-pinned); set it False for bare embedders whose token
+    streams carry no specials (e.g. the toy embedder below).
 
     Example (with a toy one-hot embedder):
         >>> import jax, jax.numpy as jnp
@@ -125,7 +153,8 @@ def bert_score(
         ...     ids = jnp.asarray([[vocab[w] for w in s.split()] for s in sents])
         ...     return jax.nn.one_hot(ids, 8), jnp.ones_like(ids), ids
         >>> from metrics_tpu.functional.text.bert import bert_score
-        >>> out = bert_score(["hello there"], ["hello there"], embedder=toy_embedder)
+        >>> out = bert_score(["hello there"], ["hello there"], embedder=toy_embedder,
+        ...                  exclude_special_tokens=False)
         >>> float(out["f1"][0])
         1.0
     """
@@ -146,6 +175,9 @@ def bert_score(
 
     pred_emb, pred_mask, pred_ids = embedder(list(preds))
     tgt_emb, tgt_mask, tgt_ids = embedder(list(target))
+    if exclude_special_tokens:
+        pred_mask = _exclude_special_tokens(pred_mask)
+        tgt_mask = _exclude_special_tokens(tgt_mask)
 
     pred_weights = tgt_weights = None
     if idf:

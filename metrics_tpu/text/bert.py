@@ -46,6 +46,7 @@ class BERTScore(Metric):
         idf: bool = False,
         rescale_with_baseline: bool = False,
         baseline: Optional[Dict[str, float]] = None,
+        exclude_special_tokens: bool = True,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -54,6 +55,7 @@ class BERTScore(Metric):
         self.idf = idf
         self.rescale_with_baseline = rescale_with_baseline
         self.baseline = baseline
+        self.exclude_special_tokens = exclude_special_tokens
         self._preds: List[str] = []
         self._target: List[str] = []
 
@@ -74,6 +76,7 @@ class BERTScore(Metric):
             idf=self.idf,
             rescale_with_baseline=self.rescale_with_baseline,
             baseline=self.baseline,
+            exclude_special_tokens=self.exclude_special_tokens,
         )
 
     def reset(self) -> None:

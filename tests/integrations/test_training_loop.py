@@ -162,3 +162,10 @@ def test_streaming_perceptual_example_runs():
     """The streaming FID/KID/IS example (fixed-shape states, scan epochs,
     single-program KID subsets, moment merges) must stay runnable."""
     _load_example("streaming_perceptual_eval").main()
+
+
+def test_bert_score_example_runs(capsys):
+    """The own-embedder BERTScore example must stay runnable and sane."""
+    _load_example("bert_score_own_embedder").main()
+    out = capsys.readouterr().out
+    assert "f1" in out and "-1" not in out  # no masking-sentinel leakage

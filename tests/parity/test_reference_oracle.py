@@ -7,6 +7,7 @@ drop-in-parity evidence available: no recorded constants, no
 re-implemented oracles. Skipped wholesale when the reference checkout or
 torch is absent (see conftest). Run via ``make parity``.
 """
+import os
 import sys
 
 import numpy as np
@@ -30,6 +31,12 @@ _reg_preds = _RNG.rand(_N).astype(np.float32)
 _reg_target = (_RNG.rand(_N) + 0.1).astype(np.float32)
 _ml_probs = _RNG.rand(_N, _C).astype(np.float32)
 _ml_labels = _RNG.randint(0, 2, (_N, _C))
+# multidim-multiclass (B, C, extra) and multioutput regression fixtures
+_md_logits = _RNG.rand(16, _C, 8).astype(np.float32)
+_md_probs = _md_logits / _md_logits.sum(1, keepdims=True)
+_md_labels = _RNG.randint(0, _C, (16, 8))
+_mo_preds = _RNG.rand(_N, 3).astype(np.float32)
+_mo_target = (_RNG.rand(_N, 3) + 0.1).astype(np.float32)
 
 
 def _run_ref(reference, name, *args, **kwargs):
@@ -80,6 +87,23 @@ CLASSIFICATION_CASES = [
     ("coverage_error", (_ml_probs, _ml_labels), {}),
     ("label_ranking_average_precision", (_ml_probs, _ml_labels), {}),
     ("label_ranking_loss", (_ml_probs, _ml_labels), {}),
+    # round-3 sweep: remaining parameter axes through the live oracle
+    ("precision_recall", (_preds_int, _labels), dict(average="macro", num_classes=_C)),
+    ("accuracy", (_md_probs, _md_labels), dict(num_classes=_C, mdmc_average="samplewise")),
+    ("accuracy", (_md_probs, _md_labels), dict(num_classes=_C, mdmc_average="global")),
+    ("precision", (_md_probs, _md_labels), dict(average="macro", num_classes=_C, mdmc_average="global")),
+    ("stat_scores", (_md_probs, _md_labels), dict(reduce="macro", num_classes=_C, mdmc_reduce="samplewise")),
+    ("accuracy", (_preds_int, _labels), dict(num_classes=_C, ignore_index=0)),
+    ("precision", (_probs, _labels), dict(average="macro", num_classes=_C, top_k=2)),
+    ("fbeta_score", (_preds_int, _labels), dict(beta=0.5, average="weighted", num_classes=_C)),
+    ("auroc", (_binary_probs, _binary_labels), dict(max_fpr=0.5)),
+    ("cohen_kappa", (_preds_int, _labels), dict(num_classes=_C, weights="linear")),
+    ("cohen_kappa", (_preds_int, _labels), dict(num_classes=_C, weights="quadratic")),
+    ("hamming_distance", (_binary_probs, _binary_labels), dict(threshold=0.3)),
+    ("jaccard_index", (_preds_int, _labels), dict(num_classes=_C, ignore_index=0)),
+    ("calibration_error", (_binary_probs, _binary_labels), dict(n_bins=10, norm="l2")),
+    ("calibration_error", (_binary_probs, _binary_labels), dict(n_bins=10, norm="max")),
+    ("hinge_loss", (_binary_probs * 2 - 1, _binary_labels), dict(squared=True)),
 ]
 
 REGRESSION_CASES = [
@@ -95,7 +119,14 @@ REGRESSION_CASES = [
     ("pearson_corrcoef", (_reg_preds, _reg_target), {}),
     ("spearman_corrcoef", (_reg_preds, _reg_target), {}),
     ("cosine_similarity", (_ml_probs, _ml_probs + 0.1), dict(reduction="mean")),
+    ("cosine_similarity", (_ml_probs, _ml_probs + 0.1), dict(reduction="sum")),
+    ("cosine_similarity", (_ml_probs, _ml_probs + 0.1), dict(reduction="none")),
     ("tweedie_deviance_score", (_reg_preds + 0.1, _reg_target), dict(power=1.5)),
+    ("tweedie_deviance_score", (_reg_preds + 0.1, _reg_target), dict(power=0.0)),
+    ("tweedie_deviance_score", (_reg_preds + 0.1, _reg_target), dict(power=2.0)),
+    ("r2_score", (_mo_preds, _mo_target), dict(multioutput="raw_values")),
+    ("r2_score", (_reg_preds, _reg_target), dict(adjusted=2)),
+    ("explained_variance", (_mo_preds, _mo_target), dict(multioutput="uniform_average")),
 ]
 
 PAIRWISE_CASES = [
@@ -159,6 +190,9 @@ IMAGE_CASES = [
     # reference snapshot) fits the smallest of the 5 halved scales
     ("multiscale_structural_similarity_index_measure", (_img_big_a, _img_big_b),
      dict(data_range=1.0, kernel_size=9, sigma=1.0)),
+    ("image_gradients", (_RNG.rand(2, 3, 16, 16).astype(np.float32),), {}),
+    ("spectral_distortion_index",
+     (_RNG.rand(2, 3, 32, 32).astype(np.float32) + 0.2, _RNG.rand(2, 3, 32, 32).astype(np.float32) + 0.2), {}),
     # 3D (volumetric) SSIM, gaussian and uniform kernels
     ("structural_similarity_index_measure",
      (_RNG.rand(1, 1, 24, 24, 24).astype(np.float32), _RNG.rand(1, 1, 24, 24, 24).astype(np.float32)),
@@ -253,6 +287,10 @@ def test_pit_matches_reference(reference, metric_name, eval_func, pit_kwargs):
     tol = 1e-3 if metric_name == "signal_distortion_ratio" else 1e-4
     np.testing.assert_allclose(np.asarray(mine_metric), ref_metric.numpy(), rtol=tol, atol=tol)
     np.testing.assert_array_equal(np.asarray(mine_perm), ref_perm.numpy())
+    # pit_permutate applies the best permutation identically
+    mine_reordered = F.pit_permutate(jnp.asarray(preds), mine_perm)
+    ref_reordered = reference.functional.pit_permutate(torch.from_numpy(preds), ref_perm)
+    np.testing.assert_allclose(np.asarray(mine_reordered), ref_reordered.numpy(), rtol=1e-6)
 
 
 TEXT_CASES = [
@@ -324,6 +362,73 @@ def test_text_matches_reference(reference, case):
             )
     else:
         np.testing.assert_allclose(np.asarray(mine, np.float64), float(ref), rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+@pytest.fixture(scope="module")
+def tiny_bert_pair(tmp_path_factory):
+    """One tiny BERT checkpoint loaded by BOTH frameworks: Flax for ours,
+    the same weights converted tensor-for-tensor into a torch BertModel
+    for the reference (hidden states agree to ~2e-7)."""
+    transformers = pytest.importorskip("transformers")
+    import torch
+    from transformers import BertConfig, BertModel, BertTokenizerFast, FlaxBertModel
+    from transformers.modeling_flax_pytorch_utils import load_flax_weights_in_pytorch_model
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "the", "cat", "sat", "on",
+             "mat", "a", "dog", "ran", "hello", "there", "quick", "brown", "fox"]
+    d = str(tmp_path_factory.mktemp("tiny_bert_parity"))
+    with open(os.path.join(d, "vocab.txt"), "w") as f:
+        f.write("\n".join(vocab))
+    tokenizer = BertTokenizerFast(vocab_file=os.path.join(d, "vocab.txt"), do_lower_case=True)
+    config = BertConfig(vocab_size=len(vocab), hidden_size=8, num_hidden_layers=1,
+                        num_attention_heads=2, intermediate_size=16, max_position_embeddings=64)
+    flax_model = FlaxBertModel(config, seed=0)
+    tokenizer.save_pretrained(d)
+    flax_model.save_pretrained(d)
+    # NOT from_pretrained(..., from_flax=True): that path inits the torch
+    # module on the meta device and the copy is a silent no-op in this
+    # transformers version — convert into a materialized model instead
+    torch_model = load_flax_weights_in_pytorch_model(BertModel(config), flax_model.params)
+    torch_model.eval()
+    return d, tokenizer, torch_model
+
+
+@pytest.mark.parametrize("idf", [False, True], ids=["plain", "idf"])
+def test_bert_score_matches_reference(reference, tiny_bert_pair, idf):
+    """BERTScore end-to-end vs the running reference: same weights drive
+    our Flax embedder and the reference's torch path (user model +
+    user_forward_fn), so tokenization, special-token exclusion, greedy
+    cosine matching, IDF weighting, and length normalization are all
+    compared live."""
+    import torch
+
+    d, tokenizer, torch_model = tiny_bert_pair
+
+    preds = ["the cat sat on the mat", "hello there"]
+    target = ["a cat sat on a mat", "hello dog"]
+
+    from metrics_tpu.functional.text.bert import bert_score as our_bert, transformers_flax_embedder
+
+    ours = our_bert(preds, target, embedder=transformers_flax_embedder(d, max_length=32), idf=idf)
+
+    def fwd(model, batch):
+        with torch.no_grad():
+            return model(batch["input_ids"], batch["attention_mask"]).last_hidden_state
+
+    ref = reference.functional.bert_score(
+        preds, target, model=torch_model, user_tokenizer=tokenizer, user_forward_fn=fwd,
+        max_length=32, num_threads=0, verbose=False, idf=idf,
+    )
+    # reference quirk: its dataset sorts sentences by token length and the
+    # scores come back in that order (ref bert.py:221, never unsorted); we
+    # keep input order, so reorder ours the same way for the comparison
+    # (the fixture's pred/target lengths sort identically, keeping pairs
+    # aligned through the reference's independent per-side sort)
+    order = np.argsort([len(tokenizer(p)["input_ids"]) for p in preds], kind="stable")
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(
+            np.asarray(ours[key])[order], np.asarray(ref[key]), rtol=1e-4, atol=1e-4, err_msg=key
+        )
 
 
 # ----------------------------------------------------- module-class parity

@@ -284,21 +284,32 @@ class TestBERTScore:
     def test_identical_is_one(self):
         from metrics_tpu.functional import bert_score
 
-        out = bert_score(["hello world"], ["hello world"], embedder=self._toy_embedder)
+        out = bert_score(["hello world"], ["hello world"], embedder=self._toy_embedder, exclude_special_tokens=False)
         np.testing.assert_allclose(float(out["f1"][0]), 1.0, atol=1e-6)
 
     def test_overlap_f1(self):
         from metrics_tpu.functional import bert_score
 
         # one-hot embeddings -> BERTScore reduces to token-overlap P/R
-        out = bert_score(["a b c d"], ["a b x y"], embedder=self._toy_embedder)
+        out = bert_score(["a b c d"], ["a b x y"], embedder=self._toy_embedder, exclude_special_tokens=False)
         np.testing.assert_allclose(float(out["precision"][0]), 0.5, atol=1e-6)
         np.testing.assert_allclose(float(out["recall"][0]), 0.5, atol=1e-6)
+
+    def test_empty_side_after_exclusion_scores_zero(self):
+        # a two-token sequence loses both tokens to [CLS]/[SEP]-style
+        # exclusion; the empty side must score 0 (the reference's
+        # zeroed-embedding semantics), never leak a masking sentinel
+        from metrics_tpu.functional import bert_score
+
+        out = bert_score(["a b"], ["a b c d"], embedder=self._toy_embedder)
+        assert float(out["precision"][0]) == 0.0
+        assert 0.0 <= float(out["recall"][0]) <= 1.0
+        assert float(out["f1"][0]) == 0.0
 
     def test_module_and_requires_embedder(self):
         from metrics_tpu import BERTScore
 
-        m = BERTScore(embedder=self._toy_embedder)
+        m = BERTScore(embedder=self._toy_embedder, exclude_special_tokens=False)
         m.update(["a b"], ["a b"])
         out = m.compute()  # module compute squeezes size-1 results to scalars
         np.testing.assert_allclose(float(out["f1"]), 1.0, atol=1e-6)
@@ -311,7 +322,7 @@ class TestBERTScore:
     def test_idf(self):
         from metrics_tpu.functional import bert_score
 
-        out = bert_score(["a b", "a c"], ["a b", "a d"], embedder=self._toy_embedder, idf=True)
+        out = bert_score(["a b", "a c"], ["a b", "a d"], embedder=self._toy_embedder, idf=True, exclude_special_tokens=False)
         assert np.all(np.isfinite(np.asarray(out["f1"])))
 
 
