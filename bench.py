@@ -487,6 +487,7 @@ def _bench_detail_fast() -> dict:
         ("retrieval", _cfg_retrieval),
         ("coco_map", _cfg_coco),
         ("fid_stream", _cfg_fid_stream),
+        ("kid_compute", _cfg_kid_compute),
     ]
     for key, fn in configs:
         if time.perf_counter() - t_start > budget:
