@@ -441,6 +441,11 @@ _mod_reg_p = _RNG.rand(_NBATCH, _B).astype(np.float32)
 _mod_reg_t = (_RNG.rand(_NBATCH, _B) + 0.1).astype(np.float32)
 _mdmc_preds = _RNG.randint(0, _C, (_NBATCH, _B, 6))
 _mdmc_target = _RNG.randint(0, _C, (_NBATCH, _B, 6))
+_mod_bin_p = _RNG.rand(_NBATCH, _B).astype(np.float32)
+_mod_bin_l = _RNG.randint(0, 2, (_NBATCH, _B))
+_mod_dist_q = _RNG.rand(_NBATCH, _B, _C).astype(np.float32)
+_mod_dist_q /= _mod_dist_q.sum(-1, keepdims=True)
+_mod_probs_norm = _mod_probs / _mod_probs.sum(-1, keepdims=True)
 
 MODULE_CASES = [
     ("Accuracy", dict(num_classes=_C, average="macro"), "cls"),
@@ -464,6 +469,14 @@ MODULE_CASES = [
     ("SpearmanCorrCoef", {}, "reg"),
     ("R2Score", {}, "reg"),
     ("ExplainedVariance", {}, "reg"),
+    # round-3 additions: probability-input, distribution, and binary kinds
+    ("HammingDistance", {}, "cls"),
+    ("CalibrationError", dict(n_bins=10), "bin"),
+    ("CalibrationError", dict(n_bins=10, norm="l2"), "bin"),
+    ("HingeLoss", {}, "bin"),
+    ("AUROC", {}, "bin"),
+    ("AveragePrecision", {}, "bin"),
+    ("KLDivergence", {}, "dist"),
 ]
 
 
@@ -491,6 +504,10 @@ def test_module_accumulation_matches_reference(reference, case):
         batches = [(_mod_probs[i], _mod_labels[i]) for i in range(_NBATCH)]
     elif kind == "mdmc":
         batches = [(_mdmc_preds[i], _mdmc_target[i]) for i in range(_NBATCH)]
+    elif kind == "bin":
+        batches = [(_mod_bin_p[i], _mod_bin_l[i]) for i in range(_NBATCH)]
+    elif kind == "dist":
+        batches = [(_mod_probs_norm[i], _mod_dist_q[i]) for i in range(_NBATCH)]
     else:
         batches = [(_mod_reg_p[i], _mod_reg_t[i]) for i in range(_NBATCH)]
 
@@ -505,10 +522,11 @@ def test_module_accumulation_matches_reference(reference, case):
     )
 
 
-# NOTE: no live ROUGE case — the REFERENCE's rouge_score functional calls
-# nltk sentence tokenization unconditionally and the punkt data is absent
-# from this zero-egress image; our ROUGE is pinned against the rouge_score
-# package itself in tests/text/test_text.py (a stronger oracle).
+# ROUGE's live case lives in test_rouge_matches_reference_with_shared_splitter
+# above (the REFERENCE's rouge_score calls nltk sentence tokenization
+# unconditionally and the punkt data is absent from this zero-egress image,
+# so the same vendored splitter is injected into both sides); our ROUGE is
+# additionally pinned against the rouge_score package in tests/text/test_text.py.
 def test_sacre_bleu_matches_reference(reference):
     preds = ["the cat is on the mat", "hello there general kenobi"]
     targets = [["there is a cat on the mat"], ["hello there general kenobi"]]
