@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test parity doctest bench tpu-smoke clean
+.PHONY: test parity doctest bench tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,6 +13,15 @@ parity:
 # on-device smoke suite: needs a live TPU backend (skips itself otherwise)
 tpu-smoke:
 	METRICS_TPU_SMOKE=1 python -m pytest tests/tpu_smoke/ -q
+
+# opportunistic chip-evidence capture (VERDICT r3 #1): run at every
+# healthy-tunnel moment — smoke suite + bench headline + fast detail, all
+# appending timestamped records to TPU_CAPTURES.jsonl. Both halves are
+# watchdogged, skip the recovery window, and skip the (evidence-free) CPU
+# fallback, so a wedged tunnel costs probe time only.
+tpu-capture:
+	-METRICS_TPU_SMOKE=1 python -m pytest tests/tpu_smoke/ -q
+	-BENCH_RECOVERY_BUDGET=0 BENCH_NO_CPU_FALLBACK=1 python bench.py
 
 doctest:
 	JAX_PLATFORMS=cpu python -m pytest --doctest-modules metrics_tpu/ -q

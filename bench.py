@@ -22,6 +22,14 @@ import numpy as np
 BATCH, NUM_CLASSES = 1024, 128
 ITERS = 200
 
+_CAPTURES_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "TPU_CAPTURES.jsonl")
+
+
+def _is_accelerator(device: str) -> bool:
+    """One predicate for 'this device string names a real accelerator'."""
+    d = str(device)
+    return bool(d) and "CPU" not in d.upper() and "unavailable" not in d
+
 
 def _bench_ours() -> float:
     import jax
@@ -514,8 +522,8 @@ def _write_detail(detail: dict) -> None:
                 existing = json.load(f)
         except Exception:
             existing = {}
-        existing_on_accel = "CPU" not in str(existing.get("device", "CPU")).upper()
-        ours_on_accel = "CPU" not in str(detail.get("device", "")).upper()
+        existing_on_accel = _is_accelerator(existing.get("device", ""))
+        ours_on_accel = _is_accelerator(detail.get("device", ""))
         existing_full = existing.get("suite", "full") == "full"
         # accelerator evidence outranks CPU evidence; within the same device
         # class, a full capture outranks a fast subset
@@ -550,7 +558,7 @@ def _record_capture(kind: str, device: str, payload: dict) -> None:
     landed on a real accelerator — the audit trail VERDICT r2 asked for:
     every TPU claim in the repo should trace to a committed (ISO time,
     device, code rev) artifact. CPU runs are not recorded (replaceable)."""
-    if "CPU" in device.upper():
+    if not _is_accelerator(device):
         return
     rec = {"kind": kind, "device": device}
     rec.update(payload)
@@ -558,12 +566,65 @@ def _record_capture(kind: str, device: str, payload: dict) -> None:
     rec.setdefault("ts_utc", datetime.datetime.now(
         datetime.timezone.utc).isoformat(timespec="seconds"))
     rec.setdefault("git_rev", _git_rev())
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "TPU_CAPTURES.jsonl")
     try:
-        with open(path, "a") as f:
+        with open(_CAPTURES_PATH, "a") as f:
             f.write(json.dumps(rec) + "\n")
     except Exception as err:  # the record is evidence, not a dependency
         print(f"# capture record write failed: {err}", file=sys.stderr, flush=True)
+
+
+def _last_tpu_capture() -> dict | None:
+    """Most recent committed ``bench_headline`` capture from a real accelerator.
+
+    Round-end tunnel wedges erased three rounds of chip evidence from the
+    driver-parsed JSON line (BENCH_r01..r03 all landed on CPU while healthy
+    on-TPU numbers sat in TPU_CAPTURES.jsonl). This makes the capture log the
+    durable source: when the live run falls back to CPU, the final line still
+    carries the latest on-chip headline — explicitly marked ``stale`` with its
+    own timestamp and git rev, never presented as the live number.
+    """
+    best = None
+    try:
+        with open(_CAPTURES_PATH) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") == "bench_headline" and rec.get("accuracy_update_us"):
+                    best = rec  # file is append-only: last matching line wins
+    except OSError:
+        return None
+    return best
+
+
+def _attach_tpu_provenance(result: dict) -> dict:
+    """Ensure the driver-parsed line always names TPU evidence.
+
+    Live accelerator run → provenance is the run itself (``stale: false``).
+    CPU fallback → embed the newest committed on-TPU headline as
+    ``tpu_provenance`` with ``stale: true`` so the chip number and its
+    (timestamp, git rev) audit trail survive a wedged round-end tunnel.
+    """
+    device = str(result.get("device", ""))
+    if _is_accelerator(device):
+        result["tpu_provenance"] = {"stale": False, "device": device}
+        return result
+    cap = _last_tpu_capture()
+    if cap is not None:
+        base = cap.get("torch_cpu_baseline_us")
+        val = cap["accuracy_update_us"]
+        result["tpu_provenance"] = {
+            "stale": True,
+            "device": cap.get("device"),
+            "value": val,
+            "unit": "us/call",
+            "vs_baseline": round(base / val, 3) if base else None,
+            "ts_utc": cap.get("ts_utc"),
+            "git_rev": cap.get("git_rev"),
+            "note": "most recent committed on-TPU headline (live run fell back to CPU)",
+        }
+    return result
 
 
 def _worker_main() -> None:
@@ -788,6 +849,17 @@ def main() -> None:
             print("# retrying TPU bench after fast failure", file=sys.stderr, flush=True)
             result, _ = _run_worker(dict(os.environ), tpu_timeout)
 
+    if result is None and os.environ.get("BENCH_NO_CPU_FALLBACK"):
+        # opportunistic-capture mode (make tpu-capture): CPU numbers are
+        # never recorded as evidence, so a wedged tunnel should cost probe
+        # time only — not a full CPU benchmark that produces nothing
+        print("# no TPU and BENCH_NO_CPU_FALLBACK set: skipping CPU run",
+              file=sys.stderr, flush=True)
+        result = {
+            "metric": f"Accuracy.update (multiclass B={BATCH} C={NUM_CLASSES}, jitted) latency",
+            "value": None, "unit": "us/call", "vs_baseline": None,
+            "device": "unavailable (TPU wedged; CPU fallback disabled)",
+        }
     if result is None:
         print("# falling back to CPU backend", file=sys.stderr, flush=True)
         env = dict(os.environ)
@@ -804,7 +876,7 @@ def main() -> None:
             "vs_baseline": None,
             "device": "unavailable (all backends failed; see stderr)",
         }
-    print(json.dumps(result), flush=True)
+    print(json.dumps(_attach_tpu_provenance(result)), flush=True)
 
 
 if __name__ == "__main__":
