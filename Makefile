@@ -20,7 +20,7 @@ tpu-smoke:
 # watchdogged, skip the recovery window, and skip the (evidence-free) CPU
 # fallback, so a wedged tunnel costs probe time only.
 tpu-capture:
-	-METRICS_TPU_SMOKE=1 python -m pytest tests/tpu_smoke/ -q
+	-timeout 900 env METRICS_TPU_SMOKE=1 python -m pytest tests/tpu_smoke/ -q
 	-BENCH_RECOVERY_BUDGET=0 BENCH_NO_CPU_FALLBACK=1 python bench.py
 
 doctest:

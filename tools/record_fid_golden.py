@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Record the dual-stack end-to-end FID golden.
+
+Runs BOTH pipelines (the reference's torch+scipy FID pipeline and this
+framework's checkpoint→converter→extractor→FID path — see
+tests/image/test_fid_end_to_end.py) over the fixed seeded checkpoint and
+image sets, and writes ``tests/image/fid_end_to_end_golden.json``.
+
+Needs torch + scipy (both baked into this image). Re-run only when the
+synthetic-state generator, the converter mapping, or the network forward
+changes — the committed golden is the durable cross-stack parity artifact.
+
+    python tools/record_fid_golden.py [--n 32]
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests", "image"))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--n", type=int, default=32, help="images per distribution")
+    args = parser.parse_args(argv)
+
+    import jax
+    import scipy
+    import torch
+
+    from test_fid_end_to_end import GOLDEN_PATH, run_both_pipelines
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        rec = run_both_pipelines(args.n, tmpdir)
+    rec["versions"] = {
+        "jax": jax.__version__,
+        "torch": torch.__version__,
+        "scipy": scipy.__version__,
+    }
+    with open(GOLDEN_PATH, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    print(f"wrote {GOLDEN_PATH}:")
+    print(json.dumps(rec, indent=2))
+
+
+if __name__ == "__main__":
+    main()

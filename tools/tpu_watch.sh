@@ -1,9 +1,10 @@
 #!/bin/bash
 # Opportunistic chip-evidence watcher (VERDICT r3 #1): probe the TPU tunnel
-# every INTERVAL seconds; the moment it answers, fire `make tpu-capture`
-# (smoke suite + bench headline + fast detail -> TPU_CAPTURES.jsonl) and
-# exit. Run in the background at the start of a round so a healthy-tunnel
-# window is never missed while other work is in flight.
+# every INTERVAL seconds; the moment it answers with a REAL accelerator,
+# fire `make tpu-capture` (smoke suite + bench headline + fast detail ->
+# TPU_CAPTURES.jsonl) and exit once evidence was actually recorded. Run in
+# the background at the start of a round so a healthy-tunnel window is
+# never missed while other work is in flight.
 #
 # Usage: tools/tpu_watch.sh [max_seconds] [interval_seconds]
 set -u
@@ -14,17 +15,27 @@ START=$(date +%s)
 N=0
 while true; do
     N=$((N + 1))
-    if timeout 120 python -c "import jax; jax.devices(); print('BACKEND_OK')" 2>/dev/null | grep -q BACKEND_OK; then
-        echo "# tpu_watch: tunnel healthy on probe #$N ($(date -u +%FT%TZ)) — capturing"
-        make tpu-capture
-        echo "# tpu_watch: capture done ($(date -u +%FT%TZ))"
-        exit 0
+    # platform check matters: a CPU fallback also answers jax.devices()
+    # (the smoke conftest guards the same way) — only a real accelerator
+    # makes firing the capture worthwhile
+    if timeout 120 python -c "import jax; d = jax.devices()[0]; print('TPU_OK' if d.platform != 'cpu' else 'CPU_ONLY')" 2>/dev/null | grep -q TPU_OK; then
+        echo "# tpu_watch: accelerator healthy on probe #$N ($(date -u +%FT%TZ)) — capturing"
+        BEFORE=$(wc -l < TPU_CAPTURES.jsonl 2>/dev/null || echo 0)
+        # the capture target is internally watchdogged, but a tunnel wedging
+        # MID-capture would hang it (and this watcher) — bound the whole run
+        timeout 2400 make tpu-capture
+        AFTER=$(wc -l < TPU_CAPTURES.jsonl 2>/dev/null || echo 0)
+        if [ "$AFTER" -gt "$BEFORE" ]; then
+            echo "# tpu_watch: capture done, $((AFTER - BEFORE)) record(s) appended ($(date -u +%FT%TZ))"
+            exit 0
+        fi
+        echo "# tpu_watch: capture ran but recorded no evidence (tunnel lost mid-run?) — continuing watch"
     fi
     ELAPSED=$(( $(date +%s) - START ))
     if [ "$ELAPSED" -ge "$BUDGET" ]; then
         echo "# tpu_watch: budget ${BUDGET}s exhausted after $N probes"
         exit 1
     fi
-    echo "# tpu_watch: probe #$N wedged/failed (${ELAPSED}s elapsed), retrying in ${INTERVAL}s"
+    echo "# tpu_watch: probe #$N no accelerator (${ELAPSED}s elapsed), retrying in ${INTERVAL}s"
     sleep "$INTERVAL"
 done
