@@ -15,6 +15,7 @@ max detections (1, 10, 100), area ranges all/small/medium/large.
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu import native
@@ -116,6 +117,21 @@ class MeanAveragePrecision(Metric):
         self.add_state("detection_labels", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_boxes", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        # one list element PER IMAGE: the image boundaries are load-bearing,
+        # so cross-process sync must re-split after gathering (the generic
+        # list sync would concatenate ranks into one pseudo-image). The
+        # (trailing_shape, dtype) specs let ranks holding zero images still
+        # join the collectives — uneven per-rank image counts are the
+        # normal case for a sharded eval loop. The lengths_group names
+        # declare which states share per-image lengths, so one lengths
+        # collective serves each group.
+        self._ragged_state_specs = {
+            "detection_boxes": ((4,), jnp.float32, "detections"),
+            "detection_scores": ((), jnp.float32, "detections"),
+            "detection_labels": ((), jnp.int32, "detections"),
+            "groundtruth_boxes": ((4,), jnp.float32, "groundtruths"),
+            "groundtruth_labels": ((), jnp.int32, "groundtruths"),
+        }
 
     def update(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
         """Append per-image detections + groundtruths (ref mean_ap.py:264-305)."""

@@ -554,7 +554,27 @@ class Metric(ABC):
 
         input_dict = {attr: getattr(self, attr) for attr in self._reductions}
 
-        for attr, reduction_fn in self._reductions.items():
+        # Structure-preserving ("ragged") list states — declared via
+        # ``_ragged_state_specs`` — hold one array PER ELEMENT (e.g. mAP's
+        # per-image boxes) whose boundaries the pre-concatenation below
+        # would silently erase. They sync through a pack→gather→re-split
+        # protocol instead (see _gather_ragged) and skip the generic path.
+        ragged_specs = getattr(self, "_ragged_state_specs", None) or {}
+        # deterministic ORDER is load-bearing: every participant must issue
+        # the collectives in the same sequence, and set iteration order
+        # varies per process with the string-hash seed (observed as a gloo
+        # byte-size mismatch between two otherwise identical workers)
+        ragged_attrs = [a for a in ragged_specs if isinstance(input_dict.get(a), list)]
+        lengths_cache: Dict[str, Any] = {}
+        for attr in ragged_attrs:
+            object.__setattr__(
+                self,
+                attr,
+                self._gather_ragged(attr, input_dict[attr], base_gather, lengths_cache),
+            )
+            del input_dict[attr]
+
+        for attr in input_dict:
             # pre-concatenate list states to reduce number of collectives
             if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
@@ -579,7 +599,8 @@ class Metric(ABC):
             else:
                 output_dict[attr] = attr_gather(value)
 
-        for attr, reduction_fn in self._reductions.items():
+        for attr in output_dict:
+            reduction_fn = self._reductions[attr]
             out = output_dict[attr]
             if isinstance(out, list) and len(out) == 0:
                 object.__setattr__(self, attr, [])
@@ -592,6 +613,79 @@ class Metric(ABC):
                 raise TypeError("reduction_fn must be callable or None")
             reduced = reduction_fn(out) if reduction_fn is not None else out
             object.__setattr__(self, attr, reduced)
+
+    def _gather_ragged(
+        self, attr: str, value: list, base_gather: Callable, lengths_cache: Dict[str, Any]
+    ) -> list:
+        """Gather a structure-preserving list state across participants.
+
+        Subclasses declare ``_ragged_state_specs[attr] = (trailing_shape,
+        dtype[, lengths_group])`` for list states whose per-element
+        boundaries carry meaning (mAP's per-image boxes/scores/labels). The
+        generic list-state sync pre-concatenates into one collective, which
+        is right for sample-pool states (FID feature lists, CatMetric) but
+        erases element boundaries.
+
+        Eager path (ProcessEnv over DCN, host-side custom gathers): pack
+        into ``(concat(data), lengths)``, gather both, then re-split every
+        rank's data by its gathered lengths — so ranks with different (even
+        zero) element counts stay collective-aligned, the failure mode the
+        reference's per-element gather cannot handle. The declared
+        ``(trailing_shape, dtype)`` makes the zero-element rank's
+        placeholder constructible, and all data crosses in the declared
+        dtype so rank-local dtype drift (x64 mode on one side) can never
+        desynchronize collective byte sizes. States that share a STATIC
+        ``lengths_group`` (boxes/scores/labels all keyed by the same
+        images) reuse one lengths collective — static declaration, not
+        value-based grouping, because every rank must agree on the
+        collective sequence without seeing its peers' lengths.
+
+        Traced path (named-axis collectives inside ``shard_map``): lengths
+        are not concrete, so re-splitting is impossible — but the single
+        trace guarantees every shard holds the SAME element count, so a
+        per-element gather preserves boundaries exactly (the reference's
+        protocol, ref metric.py:243-268). Detected from the element values
+        BEFORE any packing op is issued; only the degenerate
+        empty-list-inside-trace case still issues (and discards) one tiny
+        lengths gather, because an empty list carries no tracers to
+        inspect.
+        """
+        spec = self._ragged_state_specs[attr]
+        trailing, dtype, group = spec if len(spec) == 3 else (*spec, None)
+
+        def _gather_per_element():
+            out = []
+            for v in value:
+                out.extend(base_gather(v))
+            return out
+
+        if any(isinstance(v, jax.core.Tracer) for v in value):
+            return _gather_per_element()
+
+        local_lengths = tuple(int(v.shape[0]) for v in value)
+        if group is not None and group in lengths_cache:
+            cached_local, gathered_lengths = lengths_cache[group]
+            if cached_local != local_lengths:
+                raise MetricsUserError(
+                    f"Ragged states in lengths_group {group!r} disagree on element"
+                    f" lengths ({attr}: {local_lengths} vs {cached_local}); states in"
+                    " one group must always be updated together."
+                )
+        else:
+            gathered_lengths = base_gather(jnp.asarray(local_lengths, jnp.int32))
+            if any(isinstance(g, jax.core.Tracer) for g in gathered_lengths):
+                return _gather_per_element()  # empty list inside a trace
+            gathered_lengths = [np.asarray(g).astype(int) for g in gathered_lengths]
+            if group is not None:
+                lengths_cache[group] = (local_lengths, gathered_lengths)
+        data = dim_zero_cat(value).astype(dtype) if value else jnp.zeros((0, *trailing), dtype)
+        gathered_data = base_gather(data)
+        out = []
+        for rank_lengths, rank_data in zip(gathered_lengths, gathered_data):
+            if rank_lengths.size == 0:
+                continue
+            out.extend(jnp.split(jnp.asarray(rank_data), np.cumsum(rank_lengths)[:-1]))
+        return out
 
     def _resolve_env(self) -> DistEnv:
         if self._sync_env is not None:

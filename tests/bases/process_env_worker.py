@@ -1,0 +1,105 @@
+"""Subprocess worker for the real two-process ProcessEnv tests.
+
+Launched twice (process_id 0/1) by ``test_process_env_real.py`` with a
+shared coordinator port. Each worker initializes ``jax.distributed`` on the
+CPU backend, updates metrics with ITS SHARD of a deterministic dataset, and
+lets ``compute()`` sync through the ambient environment — which must
+resolve to :class:`metrics_tpu.parallel.ProcessEnv`, the process-level
+allgather path a multi-host TPU pod uses over DCN. Results print as one
+``RESULT {json}`` line for the parent to compare against the
+single-process full-data values.
+
+Dataset split modes: ``even`` (balanced shards), ``uneven`` (unbalanced —
+exercises ProcessEnv's size-exchange/pad/trim), ``zero`` (rank 0 holds no
+detection images at all — exercises the ragged placeholder path).
+"""
+import json
+import os
+import sys
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, ROOT)
+
+
+def _dataset():
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    n, c = 24, 4
+    logits = rng.rand(n, c).astype(np.float32)
+    preds = logits / logits.sum(-1, keepdims=True)
+    target = rng.randint(0, c, n)
+    cat_values = np.arange(1.0, 11.0, dtype=np.float32)
+
+    det_preds, det_targs = [], []
+    for i in range(4):
+        nb = i + 1  # 1..4 boxes — per-image shapes all differ
+        boxes = rng.rand(nb, 4).astype(np.float32) * 50
+        boxes[:, 2:] += boxes[:, :2] + 5
+        gt = rng.rand(2, 4).astype(np.float32) * 50
+        gt[:, 2:] += gt[:, :2] + 5
+        det_preds.append(dict(boxes=boxes, scores=rng.rand(nb).astype(np.float32),
+                              labels=rng.randint(0, 3, nb)))
+        det_targs.append(dict(boxes=gt, labels=rng.randint(0, 3, 2)))
+    return preds, target, cat_values, det_preds, det_targs
+
+
+def _splits(mode):
+    """(acc split, cat split, detection split) as index boundaries for rank 0."""
+    return {
+        "even": (12, 5, 2),
+        "uneven": (5, 2, 1),
+        "zero": (5, 2, 0),
+    }[mode]
+
+
+def main():
+    process_id, port, mode = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=process_id
+    )
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, CatMetric
+    from metrics_tpu.detection import MeanAveragePrecision
+    from metrics_tpu.parallel import default_env
+
+    result = {
+        "env": type(default_env()).__name__,
+        "process_count": jax.process_count(),
+    }
+
+    preds, target, cat_values, det_preds, det_targs = _dataset()
+    acc_b, cat_b, det_b = _splits(mode)
+
+    def shard(seq, boundary):
+        return seq[:boundary] if process_id == 0 else seq[boundary:]
+
+    acc = Accuracy(num_classes=4, average="macro")
+    acc.update(jnp.asarray(shard(preds, acc_b)), jnp.asarray(shard(target, acc_b)))
+    result["accuracy"] = float(acc.compute())
+
+    cat = CatMetric()
+    cat.update(jnp.asarray(shard(cat_values, cat_b)))
+    result["cat"] = [float(v) for v in jnp.ravel(cat.compute())]
+
+    m = MeanAveragePrecision()
+    my_preds, my_targs = shard(det_preds, det_b), shard(det_targs, det_b)
+    if my_preds:
+        m.update(
+            [{k: jnp.asarray(v) for k, v in p.items()} for p in my_preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in my_targs],
+        )
+    import numpy as np
+
+    result["map"] = {k: np.asarray(v).tolist() for k, v in m.compute().items()}
+    # sync must not have destroyed the local state (compute unsyncs)
+    result["local_images_after_compute"] = len(m.detection_boxes)
+
+    print("RESULT " + json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

