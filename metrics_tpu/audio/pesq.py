@@ -18,13 +18,22 @@ Array = jax.Array
 
 
 class PerceptualEvaluationSpeechQuality(Metric):
-    """Average PESQ MOS-LQO in 'wb'/'nb' mode over accumulated samples."""
+    """Average PESQ MOS-LQO in 'wb'/'nb' mode over accumulated samples.
+
+    ``backend`` selects where the per-sample score comes from: ``'auto'``
+    uses the compiled ``pesq`` package when importable (exact reference
+    parity) and falls back to the native P.862-structure core with a
+    one-time warning; ``'pesq'`` requires the package (the reference's
+    behavior); ``'native'`` forces the core. Package-produced and
+    native-produced values are NOT comparable across environments — pin
+    the backend when numbers will be compared.
+    """
 
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
 
-    def __init__(self, fs: int, mode: str, **kwargs: Any) -> None:
+    def __init__(self, fs: int, mode: str, backend: str = "auto", **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if fs not in (8000, 16000):
             raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
@@ -32,6 +41,22 @@ class PerceptualEvaluationSpeechQuality(Metric):
         if mode not in ("wb", "nb"):
             raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
         self.mode = mode
+        if backend not in ("auto", "pesq", "native"):
+            raise ValueError(
+                f"Expected argument `backend` to be one of ['auto', 'pesq', 'native'] but got {backend}"
+            )
+        if backend == "pesq":
+            from metrics_tpu.utilities.imports import _PESQ_AVAILABLE
+
+            if not _PESQ_AVAILABLE:
+                # fail at construction like the reference module does
+                # (ref audio/pesq.py:83-87), not at the first update deep
+                # inside an eval loop
+                raise ModuleNotFoundError(
+                    "PerceptualEvaluationSpeechQuality metric requires that pesq is installed."
+                    " Either install as `pip install torchmetrics[audio]` or `pip install pesq`."
+                )
+        self.backend = backend
 
         self.add_state("sum_pesq", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
@@ -40,7 +65,11 @@ class PerceptualEvaluationSpeechQuality(Metric):
         from metrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality
 
         scores = np.atleast_1d(
-            np.asarray(perceptual_evaluation_speech_quality(preds, target, self.fs, self.mode))
+            np.asarray(
+                perceptual_evaluation_speech_quality(
+                    preds, target, self.fs, self.mode, backend=self.backend
+                )
+            )
         )
         self.sum_pesq = self.sum_pesq + float(scores.sum())
         self.total = self.total + scores.size

@@ -22,27 +22,44 @@ Array = jax.Array
 _warned_native = False
 
 
-def _backend_pesq(fs: int, target: np.ndarray, preds: np.ndarray, mode: str) -> float:
-    if _PESQ_AVAILABLE:
+def _backend_pesq(fs: int, target: np.ndarray, preds: np.ndarray, mode: str, backend: str) -> float:
+    if backend == "pesq" and not _PESQ_AVAILABLE:
+        # the reference's exact failure (ref functional/audio/pesq.py:76-80):
+        # an explicit package request must never silently change backend
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed."
+            " Either install as `pip install torchmetrics[audio]` or `pip install pesq`."
+        )
+    if backend != "native" and _PESQ_AVAILABLE:
         import pesq as pesq_backend
 
         return float(pesq_backend.pesq(fs, target, preds, mode))
-    global _warned_native
-    if not _warned_native:
-        _warned_native = True
-        rank_zero_warn(
-            "The `pesq` package is not installed; PESQ is computed by the native"
-            " P.862-structure core. Scores follow the ITU pipeline's behavior but"
-            " are not bit-calibrated to the ITU implementation — see"
-            " metrics_tpu/functional/audio/_pesq_core.py for the calibration story."
-        )
+    if backend == "auto":
+        global _warned_native
+        if not _warned_native:
+            _warned_native = True
+            rank_zero_warn(
+                "The `pesq` package is not installed; PESQ is computed by the"
+                " backend='native' P.862-structure core. Scores follow the ITU"
+                " pipeline's behavior but are not bit-calibrated to the ITU"
+                " implementation — pass backend='pesq' to require the package"
+                " instead, and record which backend produced any number you"
+                " compare across environments. See"
+                " metrics_tpu/functional/audio/_pesq_core.py for the calibration story."
+            )
     from metrics_tpu.functional.audio._pesq_core import pesq_native
 
     return pesq_native(fs, target, preds, mode)
 
 
 def perceptual_evaluation_speech_quality(
-    preds: Array, target: Array, fs: int, mode: str, keep_same_device: bool = False, **kwargs: Any
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    backend: str = "auto",
+    **kwargs: Any,
 ) -> Array:
     """PESQ MOS-LQO of ``preds`` against ``target`` (ref pesq.py:30-126).
 
@@ -54,6 +71,14 @@ def perceptual_evaluation_speech_quality(
             in the ITU algorithm, matching the ``pesq`` package).
         keep_same_device: accepted for signature parity; values are host
             scalars either way (the reference moves inputs to CPU too).
+        backend: ``'auto'`` (the compiled ``pesq`` package when importable
+            — exact reference parity — else the native core, with a
+            one-time warning naming the switch), ``'pesq'`` (require the
+            package; raises the reference's ``ModuleNotFoundError`` when
+            absent), or ``'native'`` (force the P.862-structure core —
+            structurally faithful but not bit-calibrated to the ITU
+            implementation; values are NOT comparable with
+            package-produced ones).
 
     Example:
         >>> import jax, jax.numpy as jnp
@@ -68,14 +93,20 @@ def perceptual_evaluation_speech_quality(
         raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
     if mode not in ("wb", "nb"):
         raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    if backend not in ("auto", "pesq", "native"):
+        raise ValueError(
+            f"Expected argument `backend` to be one of ['auto', 'pesq', 'native'] but got {backend}"
+        )
     preds_np = np.asarray(preds, dtype=np.float32)
     target_np = np.asarray(target, dtype=np.float32)
     if preds_np.shape != target_np.shape:
         raise RuntimeError(f"Predictions and targets are expected to have the same shape, got {preds_np.shape} and {target_np.shape}")
 
     if preds_np.ndim == 1:
-        return jnp.asarray(_backend_pesq(fs, target_np, preds_np, mode), jnp.float32)
+        return jnp.asarray(_backend_pesq(fs, target_np, preds_np, mode, backend), jnp.float32)
     flat_p = preds_np.reshape(-1, preds_np.shape[-1])
     flat_t = target_np.reshape(-1, target_np.shape[-1])
-    vals = np.array([_backend_pesq(fs, t, p, mode) for t, p in zip(flat_t, flat_p)], np.float32)
+    vals = np.array(
+        [_backend_pesq(fs, t, p, mode, backend) for t, p in zip(flat_t, flat_p)], np.float32
+    )
     return jnp.asarray(vals.reshape(preds_np.shape[:-1]))

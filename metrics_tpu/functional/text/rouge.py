@@ -33,7 +33,7 @@ ALLOWED_ROUGE_KEYS = {
 ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
 
 
-def _add_newline_to_end_of_each_sentence(x: str) -> str:
+def _add_newline_to_end_of_each_sentence(x: str, scrub_pegasus_markers: bool = False) -> str:
     """Sentence splitting for rougeLsum (ref rouge.py:64-72).
 
     The reference uses nltk's trained punkt model; when nltk (or its
@@ -42,12 +42,18 @@ def _add_newline_to_end_of_each_sentence(x: str) -> str:
     corpus) takes over instead of raising, so rougeLsum works in
     egress-free environments.
 
-    Deliberate divergence: the reference's ``re.sub("<n>", "", x)``
-    discards its result (an upstream no-op, ref rouge.py:70), so
-    torchmetrics keeps literal ``<n>`` markers in rougeLsum inputs; here
-    the scrub is applied as evidently intended.
+    Parity note: the reference's ``re.sub("<n>", "", x)`` discards its
+    result (an upstream bug it inherited, ref rouge.py:50), so
+    torchmetrics keeps literal ``<n>`` markers in rougeLsum inputs — and
+    so does this function by default, because drop-in behavioral parity is
+    the contract (live-pinned with an ``<n>``-bearing input in
+    tests/parity/test_reference_oracle.py). Pass
+    ``scrub_pegasus_markers=True`` (plumbed from ``rouge_score`` /
+    ``ROUGEScore``) to apply the scrub as the upstream comment evidently
+    intended.
     """
-    x = re.sub("<n>", "", x)
+    if scrub_pegasus_markers:
+        x = re.sub("<n>", "", x)
     if _punkt_usable():
         import nltk
 
@@ -188,6 +194,7 @@ def _rouge_score_update(
     stemmer: Optional[object] = None,
     normalizer: Optional[Callable[[str], str]] = None,
     tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+    scrub_pegasus_markers: bool = False,
 ) -> Dict[Union[int, str], List[Dict[str, Array]]]:
     """Per-sample ROUGE results, best- or avg-aggregated over references
     (ref rouge.py:133-236)."""
@@ -198,7 +205,9 @@ def _rouge_score_update(
         result_avg: Dict[Union[int, str], List[Dict[str, float]]] = {k: [] for k in rouge_keys_values}
 
         if "Lsum" in rouge_keys_values:
-            pred_sents_raw = _add_newline_to_end_of_each_sentence(pred_raw).split("\n")
+            pred_sents_raw = _add_newline_to_end_of_each_sentence(
+                pred_raw, scrub_pegasus_markers
+            ).split("\n")
 
         pred_tok = (
             list(tokenizer(normalizer(pred_raw) if normalizer else pred_raw))
@@ -218,7 +227,9 @@ def _rouge_score_update(
                 elif rouge_key == "L":
                     score = _rouge_l_score(pred_tok, tgt_tok)
                 else:  # Lsum
-                    tgt_sents_raw = _add_newline_to_end_of_each_sentence(tgt_raw).split("\n")
+                    tgt_sents_raw = _add_newline_to_end_of_each_sentence(
+                        tgt_raw, scrub_pegasus_markers
+                    ).split("\n")
                     pred_sents = [_normalize_and_tokenize_text(s, stemmer) for s in pred_sents_raw]
                     tgt_sents = [_normalize_and_tokenize_text(s, stemmer) for s in tgt_sents_raw]
                     score = _rouge_lsum_score(pred_sents, tgt_sents)
@@ -262,8 +273,14 @@ def rouge_score(
     normalizer: Optional[Callable[[str], str]] = None,
     tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
     rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+    scrub_pegasus_markers: bool = False,
 ) -> Dict[str, Array]:
     """ROUGE scores (ref rouge.py:259-379).
+
+    ``scrub_pegasus_markers=True`` strips literal ``"<n>"`` markers before
+    rougeLsum sentence splitting — the behavior the reference's discarded
+    ``re.sub`` evidently intends (ref rouge.py:50). The default keeps the
+    markers for bit-for-bit reference parity.
 
     Example:
         >>> from metrics_tpu.functional import rouge_score
@@ -301,7 +318,8 @@ def rouge_score(
         )
 
     sentence_results = _rouge_score_update(
-        preds, target, rouge_keys_values, accumulate, stemmer, normalizer, tokenizer
+        preds, target, rouge_keys_values, accumulate, stemmer, normalizer, tokenizer,
+        scrub_pegasus_markers=scrub_pegasus_markers,
     )
 
     output: Dict[str, List[Array]] = {

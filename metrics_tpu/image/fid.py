@@ -191,6 +191,31 @@ def _moments_to_mean_cov(num: Array, feat_sum: Array, outer_sum: Array) -> tuple
     return mu, sigma
 
 
+def _moments_to_mean_cov_host64(num: Array, feat_sum: Array, outer_sum: Array) -> tuple:
+    """Eager-path variant: the ``Σxxᵀ - n μμᵀ`` subtraction in host f64.
+
+    When feature means are large relative to per-dimension variances, the
+    f32 subtraction is catastrophic — its error is ulp(mean-scale), which
+    can exceed the whole variance signal. Doing just the subtraction in
+    float64 on host removes that term; what remains is the (much smaller,
+    √batches-growing) f32 rounding already baked into ``outer_sum``
+    accumulation. Results re-enter the working dtype AFTER the subtraction,
+    where rounding is relative again. Pinned against the list path in the
+    large-mean/small-variance regime by
+    tests/image/test_streaming_moments.py (ADVICE r3). Under x64 the
+    accumulators are already f64 and this path is the same math.
+    """
+    import numpy as np
+
+    n = float(num)
+    feat_sum64 = np.asarray(feat_sum, np.float64)
+    outer_sum64 = np.asarray(outer_sum, np.float64)
+    mu64 = feat_sum64 / n
+    sigma64 = (outer_sum64 - n * np.outer(mu64, mu64)) / (n - 1.0)
+    dtype = feat_sum.dtype
+    return jnp.asarray(mu64.astype(dtype)), jnp.asarray(sigma64.astype(dtype))
+
+
 class FrechetInceptionDistance(Metric):
     """FID between accumulated real and generated feature distributions.
 
@@ -214,6 +239,16 @@ class FrechetInceptionDistance(Metric):
             fully jit/scan-compatible updates, and ``compute()`` reduces
             two ``(D, D)`` matrices instead of shipping ``N×D`` features
             off-device. ``None`` (default) keeps the list-state path.
+        feature_shift: optional static offset (scalar or ``(feature_dim,)``)
+            subtracted from features before the moment accumulation (and
+            added back to the means at compute). The one-pass covariance's
+            f32 cancellation error scales with ``ulp(mean²·n)``; when
+            feature means are large relative to per-dimension variances
+            (mean 100, std 0.01 makes the unshifted value pure noise), a
+            shift near the typical feature mean moves the accumulation to
+            the origin where the error is relative again. A CONSTANT, so
+            states stay sum-mergeable across shards/processes and updates
+            stay jit/scan-compatible. Moment path only.
 
     Example (pre-extracted features):
         >>> import jax, jax.numpy as jnp
@@ -236,6 +271,7 @@ class FrechetInceptionDistance(Metric):
         reset_real_features: bool = True,
         sqrtm_method: Optional[str] = None,
         feature_dim: Optional[int] = None,
+        feature_shift: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -252,6 +288,20 @@ class FrechetInceptionDistance(Metric):
         if feature_dim is not None and not (isinstance(feature_dim, int) and feature_dim > 0):
             raise ValueError("Argument `feature_dim` expected to be `None` or a positive integer")
         self.feature_dim = feature_dim
+        if feature_shift is not None:
+            if feature_dim is None:
+                raise ValueError(
+                    "Argument `feature_shift` requires the moment-state path (`feature_dim=`);"
+                    " the list path centers exactly and needs no shift"
+                )
+            shift = jnp.asarray(feature_shift, jnp.float32)
+            if shift.ndim not in (0, 1) or (shift.ndim == 1 and shift.shape[0] != feature_dim):
+                raise ValueError(
+                    f"Argument `feature_shift` must be a scalar or shape ({feature_dim},),"
+                    f" got shape {shift.shape}"
+                )
+            feature_shift = shift
+        self.feature_shift = feature_shift
 
         if feature_dim is None:
             self.add_state("real_features", [], dist_reduce_fx=None)
@@ -279,6 +329,8 @@ class FrechetInceptionDistance(Metric):
         if self.feature_dim is not None:
             prefix = "real" if real else "fake"
             f = features.astype(getattr(self, f"{prefix}_features_sum").dtype)
+            if self.feature_shift is not None:
+                f = f - self.feature_shift.astype(f.dtype)
             setattr(self, f"{prefix}_num_samples", getattr(self, f"{prefix}_num_samples") + f.shape[0])
             setattr(self, f"{prefix}_features_sum", getattr(self, f"{prefix}_features_sum") + f.sum(axis=0))
             setattr(self, f"{prefix}_outer_sum", getattr(self, f"{prefix}_outer_sum") + f.T @ f)
@@ -290,14 +342,27 @@ class FrechetInceptionDistance(Metric):
     def compute(self) -> Array:
         """FID over the accumulated features (ref fid.py:268-287)."""
         if self.feature_dim is not None:
-            for n in (self.real_num_samples, self.fake_num_samples):
-                # match the list path's eager failure on an empty side
-                # (dim_zero_cat's error); traced computes can't raise and
-                # produce NaN from the 0/0 instead
-                if not isinstance(n, jax.core.Tracer) and int(n) == 0:
-                    raise ValueError("No samples to concatenate")
-            mu1, sigma1 = _moments_to_mean_cov(self.real_num_samples, self.real_features_sum, self.real_outer_sum)
-            mu2, sigma2 = _moments_to_mean_cov(self.fake_num_samples, self.fake_features_sum, self.fake_outer_sum)
+            traced = any(
+                isinstance(n, jax.core.Tracer)
+                for n in (self.real_num_samples, self.fake_num_samples)
+            )
+            if not traced:
+                for n in (self.real_num_samples, self.fake_num_samples):
+                    # match the list path's eager failure on an empty side
+                    # (dim_zero_cat's error); traced computes can't raise and
+                    # produce NaN from the 0/0 instead
+                    if int(n) == 0:
+                        raise ValueError("No samples to concatenate")
+            # eager computes route the cancellation-prone subtraction
+            # through host f64 (see _moments_to_mean_cov_host64); traced
+            # computes stay in-graph with the working-dtype formulation
+            to_mean_cov = _moments_to_mean_cov if traced else _moments_to_mean_cov_host64
+            mu1, sigma1 = to_mean_cov(self.real_num_samples, self.real_features_sum, self.real_outer_sum)
+            mu2, sigma2 = to_mean_cov(self.fake_num_samples, self.fake_features_sum, self.fake_outer_sum)
+            if self.feature_shift is not None:
+                # covariances are shift-invariant; only the means move back
+                mu1 = mu1 + self.feature_shift.astype(mu1.dtype)
+                mu2 = mu2 + self.feature_shift.astype(mu2.dtype)
         else:
             real_features = dim_zero_cat(self.real_features)
             fake_features = dim_zero_cat(self.fake_features)

@@ -30,10 +30,16 @@ class InceptionScore(Metric):
             ``E_x KL(p(y|x)‖p(y)) = mean(Σ p log p) + H(mean p)`` is exact
             from those sums, so the streaming score is not an
             approximation. Samples round-robin over splits by arrival
-            order (the list path shuffles before chunking, so both
-            assignments are random-equivalent; ``splits=1`` is
-            bit-identical). O(1) memory, ``dist_reduce_fx="sum"`` merge,
-            fully jit/scan-compatible.
+            order, where the list path shuffles before chunking — so the
+            MEAN is exact, but the per-split std (the second return) is
+            drawn from the list path's distribution only when arrival
+            order is exchangeable: for a stream whose order correlates
+            with content (sorted datasets, curriculum order), round-robin
+            splits are near-identical and the std biases LOW relative to
+            the reference's shuffled chunks. Shuffle the stream (or use
+            the list path) when the std matters on ordered data;
+            ``splits=1`` is bit-identical. O(1) memory,
+            ``dist_reduce_fx="sum"`` merge, fully jit/scan-compatible.
 
     Example (pre-extracted logits):
         >>> import jax, jax.numpy as jnp

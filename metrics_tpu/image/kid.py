@@ -57,7 +57,11 @@ class KernelInceptionDistance(Metric):
             stacks a single buffer per device instead of a ragged list.
             Eager updates past capacity raise; traced updates clamp to the
             tail (XLA ``dynamic_update_slice`` semantics), so size
-            ``max_samples`` to bound the stream.
+            ``max_samples`` to bound the stream. The jit-friendliness is
+            the UPDATE path's: ``compute()`` stays eager-only in both
+            layouts — it slices the buffer by the concrete fill count and
+            draws subsets from the host RNG stream (reference-identical
+            indices, ref kid.py:262-270), neither of which can trace.
         max_samples: buffer capacity (rows) for the fixed-shape path.
 
     Example (pre-extracted features):

@@ -38,6 +38,7 @@ class ROUGEScore(Metric):
         tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
         accumulate: str = "best",
         rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        scrub_pegasus_markers: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -67,6 +68,10 @@ class ROUGEScore(Metric):
         self.normalizer = normalizer
         self.tokenizer = tokenizer
         self.accumulate = accumulate
+        # default False = the reference's (buggy-but-shipped) marker-keeping
+        # behavior; True applies the evidently-intended "<n>" scrub before
+        # rougeLsum splitting (see functional rouge_score)
+        self.scrub_pegasus_markers = scrub_pegasus_markers
 
         for rouge_key in self.rouge_keys:
             for score in ("fmeasure", "precision", "recall"):
@@ -92,6 +97,7 @@ class ROUGEScore(Metric):
             stemmer=self.stemmer,
             normalizer=self.normalizer,
             tokenizer=self.tokenizer,
+            scrub_pegasus_markers=self.scrub_pegasus_markers,
         )
         for rouge_key, metrics in output.items():
             for metric in metrics:

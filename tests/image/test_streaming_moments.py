@@ -36,6 +36,52 @@ class TestStreamingFID:
         got = float(mom_fid.compute())
         assert got == pytest.approx(expected, rel=1e-3, abs=1e-4)
 
+    def test_large_mean_small_variance_regime(self):
+        """ADVICE r3: the one-pass covariance is catastrophic in f32 when
+        means dwarf variances (mean 100, std 0.01: the f.T@f accumulation
+        itself rounds at ulp(n·mean²) ≈ 0.5 while the whole variance
+        signal is ~0.05 — unshifted streaming FID here is pure noise,
+        measured at ~-0.02 vs a true 4.5e-4). A static ``feature_shift``
+        near the typical mean moves accumulation to the origin and must
+        recover the two-pass list-path value; being a constructor
+        constant, it keeps states sum-mergeable and updates traceable."""
+        rng = np.random.RandomState(7)
+        real = 100.0 + 0.01 * rng.randn(512, D).astype(np.float32)
+        fake = 100.0 + 0.01 * rng.randn(512, D).astype(np.float32) + 0.005
+        list_fid = FrechetInceptionDistance(sqrtm_method="eigh")
+        mom_fid = FrechetInceptionDistance(
+            sqrtm_method="eigh", feature_dim=D, feature_shift=100.0
+        )
+        for m in (list_fid, mom_fid):
+            m.update(jnp.asarray(real), real=True)
+            m.update(jnp.asarray(fake), real=False)
+        expected = float(list_fid.compute())
+        got = float(mom_fid.compute())
+        assert got == pytest.approx(expected, rel=0.05, abs=1e-6)
+
+    def test_feature_shift_neutral_on_ordinary_scale(self):
+        """A shift must not change results in the well-conditioned regime
+        (same stream as test_matches_list_path, shifted by its 0.5 mean)."""
+        plain = FrechetInceptionDistance(sqrtm_method="eigh", feature_dim=D)
+        shifted = FrechetInceptionDistance(
+            sqrtm_method="eigh", feature_dim=D, feature_shift=0.5
+        )
+        for f in _feature_stream(0):
+            plain.update(f, real=True)
+            shifted.update(f, real=True)
+        for f in _feature_stream(1, shift=0.5):
+            plain.update(f, real=False)
+            shifted.update(f, real=False)
+        assert float(shifted.compute()) == pytest.approx(
+            float(plain.compute()), rel=1e-3, abs=1e-5
+        )
+
+    def test_feature_shift_validation(self):
+        with pytest.raises(ValueError, match="feature_shift"):
+            FrechetInceptionDistance(feature_shift=1.0)  # needs feature_dim
+        with pytest.raises(ValueError, match="feature_shift"):
+            FrechetInceptionDistance(feature_dim=D, feature_shift=np.zeros(D + 1))
+
     def test_moments_equal_two_pass_mean_cov(self):
         # the underlying (μ, Σ) themselves, not just the scalar FID
         from metrics_tpu.image.fid import _mean_cov, _moments_to_mean_cov

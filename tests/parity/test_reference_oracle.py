@@ -332,10 +332,15 @@ def test_rouge_matches_reference_with_shared_splitter(reference, use_stemmer, mo
     preds = [
         "Mr. Smith visited Washington. He gave a speech. The crowd cheered loudly.",
         "The quick brown foxes jumped over lazy dogs. It rained later.",
+        # ADVICE r3: literal pegasus '<n>' markers — the reference's scrub
+        # is a discarded re.sub (ref rouge.py:50), so both frameworks must
+        # keep the markers; this input pins that live
+        "First sentence here.<n>Second sentence follows. <n> Third one ends.",
     ]
     targets = [
         ["Mr. Smith went to Washington. He delivered a speech. The crowd was loud."],
         ["Quick brown dogs jumped over the lazy cat. Rain followed."],
+        ["First sentence there.<n>Second sentence happened. Third one ended."],
     ]
     keys = ("rouge1", "rouge2", "rougeL", "rougeLsum")
     mine = F.rouge_score(preds, targets, rouge_keys=keys, use_stemmer=use_stemmer)

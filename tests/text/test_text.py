@@ -249,6 +249,32 @@ class TestROUGE:
         ours = rouge_score(pred, tgt, rouge_keys="rougeLsum")
         np.testing.assert_allclose(float(ours["rougeLsum_fmeasure"]), expected["rougeLsum"].fmeasure, atol=1e-5)
 
+    def test_scrub_pegasus_markers(self):
+        """scrub_pegasus_markers=True must equal scoring pre-scrubbed text;
+        the default must keep literal '<n>' (reference parity — the
+        reference's re.sub discards its result, ref rouge.py:50)."""
+        pred = "The cat sat.<n>The dog ran away quickly."
+        tgt = "A cat sat down.<n>The dog sprinted off."
+        scrubbed = rouge_score(
+            pred, tgt, rouge_keys="rougeLsum", scrub_pegasus_markers=True
+        )
+        manual = rouge_score(
+            pred.replace("<n>", ""), tgt.replace("<n>", ""), rouge_keys="rougeLsum"
+        )
+        np.testing.assert_allclose(
+            float(scrubbed["rougeLsum_fmeasure"]), float(manual["rougeLsum_fmeasure"]), atol=1e-7
+        )
+        kept = rouge_score(pred, tgt, rouge_keys="rougeLsum")
+        assert float(kept["rougeLsum_fmeasure"]) != float(scrubbed["rougeLsum_fmeasure"])
+        # module plumbs the same flag
+        m = ROUGEScore(rouge_keys="rougeLsum", scrub_pegasus_markers=True)
+        m.update(pred, tgt)
+        np.testing.assert_allclose(
+            float(m.compute()["rougeLsum_fmeasure"]),
+            float(scrubbed["rougeLsum_fmeasure"]),
+            atol=1e-7,
+        )
+
     def test_module(self):
         m = ROUGEScore(rouge_keys=("rouge1", "rougeL"))
         m.update(PREDS, [[t] for t in TARGETS])

@@ -5,14 +5,17 @@ Run in any environment that has the compiled ``pesq`` package:
 
     pip install pesq && python tools/record_pesq_goldens.py
 
-Writes ``tests/audio/pesq_goldens.json`` with the package's MOS-LQO for a
-deterministic battery (the same speech-like carrier + seeded noise at
-several SNRs that tests/audio/test_pesq_native.py reconstructs), and
-prints the native core's value next to each so calibration drift is
-visible before committing. The committed tolerance is intentionally loose
-(the native core approximates the ITU lookup tables — see
-metrics_tpu/functional/audio/_pesq_core.py); tighten it as the core's
-tables are refined against these recordings.
+Writes ``tests/audio/pesq_goldens.json`` with the package's MOS-LQO for
+the shared 54-case deterministic corpus (``tests/audio/pesq_corpus.py``:
+two carriers x three (fs, mode) combinations x nine degradations — noise
+ladders, colored noise, delay, clipping, dropouts, smoothing; every case
+reconstructible from its id alone). The native core's value prints next
+to each recording so calibration drift is visible before committing. The
+committed tolerance is intentionally loose (the native core approximates
+the ITU lookup tables — see metrics_tpu/functional/audio/_pesq_core.py);
+tighten it as the core's tables are refined against these recordings.
+``tests/audio/test_pesq_native.py`` (test_recorded_package_goldens_if_present)
+then pins the native core to every recorded case.
 """
 import json
 import os
@@ -24,30 +27,30 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 OUT = os.path.join(HERE, "..", "tests", "audio", "pesq_goldens.json")
 
 
-def _speechish(n, fs):
-    t = np.arange(n) / fs
-    return (np.sin(2 * np.pi * 440 * t) * (0.5 + 0.5 * np.sin(2 * np.pi * 3 * t))).astype(np.float64)
-
-
 def main() -> int:
     from pesq import pesq as pesq_pkg
 
     sys.path.insert(0, os.path.join(HERE, ".."))
+    sys.path.insert(0, os.path.join(HERE, "..", "tests", "audio"))
+    from pesq_corpus import build_corpus
+
     from metrics_tpu.functional.audio._pesq_core import pesq_native
 
     cases = []
-    for fs, mode, n in ((8000, "nb", 32000), (16000, "nb", 64000), (16000, "wb", 64000)):
-        for seed, snr_db in ((0, 40), (1, 30), (2, 20), (3, 10), (4, 0)):
-            sig = _speechish(n, fs)
-            rng = np.random.RandomState(seed)
-            noise = rng.randn(n)
-            noise *= np.sqrt((sig**2).mean() / (noise**2).mean()) * 10 ** (-snr_db / 20.0)
-            deg = sig + noise
-            score = float(pesq_pkg(fs, sig.astype(np.float32), deg.astype(np.float32), mode))
-            native = pesq_native(fs, sig, deg, mode)
-            print(f"fs={fs} mode={mode} snr={snr_db:+d}: package={score:.4f} native={native:.4f}")
-            cases.append({"fs": fs, "mode": mode, "n": n, "seed": seed, "snr_db": snr_db, "score": score})
+    worst = 0.0
+    for case in build_corpus():
+        fs, mode = case["fs"], case["mode"]
+        score = float(
+            pesq_pkg(fs, case["target"].astype(np.float32), case["degraded"].astype(np.float32), mode)
+        )
+        native = pesq_native(fs, case["target"], case["degraded"], mode)
+        worst = max(worst, abs(native - score))
+        print(f'{case["id"]:45s} package={score:.4f} native={native:.4f} diff={native - score:+.4f}')
+        cases.append({"id": case["id"], "fs": fs, "mode": mode,
+                      "carrier": case["carrier"], "degradation": case["degradation"],
+                      "score": score})
 
+    print(f"worst |native - package| across corpus: {worst:.4f}")
     with open(OUT, "w") as f:
         json.dump({"tolerance": 0.35, "cases": cases}, f, indent=2)
         f.write("\n")
