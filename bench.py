@@ -137,6 +137,60 @@ def _cfg_collection(detail: dict) -> None:
     detail["collection_update_fused_us"] = round((time.perf_counter() - t0) / 50 * 1e6, 1)
 
 
+def _cfg_compute_group_detection(detail: dict, reps: int = 5) -> None:
+    """First-update cost of auto compute-group detection (VERDICT r3 #7).
+
+    ``_merge_compute_groups`` keeps the reference's first-update
+    state-equality design (ref collections.py:159-213): one
+    ``jnp.allclose`` — a device round trip — per state pair across group
+    leaders, paid once per collection lifetime. This config measures that
+    first update with detection on (auto), off, and with groups declared
+    explicitly (zero detection work), on the bench device. Construction
+    repeats per rep so the detection runs every time; the jitted updates
+    land in the in-process cache after rep 1, isolating the merge cost.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
+
+    rng = np.random.RandomState(4)
+    logits = rng.rand(256, 32).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, 32, 256))
+
+    def metrics():
+        # all four share the macro stat-score pipeline, so they form ONE
+        # valid state-sharing group — the explicit declaration below must
+        # mirror what auto-detection discovers (micro-average Accuracy
+        # would keep scalar states and belong in its own group)
+        return {
+            "acc": Accuracy(num_classes=32, average="macro"),
+            "f1": F1Score(num_classes=32, average="macro"),
+            "prec": Precision(num_classes=32, average="macro"),
+            "rec": Recall(num_classes=32, average="macro"),
+        }
+
+    def first_update_us(**kwargs):
+        best = float("inf")
+        for rep in range(reps + 1):
+            mc = MetricCollection(metrics(), **kwargs)
+            t0 = time.perf_counter()
+            mc.update(preds, target)
+            # "acc" leads the explicit group and updates in every mode
+            jax.block_until_ready(mc["acc"].tp)
+            dt = (time.perf_counter() - t0) * 1e6
+            if rep:  # rep 0 pays the one-time jit compiles
+                best = min(best, dt)
+        return round(best, 1)
+
+    detail["cg_first_update_auto_detect_us"] = first_update_us(compute_groups=True)
+    detail["cg_first_update_no_groups_us"] = first_update_us(compute_groups=False)
+    detail["cg_first_update_explicit_us"] = first_update_us(
+        compute_groups=[["acc", "f1", "prec", "rec"]]
+    )
+
+
 def _cfg_scan_epoch(detail: dict, reps: int = 5) -> None:
     """Whole-epoch scan (one program) vs 100 jitted per-batch dispatches.
 
@@ -316,6 +370,8 @@ def _bench_detail() -> dict:
 
     _cfg_collection(detail)
     _mark("collection_update_us")
+    _cfg_compute_group_detection(detail)
+    _mark("cg_first_update_auto_detect_us")
     _cfg_scan_epoch(detail)
     _mark("scan_epoch_100_batches_ms")
     _cfg_retrieval(detail)
@@ -491,6 +547,7 @@ def _bench_detail_fast() -> dict:
     detail = {"suite": "fast"}
     configs = [
         ("collection", _cfg_collection),
+        ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),
         ("retrieval", _cfg_retrieval),
         ("coco_map", _cfg_coco),
