@@ -680,3 +680,79 @@ def test_compositional_arithmetic_matches_reference(reference):
     np.testing.assert_allclose(
         float(mine_comp.compute()), float(ref_comp.compute()), rtol=1e-5
     )
+
+
+def test_classwise_wrapper_matches_reference(reference):
+    """ClasswiseWrapper: per-class dict keys AND values, default + custom
+    labels, over a multi-batch lifecycle (ref wrappers/classwise.py)."""
+    import torch
+
+    import metrics_tpu
+
+    rng = np.random.RandomState(31)
+    batches = []
+    for _ in range(_NBATCH):
+        logits = rng.rand(_B, 3).astype(np.float32)
+        batches.append((logits / logits.sum(-1, keepdims=True), rng.randint(0, 3, _B)))
+
+    for labels in (None, ["horse", "fish", "dog"]):
+        mine = metrics_tpu.wrappers.ClasswiseWrapper(
+            metrics_tpu.Accuracy(num_classes=3, average=None), labels=labels
+        )
+        ref = reference.ClasswiseWrapper(
+            reference.Accuracy(num_classes=3, average=None), labels=labels
+        )
+        for p, t in batches:
+            mine.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(torch.from_numpy(p), torch.from_numpy(t))
+        got, exp = mine.compute(), ref.compute()
+        assert set(got) == set(exp)
+        for k in exp:
+            np.testing.assert_allclose(float(got[k]), float(exp[k]), rtol=1e-5, err_msg=k)
+
+
+def test_bootstrapper_matches_reference_with_shared_sampler(reference, monkeypatch):
+    """BootStrapper lifecycle with the SAME resampling indices injected
+    into both frameworks (each normally draws its own RNG, so the sampler
+    is the one stage that must be shared — everything else, per-copy
+    updates, mean/std/quantile/raw aggregation, is compared live).
+    Ref: wrappers/bootstrapping.py:126-161."""
+    import torch
+
+    import metrics_tpu
+    from metrics_tpu.wrappers import bootstrapping as my_boot_mod
+
+    ref_boot_mod = sys.modules[reference.BootStrapper.__module__]
+
+    def make_shared_sampler(to_backend):
+        rng = np.random.RandomState(99)
+
+        def sampler(size, *args, **kwargs):
+            return to_backend(rng.randint(0, size, int(size)))
+
+        return sampler
+
+    monkeypatch.setattr(my_boot_mod, "_bootstrap_sampler",
+                        make_shared_sampler(jnp.asarray))
+    monkeypatch.setattr(ref_boot_mod, "_bootstrap_sampler",
+                        make_shared_sampler(torch.from_numpy))
+
+    mine = metrics_tpu.BootStrapper(
+        metrics_tpu.MeanSquaredError(), num_bootstraps=4, mean=True, std=True,
+        quantile=0.95, raw=True,
+    )
+    ref = reference.BootStrapper(
+        reference.MeanSquaredError(), num_bootstraps=4, mean=True, std=True,
+        quantile=0.95, raw=True,
+    )
+    for i in range(_NBATCH):
+        mine.update(jnp.asarray(_mod_reg_p[i]), jnp.asarray(_mod_reg_t[i]))
+        ref.update(torch.from_numpy(_mod_reg_p[i]), torch.from_numpy(_mod_reg_t[i]))
+    got, exp = mine.compute(), ref.compute()
+    assert set(got) == set(exp)
+    for k in exp:
+        np.testing.assert_allclose(
+            np.asarray(got[k], np.float64),
+            np.asarray(exp[k].numpy() if hasattr(exp[k], "numpy") else exp[k], np.float64),
+            rtol=1e-5, err_msg=k,
+        )
