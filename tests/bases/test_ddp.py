@@ -17,6 +17,16 @@ from metrics_tpu.parallel.dist_env import AxisEnv, NoOpEnv, default_env
 WORLD = 8
 
 
+class Fake2Env(NoOpEnv):
+    """Simulated 2-rank env: each 'rank' contributes the local state twice."""
+
+    def world_size(self):
+        return 2
+
+    def all_gather(self, x):
+        return [x, x]
+
+
 def _mesh():
     return Mesh(np.array(jax.devices()[:WORLD]), ("r",))
 
@@ -153,14 +163,6 @@ def test_stateful_sync_with_env():
     env = NoOpEnv()
     m.sync(env=env)  # world=1 -> no-op, not marked synced
     assert not m._is_synced
-
-    # simulated 2-rank env, each "rank" contributing the local state twice
-    class Fake2Env(NoOpEnv):
-        def world_size(self):
-            return 2
-
-        def all_gather(self, x):
-            return [x, x]
 
     m.sync(env=Fake2Env())
     assert m._is_synced
@@ -362,3 +364,68 @@ def test_sync_dtype_never_compresses_sample_states():
     # list state crossed as f32; scalar sum state compressed to bf16
     assert sorted(seen) == ["bfloat16", "float32"]
     np.testing.assert_allclose(np.asarray(m.samples), np.full(8, 1000.5))
+
+
+class TestRaggedSync:
+    """Edge cases of the ragged list-state protocol (_ragged_state_specs)
+    beyond the real 2-process coverage in test_process_env_real.py."""
+
+    @staticmethod
+    def _map_with(preds_boxes):
+        from metrics_tpu.detection import MeanAveragePrecision
+
+        m = MeanAveragePrecision()
+        preds = [
+            dict(boxes=jnp.asarray(b).reshape(-1, 4),
+                 scores=jnp.arange(1, len(b) + 1, dtype=jnp.float32) / 10,
+                 labels=jnp.zeros(len(b), jnp.int32))
+            for b in preds_boxes
+        ]
+        targs = [
+            dict(boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), labels=jnp.asarray([0]))
+            for _ in preds_boxes
+        ]
+        m.update(preds, targs)
+        return m
+
+    def test_zero_box_image_survives_roundtrip(self):
+        """An image with ZERO detections is a legal element — its (0, 4)
+        boundary must survive the pack->gather->re-split."""
+        m = self._map_with([[[0.0, 0.0, 10.0, 10.0]], []])
+        assert tuple(m.detection_boxes[1].shape) == (0, 4)
+        m.sync(env=Fake2Env())
+        assert [tuple(b.shape) for b in m.detection_boxes] == [(1, 4), (0, 4)] * 2
+        assert [int(s.shape[0]) for s in m.detection_scores] == [1, 0, 1, 0]
+        m.unsync()
+        assert len(m.detection_boxes) == 2
+
+    def test_lengths_group_mismatch_raises(self):
+        """States declared in one lengths_group must agree on element
+        lengths — a mismatch is a corrupted update, not a silent re-split."""
+        from metrics_tpu.utilities.exceptions import MetricsUserError
+
+        m = self._map_with([[[0.0, 0.0, 10.0, 10.0]]])
+        # corrupt: drop a scores element so the 'detections' group disagrees
+        object.__setattr__(m, "detection_scores", [])
+
+        with pytest.raises(MetricsUserError, match="lengths_group"):
+            m.sync(env=Fake2Env())
+
+    def test_single_lengths_collective_per_group(self):
+        """boxes/scores/labels share the 'detections' group: their lengths
+        must cross in ONE collective, not three (ditto groundtruths)."""
+        gathered_shapes = []
+
+        class Recording2(Fake2Env):
+            def all_gather(self, x):
+                gathered_shapes.append((tuple(x.shape), str(x.dtype)))
+                return super().all_gather(x)
+
+        m = self._map_with([[[0.0, 0.0, 10.0, 10.0]], [[1.0, 1.0, 5.0, 5.0]]])
+        m.sync(env=Recording2())
+        int_lengths = [s for s in gathered_shapes if s == ((2,), "int32")]
+        # 2 lengths gathers (detections + groundtruths)... plus labels data
+        # which is also (2,) int32 x2 (det_labels, gt_labels) = 4 total
+        assert len(int_lengths) == 4
+        # total collectives: 2 lengths + 5 data = 7 (not 5 lengths + 5 data)
+        assert len(gathered_shapes) == 7
