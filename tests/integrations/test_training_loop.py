@@ -169,3 +169,10 @@ def test_bert_score_example_runs(capsys):
     _load_example("bert_score_own_embedder").main()
     out = capsys.readouterr().out
     assert "f1" in out and "-1" not in out  # no masking-sentinel leakage
+
+
+def test_multihost_example_runs():
+    """The ProcessEnv multi-host recipe must stay runnable: two real
+    local processes reproduce the single-process value (uneven shards,
+    explicit compute group)."""
+    _load_example("multihost_eval").main()
