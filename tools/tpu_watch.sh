@@ -6,11 +6,22 @@
 # the background at the start of a round so a healthy-tunnel window is
 # never missed while other work is in flight.
 #
-# Usage: tools/tpu_watch.sh [max_seconds] [interval_seconds]
+# Usage: tools/tpu_watch.sh [max_seconds] [interval_seconds] [probe_timeout]
+#
+# Probe cadence trades responsiveness against interference: a probe that
+# times out kills a claim-WAITING client, and a hard-killed claim-waiter
+# is the very failure mode that wedges the single-client tunnel for
+# hours (round-3 postmortem). The axon plugin exposes no claim-free
+# health endpoint, so the probe must attempt the claim; three
+# mitigations: long intervals, a generous timeout (a client merely slow
+# mid-grant is never killed), and SIGINT-first termination (the
+# interpreter unwinds and can release the pending claim; SIGKILL only
+# 30s later as a last resort).
 set -u
 cd "$(dirname "$0")/.."
 BUDGET="${1:-21600}"   # default: keep watching for 6h
-INTERVAL="${2:-300}"
+INTERVAL="${2:-600}"
+PROBE_TIMEOUT="${3:-240}"
 START=$(date +%s)
 N=0
 while true; do
@@ -18,7 +29,7 @@ while true; do
     # platform check matters: a CPU fallback also answers jax.devices()
     # (the smoke conftest guards the same way) — only a real accelerator
     # makes firing the capture worthwhile
-    if timeout 120 python -c "import jax; d = jax.devices()[0]; print('TPU_OK' if d.platform != 'cpu' else 'CPU_ONLY')" 2>/dev/null | grep -q TPU_OK; then
+    if timeout --signal=INT --kill-after=30 "$PROBE_TIMEOUT" python -c "import jax; d = jax.devices()[0]; print('TPU_OK' if d.platform != 'cpu' else 'CPU_ONLY')" 2>/dev/null | grep -q TPU_OK; then
         echo "# tpu_watch: accelerator healthy on probe #$N ($(date -u +%FT%TZ)) — capturing"
         BEFORE=$(wc -l < TPU_CAPTURES.jsonl 2>/dev/null || echo 0)
         # the capture target is internally watchdogged, but a tunnel wedging
