@@ -756,3 +756,96 @@ def test_bootstrapper_matches_reference_with_shared_sampler(reference, monkeypat
             np.asarray(exp[k].numpy() if hasattr(exp[k], "numpy") else exp[k], np.float64),
             rtol=1e-5, err_msg=k,
         )
+
+
+def test_input_format_classification_fuzz_matches_reference(reference):
+    """Live fuzz of the input canonicalization decision table.
+
+    ``_input_format_classification`` (410 LoC, the gate every
+    classification metric's inputs pass through) is compared against the
+    reference's implementation over ~150 randomized configurations
+    spanning every input kind (binary, multiclass ints, probs,
+    multilabel, multidim) crossed with random threshold / num_classes /
+    multiclass / top_k settings — including invalid combinations, where
+    BOTH sides must reject. Ref: checks.py:310-449.
+    """
+    import torch
+
+    from metrics_tpu.utilities.checks import (
+        _input_format_classification as mine_fmt,
+    )
+
+    from torchmetrics.utilities.checks import (  # type: ignore
+        _input_format_classification as ref_fmt,
+    )
+
+    rng = np.random.RandomState(77)
+    n, c, x = 12, 4, 3
+
+    def gen_inputs(kind):
+        if kind == "binary_prob":
+            return rng.rand(n).astype(np.float32), rng.randint(0, 2, n)
+        if kind == "binary_int":
+            return rng.randint(0, 2, n), rng.randint(0, 2, n)
+        if kind == "mc_int":
+            return rng.randint(0, c, n), rng.randint(0, c, n)
+        if kind == "mc_prob":
+            logits = rng.rand(n, c).astype(np.float32)
+            return logits / logits.sum(-1, keepdims=True), rng.randint(0, c, n)
+        if kind == "ml_prob":
+            return rng.rand(n, c).astype(np.float32), rng.randint(0, 2, (n, c))
+        if kind == "mdmc_prob":
+            logits = rng.rand(n, c, x).astype(np.float32)
+            return logits / logits.sum(1, keepdims=True), rng.randint(0, c, (n, x))
+        if kind == "mdmc_int":
+            return rng.randint(0, c, (n, x)), rng.randint(0, c, (n, x))
+        raise AssertionError(kind)
+
+    kinds = ["binary_prob", "binary_int", "mc_int", "mc_prob", "ml_prob", "mdmc_prob", "mdmc_int"]
+    checked = agreed_errors = 0
+    for i in range(150):
+        kind = kinds[i % len(kinds)]
+        preds_np, target_np = gen_inputs(kind)
+        kwargs = dict(
+            threshold=float(rng.choice([0.3, 0.5, 0.7])),
+            num_classes=int(rng.choice([0, c])) or None,
+            multiclass={0: None, 1: True, 2: False}[int(rng.randint(3))],
+            top_k=int(rng.choice([0, 2])) or None,
+        )
+        ref_err = mine_err = None
+        try:
+            ref_p, ref_t, ref_mode = ref_fmt(
+                torch.from_numpy(np.asarray(preds_np)), torch.from_numpy(np.asarray(target_np)), **kwargs
+            )
+        except Exception as e:  # noqa: BLE001 — any rejection counts
+            ref_err = e
+        try:
+            my_p, my_t, my_mode = mine_fmt(
+                jnp.asarray(preds_np), jnp.asarray(target_np), **kwargs
+            )
+        except Exception as e:  # noqa: BLE001
+            mine_err = e
+
+        case_desc = f"case {i} kind={kind} kwargs={kwargs}"
+        if ref_err is not None or mine_err is not None:
+            assert ref_err is not None and mine_err is not None, (
+                f"{case_desc}: one side rejected, the other accepted"
+                f" (ref={ref_err!r}, mine={mine_err!r})"
+            )
+            # a rejection must be a deliberate validation error on BOTH
+            # sides — an accidental crash (IndexError, TypeError) hiding
+            # behind the reference's ValueError would otherwise pass
+            assert isinstance(ref_err, ValueError) and isinstance(mine_err, ValueError), (
+                f"{case_desc}: non-validation rejection"
+                f" (ref={type(ref_err).__name__}: {ref_err}, mine={type(mine_err).__name__}: {mine_err})"
+            )
+            agreed_errors += 1
+            continue
+        assert my_mode.value == ref_mode.value, case_desc
+        np.testing.assert_array_equal(np.asarray(my_p), ref_p.numpy(), err_msg=case_desc)
+        np.testing.assert_array_equal(np.asarray(my_t), ref_t.numpy(), err_msg=case_desc)
+        checked += 1
+
+    # the fuzz must exercise both regimes meaningfully
+    assert checked >= 50, (checked, agreed_errors)
+    assert agreed_errors >= 20, (checked, agreed_errors)
