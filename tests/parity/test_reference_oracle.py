@@ -849,3 +849,36 @@ def test_input_format_classification_fuzz_matches_reference(reference):
     # the fuzz must exercise both regimes meaningfully
     assert checked >= 50, (checked, agreed_errors)
     assert agreed_errors >= 20, (checked, agreed_errors)
+
+
+def test_multiclass_curves_match_reference(reference):
+    """Multiclass PR curve / ROC / AveragePrecision return PER-CLASS lists
+    with data-dependent lengths — a structure the generic case runner
+    can't compare. Ref: functional/classification/{precision_recall_curve,
+    roc,average_precision}.py."""
+    import torch
+
+    t_probs = torch.from_numpy(_probs)
+    t_labels = torch.from_numpy(_labels)
+    j_probs, j_labels = jnp.asarray(_probs), jnp.asarray(_labels)
+
+    for name in ("precision_recall_curve", "roc"):
+        mine = getattr(F, name)(j_probs, j_labels, num_classes=_C)
+        ref = getattr(reference.functional, name)(t_probs, t_labels, num_classes=_C)
+        assert len(mine) == len(ref)  # (x, y, thresholds)
+        for mine_axis, ref_axis in zip(mine, ref):
+            assert len(mine_axis) == len(ref_axis) == _C
+            for cls, (a, b) in enumerate(zip(mine_axis, ref_axis)):
+                np.testing.assert_allclose(
+                    np.asarray(a), b.numpy(), rtol=1e-4, atol=1e-4,
+                    err_msg=f"{name} class {cls}",
+                )
+
+    mine_ap = F.average_precision(j_probs, j_labels, num_classes=_C, average=None)
+    ref_ap = reference.functional.average_precision(
+        t_probs, t_labels, num_classes=_C, average=None
+    )
+    for cls, (a, b) in enumerate(zip(mine_ap, ref_ap)):
+        np.testing.assert_allclose(
+            np.asarray(a), float(b), rtol=1e-4, atol=1e-4, err_msg=f"ap class {cls}"
+        )
