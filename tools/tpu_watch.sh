@@ -38,6 +38,14 @@ while true; do
         AFTER=$(wc -l < TPU_CAPTURES.jsonl 2>/dev/null || echo 0)
         if [ "$AFTER" -gt "$BEFORE" ]; then
             echo "# tpu_watch: capture done, $((AFTER - BEFORE)) record(s) appended ($(date -u +%FT%TZ))"
+            # bonus while the tunnel is demonstrably healthy: the FULL
+            # detail suite (BENCH_ALL) — the only pass that refreshes an
+            # existing full-suite BENCH_DETAIL.json with new configs
+            echo "# tpu_watch: running BENCH_ALL full detail suite"
+            timeout --signal=INT --kill-after=30 3600 \
+                env BENCH_ALL=1 BENCH_RECOVERY_BUDGET=0 BENCH_NO_CPU_FALLBACK=1 python bench.py
+            RC=$?
+            echo "# tpu_watch: BENCH_ALL pass rc=$RC ($(date -u +%FT%TZ))"
             exit 0
         fi
         echo "# tpu_watch: capture ran but recorded no evidence (tunnel lost mid-run?) — continuing watch"
