@@ -882,3 +882,30 @@ def test_multiclass_curves_match_reference(reference):
         np.testing.assert_allclose(
             np.asarray(a), float(b), rtol=1e-4, atol=1e-4, err_msg=f"ap class {cls}"
         )
+
+
+def test_curve_modules_match_reference(reference):
+    """Unbinned curve MODULES over a multi-batch lifecycle: the growing
+    list states accumulate across updates, then compute returns per-class
+    ragged outputs (the shapes test_multiclass_curves_match_reference
+    covers for one-shot functionals). Ref: classification/
+    {precision_recall_curve,roc}.py module classes."""
+    import torch
+
+    import metrics_tpu
+
+    for name in ("PrecisionRecallCurve", "ROC"):
+        mine = getattr(metrics_tpu, name)(num_classes=_C)
+        ref = getattr(reference, name)(num_classes=_C)
+        for i in range(_NBATCH):
+            mine.update(jnp.asarray(_mod_probs[i]), jnp.asarray(_mod_labels[i]))
+            ref.update(torch.from_numpy(_mod_probs[i]), torch.from_numpy(_mod_labels[i]))
+        got, exp = mine.compute(), ref.compute()
+        assert len(got) == len(exp)  # (x, y, thresholds)
+        for got_axis, exp_axis in zip(got, exp):
+            assert len(got_axis) == len(exp_axis) == _C
+            for cls, (a, b) in enumerate(zip(got_axis, exp_axis)):
+                np.testing.assert_allclose(
+                    np.asarray(a), b.numpy(), rtol=1e-4, atol=1e-4,
+                    err_msg=f"{name} class {cls}",
+                )
