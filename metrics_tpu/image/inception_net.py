@@ -330,15 +330,25 @@ class InceptionV3FeatureExtractor:
                 ),
             )
 
-        def _forward(variables, imgs):
-            if imgs.dtype == jnp.uint8:
-                imgs = imgs.astype(jnp.float32) / 127.5 - 1.0
-            if imgs.shape[1] == 3 and imgs.shape[-1] != 3:  # NCHW -> NHWC
-                imgs = jnp.transpose(imgs, (0, 2, 3, 1))
-            features, logits = self.net.apply(variables, imgs)
-            return features if self.output == "pool" else logits
+        self._jitted = None  # built lazily; compiled executables don't pickle
 
-        self._forward = jax.jit(_forward)
+    def _forward(self, variables, imgs):
+        if imgs.dtype == jnp.uint8:
+            imgs = imgs.astype(jnp.float32) / 127.5 - 1.0
+        if imgs.shape[1] == 3 and imgs.shape[-1] != 3:  # NCHW -> NHWC
+            imgs = jnp.transpose(imgs, (0, 2, 3, 1))
+        features, logits = self.net.apply(variables, imgs)
+        return features if self.output == "pool" else logits
 
     def __call__(self, imgs: Array) -> Array:
-        return self._forward(self.variables, imgs)
+        if self._jitted is None:
+            self._jitted = jax.jit(self._forward)
+        return self._jitted(self.variables, imgs)
+
+    def __getstate__(self):
+        # metrics holding this extractor must pickle/deepcopy like the
+        # reference's torch modules do; the jit wrapper rebuilds on first
+        # call after restore
+        state = self.__dict__.copy()
+        state["_jitted"] = None
+        return state

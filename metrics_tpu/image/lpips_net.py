@@ -136,13 +136,23 @@ class LPIPSNet:
                 lambda: self.net.init(jax.random.PRNGKey(0), dummy, dummy),
             )
 
-        def _forward(variables, img1, img2):
-            if img1.shape[1] == 3 and img1.shape[-1] != 3:  # NCHW -> NHWC
-                img1 = jnp.transpose(img1, (0, 2, 3, 1))
-                img2 = jnp.transpose(img2, (0, 2, 3, 1))
-            return self.net.apply(variables, img1, img2)
+        self._jitted = None  # built lazily; compiled executables don't pickle
 
-        self._forward = jax.jit(_forward)
+    def _forward(self, variables, img1, img2):
+        if img1.shape[1] == 3 and img1.shape[-1] != 3:  # NCHW -> NHWC
+            img1 = jnp.transpose(img1, (0, 2, 3, 1))
+            img2 = jnp.transpose(img2, (0, 2, 3, 1))
+        return self.net.apply(variables, img1, img2)
 
     def __call__(self, img1: Array, img2: Array) -> Array:
-        return self._forward(self.variables, img1, img2)
+        if self._jitted is None:
+            self._jitted = jax.jit(self._forward)
+        return self._jitted(self.variables, img1, img2)
+
+    def __getstate__(self):
+        # metrics holding this net must pickle/deepcopy like the reference's
+        # torch modules do (checkpointing, per-dataloader clones); the jit
+        # wrapper rebuilds on first call after restore
+        state = self.__dict__.copy()
+        state["_jitted"] = None
+        return state
