@@ -57,12 +57,21 @@ class KernelInceptionDistance(Metric):
             stacks a single buffer per device instead of a ragged list.
             Eager updates past capacity raise; traced updates clamp to the
             tail (XLA ``dynamic_update_slice`` semantics), so size
-            ``max_samples`` to bound the stream. The jit-friendliness is
-            the UPDATE path's: ``compute()`` stays eager-only in both
-            layouts — it slices the buffer by the concrete fill count and
-            draws subsets from the host RNG stream (reference-identical
-            indices, ref kid.py:262-270), neither of which can trace.
+            ``max_samples`` to bound the stream. By default ``compute()``
+            stays eager-only in both layouts — it slices the buffer by the
+            concrete fill count and draws subsets from the host RNG stream
+            (reference-identical indices, ref kid.py:262-270), neither of
+            which can trace; pass ``compute_rng_key`` for a fully
+            in-graph compute.
         max_samples: buffer capacity (rows) for the fixed-shape path.
+        compute_rng_key: opt-in (buffer path only): an int seed or
+            ``jax.random`` key that moves subset sampling in-graph, making
+            ``compute``/``pure_compute`` fully jit-compatible (e.g. KID at
+            the end of a compiled eval epoch). Subset indices then come
+            from ``jax.random``, NOT the reference's ``np.random`` stream
+            — same estimator distribution, different draws — and an
+            under-filled side poisons the outputs with NaN instead of
+            raising (tracing cannot raise). See ``_compute_in_graph``.
 
     Example (pre-extracted features):
         >>> import jax, jax.numpy as jnp
@@ -91,6 +100,7 @@ class KernelInceptionDistance(Metric):
         reset_real_features: bool = True,
         feature_dim: Optional[int] = None,
         max_samples: Optional[int] = None,
+        compute_rng_key: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -122,6 +132,33 @@ class KernelInceptionDistance(Metric):
             raise ValueError("Argument `max_samples` expected to be `None` or a positive integer")
         self.feature_dim = feature_dim
         self.max_samples = max_samples
+        if compute_rng_key is not None:
+            if feature_dim is None:
+                raise ValueError(
+                    "Argument `compute_rng_key` requires the fixed-shape buffer path"
+                    " (`feature_dim=`/`max_samples=`): the list path has no static"
+                    " bound to sample under jit"
+                )
+            if isinstance(compute_rng_key, int):
+                compute_rng_key = jax.random.PRNGKey(compute_rng_key)
+            elif not (
+                isinstance(compute_rng_key, jax.Array)
+                and (
+                    jnp.issubdtype(compute_rng_key.dtype, jnp.integer)  # raw uint32 key
+                    or jnp.issubdtype(compute_rng_key.dtype, jax.dtypes.prng_key)  # typed key
+                )
+            ):
+                raise ValueError(
+                    "Argument `compute_rng_key` expected to be an int seed or a"
+                    f" jax.random key array, got {type(compute_rng_key).__name__}"
+                )
+            if subset_size > max_samples:
+                raise ValueError(
+                    f"Argument `subset_size` ({subset_size}) cannot exceed `max_samples`"
+                    f" ({max_samples}) when `compute_rng_key` is set (the in-graph draw"
+                    " samples from the fixed buffer)"
+                )
+        self.compute_rng_key = compute_rng_key
 
         if feature_dim is None:
             self.add_state("real_features", [], dist_reduce_fx=None)
@@ -214,9 +251,75 @@ class KernelInceptionDistance(Metric):
             return jnp.concatenate([buf[i, : int(count[i])] for i in range(buf.shape[0])])
         return buf[: int(count)]
 
+    def _compute_in_graph(self) -> Tuple[Array, Array]:
+        """Fully traceable buffer-mode compute: in-graph subset sampling.
+
+        Each subset draws ``subset_size`` rows uniformly WITHOUT
+        replacement from the valid prefix of the fixed ``(max_samples, D)``
+        buffer: valid rows get uniform(0, 1) priorities, invalid rows
+        ``-inf``, and ``top_k`` keeps the ``subset_size`` best — a uniform
+        random subset of the valid rows, entirely in matmul/sort ops. The
+        RNG is ``jax.random`` from the static ``compute_rng_key`` (a
+        DOCUMENTED departure from the reference's ``np.random`` stream —
+        subset values differ, the estimator's distribution does not; the
+        default eager path keeps reference-identical indices). Raising is
+        impossible in-graph, so an under-filled side (count <
+        subset_size) poisons both outputs with NaN, matching the buffer
+        paths' overflow semantics.
+        """
+        def _flat(prefix: str) -> Tuple[Array, Array, Array]:
+            """(rows, valid_mask, total_count) for 2-D or dist-stacked 3-D buffers."""
+            buf = getattr(self, f"{prefix}_buffer")
+            count = getattr(self, f"{prefix}_count")
+            if buf.ndim == 3:  # synced: (world, capacity, D) + (world,) counts
+                mask = (jnp.arange(buf.shape[1])[None, :] < count[:, None]).reshape(-1)
+                return buf.reshape(-1, buf.shape[-1]), mask, count.sum()
+            return buf, jnp.arange(buf.shape[0]) < count, count
+
+        rbuf, rmask, rcnt = _flat("real")
+        fbuf, fmask, fcnt = _flat("fake")
+
+        def _subset(key: Array, mask: Array) -> Array:
+            priorities = jnp.where(mask, jax.random.uniform(key, mask.shape), -jnp.inf)
+            _, idx = jax.lax.top_k(priorities, self.subset_size)
+            return idx
+
+        def _one_subset(key: Array) -> Array:
+            key_r, key_f = jax.random.split(key)
+            return poly_mmd(
+                rbuf[_subset(key_r, rmask)], fbuf[_subset(key_f, fmask)],
+                self.degree, self.gamma, self.coef,
+            )
+
+        scores = jax.lax.map(_one_subset, jax.random.split(self.compute_rng_key, self.subsets))
+        underfilled = (rcnt < self.subset_size) | (fcnt < self.subset_size)
+        poison = jnp.where(underfilled, jnp.asarray(jnp.nan, scores.dtype), 0.0)
+        return scores.mean() + poison, scores.std(ddof=1) + poison
+
     def compute(self) -> Tuple[Array, Array]:
         """Mean/std of per-subset MMD (ref kid.py:244-275)."""
         if self.feature_dim is not None:
+            traced = isinstance(self.real_count, jax.core.Tracer) or isinstance(
+                self.fake_count, jax.core.Tracer
+            )
+            if self.compute_rng_key is not None:
+                if not traced:
+                    # eager calls CAN raise — give the default path's clear
+                    # error instead of the traced path's silent NaN poison
+                    for prefix in ("real", "fake"):
+                        count = getattr(self, f"{prefix}_count")
+                        if int(np.asarray(count).sum()) < self.subset_size:
+                            raise ValueError(
+                                "Argument `subset_size` should be smaller than the number of samples"
+                            )
+                return self._compute_in_graph()
+            if traced:
+                raise ValueError(
+                    "KernelInceptionDistance buffer-mode `compute()` under jit needs"
+                    " `compute_rng_key=` (in-graph jax.random subset sampling); the"
+                    " default path keeps the reference's host np.random stream,"
+                    " which cannot trace"
+                )
             real_features = self._buffered("real")
             fake_features = self._buffered("fake")
         else:

@@ -455,3 +455,105 @@ class TestStreamingIS:
         m = InceptionScore(num_classes=D)
         with pytest.raises(ValueError, match="shape"):
             m.update(jnp.zeros((4, D + 2)))
+
+
+class TestKIDInGraphCompute:
+    """Opt-in compute_rng_key: buffer-mode KID compute as one traced program."""
+
+    def _filled(self, **kwargs):
+        kid = KernelInceptionDistance(
+            subsets=20, subset_size=24, feature_dim=D, max_samples=128, **kwargs
+        )
+        rng = np.random.RandomState(3)
+        kid.update(jnp.asarray(rng.rand(100, D).astype(np.float32)), real=True)
+        kid.update(jnp.asarray((rng.rand(100, D) + 0.2).astype(np.float32)), real=False)
+        return kid
+
+    def test_jit_compute_close_to_eager_reference_stream(self):
+        eager = self._filled()
+        np.random.seed(0)
+        mean_e, std_e = (float(v) for v in eager.compute())
+
+        traced = self._filled(compute_rng_key=7)
+        mean_t, std_t = jax.jit(traced.pure_compute)(traced.state())
+        assert np.isfinite(float(mean_t)) and np.isfinite(float(std_t))
+        # different RNG stream, same estimator: means agree within a few
+        # subset-std standard errors
+        tol = 4 * max(std_e, float(std_t)) / np.sqrt(20) + 1e-6
+        assert abs(float(mean_t) - mean_e) < tol
+
+    def test_in_graph_deterministic(self):
+        kid = self._filled(compute_rng_key=11)
+        a = [float(v) for v in kid.compute()]
+        kid._computed = None
+        b = [float(v) for v in kid.compute()]
+        assert a == b
+
+    def test_traced_without_key_raises_clearly(self):
+        kid = self._filled()
+        with pytest.raises(ValueError, match="compute_rng_key"):
+            jax.jit(kid.pure_compute)(kid.state())
+
+    def test_underfilled_poisons_nan(self):
+        kid = KernelInceptionDistance(
+            subsets=4, subset_size=24, feature_dim=D, max_samples=64, compute_rng_key=5
+        )
+        rng = np.random.RandomState(4)
+        kid.update(jnp.asarray(rng.rand(8, D).astype(np.float32)), real=True)  # < subset_size
+        kid.update(jnp.asarray(rng.rand(40, D).astype(np.float32)), real=False)
+        mean, std = jax.jit(kid.pure_compute)(kid.state())
+        assert np.isnan(float(mean)) and np.isnan(float(std))
+
+    def test_key_requires_buffer_path(self):
+        with pytest.raises(ValueError, match="compute_rng_key"):
+            KernelInceptionDistance(compute_rng_key=3)
+
+    def test_synced_stacked_buffers_in_graph(self):
+        """The dist-synced (world, capacity, D) layout flows through the
+        in-graph path: after a 2-rank duplicate-env sync, the flattened
+        masked draw sees both ranks' valid rows and the value stays close
+        to the un-synced one (identical duplicated distributions)."""
+        from metrics_tpu.parallel import NoOpEnv
+
+        class Fake2Env(NoOpEnv):
+            def world_size(self):
+                return 2
+
+            def all_gather(self, x):
+                return [x, x]
+
+        kid = self._filled(compute_rng_key=13)
+        single_mean = float(kid.compute()[0])
+        kid._computed = None
+        kid.sync(env=Fake2Env())
+        assert kid.real_buffer.ndim == 3  # stacked layout actually engaged
+        # the public compute() manages sync itself; having synced manually
+        # to pin the stacked layout, call the raw computation directly
+        synced_mean, synced_std = (float(v) for v in kid._compute_impl())
+        kid.unsync()
+        assert np.isfinite(synced_mean) and np.isfinite(synced_std)
+        tol = 4 * synced_std / np.sqrt(20) + 1e-6
+        assert abs(synced_mean - single_mean) < tol
+
+    def test_eager_underfill_with_key_raises(self):
+        kid = KernelInceptionDistance(
+            subsets=4, subset_size=24, feature_dim=D, max_samples=64, compute_rng_key=5
+        )
+        rng = np.random.RandomState(4)
+        kid.update(jnp.asarray(rng.rand(8, D).astype(np.float32)), real=True)
+        kid.update(jnp.asarray(rng.rand(40, D).astype(np.float32)), real=False)
+        with pytest.raises(ValueError, match="subset_size"):
+            kid.compute()
+
+    def test_key_validation(self):
+        with pytest.raises(ValueError, match="compute_rng_key"):
+            KernelInceptionDistance(feature_dim=D, max_samples=64, compute_rng_key="seed")
+        with pytest.raises(ValueError, match="subset_size"):
+            KernelInceptionDistance(
+                subset_size=128, feature_dim=D, max_samples=64, compute_rng_key=1
+            )
+        # both key flavors accepted
+        KernelInceptionDistance(subset_size=32, feature_dim=D, max_samples=64,
+                                compute_rng_key=jax.random.PRNGKey(0))
+        KernelInceptionDistance(subset_size=32, feature_dim=D, max_samples=64,
+                                compute_rng_key=jax.random.key(0))

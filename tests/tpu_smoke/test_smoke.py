@@ -301,3 +301,18 @@ def test_ragged_detection_sync_on_device():
     m2 = MeanAveragePrecision()
     m2.update(preds + preds, targs + targs)
     np.testing.assert_allclose(float(m2.compute()["map"]), single, atol=1e-7)
+
+
+def test_kid_in_graph_compute_on_device():
+    """Round-4 opt-in compute_rng_key: buffer-mode KID compute — subset
+    sampling included — as ONE jitted program on the real chip."""
+    from metrics_tpu.image.kid import KernelInceptionDistance
+
+    kid = KernelInceptionDistance(
+        subsets=8, subset_size=16, feature_dim=32, max_samples=64, compute_rng_key=3
+    )
+    kid.update(jnp.asarray(RNG.rand(48, 32).astype(np.float32)), real=True)
+    kid.update(jnp.asarray((RNG.rand(48, 32) + 0.2).astype(np.float32)), real=False)
+    mean, std = jax.jit(kid.pure_compute)(kid.state())
+    assert np.isfinite(float(mean)) and np.isfinite(float(std))
+    assert float(mean) > 0
