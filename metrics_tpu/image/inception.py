@@ -36,10 +36,20 @@ class InceptionScore(Metric):
             order is exchangeable: for a stream whose order correlates
             with content (sorted datasets, curriculum order), round-robin
             splits are near-identical and the std biases LOW relative to
-            the reference's shuffled chunks. Shuffle the stream (or use
-            the list path) when the std matters on ordered data;
-            ``splits=1`` is bit-identical. O(1) memory,
-            ``dist_reduce_fx="sum"`` merge, fully jit/scan-compatible.
+            the reference's shuffled chunks. Pass ``assignment_rng_key``
+            (or shuffle the stream, or use the list path) when the std
+            matters on ordered data; ``splits=1`` is bit-identical.
+            O(1) memory, ``dist_reduce_fx="sum"`` merge, fully
+            jit/scan-compatible.
+        assignment_rng_key: opt-in (streaming path only): an int seed or
+            ``jax.random`` key that assigns samples to splits RANDOMLY
+            (keyed by the running sample count — deterministic per
+            stream, traceable, mergeable), restoring an honest per-split
+            std on content-ordered streams. Split sizes become
+            multinomial rather than exactly equal: the mean stays an
+            unbiased estimate (tiny deviation from the round-robin
+            value), and feeding far fewer samples than ``splits`` can
+            leave a split empty (NaN, like an empty chunk would).
 
     Example (pre-extracted logits):
         >>> import jax, jax.numpy as jnp
@@ -60,6 +70,7 @@ class InceptionScore(Metric):
         logits_extractor: Optional[Callable[[Array], Array]] = None,
         splits: int = 10,
         num_classes: Optional[int] = None,
+        assignment_rng_key: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -70,6 +81,16 @@ class InceptionScore(Metric):
         if num_classes is not None and not (isinstance(num_classes, int) and num_classes > 0):
             raise ValueError("Argument `num_classes` expected to be `None` or a positive integer")
         self.num_classes = num_classes
+        if assignment_rng_key is not None:
+            if num_classes is None:
+                raise ValueError(
+                    "Argument `assignment_rng_key` applies to the streaming path only"
+                    " (`num_classes=`); the list path already shuffles at compute"
+                )
+            from metrics_tpu.utilities.checks import as_rng_key
+
+            assignment_rng_key = as_rng_key(assignment_rng_key, "assignment_rng_key")
+        self.assignment_rng_key = assignment_rng_key
         if num_classes is None:
             self.add_state("features", [], dist_reduce_fx=None)
         else:
@@ -88,7 +109,17 @@ class InceptionScore(Metric):
         n = features.shape[0]
         prob = jax.nn.softmax(features, axis=1)
         log_prob = jax.nn.log_softmax(features, axis=1)
-        ids = (self.num_seen + jnp.arange(n)) % self.splits
+        if self.assignment_rng_key is not None:
+            # random split assignment, keyed by the running sample count:
+            # deterministic for a given stream, traceable, and mergeable
+            # (segment sums add regardless of how ids were drawn). For
+            # content-ordered streams this keeps the per-split std honest
+            # where round-robin makes splits near-identical; split sizes
+            # become multinomial instead of exactly equal (documented).
+            key = jax.random.fold_in(self.assignment_rng_key, self.num_seen)
+            ids = jax.random.randint(key, (n,), 0, self.splits)
+        else:
+            ids = (self.num_seen + jnp.arange(n)) % self.splits
         self.prob_sum = self.prob_sum + jax.ops.segment_sum(prob, ids, num_segments=self.splits)
         self.plogp_sum = self.plogp_sum + jax.ops.segment_sum((prob * log_prob).sum(axis=1), ids, num_segments=self.splits)
         self.split_count = self.split_count + jax.ops.segment_sum(jnp.ones(n), ids, num_segments=self.splits)

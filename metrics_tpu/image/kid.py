@@ -139,19 +139,9 @@ class KernelInceptionDistance(Metric):
                     " (`feature_dim=`/`max_samples=`): the list path has no static"
                     " bound to sample under jit"
                 )
-            if isinstance(compute_rng_key, int):
-                compute_rng_key = jax.random.PRNGKey(compute_rng_key)
-            elif not (
-                isinstance(compute_rng_key, jax.Array)
-                and (
-                    jnp.issubdtype(compute_rng_key.dtype, jnp.integer)  # raw uint32 key
-                    or jnp.issubdtype(compute_rng_key.dtype, jax.dtypes.prng_key)  # typed key
-                )
-            ):
-                raise ValueError(
-                    "Argument `compute_rng_key` expected to be an int seed or a"
-                    f" jax.random key array, got {type(compute_rng_key).__name__}"
-                )
+            from metrics_tpu.utilities.checks import as_rng_key
+
+            compute_rng_key = as_rng_key(compute_rng_key, "compute_rng_key")
             if subset_size > max_samples:
                 raise ValueError(
                     f"Argument `subset_size` ({subset_size}) cannot exceed `max_samples`"

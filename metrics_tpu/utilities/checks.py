@@ -26,6 +26,31 @@ from metrics_tpu.utilities.enums import DataType
 Array = jax.Array
 
 
+def as_rng_key(value, arg_name: str):
+    """Coerce an int seed or ``jax.random`` key to a usable key, eagerly.
+
+    Metrics taking an opt-in RNG key (KID's ``compute_rng_key``,
+    InceptionScore's ``assignment_rng_key``) validate at CONSTRUCTION so a
+    bad value fails with a clear message instead of an opaque trace-time
+    error deep inside ``jax.random``. Accepts: a Python int seed, a typed
+    ``jax.random.key`` array, or a raw legacy ``PRNGKey`` (uint32 with
+    trailing dimension 2).
+    """
+    if isinstance(value, int) and not isinstance(value, bool):
+        return jax.random.PRNGKey(value)
+    if isinstance(value, jax.Array):
+        if jnp.issubdtype(value.dtype, jax.dtypes.prng_key):
+            return value
+        if value.dtype == jnp.uint32 and value.ndim >= 1 and value.shape[-1] == 2:
+            return value
+    raise ValueError(
+        f"Argument `{arg_name}` expected to be an int seed or a jax.random key"
+        " (typed key or raw uint32 (..., 2) PRNGKey),"
+        f" got {type(value).__name__}"
+        + (f" with dtype={value.dtype} shape={value.shape}" if isinstance(value, jax.Array) else "")
+    )
+
+
 def _is_traced(*xs) -> bool:
     return any(isinstance(x, jax.core.Tracer) for x in xs)
 
