@@ -909,3 +909,41 @@ def test_curve_modules_match_reference(reference):
                     np.asarray(a), b.numpy(), rtol=1e-4, atol=1e-4,
                     err_msg=f"{name} class {cls}",
                 )
+
+
+def test_tracker_over_collection_matches_reference(reference):
+    """MetricTracker wrapping a MetricCollection — per-metric maximize
+    flags, per-metric best values and steps (ref wrappers/tracker.py)."""
+    import torch
+
+    import metrics_tpu
+
+    mine = metrics_tpu.MetricTracker(
+        metrics_tpu.MetricCollection(
+            [metrics_tpu.MeanSquaredError(), metrics_tpu.ExplainedVariance()]
+        ),
+        maximize=[False, True],
+    )
+    ref = reference.MetricTracker(
+        reference.MetricCollection(
+            [reference.MeanSquaredError(), reference.ExplainedVariance()]
+        ),
+        maximize=[False, True],
+    )
+    for i in range(_NBATCH):
+        mine.increment()
+        ref.increment()
+        mine.update(jnp.asarray(_mod_reg_p[i]), jnp.asarray(_mod_reg_t[i]))
+        ref.update(torch.from_numpy(_mod_reg_p[i]), torch.from_numpy(_mod_reg_t[i]))
+
+    got, exp = mine.compute(), ref.compute()
+    assert set(got) == set(exp)
+    for k in exp:
+        np.testing.assert_allclose(float(got[k]), float(exp[k]), rtol=1e-5, err_msg=k)
+
+    best_mine, steps_mine = mine.best_metric(return_step=True)
+    best_ref, steps_ref = ref.best_metric(return_step=True)
+    assert set(best_mine) == set(best_ref)
+    for k in best_ref:
+        assert steps_mine[k] == steps_ref[k], k
+        np.testing.assert_allclose(float(best_mine[k]), float(best_ref[k]), rtol=1e-5, err_msg=k)
