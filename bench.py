@@ -389,14 +389,34 @@ def _bench_detail() -> dict:
     ext = InceptionV3FeatureExtractor()
     imgs = jnp.asarray((rng.rand(8, 3, 299, 299) * 255).astype(np.uint8))
     fid = FrechetInceptionDistance(feature_extractor=ext)
-    fid.update(imgs, real=True)  # warm (compiles the inception trunk)
+    # warm both update variants (belt-and-braces: with the default eager
+    # list-state update only the real-agnostic extractor jit matters, but a
+    # jit_update config would add one cache entry per static `real` value)
+    fid.update(imgs, real=True)
     jax.block_until_ready(fid.real_features[-1])
-    t0 = time.perf_counter()
+    fid.update(imgs, real=False)
+    jax.block_until_ready(fid.fake_features[-1])
+    # best-of-reps: a single timed loop is exposed to tunnel-congestion
+    # spikes (the 2026-08-01 capture recorded 2987 ms/call minutes before
+    # the tunnel wedged entirely; an isolated probe on the same chip+rev
+    # measured 0.4-0.5 ms warm)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(5):
+            fid.update(imgs, real=False)
+        jax.block_until_ready(fid.fake_features[-1])
+        best = min(best, (time.perf_counter() - t0) / 5 * 1e3)
+    detail["fid_update_ms_batch8_299px"] = round(best, 1)
+    _mark("fid_update_ms_batch8_299px")
+    # pin the compute workload to the historical basis (1 real + 5 fake
+    # batches) so fid_compute_s stays comparable across captures no matter
+    # how many timing reps ran above
+    fid.reset()
+    fid.update(imgs, real=True)
     for _ in range(5):
         fid.update(imgs, real=False)
     jax.block_until_ready(fid.fake_features[-1])
-    detail["fid_update_ms_batch8_299px"] = round((time.perf_counter() - t0) / 5 * 1e3, 1)
-    _mark("fid_update_ms_batch8_299px")
     t0 = time.perf_counter()
     jax.block_until_ready(fid.compute())
     detail["fid_compute_s"] = round(time.perf_counter() - t0, 2)
