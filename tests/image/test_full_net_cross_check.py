@@ -253,8 +253,8 @@ def test_inception_full_forward_golden():
 # --------------------------------------------------------------------------
 # torch-side LPIPS (lpips-package semantics)
 # --------------------------------------------------------------------------
-_SHIFT_T = torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1)
-_SCALE_T = torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1)
+_SHIFT_VALS = (-0.030, -0.088, -0.188)
+_SCALE_VALS = (0.458, 0.448, 0.450)
 
 
 def _torch_alex_taps(backbone, x):
@@ -287,13 +287,22 @@ def _torch_vgg_taps(backbone, x):
     return taps
 
 
-def _torch_lpips(backbone, lins, net, x1, x2):
-    """lpips-package forward: scale, tap, unit-normalize, lin, mean, sum."""
+def _torch_lpips(backbone, lins, net, x1, x2, dtype=torch.float32):
+    """lpips-package forward: scale, tap, unit-normalize, lin, mean, sum.
+
+    ``dtype`` sets the scaling constants and accumulator precision; pass
+    f64 weights/inputs with ``dtype=torch.float64`` for an all-f64 run
+    (the end-to-end metric parity test does).
+    """
     tap_fn = _torch_alex_taps if net == "alex" else _torch_vgg_taps
     with torch.no_grad():
-        t1 = tap_fn(backbone, (x1 - _SHIFT_T) / _SCALE_T)
-        t2 = tap_fn(backbone, (x2 - _SHIFT_T) / _SCALE_T)
-        total = torch.zeros(x1.shape[0])
+        # constants built from the literals at the target dtype (a widened
+        # f32 constant differs from the flax side's native-f64 parse)
+        shift = torch.tensor(_SHIFT_VALS, dtype=dtype).view(1, 3, 1, 1)
+        scale = torch.tensor(_SCALE_VALS, dtype=dtype).view(1, 3, 1, 1)
+        t1 = tap_fn(backbone, (x1 - shift) / scale)
+        t2 = tap_fn(backbone, (x2 - shift) / scale)
+        total = torch.zeros(x1.shape[0], dtype=dtype)
         for i, (a, b) in enumerate(zip(t1, t2)):
             na = a * torch.rsqrt((a**2).sum(1, keepdim=True) + 1e-10)
             nb = b * torch.rsqrt((b**2).sum(1, keepdim=True) + 1e-10)

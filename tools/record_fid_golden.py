@@ -29,6 +29,10 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     import jax
+
+    # goldens are CPU artifacts; the config API is the pin that actually
+    # works on this image (the site platform plugin overrides JAX_PLATFORMS)
+    jax.config.update("jax_platforms", "cpu")
     import scipy
     import torch
 

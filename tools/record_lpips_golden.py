@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Record the dual-stack end-to-end LPIPS golden (both backbones).
+
+Runs BOTH pipelines (the reference's lpips-package pipeline semantics in
+torch and this framework's checkpoint→converter→net→metric path — see
+tests/image/test_lpips_end_to_end.py) over the fixed seeded checkpoints
+and image batches, and writes ``tests/image/lpips_end_to_end_golden.json``.
+
+Needs torch (baked into this image). Re-run only when the synthetic-state
+generator, the converter mapping, or the network forward changes — the
+committed golden is the durable cross-stack parity artifact.
+
+    python tools/record_lpips_golden.py
+"""
+import json
+import os
+import sys
+import tempfile
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests", "image"))
+
+
+def main(argv=None):
+    import jax
+
+    # goldens are CPU artifacts; the config API is the pin that actually
+    # works on this image (the site platform plugin overrides JAX_PLATFORMS)
+    jax.config.update("jax_platforms", "cpu")
+    import torch
+
+    from test_lpips_end_to_end import GOLDEN_PATH, run_both_pipelines
+
+    records = []
+    for net in ("alex", "vgg"):
+        with tempfile.TemporaryDirectory() as tmpdir:
+            records.append(run_both_pipelines(net, tmpdir))
+    for rec in records:
+        rec["versions"] = {"jax": jax.__version__, "torch": torch.__version__}
+    with open(GOLDEN_PATH, "w") as f:
+        json.dump(records, f, indent=2)
+        f.write("\n")
+    print(f"wrote {GOLDEN_PATH}:")
+    print(json.dumps(records, indent=2))
+
+
+if __name__ == "__main__":
+    main()
