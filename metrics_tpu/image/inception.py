@@ -140,8 +140,17 @@ class InceptionScore(Metric):
         prob = jax.nn.softmax(features, axis=1)
         log_prob = jax.nn.log_softmax(features, axis=1)
 
-        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
-        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+        # torch.chunk semantics (ref inception.py:139-140), NOT array_split:
+        # chunks are ceil(N/splits) rows each, and when N % splits != 0 that
+        # can mean FEWER than `splits` chunks (e.g. N=25, splits=10 -> nine
+        # chunks of 3,3,3,3,3,3,3,3,1) — sizes, std, and mean all differ
+        # from an equal-split layout
+        # max(..., 1): with zero accumulated samples this degrades to one
+        # empty chunk -> NaN, like torch.chunk's empty chunks do
+        chunk_rows = max(-(-prob.shape[0] // self.splits), 1)
+        boundaries = list(range(chunk_rows, prob.shape[0], chunk_rows))
+        prob_chunks = jnp.split(prob, boundaries, axis=0)
+        log_prob_chunks = jnp.split(log_prob, boundaries, axis=0)
 
         kl_scores = []
         for p, log_p in zip(prob_chunks, log_prob_chunks):

@@ -193,7 +193,10 @@ class InceptionV3(nn.Module):
         x = InceptionE(pool="max", dtype=self.dtype)(x)  # Mixed_7c, FID variant
         features = jnp.mean(x, axis=(1, 2))  # global average pool -> (N, 2048)
         logits = nn.Dense(self.num_classes, dtype=self.dtype)(features.astype(self.dtype))
-        return features.astype(jnp.float32), logits.astype(jnp.float32)
+        # outputs at f32 or better: bf16/f16 compute upcasts (stable metric
+        # math downstream), f64 compute stays f64 (end-to-end parity runs)
+        out_dt = jnp.promote_types(jnp.float32, jnp.result_type(self.dtype))
+        return features.astype(out_dt), logits.astype(out_dt)
 
 
 def load_params(npz_path: str) -> Any:
@@ -296,10 +299,13 @@ class InceptionV3FeatureExtractor:
         weights_path: local ``.npz`` of flax variables (``save_params``
             layout). ``None`` -> deterministic random init (documented
             above; this environment cannot download weight assets).
-        output: 'pool' (2048-d features) or 'logits'.
+        output: 'pool' (2048-d features), 'logits', or 'logits_unbiased'
+            (fc head without bias — torch_fidelity's feature name and the
+            reference IS/KID default, ref inception.py:106).
         num_classes: logits head width (1008 = FID variant).
         dtype: compute dtype for the conv trunk (``jnp.bfloat16`` uses the
-            MXU's native precision; features are returned as float32).
+            MXU's native precision; outputs come back at f32 or better —
+            bf16/f16 compute upcasts to f32, f64 compute stays f64).
     """
 
     def __init__(
@@ -309,8 +315,10 @@ class InceptionV3FeatureExtractor:
         num_classes: int = 1008,
         dtype: Any = jnp.float32,
     ) -> None:
-        if output not in ("pool", "logits"):
-            raise ValueError(f"Argument `output` must be 'pool' or 'logits', got {output}")
+        if output not in ("pool", "logits", "logits_unbiased"):
+            raise ValueError(
+                f"Argument `output` must be 'pool', 'logits' or 'logits_unbiased', got {output}"
+            )
         self.output = output
         self.net = InceptionV3(num_classes=num_classes, dtype=dtype)
         if weights_path is not None:
@@ -338,7 +346,14 @@ class InceptionV3FeatureExtractor:
         if imgs.shape[1] == 3 and imgs.shape[-1] != 3:  # NCHW -> NHWC
             imgs = jnp.transpose(imgs, (0, 2, 3, 1))
         features, logits = self.net.apply(variables, imgs)
-        return features if self.output == "pool" else logits
+        if self.output == "pool":
+            return features
+        if self.output == "logits_unbiased":
+            # torch_fidelity's 'logits_unbiased' (the reference IS/KID
+            # default feature) is the fc head without its bias; since the
+            # head is linear, that is exactly logits - bias
+            return logits - variables["params"]["Dense_0"]["bias"]
+        return logits
 
     def __call__(self, imgs: Array) -> Array:
         if self._jitted is None:

@@ -284,7 +284,8 @@ class KernelInceptionDistance(Metric):
         scores = jax.lax.map(_one_subset, jax.random.split(self.compute_rng_key, self.subsets))
         underfilled = (rcnt < self.subset_size) | (fcnt < self.subset_size)
         poison = jnp.where(underfilled, jnp.asarray(jnp.nan, scores.dtype), 0.0)
-        return scores.mean() + poison, scores.std(ddof=1) + poison
+        # ddof=0: the reference's biased std (kid.py:275 `unbiased=False`)
+        return scores.mean() + poison, scores.std(ddof=0) + poison
 
     def compute(self) -> Tuple[Array, Array]:
         """Mean/std of per-subset MMD (ref kid.py:244-275)."""
@@ -348,7 +349,8 @@ class KernelInceptionDistance(Metric):
             return poly_mmd(real_features[ir], fake_features[if_], self.degree, self.gamma, self.coef)
 
         kid_scores = jax.lax.map(_one_subset, (jnp.asarray(idx_real), jnp.asarray(idx_fake)))
-        return kid_scores.mean(), kid_scores.std(ddof=1)
+        # ddof=0: the reference's biased std (kid.py:275 `unbiased=False`)
+        return kid_scores.mean(), kid_scores.std(ddof=0)
 
     def reset(self) -> None:
         if not self.reset_real_features:

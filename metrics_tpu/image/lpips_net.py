@@ -92,7 +92,8 @@ class _LPIPSModule(nn.Module):
             diff = (_unit_normalize(t1) - _unit_normalize(t2)) ** 2
             score = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}", dtype=self.dtype)(diff)
             total = total + jnp.mean(score, axis=(1, 2, 3))
-        return total.astype(jnp.float32)
+        # f32 or better: bf16 compute upcasts, f64 stays f64 (parity runs)
+        return total.astype(jnp.promote_types(jnp.float32, jnp.result_type(self.dtype)))
 
 
 class LPIPSNet:
@@ -107,7 +108,8 @@ class LPIPSNet:
         weights_path: local ``.npz`` of flax variables; ``None`` ->
             deterministic random init.
         dtype: compute dtype for the backbone (``jnp.bfloat16`` for MXU-
-            native precision; scores return float32).
+            native precision; scores come back at f32 or better — bf16
+            compute upcasts to f32, f64 compute stays f64).
     """
 
     def __init__(

@@ -1,0 +1,169 @@
+"""End-to-end InceptionScore / KID parity through the full pipeline.
+
+Completes the perceptual-family set (FID: ``test_fid_end_to_end.py``,
+LPIPS: ``test_lpips_end_to_end.py``): a torch checkpoint on disk goes
+through ``tools/convert_inception_weights.py``, the flax extractor, and
+the metric's own accumulate/compute, and the result is compared against
+the reference pipeline's number computed in torch at f64.
+
+Determinism without touching either stack's RNG:
+
+- **InceptionScore** with ``splits=1``: the reference permutes features
+  before chunking (ref inception.py:133-134), but with one split the
+  score is permutation-invariant, so both stacks are exactly comparable.
+  The feature is the reference's default ``'logits_unbiased'`` (the fc
+  head without bias, ref inception.py:106) — both the list path and the
+  fixed-shape streaming path (``num_classes=``) are checked.
+- **KernelInceptionDistance** with ``subset_size == N``: every "random"
+  subset is the full set permuted, and the polynomial-kernel MMD is
+  permutation-invariant, so all subset scores equal the full-set MMD
+  (mean = that value, biased std = 0 — pinning the reference's
+  ``std(unbiased=False)``, ref kid.py:275).
+
+The checkpoint is the same seeded synthetic state dict as the FID test
+(zero-egress image; names/shapes/semantics are the real network's). The
+committed golden (``is_kid_end_to_end_golden.json``, written by
+``tools/record_is_kid_golden.py``) pins both stacks' numbers.
+"""
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+sys.path.insert(0, os.path.dirname(__file__))
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "is_kid_end_to_end_golden.json")
+
+N_PER_SIDE = 8
+
+
+def _setup(tmpdir, n=N_PER_SIDE, img_seed=None):
+    from test_fid_end_to_end import IMG_SEED, _build_npz, _images
+
+    real_u8, fake_u8 = _images(n, IMG_SEED if img_seed is None else img_seed)
+    state, npz = _build_npz(tmpdir)
+    return state, npz, real_u8, fake_u8
+
+
+def _torch_features(state, u8):
+    """uint8 images -> (pool feats, unbiased logits), both f64 torch."""
+    import torch
+    from test_full_net_cross_check import _torch_inception_forward
+
+    state64 = {k: v.double() for k, v in state.items()}
+    x = (torch.from_numpy(u8).float() / 127.5 - 1.0).double()
+    feats, _ = _torch_inception_forward(state64, x)
+    feats = torch.from_numpy(feats)
+    # torch_fidelity's 'logits_unbiased': the fc head without bias
+    logits_unbiased = torch.nn.functional.linear(feats, state64["fc.weight"])
+    return feats, logits_unbiased
+
+
+def torch_reference_is(logits):
+    """Reference IS compute at splits=1 (ref inception.py:128-152; the
+    permutation is a no-op for a single chunk)."""
+    prob = logits.softmax(dim=1)
+    log_prob = logits.log_softmax(dim=1)
+    mean_prob = prob.mean(dim=0, keepdim=True)
+    kl = prob * (log_prob - mean_prob.log())
+    return float(kl.sum(dim=1).mean().exp())
+
+
+def torch_reference_kid(f_real, f_fake, degree=3, gamma=None, coef=1.0):
+    """Reference poly-kernel MMD over the FULL sets (ref kid.py:29-64);
+    with subset_size == N every reference subset score equals this."""
+    import torch
+
+    def poly_kernel(f1, f2):
+        g = 1.0 / f1.shape[1] if gamma is None else gamma
+        return (f1 @ f2.T * g + coef) ** degree
+
+    k_11, k_22, k_12 = poly_kernel(f_real, f_real), poly_kernel(f_fake, f_fake), poly_kernel(f_real, f_fake)
+    m = k_11.shape[0]
+    kt_xx = k_11.sum() - torch.diag(k_11).sum()
+    kt_yy = k_22.sum() - torch.diag(k_22).sum()
+    value = (kt_xx + kt_yy) / (m * (m - 1)) - 2 * k_12.sum() / (m**2)
+    return float(value)
+
+
+def repo_is_from_npz(npz, fake_u8):
+    """Checkpoint file → unbiased-logits extractor → InceptionScore,
+    both the list path and the fixed-shape streaming path."""
+    from metrics_tpu.image import InceptionScore, InceptionV3FeatureExtractor
+
+    with jax.enable_x64(True):
+        ext = InceptionV3FeatureExtractor(
+            weights_path=npz, output="logits_unbiased", dtype=jnp.float64
+        )
+        is_list = InceptionScore(logits_extractor=ext, splits=1)
+        is_stream = InceptionScore(logits_extractor=ext, splits=1, num_classes=1008)
+        for m in (is_list, is_stream):
+            # two batches so the streaming accumulation actually folds
+            m.update(jnp.asarray(fake_u8[: len(fake_u8) // 2]))
+            m.update(jnp.asarray(fake_u8[len(fake_u8) // 2 :]))
+        return float(is_list.compute()[0]), float(is_stream.compute()[0])
+
+
+def repo_kid_from_npz(npz, real_u8, fake_u8, n):
+    from metrics_tpu.image import InceptionV3FeatureExtractor, KernelInceptionDistance
+
+    with jax.enable_x64(True):
+        ext = InceptionV3FeatureExtractor(weights_path=npz, dtype=jnp.float64)
+        kid = KernelInceptionDistance(feature_extractor=ext, subsets=2, subset_size=n)
+        kid.update(jnp.asarray(real_u8), real=True)
+        kid.update(jnp.asarray(fake_u8), real=False)
+        mean, std = kid.compute()
+        return float(mean), float(std)
+
+
+def run_both_pipelines(tmpdir, n=N_PER_SIDE):
+    """Shared by the live test and tools/record_is_kid_golden.py."""
+    state, npz, real_u8, fake_u8 = _setup(tmpdir, n)
+    feats_real, _ = _torch_features(state, real_u8)
+    feats_fake, logits_fake = _torch_features(state, fake_u8)
+    torch_is = torch_reference_is(logits_fake)
+    torch_kid = torch_reference_kid(feats_real, feats_fake)
+    repo_is_list, repo_is_stream = repo_is_from_npz(npz, fake_u8)
+    repo_kid_mean, repo_kid_std = repo_kid_from_npz(npz, real_u8, fake_u8, n)
+    return {
+        "n_per_side": n,
+        "torch_is": torch_is,
+        "torch_kid": torch_kid,
+        "repo_is_list": repo_is_list,
+        "repo_is_stream": repo_is_stream,
+        "repo_kid_mean": repo_kid_mean,
+        "repo_kid_std": repo_kid_std,
+        "is_reldiff": abs(repo_is_list - torch_is) / max(abs(torch_is), 1e-300),
+        "kid_reldiff": abs(repo_kid_mean - torch_kid) / max(abs(torch_kid), 1e-300),
+    }
+
+
+def test_is_kid_end_to_end_matches_torch(tmpdir):
+    pytest.importorskip("torch")
+    res = run_both_pipelines(tmpdir)
+    assert res["torch_is"] > 0
+    # f64 end to end on both stacks; measured agreement ~1e-9 relative
+    assert abs(res["repo_is_list"] - res["torch_is"]) <= 1e-6 * abs(res["torch_is"])
+    # the streaming-moment layout is the same number through different state
+    assert abs(res["repo_is_stream"] - res["repo_is_list"]) <= 1e-9 * abs(res["repo_is_list"])
+    assert abs(res["repo_kid_mean"] - res["torch_kid"]) <= 1e-6 * abs(res["torch_kid"]) + 1e-12
+    # subset_size == N: every subset is the full set, so the biased std is 0
+    assert abs(res["repo_kid_std"]) <= 1e-9
+
+
+def test_is_kid_end_to_end_matches_committed_golden(tmpdir):
+    pytest.importorskip("torch")
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    assert golden["is_reldiff"] < 1e-6 and golden["kid_reldiff"] < 1e-6
+    _, npz, real_u8, fake_u8 = _setup(tmpdir, golden["n_per_side"])
+    repo_is_list, repo_is_stream = repo_is_from_npz(npz, fake_u8)
+    repo_kid_mean, _ = repo_kid_from_npz(npz, real_u8, fake_u8, golden["n_per_side"])
+    assert abs(repo_is_list - golden["torch_is"]) <= 1e-6 * abs(golden["torch_is"])
+    assert abs(repo_is_stream - golden["torch_is"]) <= 1e-6 * abs(golden["torch_is"])
+    assert abs(repo_kid_mean - golden["torch_kid"]) <= 1e-6 * abs(golden["torch_kid"]) + 1e-12

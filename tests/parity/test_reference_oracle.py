@@ -947,3 +947,89 @@ def test_tracker_over_collection_matches_reference(reference):
     for k in best_ref:
         assert steps_mine[k] == steps_ref[k], k
         np.testing.assert_allclose(float(best_mine[k]), float(best_ref[k]), rtol=1e-5, err_msg=k)
+
+
+def test_inception_score_matches_reference_with_shared_permutation(reference, monkeypatch):
+    """InceptionScore module lifecycle vs the live reference with the SAME
+    feature permutation in both frameworks (each draws its own RNG at
+    compute; everything else — softmax KL per chunk, exp, mean/std — is
+    compared live). N=25 with splits=10 deliberately exercises torch.chunk
+    semantics: ceil(25/10)=3-row chunks -> only NINE chunks (eight of 3,
+    one of 1), not ten equal parts. Ref: image/inception.py:128-152; the
+    reference needs no torch_fidelity when `feature` is an nn.Module
+    (inception.py:131-132) — Identity makes update() accumulate raw
+    logits in both stacks."""
+    import torch
+    from torchmetrics.image.inception import InceptionScore as RefIS
+
+    from metrics_tpu.image import InceptionScore as MyIS
+
+    rng = np.random.RandomState(77)
+    batches = [rng.randn(n, 7).astype(np.float32) * 3 for n in (9, 8, 8)]
+    total = sum(b.shape[0] for b in batches)
+
+    mine = MyIS(splits=10)
+    ref = RefIS(feature=torch.nn.Identity(), splits=10)
+    for b in batches:
+        mine.update(jnp.asarray(b))
+        ref.update(torch.from_numpy(b))
+
+    # pin the one random stage: precompute the reference's upcoming draw,
+    # then rewind its RNG so compute() re-draws exactly that permutation
+    torch.manual_seed(123)
+    state = torch.get_rng_state()
+    perm = torch.randperm(total).numpy()
+    torch.set_rng_state(state)
+    monkeypatch.setattr(np.random, "permutation", lambda n: perm)
+
+    ref_mean, ref_std = ref.compute()
+    my_mean, my_std = mine.compute()
+    np.testing.assert_allclose(float(my_mean), float(ref_mean), rtol=1e-5)
+    np.testing.assert_allclose(float(my_std), float(ref_std), rtol=1e-4)
+
+
+@pytest.mark.parametrize("kid_kwargs", [
+    {"subsets": 3, "subset_size": 12},
+    {"subsets": 4, "subset_size": 10, "degree": 2, "gamma": 0.3, "coef": 0.5},
+])
+def test_kid_matches_reference_with_shared_subsets(reference, monkeypatch, kid_kwargs):
+    """KernelInceptionDistance lifecycle vs the live reference with the
+    SAME subset draws injected (the reference draws torch.randperm twice
+    per subset, real then fake — kid.py:262-266; this framework keeps the
+    identical interleaved host-RNG stream). Pins the polynomial-kernel
+    MMD, the mean, and the BIASED std (ref kid.py:275 unbiased=False).
+    Identity feature module: update() accumulates raw features."""
+    import torch
+    from torchmetrics.image.kid import KernelInceptionDistance as RefKID
+
+    from metrics_tpu.image import KernelInceptionDistance as MyKID
+
+    rng = np.random.RandomState(78)
+    real_batches = [rng.rand(n, 16).astype(np.float32) for n in (14, 16)]
+    fake_batches = [rng.rand(n, 16).astype(np.float32) + 0.3 for n in (12, 14)]
+    n_real = sum(b.shape[0] for b in real_batches)
+    n_fake = sum(b.shape[0] for b in fake_batches)
+
+    mine = MyKID(**kid_kwargs)
+    ref = RefKID(feature=torch.nn.Identity(), **kid_kwargs)
+    for b in real_batches:
+        mine.update(jnp.asarray(b), real=True)
+        ref.update(torch.from_numpy(b), real=True)
+    for b in fake_batches:
+        mine.update(jnp.asarray(b), real=False)
+        ref.update(torch.from_numpy(b), real=False)
+
+    torch.manual_seed(321)
+    state = torch.get_rng_state()
+    draws = []
+    for _ in range(kid_kwargs["subsets"]):
+        draws.append(torch.randperm(n_real).numpy())
+        draws.append(torch.randperm(n_fake).numpy())
+    torch.set_rng_state(state)
+    seq = iter(draws)
+    monkeypatch.setattr(np.random, "permutation", lambda n: next(seq))
+
+    ref_mean, ref_std = ref.compute()
+    my_mean, my_std = mine.compute()
+    np.testing.assert_allclose(float(my_mean), float(ref_mean), rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(float(my_std), float(ref_std), rtol=1e-4, atol=1e-8)

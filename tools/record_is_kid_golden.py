@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Record the dual-stack end-to-end InceptionScore/KID golden.
+
+Runs BOTH pipelines (the reference's IS/KID compute semantics in torch and
+this framework's checkpoint→converter→extractor→metric path — see
+tests/image/test_is_kid_end_to_end.py) over the fixed seeded checkpoint
+and image sets, and writes ``tests/image/is_kid_end_to_end_golden.json``.
+
+Needs torch (baked into this image). Re-run only when the synthetic-state
+generator, the converter mapping, or the network forward changes.
+
+    python tools/record_is_kid_golden.py [--n 8]
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests", "image"))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--n", type=int, default=8, help="images per distribution")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    # goldens are CPU artifacts; the config API is the pin that actually
+    # works on this image (the site platform plugin overrides JAX_PLATFORMS)
+    jax.config.update("jax_platforms", "cpu")
+    import torch
+
+    from test_is_kid_end_to_end import GOLDEN_PATH, run_both_pipelines
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        rec = run_both_pipelines(tmpdir, args.n)
+    rec["versions"] = {"jax": jax.__version__, "torch": torch.__version__}
+    with open(GOLDEN_PATH, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    print(f"wrote {GOLDEN_PATH}:")
+    print(json.dumps(rec, indent=2))
+
+
+if __name__ == "__main__":
+    main()
