@@ -249,6 +249,13 @@ class FrechetInceptionDistance(Metric):
             the origin where the error is relative again. A CONSTANT, so
             states stay sum-mergeable across shards/processes and updates
             stay jit/scan-compatible. Moment path only.
+        feature: reference-style selector for the bundled InceptionV3
+            extractor (ref fid.py:160-186): 64 / 192 / 768 / 2048
+            intermediate-tap width or ``'logits_unbiased'``. Mutually
+            exclusive with ``feature_extractor``.
+        weights_path: local ``.npz`` of converted InceptionV3 weights for
+            the bundled extractor (see docs/pretrained_weights.md);
+            implies ``feature=2048`` when ``feature`` is not given.
 
     Example (pre-extracted features):
         >>> import jax, jax.numpy as jnp
@@ -272,9 +279,17 @@ class FrechetInceptionDistance(Metric):
         sqrtm_method: Optional[str] = None,
         feature_dim: Optional[int] = None,
         feature_shift: Optional[Any] = None,
+        feature: Optional[Any] = None,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        if feature is not None or weights_path is not None:
+            from metrics_tpu.image.inception_net import resolve_ctor_extractor
+
+            feature_extractor = resolve_ctor_extractor(
+                feature_extractor, feature, weights_path, default_output=2048
+            )
         self.feature_extractor = feature_extractor
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")

@@ -50,6 +50,13 @@ class InceptionScore(Metric):
             unbiased estimate (tiny deviation from the round-robin
             value), and feeding far fewer samples than ``splits`` can
             leave a split empty (NaN, like an empty chunk would).
+        feature: reference-style selector for the bundled InceptionV3
+            extractor (ref inception.py:106-131): ``'logits_unbiased'``
+            (the reference default), ``'logits'``, or a 64 / 192 / 768 /
+            2048 tap width. Mutually exclusive with ``logits_extractor``.
+        weights_path: local ``.npz`` of converted InceptionV3 weights for
+            the bundled extractor; implies ``feature='logits_unbiased'``
+            when ``feature`` is not given.
 
     Example (pre-extracted logits):
         >>> import jax, jax.numpy as jnp
@@ -71,9 +78,19 @@ class InceptionScore(Metric):
         splits: int = 10,
         num_classes: Optional[int] = None,
         assignment_rng_key: Optional[Any] = None,
+        feature: Optional[Any] = None,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        if feature is not None or weights_path is not None:
+            # reference-style bundled-extractor selection; the reference
+            # IS default feature is 'logits_unbiased' (ref inception.py:106)
+            from metrics_tpu.image.inception_net import resolve_ctor_extractor
+
+            logits_extractor = resolve_ctor_extractor(
+                logits_extractor, feature, weights_path, default_output="logits_unbiased"
+            )
         self.logits_extractor = logits_extractor
         if not (isinstance(splits, int) and splits > 0):
             raise ValueError("Integer input to argument `splits` expected to be positive")

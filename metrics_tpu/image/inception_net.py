@@ -177,9 +177,15 @@ class InceptionV3(nn.Module):
         x = BasicConv(32, (3, 3), dtype=self.dtype)(x)
         x = BasicConv(64, (3, 3), padding="SAME", dtype=self.dtype)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # the three intermediate feature taps torch_fidelity exposes
+        # (features_list '64'/'192'/'768'); sown, so the param tree and the
+        # (features, logits) return are unchanged — readers opt in with
+        # apply(..., mutable=['intermediates'])
+        self.sow("intermediates", "tap_64", x)
         x = BasicConv(80, (1, 1), dtype=self.dtype)(x)
         x = BasicConv(192, (3, 3), dtype=self.dtype)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        self.sow("intermediates", "tap_192", x)
         x = InceptionA(32, dtype=self.dtype)(x)
         x = InceptionA(64, dtype=self.dtype)(x)
         x = InceptionA(64, dtype=self.dtype)(x)
@@ -188,6 +194,7 @@ class InceptionV3(nn.Module):
         x = InceptionC(160, dtype=self.dtype)(x)
         x = InceptionC(160, dtype=self.dtype)(x)
         x = InceptionC(192, dtype=self.dtype)(x)
+        self.sow("intermediates", "tap_768", x)
         x = InceptionD(dtype=self.dtype)(x)
         x = InceptionE(dtype=self.dtype)(x)
         x = InceptionE(pool="max", dtype=self.dtype)(x)  # Mixed_7c, FID variant
@@ -197,6 +204,28 @@ class InceptionV3(nn.Module):
         # math downstream), f64 compute stays f64 (end-to-end parity runs)
         out_dt = jnp.promote_types(jnp.float32, jnp.result_type(self.dtype))
         return features.astype(out_dt), logits.astype(out_dt)
+
+
+def resolve_ctor_extractor(explicit, feature, weights_path, default_output):
+    """Reference-style ctor sugar shared by FID / InceptionScore / KID.
+
+    The reference selects its torch_fidelity feature with
+    ``feature: int | str`` (ref fid.py:160-186, inception.py:106-131,
+    kid.py:169-199); here ``feature=`` / ``weights_path=`` build the
+    bundled flax extractor at the equivalent tap. An explicitly injected
+    extractor keeps precedence and cannot be combined with the sugar.
+    """
+    if feature is None and weights_path is None:
+        return explicit
+    if explicit is not None:
+        raise ValueError(
+            "Pass either an explicit extractor callable or the bundled-network"
+            " arguments (`feature=` / `weights_path=`), not both"
+        )
+    return InceptionV3FeatureExtractor(
+        weights_path=weights_path,
+        output=default_output if feature is None else feature,
+    )
 
 
 def load_params(npz_path: str) -> Any:
@@ -299,9 +328,14 @@ class InceptionV3FeatureExtractor:
         weights_path: local ``.npz`` of flax variables (``save_params``
             layout). ``None`` -> deterministic random init (documented
             above; this environment cannot download weight assets).
-        output: 'pool' (2048-d features), 'logits', or 'logits_unbiased'
-            (fc head without bias — torch_fidelity's feature name and the
-            reference IS/KID default, ref inception.py:106).
+        output: 'pool' (2048-d features; int 2048 is an alias), 'logits',
+            'logits_unbiased' (fc head without bias — torch_fidelity's
+            feature name and the reference IS/KID default, ref
+            inception.py:106), or an intermediate tap 64 / 192 / 768
+            (torch_fidelity's block boundaries: after the first and
+            second max-pools and after Mixed_6e, each globally
+            average-pooled to (N, C) like the reference's
+            `feature=` int options, ref fid.py:160-171).
         num_classes: logits head width (1008 = FID variant).
         dtype: compute dtype for the conv trunk (``jnp.bfloat16`` uses the
             MXU's native precision; outputs come back at f32 or better —
@@ -311,13 +345,18 @@ class InceptionV3FeatureExtractor:
     def __init__(
         self,
         weights_path: Optional[str] = None,
-        output: str = "pool",
+        output: Any = "pool",  # str name or int tap width (see docstring)
         num_classes: int = 1008,
         dtype: Any = jnp.float32,
     ) -> None:
-        if output not in ("pool", "logits", "logits_unbiased"):
+        if isinstance(output, np.integer):  # np.int64(64) etc. from configs
+            output = int(output)
+        if output == 2048:  # the reference's int name for the pooled features
+            output = "pool"
+        valid = ("pool", "logits", "logits_unbiased", 64, 192, 768)
+        if output not in valid:
             raise ValueError(
-                f"Argument `output` must be 'pool', 'logits' or 'logits_unbiased', got {output}"
+                f"Argument `output` must be one of {valid} or 2048 (alias of 'pool'), got {output}"
             )
         self.output = output
         self.net = InceptionV3(num_classes=num_classes, dtype=dtype)
@@ -345,6 +384,13 @@ class InceptionV3FeatureExtractor:
             imgs = imgs.astype(jnp.float32) / 127.5 - 1.0
         if imgs.shape[1] == 3 and imgs.shape[-1] != 3:  # NCHW -> NHWC
             imgs = jnp.transpose(imgs, (0, 2, 3, 1))
+        if isinstance(self.output, int):  # 64 / 192 / 768 intermediate tap
+            _, inter = self.net.apply(variables, imgs, mutable=["intermediates"])
+            (tap,) = inter["intermediates"][f"tap_{self.output}"]
+            # torch_fidelity pools each intermediate map to (N, C)
+            # (adaptive_avg_pool2d to 1x1), same as the 2048 head
+            out_dt = jnp.promote_types(jnp.float32, jnp.result_type(tap.dtype))
+            return jnp.mean(tap, axis=(1, 2)).astype(out_dt)
         features, logits = self.net.apply(variables, imgs)
         if self.output == "pool":
             return features

@@ -72,6 +72,13 @@ class KernelInceptionDistance(Metric):
             — same estimator distribution, different draws — and an
             under-filled side poisons the outputs with NaN instead of
             raising (tracing cannot raise). See ``_compute_in_graph``.
+        feature: reference-style selector for the bundled InceptionV3
+            extractor (ref kid.py:169-199): 64 / 192 / 768 / 2048 tap
+            width or ``'logits_unbiased'``. Mutually exclusive with
+            ``feature_extractor``.
+        weights_path: local ``.npz`` of converted InceptionV3 weights for
+            the bundled extractor; implies ``feature=2048`` when
+            ``feature`` is not given.
 
     Example (pre-extracted features):
         >>> import jax, jax.numpy as jnp
@@ -101,9 +108,18 @@ class KernelInceptionDistance(Metric):
         feature_dim: Optional[int] = None,
         max_samples: Optional[int] = None,
         compute_rng_key: Optional[Any] = None,
+        feature: Optional[Any] = None,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        if feature is not None or weights_path is not None:
+            # reference-style bundled-extractor selection (ref kid.py:169-199)
+            from metrics_tpu.image.inception_net import resolve_ctor_extractor
+
+            feature_extractor = resolve_ctor_extractor(
+                feature_extractor, feature, weights_path, default_output=2048
+            )
         self.feature_extractor = feature_extractor
 
         if not (isinstance(subsets, int) and subsets > 0):

@@ -125,16 +125,24 @@ def _block_e(x, s, p, pool):
     return torch.cat([b1, b3, bd, bp], 1)
 
 
-def _torch_inception_forward(state, x):
-    """(N, 3, H, W) float -> (pool3 features (N, 2048), logits)."""
+def _torch_inception_forward(state, x, taps=None):
+    """(N, 3, H, W) float -> (pool3 features (N, 2048), logits).
+
+    With ``taps`` (a dict), also records the globally-average-pooled
+    intermediate features at torch_fidelity's 64/192/768 block
+    boundaries (after the two stem max-pools and Mixed_6e)."""
     with torch.no_grad():
         x = _cbr(x, state, "Conv2d_1a_3x3", stride=2)
         x = _cbr(x, state, "Conv2d_2a_3x3")
         x = _cbr(x, state, "Conv2d_2b_3x3", padding=1)
         x = F.max_pool2d(x, 3, stride=2)
+        if taps is not None:
+            taps[64] = x.mean(dim=(2, 3)).numpy()
         x = _cbr(x, state, "Conv2d_3b_1x1")
         x = _cbr(x, state, "Conv2d_4a_3x3")
         x = F.max_pool2d(x, 3, stride=2)
+        if taps is not None:
+            taps[192] = x.mean(dim=(2, 3)).numpy()
         x = _block_a(x, state, "Mixed_5b")
         x = _block_a(x, state, "Mixed_5c")
         x = _block_a(x, state, "Mixed_5d")
@@ -143,6 +151,8 @@ def _torch_inception_forward(state, x):
         x = _block_c(x, state, "Mixed_6c")
         x = _block_c(x, state, "Mixed_6d")
         x = _block_c(x, state, "Mixed_6e")
+        if taps is not None:
+            taps[768] = x.mean(dim=(2, 3)).numpy()
         x = _block_d(x, state, "Mixed_7a")
         x = _block_e(x, state, "Mixed_7b", pool="avg")
         x = _block_e(x, state, "Mixed_7c", pool="max")
@@ -395,3 +405,35 @@ _GOLDEN_POOL3 = [0.0, 0.0, 0.750713, 0.0]
 _GOLDEN_POOL3_STATS = [0.17704, 0.277143]
 _GOLDEN_LOGITS = [-1.236323, -5.633951, 1.915418, -8.789635]
 _GOLDEN_LPIPS_ALEX = [1.13647997, 1.15354896]
+
+
+def test_inception_intermediate_taps_match_torch():
+    """The 64/192/768 intermediate feature taps (torch_fidelity's int
+    feature options, which the metrics expose via `feature=`) agree with
+    the torch forward at the same block boundaries, f64, through the
+    extractor's own pooling path."""
+    from test_weight_conversion import _make_inception_state
+
+    from metrics_tpu.image.inception_net import InceptionV3FeatureExtractor
+
+    with jax.enable_x64(True):
+        state = _make_inception_state(seed=21)
+        flat = convert_state_dict(state)
+        x = np.random.RandomState(23).rand(2, 3, 75, 75).astype(np.float64)
+
+        taps_t = {}
+        state64 = {k: v.double() for k, v in state.items()}
+        _torch_inception_forward(state64, torch.from_numpy(x), taps=taps_t)
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            npz = f"{td}/net.npz"
+            np.savez(npz, **flat)
+            for width in (64, 192, 768):
+                ext = InceptionV3FeatureExtractor(
+                    weights_path=npz, output=width, dtype=jnp.float64
+                )
+                got = np.asarray(ext(jnp.asarray(x)))
+                assert got.shape == (2, width)
+                np.testing.assert_allclose(got, taps_t[width], atol=1e-6)

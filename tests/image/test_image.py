@@ -319,3 +319,38 @@ class TestGenerativeMetrics:
         img2 = jnp.asarray(np.random.rand(4, 3, 8, 8), dtype=jnp.float32)
         val = lpips(img1, img2)
         assert float(val) > 0
+
+
+class TestBundledExtractorSugar:
+    """Reference-style `feature=` / `weights_path=` ctor selection on the
+    generative metrics (ref fid.py:160-186, inception.py:106-131,
+    kid.py:169-199)."""
+
+    def test_fid_feature_tap(self):
+        fid = FrechetInceptionDistance(feature=64)
+        imgs = jnp.asarray(np.random.RandomState(0).rand(2, 3, 75, 75), jnp.float32)
+        fid.update(imgs, real=True)
+        fid.update(imgs + 0.1, real=False)
+        assert np.isfinite(float(fid.compute()))
+
+    def test_feature_and_extractor_mutually_exclusive(self):
+        with pytest.raises(ValueError, match="not both"):
+            FrechetInceptionDistance(feature_extractor=lambda x: x, feature=2048)
+
+    def test_is_default_feature_is_unbiased_logits(self):
+        m = InceptionScore(feature="logits_unbiased", splits=1)
+        m.update(jnp.asarray(np.random.RandomState(1).rand(3, 3, 75, 75), jnp.float32))
+        mean, _ = m.compute()
+        assert float(mean) >= 1.0 - 1e-5  # IS >= 1 up to f32 rounding
+
+    def test_kid_2048_alias(self):
+        kid = KernelInceptionDistance(feature=2048, subsets=2, subset_size=2)
+        imgs = jnp.asarray(np.random.RandomState(2).rand(2, 3, 75, 75), jnp.float32)
+        kid.update(imgs, real=True)
+        kid.update(imgs + 0.1, real=False)
+        mean, _ = kid.compute()
+        assert np.isfinite(float(mean))
+
+    def test_invalid_tap_rejected(self):
+        with pytest.raises(ValueError, match="output"):
+            FrechetInceptionDistance(feature=512)
