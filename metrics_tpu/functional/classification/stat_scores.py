@@ -167,7 +167,11 @@ def _reduce_stat_scores(
     scores = weights * (numerator / denominator)
     scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
 
-    if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE and scores.ndim:
+        # the ndim guard matches torch semantics on 0-d scores (micro
+        # reduce of NON-mdmc inputs with mdmc_average set): torch's
+        # mean(dim=0)/sum(dim=0) treat a 0-d tensor as one element and
+        # return it unchanged, where jnp raises on axis=0
         scores = scores.mean(axis=0)
         ignore_mask = ignore_mask.sum(axis=0).astype(bool)
 

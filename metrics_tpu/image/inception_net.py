@@ -355,8 +355,10 @@ class InceptionV3FeatureExtractor:
             output = "pool"
         valid = ("pool", "logits", "logits_unbiased", 64, 192, 768)
         if output not in valid:
+            # named `feature=` on the metric ctors, `output=` here
             raise ValueError(
-                f"Argument `output` must be one of {valid} or 2048 (alias of 'pool'), got {output}"
+                f"Argument `output` (metric-ctor `feature`) must be one of {valid}"
+                f" or 2048 (alias of 'pool'), got {output}"
             )
         self.output = output
         self.net = InceptionV3(num_classes=num_classes, dtype=dtype)

@@ -1033,3 +1033,127 @@ def test_kid_matches_reference_with_shared_subsets(reference, monkeypatch, kid_k
     my_mean, my_std = mine.compute()
     np.testing.assert_allclose(float(my_mean), float(ref_mean), rtol=1e-4, atol=1e-7)
     np.testing.assert_allclose(float(my_std), float(ref_std), rtol=1e-4, atol=1e-8)
+
+
+def test_stat_scores_family_config_fuzz_matches_reference(reference):
+    """Live fuzz of the WHOLE stat-scores pipeline, not just the input
+    gate: ~240 randomized (metric, input-kind, kwargs) configurations
+    across accuracy / precision / recall / f1 / fbeta / specificity /
+    stat_scores / hamming_distance, crossing average, mdmc_average,
+    num_classes, threshold, top_k, and ignore_index — the drop-in
+    surface a reference user actually hits. Invalid combinations must be
+    rejected by BOTH frameworks (ValueError on each side); valid ones
+    must agree numerically including the zero-division conventions.
+    Ref: functional/classification/{stat_scores,accuracy,precision_recall,
+    f_beta,specificity,hamming}.py.
+    """
+    import warnings
+
+    import torch
+
+    rng = np.random.RandomState(1789)
+    n, c, x = 12, 4, 3
+
+    def gen_inputs(kind):
+        if kind == "binary_prob":
+            return rng.rand(n).astype(np.float32), rng.randint(0, 2, n)
+        if kind == "mc_int":
+            return rng.randint(0, c, n), rng.randint(0, c, n)
+        if kind == "mc_prob":
+            logits = rng.rand(n, c).astype(np.float32)
+            return logits / logits.sum(-1, keepdims=True), rng.randint(0, c, n)
+        if kind == "ml_prob":
+            return rng.rand(n, c).astype(np.float32), rng.randint(0, 2, (n, c))
+        if kind == "mdmc_int":
+            return rng.randint(0, c, (n, x)), rng.randint(0, c, (n, x))
+        if kind == "mdmc_prob":
+            logits = rng.rand(n, c, x).astype(np.float32)
+            return logits / logits.sum(1, keepdims=True), rng.randint(0, c, (n, x))
+        raise AssertionError(kind)
+
+    kinds = ["binary_prob", "mc_int", "mc_prob", "ml_prob", "mdmc_int", "mdmc_prob"]
+    metrics = [
+        ("accuracy", {}),
+        ("precision", {}),
+        ("recall", {}),
+        ("f1_score", {}),
+        ("fbeta_score", {"beta": 0.5}),
+        ("specificity", {}),
+        ("stat_scores", {}),
+        ("hamming_distance", {}),
+    ]
+    checked = agreed_errors = 0
+    for i in range(240):
+        name, extra = metrics[i % len(metrics)]
+        kind = kinds[(i // len(metrics)) % len(kinds)]
+        preds_np, target_np = gen_inputs(kind)
+        kwargs = dict(extra)
+        if name == "hamming_distance":
+            kwargs["threshold"] = float(rng.choice([0.3, 0.5, 0.7]))
+        elif name == "stat_scores":
+            kwargs.update(
+                reduce=str(rng.choice(["micro", "macro", "samples"])),
+                mdmc_reduce={0: None, 1: "global", 2: "samplewise"}[int(rng.randint(3))],
+                num_classes=int(rng.choice([0, c])) or None,
+                threshold=float(rng.choice([0.3, 0.5])),
+                top_k=int(rng.choice([0, 2])) or None,
+                ignore_index=int(rng.choice([0, 1])) if rng.rand() < 0.3 else None,
+            )
+        else:
+            kwargs.update(
+                average=str(rng.choice(["micro", "macro", "weighted", "none", "samples"])),
+                mdmc_average={0: None, 1: "global", 2: "samplewise"}[int(rng.randint(3))],
+                num_classes=int(rng.choice([0, c])) or None,
+                threshold=float(rng.choice([0.3, 0.5])),
+                top_k=int(rng.choice([0, 2])) or None,
+                ignore_index=int(rng.choice([0, 1])) if rng.rand() < 0.3 else None,
+            )
+
+        ref_err = mine_err = ref_out = my_out = None
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            try:
+                ref_fn = getattr(reference.functional, name)
+                ref_out = ref_fn(
+                    torch.from_numpy(np.asarray(preds_np)),
+                    torch.from_numpy(np.asarray(target_np)),
+                    **kwargs,
+                )
+            except Exception as e:  # noqa: BLE001
+                ref_err = e
+            try:
+                my_out = getattr(F, name)(
+                    jnp.asarray(preds_np), jnp.asarray(target_np), **kwargs
+                )
+            except Exception as e:  # noqa: BLE001
+                mine_err = e
+
+        case = f"case {i} {name} kind={kind} kwargs={kwargs}"
+        if ref_err is not None or mine_err is not None:
+            assert ref_err is not None and mine_err is not None, (
+                f"{case}: one side rejected, the other accepted"
+                f" (ref={ref_err!r}, mine={mine_err!r})"
+            )
+            assert isinstance(ref_err, ValueError) and isinstance(mine_err, ValueError), (
+                f"{case}: non-validation rejection"
+                f" (ref={type(ref_err).__name__}: {ref_err},"
+                f" mine={type(mine_err).__name__}: {mine_err})"
+            )
+            agreed_errors += 1
+            continue
+        if isinstance(ref_out, (list, tuple)):
+            for a, b in zip(my_out, ref_out):
+                np.testing.assert_allclose(
+                    np.asarray(a, np.float64), np.asarray(b.numpy(), np.float64),
+                    rtol=1e-5, atol=1e-6, equal_nan=True, err_msg=case,
+                )
+        else:
+            np.testing.assert_allclose(
+                np.asarray(my_out, np.float64), np.asarray(ref_out.numpy(), np.float64),
+                rtol=1e-5, atol=1e-6, equal_nan=True, err_msg=case,
+            )
+        checked += 1
+
+    # both regimes must be meaningfully exercised
+    assert checked >= 80, (checked, agreed_errors)
+    assert agreed_errors >= 40, (checked, agreed_errors)
