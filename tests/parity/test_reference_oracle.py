@@ -62,6 +62,22 @@ def _run_mine(name, *args, **kwargs):
     return np.asarray(out)
 
 
+
+def _assert_errors_agree(case, ref_err, mine_err):
+    """Both frameworks must have rejected, and both as deliberate
+    validation errors (an accidental crash hiding behind the reference's
+    ValueError would otherwise pass)."""
+    assert ref_err is not None and mine_err is not None, (
+        f"{case}: one side rejected, the other accepted"
+        f" (ref={ref_err!r}, mine={mine_err!r})"
+    )
+    assert isinstance(ref_err, ValueError) and isinstance(mine_err, ValueError), (
+        f"{case}: non-validation rejection"
+        f" (ref={type(ref_err).__name__}: {ref_err},"
+        f" mine={type(mine_err).__name__}: {mine_err})"
+    )
+
+
 CLASSIFICATION_CASES = [
     ("accuracy", (_probs, _labels), dict(num_classes=_C)),
     ("accuracy", (_probs, _labels), dict(average="macro", num_classes=_C)),
@@ -1130,15 +1146,7 @@ def test_stat_scores_family_config_fuzz_matches_reference(reference):
 
         case = f"case {i} {name} kind={kind} kwargs={kwargs}"
         if ref_err is not None or mine_err is not None:
-            assert ref_err is not None and mine_err is not None, (
-                f"{case}: one side rejected, the other accepted"
-                f" (ref={ref_err!r}, mine={mine_err!r})"
-            )
-            assert isinstance(ref_err, ValueError) and isinstance(mine_err, ValueError), (
-                f"{case}: non-validation rejection"
-                f" (ref={type(ref_err).__name__}: {ref_err},"
-                f" mine={type(mine_err).__name__}: {mine_err})"
-            )
+            _assert_errors_agree(case, ref_err, mine_err)
             agreed_errors += 1
             continue
         if isinstance(ref_out, (list, tuple)):
@@ -1157,3 +1165,95 @@ def test_stat_scores_family_config_fuzz_matches_reference(reference):
     # both regimes must be meaningfully exercised
     assert checked >= 80, (checked, agreed_errors)
     assert agreed_errors >= 40, (checked, agreed_errors)
+
+
+def test_retrieval_modules_config_fuzz_matches_reference(reference):
+    """Live fuzz of the retrieval MODULE lifecycle: ~96 randomized
+    (metric, ragged-query layout, kwargs) cases. The repo's retrieval
+    compute is a vectorized padded ``(Q, L)`` redesign of the reference's
+    per-query Python loop, so the risk surface is exactly here: ragged
+    group sizes (incl. single-row and empty-target queries), interleaved
+    un-sorted index order, multi-batch accumulation, every
+    ``empty_target_action``, ``ignore_index`` holes, ``k`` cutoffs,
+    ``adaptive_k``, and NDCG's graded (non-binary) targets. Invalid /
+    error-action cases must raise in BOTH frameworks.
+    Ref: retrieval/base.py:27-151 + per-metric subclasses.
+    """
+    import torch
+
+    import metrics_tpu
+
+    rng = np.random.RandomState(4242)
+    metrics = [
+        ("RetrievalMAP", {}),
+        ("RetrievalMRR", {}),
+        ("RetrievalRPrecision", {}),
+        ("RetrievalPrecision", {"k": True, "adaptive_k": True}),
+        ("RetrievalRecall", {"k": True}),
+        ("RetrievalFallOut", {"k": True}),
+        ("RetrievalHitRate", {"k": True}),
+        ("RetrievalNormalizedDCG", {"k": True, "graded": True}),
+    ]
+
+    checked = agreed_errors = 0
+    for i in range(96):
+        name, opts = metrics[i % len(metrics)]
+        nq = int(rng.randint(3, 7))
+        sizes = rng.randint(1, 8, nq)
+        idx = np.repeat(np.arange(nq), sizes)
+        order = rng.permutation(len(idx))  # interleave queries
+        idx = idx[order]
+        preds = rng.rand(len(idx)).astype(np.float32)
+        if opts.get("graded") and rng.rand() < 0.5:
+            target = rng.randint(0, 4, len(idx))
+        else:
+            target = (rng.rand(len(idx)) < 0.4).astype(np.int64)
+        if rng.rand() < 0.4:  # force at least one empty-target query
+            target[idx == 0] = 0
+        kwargs = {"empty_target_action": str(rng.choice(["neg", "pos", "skip", "error"]))}
+        if rng.rand() < 0.25:
+            kwargs["ignore_index"] = -100
+            target = target.copy()
+            target[rng.rand(len(idx)) < 0.2] = -100
+        if opts.get("k") and rng.rand() < 0.7:
+            kwargs["k"] = int(rng.choice([1, 3]))
+        if opts.get("adaptive_k") and rng.rand() < 0.5:
+            kwargs["adaptive_k"] = True
+        split = int(rng.randint(1, len(idx)))  # two-batch accumulation
+
+        ref_err = mine_err = ref_out = my_out = None
+        try:
+            ref_m = getattr(reference, name)(**kwargs)
+            for sl in (slice(None, split), slice(split, None)):
+                ref_m.update(
+                    torch.from_numpy(preds[sl]),
+                    torch.from_numpy(target[sl]),
+                    indexes=torch.from_numpy(idx[sl]),
+                )
+            ref_out = ref_m.compute()
+        except Exception as e:  # noqa: BLE001
+            ref_err = e
+        try:
+            my_m = getattr(metrics_tpu, name)(**kwargs)
+            for sl in (slice(None, split), slice(split, None)):
+                my_m.update(
+                    jnp.asarray(preds[sl]),
+                    jnp.asarray(target[sl]),
+                    indexes=jnp.asarray(idx[sl]),
+                )
+            my_out = my_m.compute()
+        except Exception as e:  # noqa: BLE001
+            mine_err = e
+
+        case = f"case {i} {name} kwargs={kwargs} sizes={sizes.tolist()}"
+        if ref_err is not None or mine_err is not None:
+            _assert_errors_agree(case, ref_err, mine_err)
+            agreed_errors += 1
+            continue
+        np.testing.assert_allclose(
+            float(my_out), float(ref_out), rtol=1e-5, atol=1e-6, err_msg=case
+        )
+        checked += 1
+
+    assert checked >= 50, (checked, agreed_errors)
+    assert agreed_errors >= 10, (checked, agreed_errors)
