@@ -20,13 +20,37 @@ def _ngram_counts(tokens: Sequence, n: int) -> Counter:
     return Counter(tuple(tokens[i:i + n]) for i in range(len(tokens) - n + 1))
 
 
+_CHRF_PUNCTUATIONS = set("!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~")
+
+
+def _words_and_punctuation(sentence: str) -> List[str]:
+    """chrF word tokenization (ref chrf.py:96-125, after m-popovic/chrF):
+    ONE leading or trailing punctuation char is split off each whitespace
+    token (trailing wins when both; single-char tokens stay whole; no
+    recursion — '...' becomes ['..', '.'])."""
+    words: List[str] = []
+    for word in sentence.strip().split():
+        if len(word) == 1:
+            words.append(word)
+        elif word[-1] in _CHRF_PUNCTUATIONS:
+            words.extend((word[:-1], word[-1]))
+        elif word[0] in _CHRF_PUNCTUATIONS:
+            words.extend((word[0], word[1:]))
+        else:
+            words.append(word)
+    return words
+
+
 def _char_and_word_ngrams(
     sentence: str, n_char_order: int, n_word_order: int, lowercase: bool, whitespace: bool
 ) -> Tuple[Dict[int, Counter], Dict[int, Counter]]:
     if lowercase:
         sentence = sentence.lower()
-    chars = list(sentence) if whitespace else list(sentence.replace(" ", ""))
-    words = sentence.split()
+    # the reference strips ONLY in the no-whitespace branch (ref
+    # chrf.py:81-93), so tabs/newlines at the edges drop there but a
+    # whitespace=True run keeps the sentence verbatim
+    chars = list(sentence) if whitespace else list(sentence.strip().replace(" ", ""))
+    words = _words_and_punctuation(sentence)
     char_ngrams = {n: _ngram_counts(chars, n) for n in range(1, n_char_order + 1)}
     word_ngrams = {n: _ngram_counts(words, n) for n in range(1, n_word_order + 1)}
     return char_ngrams, word_ngrams
@@ -43,6 +67,45 @@ def _order_f_scores(
         pred_total.append(float(sum(pred_grams[n].values())))
         tgt_total.append(float(sum(tgt_grams[n].values())))
     return matching, pred_total, tgt_total
+
+
+def _sentence_stats(
+    pred: str,
+    tgts: Sequence[str],
+    n_char_order: int,
+    n_word_order: int,
+    lowercase: bool,
+    whitespace: bool,
+    beta: float,
+) -> Tuple[float, List[float], List[float], List[float]]:
+    """Per-sentence (best_f, matching, pred_total, tgt_total) stats.
+
+    Best-reference selection mirrors the reference exactly: best_f seeds
+    at 0 and is replaced only on STRICTLY greater (ref chrf.py:332-364),
+    so when every reference scores 0 — or there are none — the matching
+    and target stats stay ZERO while the hypothesis counts still
+    contribute (ref accumulates pred n-grams unconditionally,
+    chrf.py:375-441). Shared by the functional corpus loop and
+    ``CHRFScore.update``.
+    """
+    n_orders = n_char_order + n_word_order
+    p_char, p_word = _char_and_word_ngrams(pred, n_char_order, n_word_order, lowercase, whitespace)
+    best_f = 0.0
+    best_matching = [0.0] * n_orders
+    best_tgt = [0.0] * n_orders
+    pred_total = None
+    for tgt in tgts:
+        t_char, t_word = _char_and_word_ngrams(tgt, n_char_order, n_word_order, lowercase, whitespace)
+        m_c, p_c, t_c = _order_f_scores(p_char, t_char)
+        m_w, p_w, t_w = _order_f_scores(p_word, t_word)
+        matching, pred_total, tgt_total = m_c + m_w, p_c + p_w, t_c + t_w
+        f = _chrf_f_score(matching, pred_total, tgt_total, beta)
+        if f > best_f:
+            best_f, best_matching, best_tgt = f, matching, tgt_total
+    if pred_total is None:  # no references at all: hypothesis counts only
+        pred_total = [float(sum(p_char[n].values())) for n in sorted(p_char)]
+        pred_total += [float(sum(p_word[n].values())) for n in sorted(p_word)]
+    return best_f, best_matching, pred_total, best_tgt
 
 
 def _chrf_f_score(matching, pred_total, tgt_total, beta: float) -> float:
@@ -97,29 +160,14 @@ def chrf_score(
     sentence_scores = []
 
     for pred, tgts in zip(preds_, target_):
-        if not tgts:
-            # no references: zero matches against zero totals — contributes
-            # nothing to the corpus totals and scores 0 at sentence level
-            sentence_scores.append(0.0)
-            continue
-        p_char, p_word = _char_and_word_ngrams(pred, n_char_order, n_word_order, lowercase, whitespace)
-        # pick the reference with the best sentence-level F score
-        best = None
-        for tgt in tgts:
-            t_char, t_word = _char_and_word_ngrams(tgt, n_char_order, n_word_order, lowercase, whitespace)
-            m_c, p_c, t_c = _order_f_scores(p_char, t_char)
-            m_w, p_w, t_w = _order_f_scores(p_word, t_word)
-            matching, pred_total, tgt_total = m_c + m_w, p_c + p_w, t_c + t_w
-            f = _chrf_f_score(matching, pred_total, tgt_total, beta)
-            if best is None or f > best[0]:
-                best = (f, matching, pred_total, tgt_total)
-
-        f, matching, pred_total, tgt_total = best
-        sentence_scores.append(f)
+        best_f, best_matching, pred_total, best_tgt = _sentence_stats(
+            pred, tgts, n_char_order, n_word_order, lowercase, whitespace, beta
+        )
+        sentence_scores.append(best_f)
         for i in range(n_orders):
-            total_matching[i] += matching[i]
+            total_matching[i] += best_matching[i]
             total_pred[i] += pred_total[i]
-            total_tgt[i] += tgt_total[i]
+            total_tgt[i] += best_tgt[i]
 
     corpus_score = jnp.asarray(_chrf_f_score(total_matching, total_pred, total_tgt, beta))
     if return_sentence_level_score:

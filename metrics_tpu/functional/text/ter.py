@@ -192,7 +192,14 @@ def _ter_update(
         for tgt in tgts:
             tgt_words = tokenizer(tgt).split()
             tgt_lengths += len(tgt_words)
-            num_edits = _ter_edits(pred_words, tgt_words)
+            # the reference runs the edit computation with the roles
+            # REVERSED: _compute_sentence_statistics passes
+            # (tgt_words, pred_words) into _translation_edit_rate's
+            # (pred_words, target_words) parameters (ref ter.py:439-441),
+            # so shifts move the reference toward the hypothesis, and the
+            # empty-"target" shortcut (ter.py:400-401) fires for an EMPTY
+            # HYPOTHESIS — zero edits, hence TER 0 for empty predictions
+            num_edits = 0.0 if not pred_words else _ter_edits(tgt_words, pred_words)
             if num_edits < best_num_edits:
                 best_num_edits = num_edits
         avg_tgt_len = tgt_lengths / len(tgts)

@@ -4,7 +4,7 @@ from typing import Any, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.text.chrf import _char_and_word_ngrams, _chrf_f_score, _order_f_scores
+from metrics_tpu.functional.text.chrf import _chrf_f_score, _sentence_stats
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
 
@@ -63,26 +63,10 @@ class CHRFScore(Metric):
         target_ = [[t] if isinstance(t, str) else list(t) for t in target]
 
         for pred, tgts in zip(preds_, target_):
-            if not tgts:
-                # no references: nothing to accumulate; sentence score 0
-                if self.return_sentence_level_score:
-                    self.sentence_chrf_score.append(jnp.zeros(1))
-                continue
-            p_char, p_word = _char_and_word_ngrams(
-                pred, self.n_char_order, self.n_word_order, self.lowercase, self.whitespace
+            f, matching, pred_total, tgt_total = _sentence_stats(
+                pred, tgts, self.n_char_order, self.n_word_order,
+                self.lowercase, self.whitespace, self.beta,
             )
-            best = None
-            for tgt in tgts:
-                t_char, t_word = _char_and_word_ngrams(
-                    tgt, self.n_char_order, self.n_word_order, self.lowercase, self.whitespace
-                )
-                m_c, p_c, t_c = _order_f_scores(p_char, t_char)
-                m_w, p_w, t_w = _order_f_scores(p_word, t_word)
-                matching, pred_total, tgt_total = m_c + m_w, p_c + p_w, t_c + t_w
-                f = _chrf_f_score(matching, pred_total, tgt_total, self.beta)
-                if best is None or f > best[0]:
-                    best = (f, matching, pred_total, tgt_total)
-            f, matching, pred_total, tgt_total = best
             self.matching = self.matching + jnp.asarray(matching)
             self.pred_total = self.pred_total + jnp.asarray(pred_total)
             self.tgt_total = self.tgt_total + jnp.asarray(tgt_total)

@@ -1257,3 +1257,87 @@ def test_retrieval_modules_config_fuzz_matches_reference(reference):
 
     assert checked >= 50, (checked, agreed_errors)
     assert agreed_errors >= 10, (checked, agreed_errors)
+
+
+def test_text_corpus_config_fuzz_matches_reference(reference):
+    """Live fuzz of the host-side text metrics on randomized corpora:
+    100 (metric, corpus, kwargs) cases over word soup drawn from a
+    vocabulary that bakes in the nasty cases — empty hypotheses,
+    unicode (accents + CJK), punctuation glued to words, repeated
+    tokens — crossed with each metric's parameter axes. String
+    processing is where silent tokenizer/normalization divergence
+    hides; every stage here runs live against the reference.
+    """
+    rng = np.random.RandomState(31337)
+    vocab = [
+        "the", "cat", "sat", "mat", "on", "a", "dog", "ran", "fast,",
+        "très", "café", "naïve", "日本", "語", "re-run", "x1", "...", "it's",
+        "edge\t",  # trailing tab: when sentence-final, ref chrF's char
+        # mode strips it (chrf.py:81-93) — pins the strip parity
+    ]
+
+    def sentence(max_words=9, allow_empty=True):
+        n = int(rng.randint(0 if allow_empty else 1, max_words))
+        return " ".join(rng.choice(vocab, n)) if n else ""
+
+    def corpus(n_pairs, n_refs):
+        preds = [sentence() for _ in range(n_pairs)]
+        targets = [[sentence(allow_empty=False) for _ in range(n_refs)] for _ in range(n_pairs)]
+        return preds, targets
+
+    def flat_corpus(n_pairs):
+        preds, targets = corpus(n_pairs, 1)
+        return preds, [t[0] for t in targets]
+
+    cases = []
+    for _ in range(10):
+        n_pairs = int(rng.randint(1, 4))
+        n_refs = int(rng.randint(1, 3))
+        for name in ("word_error_rate", "char_error_rate", "match_error_rate",
+                     "word_information_lost", "word_information_preserved"):
+            cases.append((name, flat_corpus(n_pairs), {}))
+        cases.append(("bleu_score", corpus(n_pairs, n_refs),
+                      dict(n_gram=int(rng.choice([1, 2, 4])), smooth=bool(rng.rand() < 0.5))))
+        cases.append(("sacre_bleu_score", corpus(n_pairs, n_refs),
+                      dict(tokenize=str(rng.choice(["13a", "char", "intl"])),
+                           smooth=bool(rng.rand() < 0.5),
+                           lowercase=bool(rng.rand() < 0.5))))
+        cases.append(("chrf_score", corpus(n_pairs, n_refs),
+                      dict(n_char_order=int(rng.choice([4, 6])),
+                           n_word_order=int(rng.choice([0, 2])),
+                           beta=float(rng.choice([1.0, 2.0])),
+                           lowercase=bool(rng.rand() < 0.5))))
+        cases.append(("translation_edit_rate", corpus(n_pairs, n_refs),
+                      dict(normalize=bool(rng.rand() < 0.5),
+                           no_punctuation=bool(rng.rand() < 0.5),
+                           lowercase=bool(rng.rand() < 0.5),
+                           asian_support=bool(rng.rand() < 0.5))))
+        cases.append(("extended_edit_distance", corpus(n_pairs, n_refs),
+                      dict(alpha=float(rng.choice([2.0, 1.0])),
+                           rho=float(rng.choice([0.3, 0.5])))))
+
+    checked = agreed_errors = 0
+    for i, (name, (preds, targets), kwargs) in enumerate(cases):
+        ref_err = mine_err = ref_out = my_out = None
+        case = f"case {i} {name} kwargs={kwargs} preds={preds!r}"
+        try:
+            ref_fn = getattr(reference.functional, name)
+            ref_out = ref_fn(preds, targets, **kwargs)
+        except Exception as e:  # noqa: BLE001
+            ref_err = e
+        try:
+            my_out = getattr(F, name)(preds, targets, **kwargs)
+        except Exception as e:  # noqa: BLE001
+            mine_err = e
+
+        if ref_err is not None or mine_err is not None:
+            _assert_errors_agree(case, ref_err, mine_err)
+            agreed_errors += 1
+            continue
+        np.testing.assert_allclose(
+            np.asarray(my_out, np.float64), np.asarray(ref_out, np.float64),
+            rtol=1e-5, atol=1e-8, equal_nan=True, err_msg=case,
+        )
+        checked += 1
+
+    assert checked >= 80, (checked, agreed_errors)
