@@ -1341,3 +1341,100 @@ def test_text_corpus_config_fuzz_matches_reference(reference):
         checked += 1
 
     assert checked >= 80, (checked, agreed_errors)
+
+
+def test_curve_family_config_fuzz_matches_reference(reference):
+    """Live fuzz of the curve/score pipeline: ~120 randomized
+    (metric, input-kind, kwargs) cases across roc /
+    precision_recall_curve / auroc / average_precision / auc, crossing
+    num_classes, pos_label, average, max_fpr, and sample_weights — the
+    threshold-sweep half of the classification surface. Outputs are
+    compared as trees (multiclass curves stay per-class lists, so ragged
+    per-class lengths compare element-for-element instead of collapsing
+    through np.asarray); invalid configs must be rejected by BOTH
+    frameworks."""
+    import warnings
+
+    import torch
+
+    def to_np_tree(out):
+        if isinstance(out, (list, tuple)):
+            return [to_np_tree(o) for o in out]
+        return np.asarray(out.numpy() if hasattr(out, "numpy") else out)
+
+    def assert_tree_close(a, b, case):
+        if isinstance(a, list) or isinstance(b, list):
+            assert isinstance(a, list) and isinstance(b, list) and len(a) == len(b), case
+            for aa, bb in zip(a, b):
+                assert_tree_close(aa, bb, case)
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a, np.float64), np.asarray(b, np.float64),
+                rtol=1e-5, atol=1e-6, equal_nan=True, err_msg=case,
+            )
+
+    rng = np.random.RandomState(9090)
+    n, c = 24, 4
+
+    checked = agreed_errors = 0
+    for i in range(120):
+        kind = ("binary", "multiclass", "multilabel_ap")[i % 3]
+        if kind == "binary":
+            preds = rng.rand(n).astype(np.float32)
+            target = rng.randint(0, 2, n)
+        elif kind == "multiclass":
+            logits = rng.rand(n, c).astype(np.float32)
+            preds = logits / logits.sum(-1, keepdims=True)
+            target = rng.randint(0, c, n)
+        else:
+            preds = rng.rand(n, c).astype(np.float32)
+            target = rng.randint(0, 2, (n, c))
+
+        name = ("roc", "precision_recall_curve", "auroc", "average_precision", "auc")[
+            int(rng.randint(5))
+        ]
+        kwargs = {}
+        args = (preds, target)
+        if name == "auc":
+            x = np.sort(rng.rand(n).astype(np.float32))
+            y = rng.rand(n).astype(np.float32)
+            args = (x, y)
+            if rng.rand() < 0.5:
+                kwargs["reorder"] = bool(rng.rand() < 0.5)
+        else:
+            if kind != "binary":
+                kwargs["num_classes"] = c
+            elif rng.rand() < 0.4:
+                kwargs["pos_label"] = int(rng.choice([0, 1]))
+            if name == "auroc":
+                if rng.rand() < 0.5:
+                    kwargs["average"] = str(rng.choice(["macro", "weighted", "micro"]))
+                if rng.rand() < 0.3:
+                    kwargs["max_fpr"] = float(rng.choice([0.3, 0.8]))
+            if name == "average_precision" and kind != "binary" and rng.rand() < 0.5:
+                kwargs["average"] = str(rng.choice(["macro", "weighted", "none"]))
+
+        ref_err = mine_err = ref_out = my_out = None
+        case = f"case {i} {name} kind={kind} kwargs={kwargs}"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            try:
+                ref_fn = getattr(reference.functional, name)
+                ref_out = to_np_tree(
+                    ref_fn(*[torch.from_numpy(np.asarray(a)) for a in args], **kwargs)
+                )
+            except Exception as e:  # noqa: BLE001
+                ref_err = e
+            try:
+                my_out = to_np_tree(getattr(F, name)(*[jnp.asarray(a) for a in args], **kwargs))
+            except Exception as e:  # noqa: BLE001
+                mine_err = e
+
+        if ref_err is not None or mine_err is not None:
+            _assert_errors_agree(case, ref_err, mine_err)
+            agreed_errors += 1
+            continue
+        assert_tree_close(my_out, ref_out, case)
+        checked += 1
+
+    assert checked >= 70, (checked, agreed_errors)
