@@ -63,15 +63,19 @@ def _run_mine(name, *args, **kwargs):
 
 
 
-def _assert_errors_agree(case, ref_err, mine_err):
+def _assert_errors_agree(case, ref_err, mine_err, allowed=(ValueError,), same_type=False):
     """Both frameworks must have rejected, and both as deliberate
-    validation errors (an accidental crash hiding behind the reference's
-    ValueError would otherwise pass)."""
+    validation errors of an ``allowed`` type (an accidental crash hiding
+    behind the reference's ValueError would otherwise pass);
+    ``same_type=True`` additionally requires the two exception classes to
+    match (e.g. the aggregation nan_strategy='error' RuntimeError)."""
     assert ref_err is not None and mine_err is not None, (
         f"{case}: one side rejected, the other accepted"
         f" (ref={ref_err!r}, mine={mine_err!r})"
     )
-    assert isinstance(ref_err, ValueError) and isinstance(mine_err, ValueError), (
+    assert isinstance(ref_err, allowed) and isinstance(mine_err, allowed) and (
+        not same_type or type(ref_err).__name__ == type(mine_err).__name__
+    ), (
         f"{case}: non-validation rejection"
         f" (ref={type(ref_err).__name__}: {ref_err},"
         f" mine={type(mine_err).__name__}: {mine_err})"
@@ -1347,7 +1351,7 @@ def test_curve_family_config_fuzz_matches_reference(reference):
     """Live fuzz of the curve/score pipeline: ~120 randomized
     (metric, input-kind, kwargs) cases across roc /
     precision_recall_curve / auroc / average_precision / auc, crossing
-    num_classes, pos_label, average, max_fpr, and sample_weights — the
+    num_classes, pos_label, average, and max_fpr — the
     threshold-sweep half of the classification surface. Outputs are
     compared as trees (multiclass curves stay per-class lists, so ragged
     per-class lengths compare element-for-element instead of collapsing
@@ -1411,6 +1415,8 @@ def test_curve_family_config_fuzz_matches_reference(reference):
                     kwargs["average"] = str(rng.choice(["macro", "weighted", "micro"]))
                 if rng.rand() < 0.3:
                     kwargs["max_fpr"] = float(rng.choice([0.3, 0.8]))
+                if kind == "multiclass" and rng.rand() < 0.2:
+                    kwargs["average"] = "bogus-mode"  # invalid: both must reject
             if name == "average_precision" and kind != "binary" and rng.rand() < 0.5:
                 kwargs["average"] = str(rng.choice(["macro", "weighted", "none"]))
 
@@ -1437,4 +1443,198 @@ def test_curve_family_config_fuzz_matches_reference(reference):
         assert_tree_close(my_out, ref_out, case)
         checked += 1
 
+    # both regimes must be exercised: the invalid-average injections above
+    # guarantee a non-empty rejection sample
     assert checked >= 70, (checked, agreed_errors)
+    assert agreed_errors >= 3, (checked, agreed_errors)
+
+
+def test_auroc_max_fpr_validation_divergence(reference):
+    """Pinned DELIBERATE divergence: the reference's max_fpr validation has
+    an operator-precedence bug — `not isinstance(max_fpr, float) and
+    0 < max_fpr <= 1` (ref auroc.py:102-104) never fires for floats, so
+    `max_fpr=0.0` silently flows through and returns NaN. This framework
+    validates the documented contract (float in (0, 1]) and raises. If
+    the reference side of this test ever starts raising, the divergence
+    is gone — fold max_fpr back into the mutual-rejection fuzz."""
+    import torch
+
+    preds = np.random.RandomState(5).rand(16).astype(np.float32)
+    target = np.random.RandomState(6).randint(0, 2, 16)
+    ref_out = reference.functional.auroc(
+        torch.from_numpy(preds), torch.from_numpy(target), max_fpr=0.0
+    )
+    assert np.isnan(float(ref_out))  # the bug: accepted, garbage out
+    with pytest.raises(ValueError, match="max_fpr"):
+        F.auroc(jnp.asarray(preds), jnp.asarray(target), max_fpr=0.0)
+
+
+def test_audio_config_fuzz_matches_reference(reference):
+    """Live fuzz of the audio functionals on random multi-channel
+    signals: ~72 (metric, shape, kwargs) cases across SNR / SI-SNR /
+    SI-SDR / SDR / PIT, crossing zero_mean, SDR's filter_length /
+    load_diag, and PIT's metric-function x eval-function axes."""
+    import warnings
+
+    import torch
+
+    rng = np.random.RandomState(2718)
+
+    checked = agreed_errors = 0
+    for i in range(72):
+        shape = [(16,), (2, 16), (2, 2, 32)][i % 3]
+        preds = rng.randn(*shape).astype(np.float32)
+        target = (0.7 * preds + 0.3 * rng.randn(*shape)).astype(np.float32)
+
+        name = ("signal_noise_ratio", "scale_invariant_signal_noise_ratio",
+                "scale_invariant_signal_distortion_ratio", "signal_distortion_ratio",
+                "permutation_invariant_training")[int(rng.randint(5))]
+        kwargs = {}
+        args = (preds, target)
+        if name == "signal_noise_ratio" and rng.rand() < 0.5:
+            kwargs["zero_mean"] = True
+        if name == "scale_invariant_signal_distortion_ratio" and rng.rand() < 0.5:
+            kwargs["zero_mean"] = True
+        if name == "signal_distortion_ratio":
+            # SDR's Toeplitz solve needs time >> filter_length; fixed (2, 64)
+            preds = rng.randn(2, 64).astype(np.float32)
+            target = (0.7 * preds + 0.3 * rng.randn(2, 64)).astype(np.float32)
+            args = (preds, target)
+            kwargs["filter_length"] = int(rng.choice([8, 16]))
+            if rng.rand() < 0.5:
+                kwargs["zero_mean"] = True
+            if rng.rand() < 0.5:
+                kwargs["load_diag"] = float(rng.choice([1e-6, 1e-3]))
+        if name == "permutation_invariant_training":
+            spk, time = 2, 24
+            preds = rng.randn(3, spk, time).astype(np.float32)
+            target = rng.randn(3, spk, time).astype(np.float32)
+            args = (preds, target)
+            mf = str(rng.choice(["scale_invariant_signal_noise_ratio", "signal_noise_ratio"]))
+            kwargs["metric_func"] = getattr(F, mf)
+            kwargs["eval_func"] = str(rng.choice(["max", "min"]))
+            ref_kwargs = dict(kwargs)
+            ref_kwargs["metric_func"] = getattr(reference.functional, mf)
+        else:
+            ref_kwargs = kwargs
+
+        ref_err = mine_err = ref_out = my_out = None
+        case = f"case {i} {name} shape={np.shape(args[0])} kwargs={ {k: v for k, v in kwargs.items() if not callable(v)} }"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            try:
+                ref_fn = getattr(reference.functional, name)
+                ref_out = ref_fn(
+                    torch.from_numpy(args[0]), torch.from_numpy(args[1]), **ref_kwargs
+                )
+                if isinstance(ref_out, tuple):  # PIT returns (metric, perm)
+                    ref_out = ref_out[0]
+                ref_out = np.asarray(ref_out)
+            except Exception as e:  # noqa: BLE001
+                ref_err = e
+            try:
+                my_out = getattr(F, name)(jnp.asarray(args[0]), jnp.asarray(args[1]), **kwargs)
+                if isinstance(my_out, tuple):
+                    my_out = my_out[0]
+                my_out = np.asarray(my_out)
+            except Exception as e:  # noqa: BLE001
+                mine_err = e
+
+        if ref_err is not None or mine_err is not None:
+            _assert_errors_agree(case, ref_err, mine_err)
+            agreed_errors += 1
+            continue
+        np.testing.assert_allclose(
+            np.asarray(my_out, np.float64), np.asarray(ref_out, np.float64),
+            rtol=1e-3, atol=1e-4, err_msg=case,  # f32 linear solves inside SDR
+        )
+        checked += 1
+
+    assert checked >= 60, (checked, agreed_errors)
+
+
+def test_aggregation_nan_fuzz_matches_reference(reference):
+    """Live fuzz of the aggregation metrics under random NaN patterns:
+    ~80 (class, nan_strategy, shape/weights) lifecycles across Max / Min
+    / Sum / Mean / Cat, including float-imputation values and MeanMetric
+    broadcastable weights. 'error' strategy must raise on BOTH sides
+    when NaNs are present."""
+    import warnings
+
+    import torch
+
+    import metrics_tpu
+
+    rng = np.random.RandomState(1618)
+    classes = ["MaxMetric", "MinMetric", "SumMetric", "MeanMetric", "CatMetric"]
+
+    checked = agreed_errors = 0
+    for i in range(80):
+        cls = classes[i % len(classes)]
+        strategy = ("warn", "ignore", "error", 42.0)[int(rng.randint(4))]
+        n_updates = int(rng.randint(1, 4))
+        updates = []
+        for _ in range(n_updates):
+            x = rng.randn(int(rng.randint(1, 6))).astype(np.float32)
+            if rng.rand() < 0.5:
+                x[rng.rand(len(x)) < 0.4] = np.nan
+            updates.append(x)
+        use_weight = cls == "MeanMetric" and rng.rand() < 0.5
+        # elementwise OR scalar (broadcast) weights — both reference forms
+        weights = [
+            np.float32(abs(rng.randn()) + 0.1)
+            if rng.rand() < 0.4
+            else np.abs(rng.randn(len(x))).astype(np.float32) + 0.1
+            for x in updates
+        ]
+
+        ref_err = mine_err = ref_out = my_out = None
+        case = f"case {i} {cls} strategy={strategy} updates={[u.tolist() for u in updates]}"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            try:
+                ref_m = getattr(reference, cls)(nan_strategy=strategy)
+                for u, w in zip(updates, weights):
+                    if use_weight:
+                        wt = torch.from_numpy(w) if isinstance(w, np.ndarray) else float(w)
+                        ref_m.update(torch.from_numpy(u), wt)
+                    else:
+                        ref_m.update(torch.from_numpy(u))
+                ref_out = np.asarray(ref_m.compute())
+            except Exception as e:  # noqa: BLE001
+                ref_err = e
+            try:
+                my_m = getattr(metrics_tpu, cls)(nan_strategy=strategy)
+                for u, w in zip(updates, weights):
+                    if use_weight:
+                        my_m.update(jnp.asarray(u), jnp.asarray(w))
+                    else:
+                        my_m.update(jnp.asarray(u))
+                my_out = np.asarray(my_m.compute())
+            except Exception as e:  # noqa: BLE001
+                mine_err = e
+
+        if ref_err is not None or mine_err is not None:
+            # nan_strategy='error' raises RuntimeError in BOTH frameworks
+            # (ref aggregation.py:81); same_type pins it so an accidental
+            # crash on our side can't masquerade as the deliberate rejection
+            _assert_errors_agree(
+                case, ref_err, mine_err,
+                allowed=(RuntimeError, ValueError), same_type=True,
+            )
+            agreed_errors += 1
+            continue
+        if cls == "CatMetric":
+            np.testing.assert_allclose(
+                np.asarray(my_out, np.float64).ravel(),
+                np.asarray(ref_out, np.float64).ravel(),
+                rtol=1e-5, equal_nan=True, err_msg=case,
+            )
+        else:
+            np.testing.assert_allclose(
+                np.asarray(my_out, np.float64), np.asarray(ref_out, np.float64),
+                rtol=1e-5, equal_nan=True, err_msg=case,
+            )
+        checked += 1
+
+    assert checked >= 40, (checked, agreed_errors)
