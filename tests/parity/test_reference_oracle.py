@@ -82,6 +82,21 @@ def _assert_errors_agree(case, ref_err, mine_err, allowed=(ValueError,), same_ty
     )
 
 
+
+_FUZZ_VOCAB = [
+    "the", "cat", "sat", "mat", "on", "a", "dog", "ran", "fast,",
+    "très", "café", "naïve", "日本", "語", "re-run", "x1", "...", "it's",
+    "edge\t",  # trailing tab: when sentence-final, ref chrF's char
+    # mode strips it (chrf.py:81-93) — pins the strip parity
+]
+
+
+def _fuzz_sentence(rng, max_words=9, allow_empty=True):
+    """Shared random word-soup sentence for the text fuzzes."""
+    n = int(rng.randint(0 if allow_empty else 1, max_words))
+    return " ".join(rng.choice(_FUZZ_VOCAB, n)) if n else ""
+
+
 CLASSIFICATION_CASES = [
     ("accuracy", (_probs, _labels), dict(num_classes=_C)),
     ("accuracy", (_probs, _labels), dict(average="macro", num_classes=_C)),
@@ -1273,16 +1288,9 @@ def test_text_corpus_config_fuzz_matches_reference(reference):
     hides; every stage here runs live against the reference.
     """
     rng = np.random.RandomState(31337)
-    vocab = [
-        "the", "cat", "sat", "mat", "on", "a", "dog", "ran", "fast,",
-        "très", "café", "naïve", "日本", "語", "re-run", "x1", "...", "it's",
-        "edge\t",  # trailing tab: when sentence-final, ref chrF's char
-        # mode strips it (chrf.py:81-93) — pins the strip parity
-    ]
 
     def sentence(max_words=9, allow_empty=True):
-        n = int(rng.randint(0 if allow_empty else 1, max_words))
-        return " ".join(rng.choice(vocab, n)) if n else ""
+        return _fuzz_sentence(rng, max_words, allow_empty)
 
     def corpus(n_pairs, n_refs):
         preds = [sentence() for _ in range(n_pairs)]
@@ -1996,3 +2004,93 @@ def test_wrapper_config_fuzz_matches_reference(reference):
         checked += 1
 
     assert checked == 48
+
+
+def test_text_module_accumulation_fuzz_matches_reference(reference):
+    """Live fuzz of the text MODULE lifecycles: ~60 randomized corpora
+    split across 2-3 update batches per module (WER family, BLEU,
+    SacreBLEU, CHRF, TER, EED, SQuAD) — the n-gram/edit-count STATE
+    accumulation path, which the one-shot functional fuzz above does not
+    exercise. Batch boundaries are random, so corpus-level aggregation
+    must be exactly batch-order-invariant in both frameworks."""
+    import warnings
+
+    import torch  # noqa: F401  (reference modules build torch tensors)
+
+    import metrics_tpu
+
+    rng = np.random.RandomState(5151)
+
+    def sentence(allow_empty=True):
+        return _fuzz_sentence(rng, 8, allow_empty)
+
+    MODULES = [
+        ("WordErrorRate", {}, "flat"),
+        ("CharErrorRate", {}, "flat"),
+        ("MatchErrorRate", {}, "flat"),
+        ("WordInfoLost", {}, "flat"),
+        ("WordInfoPreserved", {}, "flat"),
+        ("BLEUScore", {"n_gram": 2}, "nested"),
+        ("SacreBLEUScore", {"tokenize": "13a"}, "nested"),
+        ("CHRFScore", {"n_word_order": 2}, "nested"),
+        ("TranslationEditRate", {}, "nested"),
+        ("ExtendedEditDistance", {}, "nested"),
+        ("SQuAD", {}, "squad"),
+    ]
+
+    checked = 0
+    for i in range(60):
+        name, kwargs, shape = MODULES[i % len(MODULES)]
+        n_pairs = int(rng.randint(2, 6))
+        if shape == "squad":
+            preds_all = [
+                {"prediction_text": sentence(), "id": str(j)} for j in range(n_pairs)
+            ]
+            targets_all = [
+                {
+                    "answers": {
+                        "answer_start": [0],
+                        "text": [sentence(allow_empty=False) for _ in range(int(rng.randint(1, 3)))],
+                    },
+                    "id": str(j),
+                }
+                for j in range(n_pairs)
+            ]
+        else:
+            preds_all = [sentence() for _ in range(n_pairs)]
+            if shape == "flat":
+                targets_all = [sentence(allow_empty=False) for _ in range(n_pairs)]
+            else:
+                targets_all = [
+                    [sentence(allow_empty=False) for _ in range(int(rng.randint(1, 3)))]
+                    for _ in range(n_pairs)
+                ]
+        n_splits = int(rng.randint(1, 3))  # 2 or 3 update batches
+        cuts = sorted(set(int(c) for c in rng.randint(1, n_pairs, n_splits)))
+        bounds = [0] + cuts + [n_pairs]
+        slices = [slice(a, b) for a, b in zip(bounds, bounds[1:]) if a < b]
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mine = getattr(metrics_tpu, name)(**kwargs)
+            ref = getattr(reference, name)(**kwargs)
+            for sl in slices:
+                mine.update(preds_all[sl], targets_all[sl])
+                ref.update(preds_all[sl], targets_all[sl])
+            got, exp = mine.compute(), ref.compute()
+
+        case = f"case {i} {name} n_pairs={n_pairs} slices={len(slices)}"
+        if isinstance(exp, dict):  # SQuAD: {exact_match, f1}
+            assert set(got) == set(exp), case
+            for k in exp:
+                np.testing.assert_allclose(
+                    float(got[k]), float(exp[k]), rtol=1e-5, atol=1e-6,
+                    err_msg=f"{case} {k}",
+                )
+        else:
+            np.testing.assert_allclose(
+                float(got), float(exp), rtol=1e-5, atol=1e-6, err_msg=case
+            )
+        checked += 1
+
+    assert checked == 60
