@@ -97,6 +97,28 @@ def _fuzz_sentence(rng, max_words=9, allow_empty=True):
     return " ".join(rng.choice(_FUZZ_VOCAB, n)) if n else ""
 
 
+def _to_np_tree(out):
+    """Tensor leaves -> np arrays, structure preserved (lists stay lists)."""
+    if isinstance(out, (list, tuple)):
+        return [_to_np_tree(o) for o in out]
+    return np.asarray(out.numpy() if hasattr(out, "numpy") else out)
+
+
+def _assert_tree_close(a, b, case, rtol=1e-5, atol=1e-6):
+    """Structure-strict comparison: list nesting must match level by
+    level, so a flattened-but-reordered or re-grouped public return
+    cannot pass as drop-in parity."""
+    if isinstance(a, list) or isinstance(b, list):
+        assert isinstance(a, list) and isinstance(b, list) and len(a) == len(b), case
+        for aa, bb in zip(a, b):
+            _assert_tree_close(aa, bb, case, rtol, atol)
+    else:
+        np.testing.assert_allclose(
+            np.asarray(a, np.float64), np.asarray(b, np.float64),
+            rtol=rtol, atol=atol, equal_nan=True, err_msg=case,
+        )
+
+
 CLASSIFICATION_CASES = [
     ("accuracy", (_probs, _labels), dict(num_classes=_C)),
     ("accuracy", (_probs, _labels), dict(average="macro", num_classes=_C)),
@@ -1369,22 +1391,6 @@ def test_curve_family_config_fuzz_matches_reference(reference):
 
     import torch
 
-    def to_np_tree(out):
-        if isinstance(out, (list, tuple)):
-            return [to_np_tree(o) for o in out]
-        return np.asarray(out.numpy() if hasattr(out, "numpy") else out)
-
-    def assert_tree_close(a, b, case):
-        if isinstance(a, list) or isinstance(b, list):
-            assert isinstance(a, list) and isinstance(b, list) and len(a) == len(b), case
-            for aa, bb in zip(a, b):
-                assert_tree_close(aa, bb, case)
-        else:
-            np.testing.assert_allclose(
-                np.asarray(a, np.float64), np.asarray(b, np.float64),
-                rtol=1e-5, atol=1e-6, equal_nan=True, err_msg=case,
-            )
-
     rng = np.random.RandomState(9090)
     n, c = 24, 4
 
@@ -1434,13 +1440,13 @@ def test_curve_family_config_fuzz_matches_reference(reference):
             warnings.simplefilter("ignore")
             try:
                 ref_fn = getattr(reference.functional, name)
-                ref_out = to_np_tree(
+                ref_out = _to_np_tree(
                     ref_fn(*[torch.from_numpy(np.asarray(a)) for a in args], **kwargs)
                 )
             except Exception as e:  # noqa: BLE001
                 ref_err = e
             try:
-                my_out = to_np_tree(getattr(F, name)(*[jnp.asarray(a) for a in args], **kwargs))
+                my_out = _to_np_tree(getattr(F, name)(*[jnp.asarray(a) for a in args], **kwargs))
             except Exception as e:  # noqa: BLE001
                 mine_err = e
 
@@ -1448,7 +1454,7 @@ def test_curve_family_config_fuzz_matches_reference(reference):
             _assert_errors_agree(case, ref_err, mine_err)
             agreed_errors += 1
             continue
-        assert_tree_close(my_out, ref_out, case)
+        _assert_tree_close(my_out, ref_out, case)
         checked += 1
 
     # both regimes must be exercised: the invalid-average injections above
@@ -2094,3 +2100,117 @@ def test_text_module_accumulation_fuzz_matches_reference(reference):
         checked += 1
 
     assert checked == 60
+
+
+def test_binned_curve_config_fuzz_matches_reference(reference):
+    """Live fuzz of the binned (fixed-threshold) curve family — the
+    TPU-default O(1)-memory formulation: ~36 randomized cases over
+    BinnedPrecisionRecallCurve / BinnedAveragePrecision /
+    BinnedRecallAtFixedPrecision, crossing num_classes, int-vs-explicit
+    threshold grids, min_precision, and 1-3 update batches."""
+    import warnings
+
+    import torch
+
+    import metrics_tpu
+
+    rng = np.random.RandomState(7272)
+
+    checked = 0
+    for i in range(36):
+        cls_name = (
+            "BinnedPrecisionRecallCurve",
+            "BinnedAveragePrecision",
+            "BinnedRecallAtFixedPrecision",
+        )[i % 3]
+        c = int(rng.choice([1, 3, 5]))
+        if rng.rand() < 0.5:
+            thresholds = int(rng.choice([5, 21]))
+        else:
+            thresholds = np.sort(rng.rand(int(rng.choice([5, 9])))).astype(np.float32).tolist()
+        kwargs = dict(num_classes=c, thresholds=thresholds)
+        if cls_name == "BinnedRecallAtFixedPrecision":
+            kwargs["min_precision"] = float(rng.choice([0.3, 0.6, 0.9]))
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mine = getattr(metrics_tpu, cls_name)(**kwargs)
+            ref = getattr(reference, cls_name)(**kwargs)
+            for _ in range(int(rng.randint(1, 4))):
+                n = 24
+                if c == 1:
+                    probs = rng.rand(n).astype(np.float32)
+                    target = rng.randint(0, 2, n)
+                else:
+                    probs = rng.rand(n, c).astype(np.float32)
+                    target = (np.arange(c)[None, :] == rng.randint(0, c, n)[:, None]).astype(np.int64)
+                mine.update(jnp.asarray(probs), jnp.asarray(target))
+                ref.update(torch.from_numpy(probs), torch.from_numpy(target))
+            got, exp = mine.compute(), ref.compute()
+
+        case = f"case {i} {cls_name} kwargs={kwargs}"
+        _assert_tree_close(_to_np_tree(got), _to_np_tree(exp), case, rtol=1e-4, atol=1e-4)
+        checked += 1
+
+    assert checked == 36
+
+
+def test_compositional_chain_fuzz_matches_reference(reference):
+    """Live fuzz of CompositionalMetric chains: ~40 random 2-4-op
+    arithmetic expressions over metric operands (metric-metric and
+    metric-scalar, mixed operators incl. the abs/neg unaries), updated
+    over random batches and compared against the reference's lazy
+    compositional evaluation. Ref: metric.py:616-836."""
+    import operator
+    import warnings
+
+    import torch
+
+    import metrics_tpu
+
+    rng = np.random.RandomState(6161)
+    BINOPS = [operator.add, operator.sub, operator.mul, operator.truediv]
+
+    checked = 0
+    for i in range(40):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+
+            def build(ns):
+                a = ns.MeanSquaredError()
+                b = ns.MeanAbsoluteError()
+                leaves = [a, b]
+                expr_a, expr_b = a, b
+                expr = None
+                for _ in range(int(rng2.randint(2, 5))):
+                    op = BINOPS[int(rng2.randint(len(BINOPS)))]
+                    kind = int(rng2.randint(3))
+                    cur = expr if expr is not None else expr_a
+                    if kind == 0:
+                        expr = op(cur, expr_b)
+                    elif kind == 1:
+                        expr = op(cur, float(rng2.rand() + 0.5))
+                    else:
+                        expr = abs(op(cur, expr_b)) if rng2.rand() < 0.5 else -op(cur, expr_b)
+                return expr, leaves
+
+            seed = int(rng.randint(1 << 30))
+            rng2 = np.random.RandomState(seed)
+            mine, my_leaves = build(metrics_tpu)
+            rng2 = np.random.RandomState(seed)  # identical expression tree
+            ref, ref_leaves = build(reference)
+
+            for _ in range(int(rng.randint(1, 4))):
+                preds = rng.rand(16).astype(np.float32)
+                target = (rng.rand(16) + 0.1).astype(np.float32)
+                for m in my_leaves:
+                    m.update(jnp.asarray(preds), jnp.asarray(target))
+                for m in ref_leaves:
+                    m.update(torch.from_numpy(preds), torch.from_numpy(target))
+
+            got = float(mine.compute())
+            exp = float(ref.compute())
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-7, err_msg=f"case {i} seed={seed}")
+        checked += 1
+
+    assert checked == 40
