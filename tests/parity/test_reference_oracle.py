@@ -2214,3 +2214,108 @@ def test_compositional_chain_fuzz_matches_reference(reference):
         checked += 1
 
     assert checked == 40
+
+
+def test_classification_module_lifecycle_fuzz_matches_reference(reference):
+    """Live fuzz of the classification MODULE lifecycles: ~60 randomized
+    (metric, config, driving-mode) cases through multi-batch
+    update/forward cycles — the state-accumulation path (incl. the
+    samplewise/list-state configurations) that the one-shot functional
+    fuzz cannot reach. Per-batch forward values AND the final
+    accumulated compute must both agree."""
+    import warnings
+
+    import torch
+
+    import metrics_tpu
+
+    rng = np.random.RandomState(9393)
+    c = _C
+
+    checked = agreed_errors = 0
+    for i in range(60):
+        name = ("Accuracy", "Precision", "Recall", "F1Score", "Specificity", "StatScores")[i % 6]
+        kind = ("mc_prob", "mc_int", "ml_prob", "mdmc_int")[int(rng.randint(4))]
+        kwargs = {}
+        if name == "StatScores":
+            kwargs["reduce"] = str(rng.choice(["micro", "macro", "samples"]))
+            kwargs["num_classes"] = c
+            if kind == "mdmc_int":
+                kwargs["mdmc_reduce"] = str(rng.choice(["global", "samplewise"]))
+        else:
+            kwargs["average"] = str(rng.choice(["micro", "macro", "weighted"]))
+            kwargs["num_classes"] = c
+            if kind == "mdmc_int":
+                kwargs["mdmc_average"] = str(rng.choice(["global", "samplewise"]))
+        if kind == "mc_prob" and rng.rand() < 0.3:
+            kwargs["top_k"] = 2
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ctor_case = f"case {i} {name} kind={kind} kwargs={kwargs} (ctor)"
+            ref_err = mine_err = None
+            try:
+                ref = getattr(reference, name)(**kwargs)
+            except Exception as e:  # noqa: BLE001
+                ref_err = e
+            try:
+                mine = getattr(metrics_tpu, name)(**kwargs)
+            except Exception as e:  # noqa: BLE001
+                mine_err = e
+            if ref_err is not None or mine_err is not None:
+                _assert_errors_agree(ctor_case, ref_err, mine_err)
+                agreed_errors += 1
+                continue
+
+            drive_forward = rng.rand() < 0.5
+            for _ in range(int(rng.randint(2, 5))):
+                n = 20
+                if kind == "mc_prob":
+                    logits = rng.rand(n, c).astype(np.float32)
+                    preds = logits / logits.sum(-1, keepdims=True)
+                    target = rng.randint(0, c, n)
+                elif kind == "mc_int":
+                    preds = rng.randint(0, c, n)
+                    target = rng.randint(0, c, n)
+                elif kind == "ml_prob":
+                    preds = rng.rand(n, c).astype(np.float32)
+                    target = rng.randint(0, 2, (n, c))
+                else:
+                    preds = rng.randint(0, c, (n, 4))
+                    target = rng.randint(0, c, (n, 4))
+                ref_err = mine_err = None
+                try:
+                    if drive_forward:
+                        exp_f = ref(torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)))
+                    else:
+                        ref.update(torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)))
+                except Exception as e:  # noqa: BLE001
+                    ref_err = e
+                try:
+                    if drive_forward:
+                        got_f = mine(jnp.asarray(preds), jnp.asarray(target))
+                    else:
+                        mine.update(jnp.asarray(preds), jnp.asarray(target))
+                except Exception as e:  # noqa: BLE001
+                    mine_err = e
+                case = f"case {i} {name} kind={kind} kwargs={kwargs} fwd={drive_forward}"
+                if ref_err is not None or mine_err is not None:
+                    _assert_errors_agree(case, ref_err, mine_err)
+                    agreed_errors += 1
+                    break
+                if drive_forward:
+                    np.testing.assert_allclose(
+                        np.asarray(got_f, np.float64), np.asarray(exp_f.numpy(), np.float64),
+                        rtol=1e-4, atol=1e-5, err_msg=f"{case} forward",
+                    )
+            else:
+                got, exp = mine.compute(), ref.compute()
+                np.testing.assert_allclose(
+                    np.asarray(got, np.float64), np.asarray(exp.numpy(), np.float64),
+                    rtol=1e-4, atol=1e-5, err_msg=f"{case} compute",
+                )
+                checked += 1
+
+    # the numeric-comparison regime must dominate: `checked` counts only
+    # lifecycles whose final compute was actually compared
+    assert checked >= 35, (checked, agreed_errors)
