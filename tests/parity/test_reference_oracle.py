@@ -2319,3 +2319,145 @@ def test_classification_module_lifecycle_fuzz_matches_reference(reference):
     # the numeric-comparison regime must dominate: `checked` counts only
     # lifecycles whose final compute was actually compared
     assert checked >= 35, (checked, agreed_errors)
+
+
+def test_regression_pairwise_config_fuzz_matches_reference(reference):
+    """Live fuzz of the regression + pairwise functionals: ~72 randomized
+    cases across the full regression family (multioutput modes, adjusted
+    R2, Tweedie powers incl. invalid ones, squared/log variants,
+    cosine reductions) and the four pairwise distances (reduction ×
+    zero_diagonal × one-matrix vs two-matrix forms) — completing the
+    config-fuzz sweep over every live-comparable domain."""
+    import warnings
+
+    import torch
+
+    rng = np.random.RandomState(2828)
+
+    checked = agreed_errors = 0
+    for i in range(72):
+        use_pairwise = i % 3 == 2
+        if use_pairwise:
+            name = (
+                "pairwise_cosine_similarity", "pairwise_euclidean_distance",
+                "pairwise_linear_similarity", "pairwise_manhattan_distance",
+            )[int(rng.randint(4))]
+            x = rng.rand(8, 5).astype(np.float32)
+            args = [x]
+            if rng.rand() < 0.6:
+                args.append(rng.rand(6, 5).astype(np.float32))
+            kwargs = {}
+            if rng.rand() < 0.5:
+                kwargs["reduction"] = str(rng.choice(["mean", "sum", "none"]))
+            if rng.rand() < 0.5:
+                # legal in BOTH forms: with an explicit second matrix it
+                # zeroes the min-dim diagonal of the non-square result
+                kwargs["zero_diagonal"] = bool(rng.rand() < 0.5)
+            if (
+                name == "pairwise_euclidean_distance"
+                and len(args) == 1
+                and kwargs.get("zero_diagonal") is False
+            ):
+                # reference NaNs the unmasked self-distance diagonal
+                # (sqrt of the x2+y2-2xy trick's -eps) — pinned as a
+                # divergence in test_pairwise_euclidean_diagonal_divergence
+                kwargs["zero_diagonal"] = True
+        else:
+            name = (
+                "mean_squared_error", "mean_absolute_error", "mean_squared_log_error",
+                "mean_absolute_percentage_error", "symmetric_mean_absolute_percentage_error",
+                "weighted_mean_absolute_percentage_error", "explained_variance", "r2_score",
+                "pearson_corrcoef", "spearman_corrcoef", "cosine_similarity",
+                "tweedie_deviance_score",
+            )[int(rng.randint(12))]
+            multi = rng.rand() < 0.4 and name in (
+                "mean_squared_error", "mean_absolute_error", "explained_variance", "r2_score"
+            )
+            shape = (20, 3) if multi else (20,)
+            preds = (rng.rand(*shape) + 0.1).astype(np.float32)
+            target = (rng.rand(*shape) + 0.1).astype(np.float32)
+            if name == "cosine_similarity":
+                preds = rng.rand(8, 6).astype(np.float32)
+                target = rng.rand(8, 6).astype(np.float32)
+            args = [preds, target]
+            kwargs = {}
+            if name == "mean_squared_error" and rng.rand() < 0.5:
+                kwargs["squared"] = False
+            if name == "r2_score":
+                if multi and rng.rand() < 0.6:
+                    kwargs["multioutput"] = str(
+                        rng.choice(["raw_values", "uniform_average", "variance_weighted"])
+                    )
+                if rng.rand() < 0.3:
+                    kwargs["adjusted"] = int(rng.choice([1, 3]))
+            if name == "explained_variance" and multi and rng.rand() < 0.6:
+                kwargs["multioutput"] = str(
+                    rng.choice(["raw_values", "uniform_average", "variance_weighted"])
+                )
+            if name == "cosine_similarity" and rng.rand() < 0.6:
+                kwargs["reduction"] = str(rng.choice(["mean", "sum", "none"]))
+            if i == 0:
+                # forced BY CONSTRUCTION (seed-independent): one invalid
+                # tweedie power in (0,1), so the mutual-rejection regime
+                # is always exercised
+                name = "tweedie_deviance_score"
+                kwargs = {"power": 0.5}
+            elif name == "tweedie_deviance_score":
+                kwargs["power"] = float(rng.choice([0.0, 1.0, 1.5, 2.0, 3.0]))
+
+        ref_err = mine_err = ref_out = my_out = None
+        case = f"case {i} {name} kwargs={kwargs}"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            try:
+                ref_out = _to_np_tree(
+                    getattr(reference.functional, name)(
+                        *[torch.from_numpy(a) for a in args], **kwargs
+                    )
+                )
+            except Exception as e:  # noqa: BLE001
+                ref_err = e
+            try:
+                my_out = _to_np_tree(
+                    getattr(F, name)(*[jnp.asarray(a) for a in args], **kwargs)
+                )
+            except Exception as e:  # noqa: BLE001
+                mine_err = e
+
+        if ref_err is not None or mine_err is not None:
+            _assert_errors_agree(case, ref_err, mine_err)
+            agreed_errors += 1
+            continue
+        _assert_tree_close(my_out, ref_out, case, rtol=1e-4, atol=1e-5)
+        checked += 1
+
+    assert checked >= 55, (checked, agreed_errors)
+    assert agreed_errors >= 1, (checked, agreed_errors)  # forced tweedie 0.5
+
+
+def test_pairwise_euclidean_diagonal_divergence(reference):
+    """Pinned DELIBERATE divergence: the reference computes pairwise
+    euclidean distance via the ``x2 + y2 - 2xy`` expansion, so the
+    self-distance diagonal of the one-matrix form is ``sqrt`` of a tiny
+    NEGATIVE value — NaN — whenever ``zero_diagonal=False`` leaves it
+    unmasked (ref functional/pairwise/euclidean.py:25-35). This
+    framework clamps the negative cancellation residue to zero before
+    the sqrt, so the diagonal stays FINITE (tiny f32 noise, ~1e-4 at
+    unit scale) instead of NaN. If the reference side stops producing
+    NaN, fold zero_diagonal=False one-matrix euclidean back into the
+    pairwise fuzz."""
+    import torch
+
+    x = np.random.RandomState(21).rand(6, 5).astype(np.float32)
+    ref_out = reference.functional.pairwise_euclidean_distance(
+        torch.from_numpy(x), zero_diagonal=False
+    ).numpy()
+    assert np.isnan(np.diag(ref_out)).any()  # the reference's cancellation NaNs
+    my_out = np.asarray(
+        F.pairwise_euclidean_distance(jnp.asarray(x), zero_diagonal=False)
+    )
+    assert np.isfinite(np.diag(my_out)).all()  # clamped, never NaN
+    np.testing.assert_allclose(np.diag(my_out), 0.0, atol=1e-3)
+    # off-diagonal values agree
+    mask = ~np.eye(6, dtype=bool)
+    np.testing.assert_allclose(my_out[mask], ref_out[mask], rtol=1e-4, atol=1e-5)
