@@ -316,3 +316,24 @@ def test_kid_in_graph_compute_on_device():
     mean, std = jax.jit(kid.pure_compute)(kid.state())
     assert np.isfinite(float(mean)) and np.isfinite(float(std))
     assert float(mean) > 0
+
+
+def test_inception_taps_bf16_on_device():
+    """Late-round-4 leg: the intermediate feature taps (the reference's
+    feature=64/192/768 selection) extract on the real chip with the
+    bf16 MXU-native trunk — sown intermediates flow through jit, each
+    tap pools to (N, C) at f32-or-better, and the FID ctor sugar builds
+    a working metric from a tap."""
+    from metrics_tpu.image import FrechetInceptionDistance, InceptionV3FeatureExtractor
+
+    imgs = jnp.asarray(RNG.rand(2, 3, 75, 75).astype(np.float32))
+    for width in (64, 192, 768):
+        ext = InceptionV3FeatureExtractor(output=width, dtype=jnp.bfloat16)
+        out = ext(imgs)
+        assert out.shape == (2, width) and out.dtype == jnp.float32
+        assert bool(jnp.isfinite(out).all())
+
+    fid = FrechetInceptionDistance(feature=64)
+    fid.update(imgs, real=True)
+    fid.update(imgs + 0.05, real=False)
+    assert np.isfinite(float(fid.compute()))
