@@ -114,6 +114,7 @@ def _cfg_collection(detail: dict) -> None:
         {"acc": Accuracy(num_classes=32), "f1": F1Score(num_classes=32, average="macro"),
          "ap": BinnedAveragePrecision(num_classes=32, thresholds=64)},
         compute_groups=False,
+        fused_update=False,  # pin eager: this key IS the eager baseline
     )
     mc.update(preds, target)  # warm
     t0 = time.perf_counter()
@@ -121,6 +122,21 @@ def _cfg_collection(detail: dict) -> None:
         mc.update(preds, target)
     jax.block_until_ready(mc["ap"].TPs)
     detail["collection_update_us"] = round((time.perf_counter() - t0) / 50 * 1e6, 1)
+
+    # out-of-box construction (fused_update=None): resolves to the fused
+    # program on accelerators, the eager loop on CPU — records what a user
+    # gets with no knobs touched on the bench device
+    mcd = MetricCollection(
+        {"acc": Accuracy(num_classes=32), "f1": F1Score(num_classes=32, average="macro"),
+         "ap": BinnedAveragePrecision(num_classes=32, thresholds=64)},
+    )
+    mcd.update(preds, target)  # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(50):
+        mcd.update(preds, target)
+    jax.block_until_ready(mcd["ap"].TPs)
+    detail["collection_update_default_us"] = round((time.perf_counter() - t0) / 50 * 1e6, 1)
+    detail["collection_default_fused"] = bool(mcd._fusion_enabled)
 
     # same suite through the fused single-jit dispatch (one XLA program,
     # CSE-deduplicated across metrics)
@@ -174,7 +190,9 @@ def _cfg_compute_group_detection(detail: dict, reps: int = 5) -> None:
     def first_update_us(**kwargs):
         best = float("inf")
         for rep in range(reps + 1):
-            mc = MetricCollection(metrics(), **kwargs)
+            # fused dispatch pinned off: this config times the compute-group
+            # machinery itself, which the fused program would bypass
+            mc = MetricCollection(metrics(), fused_update=False, **kwargs)
             t0 = time.perf_counter()
             mc.update(preds, target)
             # "acc" leads the explicit group and updates in every mode
@@ -189,6 +207,66 @@ def _cfg_compute_group_detection(detail: dict, reps: int = 5) -> None:
     detail["cg_first_update_explicit_us"] = first_update_us(
         compute_groups=[["acc", "f1", "prec", "rec"]]
     )
+    # detection cost proper: auto's first update necessarily runs EVERY
+    # member (their states are what get compared), so the no-groups run is
+    # its floor; the difference is what the batched one-sync sweep costs
+    # clamped at 0: the two keys are independently-sampled best-of-reps, so
+    # host noise can push the difference slightly negative
+    detail["cg_detection_overhead_us"] = round(
+        max(0.0, detail["cg_first_update_auto_detect_us"] - detail["cg_first_update_no_groups_us"]), 1
+    )
+
+
+def _cfg_cg_steady_state(detail: dict, steps: int = 200, reps: int = 3) -> None:
+    """Amortized compute-group win over a steady-state epoch (VERDICT r4 #2).
+
+    The reference's headline claim is 2-3x lower cost beyond ~100 steps
+    (ref docs/source/pages/overview.rst:303-310): after the first-update
+    detection, only each group's leader runs ``update``. This config times a
+    200-step epoch over a 4-metric macro stat-score suite (one shared group)
+    with detection on (auto), off, and declared explicitly, eager dispatch
+    pinned so the group machinery — not XLA fusion — is what's measured.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
+
+    rng = np.random.RandomState(5)
+    logits = rng.rand(256, 32).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, 32, 256))
+
+    def metrics():
+        return {
+            "acc": Accuracy(num_classes=32, average="macro"),
+            "f1": F1Score(num_classes=32, average="macro"),
+            "prec": Precision(num_classes=32, average="macro"),
+            "rec": Recall(num_classes=32, average="macro"),
+        }
+
+    def epoch_ms(**kwargs):
+        best = float("inf")
+        for rep in range(reps + 1):
+            mc = MetricCollection(metrics(), fused_update=False, **kwargs)
+            mc.update(preds, target)  # first update: detection + jit warm
+            jax.block_until_ready(mc["acc"].tp)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                mc.update(preds, target)
+            jax.block_until_ready(mc["acc"].tp)
+            dt = (time.perf_counter() - t0) * 1e3
+            if rep:  # rep 0 pays any remaining compile
+                best = min(best, dt)
+        return round(best, 1)
+
+    detail["cg_steady_state_auto_ms"] = epoch_ms(compute_groups=True)
+    detail["cg_steady_state_no_groups_ms"] = epoch_ms(compute_groups=False)
+    detail["cg_steady_state_explicit_ms"] = epoch_ms(compute_groups=[["acc", "f1", "prec", "rec"]])
+    if detail["cg_steady_state_auto_ms"]:
+        detail["cg_steady_state_speedup"] = round(
+            detail["cg_steady_state_no_groups_ms"] / detail["cg_steady_state_auto_ms"], 2
+        )
 
 
 def _cfg_scan_epoch(detail: dict, reps: int = 5) -> None:
@@ -372,6 +450,8 @@ def _bench_detail() -> dict:
     _mark("collection_update_us")
     _cfg_compute_group_detection(detail)
     _mark("cg_first_update_auto_detect_us")
+    _cfg_cg_steady_state(detail)
+    _mark("cg_steady_state_auto_ms")
     _cfg_scan_epoch(detail)
     _mark("scan_epoch_100_batches_ms")
     _cfg_retrieval(detail)
@@ -568,6 +648,7 @@ def _bench_detail_fast() -> dict:
     configs = [
         ("collection", _cfg_collection),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
+        ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),
         ("retrieval", _cfg_retrieval),
         ("coco_map", _cfg_coco),

@@ -3,10 +3,14 @@
 Behavioral parity: /root/reference/torchmetrics/collections.py (371 LoC).
 Compute groups merge metrics whose states are identical after the first
 update, so each group runs ``update`` only once per step (the reference's
-headline 2-3x optimization, collections.py:48-54). TPU note: dynamic group
-detection needs a host sync of state values (like the reference); declare
-groups explicitly via ``compute_groups=[[...]]`` to keep the step fully
-async on device.
+headline 2-3x optimization, collections.py:48-54). TPU notes: dynamic group
+detection batches every pairwise state comparison into one device program
+with a single host sync (vs the reference's per-pair allclose round trips);
+declaring groups explicitly via ``compute_groups=[[...]]`` skips even that.
+On accelerator backends the collection defaults to fused single-program
+dispatch (``fused_update=None`` auto-resolves), where XLA CSE dedups shared
+work inside one compiled step — the compiler-native counterpart of compute
+groups.
 """
 from collections import OrderedDict
 from copy import deepcopy
@@ -17,7 +21,26 @@ import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric, _raise_if_list_state, _scan_fold
 from metrics_tpu.utilities.data import _flatten_dict, _squeeze_if_scalar
-from metrics_tpu.utilities.prints import rank_zero_warn
+from metrics_tpu.utilities.prints import rank_zero_debug, rank_zero_warn
+
+
+@jax.jit
+def _bucket_pairwise_equal(leaf_groups) -> jax.Array:
+    """(k, k) state equality over a bucket of k leaders, as ONE program.
+
+    ``leaf_groups`` is a tuple of tuples: one inner tuple per state leaf,
+    holding that leaf's value from each of the bucket's k leaders (stacked
+    here, inside the trace, so the host pays a single dispatch total).
+    Jitted module-level so the executable is cached by leaf shapes
+    process-wide: group detection costs one dispatch per bucket regardless
+    of how many leaders, states, or collections are involved.
+    """
+    out = None
+    for group in leaf_groups:
+        flat = jnp.stack([jnp.ravel(leaf) for leaf in group])
+        mat = jnp.all(jnp.isclose(flat[:, None, :], flat[None, :, :]), axis=-1)
+        out = mat if out is None else jnp.logical_and(out, mat)
+    return out
 
 
 class MetricCollection:
@@ -37,6 +60,13 @@ class MetricCollection:
         prefix / postfix: strings added around every output key.
         compute_groups: ``True`` (auto-detect), ``False`` (off), or an
             explicit list of lists of metric names.
+        fused_update: ``None`` (default) resolves per backend — fused
+            single-program dispatch on accelerators (TPU/GPU), eager loop on
+            CPU. ``True``/``False`` force the choice. Fusion compiles the
+            whole collection's ``update``/``forward`` into ONE XLA program
+            per step (XLA CSE dedups work shared between metrics); any
+            unfusable member (list states, string inputs, wrappers) falls
+            back to the eager loop for the collection's lifetime.
     """
 
     def __init__(
@@ -46,7 +76,7 @@ class MetricCollection:
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
-        fused_update: bool = False,
+        fused_update: Optional[bool] = None,
     ) -> None:
         self._modules: "OrderedDict[str, Metric]" = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
@@ -101,9 +131,35 @@ class MetricCollection:
         return self._modules.values()
 
     # ----------------------------------------------------------------- calls
+    @property
+    def _fusion_enabled(self) -> bool:
+        """Resolve the ``fused_update`` tri-state against the live backend.
+
+        The TPU-first default: on accelerator backends per-metric eager
+        dispatch latency (host→device round trips per member) dominates the
+        step, so the single-program fused path is the out-of-box behavior;
+        on CPU the eager loop keeps value-dependent input validation and
+        costs little, so it stays the default there.
+        """
+        if self._fuse_failed:
+            return False
+        if self._fused_update is None:
+            return jax.default_backend() != "cpu"
+        return self._fused_update
+
+    def _fuse_fallback(self, what: str, err: Exception) -> None:
+        msg = (
+            f"MetricCollection could not fuse `{what}` "
+            f"({type(err).__name__}: {err}); falling back to eager dispatch."
+        )
+        # auto mode falls back quietly (the user never asked for fusion);
+        # an explicit fused_update=True gets a visible warning
+        (rank_zero_warn if self._fused_update is True else rank_zero_debug)(msg)
+        self._fuse_failed = True
+
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Call forward on every metric; kwargs filtered per metric (ref :128-136)."""
-        if self._fused_update and not self._fuse_failed:
+        if self._fusion_enabled:
             fused = self._try_fused_forward(*args, **kwargs)
             if fused is not None:
                 return fused
@@ -115,7 +171,7 @@ class MetricCollection:
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Update each metric, or only group leaders once groups are formed (ref :138-157)."""
-        if self._fused_update and not self._fuse_failed and self._try_fused_update(*args, **kwargs):
+        if self._fusion_enabled and self._try_fused_update(*args, **kwargs):
             return
         if self._groups_checked:
             for _, cg in self._groups.items():
@@ -129,14 +185,16 @@ class MetricCollection:
                 self._groups_checked = True
 
     # ---------------------------------------------------------- fused calls
-    # Opt-in (``fused_update=True``): the whole collection's update/forward
-    # dispatches as ONE jitted XLA program built from the pure API below.
-    # XLA's CSE dedups work shared between metrics (input formatting, stat
-    # scores) inside the compiled program — the compiler-native counterpart
-    # of the host-side compute groups. Opt-in because value-dependent input
-    # validation (e.g. label-range checks) is skipped while tracing; any
-    # failure to fuse (list states, non-array inputs, host-side metrics)
-    # falls back to the eager loop permanently for this collection.
+    # Default on accelerators (``fused_update=None`` → fused when the
+    # backend is TPU/GPU): the whole collection's update/forward dispatches
+    # as ONE jitted XLA program built from the pure API below. XLA's CSE
+    # dedups work shared between metrics (input formatting, stat scores)
+    # inside the compiled program — the compiler-native counterpart of the
+    # host-side compute groups. CPU keeps the eager loop by default because
+    # value-dependent input validation (e.g. label-range checks) is skipped
+    # while tracing; any failure to fuse (list states, non-array inputs,
+    # host-side metrics) falls back to the eager loop permanently for this
+    # collection.
     def _fusable(self, args: tuple, kwargs: dict) -> bool:
         import numpy as _np
 
@@ -161,11 +219,7 @@ class MetricCollection:
                 self._fused_update_fn = jax.jit(self.pure_update)
             new_states = self._fused_update_fn(self.state(), *args, **kwargs)
         except Exception as err:
-            rank_zero_warn(
-                f"MetricCollection(fused_update=True) could not fuse `update` "
-                f"({type(err).__name__}: {err}); falling back to eager dispatch."
-            )
-            self._fuse_failed = True
+            self._fuse_fallback("update", err)
             return False
         self.load_pure_state(new_states, increment=True)
         return True
@@ -196,11 +250,7 @@ class MetricCollection:
             }
             new_states, batch_vals = self._fused_forward_fn(self.state(), counts, *args, **kwargs)
         except Exception as err:
-            rank_zero_warn(
-                f"MetricCollection(fused_update=True) could not fuse `forward` "
-                f"({type(err).__name__}: {err}); falling back to eager dispatch."
-            )
-            self._fuse_failed = True
+            self._fuse_fallback("forward", err)
             return None
         self.load_pure_state(new_states, increment=True)
         for name, m in self.items(keep_base=True):
@@ -209,16 +259,22 @@ class MetricCollection:
         return {self._set_name(k): v for k, v in res.items()}
 
     def _merge_compute_groups(self) -> None:
-        """Merge groups whose leader states are equal (ref :159-192)."""
+        """Merge groups whose leader states are equal (ref :159-192).
+
+        Semantics match the reference's leader-by-leader merge loop, but the
+        state comparisons are precomputed in one batched device program
+        (:meth:`_batched_leader_equality`) with a single host sync, instead
+        of the reference's per-pair ``allclose`` round trips — O(pairs×states)
+        device syncs collapse to one ``device_get``.
+        """
+        equal = self._batched_leader_equality()
         n_groups = len(self._groups)
         while True:
             for cg_idx1, cg_members1 in deepcopy(self._groups).items():
                 for cg_idx2, cg_members2 in deepcopy(self._groups).items():
                     if cg_idx1 == cg_idx2:
                         continue
-                    metric1 = self._modules[cg_members1[0]]
-                    metric2 = self._modules[cg_members2[0]]
-                    if self._equal_metric_states(metric1, metric2):
+                    if equal(cg_members1[0], cg_members2[0]):
                         self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
                         break
                 if len(self._groups) != n_groups:
@@ -228,6 +284,70 @@ class MetricCollection:
             n_groups = len(self._groups)
 
         self._groups = {idx: values for idx, values in enumerate(deepcopy(self._groups).values())}
+
+    def _state_signature(self, metric: Metric) -> tuple:
+        """Static (host-side, sync-free) fingerprint of a metric's state layout.
+
+        Two metrics can only have equal states if their signatures match:
+        same state names, same container types, same array shapes, and for
+        list states the same lengths and per-element shapes. Mirrors the
+        structural checks of :meth:`_equal_metric_states`; dtype is excluded
+        because ``allclose`` compares across dtypes.
+        """
+        sig = []
+        for key in sorted(metric._defaults):
+            state = getattr(metric, key)
+            if isinstance(state, list):
+                sig.append((key, "list", tuple(tuple(jnp.shape(s)) for s in state)))
+            else:
+                sig.append((key, "tensor", tuple(jnp.shape(state))))
+        return tuple(sig)
+
+    def _batched_leader_equality(self):
+        """Precompute pairwise state equality across all group leaders.
+
+        Leaders are bucketed by :meth:`_state_signature` (host-only work);
+        each bucket's state leaves are stacked and handed to the jitted
+        :func:`_bucket_pairwise_equal` (one dispatch per bucket), and all
+        resulting (k, k) bool matrices cross the device boundary in a single
+        ``jax.device_get``. Returns a ``(name_a, name_b) -> bool`` lookup;
+        cross-bucket pairs are unequal by construction.
+        """
+        buckets: Dict[tuple, List[str]] = {}
+        for cg in self._groups.values():
+            name = cg[0]
+            buckets.setdefault(self._state_signature(self._modules[name]), []).append(name)
+
+        device_mats: Dict[int, Tuple[List[str], Any]] = {}
+        for idx, members in enumerate(buckets.values()):
+            k = len(members)
+            if k < 2:
+                continue
+            leaf_groups = []
+            for key in self._modules[members[0]]._defaults:
+                values = [getattr(self._modules[n], key) for n in members]
+                if isinstance(values[0], list):
+                    # same length + element shapes guaranteed by the signature;
+                    # empty lists are vacuously equal and contribute nothing
+                    for elements in zip(*values):
+                        leaf_groups.append(tuple(elements))
+                else:
+                    leaf_groups.append(tuple(values))
+            mat = (
+                _bucket_pairwise_equal(tuple(leaf_groups))
+                if leaf_groups
+                else jnp.ones((k, k), dtype=bool)
+            )
+            device_mats[idx] = (members, mat)
+
+        host_mats = jax.device_get({idx: mat for idx, (_, mat) in device_mats.items()})  # the ONE sync
+        table: Dict[Tuple[str, str], bool] = {}
+        for idx, (members, _) in device_mats.items():
+            mat = host_mats[idx]
+            for i, a in enumerate(members):
+                for j, b in enumerate(members):
+                    table[(a, b)] = bool(mat[i][j])
+        return lambda a, b: table.get((a, b), False)
 
     @staticmethod
     def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:

@@ -250,9 +250,11 @@ class FrechetInceptionDistance(Metric):
             states stay sum-mergeable across shards/processes and updates
             stay jit/scan-compatible. Moment path only.
         feature: reference-style selector for the bundled InceptionV3
-            extractor (ref fid.py:160-186): 64 / 192 / 768 / 2048
-            intermediate-tap width or ``'logits_unbiased'``. Mutually
-            exclusive with ``feature_extractor``.
+            extractor: a 64 / 192 / 768 / 2048 intermediate-tap width —
+            the reference FID's int-only valid set (ref fid.py:172-186;
+            strings there raise ``TypeError``, so the sugar rejects them
+            too). Mutually exclusive with ``feature_extractor``, which
+            remains the escape hatch for any other feature source.
         weights_path: local ``.npz`` of converted InceptionV3 weights for
             the bundled extractor (see docs/pretrained_weights.md);
             implies ``feature=2048`` when ``feature`` is not given.
@@ -288,7 +290,8 @@ class FrechetInceptionDistance(Metric):
             from metrics_tpu.image.inception_net import resolve_ctor_extractor
 
             feature_extractor = resolve_ctor_extractor(
-                feature_extractor, feature, weights_path, default_output=2048
+                feature_extractor, feature, weights_path, default_output=2048,
+                allowed=(64, 192, 768, 2048),  # ref fid.py:172-186: int taps only
             )
         self.feature_extractor = feature_extractor
         if not isinstance(reset_real_features, bool):

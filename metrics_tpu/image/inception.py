@@ -52,8 +52,10 @@ class InceptionScore(Metric):
             leave a split empty (NaN, like an empty chunk would).
         feature: reference-style selector for the bundled InceptionV3
             extractor (ref inception.py:106-131): ``'logits_unbiased'``
-            (the reference default), ``'logits'``, or a 64 / 192 / 768 /
-            2048 tap width. Mutually exclusive with ``logits_extractor``.
+            (the reference default) or a 64 / 192 / 768 / 2048 tap width —
+            the reference's exact valid set (ref inception.py:121-131;
+            plain ``'logits'`` needs an injected ``logits_extractor``).
+            Mutually exclusive with ``logits_extractor``.
         weights_path: local ``.npz`` of converted InceptionV3 weights for
             the bundled extractor; implies ``feature='logits_unbiased'``
             when ``feature`` is not given.
@@ -89,7 +91,9 @@ class InceptionScore(Metric):
             from metrics_tpu.image.inception_net import resolve_ctor_extractor
 
             logits_extractor = resolve_ctor_extractor(
-                logits_extractor, feature, weights_path, default_output="logits_unbiased"
+                logits_extractor, feature, weights_path, default_output="logits_unbiased",
+                # ref inception.py:121-131 valid set
+                allowed=("logits_unbiased", 64, 192, 768, 2048),
             )
         self.logits_extractor = logits_extractor
         if not (isinstance(splits, int) and splits > 0):

@@ -206,7 +206,7 @@ class InceptionV3(nn.Module):
         return features.astype(out_dt), logits.astype(out_dt)
 
 
-def resolve_ctor_extractor(explicit, feature, weights_path, default_output):
+def resolve_ctor_extractor(explicit, feature, weights_path, default_output, allowed=None):
     """Reference-style ctor sugar shared by FID / InceptionScore / KID.
 
     The reference selects its torch_fidelity feature with
@@ -214,6 +214,13 @@ def resolve_ctor_extractor(explicit, feature, weights_path, default_output):
     kid.py:169-199); here ``feature=`` / ``weights_path=`` build the
     bundled flax extractor at the equivalent tap. An explicitly injected
     extractor keeps precedence and cannot be combined with the sugar.
+
+    ``allowed`` restricts ``feature=`` to the calling metric's
+    reference-valid set (the reference's FID takes only int tap widths,
+    fid.py:172-186, while IS/KID also take 'logits_unbiased',
+    inception.py:121-131 / kid.py:190-199); an injected extractor callable
+    remains the escape hatch for anything else, e.g. raw logits or the
+    pooled features under a different tap.
     """
     if feature is None and weights_path is None:
         return explicit
@@ -221,6 +228,17 @@ def resolve_ctor_extractor(explicit, feature, weights_path, default_output):
         raise ValueError(
             "Pass either an explicit extractor callable or the bundled-network"
             " arguments (`feature=` / `weights_path=`), not both"
+        )
+    if isinstance(feature, np.integer):
+        feature = int(feature)
+    if isinstance(feature, float) and feature.is_integer():
+        # 64.0 would pass `in`-membership by equality but then miss the
+        # extractor's isinstance(int) tap dispatch — normalize it first
+        feature = int(feature)
+    if feature is not None and allowed is not None and feature not in allowed:
+        raise ValueError(
+            f"Argument `feature` must be one of {allowed}, but got {feature!r}."
+            " Inject a `feature_extractor` callable for taps outside the reference's set."
         )
     return InceptionV3FeatureExtractor(
         weights_path=weights_path,

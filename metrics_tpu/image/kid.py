@@ -118,7 +118,9 @@ class KernelInceptionDistance(Metric):
             from metrics_tpu.image.inception_net import resolve_ctor_extractor
 
             feature_extractor = resolve_ctor_extractor(
-                feature_extractor, feature, weights_path, default_output=2048
+                feature_extractor, feature, weights_path, default_output=2048,
+                # ref kid.py:190-199 valid set
+                allowed=("logits_unbiased", 64, 192, 768, 2048),
             )
         self.feature_extractor = feature_extractor
 

@@ -18,6 +18,7 @@ import numpy as np
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.checks import _check_retrieval_inputs
 from metrics_tpu.utilities.data import bucket_pow2, dim_zero_cat
+from metrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
 
@@ -212,6 +213,17 @@ class RetrievalMetric(Metric, ABC):
         Default falls back to looping `_metric` over rows (host loop) — every
         shipped subclass overrides this with a batched implementation.
         """
+        cls = type(self)
+        # own-dict check: an MRO-walking getattr would let a parent's flag
+        # suppress the warning for every distinct slow-path subclass
+        if "_warned_host_loop_fallback" not in cls.__dict__:
+            cls._warned_host_loop_fallback = True
+            rank_zero_warn(
+                f"{cls.__name__} uses the default per-query host loop for `compute` "
+                "(only `_metric` is implemented). Override `_metric_batched` with a "
+                "vectorized (Q, L) implementation to run the fold as one jitted "
+                "device program — every shipped retrieval metric does."
+            )
         scores = []
         for q in range(padded_preds.shape[0]):
             m = np.asarray(valid[q])

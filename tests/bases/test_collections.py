@@ -328,3 +328,72 @@ def test_real_metric_compute_group_matrix(metrics, expected_groups):
     on, off = mc.compute(), mc_off.compute()
     for k in on:
         np.testing.assert_allclose(np.asarray(on[k]), np.asarray(off[k]), rtol=1e-6)
+
+
+# ---- batched group detection + backend-resolved fused default (round 5) ----
+
+
+def test_curve_list_state_group_detection():
+    """List-state (curve) metrics bucket and merge through the batched sweep."""
+    from metrics_tpu import AveragePrecision, PrecisionRecallCurve
+
+    mc = MetricCollection(
+        {"pr": PrecisionRecallCurve(num_classes=3), "ap": AveragePrecision(num_classes=3)},
+        compute_groups=True,
+    )
+    rng = np.random.RandomState(7)
+    logits = rng.rand(16, 3).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, 3, 16))
+    mc.update(preds, target)
+    groups = {frozenset(v) for v in mc.compute_groups.values()}
+    assert frozenset({"pr", "ap"}) in groups
+
+
+def test_batched_leader_equality_matches_pairwise():
+    """The one-sync batched table agrees with the per-pair reference check."""
+    mc = MetricCollection([_StatsA(), _StatsB(), _Other()], compute_groups=True)
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    for _, m in mc.items(keep_base=True):
+        m.update(x)
+    equal = mc._batched_leader_equality()
+    names = list(mc.keys(keep_base=True))
+    for a in names:
+        for b in names:
+            if a == b:
+                continue
+            expected = MetricCollection._equal_metric_states(mc[a], mc[b])
+            assert equal(a, b) == expected, (a, b)
+
+
+def test_fused_default_resolves_by_backend(monkeypatch):
+    """fused_update=None fuses on accelerators, stays eager on CPU."""
+    import jax
+
+    mc_auto = MetricCollection([_StatsA()])
+    mc_on = MetricCollection([_StatsA()], fused_update=True)
+    mc_off = MetricCollection([_StatsA()], fused_update=False)
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert not mc_auto._fusion_enabled
+    assert mc_on._fusion_enabled
+    assert not mc_off._fusion_enabled
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert mc_auto._fusion_enabled
+    assert not mc_off._fusion_enabled
+
+    # a failed fuse pins the collection to eager regardless of backend
+    mc_auto._fuse_failed = True
+    assert not mc_auto._fusion_enabled
+
+
+def test_auto_fused_unfusable_stays_quiet(monkeypatch, recwarn):
+    """Auto mode (user never opted in) must fall back without warning."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    mc = MetricCollection([_StatsA()])
+    mc._fuse_fallback("update", ValueError("boom"))
+    assert mc._fuse_failed
+    assert len(recwarn) == 0

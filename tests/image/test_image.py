@@ -352,5 +352,27 @@ class TestBundledExtractorSugar:
         assert np.isfinite(float(mean))
 
     def test_invalid_tap_rejected(self):
-        with pytest.raises(ValueError, match="output"):
+        with pytest.raises(ValueError, match="feature"):
             FrechetInceptionDistance(feature=512)
+
+    def test_per_metric_reference_valid_sets(self):
+        """`feature=` mirrors each metric's reference-valid set (ADVICE r4):
+        FID is int-tap only (ref fid.py:172-186), IS/KID additionally take
+        'logits_unbiased' (ref inception.py:121-131, kid.py:190-199), and
+        nobody takes 'logits'/'pool' through the sugar."""
+        with pytest.raises(ValueError, match="feature"):
+            FrechetInceptionDistance(feature="logits_unbiased")
+        with pytest.raises(ValueError, match="feature"):
+            FrechetInceptionDistance(feature="pool")
+        with pytest.raises(ValueError, match="feature"):
+            InceptionScore(feature="logits")
+        with pytest.raises(ValueError, match="feature"):
+            KernelInceptionDistance(feature="pool")
+        # the escape hatch for out-of-set taps stays open
+        from metrics_tpu.image.inception_net import InceptionV3FeatureExtractor
+
+        ext = InceptionV3FeatureExtractor(output="logits")
+        m = InceptionScore(logits_extractor=ext, splits=1)
+        m.update(jnp.asarray(np.random.RandomState(3).rand(2, 3, 75, 75), jnp.float32))
+        mean, _ = m.compute()
+        assert np.isfinite(float(mean))
