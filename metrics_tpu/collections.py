@@ -147,11 +147,10 @@ class MetricCollection:
             return jax.default_backend() != "cpu"
         return self._fused_update
 
-    def _fuse_fallback(self, what: str, err: Exception) -> None:
-        msg = (
-            f"MetricCollection could not fuse `{what}` "
-            f"({type(err).__name__}: {err}); falling back to eager dispatch."
-        )
+    def _fuse_fallback(self, what: str, reason: Union[str, Exception]) -> None:
+        if isinstance(reason, Exception):
+            reason = f"{type(reason).__name__}: {reason}"
+        msg = f"MetricCollection could not fuse `{what}` ({reason}); falling back to eager dispatch."
         # auto mode falls back quietly (the user never asked for fusion);
         # an explicit fused_update=True gets a visible warning
         (rank_zero_warn if self._fused_update is True else rank_zero_debug)(msg)
@@ -213,7 +212,7 @@ class MetricCollection:
     def _try_fused_update(self, *args: Any, **kwargs: Any) -> bool:
         try:
             if not self._fusable(args, kwargs):
-                self._fuse_failed = True
+                self._fuse_fallback("update", "unfusable member or non-array inputs")
                 return False
             if self._fused_update_fn is None:
                 self._fused_update_fn = jax.jit(self.pure_update)
@@ -239,7 +238,7 @@ class MetricCollection:
     def _try_fused_forward(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
         try:
             if not self._fusable(args, kwargs):
-                self._fuse_failed = True
+                self._fuse_fallback("forward", "unfusable member or non-array inputs")
                 return None
             if self._fused_forward_fn is None:
                 self._fused_forward_fn = jax.jit(self._fused_forward_impl)

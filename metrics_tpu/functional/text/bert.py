@@ -5,8 +5,10 @@ Behavioral parity: /root/reference/torchmetrics/functional/text/bert.py
 embeddings, greedy max-matching → precision/recall/F1, optional IDF
 weighting) is identical; the embedding model is injectable — any callable
 ``List[str] -> (embeddings (N, L, D), mask (N, L), input_ids (N, L))``.
-Use :func:`transformers_flax_embedder` to wrap a local HF Flax checkpoint
-(the reference hardcodes a torch ``AutoModel`` inference loop,
+Zero-config calls fall back to the bundled deterministic
+:class:`HashEmbedder` (a lexical baseline needing no weight assets); use
+:func:`transformers_flax_embedder` to wrap a local HF Flax checkpoint for
+fidelity (the reference hardcodes a torch ``AutoModel`` inference loop,
 bert.py:136-325; weights are assets the framework does not bundle).
 """
 import math
@@ -91,6 +93,105 @@ def _greedy_cosine_match(
     return precision, recall, f1
 
 
+class HashEmbedder:
+    """Deterministic zero-config embedder: hashed token vectors + local context.
+
+    The reference ships a batteries-included tokenizer+model flow (HF
+    ``AutoModel`` inference loop, ref bert.py:136-325) whose weights are
+    downloadable assets; this environment bundles no checkpoints, so the
+    zero-config default is a *lexical baseline* that needs none: each token
+    maps to a fixed pseudo-random unit vector derived from a BLAKE2b digest
+    of its text (identical across runs, processes, and platforms), mixed
+    with its neighbors' vectors so matching is order-sensitive rather than
+    pure bag-of-words. Exact-match corpora score 1.0, disjoint corpora
+    score near 0, and scores are reproducible — but they are NOT comparable
+    to published BERTScore numbers; inject
+    :func:`transformers_flax_embedder` (a local HF Flax checkpoint) for
+    fidelity.
+
+    Args:
+        dim: embedding width.
+        max_length: token truncation length.
+        context_weight: neighbor-mixing weight (0 = bag-of-words).
+    """
+
+    emits_special_tokens = False  # no [CLS]/[SEP]: positional exclusion must not run
+
+    def __init__(self, dim: int = 128, max_length: int = 128, context_weight: float = 0.3) -> None:
+        self.dim = dim
+        self.max_length = max_length
+        self.context_weight = context_weight
+        self._token_cache: Dict[str, np.ndarray] = {}
+
+    def _token_vector(self, token: str) -> np.ndarray:
+        vec = self._token_cache.get(token)
+        if vec is None:
+            import hashlib
+
+            digest = hashlib.blake2b(token.encode("utf-8"), digest_size=8).digest()
+            seed = int.from_bytes(digest[:4], "little")
+            rng = np.random.RandomState(seed)  # MT19937: stable across platforms
+            vec = rng.standard_normal(self.dim).astype(np.float32)
+            vec /= max(float(np.linalg.norm(vec)), 1e-12)
+            self._token_cache[token] = vec
+        return vec
+
+    def _token_id(self, token: str) -> int:
+        import hashlib
+
+        digest = hashlib.blake2b(token.encode("utf-8"), digest_size=8).digest()
+        return 1 + int.from_bytes(digest[4:8], "little") % (2**30)  # 0 is the pad id
+
+    @staticmethod
+    def tokenize(sentence: str) -> List[str]:
+        import re
+
+        return re.findall(r"\w+|[^\w\s]", sentence.lower())
+
+    def __call__(self, sentences: List[str]) -> Tuple[Array, Array, Array]:
+        token_lists = [self.tokenize(s)[: self.max_length] for s in sentences]
+        length = max(1, max((len(t) for t in token_lists), default=1))
+        n = len(sentences)
+        emb = np.zeros((n, length, self.dim), dtype=np.float32)
+        mask = np.zeros((n, length), dtype=np.int32)
+        ids = np.zeros((n, length), dtype=np.int64)
+        for i, tokens in enumerate(token_lists):
+            if not tokens:
+                continue
+            vecs = np.stack([self._token_vector(t) for t in tokens])
+            mixed = vecs.copy()
+            if self.context_weight and len(tokens) > 1:
+                mixed[1:] += self.context_weight * vecs[:-1]
+                mixed[:-1] += self.context_weight * vecs[1:]
+            emb[i, : len(tokens)] = mixed
+            mask[i, : len(tokens)] = 1
+            ids[i, : len(tokens)] = [self._token_id(t) for t in tokens]
+        return jnp.asarray(emb), jnp.asarray(mask), jnp.asarray(ids)
+
+
+_DEFAULT_EMBEDDER: Optional[HashEmbedder] = None
+_WARNED_DEFAULT_EMBEDDER = False
+
+
+def _default_embedder() -> HashEmbedder:
+    """Process-wide zero-config embedder (token-vector cache shared)."""
+    global _DEFAULT_EMBEDDER, _WARNED_DEFAULT_EMBEDDER
+    if _DEFAULT_EMBEDDER is None:
+        _DEFAULT_EMBEDDER = HashEmbedder()
+    if not _WARNED_DEFAULT_EMBEDDER:
+        _WARNED_DEFAULT_EMBEDDER = True
+        from metrics_tpu.utilities.prints import rank_zero_warn
+
+        rank_zero_warn(
+            "BERTScore is running with the bundled deterministic hash embedder (no"
+            " model assets required). Scores are reproducible lexical-similarity"
+            " values, NOT comparable to published BERTScore numbers — pass"
+            " `embedder=transformers_flax_embedder(path)` or `model_name_or_path=`"
+            " for a real contextual model."
+        )
+    return _DEFAULT_EMBEDDER
+
+
 def transformers_flax_embedder(
     model_name_or_path: str,
     max_length: int = 512,
@@ -144,7 +245,16 @@ def bert_score(
     ``exclude_special_tokens`` applies the reference's rule of dropping
     the [CLS]/[SEP] positions from matching and length normalization
     (live-parity-pinned); set it False for bare embedders whose token
-    streams carry no specials (e.g. the toy embedder below).
+    streams carry no specials (e.g. the toy embedder below). Embedders
+    exposing ``emits_special_tokens = False`` (like the zero-config
+    default) opt out automatically.
+
+    Example (zero-config — bundled deterministic hash embedder):
+        >>> from metrics_tpu.functional.text.bert import bert_score
+        >>> out = bert_score(["hello there", "general kenobi"],
+        ...                  ["hello there", "general kenobi"])
+        >>> [round(float(f), 2) for f in out["f1"]]
+        [1.0, 1.0]
 
     Example (with a toy one-hot embedder):
         >>> import jax, jax.numpy as jnp
@@ -166,15 +276,19 @@ def bert_score(
         raise ValueError("Number of predicted and reference sentences must be the same!")
 
     if embedder is None:
-        if model_name_or_path is None:
-            raise ValueError(
-                "BERTScore requires an embedding model: pass `embedder=` (a callable) or"
-                " `model_name_or_path=` pointing at a local HF Flax checkpoint."
-            )
-        embedder = transformers_flax_embedder(model_name_or_path)
+        if model_name_or_path is not None:
+            embedder = transformers_flax_embedder(model_name_or_path)
+        else:
+            # zero-config default: deterministic hash embedder, no assets
+            embedder = _default_embedder()
 
     pred_emb, pred_mask, pred_ids = embedder(list(preds))
     tgt_emb, tgt_mask, tgt_ids = embedder(list(target))
+    # embedders that emit no [CLS]/[SEP] (e.g. the hash default) opt out of
+    # the positional special-token exclusion, which would otherwise zero
+    # real content tokens
+    if not getattr(embedder, "emits_special_tokens", True):
+        exclude_special_tokens = False
     if exclude_special_tokens:
         pred_mask = _exclude_special_tokens(pred_mask)
         tgt_mask = _exclude_special_tokens(tgt_mask)

@@ -231,7 +231,7 @@ def resolve_ctor_extractor(explicit, feature, weights_path, default_output, allo
         )
     if isinstance(feature, np.integer):
         feature = int(feature)
-    if isinstance(feature, float) and feature.is_integer():
+    if isinstance(feature, (float, np.floating)) and float(feature).is_integer():
         # 64.0 would pass `in`-membership by equality but then miss the
         # extractor's isinstance(int) tap dispatch — normalize it first
         feature = int(feature)

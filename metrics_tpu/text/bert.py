@@ -2,8 +2,10 @@
 
 Accumulates raw sentences on host (the reference stores tokenized
 input_ids/attention_mask list states); embedding + matching run at compute.
-The embedder is injectable — see
-:func:`metrics_tpu.functional.text.bert.transformers_flax_embedder`.
+Zero-config (``BERTScore()``) uses the bundled deterministic hash embedder
+(no weight assets); the embedder is injectable — see
+:func:`metrics_tpu.functional.text.bert.transformers_flax_embedder` for
+wrapping a real HF Flax checkpoint.
 """
 from typing import Any, Dict, List, Optional, Union
 
@@ -22,14 +24,11 @@ class BERTScore(Metric):
     device states); cross-process sync of raw strings is not supported —
     compute per process or pre-gather the text.
 
-    Example (toy embedder; use ``transformers_flax_embedder`` for real runs):
-        >>> import jax, jax.numpy as jnp
+    Example (zero-config: the bundled deterministic hash embedder — a
+    reproducible lexical baseline; inject ``transformers_flax_embedder``
+    for scores comparable to published BERTScore):
         >>> from metrics_tpu import BERTScore
-        >>> def toy_embedder(sents):
-        ...     ids = jnp.asarray([[ord(w[0]) % 64 for w in s.split()] + [0] * (4 - len(s.split()))
-        ...                        for s in sents])
-        ...     return jax.nn.one_hot(ids, 64), (ids > 0).astype(jnp.int32), ids
-        >>> m = BERTScore(embedder=toy_embedder)
+        >>> m = BERTScore()
         >>> m.update(["the cat sat"], ["the cat sat"])
         >>> {k: round(float(v.mean()), 2) for k, v in sorted(m.compute().items())}
         {'f1': 1.0, 'precision': 1.0, 'recall': 1.0}

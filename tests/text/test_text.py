@@ -332,7 +332,7 @@ class TestBERTScore:
         assert 0.0 <= float(out["recall"][0]) <= 1.0
         assert float(out["f1"][0]) == 0.0
 
-    def test_module_and_requires_embedder(self):
+    def test_module_and_zero_config_default(self):
         from metrics_tpu import BERTScore
 
         m = BERTScore(embedder=self._toy_embedder, exclude_special_tokens=False)
@@ -340,10 +340,11 @@ class TestBERTScore:
         out = m.compute()  # module compute squeezes size-1 results to scalars
         np.testing.assert_allclose(float(out["f1"]), 1.0, atol=1e-6)
 
+        # zero-config falls back to the bundled deterministic hash embedder
+        # (VERDICT r4 #6) instead of raising
         m2 = BERTScore()
         m2.update(["x"], ["x"])
-        with pytest.raises(ValueError, match="embedding model"):
-            m2.compute()
+        np.testing.assert_allclose(float(m2.compute()["f1"]), 1.0, atol=1e-5)
 
     def test_idf(self):
         from metrics_tpu.functional import bert_score
