@@ -121,11 +121,12 @@ class HashEmbedder:
         self.dim = dim
         self.max_length = max_length
         self.context_weight = context_weight
-        self._token_cache: Dict[str, np.ndarray] = {}
+        self._token_cache: Dict[str, Tuple[np.ndarray, int]] = {}
 
-    def _token_vector(self, token: str) -> np.ndarray:
-        vec = self._token_cache.get(token)
-        if vec is None:
+    def _token_entry(self, token: str) -> Tuple[np.ndarray, int]:
+        """(unit vector, id) per token — one BLAKE2b digest per unique token."""
+        entry = self._token_cache.get(token)
+        if entry is None:
             import hashlib
 
             digest = hashlib.blake2b(token.encode("utf-8"), digest_size=8).digest()
@@ -133,14 +134,10 @@ class HashEmbedder:
             rng = np.random.RandomState(seed)  # MT19937: stable across platforms
             vec = rng.standard_normal(self.dim).astype(np.float32)
             vec /= max(float(np.linalg.norm(vec)), 1e-12)
-            self._token_cache[token] = vec
-        return vec
-
-    def _token_id(self, token: str) -> int:
-        import hashlib
-
-        digest = hashlib.blake2b(token.encode("utf-8"), digest_size=8).digest()
-        return 1 + int.from_bytes(digest[4:8], "little") % (2**30)  # 0 is the pad id
+            token_id = 1 + int.from_bytes(digest[4:8], "little") % (2**30)  # 0 is the pad id
+            entry = (vec, token_id)
+            self._token_cache[token] = entry
+        return entry
 
     @staticmethod
     def tokenize(sentence: str) -> List[str]:
@@ -158,14 +155,15 @@ class HashEmbedder:
         for i, tokens in enumerate(token_lists):
             if not tokens:
                 continue
-            vecs = np.stack([self._token_vector(t) for t in tokens])
+            entries = [self._token_entry(t) for t in tokens]
+            vecs = np.stack([v for v, _ in entries])
             mixed = vecs.copy()
             if self.context_weight and len(tokens) > 1:
                 mixed[1:] += self.context_weight * vecs[:-1]
                 mixed[:-1] += self.context_weight * vecs[1:]
             emb[i, : len(tokens)] = mixed
             mask[i, : len(tokens)] = 1
-            ids[i, : len(tokens)] = [self._token_id(t) for t in tokens]
+            ids[i, : len(tokens)] = [tid for _, tid in entries]
         return jnp.asarray(emb), jnp.asarray(mask), jnp.asarray(ids)
 
 

@@ -261,3 +261,51 @@ class TestFunctionalAndModule:
             m.update(jnp.asarray(p), jnp.asarray(t))
             per_sample.append(np.asarray(perceptual_evaluation_speech_quality(jnp.asarray(p), jnp.asarray(t), 8000, "nb")))
         np.testing.assert_allclose(float(m.compute()), np.concatenate(per_sample).mean(), rtol=1e-6)
+
+
+class TestItuTables:
+    """Internal-consistency verification of the transcribed ITU P.862
+    narrowband tables (VERDICT r4 #5). Each property is one a digit-level
+    mis-transcription cannot survive, so the battery certifies the tables
+    without needing the pesq package as an oracle."""
+
+    def test_bark_centres_match_width_ladder(self):
+        from metrics_tpu.functional.audio._pesq_core import (
+            _NB_CENTRE_BARK,
+            _NB_WIDTH_BARK,
+        )
+
+        edges = np.concatenate([[0.0], np.cumsum(_NB_WIDTH_BARK)])
+        mid = 0.5 * (edges[1:] + edges[:-1])
+        np.testing.assert_allclose(mid, _NB_CENTRE_BARK, atol=4e-6)
+
+    def test_centre_pairs_decode_modified_bark_scale(self):
+        """P.862's bark scale is linear at 100 Hz/bark through the low
+        bands, then smoothly super-linear."""
+        from metrics_tpu.functional.audio._pesq_core import (
+            _NB_CENTRE_BARK,
+            _NB_CENTRE_HZ,
+        )
+
+        slope = np.diff(_NB_CENTRE_HZ) / np.diff(_NB_CENTRE_BARK)
+        np.testing.assert_allclose(slope[:13], 100.0, atol=0.05)
+        assert np.all(np.diff(slope) > -0.5)  # monotone non-decreasing
+
+    def test_abs_threshold_decodes_to_round_db(self):
+        """The ITU threshold powers are 10^(dB/10) of one-decimal dB values."""
+        from metrics_tpu.functional.audio._pesq_core import _NB_ABS_THRESH_POWER
+
+        db = 10.0 * np.log10(_NB_ABS_THRESH_POWER)
+        np.testing.assert_allclose(db, np.round(db, 1), atol=2e-4)
+
+    def test_band_edges_tile_the_bark_ladder(self):
+        from metrics_tpu.functional.audio._pesq_core import (
+            _NB_CENTRE_HZ,
+            _nb_band_edges_hz,
+        )
+
+        edges = _nb_band_edges_hz()
+        assert edges.shape == (43,)
+        assert np.all(np.diff(edges) > 0)
+        # each centre sits inside its band
+        assert np.all(edges[:-1] < _NB_CENTRE_HZ) and np.all(_NB_CENTRE_HZ < edges[1:])
