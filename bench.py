@@ -328,24 +328,34 @@ def _cfg_retrieval(detail: dict) -> None:
     detail["retrieval_map_compute_ms_100k_rows"] = round(best * 1e3, 1)
 
 
+def _synth_coco_image(rng):
+    """One synthetic image at maxDet density (100 dets / 30 gts) — shared by
+    the 100-image and 5k-image configs so their scaling comparison can never
+    silently measure different workloads."""
+    import jax.numpy as jnp
+
+    boxes = rng.rand(100, 4).astype(np.float32) * 100
+    boxes[:, 2:] += boxes[:, :2] + 5
+    gt = rng.rand(30, 4).astype(np.float32) * 100
+    gt[:, 2:] += gt[:, :2] + 5
+    pred = dict(boxes=jnp.asarray(boxes), scores=jnp.asarray(rng.rand(100).astype(np.float32)),
+                labels=jnp.asarray(rng.randint(0, 10, 100)))
+    targ = dict(boxes=jnp.asarray(gt), labels=jnp.asarray(rng.randint(0, 10, 30)))
+    return pred, targ
+
+
 def _cfg_coco(detail: dict, python_baseline: bool = False) -> None:
     """COCO mAP at maxDet density: 100 images x 100 dets / 30 gts; with
     ``python_baseline`` also times the numpy-fallback matcher (the
     reference's per-threshold Python-loop protocol)."""
-    import jax.numpy as jnp
-
     from metrics_tpu.detection import MeanAveragePrecision
 
     rng = np.random.RandomState(3)
     coco_preds, coco_targs = [], []
     for _ in range(100):
-        boxes = rng.rand(100, 4).astype(np.float32) * 100
-        boxes[:, 2:] += boxes[:, :2] + 5
-        gt = rng.rand(30, 4).astype(np.float32) * 100
-        gt[:, 2:] += gt[:, :2] + 5
-        coco_preds.append(dict(boxes=jnp.asarray(boxes), scores=jnp.asarray(rng.rand(100).astype(np.float32)),
-                               labels=jnp.asarray(rng.randint(0, 10, 100))))
-        coco_targs.append(dict(boxes=jnp.asarray(gt), labels=jnp.asarray(rng.randint(0, 10, 30))))
+        pred, targ = _synth_coco_image(rng)
+        coco_preds.append(pred)
+        coco_targs.append(targ)
     m = MeanAveragePrecision()
     m.update(coco_preds, coco_targs)
     m.compute()  # warm: one-time fetch/jit costs paid before either timing
@@ -367,6 +377,33 @@ def _cfg_coco(detail: dict, python_baseline: bool = False) -> None:
         detail["coco_map_python_matcher_baseline_s"] = round(time.perf_counter() - t0, 2)
     finally:
         _native_mod.coco_match = _orig_match
+
+
+def _cfg_coco_5k(detail: dict, n_images: int = 5000) -> None:
+    """COCO mAP at dataset scale (VERDICT r4 #8): 5k images — the size of
+    COCO val2017 — at maxDet density, to establish whether the host-side
+    C++ matcher + numpy accumulation keeps scaling linearly past the
+    100-image config (if it does, there is no crossover to justify a
+    device-side mAP path at real dataset sizes)."""
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.RandomState(9)
+    m = MeanAveragePrecision()
+    batch_p, batch_t = [], []
+    for i in range(n_images):
+        pred, targ = _synth_coco_image(rng)
+        batch_p.append(pred)
+        batch_t.append(targ)
+        if len(batch_p) == 500:  # update in dataloader-sized chunks
+            m.update(batch_p, batch_t)
+            batch_p, batch_t = [], []
+    if batch_p:
+        m.update(batch_p, batch_t)
+    m.compute()  # warm: same protocol as the 100-image config
+    m._computed = None
+    t0 = time.perf_counter()
+    m.compute()
+    detail[f"coco_map_compute_s_{n_images // 1000}k_images"] = round(time.perf_counter() - t0, 2)
 
 
 def _cfg_fid_stream(detail: dict) -> None:
@@ -545,6 +582,8 @@ def _bench_detail() -> dict:
     _mark("retrieval_map_compute_ms_100k_rows")
     _cfg_coco(detail, python_baseline=True)
     _mark("coco_map_compute_s_100_images")
+    _cfg_coco_5k(detail)
+    _mark("coco_map_compute_s_5k_images")
     _cfg_fid_stream(detail)
     _mark("fid_compute_s_moments_5k_feats")
     _cfg_kid_compute(detail)

@@ -574,6 +574,54 @@ class Metric(ABC):
             )
             del input_dict[attr]
 
+        # Generic list states and per-rank emptiness: an empty list on ONE
+        # rank while peers hold data would silently desynchronize the
+        # collective schedule (the empty rank has no array to contribute
+        # and no declared placeholder shape/dtype) — a deadlock, not an
+        # error, under a process-level gather. A tiny count pre-gather
+        # (uniform across ranks, so the schedule stays aligned) separates
+        # the three cases: all-empty is a legitimate no-op (state stays
+        # []), mixed emptiness fails loudly on EVERY rank with the fix,
+        # and the all-nonempty common case proceeds to the data gather.
+        # Inside a trace (AxisEnv under shard_map) one trace serves every
+        # shard, so emptiness cannot differ — the pre-gather is skipped
+        # for non-empty traced lists and discarded for empty ones (same
+        # pattern as _gather_ragged).
+        if will_communicate:
+            probe_attrs = [
+                attr
+                for attr, value in input_dict.items()
+                if isinstance(value, list)
+                # single trace: schedules agree by construction, skip the probe
+                and not (value and any(isinstance(v, jax.core.Tracer) for v in value))
+            ]
+            if probe_attrs:
+                # ALL counts cross in one int32-vector collective (the
+                # lengths_group amortization of _gather_ragged, applied here)
+                counts_vec = base_gather(
+                    jnp.asarray([len(input_dict[a]) for a in probe_attrs], jnp.int32)
+                )
+                if not any(isinstance(c, jax.core.Tracer) for c in counts_vec):
+                    per_rank = [np.asarray(c).astype(int) for c in counts_vec]
+                    for i, attr in enumerate(probe_attrs):
+                        counts = [int(r[i]) for r in per_rank]
+                        if max(counts) == 0:
+                            object.__setattr__(self, attr, [])
+                            del input_dict[attr]
+                        elif min(counts) == 0:
+                            raise MetricsUserError(
+                                f"Cross-process sync of list state `{attr}`: some ranks"
+                                f" never updated it (per-rank element counts {counts})."
+                                " A generic list state needs at least one element on"
+                                " every rank — either ensure every rank updates, or"
+                                " declare `_ragged_state_specs` for it (a"
+                                " (trailing_shape, dtype) spec lets empty ranks join"
+                                " the collectives — see detection/mean_ap.py and"
+                                " retrieval/base.py)."
+                            )
+                # else: empty list inside a trace — identical on every shard,
+                # the probe is discarded
+
         for attr in input_dict:
             # pre-concatenate list states to reduce number of collectives
             if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:

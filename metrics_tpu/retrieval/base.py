@@ -97,6 +97,20 @@ class RetrievalMetric(Metric, ABC):
         self.add_state("indexes", default=[], dist_reduce_fx=None)
         self.add_state("preds", default=[], dist_reduce_fx=None)
         self.add_state("target", default=[], dist_reduce_fx=None)
+        # Ragged sync specs (same protocol as detection, metric.py
+        # _gather_ragged): a rank holding zero rows — normal for a sharded
+        # eval where one process saw no queries — still joins every
+        # collective via the declared placeholder. All three states share
+        # per-update lengths ("rows"), so one lengths collective serves
+        # them. Dtypes: indexes are int32 after _check_retrieval_inputs;
+        # preds/target cross as float32 (binary {0,1} and NDCG grade
+        # targets are exact in f32; under x64 the cast only affects the
+        # transient synced copy — unsync restores the local state).
+        self._ragged_state_specs = {
+            "indexes": ((), jnp.int32, "rows"),
+            "preds": ((), jnp.float32, "rows"),
+            "target": ((), jnp.float32, "rows"),
+        }
 
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         """Validate, flatten, and append (ref base.py:101-112)."""

@@ -30,6 +30,9 @@ def _dataset():
     preds = logits / logits.sum(-1, keepdims=True)
     target = rng.randint(0, c, n)
     cat_values = np.arange(1.0, 11.0, dtype=np.float32)
+    # regression pair for the bf16-compressed collective leg
+    reg_preds = rng.rand(n).astype(np.float32) * 3.0
+    reg_target = reg_preds + rng.randn(n).astype(np.float32) * 0.3
 
     det_preds, det_targs = [], []
     for i in range(4):
@@ -41,15 +44,31 @@ def _dataset():
         det_preds.append(dict(boxes=boxes, scores=rng.rand(nb).astype(np.float32),
                               labels=rng.randint(0, 3, nb)))
         det_targs.append(dict(boxes=gt, labels=rng.randint(0, 3, 2)))
-    return preds, target, cat_values, det_preds, det_targs
+
+    # retrieval: 6 queries of 3-5 docs each, flattened per query so shards
+    # can split on query boundaries
+    ret_queries = []
+    for q in range(6):
+        nd = 3 + (q % 3)
+        ret_queries.append(dict(
+            indexes=np.full(nd, q, dtype=np.int64),
+            preds=rng.rand(nd).astype(np.float32),
+            target=(rng.rand(nd) > 0.6).astype(np.int64),
+        ))
+    # every query needs at least one positive doc (avoids empty_target_action)
+    for q in ret_queries:
+        q["target"][0] = 1
+    return preds, target, cat_values, det_preds, det_targs, reg_preds, reg_target, ret_queries
 
 
 def _splits(mode):
-    """(acc split, cat split, detection split) as index boundaries for rank 0."""
+    """(acc split, cat split, detection split, retrieval-query split) as
+    index boundaries for rank 0. ``zero`` gives rank 0 no detection images
+    AND no retrieval queries (empty ragged + empty list-state gathers)."""
     return {
-        "even": (12, 5, 2),
-        "uneven": (5, 2, 1),
-        "zero": (5, 2, 0),
+        "even": (12, 5, 2, 3),
+        "uneven": (5, 2, 1, 1),
+        "zero": (5, 2, 0, 0),
     }[mode]
 
 
@@ -71,8 +90,8 @@ def main():
         "process_count": jax.process_count(),
     }
 
-    preds, target, cat_values, det_preds, det_targs = _dataset()
-    acc_b, cat_b, det_b = _splits(mode)
+    preds, target, cat_values, det_preds, det_targs, reg_preds, reg_target, ret_queries = _dataset()
+    acc_b, cat_b, det_b, ret_b = _splits(mode)
 
     def shard(seq, boundary):
         return seq[:boundary] if process_id == 0 else seq[boundary:]
@@ -85,6 +104,49 @@ def main():
     cat.update(jnp.asarray(shard(cat_values, cat_b)))
     result["cat"] = [float(v) for v in jnp.ravel(cat.compute())]
 
+    import numpy as np
+
+    from metrics_tpu import BinnedPrecisionRecallCurve, MeanSquaredError, PrecisionRecallCurve, SumMetric
+    from metrics_tpu.retrieval import RetrievalMAP
+
+    # scalar state over the wire
+    s = SumMetric()
+    s.update(jnp.asarray(shard(cat_values, cat_b)))
+    result["sum"] = float(s.compute())
+
+    # fixed-shape (C, T) binned curve states, sum-reduced
+    binned = BinnedPrecisionRecallCurve(num_classes=4, thresholds=16)
+    binned.update(jnp.asarray(shard(preds, acc_b)), jnp.asarray(shard(target, acc_b)))
+    b_prec, b_rec, b_thr = binned.compute()
+    result["binned"] = [np.asarray(b_prec).tolist(), np.asarray(b_rec).tolist(),
+                        np.asarray(b_thr).tolist()]
+
+    # curve LIST states (two ragged leaves: (B, C) preds + (B,) target)
+    pr = PrecisionRecallCurve(num_classes=4)
+    pr.update(jnp.asarray(shard(preds, acc_b)), jnp.asarray(shard(target, acc_b)))
+    p_prec, p_rec, p_thr = pr.compute()
+    result["pr_curve"] = [
+        [np.asarray(x).tolist() for x in p_prec],
+        [np.asarray(x).tolist() for x in p_rec],
+        [np.asarray(x).tolist() for x in p_thr],
+    ]
+
+    # retrieval list states incl. query indexes (global regrouping after sync)
+    rm = RetrievalMAP()
+    my_queries = shard(ret_queries, ret_b)
+    if my_queries:
+        rm.update(
+            jnp.asarray(np.concatenate([q["preds"] for q in my_queries])),
+            jnp.asarray(np.concatenate([q["target"] for q in my_queries])),
+            indexes=jnp.asarray(np.concatenate([q["indexes"] for q in my_queries])),
+        )
+    result["retrieval_map"] = float(rm.compute())
+
+    # bf16-compressed DCN collective (float state compressed, count exact)
+    mse = MeanSquaredError(sync_dtype=jnp.bfloat16)
+    mse.update(jnp.asarray(shard(reg_preds, acc_b)), jnp.asarray(shard(reg_target, acc_b)))
+    result["mse_bf16"] = float(mse.compute())
+
     m = MeanAveragePrecision()
     my_preds, my_targs = shard(det_preds, det_b), shard(det_targs, det_b)
     if my_preds:
@@ -92,8 +154,6 @@ def main():
             [{k: jnp.asarray(v) for k, v in p.items()} for p in my_preds],
             [{k: jnp.asarray(v) for k, v in t.items()} for t in my_targs],
         )
-    import numpy as np
-
     result["map"] = {k: np.asarray(v).tolist() for k, v in m.compute().items()}
     # sync must not have destroyed the local state (compute unsyncs)
     result["local_images_after_compute"] = len(m.detection_boxes)

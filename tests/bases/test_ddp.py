@@ -361,8 +361,9 @@ def test_sync_dtype_never_compresses_sample_states():
     m = M()
     m.update(jnp.full(4, 1000.5))  # 1000.5 is not bf16-representable
     m._sync_dist(m.dist_sync_fn, env=NoOpEnv())
-    # list state crossed as f32; scalar sum state compressed to bf16
-    assert sorted(seen) == ["bfloat16", "float32"]
+    # list state crossed as f32 (plus its int32 emptiness pre-gather, never
+    # compressed); scalar sum state compressed to bf16
+    assert sorted(seen) == ["bfloat16", "float32", "int32"]
     np.testing.assert_allclose(np.asarray(m.samples), np.full(8, 1000.5))
 
 
@@ -429,3 +430,58 @@ class TestRaggedSync:
         assert len(int_lengths) == 4
         # total collectives: 2 lengths + 5 data = 7 (not 5 lengths + 5 data)
         assert len(gathered_shapes) == 7
+
+
+def test_empty_list_state_sync_all_empty_is_noop():
+    """Every rank empty -> the count pre-gather agrees on 0 and the state
+    legitimately stays [] (no data collective is issued)."""
+    issued = []
+
+    def gather(x, env):
+        issued.append(tuple(x.shape))
+        return [x, x]  # both ranks identical (this one is empty)
+
+    class M(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__(dist_sync_fn=gather)
+            self.add_state("samples", [], dist_reduce_fx="cat")
+
+        def update(self, x):
+            self.samples.append(x)
+
+        def compute(self):
+            return len(self.samples)
+
+    m = M()
+    m._sync_dist(m.dist_sync_fn, env=NoOpEnv())
+    assert m.samples == []
+    assert issued == [(1,)]  # exactly one count-vector gather, no data gather
+
+
+def test_empty_list_state_sync_mixed_emptiness_raises():
+    """One rank empty while a peer holds data: fail loudly (the old generic
+    path silently desynchronized the collective schedule -> deadlock)."""
+    from metrics_tpu.utilities.exceptions import MetricsUserError
+
+    def gather(x, env):
+        # simulate the peer reporting 3 elements in the count pre-gather
+        return [x, jnp.asarray([3], jnp.int32)]
+
+    class M(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__(dist_sync_fn=gather)
+            self.add_state("samples", [], dist_reduce_fx="cat")
+
+        def update(self, x):
+            self.samples.append(x)
+
+        def compute(self):
+            return len(self.samples)
+
+    m = M()
+    with pytest.raises(MetricsUserError, match="_ragged_state_specs"):
+        m._sync_dist(m.dist_sync_fn, env=NoOpEnv())

@@ -71,10 +71,18 @@ def _run_two_processes(mode, timeout=240):
 
 
 def _single_process_expected(mode):
-    from metrics_tpu import Accuracy, CatMetric
+    from metrics_tpu import (
+        Accuracy,
+        BinnedPrecisionRecallCurve,
+        CatMetric,
+        MeanSquaredError,
+        PrecisionRecallCurve,
+        SumMetric,
+    )
     from metrics_tpu.detection import MeanAveragePrecision
+    from metrics_tpu.retrieval import RetrievalMAP
 
-    preds, target, cat_values, det_preds, det_targs = _dataset()
+    preds, target, cat_values, det_preds, det_targs, reg_preds, reg_target, ret_queries = _dataset()
     acc = Accuracy(num_classes=4, average="macro")
     acc.update(jnp.asarray(preds), jnp.asarray(target))
     cat = CatMetric()
@@ -84,10 +92,36 @@ def _single_process_expected(mode):
         [{k: jnp.asarray(v) for k, v in p.items()} for p in det_preds],
         [{k: jnp.asarray(v) for k, v in t.items()} for t in det_targs],
     )
+    s = SumMetric()
+    s.update(jnp.asarray(cat_values))
+    binned = BinnedPrecisionRecallCurve(num_classes=4, thresholds=16)
+    binned.update(jnp.asarray(preds), jnp.asarray(target))
+    b_prec, b_rec, b_thr = binned.compute()
+    pr = PrecisionRecallCurve(num_classes=4)
+    pr.update(jnp.asarray(preds), jnp.asarray(target))
+    p_prec, p_rec, p_thr = pr.compute()
+    rm = RetrievalMAP()
+    rm.update(
+        jnp.asarray(np.concatenate([q["preds"] for q in ret_queries])),
+        jnp.asarray(np.concatenate([q["target"] for q in ret_queries])),
+        indexes=jnp.asarray(np.concatenate([q["indexes"] for q in ret_queries])),
+    )
+    mse = MeanSquaredError()  # full precision: the bf16 leg must land nearby
+    mse.update(jnp.asarray(reg_preds), jnp.asarray(reg_target))
     return {
         "accuracy": float(acc.compute()),
         "cat": [float(v) for v in jnp.ravel(cat.compute())],
         "map": {k: np.asarray(v).tolist() for k, v in m.compute().items()},
+        "sum": float(s.compute()),
+        "binned": [np.asarray(b_prec).tolist(), np.asarray(b_rec).tolist(),
+                   np.asarray(b_thr).tolist()],
+        "pr_curve": [
+            [np.asarray(x).tolist() for x in p_prec],
+            [np.asarray(x).tolist() for x in p_rec],
+            [np.asarray(x).tolist() for x in p_thr],
+        ],
+        "retrieval_map": float(rm.compute()),
+        "mse_bf16": float(mse.compute()),
     }
 
 
@@ -98,7 +132,7 @@ def test_two_process_sync_matches_single_process(mode):
 
     from process_env_worker import _splits
 
-    _, _, det_b = _splits(mode)
+    _, _, det_b, _ = _splits(mode)
     for rank, res in enumerate(results):
         # the ambient env actually was the process-level one, world 2
         assert res["env"] == "ProcessEnv", res
@@ -118,3 +152,24 @@ def test_two_process_sync_matches_single_process(mode):
         # compute()'s sync_context unsynced back to the local shard
         local_images = det_b if rank == 0 else 4 - det_b
         assert res["local_images_after_compute"] == local_images
+
+        # scalar state
+        np.testing.assert_allclose(res["sum"], expected["sum"], atol=1e-6)
+
+        # fixed-shape (C, T) binned curve states
+        for got, want in zip(res["binned"], expected["binned"]):
+            np.testing.assert_allclose(got, want, atol=1e-6)
+
+        # curve list states: two ragged leaves concatenated across ranks;
+        # per-class threshold counts are data-dependent, so shapes matching
+        # is itself part of the assertion
+        for got_cls, want_cls in zip(res["pr_curve"], expected["pr_curve"]):
+            assert len(got_cls) == len(want_cls)
+            for got, want in zip(got_cls, want_cls):
+                np.testing.assert_allclose(got, want, atol=1e-6)
+
+        # retrieval list states incl. indexes: global query regrouping
+        np.testing.assert_allclose(res["retrieval_map"], expected["retrieval_map"], atol=1e-6)
+
+        # bf16-compressed collective: within bf16 rounding of full precision
+        np.testing.assert_allclose(res["mse_bf16"], expected["mse_bf16"], rtol=2e-2)
