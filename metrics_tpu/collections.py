@@ -215,7 +215,12 @@ class MetricCollection:
                 self._fuse_fallback("update", "unfusable member or non-array inputs")
                 return False
             if self._fused_update_fn is None:
-                self._fused_update_fn = jax.jit(self.pure_update)
+                # the state pytree fed in is the copy state() returns, owned
+                # by this call alone — donating it lets XLA write the new
+                # accumulators in place instead of allocating fresh buffers
+                # every step (CPU has no donation support and would warn)
+                donate = (0,) if jax.default_backend() != "cpu" else ()
+                self._fused_update_fn = jax.jit(self.pure_update, donate_argnums=donate)
             new_states = self._fused_update_fn(self.state(), *args, **kwargs)
         except Exception as err:
             self._fuse_fallback("update", err)
@@ -241,7 +246,8 @@ class MetricCollection:
                 self._fuse_fallback("forward", "unfusable member or non-array inputs")
                 return None
             if self._fused_forward_fn is None:
-                self._fused_forward_fn = jax.jit(self._fused_forward_impl)
+                donate = (0,) if jax.default_backend() != "cpu" else ()
+                self._fused_forward_fn = jax.jit(self._fused_forward_impl, donate_argnums=donate)
             # merge counts ride as traced leaves so growing counts don't retrace
             counts = {
                 name: jnp.asarray(m._update_count + 1, dtype=jnp.float32)

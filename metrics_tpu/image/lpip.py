@@ -2,10 +2,11 @@
 
 Behavioral parity: /root/reference/torchmetrics/image/lpip.py (149 LoC). The
 reference wraps the ``lpips`` package's pretrained AlexNet/VGG/SqueezeNet
-(lpip.py:25-40). Here ``net_type='alex'|'vgg'`` builds the bundled Flax
-LPIPS network (:class:`metrics_tpu.image.lpips_net.LPIPSNet`; pretrained
-weights load from a local ``.npz`` via ``weights_path``), and ``net`` stays
-injectable for any callable ``(img1, img2) -> (N,)`` per-pair distances.
+(lpip.py:25-40). Here ``net_type='alex'|'vgg'|'squeeze'`` builds the
+bundled Flax LPIPS network (:class:`metrics_tpu.image.lpips_net.LPIPSNet`;
+pretrained weights load from a local ``.npz`` via ``weights_path``), and
+``net`` stays injectable for any callable ``(img1, img2) -> (N,)``
+per-pair distances.
 """
 from typing import Any, Callable, Optional
 
@@ -23,8 +24,9 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
     Args:
         net: callable ``(img1, img2) -> (N,)`` perceptual distances; takes
             precedence over ``net_type`` when given.
-        net_type: 'alex' | 'vgg' — builds the bundled Flax LPIPS network
-            (requires flax).
+        net_type: 'alex' | 'vgg' | 'squeeze' — builds the bundled Flax
+            LPIPS network (requires flax; the reference's valid set,
+            ref lpip.py:84-90).
         weights_path: local ``.npz`` of LPIPS weights for ``net_type``.
         reduction: 'mean' | 'sum' over the accumulated per-pair scores.
 

@@ -1,4 +1,4 @@
-"""Flax LPIPS perceptual network (AlexNet / VGG16 backbones).
+"""Flax LPIPS perceptual network (AlexNet / VGG16 / SqueezeNet backbones).
 
 TPU-native replacement for the ``lpips`` package the reference wraps
 (/root/reference/torchmetrics/image/lpip.py:23-36). Same computation as
@@ -70,6 +70,76 @@ class VGG16Features(nn.Module):
         return taps
 
 
+class _Fire(nn.Module):
+    """SqueezeNet fire module: 1x1 squeeze -> parallel 1x1/3x3 expands."""
+
+    squeeze_ch: int
+    expand_ch: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        s = nn.relu(nn.Conv(self.squeeze_ch, (1, 1), dtype=self.dtype, name="squeeze")(x))
+        e1 = nn.Conv(self.expand_ch, (1, 1), dtype=self.dtype, name="expand1x1")(s)
+        e3 = nn.Conv(self.expand_ch, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, name="expand3x3")(s)
+        return jnp.concatenate([nn.relu(e1), nn.relu(e3)], axis=-1)
+
+
+def _max_pool_ceil(x: Array) -> Array:
+    """3x3/stride-2 max pool with torch's ceil_mode=True semantics.
+
+    torchvision's SqueezeNet pools with ceil_mode=True; when the input
+    doesn't tile evenly the partial window still produces an output
+    element. Shapes are static at trace time, so the pad amounts are
+    plain Python; -inf padding never wins a max over real (post-ReLU)
+    activations, which is exactly torch's ignore-out-of-bounds behavior.
+    """
+    h, w = x.shape[1], x.shape[2]
+    ph = (2 - (h - 3) % 2) % 2
+    pw = (2 - (w - 3) % 2) % 2
+    if ph or pw:
+        x = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)), constant_values=-jnp.inf)
+    return nn.max_pool(x, (3, 3), strides=(2, 2))
+
+
+class SqueezeNetFeatures(nn.Module):
+    """SqueezeNet 1.1 trunk tapped at the lpips package's seven slices.
+
+    Slice boundaries follow lpips' ``pretrained_networks.squeezenet``
+    (features[0:2], [2:5], [5:8], [8:10], [10:11], [11:12], [12:13]),
+    giving tap widths (64, 128, 256, 384, 384, 512, 512).
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        taps = []
+        x = nn.relu(nn.Conv(64, (3, 3), strides=(2, 2), padding="VALID", dtype=self.dtype)(x))
+        taps.append(x)  # slice1: conv1+relu
+        x = _max_pool_ceil(x)
+        x = _Fire(16, 64, name="Fire_0", dtype=self.dtype)(x)
+        x = _Fire(16, 64, name="Fire_1", dtype=self.dtype)(x)
+        taps.append(x)  # slice2: pool + fire1 + fire2
+        x = _max_pool_ceil(x)
+        x = _Fire(32, 128, name="Fire_2", dtype=self.dtype)(x)
+        x = _Fire(32, 128, name="Fire_3", dtype=self.dtype)(x)
+        taps.append(x)  # slice3: pool + fire3 + fire4
+        x = _max_pool_ceil(x)
+        x = _Fire(48, 192, name="Fire_4", dtype=self.dtype)(x)
+        taps.append(x)  # slice4: pool + fire5
+        x = _Fire(48, 192, name="Fire_5", dtype=self.dtype)(x)
+        taps.append(x)  # slice5: fire6
+        x = _Fire(64, 256, name="Fire_6", dtype=self.dtype)(x)
+        taps.append(x)  # slice6: fire7
+        x = _Fire(64, 256, name="Fire_7", dtype=self.dtype)(x)
+        taps.append(x)  # slice7: fire8
+        return taps
+
+
+_BACKBONES = {"alex": AlexNetFeatures, "vgg": VGG16Features, "squeeze": SqueezeNetFeatures}
+
+
 class _LPIPSModule(nn.Module):
     """Scaling layer + backbone + per-tap lin heads on normalized sq-diffs."""
 
@@ -80,7 +150,7 @@ class _LPIPSModule(nn.Module):
     def __call__(self, img1: Array, img2: Array) -> Array:
         shift = jnp.asarray(_SHIFT).reshape(1, 1, 1, 3)
         scale = jnp.asarray(_SCALE).reshape(1, 1, 1, 3)
-        backbone = (AlexNetFeatures if self.net_type == "alex" else VGG16Features)(dtype=self.dtype)
+        backbone = _BACKBONES[self.net_type](dtype=self.dtype)
         taps1 = backbone((img1 - shift) / scale)
         taps2 = backbone((img2 - shift) / scale)
 
@@ -104,7 +174,9 @@ class LPIPSNet:
     contract, lpip.py:39-41).
 
     Args:
-        net_type: 'alex' (fast, LPIPS default for eval) or 'vgg'.
+        net_type: 'alex' (fast, LPIPS default for eval), 'vgg', or
+            'squeeze' — the reference's three valid backbones
+            (ref lpip.py:84-90).
         weights_path: local ``.npz`` of flax variables; ``None`` ->
             deterministic random init.
         dtype: compute dtype for the backbone (``jnp.bfloat16`` for MXU-
@@ -118,10 +190,10 @@ class LPIPSNet:
         weights_path: Optional[str] = None,
         dtype: Any = jnp.float32,
     ) -> None:
-        if net_type not in ("alex", "vgg"):
-            raise ValueError(f"Argument `net_type` must be 'alex' or 'vgg', got {net_type}")
+        if net_type not in ("alex", "vgg", "squeeze"):
+            raise ValueError(f"Argument `net_type` must be 'alex', 'vgg' or 'squeeze', got {net_type}")
         self.net = _LPIPSModule(net_type=net_type, dtype=dtype)
-        init_hw = 64 if net_type == "alex" else 32
+        init_hw = 32 if net_type == "vgg" else 64
         if weights_path is not None:
             self.variables = load_params(weights_path)
         else:

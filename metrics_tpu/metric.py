@@ -565,14 +565,6 @@ class Metric(ABC):
         # varies per process with the string-hash seed (observed as a gloo
         # byte-size mismatch between two otherwise identical workers)
         ragged_attrs = [a for a in ragged_specs if isinstance(input_dict.get(a), list)]
-        lengths_cache: Dict[str, Any] = {}
-        for attr in ragged_attrs:
-            object.__setattr__(
-                self,
-                attr,
-                self._gather_ragged(attr, input_dict[attr], base_gather, lengths_cache),
-            )
-            del input_dict[attr]
 
         # Generic list states and per-rank emptiness: an empty list on ONE
         # rank while peers hold data would silently desynchronize the
@@ -586,12 +578,15 @@ class Metric(ABC):
         # Inside a trace (AxisEnv under shard_map) one trace serves every
         # shard, so emptiness cannot differ — the pre-gather is skipped
         # for non-empty traced lists and discarded for empty ones (same
-        # pattern as _gather_ragged).
+        # pattern as _gather_ragged). The probe runs BEFORE the ragged
+        # gathers below: a raise here must leave every state untouched, so
+        # sync() can propagate the error with nothing to roll back.
         if will_communicate:
             probe_attrs = [
                 attr
                 for attr, value in input_dict.items()
                 if isinstance(value, list)
+                and attr not in ragged_attrs  # ragged specs handle emptiness
                 # single trace: schedules agree by construction, skip the probe
                 and not (value and any(isinstance(v, jax.core.Tracer) for v in value))
             ]
@@ -621,6 +616,15 @@ class Metric(ABC):
                             )
                 # else: empty list inside a trace — identical on every shard,
                 # the probe is discarded
+
+        lengths_cache: Dict[str, Any] = {}
+        for attr in ragged_attrs:
+            object.__setattr__(
+                self,
+                attr,
+                self._gather_ragged(attr, input_dict[attr], base_gather, lengths_cache),
+            )
+            del input_dict[attr]
 
         for attr in input_dict:
             # pre-concatenate list states to reduce number of collectives

@@ -297,6 +297,43 @@ def _torch_vgg_taps(backbone, x):
     return taps
 
 
+def _torch_fire(backbone, idx, x):
+    s = F.relu(F.conv2d(x, backbone[f"{idx}.squeeze.weight"], backbone[f"{idx}.squeeze.bias"]))
+    e1 = F.relu(F.conv2d(s, backbone[f"{idx}.expand1x1.weight"], backbone[f"{idx}.expand1x1.bias"]))
+    e3 = F.relu(F.conv2d(s, backbone[f"{idx}.expand3x3.weight"], backbone[f"{idx}.expand3x3.bias"], padding=1))
+    return torch.cat([e1, e3], 1)
+
+
+def _torch_squeeze_taps(backbone, x):
+    """squeezenet1_1 features sliced at lpips' seven boundaries
+    (pretrained_networks.squeezenet: [0:2],[2:5],[5:8],[8:10],[10:11],
+    [11:12],[12:13]); pools are ceil_mode=True like torchvision's."""
+    taps = []
+    x = F.relu(F.conv2d(x, backbone["0.weight"], backbone["0.bias"], stride=2))
+    taps.append(x)
+    x = F.max_pool2d(x, 3, 2, ceil_mode=True)
+    x = _torch_fire(backbone, 3, x)
+    x = _torch_fire(backbone, 4, x)
+    taps.append(x)
+    x = F.max_pool2d(x, 3, 2, ceil_mode=True)
+    x = _torch_fire(backbone, 6, x)
+    x = _torch_fire(backbone, 7, x)
+    taps.append(x)
+    x = F.max_pool2d(x, 3, 2, ceil_mode=True)
+    x = _torch_fire(backbone, 9, x)
+    taps.append(x)
+    x = _torch_fire(backbone, 10, x)
+    taps.append(x)
+    x = _torch_fire(backbone, 11, x)
+    taps.append(x)
+    x = _torch_fire(backbone, 12, x)
+    taps.append(x)
+    return taps
+
+
+_TAP_FNS = {"alex": _torch_alex_taps, "vgg": _torch_vgg_taps, "squeeze": _torch_squeeze_taps}
+
+
 def _torch_lpips(backbone, lins, net, x1, x2, dtype=torch.float32):
     """lpips-package forward: scale, tap, unit-normalize, lin, mean, sum.
 
@@ -304,7 +341,7 @@ def _torch_lpips(backbone, lins, net, x1, x2, dtype=torch.float32):
     f64 weights/inputs with ``dtype=torch.float64`` for an all-f64 run
     (the end-to-end metric parity test does).
     """
-    tap_fn = _torch_alex_taps if net == "alex" else _torch_vgg_taps
+    tap_fn = _TAP_FNS[net]
     with torch.no_grad():
         # constants built from the literals at the target dtype (a widened
         # f32 constant differs from the flax side's native-f64 parse)
@@ -322,24 +359,54 @@ def _torch_lpips(backbone, lins, net, x1, x2, dtype=torch.float32):
     return total.numpy()
 
 
+# squeezenet1_1 fire layout: features index -> (in_ch, squeeze_ch, expand_ch)
+_SQUEEZE_FIRE_SHAPES = {
+    3: (64, 16, 64), 4: (128, 16, 64),
+    6: (128, 32, 128), 7: (256, 32, 128),
+    9: (256, 48, 192), 10: (384, 48, 192),
+    11: (384, 64, 256), 12: (512, 64, 256),
+}
+
+
+def _synth_conv(rng, o, i, k):
+    w = torch.from_numpy((0.3 / np.sqrt(i * k * k) * rng.randn(o, i, k, k)).astype(np.float32))
+    b = torch.from_numpy(0.1 * rng.randn(o).astype(np.float32))
+    return w, b
+
+
 def _make_lpips_state(net, seed):
     rng = np.random.RandomState(seed)
-    shapes = {
-        "alex": [(64, 3, 11), (192, 64, 5), (384, 192, 3), (256, 384, 3), (256, 256, 3)],
-        "vgg": [
-            (64, 3, 3), (64, 64, 3), (128, 64, 3), (128, 128, 3),
-            (256, 128, 3), (256, 256, 3), (256, 256, 3),
-            (512, 256, 3), (512, 512, 3), (512, 512, 3),
-            (512, 512, 3), (512, 512, 3), (512, 512, 3),
-        ],
-    }[net]
     backbone = {}
-    for conv_idx, (o, i, k) in zip(_BACKBONE_CONVS[net], shapes):
-        backbone[f"{conv_idx}.weight"] = torch.from_numpy(
-            (0.3 / np.sqrt(i * k * k) * rng.randn(o, i, k, k)).astype(np.float32)
-        )
-        backbone[f"{conv_idx}.bias"] = torch.from_numpy(0.1 * rng.randn(o).astype(np.float32))
-    tap_widths = {"alex": [64, 192, 384, 256, 256], "vgg": [64, 128, 256, 512, 512]}[net]
+    if net == "squeeze":
+        backbone["0.weight"], backbone["0.bias"] = _synth_conv(rng, 64, 3, 3)
+        for idx, (in_ch, s_ch, e_ch) in _SQUEEZE_FIRE_SHAPES.items():
+            for sub, (o, i, k) in (
+                ("squeeze", (s_ch, in_ch, 1)),
+                ("expand1x1", (e_ch, s_ch, 1)),
+                ("expand3x3", (e_ch, s_ch, 3)),
+            ):
+                w, b = _synth_conv(rng, o, i, k)
+                backbone[f"{idx}.{sub}.weight"] = w
+                backbone[f"{idx}.{sub}.bias"] = b
+    else:
+        shapes = {
+            "alex": [(64, 3, 11), (192, 64, 5), (384, 192, 3), (256, 384, 3), (256, 256, 3)],
+            "vgg": [
+                (64, 3, 3), (64, 64, 3), (128, 64, 3), (128, 128, 3),
+                (256, 128, 3), (256, 256, 3), (256, 256, 3),
+                (512, 256, 3), (512, 512, 3), (512, 512, 3),
+                (512, 512, 3), (512, 512, 3), (512, 512, 3),
+            ],
+        }[net]
+        for conv_idx, (o, i, k) in zip(_BACKBONE_CONVS[net], shapes):
+            w, b = _synth_conv(rng, o, i, k)
+            backbone[f"{conv_idx}.weight"] = w
+            backbone[f"{conv_idx}.bias"] = b
+    tap_widths = {
+        "alex": [64, 192, 384, 256, 256],
+        "vgg": [64, 128, 256, 512, 512],
+        "squeeze": [64, 128, 256, 384, 384, 512, 512],
+    }[net]
     lins = {
         f"lin{li}.model.1.weight": torch.from_numpy(
             np.abs(rng.randn(1, c, 1, 1)).astype(np.float32)
@@ -349,7 +416,7 @@ def _make_lpips_state(net, seed):
     return backbone, lins
 
 
-@pytest.mark.parametrize("net", ["alex", "vgg"])
+@pytest.mark.parametrize("net", ["alex", "vgg", "squeeze"])
 def test_lpips_full_forward_matches_torch(net):
     """Both LPIPS backbones end-to-end: scaling layer, every conv/pool
     stage, channel unit-normalization, lin heads, spatial averaging."""

@@ -310,7 +310,14 @@ class TestGenerativeMetrics:
 
     def test_lpips_bad_net_type(self):
         with pytest.raises(ValueError, match="net_type"):
-            LearnedPerceptualImagePatchSimilarity(net_type="squeeze")
+            LearnedPerceptualImagePatchSimilarity(net_type="resnet")
+
+    def test_lpips_squeeze_net_type(self):
+        # the reference's third valid backbone (ref lpip.py:84-90)
+        lpips = LearnedPerceptualImagePatchSimilarity(net_type="squeeze")
+        img1 = jnp.asarray(np.random.RandomState(0).rand(2, 3, 64, 64) * 2 - 1, jnp.float32)
+        img2 = jnp.asarray(np.random.RandomState(1).rand(2, 3, 64, 64) * 2 - 1, jnp.float32)
+        assert float(lpips(img1, img2)) > 0
 
     def test_lpips_with_net(self):
         l2_net = lambda a, b: jnp.square(a - b).mean(axis=(1, 2, 3))

@@ -45,7 +45,26 @@ def test_weights_roundtrip(tmp_path):
 
 def test_invalid_net_type_raises():
     with pytest.raises(ValueError, match="net_type"):
-        LPIPSNet(net_type="squeeze")
+        LPIPSNet(net_type="resnet")
+
+
+def test_squeeze_backbone_builds_and_scores():
+    """'squeeze' completes the reference's valid net_type set (ref
+    lpip.py:84-90): seven taps at widths (64,128,256,384,384,512,512)."""
+    from metrics_tpu.image.lpips_net import SqueezeNetFeatures
+
+    net = LPIPSNet(net_type="squeeze")
+    val = np.asarray(net(jnp.asarray(IMGS), jnp.asarray(-IMGS)))
+    assert val.shape == (IMGS.shape[0],)
+    assert np.all(np.isfinite(val))
+
+    import jax
+
+    taps = SqueezeNetFeatures().apply(
+        SqueezeNetFeatures().init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3))),
+        jnp.zeros((1, 64, 64, 3)),
+    )
+    assert [t.shape[-1] for t in taps] == [64, 128, 256, 384, 384, 512, 512]
 
 
 def test_metric_builds_bundled_net():

@@ -25,18 +25,39 @@ _BACKBONE_CONVS = {
     "alex": [0, 3, 6, 8, 10],
     "vgg": [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28],
 }
-_TRUNK_NAME = {"alex": "AlexNetFeatures_0", "vgg": "VGG16Features_0"}
+# torchvision squeezenet1_1 features index of each fire module -> flax Fire_i
+_SQUEEZE_FIRES = [3, 4, 6, 7, 9, 10, 11, 12]
+_TRUNK_NAME = {
+    "alex": "AlexNetFeatures_0",
+    "vgg": "VGG16Features_0",
+    "squeeze": "SqueezeNetFeatures_0",
+}
+_NUM_LINS = {"alex": 5, "vgg": 5, "squeeze": 7}
+
+
+def _put_conv(flat: dict, prefix: str, w, b=None) -> None:
+    flat[f"{prefix}/kernel"] = np.transpose(np.asarray(w, dtype=np.float32), (2, 3, 1, 0)).copy()
+    if b is not None:
+        flat[f"{prefix}/bias"] = np.asarray(b, dtype=np.float32)
 
 
 def convert(backbone_state: dict, lins_state: dict, net: str) -> dict:
     trunk = _TRUNK_NAME[net]
     flat = {}
-    for i, conv_idx in enumerate(_BACKBONE_CONVS[net]):
-        w = np.asarray(backbone_state[f"{conv_idx}.weight"], dtype=np.float32)
-        b = np.asarray(backbone_state[f"{conv_idx}.bias"], dtype=np.float32)
-        flat[f"params/{trunk}/Conv_{i}/kernel"] = np.transpose(w, (2, 3, 1, 0)).copy()
-        flat[f"params/{trunk}/Conv_{i}/bias"] = b
-    for i in range(5):
+    if net == "squeeze":
+        _put_conv(flat, f"params/{trunk}/Conv_0",
+                  backbone_state["0.weight"], backbone_state["0.bias"])
+        for i, idx in enumerate(_SQUEEZE_FIRES):
+            for sub in ("squeeze", "expand1x1", "expand3x3"):
+                _put_conv(flat, f"params/{trunk}/Fire_{i}/{sub}",
+                          backbone_state[f"{idx}.{sub}.weight"],
+                          backbone_state[f"{idx}.{sub}.bias"])
+    else:
+        for i, conv_idx in enumerate(_BACKBONE_CONVS[net]):
+            _put_conv(flat, f"params/{trunk}/Conv_{i}",
+                      backbone_state[f"{conv_idx}.weight"],
+                      backbone_state[f"{conv_idx}.bias"])
+    for i in range(_NUM_LINS[net]):
         w = np.asarray(lins_state[f"lin{i}.model.1.weight"], dtype=np.float32)
         flat[f"params/lin{i}/kernel"] = np.transpose(w, (2, 3, 1, 0)).copy()
     return flat
@@ -49,7 +70,7 @@ def validate(flat: dict, net: str) -> None:
 
     from metrics_tpu.image.lpips_net import _LPIPSModule
 
-    hw = 64 if net == "alex" else 32
+    hw = 32 if net == "vgg" else 64
     dummy = jnp.zeros((1, hw, hw, 3))
     expected = jax.eval_shape(
         lambda: _LPIPSModule(net_type=net).init(jax.random.PRNGKey(0), dummy, dummy)
@@ -69,7 +90,7 @@ def validate(flat: dict, net: str) -> None:
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    parser.add_argument("--net", choices=("alex", "vgg"), required=True)
+    parser.add_argument("--net", choices=("alex", "vgg", "squeeze"), required=True)
     parser.add_argument("--backbone", required=True, help="torchvision features state dict (.pth)")
     parser.add_argument("--lins", required=True, help="lpips v0.1 checkpoint (.pth)")
     parser.add_argument("out_npz")
