@@ -421,8 +421,11 @@ class MetricCollection:
         res = _flatten_dict({name: m.pure_compute(states[name]) for name, m in self.items(keep_base=True)})
         return {self._set_name(k): v for k, v in res.items()}
 
-    def pure_sync(self, states: Dict[str, Dict[str, Any]], axis_name: str) -> Dict[str, Dict[str, Any]]:
-        """Cross-device sync of every metric's state over a mesh axis."""
+    def pure_sync(
+        self, states: Dict[str, Dict[str, Any]], axis_name: Union[str, Tuple[str, ...]]
+    ) -> Dict[str, Dict[str, Any]]:
+        """Cross-device sync of every metric's state over a mesh axis (or
+        an axis tuple for one collective over several axes at once)."""
         return {name: m.pure_sync(states[name], axis_name) for name, m in self.items(keep_base=True)}
 
     def scan_update(self, states: Dict[str, Dict[str, Any]], *batched_args: Any, **batched_kwargs: Any) -> Dict[str, Dict[str, Any]]:

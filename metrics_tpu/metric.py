@@ -335,12 +335,17 @@ class Metric(ABC):
             self._update_count = saved_count  # may be a traced count on error
             self._load_state(saved)
 
-    def pure_sync(self, state: Dict[str, StateType], axis_name: str) -> Dict[str, StateType]:
+    def pure_sync(
+        self, state: Dict[str, StateType], axis_name: Union[str, Tuple[str, ...]]
+    ) -> Dict[str, StateType]:
         """Cross-device state sync usable **inside** ``shard_map``/``pmap``.
 
         Lowers to XLA all-gathers over the named mesh axis (ICI) followed by
         the per-state reductions — the jitted equivalent of ref
-        metric.py:243-268 + utilities/distributed.py:96-151.
+        metric.py:243-268 + utilities/distributed.py:96-151. ``axis_name``
+        may be a tuple of axis names for one collective over several mesh
+        axes at once (e.g. ``("dp", "sp")`` for batch- and sequence-sharded
+        updates — see docs/distributed.md, sequence parallelism).
         """
         env = AxisEnv(axis_name)
         saved = self._copy_state()

@@ -62,10 +62,13 @@ class AxisEnv(DistEnv):
     """Collectives over a named mesh axis inside ``shard_map``/``pmap``.
 
     Must only be used while tracing inside the SPMD region; ``all_gather``
-    lowers to an XLA all-gather over ICI.
+    lowers to an XLA all-gather over ICI. ``axis_name`` may be a tuple of
+    axis names for one collective over several mesh axes at once (jax
+    collectives accept axis tuples) — the sequence-parallel pattern in
+    docs/distributed.md.
     """
 
-    def __init__(self, axis_name: str = "batch"):
+    def __init__(self, axis_name: "str | tuple" = "batch"):
         self.axis_name = axis_name
 
     def world_size(self) -> int:

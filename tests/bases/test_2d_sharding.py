@@ -233,3 +233,103 @@ def test_matmul_update_matches_bincount_all_modes():
         a = _confusion_matrix_update(preds, target, C)
         b = _confusion_matrix_update_matmul(preds, target, C)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---- sequence parallelism: long-sequence token metrics (round 5) ----
+#
+# The framework's long-context axis (SURVEY §5.7): token-level metrics
+# over sequences too long for one device evaluate with the BATCH over
+# `dp` and the SEQUENCE over `sp` — each device updates from its
+# (B/dp, S/sp) token block, and one collective over BOTH axes merges the
+# associative stat-score sums. No ring/all-to-all machinery is needed:
+# metric reductions are order-free, so the joint-axis psum IS the
+# sequence-parallel protocol.
+
+
+def _mesh_dp_sp():
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 devices (root conftest forces 8 host devices)")
+    return Mesh(np.array(devices[:8]).reshape(2, 4), ("dp", "sp"))
+
+
+def test_sequence_parallel_token_accuracy():
+    """Token accuracy over (B, S) sharded on batch x sequence equals the
+    single-device full-sequence value; sync is one collective over the
+    joint ("dp", "sp") axis tuple."""
+    from metrics_tpu import Accuracy
+
+    num_classes = 6
+    b, s = 4, 32  # 8 tokens per device along the sequence axis
+    rng = np.random.RandomState(11)
+    logits = rng.rand(b, s, num_classes).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, num_classes, (b, s)))
+
+    metric = Accuracy(num_classes=num_classes, average="macro")
+
+    def worker(st, p, t):
+        # each shard flattens ITS token block; the sums merge associatively
+        st = metric.pure_update(st, p.reshape(-1, num_classes), t.reshape(-1))
+        return metric.pure_sync(st, ("dp", "sp"))
+
+    state = metric.state()
+    specs = jax.tree_util.tree_map(lambda _: P(), state)
+    step = jax.jit(
+        shard_map(
+            worker,
+            mesh=_mesh_dp_sp(),
+            in_specs=(specs, P("dp", "sp", None), P("dp", "sp")),
+            out_specs=specs,
+            check_vma=False,
+        )
+    )
+    synced = step(state, preds, target)
+    dist_val = float(metric.pure_compute(synced))
+
+    full = metric.pure_update(metric.state(), preds.reshape(-1, num_classes), target.reshape(-1))
+    np.testing.assert_allclose(dist_val, float(metric.pure_compute(full)), rtol=1e-6)
+
+
+def test_sequence_parallel_binned_curve_3d_mesh():
+    """dp x sp x cp: batch- and sequence-sharded updates into a
+    class-sharded (C/cp, T) binned state — the full long-context +
+    huge-C composition. Sync rides ("dp", "sp"); the class axis never
+    communicates."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices (root conftest forces 8 host devices)")
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2), ("dp", "sp", "cp"))
+    num_classes, thresholds = 4, 8
+    b, s = 4, 8
+    rng = np.random.RandomState(12)
+    # multilabel token scores: (B, S, C) in [0, 1], targets 0/1
+    preds = jnp.asarray(rng.rand(b, s, num_classes).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, (b, s, num_classes)))
+
+    metric = BinnedPrecisionRecallCurve(num_classes=num_classes, thresholds=thresholds)
+
+    def worker(st, p, t):
+        st = metric.pure_update(st, p.reshape(-1, p.shape[-1]), t.reshape(-1, t.shape[-1]))
+        return metric.pure_sync(st, ("dp", "sp"))
+
+    state = metric.state()
+    specs = jax.tree_util.tree_map(lambda _: P("cp"), state)
+    step = jax.jit(
+        shard_map(
+            worker,
+            mesh=mesh,
+            in_specs=(specs, P("dp", "sp", "cp"), P("dp", "sp", "cp")),
+            out_specs=specs,
+            check_vma=False,
+        )
+    )
+    synced = step(state, preds, target)
+
+    full = metric.pure_update(
+        metric.state(), preds.reshape(-1, num_classes), target.reshape(-1, num_classes)
+    )
+    for a, b_ in zip(
+        jax.tree_util.tree_leaves(metric.pure_compute(synced)),
+        jax.tree_util.tree_leaves(metric.pure_compute(full)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-6)
