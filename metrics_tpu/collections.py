@@ -19,7 +19,7 @@ from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tupl
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.metric import Metric, _raise_if_list_state, _scan_fold
+from metrics_tpu.metric import Metric, _donation_argnums, _raise_if_list_state, _scan_fold
 from metrics_tpu.utilities.data import _flatten_dict, _squeeze_if_scalar
 from metrics_tpu.utilities.prints import rank_zero_debug, rank_zero_warn
 
@@ -215,12 +215,7 @@ class MetricCollection:
                 self._fuse_fallback("update", "unfusable member or non-array inputs")
                 return False
             if self._fused_update_fn is None:
-                # the state pytree fed in is the copy state() returns, owned
-                # by this call alone — donating it lets XLA write the new
-                # accumulators in place instead of allocating fresh buffers
-                # every step (CPU has no donation support and would warn)
-                donate = (0,) if jax.default_backend() != "cpu" else ()
-                self._fused_update_fn = jax.jit(self.pure_update, donate_argnums=donate)
+                self._fused_update_fn = jax.jit(self.pure_update, donate_argnums=_donation_argnums())
             new_states = self._fused_update_fn(self.state(), *args, **kwargs)
         except Exception as err:
             self._fuse_fallback("update", err)
@@ -246,8 +241,7 @@ class MetricCollection:
                 self._fuse_fallback("forward", "unfusable member or non-array inputs")
                 return None
             if self._fused_forward_fn is None:
-                donate = (0,) if jax.default_backend() != "cpu" else ()
-                self._fused_forward_fn = jax.jit(self._fused_forward_impl, donate_argnums=donate)
+                self._fused_forward_fn = jax.jit(self._fused_forward_impl, donate_argnums=_donation_argnums())
             # merge counts ride as traced leaves so growing counts don't retrace
             counts = {
                 name: jnp.asarray(m._update_count + 1, dtype=jnp.float32)

@@ -65,6 +65,18 @@ def jit_distributed_available() -> bool:
     return default_env().is_distributed()
 
 
+def _donation_argnums() -> Tuple[int, ...]:
+    """``donate_argnums`` for jitted ``(state, batch) -> state`` reducers.
+
+    The state pytrees fed to these jits are the copies ``state()`` returns,
+    owned by the call alone — donating them lets XLA write the new
+    accumulators in place instead of allocating fresh buffers each step.
+    CPU has no donation support and would emit a warning per compile, so
+    the policy is decided once here for every donation site.
+    """
+    return (0,) if jax.default_backend() != "cpu" else ()
+
+
 def _raise_if_list_state(defaults: Dict[str, Any], owner: str) -> None:
     """Scan-safety guard shared by Metric/MetricCollection ``scan_update``."""
     for name, default in defaults.items():
@@ -513,7 +525,10 @@ class Metric(ABC):
                         self._jitted_update = {}
                     fn = self._jitted_update.get(key)
                     if fn is None:
-                        fn = self._jitted_update[key] = jax.jit(functools.partial(self.pure_update, **static))
+                        fn = self._jitted_update[key] = jax.jit(
+                            functools.partial(self.pure_update, **static),
+                            donate_argnums=_donation_argnums(),
+                        )
                     new_state = fn(self.state(), *args, **dynamic)
                     self._load_state(new_state)
                 else:

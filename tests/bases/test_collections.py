@@ -397,3 +397,86 @@ def test_auto_fused_unfusable_stays_quiet(monkeypatch, recwarn):
     mc._fuse_fallback("update", ValueError("boom"))
     assert mc._fuse_failed
     assert len(recwarn) == 0
+
+
+def test_batched_leader_equality_fuzz():
+    """Property fuzz: the one-sync batched table must agree with the
+    per-pair reference check (`_equal_metric_states`, ref semantics) over
+    randomized state contents — including NaNs (never equal under
+    allclose), mixed dtypes within a layout bucket, near-equal values at
+    the allclose tolerance boundary, and list states."""
+    rng = np.random.RandomState(99)
+
+    class _TensorState(Metric):
+        full_state_update = False
+
+        def __init__(self, shape, dtype):
+            super().__init__()
+            self.add_state("a", jnp.zeros(shape, dtype), dist_reduce_fx="sum")
+            self.add_state("b", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+        def update(self, *_):
+            pass
+
+        def compute(self):
+            return self.b
+
+    class _ListState(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("vals", [], dist_reduce_fx="cat")
+
+        def update(self, *_):
+            pass
+
+        def compute(self):
+            return jnp.zeros(())
+
+    for trial in range(25):
+        mc = MetricCollection.__new__(MetricCollection)
+        mc._modules = {}
+        mods = {}
+        n = rng.randint(2, 7)
+        base = rng.randn(3).astype(np.float32)
+        for i in range(n):
+            kind = rng.randint(0, 4)
+            if kind == 0:  # shared (3,) layout, values equal / close / NaN / off
+                m = _TensorState((3,), jnp.float32 if rng.rand() < 0.7 else jnp.float64)
+                variant = rng.randint(0, 5)
+                vals = {
+                    0: base,
+                    # perturbations scaled to allclose's rtol=1e-5 so both
+                    # sides of the tolerance boundary are really exercised
+                    1: base * (1 + 0.5e-5),   # inside the relative tolerance
+                    2: base + np.nan,          # NaN never equal
+                    3: base + rng.rand() + 0.1,
+                    4: base * (1 + 5e-5),      # OUTSIDE the relative tolerance
+                }[variant]
+                object.__setattr__(m, "a", jnp.asarray(vals))
+                object.__setattr__(m, "b", jnp.asarray(float(rng.randint(0, 2)), jnp.float32))
+            elif kind == 1:  # distinct layout bucket
+                m = _TensorState((rng.randint(4, 7),), jnp.float32)
+                object.__setattr__(m, "a", jnp.asarray(rng.randn(m.a.shape[0]), jnp.float32))
+            elif kind == 2:  # list states, 0-2 elements
+                m = _ListState()
+                n_el = rng.randint(0, 3)
+                object.__setattr__(
+                    m, "vals", [jnp.asarray(base if rng.rand() < 0.5 else rng.randn(3), jnp.float32)
+                                for _ in range(n_el)]
+                )
+            else:  # scalar-only layout
+                m = _TensorState((), jnp.float32)
+                object.__setattr__(m, "b", jnp.asarray(float(rng.randint(0, 2)), jnp.float32))
+            mods[f"m{i}"] = m
+        mc._modules = mods
+        mc._groups = {i: [k] for i, k in enumerate(mods)}
+
+        equal = mc._batched_leader_equality()
+        for a in mods:
+            for b in mods:
+                if a == b:
+                    continue
+                expected = MetricCollection._equal_metric_states(mods[a], mods[b])
+                assert equal(a, b) == expected, (trial, a, b)
