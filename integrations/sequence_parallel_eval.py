@@ -1,0 +1,112 @@
+"""Long-sequence token-metric evaluation on a dp x sp mesh.
+
+The framework's long-context axis (SURVEY §5.7, docs/distributed.md):
+token-level metrics over sequences too long for one device shard the
+BATCH over `dp` and the SEQUENCE over `sp`. Each device updates from its
+(B/dp, S/sp) token block and ONE collective over the joint ("dp", "sp")
+axis tuple merges the associative stat-score sums — metric reductions are
+order-free, so the joint psum is the whole sequence-parallel protocol (no
+ring or all-to-all machinery). Numerics are identical to the full-sequence
+single-device path (tests/bases/test_2d_sharding.py pins this).
+
+Run: python integrations/sequence_parallel_eval.py
+"""
+
+# allow running uninstalled: put the repo root on sys.path
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU mesh demo (same program rides ICI on a real slice); config API, not
+# env vars — see conftest.py for why
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import Accuracy, F1Score, MetricCollection
+
+NUM_CLASSES = 6
+BATCH = 4        # sharded 2-way over dp
+SEQ_LEN = 4096   # sharded 4-way over sp: each device scores 1024 tokens
+N_BATCHES = 3
+
+
+def main() -> None:
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "sp"))
+    suite = MetricCollection(
+        {
+            "token_acc": Accuracy(num_classes=NUM_CLASSES, average="macro"),
+            "token_f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+        },
+        compute_groups=False,
+    )
+    states = suite.state()
+
+    def worker(states, preds, target):
+        # flatten THIS device's (B/dp, S/sp) token block and fold it in;
+        # then one collective over both mesh axes merges every shard
+        states = suite.pure_update(
+            states, preds.reshape(-1, NUM_CLASSES), target.reshape(-1)
+        )
+        return suite.pure_sync(states, ("dp", "sp"))
+
+    specs = jax.tree_util.tree_map(lambda _: P(), states)
+    step = jax.jit(
+        shard_map(
+            worker,
+            mesh=mesh,
+            in_specs=(specs, P("dp", "sp", None), P("dp", "sp")),
+            out_specs=specs,
+            check_vma=False,
+        )
+    )
+
+    rng = np.random.RandomState(0)
+    flat_preds, flat_target = [], []
+    merged = states
+    for b in range(N_BATCHES):
+        logits = rng.rand(BATCH, SEQ_LEN, NUM_CLASSES).astype(np.float32)
+        preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, (BATCH, SEQ_LEN)))
+        # the LOOP pattern (docs/distributed.md): each step syncs ITS
+        # batch's delta from a fresh state, and the already-synced epoch
+        # state merges the deltas — re-syncing a carried state would
+        # re-add prior totals once per shard every step
+        batch_synced = step(states, preds, target)
+        merged = batch_synced if b == 0 else suite.pure_merge(merged, batch_synced)
+        flat_preds.append(np.asarray(preds).reshape(-1, NUM_CLASSES))
+        flat_target.append(np.asarray(target).reshape(-1))
+
+    out = suite.pure_compute(merged)
+    print({k: round(float(v), 6) for k, v in out.items()})
+
+    # verify the whole epoch against an unsharded full-sequence evaluation
+    verify = MetricCollection(
+        {
+            "token_acc": Accuracy(num_classes=NUM_CLASSES, average="macro"),
+            "token_f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+        },
+        compute_groups=False,
+        fused_update=False,
+    )
+    verify.update(
+        jnp.asarray(np.concatenate(flat_preds)), jnp.asarray(np.concatenate(flat_target))
+    )
+    ref = verify.compute()
+    for k in out:
+        np.testing.assert_allclose(
+            np.asarray(out[k]), np.asarray(ref[k]), rtol=1e-6, err_msg=k
+        )
+    print("sequence-parallel eval ok (matches full-sequence single-device)")
+
+
+if __name__ == "__main__":
+    main()

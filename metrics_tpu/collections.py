@@ -410,6 +410,26 @@ class MetricCollection:
             for name, m in self.items(keep_base=True)
         }
 
+    def pure_merge(
+        self,
+        states_a: Dict[str, Dict[str, Any]],
+        states_b: Dict[str, Dict[str, Any]],
+        counts: Any = 2,
+    ) -> Dict[str, Dict[str, Any]]:
+        """Merge two partial state pytrees member-wise (the collection
+        counterpart of :meth:`Metric.pure_merge` — the delta+merge loop
+        pattern of docs/distributed.md). ``counts`` is either one value for
+        every member or a ``{name: count}`` dict; it only matters for
+        ``mean``-reduced states."""
+        return {
+            name: m.pure_merge(
+                states_a[name],
+                states_b[name],
+                count=counts[name] if isinstance(counts, dict) else counts,
+            )
+            for name, m in self.items(keep_base=True)
+        }
+
     def pure_compute(self, states: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         """Values for every metric from a state pytree (prefix/postfix applied)."""
         res = _flatten_dict({name: m.pure_compute(states[name]) for name, m in self.items(keep_base=True)})

@@ -176,3 +176,10 @@ def test_multihost_example_runs():
     local processes reproduce the single-process value (uneven shards,
     explicit compute group)."""
     _load_example("multihost_eval").main()
+
+
+def test_sequence_parallel_example_runs():
+    """The dp x sp long-sequence example must stay runnable and
+    self-verifying (it asserts the sharded result against an unsharded
+    full-sequence evaluation internally)."""
+    _load_example("sequence_parallel_eval").main()

@@ -2461,3 +2461,60 @@ def test_pairwise_euclidean_diagonal_divergence(reference):
     # off-diagonal values agree
     mask = ~np.eye(6, dtype=bool)
     np.testing.assert_allclose(my_out[mask], ref_out[mask], rtol=1e-4, atol=1e-5)
+
+
+def test_compute_group_formation_matches_reference(reference):
+    """The GROUPS auto-detection discovers — not just the computed values —
+    must match the reference's first-update merge on random suites: the
+    round-5 batched one-sync equality sweep has to reproduce the
+    reference's leader-by-leader allclose semantics exactly (ref
+    collections.py:159-213). Suites deliberately mix members that share
+    state layouts but diverge in value (micro vs macro, different
+    thresholds) with true state-sharers."""
+    import warnings
+
+    import torch
+
+    import metrics_tpu
+
+    rng = np.random.RandomState(7171)
+    c = _C
+    POOL = [
+        ("Accuracy", dict(num_classes=c, average="macro")),
+        ("Precision", dict(num_classes=c, average="macro")),
+        ("Recall", dict(num_classes=c, average="macro")),
+        ("F1Score", dict(num_classes=c, average="macro")),
+        ("Accuracy", dict(num_classes=c, average="micro")),
+        ("Precision", dict(num_classes=c, average="micro")),
+        ("Specificity", dict(num_classes=c, average="weighted")),
+        ("ConfusionMatrix", dict(num_classes=c)),
+        ("CohenKappa", dict(num_classes=c)),
+        ("StatScores", dict(num_classes=c, reduce="macro")),
+    ]
+
+    for i in range(30):
+        k = int(rng.randint(2, 6))
+        picks = [POOL[j] for j in rng.choice(len(POOL), k, replace=False)]
+
+        def build(ns):
+            return ns.MetricCollection(
+                {f"m{j}": getattr(ns, n)(**kw) for j, (n, kw) in enumerate(picks)},
+                compute_groups=True,
+            )
+
+        logits = rng.rand(24, c).astype(np.float32)
+        preds = logits / logits.sum(-1, keepdims=True)
+        target = rng.randint(0, c, 24)
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mine, ref = build(metrics_tpu), build(reference)
+            mine.update(jnp.asarray(preds), jnp.asarray(target))
+            ref.update(torch.from_numpy(preds), torch.from_numpy(target))
+
+        got = {frozenset(v) for v in mine.compute_groups.values()}
+        exp = {frozenset(v) for v in ref.compute_groups.values()}
+        assert got == exp, (
+            f"case {i} picks={[(n, kw.get('average') or kw.get('reduce')) for n, kw in picks]}:"
+            f" groups {sorted(map(sorted, got))} vs reference {sorted(map(sorted, exp))}"
+        )
