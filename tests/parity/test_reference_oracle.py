@@ -2518,3 +2518,75 @@ def test_compute_group_formation_matches_reference(reference):
             f"case {i} picks={[(n, kw.get('average') or kw.get('reduce')) for n, kw in picks]}:"
             f" groups {sorted(map(sorted, got))} vs reference {sorted(map(sorted, exp))}"
         )
+
+
+def test_fused_collection_fuzz_matches_reference(reference):
+    """The fused single-program dispatch — the out-of-box TPU path
+    (fused_update=None resolves to fused on accelerators) — must produce
+    the same forward values, accumulated states, and epoch computes as the
+    torch reference, which only has the eager loop. 15 random suites,
+    forward- and update-driven, with a mid-stream reset."""
+    import warnings
+
+    import torch
+
+    import metrics_tpu
+
+    rng = np.random.RandomState(8181)
+    c = _C
+    POOL = [
+        ("Accuracy", dict(num_classes=c, average="macro")),
+        ("Precision", dict(num_classes=c, average="micro")),
+        ("Recall", dict(num_classes=c, average="macro")),
+        ("F1Score", dict(num_classes=c, average="weighted")),
+        ("ConfusionMatrix", dict(num_classes=c)),
+        ("CohenKappa", dict(num_classes=c)),
+    ]
+
+    for i in range(15):
+        k = int(rng.randint(2, 5))
+        picks = [POOL[j] for j in rng.choice(len(POOL), k, replace=False)]
+
+        mine = metrics_tpu.MetricCollection(
+            {f"m{j}": getattr(metrics_tpu, n)(**kw) for j, (n, kw) in enumerate(picks)},
+            fused_update=True,
+        )
+        ref = reference.MetricCollection(
+            {f"m{j}": getattr(reference, n)(**kw) for j, (n, kw) in enumerate(picks)},
+        )
+
+        n_batches = int(rng.randint(2, 4))
+        reset_at = int(rng.randint(0, n_batches)) if rng.rand() < 0.3 else None
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for b in range(n_batches):
+                logits = rng.rand(24, c).astype(np.float32)
+                preds = logits / logits.sum(-1, keepdims=True)
+                target = rng.randint(0, c, 24)
+                if rng.rand() < 0.5:
+                    got_f = mine(jnp.asarray(preds), jnp.asarray(target))
+                    exp_f = ref(torch.from_numpy(preds), torch.from_numpy(target))
+                    assert set(got_f) == set(exp_f), f"case {i} batch {b}"
+                    for fk in got_f:
+                        np.testing.assert_allclose(
+                            np.asarray(got_f[fk], np.float64),
+                            np.asarray(exp_f[fk].numpy(), np.float64),
+                            rtol=1e-4, atol=1e-5,
+                            err_msg=f"case {i} batch {b} fused forward {fk}",
+                        )
+                else:
+                    mine.update(jnp.asarray(preds), jnp.asarray(target))
+                    ref.update(torch.from_numpy(preds), torch.from_numpy(target))
+                if reset_at == b:
+                    mine.reset()
+                    ref.reset()
+            assert not mine._fuse_failed, f"case {i}: fused path silently fell back"
+            got, exp = mine.compute(), ref.compute()
+        case = f"case {i} picks={[n for n, _ in picks]}"
+        assert set(got) == set(exp), case
+        for key in got:
+            np.testing.assert_allclose(
+                np.asarray(got[key], np.float64),
+                np.asarray(exp[key].numpy(), np.float64),
+                rtol=1e-4, atol=1e-5, err_msg=f"{case} key={key}",
+            )
