@@ -911,7 +911,48 @@ def _attach_tpu_provenance(result: dict) -> dict:
             "git_rev": cap.get("git_rev"),
             "note": "most recent committed on-TPU headline (live run fell back to CPU)",
         }
+    history = _tunnel_probe_history()
+    if history:
+        # attach even with no prior capture: the outage evidence matters
+        # most precisely when there is no chip number to show at all
+        result.setdefault("tpu_provenance", {"stale": True, "device": None})
+        result["tpu_provenance"]["tunnel_probe_history"] = history
     return result
+
+
+def _tunnel_probe_history() -> dict | None:
+    """Summarize this round's background tunnel probes (tools/tpu_watch.sh).
+
+    When the round-end run lands on CPU, the honest context is HOW HARD the
+    round tried for a chip: the watcher logs one line per failed probe, so
+    the count + span show whether the tunnel was down for minutes or for
+    the whole round.
+    """
+    import glob
+    import re
+
+    best = None
+    for path in glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpu_watch*.log")):
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            continue
+        fails = re.findall(r"probe #(\d+) no accelerator \((\d+)s elapsed\)", text)
+        if not fails:
+            continue
+        # count lines and take the max span: robust to a watcher restart
+        # appending to the same log (probe numbering resets) and to probes
+        # that found an accelerator without recording evidence
+        summary = {
+            "log": os.path.basename(path),
+            "failed_probes": len(fails),
+            "watch_span_s": max(int(f[1]) for f in fails),
+            "captured": "capture done" in text,
+        }
+        if best is None or summary["watch_span_s"] > best["watch_span_s"]:
+            best = summary
+    return best
 
 
 def _worker_main() -> None:
