@@ -1,0 +1,94 @@
+"""Native C++ n-gram core vs the Counter path: bit-exact equivalence.
+
+The chrF hot loop (per-sentence multiset n-gram intersections over 6 char
+orders + 2 word orders) dispatches to ``tm_ngram_overlap`` (rank-doubling
+over dense ids) when the native library is built; the Counter path is the
+always-available fallback AND the equivalence oracle here. The live-parity
+suite (tests/parity) separately pins the default path against the torch
+reference, which exercises the native core end to end.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from metrics_tpu import native
+from metrics_tpu.functional.text.chrf import (
+    _char_and_word_ngrams,
+    _ngram_counts,
+    _sentence_stats,
+    _sentence_stats_native,
+    chrf_score,
+)
+
+
+def _counter_overlap(a, b, max_order):
+    out = []
+    for n in range(1, max_order + 1):
+        ca = _ngram_counts(list(a), n)
+        cb = _ngram_counts(list(b), n)
+        out.append(float(sum((ca & cb).values())))
+    return out
+
+
+@pytest.mark.skipif(not native.native_available(), reason="native library unavailable")
+class TestNgramOverlap:
+    def test_fuzz_matches_counters(self):
+        rng = np.random.RandomState(3)
+        for trial in range(200):
+            na, nb = rng.randint(0, 60, 2)
+            vocab = rng.randint(2, 30)
+            a = rng.randint(0, vocab, na).astype(np.int32)
+            b = rng.randint(0, vocab, nb).astype(np.int32)
+            max_order = int(rng.randint(1, 8))
+            got = native.ngram_overlap(a, b, max_order)
+            want = _counter_overlap(a.tolist(), b.tolist(), max_order)
+            np.testing.assert_array_equal(got, want, err_msg=f"trial {trial}")
+
+    def test_empty_and_degenerate(self):
+        empty = np.zeros(0, np.int32)
+        one = np.asarray([5], np.int32)
+        np.testing.assert_array_equal(native.ngram_overlap(empty, one, 3), [0.0, 0.0, 0.0])
+        np.testing.assert_array_equal(native.ngram_overlap(one, one, 3), [1.0, 0.0, 0.0])
+
+    def test_large_symbol_values(self):
+        # unicode codepoints go in raw: ids far above the dense range
+        a = np.asarray([0x1F600, 0x1F601, 0x1F600, 0x1F601], np.int32)
+        b = np.asarray([0x1F601, 0x1F600, 0x1F601], np.int32)
+        np.testing.assert_array_equal(
+            native.ngram_overlap(a, b, 2),
+            _counter_overlap(a.tolist(), b.tolist(), 2),
+        )
+
+
+@pytest.mark.skipif(not native.native_available(), reason="native library unavailable")
+def test_sentence_stats_native_matches_counter_path():
+    """Full-sentence equivalence incl. tokenization, multi-reference best-f
+    selection, lowercase/whitespace branches, and punctuation handling."""
+    rng = np.random.RandomState(4)
+    words = ["the", "cat", "sat.", "on,", "a", "mat!", "HELLO", "world", "...", "x"]
+
+    def sent():
+        return " ".join(rng.choice(words, rng.randint(0, 14)))
+
+    for trial in range(60):
+        pred = sent()
+        tgts = [sent() for _ in range(rng.randint(0, 3))]
+        lowercase = bool(rng.rand() < 0.5)
+        whitespace = bool(rng.rand() < 0.5)
+        n_word = int(rng.randint(0, 3))
+        got = _sentence_stats_native(pred, tgts, 6, n_word, lowercase, whitespace, 2.0)
+        assert got is not None
+
+        # the Counter oracle, with native forcibly bypassed
+        import metrics_tpu.functional.text.chrf as chrf_mod
+
+        orig = chrf_mod._sentence_stats_native
+        chrf_mod._sentence_stats_native = lambda *a, **k: None
+        try:
+            want = _sentence_stats(pred, tgts, 6, n_word, lowercase, whitespace, 2.0)
+        finally:
+            chrf_mod._sentence_stats_native = orig
+        assert got[0] == want[0], (trial, pred, tgts)
+        for g, w in zip(got[1:], want[1:]):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=str(trial))
