@@ -423,6 +423,48 @@ def _cfg_chrf(detail: dict, n_pairs: int = 1000, reps: int = 3) -> None:
         native_mod._lib, native_mod._load_failed, native_mod._tried_build = saved
 
 
+def _cfg_rouge(detail: dict, n_pairs: int = 20, reps: int = 3) -> None:
+    """ROUGE-L/Lsum over 200-token summaries: native C++ LCS vs Python DP.
+
+    The LCS dynamic programs are quadratic in summary length, so the
+    native win grows with document size (~2x at 40-token paragraphs,
+    ~20x here; bit-exact — tests/text/test_rouge_native.py)."""
+    import metrics_tpu.native as native_mod
+    from metrics_tpu.functional.text.rouge import rouge_score
+
+    rng = np.random.RandomState(13)
+    words = [f"w{i}" for i in range(200)]
+    def para():
+        return ". ".join(" ".join(rng.choice(words, 25)) for _ in range(8))
+    preds = [para() for _ in range(n_pairs)]
+    tgts = [para() for _ in range(n_pairs)]
+    keys = ("rougeL", "rougeLsum")
+
+    def best_ms():
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            rouge_score(preds, tgts, rouge_keys=keys)
+            best = min(best, (time.perf_counter() - t0) * 1e3)
+        return round(best, 1)
+
+    rouge_score(preds[:1], tgts[:1], rouge_keys=keys)  # warm
+    if native_mod.native_available():
+        detail["rouge_lsum_ms_20_summaries"] = best_ms()
+    saved = (native_mod._lib, native_mod._load_failed, native_mod._tried_build)
+    os_env = os.environ.get("METRICS_TPU_DISABLE_NATIVE")
+    try:
+        os.environ["METRICS_TPU_DISABLE_NATIVE"] = "1"
+        native_mod._lib, native_mod._load_failed, native_mod._tried_build = None, False, False
+        detail["rouge_python_dp_baseline_ms"] = best_ms()
+    finally:
+        if os_env is None:
+            os.environ.pop("METRICS_TPU_DISABLE_NATIVE", None)
+        else:
+            os.environ["METRICS_TPU_DISABLE_NATIVE"] = os_env
+        native_mod._lib, native_mod._load_failed, native_mod._tried_build = saved
+
+
 def _cfg_coco_5k(detail: dict, n_images: int = 5000) -> None:
     """COCO mAP at dataset scale (VERDICT r4 #8): 5k images — the size of
     COCO val2017 — at maxDet density, to establish whether the host-side
@@ -630,6 +672,8 @@ def _bench_detail() -> dict:
     _mark("coco_map_compute_s_5k_images")
     _cfg_chrf(detail)
     _mark("chrf_score_ms_1k_pairs")
+    _cfg_rouge(detail)
+    _mark("rouge_lsum_ms_20_summaries")
     _cfg_fid_stream(detail)
     _mark("fid_compute_s_moments_5k_feats")
     _cfg_kid_compute(detail)

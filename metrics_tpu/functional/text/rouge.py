@@ -125,11 +125,31 @@ def _rouge_n_score(pred: List[str], target: List[str], n_gram: int) -> Dict[str,
     return _compute_metrics(hits, pred_len, target_len)
 
 
+def _token_ids(tokens: List[str], vocab: Dict[str, int]) -> np.ndarray:
+    return np.fromiter(
+        (vocab.setdefault(t, len(vocab)) for t in tokens), dtype=np.int32, count=len(tokens)
+    )
+
+
 def _lcs(pred_tokens: List[str], target_tokens: List[str]) -> int:
-    """Longest common subsequence length (numpy DP)."""
+    """Longest common subsequence length (native C++ core when built, with
+    the numpy DP as the always-available fallback and equivalence oracle —
+    tests/text/test_rouge_native.py)."""
     n, m = len(pred_tokens), len(target_tokens)
     if n == 0 or m == 0:
         return 0
+    from metrics_tpu import native
+
+    if native.native_available():
+        try:
+            vocab: Dict[str, int] = {}
+            p_ids, t_ids = _token_ids(pred_tokens, vocab), _token_ids(target_tokens, vocab)
+        except TypeError:
+            p_ids = None  # custom tokenizer yielded unhashable tokens
+        if p_ids is not None:
+            out = native.lcs_ids(p_ids, t_ids)
+            if out is not None:
+                return out
     prev = np.zeros(m + 1, dtype=np.int64)
     for i in range(1, n + 1):
         cur = np.zeros(m + 1, dtype=np.int64)
@@ -150,12 +170,51 @@ def _rouge_l_score(pred: List[str], target: List[str]) -> Dict[str, float]:
     return _compute_metrics(lcs, len(pred), len(target))
 
 
+# DP matrices beyond this many cells stay on the Python path: its numpy
+# allocation raises a catchable MemoryError, while a std::bad_alloc would
+# escape the C ABI and abort the process (cap = 2^27 cells ≈ 0.5 GB int32)
+_NATIVE_LCS_MAX_CELLS = 1 << 27
+
+
 def _rouge_lsum_score(pred_sents: List[List[str]], target_sents: List[List[str]]) -> Dict[str, float]:
-    """Summary-level ROUGE-L: union-LCS over sentence pairs (rouge_score semantics)."""
+    """Summary-level ROUGE-L: union-LCS over sentence pairs (rouge_score
+    semantics). Native C++ path when built (tm_lcs_union_mark — identical
+    backtrack tie-breaking, so the covered SETS match the Python fallback,
+    not just their sizes); ids are converted once per summary, not once
+    per (ref, pred) pair."""
     pred_len = sum(len(s) for s in pred_sents)
     target_len = sum(len(s) for s in target_sents)
     if pred_len == 0 or target_len == 0:
         return _compute_metrics(0, pred_len, target_len)
+
+    from metrics_tpu import native
+
+    if native.native_available():
+        try:
+            vocab: Dict[str, int] = {}
+            pred_ids = [_token_ids(s, vocab) for s in pred_sents if s]
+            ref_ids = [_token_ids(s, vocab) for s in target_sents]
+        except TypeError:
+            pred_ids = None  # custom tokenizer yielded unhashable tokens
+        max_pred = max((len(p) for p in pred_ids), default=0) if pred_ids is not None else 0
+        if pred_ids is not None and all(
+            (len(r) + 1) * (max_pred + 1) <= _NATIVE_LCS_MAX_CELLS for r in ref_ids
+        ):
+            hits = 0
+            ok = True
+            for r_ids in ref_ids:
+                if not len(r_ids):
+                    continue
+                covered_u8 = np.zeros(len(r_ids), dtype=np.uint8)
+                for p_ids in pred_ids:
+                    if not native.lcs_union_mark(p_ids, r_ids, covered_u8):
+                        ok = False
+                        break
+                if not ok:
+                    break
+                hits += int(covered_u8.sum())
+            if ok:
+                return _compute_metrics(hits, pred_len, target_len)
 
     def _union_lcs(ref_sent: List[str], pred_sentences: List[List[str]]) -> int:
         """Count of reference tokens covered by LCS with any pred sentence."""

@@ -109,14 +109,22 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_int32, ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
     ]
     # void* argtypes: the raw .ctypes.data integer passes without building
-    # per-call ctypes cast objects — this function runs ~2 calls per
-    # sentence PAIR on the chrF hot path, where that overhead was measured
+    # per-call ctypes cast objects — these functions run per sentence
+    # (pair) on the chrF/ROUGE hot paths, where that overhead was measured
     # to rival the C work itself
     lib.tm_ngram_overlap.restype = None
     lib.tm_ngram_overlap.argtypes = [
         ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_int32, ctypes.c_void_p,
+    ]
+    lib.tm_lcs.restype = ctypes.c_int64
+    lib.tm_lcs.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.tm_lcs_union_mark.restype = None
+    lib.tm_lcs_union_mark.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
     ]
     _lib = lib
     return _lib
@@ -160,6 +168,31 @@ def ngram_overlap(a: np.ndarray, b: np.ndarray, max_order: int) -> Optional[np.n
         a.ctypes.data, len(a), b.ctypes.data, len(b), int(max_order), out.ctypes.data
     )
     return out
+
+
+def lcs_ids(a: np.ndarray, b: np.ndarray) -> Optional[int]:
+    """Longest-common-subsequence length between two int32 id arrays;
+    None if native unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    a = _as_i32(a)
+    b = _as_i32(b)
+    return int(lib.tm_lcs(a.ctypes.data, len(a), b.ctypes.data, len(b)))
+
+
+def lcs_union_mark(p: np.ndarray, r: np.ndarray, covered: np.ndarray) -> bool:
+    """OR the LCS-covered positions of ``r`` (vs ``p``) into ``covered``
+    (uint8, modified in place). Returns False if native unavailable —
+    the caller keeps its Python backtrack."""
+    lib = _load()
+    if lib is None:
+        return False
+    p = _as_i32(p)
+    r = _as_i32(r)
+    assert covered.dtype == np.uint8 and covered.flags["C_CONTIGUOUS"] and len(covered) == len(r)
+    lib.tm_lcs_union_mark(p.ctypes.data, len(p), r.ctypes.data, len(r), covered.ctypes.data)
+    return True
 
 
 def eed_score(

@@ -87,6 +87,68 @@ void tm_ngram_overlap(const int32_t* a, int64_t na, const int32_t* b, int64_t nb
     }
 }
 
+// Longest-common-subsequence LENGTH between id sequences (rolling rows) —
+// the ROUGE-L hot loop (ref functional/text/rouge.py computes it with a
+// per-cell Python DP).
+int64_t tm_lcs(const int32_t* a, int64_t n, const int32_t* b, int64_t m) {
+    if (n == 0 || m == 0) return 0;
+    std::vector<int64_t> prev(static_cast<size_t>(m) + 1, 0), cur(static_cast<size_t>(m) + 1, 0);
+    for (int64_t i = 1; i <= n; ++i) {
+        const int32_t ai = a[i - 1];
+        cur[0] = 0;
+        for (int64_t j = 1; j <= m; ++j) {
+            if (ai == b[j - 1]) {
+                cur[static_cast<size_t>(j)] = prev[static_cast<size_t>(j - 1)] + 1;
+            } else {
+                const int64_t up = prev[static_cast<size_t>(j)];
+                const int64_t left = cur[static_cast<size_t>(j - 1)];
+                cur[static_cast<size_t>(j)] = up > left ? up : left;
+            }
+        }
+        std::swap(prev, cur);
+    }
+    return prev[static_cast<size_t>(m)];
+}
+
+// LCS of pred sentence p vs ref sentence r with backtracking; ORs the
+// LCS-covered ref positions into `covered` (uint8, length m) — the
+// union-LCS step of summary-level ROUGE-Lsum. The backtrack tie-breaking
+// replicates the Python implementation exactly (match-with-diagonal
+// first, else move i when dp[i-1][j] >= dp[i][j-1], else move j), so the
+// covered sets — not just their sizes — are identical.
+void tm_lcs_union_mark(const int32_t* p, int64_t n, const int32_t* r, int64_t m,
+                       uint8_t* covered) {
+    if (n == 0 || m == 0) return;
+    const size_t stride = static_cast<size_t>(m) + 1;
+    std::vector<int32_t> dp(static_cast<size_t>(n + 1) * stride, 0);
+    for (int64_t i = 1; i <= n; ++i) {
+        const int32_t pi = p[i - 1];
+        for (int64_t j = 1; j <= m; ++j) {
+            const size_t ij = static_cast<size_t>(i) * stride + static_cast<size_t>(j);
+            if (pi == r[j - 1]) {
+                dp[ij] = dp[ij - stride - 1] + 1;
+            } else {
+                const int32_t up = dp[ij - stride];
+                const int32_t left = dp[ij - 1];
+                dp[ij] = up > left ? up : left;
+            }
+        }
+    }
+    int64_t i = n, j = m;
+    while (i > 0 && j > 0) {
+        const size_t ij = static_cast<size_t>(i) * stride + static_cast<size_t>(j);
+        if (p[i - 1] == r[j - 1] && dp[ij] == dp[ij - stride - 1] + 1) {
+            covered[j - 1] = 1;
+            --i;
+            --j;
+        } else if (dp[ij - stride] >= dp[ij - 1]) {
+            --i;
+        } else {
+            --j;
+        }
+    }
+}
+
 // Levenshtein distance between id sequences a[0:n) and b[0:m).
 int64_t tm_levenshtein(const int32_t* a, int64_t n, const int32_t* b, int64_t m) {
     if (n == 0) return m;

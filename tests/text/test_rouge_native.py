@@ -1,0 +1,110 @@
+"""Native C++ LCS core vs the Python DP: exact equivalence for ROUGE-L.
+
+``tm_lcs`` (length) and ``tm_lcs_union_mark`` (union-LCS covered-position
+marking with the Python backtrack's exact tie-breaking) dispatch from
+rouge.py when the library is built; the Python paths remain the fallback
+and the oracle. The live-parity suite separately pins rouge_score against
+the torch reference, exercising the native core end to end.
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu import native
+from metrics_tpu.functional.text.rouge import _lcs, _rouge_lsum_score
+
+
+def _py_lcs(a, b):
+    n, m = len(a), len(b)
+    prev = [0] * (m + 1)
+    for i in range(1, n + 1):
+        cur = [0] * (m + 1)
+        for j in range(1, m + 1):
+            cur[j] = prev[j - 1] + 1 if a[i - 1] == b[j - 1] else max(prev[j], cur[j - 1])
+        prev = cur
+    return prev[m]
+
+
+def _py_union_covered(ref_sent, pred_sentences):
+    covered = [False] * len(ref_sent)
+    for p_sent in pred_sentences:
+        n, m = len(p_sent), len(ref_sent)
+        dp = np.zeros((n + 1, m + 1), dtype=np.int64)
+        for i in range(1, n + 1):
+            for j in range(1, m + 1):
+                if p_sent[i - 1] == ref_sent[j - 1]:
+                    dp[i, j] = dp[i - 1, j - 1] + 1
+                else:
+                    dp[i, j] = max(dp[i - 1, j], dp[i, j - 1])
+        i, j = n, m
+        while i > 0 and j > 0:
+            if p_sent[i - 1] == ref_sent[j - 1] and dp[i, j] == dp[i - 1, j - 1] + 1:
+                covered[j - 1] = True
+                i, j = i - 1, j - 1
+            elif dp[i - 1, j] >= dp[i, j - 1]:
+                i -= 1
+            else:
+                j -= 1
+    return covered
+
+
+@pytest.mark.skipif(not native.native_available(), reason="native library unavailable")
+class TestNativeLcs:
+    def test_lcs_fuzz(self):
+        rng = np.random.RandomState(5)
+        for trial in range(200):
+            n, m = rng.randint(0, 40, 2)
+            vocab = rng.randint(2, 12)
+            a = rng.randint(0, vocab, n).astype(np.int32)
+            b = rng.randint(0, vocab, m).astype(np.int32)
+            got = native.lcs_ids(a, b)
+            assert got == _py_lcs(a.tolist(), b.tolist()), trial
+
+    def test_union_mark_covered_sets_identical(self):
+        """Not just counts: the exact covered POSITIONS must match the
+        Python backtrack, or multi-sentence unions would diverge."""
+        rng = np.random.RandomState(6)
+        for trial in range(100):
+            vocab = rng.randint(2, 10)
+            ref = rng.randint(0, vocab, rng.randint(1, 25)).astype(np.int32)
+            preds = [rng.randint(0, vocab, rng.randint(0, 25)).astype(np.int32)
+                     for _ in range(rng.randint(1, 4))]
+            covered = np.zeros(len(ref), dtype=np.uint8)
+            for p in preds:
+                if len(p):
+                    assert native.lcs_union_mark(p, ref, covered)
+            want = _py_union_covered(ref.tolist(), [p.tolist() for p in preds])
+            np.testing.assert_array_equal(covered.astype(bool), want, err_msg=str(trial))
+
+    def test_rouge_lsum_end_to_end_equivalence(self):
+        rng = np.random.RandomState(7)
+        words = ["a", "b", "c", "d", "e", "f"]
+        for trial in range(40):
+            pred_sents = [[str(w) for w in rng.choice(words, rng.randint(0, 15))]
+                          for _ in range(rng.randint(1, 4))]
+            tgt_sents = [[str(w) for w in rng.choice(words, rng.randint(0, 15))]
+                         for _ in range(rng.randint(1, 4))]
+            got = _rouge_lsum_score(pred_sents, tgt_sents)
+
+            import metrics_tpu.native as nat
+
+            saved = (nat._lib, nat._load_failed, nat._tried_build)
+            nat._lib, nat._load_failed, nat._tried_build = None, True, True
+            try:
+                want = _rouge_lsum_score(pred_sents, tgt_sents)
+            finally:
+                nat._lib, nat._load_failed, nat._tried_build = saved
+            assert got == want, (trial, got, want)
+
+    def test_lcs_dispatch_matches_fallback(self):
+        toks_a = ["x", "y", "z", "x", "w"]
+        toks_b = ["y", "x", "w", "z"]
+        got = _lcs(toks_a, toks_b)
+        import metrics_tpu.native as nat
+
+        saved = (nat._lib, nat._load_failed, nat._tried_build)
+        nat._lib, nat._load_failed, nat._tried_build = None, True, True
+        try:
+            want = _lcs(toks_a, toks_b)
+        finally:
+            nat._lib, nat._load_failed, nat._tried_build = saved
+        assert got == want
