@@ -379,6 +379,27 @@ def _cfg_coco(detail: dict, python_baseline: bool = False) -> None:
         _native_mod.coco_match = _orig_match
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _python_fallback(native_mod):
+    """Force the pure-Python fallback for the scope (baseline timings),
+    restoring the env knob and ALL native-module loader state after."""
+    saved = (native_mod._lib, native_mod._load_failed, native_mod._tried_build)
+    saved_env = os.environ.get("METRICS_TPU_DISABLE_NATIVE")
+    try:
+        os.environ["METRICS_TPU_DISABLE_NATIVE"] = "1"
+        native_mod._lib, native_mod._load_failed, native_mod._tried_build = None, False, False
+        yield
+    finally:
+        if saved_env is None:
+            os.environ.pop("METRICS_TPU_DISABLE_NATIVE", None)
+        else:
+            os.environ["METRICS_TPU_DISABLE_NATIVE"] = saved_env
+        native_mod._lib, native_mod._load_failed, native_mod._tried_build = saved
+
+
 def _cfg_chrf(detail: dict, n_pairs: int = 1000, reps: int = 3) -> None:
     """chrF corpus scoring: native C++ n-gram core vs the Counter fallback.
 
@@ -407,20 +428,8 @@ def _cfg_chrf(detail: dict, n_pairs: int = 1000, reps: int = 3) -> None:
     chrf_score(preds[:2], tgts[:2])  # warm: jax asarray + native build
     if native_mod.native_available():
         detail["chrf_score_ms_1k_pairs"] = best_ms()
-    # Counter-path baseline (the reference's protocol), forced via the
-    # public env knob in a state-restoring way
-    saved = (native_mod._lib, native_mod._load_failed, native_mod._tried_build)
-    os_env = os.environ.get("METRICS_TPU_DISABLE_NATIVE")
-    try:
-        os.environ["METRICS_TPU_DISABLE_NATIVE"] = "1"
-        native_mod._lib, native_mod._load_failed, native_mod._tried_build = None, False, False
+    with _python_fallback(native_mod):  # Counter path = the reference's protocol
         detail["chrf_python_counter_baseline_ms"] = best_ms()
-    finally:
-        if os_env is None:
-            os.environ.pop("METRICS_TPU_DISABLE_NATIVE", None)
-        else:
-            os.environ["METRICS_TPU_DISABLE_NATIVE"] = os_env
-        native_mod._lib, native_mod._load_failed, native_mod._tried_build = saved
 
 
 def _cfg_rouge(detail: dict, n_pairs: int = 20, reps: int = 3) -> None:
@@ -451,18 +460,8 @@ def _cfg_rouge(detail: dict, n_pairs: int = 20, reps: int = 3) -> None:
     rouge_score(preds[:1], tgts[:1], rouge_keys=keys)  # warm
     if native_mod.native_available():
         detail["rouge_lsum_ms_20_summaries"] = best_ms()
-    saved = (native_mod._lib, native_mod._load_failed, native_mod._tried_build)
-    os_env = os.environ.get("METRICS_TPU_DISABLE_NATIVE")
-    try:
-        os.environ["METRICS_TPU_DISABLE_NATIVE"] = "1"
-        native_mod._lib, native_mod._load_failed, native_mod._tried_build = None, False, False
+    with _python_fallback(native_mod):  # Python DP = the reference's protocol
         detail["rouge_python_dp_baseline_ms"] = best_ms()
-    finally:
-        if os_env is None:
-            os.environ.pop("METRICS_TPU_DISABLE_NATIVE", None)
-        else:
-            os.environ["METRICS_TPU_DISABLE_NATIVE"] = os_env
-        native_mod._lib, native_mod._load_failed, native_mod._tried_build = saved
 
 
 def _cfg_coco_5k(detail: dict, n_images: int = 5000) -> None:

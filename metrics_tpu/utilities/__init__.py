@@ -8,4 +8,9 @@ from metrics_tpu.utilities.data import (  # noqa: F401
 )
 from metrics_tpu.utilities.checks import _check_same_shape  # noqa: F401
 from metrics_tpu.utilities.distributed import class_reduce, reduce  # noqa: F401
-from metrics_tpu.utilities.prints import rank_zero_debug, rank_zero_info, rank_zero_warn  # noqa: F401
+from metrics_tpu.utilities.prints import (  # noqa: F401
+    _future_warning,
+    rank_zero_debug,
+    rank_zero_info,
+    rank_zero_warn,
+)

@@ -46,3 +46,30 @@ def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str 
     if class_reduction == "none" or class_reduction is None:
         return fraction
     raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")
+
+
+def gather_all_tensors(x, group=None, env=None):
+    """Gather ``x`` from every participant (ref utilities/distributed.py:96-151).
+
+    Migration shim at the reference's import path: the implementation lives
+    in :mod:`metrics_tpu.parallel.dist_env` (the DistEnv abstraction owns
+    the collectives here). ``group`` accepts the reference's second
+    argument: a mesh-axis name (str) builds an :class:`AxisEnv` scope, and
+    a :class:`DistEnv` passes through — a torch process-group object has no
+    meaning here and raises.
+    """
+    from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv
+    from metrics_tpu.parallel.dist_env import gather_all_tensors as _impl
+
+    if group is not None and env is None:
+        if isinstance(group, str):
+            env = AxisEnv(group)
+        elif isinstance(group, DistEnv):
+            env = group
+        else:
+            raise ValueError(
+                "`group` must be a mesh-axis name (str) or a DistEnv here —"
+                " torch process groups do not exist on this backend"
+                " (see docs/migration.md)."
+            )
+    return _impl(x, env=env)
