@@ -652,7 +652,33 @@ class Metric(ABC):
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
 
         output_dict: Dict[str, Any] = {}
+        # named reductions expressible as one fused collective: XLA lowers
+        # psum/pmax/pmin to reduce-scatter+all-gather over ICI and never
+        # materializes the (world, ...) stacked intermediate the
+        # gather+reduce form does. Taken only on the plain path — a custom
+        # dist_sync_fn must keep receiving every state, and sync_dtype
+        # compression relies on gather-then-reduce so the accumulation
+        # stays at full precision (only the wire bytes are compressed).
+        native_reduce_ops = {dim_zero_sum: "sum", dim_zero_mean: "mean",
+                             dim_zero_max: "max", dim_zero_min: "min"}
+
+        def _would_compress(x) -> bool:
+            return (
+                self.sync_dtype is not None
+                and jnp.issubdtype(x.dtype, jnp.floating)
+                and jnp.dtype(x.dtype).itemsize > self.sync_dtype.itemsize
+            )
+
         for attr, value in input_dict.items():
+            # per-attr eligibility: integer/narrow states are never
+            # compressed, so sync_dtype does not cost them the fused path
+            if dist_sync_fn is None and not isinstance(value, list) and not _would_compress(value):
+                op = native_reduce_ops.get(self._reductions[attr])
+                if op is not None:
+                    reduced = env.all_reduce(value, op)
+                    if reduced is not None:
+                        object.__setattr__(self, attr, reduced)
+                        continue
             # Never compress sample-accumulating states (list states and
             # tensor states with a `cat` reduction): those hold raw samples
             # (CatMetric values, curve preds) that would stay quantized

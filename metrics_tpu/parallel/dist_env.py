@@ -44,6 +44,19 @@ class DistEnv:
         """Gather ``x`` from every participant; returns a list of per-rank arrays."""
         raise NotImplementedError
 
+    def all_reduce(self, x: Array, op: str) -> Optional[Array]:
+        """Fused cross-participant reduction (``op`` in sum/mean/max/min),
+        or None when this env has no better path than gather+reduce.
+
+        Where available (named-axis collectives), this is the
+        bandwidth-optimal form: XLA lowers ``psum`` to
+        reduce-scatter + all-gather over ICI and never materializes the
+        ``(world, ...)`` stacked intermediate that gather+reduce does —
+        for a (1000, 1000) confusion-matrix state on an 8-device axis
+        that's 8x less transient memory and ~half the link bytes.
+        """
+        return None
+
     def is_distributed(self) -> bool:
         return self.world_size() > 1
 
@@ -77,6 +90,21 @@ class AxisEnv(DistEnv):
     def all_gather(self, x: Array) -> List[Array]:
         gathered = jax.lax.all_gather(jnp.atleast_1d(x), self.axis_name)  # (world, ...)
         return [gathered[i] for i in range(self.world_size())]
+
+    def all_reduce(self, x: Array, op: str) -> Optional[Array]:
+        # atleast_1d mirrors all_gather's shape semantics exactly: the
+        # gather+reduce path turns a scalar state into a (1,) result, and
+        # downstream code must see the same shapes on either path
+        x = jnp.atleast_1d(x)
+        if op == "sum":
+            return jax.lax.psum(x, self.axis_name)
+        if op == "mean":
+            return jax.lax.pmean(x, self.axis_name)
+        if op == "max":
+            return jax.lax.pmax(x, self.axis_name)
+        if op == "min":
+            return jax.lax.pmin(x, self.axis_name)
+        return None
 
 
 class ProcessEnv(DistEnv):

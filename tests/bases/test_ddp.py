@@ -485,3 +485,79 @@ def test_empty_list_state_sync_mixed_emptiness_raises():
     m = M()
     with pytest.raises(MetricsUserError, match="_ragged_state_specs"):
         m._sync_dist(m.dist_sync_fn, env=NoOpEnv())
+
+
+def test_named_reductions_lower_to_fused_collectives():
+    """sum/mean/max/min tensor-state sync inside shard_map must lower to
+    psum/pmax/pmin (XLA's reduce-scatter+all-gather form), NOT to
+    all-gather + local reduce — the (world, ...) stacked intermediate
+    never exists. cat/None reductions still need the gather."""
+    from metrics_tpu import Accuracy
+
+    metric = Accuracy(num_classes=4, average="macro")  # sum-reduced states
+
+    def worker(state):
+        return metric.pure_sync(state, "r")
+
+    jaxpr = str(
+        jax.make_jaxpr(
+            shard_map(worker, mesh=_mesh(), in_specs=(P(),), out_specs=P(), check_vma=False)
+        )(metric.state())
+    )
+    assert "psum" in jaxpr
+    assert "all_gather" not in jaxpr
+
+    class _CatState(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("rows", jnp.zeros((2, 3)), dist_reduce_fx="cat")
+
+        def update(self, x):
+            self.rows = x
+
+        def compute(self):
+            return self.rows
+
+    cat_metric = _CatState()
+    jaxpr_cat = str(
+        jax.make_jaxpr(
+            shard_map(lambda s: cat_metric.pure_sync(s, "r"), mesh=_mesh(),
+                      in_specs=(P(),), out_specs=P(), check_vma=False)
+        )(cat_metric.state())
+    )
+    assert "all_gather" in jaxpr_cat
+
+
+def test_native_reduce_skipped_for_custom_gather_and_sync_dtype():
+    """A custom dist_sync_fn must receive every state (no psum bypass),
+    and sync_dtype keeps the compressed-gather path (full-precision
+    accumulation after the compressed wire crossing)."""
+    seen = []
+
+    def recording_gather(x, env):
+        seen.append(tuple(x.shape))
+        return [x, x]
+
+    class M(Metric):
+        full_state_update = False
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.asarray(2.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + x
+
+        def compute(self):
+            return self.total
+
+    m = M(dist_sync_fn=recording_gather)
+    m._sync_dist(m.dist_sync_fn, env=NoOpEnv())
+    assert seen, "custom gather was bypassed by a native reduction"
+    np.testing.assert_allclose(np.asarray(m.total), 4.0)  # 2 + 2
+
+    m2 = M(sync_dtype=jnp.bfloat16)
+    m2._sync_dist(None, env=Fake2Env())
+    np.testing.assert_allclose(np.asarray(m2.total), 4.0)
