@@ -73,3 +73,35 @@ def test_injected_embedder_still_takes_precedence():
 
     bert_score(["x y"], ["x y"], embedder=spy)
     assert len(calls) == 2  # preds + target went through the injected one
+
+
+def test_deterministic_across_processes():
+    """The zero-config claim is REPRODUCIBLE scores: token vectors must be
+    identical in a fresh interpreter (BLAKE2b is unseeded and MT19937 is
+    platform-stable, but this pins it end to end)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import json, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from metrics_tpu.functional.text.bert import bert_score\n"
+        "out = bert_score(['the quick brown fox'], ['a quick red fox'])\n"
+        "print(json.dumps([float(out[k][0]) for k in ('precision', 'recall', 'f1')]))\n"
+    ) % os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    runs = []
+    for _ in range(2):
+        proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                              text=True, timeout=240, env=env)
+        assert proc.returncode == 0, proc.stderr[-1000:]
+        runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    assert runs[0] == runs[1]
+    # and the parent process agrees bit-for-bit with the children
+    from metrics_tpu.functional.text.bert import bert_score
+
+    here = bert_score(["the quick brown fox"], ["a quick red fox"])
+    parent = [float(here[k][0]) for k in ("precision", "recall", "f1")]
+    assert parent == runs[0]
