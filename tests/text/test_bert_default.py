@@ -91,13 +91,19 @@ def test_deterministic_across_processes():
         "out = bert_score(['the quick brown fox'], ['a quick red fox'])\n"
         "print(json.dumps([float(out[k][0]) for k in ('precision', 'recall', 'f1')]))\n"
     ) % os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
-    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    # full scrub like tests/bases/test_process_env_real.py: no axon site
+    # hook, no forced device counts leaking from the test session
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    procs = [
+        subprocess.Popen([sys.executable, "-c", code], stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True, env=env)
+        for _ in range(2)
+    ]
     runs = []
-    for _ in range(2):
-        proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                              text=True, timeout=240, env=env)
-        assert proc.returncode == 0, proc.stderr[-1000:]
-        runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    for proc in procs:  # both children pay their jax startup concurrently
+        out, err = proc.communicate(timeout=240)
+        assert proc.returncode == 0, err[-1000:]
+        runs.append(json.loads(out.strip().splitlines()[-1]))
     assert runs[0] == runs[1]
     # and the parent process agrees bit-for-bit with the children
     from metrics_tpu.functional.text.bert import bert_score
