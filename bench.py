@@ -161,19 +161,32 @@ def _cfg_compute_group_detection(detail: dict, reps: int = 5) -> None:
     ``jnp.allclose`` — a device round trip — per state pair across group
     leaders, paid once per collection lifetime. This config measures that
     first update with detection on (auto), off, and with groups declared
-    explicitly (zero detection work), on the bench device. Construction
-    repeats per rep so the detection runs every time; the jitted updates
-    land in the in-process cache after rep 1, isolating the merge cost.
+    explicitly (zero detection work). Construction repeats per rep so the
+    detection runs every time; the jitted updates land in the in-process
+    cache after rep 1, isolating the merge cost.
+
+    Pinned to the host CPU backend: the compute-group machinery is
+    host-side bookkeeping, the member update work is identical across the
+    three modes (it subtracts out of every comparison), and the eager
+    member updates this config deliberately uses (fused dispatch would
+    bypass the group machinery being measured) ride the device tunnel
+    per-op on a remote accelerator — the 2026-08-02 on-chip capture spent
+    >14 min inside this config before the worker watchdog fired. On
+    accelerators the out-of-box path is fused dispatch, where groups are
+    bypassed entirely (see docs/performance.md); the group story is an
+    eager/host story and is measured where it runs.
     """
     import jax
     import jax.numpy as jnp
 
     from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
 
+    cpu = jax.local_devices(backend="cpu")[0]
     rng = np.random.RandomState(4)
     logits = rng.rand(256, 32).astype(np.float32)
-    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
-    target = jnp.asarray(rng.randint(0, 32, 256))
+    preds = jax.device_put(jnp.asarray(logits / logits.sum(-1, keepdims=True)), cpu)
+    target = jax.device_put(jnp.asarray(rng.randint(0, 32, 256)), cpu)
+    detail["cg_machinery_device"] = "host cpu (group machinery is host-side; member device work identical across modes)"
 
     def metrics():
         # all four share the macro stat-score pipeline, so they form ONE
@@ -192,11 +205,12 @@ def _cfg_compute_group_detection(detail: dict, reps: int = 5) -> None:
         for rep in range(reps + 1):
             # fused dispatch pinned off: this config times the compute-group
             # machinery itself, which the fused program would bypass
-            mc = MetricCollection(metrics(), fused_update=False, **kwargs)
-            t0 = time.perf_counter()
-            mc.update(preds, target)
-            # "acc" leads the explicit group and updates in every mode
-            jax.block_until_ready(mc["acc"].tp)
+            with jax.default_device(cpu):
+                mc = MetricCollection(metrics(), fused_update=False, **kwargs)
+                t0 = time.perf_counter()
+                mc.update(preds, target)
+                # "acc" leads the explicit group and updates in every mode
+                jax.block_until_ready(mc["acc"].tp)
             dt = (time.perf_counter() - t0) * 1e6
             if rep:  # rep 0 pays the one-time jit compiles
                 best = min(best, dt)
@@ -226,16 +240,26 @@ def _cfg_cg_steady_state(detail: dict, steps: int = 200, reps: int = 3) -> None:
     200-step epoch over a 4-metric macro stat-score suite (one shared group)
     with detection on (auto), off, and declared explicitly, eager dispatch
     pinned so the group machinery — not XLA fusion — is what's measured.
+
+    Pinned to the host CPU backend for the same reason as
+    ``_cfg_compute_group_detection``: the measured difference (update all
+    members vs only the group leader) is host-side dispatch count, and
+    ~2,400 eager collection updates over a tunneled accelerator measure
+    tunnel latency, not the group win (this config wedged the 2026-08-02
+    on-chip BENCH_ALL pass). On accelerators the out-of-box path is the
+    fused program, which bypasses groups entirely.
     """
     import jax
     import jax.numpy as jnp
 
     from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
 
+    cpu = jax.local_devices(backend="cpu")[0]
     rng = np.random.RandomState(5)
     logits = rng.rand(256, 32).astype(np.float32)
-    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
-    target = jnp.asarray(rng.randint(0, 32, 256))
+    preds = jax.device_put(jnp.asarray(logits / logits.sum(-1, keepdims=True)), cpu)
+    target = jax.device_put(jnp.asarray(rng.randint(0, 32, 256)), cpu)
+    detail["cg_machinery_device"] = "host cpu (group machinery is host-side; member device work identical across modes)"
 
     def metrics():
         return {
@@ -248,13 +272,14 @@ def _cfg_cg_steady_state(detail: dict, steps: int = 200, reps: int = 3) -> None:
     def epoch_ms(**kwargs):
         best = float("inf")
         for rep in range(reps + 1):
-            mc = MetricCollection(metrics(), fused_update=False, **kwargs)
-            mc.update(preds, target)  # first update: detection + jit warm
-            jax.block_until_ready(mc["acc"].tp)
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                mc.update(preds, target)
-            jax.block_until_ready(mc["acc"].tp)
+            with jax.default_device(cpu):
+                mc = MetricCollection(metrics(), fused_update=False, **kwargs)
+                mc.update(preds, target)  # first update: detection + jit warm
+                jax.block_until_ready(mc["acc"].tp)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    mc.update(preds, target)
+                jax.block_until_ready(mc["acc"].tp)
             dt = (time.perf_counter() - t0) * 1e3
             if rep:  # rep 0 pays any remaining compile
                 best = min(best, dt)
@@ -644,45 +669,96 @@ def _cfg_kid_compute(detail: dict) -> None:
     detail["kid_compute_s_100_subsets"] = round(time.perf_counter() - t0, 2)
 
 
+_PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.partial.json")
+
+
+def _flush_partial(detail: dict) -> None:
+    """Checkpoint the running detail dict after every completed config.
+
+    A worker killed by the parent watchdog mid-suite used to lose every
+    completed measurement with it (the 2026-08-02 on-chip BENCH_ALL pass
+    wedged inside one config and recorded nothing); the parent now salvages
+    this file on timeout (``_salvage_partial_detail``). Provenance is
+    stamped on every flush so a salvaged partial is as traceable as a
+    completed capture.
+    """
+    try:
+        import jax
+
+        snap = dict(detail)
+        snap.setdefault("device", str(jax.devices()[0]))
+        snap.setdefault("git_rev", _git_rev())
+        snap["captured_at_utc"] = datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds")
+        tmp = _PARTIAL_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f, indent=2)
+        os.replace(tmp, _PARTIAL_PATH)
+    except Exception as err:  # checkpointing must never break the suite
+        print(f"# partial flush failed: {err}", file=sys.stderr, flush=True)
+
+
 def _bench_detail() -> dict:
-    """Extra BASELINE.md configs; written to BENCH_DETAIL.json with BENCH_ALL=1."""
+    """Extra BASELINE.md configs; written to BENCH_DETAIL.json with BENCH_ALL=1.
+
+    Budgeted and checkpointed (both lessons from the 2026-08-02 on-chip
+    pass): a config only STARTS while ``BENCH_DETAIL_BUDGET`` (default
+    1500 s) remains — bounding the suite at budget + one config — one
+    config's failure never loses the rest, and the running dict flushes to
+    ``BENCH_DETAIL.partial.json`` after every config so a watchdog kill
+    mid-suite still lands everything that completed.
+    """
+    budget = float(os.environ.get("BENCH_DETAIL_BUDGET", "1500"))
+    detail = {"suite": "full"}
+    configs = [
+        ("collection_update_us", _cfg_collection),
+        ("cg_first_update_auto_detect_us", _cfg_compute_group_detection),
+        ("cg_steady_state_auto_ms", _cfg_cg_steady_state),
+        ("scan_epoch_100_batches_ms", _cfg_scan_epoch),
+        ("retrieval_map_compute_ms_100k_rows", _cfg_retrieval),
+        ("coco_map_compute_s_100_images", lambda d: _cfg_coco(d, python_baseline=True)),
+        ("coco_map_compute_s_5k_images", _cfg_coco_5k),
+        ("chrf_score_ms_1k_pairs", _cfg_chrf),
+        ("rouge_lsum_ms_20_summaries", _cfg_rouge),
+        ("fid_compute_s_moments_5k_feats", _cfg_fid_stream),
+        ("kid_compute_s_100_subsets", _cfg_kid_compute),
+        ("large_shapes", _cfg_large_shapes),
+        ("fid_update_ms_batch8_299px", _cfg_fid_inception),
+        ("bertscore_update_ms_256_sents", _cfg_bertscore),
+        ("wer_update_ms_1k_pairs", _cfg_wer),
+        ("collection_dist_sync_8dev_us", _cfg_dist_sync),
+    ]
+    detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
+    return detail
+
+
+def _run_configs(detail: dict, configs, budget: float, label: str) -> float:
+    """Shared budgeted config loop for the full and fast detail suites:
+    a config only STARTS while budget remains, one config's failure never
+    loses the rest, and the running dict checkpoints after every config."""
+    t_start = time.perf_counter()
+    for key, fn in configs:
+        if time.perf_counter() - t_start > budget:
+            detail[f"{key}_skipped"] = f"{label} budget exhausted"
+            print(f"# {label}: {key} SKIPPED (budget)", file=sys.stderr, flush=True)
+            continue
+        try:
+            fn(detail)
+        except Exception as err:  # one broken config must not lose the rest
+            detail[f"{key}_error"] = str(err)[:200]
+        print(f"# {label}: {key}", file=sys.stderr, flush=True)
+        _flush_partial(detail)
+    return round(time.perf_counter() - t_start, 1)
+
+
+def _cfg_fid_inception(detail: dict) -> None:
+    """FID with the bundled Flax InceptionV3 (BASELINE.md config #5)."""
     import jax
     import jax.numpy as jnp
 
-    def _mark(key):
-        print(f"# detail: {key}", file=sys.stderr, flush=True)
-
-    detail = {"suite": "full"}
-    rng = np.random.RandomState(0)
-
-    _cfg_collection(detail)
-    _mark("collection_update_us")
-    _cfg_compute_group_detection(detail)
-    _mark("cg_first_update_auto_detect_us")
-    _cfg_cg_steady_state(detail)
-    _mark("cg_steady_state_auto_ms")
-    _cfg_scan_epoch(detail)
-    _mark("scan_epoch_100_batches_ms")
-    _cfg_retrieval(detail)
-    _mark("retrieval_map_compute_ms_100k_rows")
-    _cfg_coco(detail, python_baseline=True)
-    _mark("coco_map_compute_s_100_images")
-    _cfg_coco_5k(detail)
-    _mark("coco_map_compute_s_5k_images")
-    _cfg_chrf(detail)
-    _mark("chrf_score_ms_1k_pairs")
-    _cfg_rouge(detail)
-    _mark("rouge_lsum_ms_20_summaries")
-    _cfg_fid_stream(detail)
-    _mark("fid_compute_s_moments_5k_feats")
-    _cfg_kid_compute(detail)
-    _mark("kid_compute_s_100_subsets")
-    _cfg_large_shapes(detail)
-    _mark("large_shapes")
-
-    # FID with the bundled Flax InceptionV3 (BASELINE.md config #5)
     from metrics_tpu.image import FrechetInceptionDistance, InceptionV3FeatureExtractor
 
+    rng = np.random.RandomState(0)
     ext = InceptionV3FeatureExtractor()
     imgs = jnp.asarray((rng.rand(8, 3, 299, 299) * 255).astype(np.uint8))
     fid = FrechetInceptionDistance(feature_extractor=ext)
@@ -705,7 +781,6 @@ def _bench_detail() -> dict:
         jax.block_until_ready(fid.fake_features[-1])
         best = min(best, (time.perf_counter() - t0) / 5 * 1e3)
     detail["fid_update_ms_batch8_299px"] = round(best, 1)
-    _mark("fid_update_ms_batch8_299px")
     # pin the compute workload to the historical basis (1 real + 5 fake
     # batches) so fid_compute_s stays comparable across captures no matter
     # how many timing reps ran above
@@ -717,11 +792,15 @@ def _bench_detail() -> dict:
     t0 = time.perf_counter()
     jax.block_until_ready(fid.compute())
     detail["fid_compute_s"] = round(time.perf_counter() - t0, 2)
-    _mark("fid_compute_s")
 
-    # BERTScore: host tokenize + greedy cosine matching on device; the
-    # embedder is a deterministic hash one-hot (the embedding model itself is
-    # a weight asset — its forward cost is the FID number above).
+
+def _cfg_bertscore(detail: dict) -> None:
+    """BERTScore: host tokenize + greedy cosine matching on device; the
+    embedder is a deterministic hash one-hot (the embedding model itself is
+    a weight asset — its forward cost is the FID inception config)."""
+    import jax
+    import jax.numpy as jnp
+
     from metrics_tpu.text import BERTScore
 
     vocab = {}
@@ -742,16 +821,17 @@ def _bench_detail() -> dict:
     t0 = time.perf_counter()
     bs.update(sents, sents)
     detail["bertscore_update_ms_256_sents"] = round((time.perf_counter() - t0) * 1e3, 1)
-    _mark("bertscore_update_ms_256_sents")
     t0 = time.perf_counter()
     jax.block_until_ready(bs.compute()["f1"])
     detail["bertscore_compute_s_256_sents"] = round(time.perf_counter() - t0, 2)
-    _mark("bertscore_compute_s_256_sents")
 
-    # WER over a 1k-pair corpus: host-side native C++ edit-distance core
+
+def _cfg_wer(detail: dict) -> None:
+    """WER over a 1k-pair corpus: host-side native C++ edit-distance core."""
     from metrics_tpu import WordErrorRate
     from metrics_tpu.native import native_available
 
+    rng = np.random.RandomState(0)
     words = [f"word{i}" for i in range(200)]
     corpus_p = [" ".join(rng.choice(words, 25)) for _ in range(1000)]
     corpus_t = [" ".join(rng.choice(words, 25)) for _ in range(1000)]
@@ -760,7 +840,6 @@ def _bench_detail() -> dict:
     t0 = time.perf_counter()
     wer.update(corpus_p, corpus_t)
     detail["wer_update_ms_1k_pairs"] = round((time.perf_counter() - t0) * 1e3, 1)
-    _mark("wer_update_ms_1k_pairs")
     detail["wer_native_core"] = native_available()
 
     # baseline: the reference's own algorithm — the pure-Python two-row
@@ -772,18 +851,16 @@ def _bench_detail() -> dict:
     t0 = time.perf_counter()
     _total = sum(_edit_distance_py(a, b) for a, b in pairs)
     detail["wer_python_dp_baseline_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
-    _mark("wer_python_dp_baseline_ms")
 
-    # BASELINE.md config #2: collection forward incl. cross-device sync on an
-    # 8-device mesh. Runs in a subprocess on 8 forced host (CPU) devices —
-    # the same collective program that rides ICI on a real slice.
+
+def _cfg_dist_sync(detail: dict) -> None:
+    """BASELINE.md config #2: collection forward incl. cross-device sync on an
+    8-device mesh. Runs in a subprocess on 8 forced host (CPU) devices —
+    the same collective program that rides ICI on a real slice."""
     detail["collection_dist_sync_8dev_us"] = _bench_dist_subprocess()
     # unlike the other keys this one is always measured on 8 forced host-CPU
     # devices in a subprocess, regardless of the main process's device
     detail["collection_dist_sync_8dev_device"] = "8 virtual CPU host devices (subprocess)"
-    _mark("collection_dist_sync_8dev_us")
-
-    return detail
 
 
 def _bench_dist_subprocess():
@@ -860,7 +937,6 @@ def _bench_detail_fast() -> dict:
     run on the real chip: a config only STARTS while budget remains, so
     the pass is bounded at budget + one config's runtime."""
     budget = float(os.environ.get("BENCH_FAST_DETAIL_BUDGET", "240"))
-    t_start = time.perf_counter()
     detail = {"suite": "fast"}
     configs = [
         ("collection", _cfg_collection),
@@ -873,25 +949,25 @@ def _bench_detail_fast() -> dict:
         ("kid_compute", _cfg_kid_compute),
         ("large_shapes", lambda d: _cfg_large_shapes(d, reps=2)),
     ]
-    for key, fn in configs:
-        if time.perf_counter() - t_start > budget:
-            detail[f"{key}_skipped"] = "fast-detail budget exhausted"
-            continue
-        try:
-            fn(detail)
-        except Exception as err:  # one broken config must not lose the rest
-            detail[f"{key}_error"] = str(err)[:200]
-        print(f"# fast detail: {key}", file=sys.stderr, flush=True)
-    detail["fast_detail_elapsed_s"] = round(time.perf_counter() - t_start, 1)
+    detail["fast_detail_elapsed_s"] = _run_configs(detail, configs, budget, "fast detail")
     return detail
 
 
-def _write_detail(detail: dict) -> None:
+def _measurement_keys(detail: dict) -> list:
+    """The keys that are actual measurements — not provenance metadata and
+    not failure/skip markers."""
+    meta = {"suite", "device", "git_rev", "captured_at_utc", "truncated"}
+    return [k for k in detail
+            if k not in meta and not k.endswith(("_error", "_skipped"))]
+
+
+def _write_detail(detail: dict, out_path: str = None) -> None:
     """Write BENCH_DETAIL.json next to this script — but never let a fast
     subset clobber a full BENCH_ALL capture, unless the fast run is the
     first one with real-accelerator numbers (CPU evidence is replaceable,
     TPU evidence is the point — VERDICT r1 item 2)."""
-    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
     if os.path.exists(out_path):
         try:
             with open(out_path) as f:
@@ -909,6 +985,15 @@ def _write_detail(detail: dict) -> None:
             return
         if detail.get("suite") == "fast" and existing_full and existing_on_accel == ours_on_accel:
             print("# keeping existing full BENCH_DETAIL.json (fast subset not written)",
+                  file=sys.stderr, flush=True)
+            return
+        # a truncated salvage only displaces a same-device-class file when it
+        # carries at least as much evidence — counting MEASUREMENT keys only
+        # (a run whose configs mostly failed accumulates `_error` markers,
+        # which must not outvote a healthy capture's real numbers)
+        if (detail.get("truncated") and existing_on_accel == ours_on_accel
+                and len(_measurement_keys(existing)) > len(_measurement_keys(detail))):
+            print("# keeping existing BENCH_DETAIL.json (truncated salvage has fewer keys)",
                   file=sys.stderr, flush=True)
             return
     with open(out_path, "w") as f:
@@ -1104,8 +1189,42 @@ def _worker_main() -> None:
             _record_capture("bench_detail", device, {
                 "ts_utc": ts_utc, "git_rev": git_rev, "suite": detail.get("suite"),
             })
+            try:  # the completed write supersedes the per-config checkpoint
+                os.remove(_PARTIAL_PATH)
+            except OSError:
+                pass
         except Exception as err:  # detail bench must never break the headline
             print(f"# detail bench failed: {err}", file=sys.stderr)
+
+
+def _salvage_partial_detail(started_wall: float) -> None:
+    """Promote a timed-out worker's per-config checkpoint (``_flush_partial``).
+
+    Only a checkpoint written by THIS worker counts (mtime after its start):
+    a stale partial from an earlier crash must not masquerade as fresh
+    evidence. The promoted dict is marked ``truncated`` and goes through
+    ``_write_detail``'s normal provenance guards.
+    """
+    try:
+        if not os.path.exists(_PARTIAL_PATH) or os.path.getmtime(_PARTIAL_PATH) < started_wall:
+            return
+        with open(_PARTIAL_PATH) as f:
+            partial = json.load(f)
+    except Exception:
+        return
+    partial["truncated"] = "worker watchdog fired mid-suite; completed configs only"
+    print(f"# salvaged partial detail ({len(partial)} keys) from timed-out worker",
+          file=sys.stderr, flush=True)
+    _write_detail(partial)
+    _record_capture("bench_detail", partial.get("device", ""), {
+        "suite": partial.get("suite"), "truncated": True,
+        "ts_utc": partial.get("captured_at_utc"),
+        "git_rev": partial.get("git_rev", "unknown"),
+    })
+    try:
+        os.remove(_PARTIAL_PATH)
+    except OSError:
+        pass
 
 
 def _run_worker(env: dict, timeout: float):
@@ -1114,6 +1233,7 @@ def _run_worker(env: dict, timeout: float):
     import time as _time
 
     t0 = _time.perf_counter()
+    t0_wall = _time.time()
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--worker"],
@@ -1126,6 +1246,7 @@ def _run_worker(env: dict, timeout: float):
             tail = tail.decode(errors="replace")
         print(f"# bench worker timed out after {timeout:.0f}s: {tail[-800:]}",
               file=sys.stderr, flush=True)
+        _salvage_partial_detail(t0_wall)
         # salvage: the worker prints the headline before any detail pass, so
         # a mid-detail kill still yields valid (often TPU) numbers
         out = err.stdout or ""

@@ -54,3 +54,104 @@ def test_large_shape_metrics_accept_the_bench_shapes():
 def test_roofline_table_sane():
     for kind, gbs in bench._HBM_ROOFLINE_GBPS.items():
         assert 100.0 < gbs < 10000.0, kind
+
+
+def test_flush_partial_stamps_provenance(tmp_path, monkeypatch):
+    """Every per-config checkpoint must be salvageable as-is: device, rev,
+    and timestamp come with it (the 2026-08-02 on-chip BENCH_ALL pass lost
+    25 minutes of completed measurements to one wedged config)."""
+    monkeypatch.setattr(bench, "_PARTIAL_PATH", str(tmp_path / "partial.json"))
+    bench._flush_partial({"suite": "full", "some_key_us": 1.5})
+    import json
+
+    with open(tmp_path / "partial.json") as f:
+        snap = json.load(f)
+    assert snap["some_key_us"] == 1.5
+    assert snap["device"] and snap["git_rev"] and snap["captured_at_utc"]
+
+
+def test_salvage_ignores_stale_partials(tmp_path, monkeypatch):
+    """A checkpoint left by an EARLIER crashed worker must not masquerade
+    as this worker's evidence."""
+    import json
+    import time
+
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps({"suite": "full", "device": "TPU x", "old": 1}))
+    monkeypatch.setattr(bench, "_PARTIAL_PATH", str(partial))
+    written = []
+    monkeypatch.setattr(bench, "_write_detail", lambda d, out_path=None: written.append(d))
+    monkeypatch.setattr(bench, "_record_capture", lambda *a, **k: None)
+    bench._salvage_partial_detail(started_wall=time.time() + 60)  # worker started AFTER the file
+    assert written == []
+    assert partial.exists()
+
+
+def test_salvage_promotes_fresh_partial(tmp_path, monkeypatch):
+    import json
+    import time
+
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps({"suite": "full", "device": "TPU x", "k_us": 2.0}))
+    monkeypatch.setattr(bench, "_PARTIAL_PATH", str(partial))
+    written = []
+    monkeypatch.setattr(bench, "_write_detail", lambda d, out_path=None: written.append(d))
+    captured = []
+    monkeypatch.setattr(bench, "_record_capture", lambda kind, dev, payload: captured.append((kind, dev)))
+    bench._salvage_partial_detail(started_wall=time.time() - 60)
+    assert len(written) == 1 and written[0]["k_us"] == 2.0
+    assert written[0]["truncated"]
+    assert captured == [("bench_detail", "TPU x")]
+    assert not partial.exists()  # promoted checkpoints don't linger
+
+
+def test_write_detail_truncated_guard(tmp_path):
+    """A truncated salvage displaces a same-device-class file only when it
+    carries at least as many keys; a CPU salvage never displaces
+    accelerator evidence."""
+    import json
+
+    out = tmp_path / "BENCH_DETAIL.json"
+    full = {"suite": "full", "device": "TPU v5 lite0", "a": 1, "b": 2, "c": 3}
+    out.write_text(json.dumps(full))
+
+    small = {"suite": "full", "device": "TPU v5 lite0", "a": 9, "truncated": "yes"}
+    bench._write_detail(small, out_path=str(out))
+    assert json.loads(out.read_text()) == full  # fewer keys: kept
+
+    big = dict(small, b=9, c=9, d=9, e=9)
+    bench._write_detail(big, out_path=str(out))
+    assert json.loads(out.read_text())["a"] == 9  # more keys: displaced
+
+    cpu = {"suite": "full", "device": "TFRT_CPU_0", "truncated": "yes",
+           **{k: 0 for k in "abcdefgh"}}
+    bench._write_detail(cpu, out_path=str(out))
+    assert json.loads(out.read_text())["a"] == 9  # CPU never displaces TPU
+
+    # error/skip markers are not evidence: a mostly-failed salvage with many
+    # `_error` keys must not outvote a healthy capture's real measurements
+    current = json.loads(out.read_text())
+    errors = {"suite": "full", "device": "TPU v5 lite0", "truncated": "yes",
+              "a": 1, **{f"cfg{i}_error": "boom" for i in range(10)}}
+    bench._write_detail(errors, out_path=str(out))
+    assert json.loads(out.read_text()) == current
+
+
+def test_bench_detail_budget_zero_skips_everything(monkeypatch):
+    """The budget check bounds the suite at budget + one config; at zero
+    budget nothing starts and the skip markers name every config."""
+    monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
+    detail = bench._bench_detail()
+    skipped = [k for k in detail if k.endswith("_skipped")]
+    assert len(skipped) == 16
+    assert "detail_elapsed_s" in detail
+
+
+def test_cg_configs_record_host_pinning():
+    """The compute-group configs measure host-side machinery and must say
+    so (they are pinned to the host CPU backend; eager member updates over
+    a tunneled accelerator wedged the 2026-08-02 on-chip pass)."""
+    detail = {}
+    bench._cfg_compute_group_detection(detail, reps=1)
+    assert "host cpu" in detail["cg_machinery_device"]
+    assert detail["cg_first_update_auto_detect_us"] > 0

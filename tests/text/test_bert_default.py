@@ -100,10 +100,19 @@ def test_deterministic_across_processes():
         for _ in range(2)
     ]
     runs = []
-    for proc in procs:  # both children pay their jax startup concurrently
-        out, err = proc.communicate(timeout=240)
-        assert proc.returncode == 0, err[-1000:]
-        runs.append(json.loads(out.strip().splitlines()[-1]))
+    try:
+        for proc in procs:  # both children pay their jax startup concurrently
+            out, err = proc.communicate(timeout=240)
+            assert proc.returncode == 0, err[-1000:]
+            runs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # a hung or failed child must not outlive the test (communicate's
+        # TimeoutExpired does not kill, and an assert on child 1 would
+        # otherwise orphan child 2)
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
     assert runs[0] == runs[1]
     # and the parent process agrees bit-for-bit with the children
     from metrics_tpu.functional.text.bert import bert_score

@@ -43,7 +43,8 @@ while true; do
             # existing full-suite BENCH_DETAIL.json with new configs
             echo "# tpu_watch: running BENCH_ALL full detail suite"
             timeout --signal=INT --kill-after=30 3600 \
-                env BENCH_ALL=1 BENCH_RECOVERY_BUDGET=0 BENCH_NO_CPU_FALLBACK=1 python bench.py
+                env BENCH_ALL=1 BENCH_RECOVERY_BUDGET=0 BENCH_NO_CPU_FALLBACK=1 \
+                BENCH_TPU_TIMEOUT=3300 BENCH_DETAIL_BUDGET=2700 python bench.py
             RC=$?
             echo "# tpu_watch: BENCH_ALL pass rc=$RC ($(date -u +%FT%TZ))"
             exit 0
