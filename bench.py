@@ -4,8 +4,9 @@ Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 Config: ``Accuracy`` (multiclass, probabilities (B, C) vs int targets) —
-BASELINE.md config #1 ("metric.update() µs/call"). Ours is the jitted pure
-``(state, batch) -> state`` reducer on the default JAX device (TPU under the
+BASELINE.md config #1 ("metric.update() µs/call"). Ours is the stateful
+``update()`` through the fast-dispatch engine (AOT-compiled executable,
+flat donated state leaves) on the default JAX device (TPU under the
 driver). The baseline is the reference's eager formulation (torch CPU ops:
 argmax → one-hot → stat-score sums, the same math TorchMetrics executes per
 update) measured in-process — lower is better; ``vs_baseline`` is the
@@ -42,14 +43,13 @@ def _bench_ours() -> float:
     preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
     target = jnp.asarray(rng.randint(0, NUM_CLASSES, BATCH))
 
-    metric = Accuracy(num_classes=NUM_CLASSES, average="macro")
-    state = metric.state()
-    # Donating the state buffer lets XLA update the accumulators in place
-    # instead of allocating a fresh state every call (~35% lower latency).
-    step = jax.jit(metric.pure_update, donate_argnums=0)
-
-    state = step(state, preds, target)  # compile
-    jax.block_until_ready(jax.tree_util.tree_leaves(state))
+    # jit_update routes through the fast-dispatch engine
+    # (metrics_tpu/dispatch.py): one AOT-compiled executable per shape
+    # bucket, state crossing as a flat donated leaf tuple — the production
+    # ``update()`` hot path, measured end to end including the host side.
+    metric = Accuracy(num_classes=NUM_CLASSES, average="macro", jit_update=True)
+    metric.update(preds, target)  # compile
+    jax.block_until_ready(metric.tp)
 
     # Best-of-5 repetitions: dispatch rides a device tunnel with noisy
     # per-call latency, so the minimum is the stable statistic.
@@ -57,8 +57,8 @@ def _bench_ours() -> float:
     for _ in range(5):
         t0 = time.perf_counter()
         for _ in range(ITERS):
-            state = step(state, preds, target)
-        jax.block_until_ready(jax.tree_util.tree_leaves(state))
+            metric.update(preds, target)
+        jax.block_until_ready(metric.tp)
         best = min(best, (time.perf_counter() - t0) / ITERS * 1e6)  # µs/call
     return best
 
@@ -153,6 +153,94 @@ def _cfg_collection(detail: dict) -> None:
     detail["collection_update_fused_us"] = round((time.perf_counter() - t0) / 50 * 1e6, 1)
 
 
+def _cfg_dispatch_engine(detail: dict) -> None:
+    """Fast-dispatch engine observability: structural dispatch / retrace
+    counts from ``metrics_tpu.profiling`` plus bucketed-batch-size latency.
+
+    These are the tunnel-independent numbers behind the "RTT-bound, not
+    compute-bound" rows: a fused collection is ONE executable launch per
+    update regardless of member count, and batch sizes within one
+    ``bucket_pow2`` bucket share one executable (zero retraces), so the
+    per-update device-dispatch count is a structural property, not a
+    latency measurement that a wedged tunnel can poison."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall, profiling
+
+    rng = np.random.RandomState(7)
+    C = 32
+
+    def batch(b):
+        logits = rng.rand(b, C).astype(np.float32)
+        return jnp.asarray(logits / logits.sum(-1, keepdims=True)), jnp.asarray(rng.randint(0, C, b))
+
+    # (1) intra-bucket retraces: 65..128 all pad to the 128 bucket -> the
+    # engine compiles ONCE and the other three sizes reuse the executable
+    m = Accuracy(num_classes=C, average="macro", jit_update=True)
+    with profiling.track_dispatches() as t:
+        for b in (65, 100, 127, 128):
+            m.update(*batch(b))
+        jax.block_until_ready(m.tp)
+    detail["dispatch_count_single_metric_4_updates"] = t.dispatch_count()
+    detail["retrace_count_intra_bucket_4_sizes"] = t.retrace_count()
+
+    # (2) fused collection: 4 metrics -> 1 cached executable launch/update
+    members = {
+        "acc": Accuracy(num_classes=C, average="macro"),
+        "f1": F1Score(num_classes=C, average="macro"),
+        "prec": Precision(num_classes=C, average="macro"),
+        "rec": Recall(num_classes=C, average="macro"),
+    }
+    col = MetricCollection(members, fused_update=True)
+    col.update(*batch(128))  # compile
+    with profiling.track_dispatches() as t:
+        for _ in range(10):
+            col.update(*batch(128))
+        jax.block_until_ready(col["acc"].tp)
+    detail["dispatch_count_fused_collection_10_updates"] = t.dispatch_count(kind="fused-aot")
+    detail["retrace_count_fused_collection_steady"] = t.retrace_count()
+
+    # (3) bucketed-batch latency: a non-pow2 batch rides the 1024-bucket
+    # executable (padded rows masked to exact no-ops) instead of retracing
+    m2 = Accuracy(num_classes=C, average="macro", jit_update=True)
+    warm = {b: batch(b) for b in (1024, 700)}
+    for b in warm:
+        m2.update(*warm[b])  # one compile, shared bucket
+    jax.block_until_ready(m2.tp)
+    for b, key in ((1024, "engine_update_us_b1024"), (700, "engine_update_us_b700_same_bucket")):
+        p, tg = warm[b]
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(50):
+                m2.update(p, tg)
+            jax.block_until_ready(m2.tp)
+            best = min(best, (time.perf_counter() - t0) / 50 * 1e6)
+        detail[key] = round(best, 1)
+    detail["retrace_count_bucketed_latency_pair"] = m2.dispatch_stats["retraces"]
+
+
+def _machinery_device(detail: dict):
+    """Host CPU device for the compute-group machinery configs.
+
+    ``JAX_PLATFORMS=tpu`` hosts register NO cpu backend, and
+    ``jax.local_devices(backend="cpu")`` raises there — which used to
+    silently lose both compute-group measurements. Fall back to the
+    default device and record which one the numbers came from."""
+    import jax
+
+    try:
+        dev = jax.local_devices(backend="cpu")[0]
+        detail["cg_machinery_device"] = (
+            "host cpu (group machinery is host-side; member device work identical across modes)"
+        )
+    except RuntimeError:
+        dev = jax.devices()[0]
+        detail["cg_machinery_device"] = f"{dev} (no cpu backend registered; fell back to default device)"
+    return dev
+
+
 def _cfg_compute_group_detection(detail: dict, reps: int = 5) -> None:
     """First-update cost of auto compute-group detection (VERDICT r3 #7).
 
@@ -181,12 +269,11 @@ def _cfg_compute_group_detection(detail: dict, reps: int = 5) -> None:
 
     from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
 
-    cpu = jax.local_devices(backend="cpu")[0]
+    cpu = _machinery_device(detail)
     rng = np.random.RandomState(4)
     logits = rng.rand(256, 32).astype(np.float32)
     preds = jax.device_put(jnp.asarray(logits / logits.sum(-1, keepdims=True)), cpu)
     target = jax.device_put(jnp.asarray(rng.randint(0, 32, 256)), cpu)
-    detail["cg_machinery_device"] = "host cpu (group machinery is host-side; member device work identical across modes)"
 
     def metrics():
         # all four share the macro stat-score pipeline, so they form ONE
@@ -254,12 +341,11 @@ def _cfg_cg_steady_state(detail: dict, steps: int = 200, reps: int = 3) -> None:
 
     from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
 
-    cpu = jax.local_devices(backend="cpu")[0]
+    cpu = _machinery_device(detail)
     rng = np.random.RandomState(5)
     logits = rng.rand(256, 32).astype(np.float32)
     preds = jax.device_put(jnp.asarray(logits / logits.sum(-1, keepdims=True)), cpu)
     target = jax.device_put(jnp.asarray(rng.randint(0, 32, 256)), cpu)
-    detail["cg_machinery_device"] = "host cpu (group machinery is host-side; member device work identical across modes)"
 
     def metrics():
         return {
@@ -702,16 +788,20 @@ def _bench_detail() -> dict:
     """Extra BASELINE.md configs; written to BENCH_DETAIL.json with BENCH_ALL=1.
 
     Budgeted and checkpointed (both lessons from the 2026-08-02 on-chip
-    pass): a config only STARTS while ``BENCH_DETAIL_BUDGET`` (default
-    1500 s) remains — bounding the suite at budget + one config — one
-    config's failure never loses the rest, and the running dict flushes to
+    pass): a config only STARTS while ``BENCH_DETAIL_BUDGET`` remains —
+    bounding the suite at budget + one config — one config's failure never
+    loses the rest, and the running dict flushes to
     ``BENCH_DETAIL.partial.json`` after every config so a watchdog kill
-    mid-suite still lands everything that completed.
+    mid-suite still lands everything that completed. The budget is OPT-IN:
+    with ``BENCH_DETAIL_BUDGET`` unset the full suite runs to completion
+    (an explicit BENCH_ALL=1 capture wants every config; watchdogged
+    ``tpu_watch.sh`` runs export their own budget).
     """
-    budget = float(os.environ.get("BENCH_DETAIL_BUDGET", "1500"))
+    budget = float(os.environ.get("BENCH_DETAIL_BUDGET", "inf"))
     detail = {"suite": "full"}
     configs = [
         ("collection_update_us", _cfg_collection),
+        ("dispatch_count_single_metric_4_updates", _cfg_dispatch_engine),
         ("cg_first_update_auto_detect_us", _cfg_compute_group_detection),
         ("cg_steady_state_auto_ms", _cfg_cg_steady_state),
         ("scan_epoch_100_batches_ms", _cfg_scan_epoch),
@@ -876,7 +966,7 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", os.path.join(os.getcwd(), ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import numpy as np, jax.numpy as jnp
-from jax import shard_map
+from metrics_tpu._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 from metrics_tpu import Accuracy, F1Score, MetricCollection
 
@@ -940,6 +1030,7 @@ def _bench_detail_fast() -> dict:
     detail = {"suite": "fast"}
     configs = [
         ("collection", _cfg_collection),
+        ("dispatch_engine", _cfg_dispatch_engine),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),
@@ -987,13 +1078,15 @@ def _write_detail(detail: dict, out_path: str = None) -> None:
             print("# keeping existing full BENCH_DETAIL.json (fast subset not written)",
                   file=sys.stderr, flush=True)
             return
-        # a truncated salvage only displaces a same-device-class file when it
+        # a same-suite, same-device-class overwrite only lands when it
         # carries at least as much evidence — counting MEASUREMENT keys only
-        # (a run whose configs mostly failed accumulates `_error` markers,
-        # which must not outvote a healthy capture's real numbers)
-        if (detail.get("truncated") and existing_on_accel == ours_on_accel
+        # (`truncated`, `*_skipped` and `*_error` markers all mean missing
+        # numbers: a truncated salvage, a budget-exhausted run, or a run
+        # whose configs mostly failed must not displace a healthy capture)
+        if (existing_on_accel == ours_on_accel
+                and existing.get("suite", "full") == detail.get("suite", "full")
                 and len(_measurement_keys(existing)) > len(_measurement_keys(detail))):
-            print("# keeping existing BENCH_DETAIL.json (truncated salvage has fewer keys)",
+            print("# keeping existing BENCH_DETAIL.json (new capture has fewer measurement keys)",
                   file=sys.stderr, flush=True)
             return
     with open(out_path, "w") as f:

@@ -64,7 +64,7 @@ def jitted_distributed_loop() -> None:
     """Fully-jitted data-parallel epoch: each device scans its shard of the
     step stream through the pure reducer, then one XLA collective syncs the
     states — the whole epoch is a single compiled program."""
-    from jax import shard_map
+    from metrics_tpu._compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     n_dev = len(jax.devices())

@@ -73,7 +73,7 @@ def sharded_scan() -> None:
     program. This is the TPU-native counterpart of the reference's
     DDP loop + ``gather_all_tensors`` at compute time.
     """
-    from jax import shard_map
+    from metrics_tpu._compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     n_dev = len(jax.devices())

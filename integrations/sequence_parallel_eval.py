@@ -28,7 +28,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from metrics_tpu._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu import Accuracy, F1Score, MetricCollection

@@ -1,0 +1,51 @@
+"""Cross-version jax shims.
+
+The repo is written against the jax>=0.5 public API; this module bridges the
+gaps when running on an older jax (accelerator images pin 0.4.x):
+
+* ``shard_map`` — jax<0.5 keeps it under ``jax.experimental.shard_map`` and
+  spells today's ``check_vma`` flag ``check_rep``. Import it from here instead
+  of ``from jax import shard_map`` so both spellings of the flag work on both
+  jax generations.
+* ``axis_size`` — ``jax.lax.axis_size`` is jax>=0.5; older jax reads the size
+  off the named axis frame.
+* ``enable_x64`` — the ``jax.enable_x64`` context manager is jax>=0.5; older
+  jax ships it as ``jax.experimental.enable_x64``.
+"""
+
+__all__ = ["shard_map", "axis_size", "enable_x64"]
+
+try:
+    from jax import shard_map as _new_shard_map  # jax>=0.5
+
+    def shard_map(f, *args, **kwargs):
+        kwargs.setdefault("check_vma", kwargs.pop("check_rep", True))
+        return _new_shard_map(f, *args, **kwargs)
+
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f, *args, **kwargs):
+        kwargs.setdefault("check_rep", kwargs.pop("check_vma", True))
+        return _old_shard_map(f, *args, **kwargs)
+
+
+try:
+    from jax.lax import axis_size  # jax>=0.5
+except ImportError:
+    import jax.core as _jax_core
+
+    def axis_size(axis_name):
+        # jax<0.5: core.axis_frame resolves a name to its size directly
+        if isinstance(axis_name, (tuple, list)):
+            size = 1
+            for name in axis_name:
+                size *= _jax_core.axis_frame(name)
+            return size
+        return _jax_core.axis_frame(axis_name)
+
+
+try:
+    from jax import enable_x64  # jax>=0.5
+except ImportError:
+    from jax.experimental import enable_x64

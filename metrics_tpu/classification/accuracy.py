@@ -124,6 +124,35 @@ class Accuracy(StatScores):
                 self.tn.append(tn)
                 self.fn.append(fn)
 
+    # -------------------------------------------- fast-dispatch mask support
+    def _masked_update_supported(self) -> bool:
+        return not self.subset_accuracy and super()._masked_update_supported()
+
+    def _masked_update(self, sample_mask: Array, preds: Array, target: Array) -> None:
+        """``update`` with an axis-0 validity mask (padded rows count zero)."""
+        mode = _mode(preds, target, self.threshold, self.top_k, self.num_classes, self.multiclass, self.ignore_index)
+        if not self.mode:
+            self.mode = mode
+        elif self.mode != mode:
+            raise ValueError(f"You can not use {mode} inputs with {self.mode} inputs.")
+        tp, fp, tn, fn = _accuracy_update(
+            preds,
+            target,
+            reduce=self.reduce,
+            mdmc_reduce=self.mdmc_reduce,
+            threshold=self.threshold,
+            num_classes=self.num_classes,
+            top_k=self.top_k,
+            multiclass=self.multiclass,
+            ignore_index=self.ignore_index,
+            mode=self.mode,
+            sample_mask=sample_mask,
+        )
+        self.tp = self.tp + tp
+        self.fp = self.fp + fp
+        self.tn = self.tn + tn
+        self.fn = self.fn + fn
+
     def compute(self) -> Array:
         """Accuracy from the accumulated state (ref accuracy.py:258-270)."""
         if not self.mode:

@@ -105,6 +105,31 @@ class StatScores(Metric):
             self.tn.append(tn)
             self.fn.append(fn)
 
+    # -------------------------------------------- fast-dispatch mask support
+    def _masked_update_supported(self) -> bool:
+        # the collapsing reduces make masked rows exact no-ops; the
+        # per-sample reduces keep one row per input and cannot pad
+        return self.reduce in ("micro", "macro") and self.mdmc_reduce != MDMCAverageMethod.SAMPLEWISE
+
+    def _masked_update(self, sample_mask: Array, preds: Array, target: Array) -> None:
+        """``update`` with an axis-0 validity mask (padded rows count zero)."""
+        tp, fp, tn, fn = _stat_scores_update(
+            preds,
+            target,
+            reduce=self.reduce,
+            mdmc_reduce=self.mdmc_reduce,
+            threshold=self.threshold,
+            num_classes=self.num_classes,
+            top_k=self.top_k,
+            multiclass=self.multiclass,
+            ignore_index=self.ignore_index,
+            sample_mask=sample_mask,
+        )
+        self.tp = self.tp + tp
+        self.fp = self.fp + fp
+        self.tn = self.tn + tn
+        self.fn = self.fn + fn
+
     def _get_final_stats(self) -> Tuple[Array, Array, Array, Array]:
         """Concatenate list states if necessary (ref stat_scores.py:202-208)."""
         tp = jnp.concatenate(self.tp) if isinstance(self.tp, list) else self.tp

@@ -91,17 +91,25 @@ class MetricCollection:
         self._fuse_failed: bool = False
         self._fused_update_fn = None
         self._fused_forward_fn = None
+        self._dispatcher = None  # AOT fast-dispatch engine for fused updates
+        self._dispatch_stats: Dict[str, int] = {"dispatches": 0, "retraces": 0}
 
         self.add_metrics(metrics, *additional_metrics)
 
     def __getstate__(self) -> Dict[str, Any]:
-        # jitted dispatchers hold unpicklable callables; rebuilt lazily
-        return {k: v for k, v in self.__dict__.items() if k not in ("_fused_update_fn", "_fused_forward_fn")}
+        # jitted/AOT dispatchers hold unpicklable callables; rebuilt lazily
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("_fused_update_fn", "_fused_forward_fn", "_dispatcher")
+        }
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
         self._fused_update_fn = None
         self._fused_forward_fn = None
+        self._dispatcher = None
+        self._dispatch_stats = dict(self.__dict__.get("_dispatch_stats") or {"dispatches": 0, "retraces": 0})
 
     # --------------------------------------------------------------- mapping
     def __getitem__(self, key: str) -> Metric:
@@ -209,18 +217,98 @@ class MetricCollection:
         leaves = jax.tree_util.tree_leaves((args, kwargs))
         return all(isinstance(x, (jax.Array, _np.ndarray, int, float, bool, _np.number)) for x in leaves)
 
+    def _make_dispatcher(self):
+        """AOT engine for the fused update: all member states cross as ONE
+        flat leaf tuple (read/written straight off the member attributes, no
+        ``state()`` copies) and the whole collection advances in one cached
+        executable launch per batch."""
+        from metrics_tpu.dispatch import FastDispatcher
+
+        layout = [(name, key) for name, m in self._modules.items() for key in m._defaults]
+
+        def read_leaves():
+            return tuple(getattr(self._modules[name], key) for name, key in layout)
+
+        def write_leaves(leaves):
+            for (name, key), leaf in zip(layout, leaves):
+                object.__setattr__(self._modules[name], key, leaf)
+
+        def unflatten(leaves):
+            states: Dict[str, Dict[str, Any]] = {name: {} for name in self._modules}
+            for (name, key), leaf in zip(layout, leaves):
+                states[name][key] = leaf
+            return states
+
+        def flatten(states):
+            return tuple(states[name][key] for name, key in layout)
+
+        def make_update(static):
+            def fn(leaves, *args, **kwargs):
+                return flatten(self.pure_update(unflatten(leaves), *args, **kwargs))
+
+            return fn
+
+        def make_masked_update(static):
+            def fn(n_valid, leaves, *args, **kwargs):
+                padded_len = next(
+                    x.shape[0]
+                    for x in jax.tree_util.tree_leaves((args, kwargs))
+                    if getattr(x, "ndim", 0) >= 1
+                )
+                mask = jnp.arange(padded_len, dtype=jnp.int32) < n_valid
+                states = unflatten(leaves)
+                new = {
+                    name: m._masked_pure_update(states[name], mask, *args, **m._filter_kwargs(**kwargs))
+                    for name, m in self.items(keep_base=True)
+                }
+                return flatten(new)
+
+            return fn
+
+        def masking_ok():
+            return all(m._masked_update_supported() for m in self._modules.values())
+
+        return FastDispatcher(
+            "MetricCollection",
+            read_leaves,
+            write_leaves,
+            make_update,
+            make_masked_update,
+            masking_ok=masking_ok,
+            stats=self._dispatch_stats,
+        )
+
+    @property
+    def dispatch_stats(self) -> Dict[str, int]:
+        """Fused-path counters: executable ``dispatches`` / ``retraces``."""
+        return dict(self._dispatch_stats)
+
     def _try_fused_update(self, *args: Any, **kwargs: Any) -> bool:
         try:
             if not self._fusable(args, kwargs):
                 self._fuse_fallback("update", "unfusable member or non-array inputs")
                 return False
-            if self._fused_update_fn is None:
-                self._fused_update_fn = jax.jit(self.pure_update, donate_argnums=_donation_argnums())
-            new_states = self._fused_update_fn(self.state(), *args, **kwargs)
+            from metrics_tpu.dispatch import fast_dispatch_enabled
+
+            if fast_dispatch_enabled():
+                if self._dispatcher is None:
+                    self._dispatcher = self._make_dispatcher()
+                self._dispatcher.update({}, (), args, kwargs)
+            else:
+                if self._fused_update_fn is None:
+                    self._fused_update_fn = jax.jit(self.pure_update, donate_argnums=_donation_argnums())
+                new_states = self._fused_update_fn(self.state(), *args, **kwargs)
+                self.load_pure_state(new_states, increment=True)
+                return True
         except Exception as err:
             self._fuse_fallback("update", err)
             return False
-        self.load_pure_state(new_states, increment=True)
+        # engine path wrote the new leaves in place; mirror load_pure_state's
+        # bookkeeping without the copies
+        for _, m in self.items(keep_base=True):
+            m._update_count += 1
+            m._computed = None
+            m._forward_cache = None
         return True
 
     def _fused_forward_impl(self, states, counts, *args: Any, **kwargs: Any):
@@ -247,7 +335,16 @@ class MetricCollection:
                 name: jnp.asarray(m._update_count + 1, dtype=jnp.float32)
                 for name, m in self.items(keep_base=True)
             }
-            new_states, batch_vals = self._fused_forward_fn(self.state(), counts, *args, **kwargs)
+            fn = self._fused_forward_fn
+            size_before = fn._cache_size() if hasattr(fn, "_cache_size") else None
+            new_states, batch_vals = fn(self.state(), counts, *args, **kwargs)
+            from metrics_tpu import profiling
+
+            if size_before is not None and fn._cache_size() > size_before:
+                self._dispatch_stats["retraces"] += 1
+                profiling.record_retrace("MetricCollection", "jit")
+            self._dispatch_stats["dispatches"] += 1
+            profiling.record_dispatch("MetricCollection", "jit")
         except Exception as err:
             self._fuse_fallback("forward", err)
             return None
@@ -493,6 +590,7 @@ class MetricCollection:
     def to_device(self, device) -> "MetricCollection":
         for _, m in self.items(keep_base=True):
             m.to_device(device)
+        self._dispatcher = None  # cached executables bound to old placement
         return self
 
     def set_dtype(self, dst_type) -> "MetricCollection":
@@ -556,6 +654,7 @@ class MetricCollection:
             raise ValueError("Unknown input to MetricCollection.")
 
         self._groups_checked = False
+        self._dispatcher = None  # member layout changed; rebuild lazily
         if self._enable_compute_groups:
             self._init_compute_groups()
         else:

@@ -53,6 +53,7 @@ def _accuracy_update(
     multiclass: Optional[bool],
     ignore_index: Optional[int],
     mode: DataType,
+    sample_mask: Optional[Array] = None,
 ) -> Tuple[Array, Array, Array, Array]:
     """Stat scores for accuracy (ref accuracy.py:71-119)."""
     if mode == DataType.MULTILABEL and top_k:
@@ -69,6 +70,7 @@ def _accuracy_update(
         multiclass=multiclass,
         ignore_index=ignore_index,
         mode=mode,
+        sample_mask=sample_mask,
     )
 
 

@@ -1,17 +1,28 @@
 """TP/FP/TN/FN statistics — the backbone of the classification domain.
 
 Behavioral parity: /root/reference/torchmetrics/functional/classification/
-stat_scores.py (438 LoC). The hot path (`_stat_scores`) is elementwise
-compare + axis-sum — trivially fused by XLA. Shape-changing options
-(``ignore_index`` with boolean masking) run eagerly; the common static paths
-(micro/macro/samples reduces, column-drop ignore) are jit-clean.
+stat_scores.py (438 LoC). The hot path is jit-clean end to end:
+
+* The common multiclass case — ``(B, C)`` float scores vs ``(B,)`` integer
+  labels with a micro/macro reduce — takes an argmax-free fast path
+  (:func:`_fast_multiclass_stat_scores`) that never materializes the
+  ``(B, C)`` one-hots: predicted classes come from a max-compare +
+  min-index reduction (first-occurrence tie semantics, bit-identical to
+  the one-hot path) and the four counts from derived identities.
+* Negative ``ignore_index`` is a ``where``-masked static-shape transform
+  for micro/macro reduces (ignored rows contribute exactly zero to every
+  count); the eager row-drop survives only as the documented fallback for
+  the shape-changing ``samples``/``samplewise`` reduces.
+* ``sample_mask`` threads a per-row validity mask through the whole
+  pipeline so shape-bucketed (padded) batches from the fast-dispatch
+  engine are exact: a masked row is a no-op in all four counts.
 """
 from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.checks import _check_classification_inputs, _input_format_classification
 from metrics_tpu.utilities.enums import AverageMethod, DataType, MDMCAverageMethod
 
 Array = jax.Array
@@ -39,13 +50,51 @@ def _drop_negative_ignored_indices(
     return preds, target
 
 
+def _mask_negative_ignored_indices(
+    preds: Array,
+    target: Array,
+    ignore_index: int,
+    mode: DataType,
+    sample_mask: Optional[Array],
+) -> Tuple[Array, Array, Optional[Array]]:
+    """``where``-masked, static-shape variant of
+    :func:`_drop_negative_ignored_indices`: instead of dropping the rows
+    whose target equals the negative ``ignore_index`` (data-dependent
+    shapes, eager-only), the rows are kept, their targets sanitized to a
+    valid class, and their contribution zeroed by a validity mask applied
+    in the final sums — exactly equivalent for the collapsing micro/macro
+    reduces, and jit/trace-clean."""
+    if sample_mask is not None and sample_mask.shape != target.shape:
+        # engine masks are per batch row; expand across target's extra dims
+        sample_mask = jnp.broadcast_to(
+            sample_mask.reshape(sample_mask.shape + (1,) * (target.ndim - sample_mask.ndim)),
+            target.shape,
+        )
+
+    if mode == DataType.MULTIDIM_MULTICLASS and jnp.issubdtype(preds.dtype, jnp.floating):
+        num_classes = preds.shape[1]
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+        target = target.reshape(-1)
+        if sample_mask is not None:
+            sample_mask = sample_mask.reshape(-1)
+
+    if mode in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+        keep = target != ignore_index
+        target = jnp.where(keep, target, 0)
+        sample_mask = keep if sample_mask is None else (sample_mask & keep)
+    return preds, target, sample_mask
+
+
 def _stat_scores(
     preds: Array,
     target: Array,
     reduce: Optional[str] = "micro",
+    sample_mask: Optional[Array] = None,
 ) -> Tuple[Array, Array, Array, Array]:
     """Vectorized tp/fp/tn/fn sums over the dims implied by ``reduce``
-    (ref stat_scores.py:63-107)."""
+    (ref stat_scores.py:63-107). ``sample_mask`` (axis-0 validity, only for
+    the collapsing micro/macro reduces) makes masked rows count zero in all
+    four sums."""
     dim: Union[int, Tuple[int, ...]] = 1  # for "samples"
     if reduce == "micro":
         dim = (0, 1) if preds.ndim == 2 else (1, 2)
@@ -55,6 +104,11 @@ def _stat_scores(
     true_pred, false_pred = target == preds, target != preds
     pos_pred, neg_pred = preds == 1, preds == 0
 
+    if sample_mask is not None:
+        mask = sample_mask.reshape((-1,) + (1,) * (preds.ndim - 1)).astype(bool)
+        true_pred = true_pred & mask
+        false_pred = false_pred & mask
+
     tp = (true_pred & pos_pred).sum(axis=dim)
     fp = (false_pred & pos_pred).sum(axis=dim)
     tn = (true_pred & neg_pred).sum(axis=dim)
@@ -62,6 +116,110 @@ def _stat_scores(
 
     dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     return tp.astype(dtype), fp.astype(dtype), tn.astype(dtype), fn.astype(dtype)
+
+
+def _fast_multiclass_eligible(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str],
+    top_k: Optional[int],
+    multiclass: Optional[bool],
+    num_classes: Optional[int],
+) -> bool:
+    """Shape/config gate for the argmax-free multiclass fast path."""
+    return (
+        reduce in ("micro", "macro")
+        and getattr(preds, "ndim", 0) == 2
+        and getattr(target, "ndim", 0) == 1
+        and preds.shape[0] == target.shape[0]
+        and preds.shape[0] > 0
+        and preds.shape[1] > 1
+        and jnp.issubdtype(preds.dtype, jnp.floating)
+        and jnp.issubdtype(target.dtype, jnp.integer)
+        and top_k in (None, 1)
+        and multiclass is not False
+        and (num_classes is None or num_classes == preds.shape[1])
+    )
+
+
+def _fast_multiclass_stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: str,
+    ignore_index: Optional[int],
+    sample_mask: Optional[Array],
+) -> Tuple[Array, Array, Array, Array]:
+    """tp/fp/tn/fn for ``(B, C)`` float scores vs ``(B,)`` int labels without
+    one-hot materialization.
+
+    The predicted class is recovered with first-occurrence argmax semantics
+    via max-compare + min-index (XLA lowers this several times faster than
+    its CPU argmax), and the four counts follow from identities on the
+    predicted/target class masks: ``fp[c] = #pred(c) - tp[c]``,
+    ``fn[c] = #target(c) - tp[c]``, ``tn[c] = rows - tp - fp - fn``.
+    Bit-identical (including ties) to formatting through one-hots.
+    ``ignore_index`` here is the non-negative column-ignore variant;
+    negative ignore arrives pre-folded into ``sample_mask``.
+    """
+    num_rows, num_classes = preds.shape
+    class_idx = jnp.arange(num_classes, dtype=jnp.int32)
+    row_max = preds.max(axis=-1, keepdims=True)
+    candidates = jnp.where(preds == row_max, class_idx, num_classes)
+    pred_cls = candidates.min(axis=-1)
+    target_cls = target.astype(jnp.int32)
+    correct = pred_cls == target_cls
+
+    if sample_mask is not None:
+        valid = sample_mask.astype(bool)
+        n_valid = valid.sum()
+        correct = correct & valid
+    else:
+        valid = None
+        n_valid = num_rows
+
+    dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+    if reduce == "micro":
+        # column-drop ignore semantics, derived: predictions/targets hitting
+        # the ignored class fall out of every count, cells shrink to C-1
+        if ignore_index is not None:
+            t_ok = target_cls != ignore_index
+            p_ok = pred_cls != ignore_index
+            if valid is not None:
+                t_ok = t_ok & valid
+                p_ok = p_ok & valid
+            tp = (correct & t_ok).sum()
+            fp = p_ok.sum() - tp
+            fn = t_ok.sum() - tp
+            tn = n_valid * (num_classes - 1) - tp - fp - fn
+        else:
+            tp = correct.sum()
+            fp = n_valid - tp
+            fn = n_valid - tp
+            tn = n_valid * num_classes - tp - fp - fn
+        return tp.astype(dtype), fp.astype(dtype), tn.astype(dtype), fn.astype(dtype)
+
+    # macro: all three per-class counts land in ONE scatter-add — index
+    # vector [target, pred+C, target+2C] with weights [valid, valid,
+    # correct]. One pass over 3B elements beats three B×C one-hot
+    # reductions on XLA CPU by ~1.5×; masked (padded) rows carry weight 0
+    # so they contribute to nothing.
+    w = valid.astype(dtype) if valid is not None else jnp.ones(num_rows, dtype)
+    idx = jnp.concatenate([target_cls, pred_cls + num_classes, target_cls + 2 * num_classes])
+    wts = jnp.concatenate([w, w, correct.astype(dtype)])
+    counts = jnp.zeros(3 * num_classes, dtype).at[idx].add(wts)
+    targ_count = counts[:num_classes]
+    pred_count = counts[num_classes : 2 * num_classes]
+    tp = counts[2 * num_classes :]
+    fp = pred_count - tp
+    fn = targ_count - tp
+    tn = (jnp.asarray(n_valid, dtype) - tp - fp - fn).astype(dtype)
+    if ignore_index is not None:
+        tp = tp.at[ignore_index].set(-1)
+        fp = fp.at[ignore_index].set(-1)
+        tn = tn.at[ignore_index].set(-1)
+        fn = fn.at[ignore_index].set(-1)
+    return tp, fp, tn, fn
 
 
 def _stat_scores_update(
@@ -75,13 +233,66 @@ def _stat_scores_update(
     multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
     mode: Optional[DataType] = None,
+    sample_mask: Optional[Array] = None,
 ) -> Tuple[Array, Array, Array, Array]:
-    """Format inputs and accumulate tp/fp/tn/fn (ref stat_scores.py:110-193)."""
+    """Format inputs and accumulate tp/fp/tn/fn (ref stat_scores.py:110-193).
+
+    ``sample_mask`` is an optional per-batch-row validity mask (bool,
+    axis-0 aligned with the inputs): masked rows contribute exactly zero to
+    every count, which is what makes shape-bucketed (padded) execution
+    exact. Only the collapsing micro/macro reduces support it — the
+    per-sample reduces keep one output row per input row, so a padded row
+    cannot be a no-op there.
+    """
+    if sample_mask is not None and (reduce == "samples" or mdmc_reduce == "samplewise"):
+        raise ValueError(
+            "`sample_mask` requires a collapsing reduce; reduce='samples' and"
+            " mdmc_reduce='samplewise' keep per-sample rows."
+        )
+
     _negative_index_dropped = False
 
     if ignore_index is not None and ignore_index < 0 and mode is not None:
-        preds, target = _drop_negative_ignored_indices(preds, target, ignore_index, mode)
+        if reduce in ("micro", "macro") and mdmc_reduce != "samplewise":
+            # static-shape path: ignored rows are masked out of the sums
+            preds, target, sample_mask = _mask_negative_ignored_indices(
+                preds, target, ignore_index, mode, sample_mask
+            )
+        else:
+            # documented eager fallback: shape-changing reduces need real
+            # row drops (data-dependent shapes, host-side boolean indexing)
+            preds, target = _drop_negative_ignored_indices(preds, target, ignore_index, mode)
         _negative_index_dropped = True
+
+    # a negative ignore_index that was NOT consumed above (mode unknown)
+    # keeps the legacy formatting semantics — stay off the fast path
+    _unhandled_negative_ignore = (
+        ignore_index is not None and ignore_index < 0 and not _negative_index_dropped
+    )
+    if not _unhandled_negative_ignore and _fast_multiclass_eligible(
+        preds, target, reduce, top_k, multiclass, num_classes
+    ):
+        if mode is None:
+            # validation parity with the formatting path: same checks, same
+            # errors (value checks skip under trace there too)
+            checked_mode = _check_classification_inputs(
+                preds,
+                target,
+                threshold=threshold,
+                num_classes=num_classes,
+                multiclass=multiclass,
+                top_k=top_k,
+                ignore_index=ignore_index,
+            )
+        else:
+            checked_mode = mode
+        if checked_mode == DataType.MULTICLASS:
+            fast_ignore = ignore_index if not _negative_index_dropped else None
+            if fast_ignore is not None and fast_ignore >= preds.shape[1]:
+                raise ValueError(
+                    f"The `ignore_index` {fast_ignore} is not valid for inputs with {preds.shape[1]} classes"
+                )
+            return _fast_multiclass_stat_scores(preds, target, reduce, fast_ignore, sample_mask)
 
     preds, target, _ = _input_format_classification(
         preds,
@@ -104,6 +315,13 @@ def _stat_scores_update(
                 "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
             )
         if mdmc_reduce == "global":
+            if sample_mask is not None:
+                # one mask row per (batch, extra-dim) pair, matching the
+                # row order of the reshape below
+                if sample_mask.ndim == 1 and sample_mask.shape[0] != preds.shape[0] * preds.shape[2]:
+                    sample_mask = jnp.repeat(sample_mask, preds.shape[2])
+                else:
+                    sample_mask = sample_mask.reshape(-1)
             preds = jnp.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
             target = jnp.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
 
@@ -111,7 +329,7 @@ def _stat_scores_update(
         preds = _del_column(preds, ignore_index)
         target = _del_column(target, ignore_index)
 
-    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce, sample_mask=sample_mask)
 
     if ignore_index is not None and reduce == "macro" and not _negative_index_dropped:
         tp = tp.at[..., ignore_index].set(-1)

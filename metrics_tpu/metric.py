@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu import profiling
+from metrics_tpu.dispatch import fast_dispatch_enabled
 from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, default_env
 from metrics_tpu.utilities.data import (
     _flatten,
@@ -48,7 +50,7 @@ from metrics_tpu.utilities.data import (
     dim_zero_sum,
 )
 from metrics_tpu.utilities.exceptions import MetricsUserError
-from metrics_tpu.utilities.prints import rank_zero_warn
+from metrics_tpu.utilities.prints import rank_zero_debug, rank_zero_warn
 
 Array = jax.Array
 StateType = Union[Array, List[Array]]
@@ -210,6 +212,11 @@ class Metric(ABC):
         self._jit_update_requested = jit_update
         # None = empty cache; populated lazily as {static-kwarg-key: jitted fn}
         self._jitted_update: Optional[Dict] = None
+        # fast-dispatch engine (AOT executable cache); built lazily on the
+        # first jitted update, permanently disabled for this metric on error
+        self._dispatcher = None
+        self._fast_dispatch_failed = False
+        self._dispatch_stats: Dict[str, int] = {"dispatches": 0, "retraces": 0}
 
         self._update_signature = inspect.signature(self.update)
         self._update_impl: Callable = self.update
@@ -310,6 +317,34 @@ class Metric(ABC):
         try:
             self._load_state(state)
             self._update_impl(*args, **kwargs)
+            return self._copy_state()
+        finally:
+            self._load_state(saved)
+
+    def _masked_update_supported(self) -> bool:
+        """Whether :meth:`_masked_update` makes padded rows exact no-ops for
+        the metric's current configuration. Metrics that opt into shape-
+        bucketed (padded) fast dispatch override this together with
+        :meth:`_masked_update`; the default opts out."""
+        return False
+
+    def _masked_update(self, sample_mask: Array, *args: Any, **kwargs: Any) -> None:
+        """``update`` with an axis-0 validity mask: rows where the mask is
+        False must contribute exactly nothing to the state. Used by the
+        fast-dispatch engine to run padded (shape-bucketed) batches."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement masked updates; "
+            "the fast-dispatch engine will use exact-shape executables."
+        )
+
+    def _masked_pure_update(
+        self, state: Dict[str, StateType], sample_mask: Array, *args: Any, **kwargs: Any
+    ) -> Dict[str, StateType]:
+        """Pure reducer form of :meth:`_masked_update` (see :meth:`pure_update`)."""
+        saved = self._copy_state()
+        try:
+            self._load_state(state)
+            self._masked_update(sample_mask, *args, **kwargs)
             return self._copy_state()
         finally:
             self._load_state(saved)
@@ -521,22 +556,96 @@ class Metric(ABC):
                         key = tuple(sorted(static.items()))
                     else:
                         static, dynamic, key = {}, kwargs, ()
-                    if self._jitted_update is None:
-                        self._jitted_update = {}
-                    fn = self._jitted_update.get(key)
-                    if fn is None:
-                        fn = self._jitted_update[key] = jax.jit(
-                            functools.partial(self.pure_update, **static),
-                            donate_argnums=_donation_argnums(),
-                        )
-                    new_state = fn(self.state(), *args, **dynamic)
-                    self._load_state(new_state)
+                    dispatched = False
+                    if not self._fast_dispatch_failed and fast_dispatch_enabled():
+                        try:
+                            if self._dispatcher is None:
+                                self._dispatcher = self._make_dispatcher()
+                            self._dispatcher.update(static, key, args, dynamic)
+                            dispatched = True
+                        except Exception as err:  # noqa: BLE001 — any engine
+                            # failure demotes to the legacy jit path for good
+                            self._fast_dispatch_failed = True
+                            self._dispatcher = None
+                            rank_zero_debug(
+                                f"fast dispatch disabled for {type(self).__name__}"
+                                f" ({type(err).__name__}: {err}); using jax.jit."
+                            )
+                    if not dispatched:
+                        if self._jitted_update is None:
+                            self._jitted_update = {}
+                        fn = self._jitted_update.get(key)
+                        if fn is None:
+                            fn = self._jitted_update[key] = jax.jit(
+                                functools.partial(self.pure_update, **static),
+                                donate_argnums=_donation_argnums(),
+                            )
+                        size_before = fn._cache_size() if hasattr(fn, "_cache_size") else None
+                        new_state = fn(self.state(), *args, **dynamic)
+                        self._load_state(new_state)
+                        if size_before is not None and fn._cache_size() > size_before:
+                            self._dispatch_stats["retraces"] += 1
+                            profiling.record_retrace(type(self).__name__, "jit")
+                        self._dispatch_stats["dispatches"] += 1
+                        profiling.record_dispatch(type(self).__name__, "jit")
                 else:
                     update(*args, **kwargs)
+                    self._dispatch_stats["dispatches"] += 1
+                    profiling.record_dispatch(type(self).__name__, "eager")
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
         return wrapped_func
+
+    # --------------------------------------------------------- fast dispatch
+    def _make_dispatcher(self):
+        """Build this metric's AOT fast-dispatch engine (lazy, one per metric)."""
+        from metrics_tpu.dispatch import FastDispatcher
+
+        names = list(self._defaults)
+
+        def read_leaves():
+            return tuple(getattr(self, k) for k in names)
+
+        def write_leaves(leaves):
+            for k, v in zip(names, leaves):
+                object.__setattr__(self, k, v)
+
+        def make_update(static):
+            def fn(leaves, *args, **dyn):
+                new = self.pure_update(dict(zip(names, leaves)), *args, **dyn, **static)
+                return tuple(new[k] for k in names)
+
+            return fn
+
+        def make_masked_update(static):
+            def fn(n_valid, leaves, *args, **dyn):
+                padded_len = next(
+                    x.shape[0]
+                    for x in jax.tree_util.tree_leaves((args, dyn))
+                    if getattr(x, "ndim", 0) >= 1
+                )
+                mask = jnp.arange(padded_len, dtype=jnp.int32) < n_valid
+                new = self._masked_pure_update(dict(zip(names, leaves)), mask, *args, **dyn, **static)
+                return tuple(new[k] for k in names)
+
+            return fn
+
+        return FastDispatcher(
+            type(self).__name__,
+            read_leaves,
+            write_leaves,
+            make_update,
+            make_masked_update,
+            masking_ok=self._masked_update_supported,
+            stats=self._dispatch_stats,
+        )
+
+    @property
+    def dispatch_stats(self) -> Dict[str, int]:
+        """Hot-path counters for this metric: device-program ``dispatches``
+        and compile-time ``retraces`` (see :mod:`metrics_tpu.profiling`)."""
+        return dict(self._dispatch_stats)
 
     def _move_list_states_to_cpu(self) -> None:
         """Move accumulated list states to host CPU (ref metric.py:282-287)."""
@@ -928,6 +1037,7 @@ class Metric(ABC):
                 "_update_signature",
                 "_jitted_update",
                 "_batched_compute_jit",
+                "_dispatcher",
             )
         }
 
@@ -939,6 +1049,9 @@ class Metric(ABC):
         self.update = self._wrap_update(self._update_impl)  # type: ignore[method-assign]
         self.compute = self._wrap_compute(self._compute_impl)  # type: ignore[method-assign]
         self._jitted_update = None
+        self._dispatcher = None
+        self._dispatch_stats = dict(self.__dict__.get("_dispatch_stats") or {"dispatches": 0, "retraces": 0})
+        self._fast_dispatch_failed = bool(self.__dict__.get("_fast_dispatch_failed", False))
 
     def __setattr__(self, name: str, value: Any) -> None:
         if name in ("higher_is_better", "is_differentiable", "full_state_update"):
@@ -977,6 +1090,8 @@ class Metric(ABC):
                 self._defaults[attr] = _put(default)
         if self._cache is not None:
             self._cache = {k: ([_put(x) for x in v] if isinstance(v, list) else _put(v)) for k, v in self._cache.items()}
+        # cached executables are bound to the old device placement
+        self._dispatcher = None
         for _, child in self._children():
             child.to_device(device)
         return self

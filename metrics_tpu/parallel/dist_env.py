@@ -85,7 +85,9 @@ class AxisEnv(DistEnv):
         self.axis_name = axis_name
 
     def world_size(self) -> int:
-        return jax.lax.axis_size(self.axis_name)
+        from metrics_tpu._compat import axis_size
+
+        return axis_size(self.axis_name)
 
     def all_gather(self, x: Array) -> List[Array]:
         gathered = jax.lax.all_gather(jnp.atleast_1d(x), self.axis_name)  # (world, ...)

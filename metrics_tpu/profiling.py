@@ -1,0 +1,117 @@
+"""Dispatch-count and retrace-count observability for the metric hot path.
+
+The round-5 benchmark prose argued the fused/AOT paths are "RTT-bound, not
+compute-bound" — this module turns that claim into structure. Every device
+program the library launches on the update hot path is *counted* at the
+call site:
+
+* ``aot``       — a cached ahead-of-time compiled executable call (the
+  fast-dispatch engine, :mod:`metrics_tpu.dispatch`). Exactly one device
+  program per record.
+* ``fused-aot`` — the same, for a whole ``MetricCollection`` (N metrics,
+  one launch).
+* ``jit``       — a ``jax.jit`` dispatch on the legacy ``jit_update`` path.
+* ``eager``     — one eager ``update()`` call. This is a *metric-level*
+  count: an eager update issues one-or-more op-by-op device dispatches that
+  XLA never fuses, so each record stands for "at least one" program.
+
+Retrace records count compilations: the engine records one per
+``lower().compile()`` and the legacy jit path one per trace-cache growth.
+
+Usage::
+
+    with track_dispatches() as tracker:
+        collection.update(preds, target)
+    assert tracker.dispatches == 1          # one fused launch for N metrics
+    assert tracker.retraces == 1            # compiled once, cached after
+
+Per-metric counters live on the objects themselves (``Metric.dispatch_stats``
+/ ``MetricCollection.dispatch_stats``); this module only aggregates across
+whatever ran inside the context. Trackers nest — each active context sees
+every event recorded while it is open. Counting is host-side bookkeeping
+(no JAX hooks, no device work), so leaving it always-on costs a few dict
+increments per update.
+"""
+import threading
+from contextlib import contextmanager
+from typing import Dict, Generator, List, Tuple
+
+_lock = threading.Lock()
+_active_trackers: List["DispatchTracker"] = []
+
+
+class DispatchTracker:
+    """Aggregated dispatch/retrace counts recorded while a context is open.
+
+    Attributes:
+        dispatches: total device-program launches recorded (all kinds).
+        retraces: total compilations recorded (all kinds).
+        events: ``(owner, kind)`` tuples in record order, for debugging.
+    """
+
+    def __init__(self) -> None:
+        self.dispatches = 0
+        self.retraces = 0
+        self.events: List[Tuple[str, str]] = []
+        self._dispatch_by_kind: Dict[str, int] = {}
+        self._retrace_by_kind: Dict[str, int] = {}
+
+    def dispatch_count(self, kind: str = None, owner: str = None) -> int:
+        """Dispatches filtered by ``kind`` and/or an ``owner`` substring."""
+        if kind is None and owner is None:
+            return self.dispatches
+        if owner is None:
+            return self._dispatch_by_kind.get(kind, 0)
+        return sum(
+            1
+            for o, k in self.events
+            if not k.startswith("retrace:")
+            and (kind is None or k == kind)
+            and owner in o
+        )
+
+    def retrace_count(self, kind: str = None) -> int:
+        if kind is None:
+            return self.retraces
+        return self._retrace_by_kind.get(kind, 0)
+
+    def _record_dispatch(self, owner: str, kind: str) -> None:
+        self.dispatches += 1
+        self._dispatch_by_kind[kind] = self._dispatch_by_kind.get(kind, 0) + 1
+        self.events.append((owner, kind))
+
+    def _record_retrace(self, owner: str, kind: str) -> None:
+        self.retraces += 1
+        self._retrace_by_kind[kind] = self._retrace_by_kind.get(kind, 0) + 1
+        self.events.append((owner, f"retrace:{kind}"))
+
+
+def record_dispatch(owner: str, kind: str) -> None:
+    """Record one device-program launch on behalf of ``owner``."""
+    if not _active_trackers:
+        return
+    with _lock:
+        for tracker in _active_trackers:
+            tracker._record_dispatch(owner, kind)
+
+
+def record_retrace(owner: str, kind: str) -> None:
+    """Record one compilation (trace + compile) on behalf of ``owner``."""
+    if not _active_trackers:
+        return
+    with _lock:
+        for tracker in _active_trackers:
+            tracker._record_retrace(owner, kind)
+
+
+@contextmanager
+def track_dispatches() -> Generator[DispatchTracker, None, None]:
+    """Count every hot-path dispatch/retrace issued inside the block."""
+    tracker = DispatchTracker()
+    with _lock:
+        _active_trackers.append(tracker)
+    try:
+        yield tracker
+    finally:
+        with _lock:
+            _active_trackers.remove(tracker)

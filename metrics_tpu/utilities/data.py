@@ -52,6 +52,15 @@ def bucket_pow2(n: int, minimum: int = 8) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def pad_axis0(x: Array, size: int) -> Array:
+    """Zero-pad ``x`` along axis 0 up to ``size`` rows (no-op when already
+    there; scalars pass through). Companion of :func:`bucket_pow2` — padded
+    rows are expected to be neutralized by a validity mask downstream."""
+    if getattr(x, "ndim", 0) == 0 or x.shape[0] >= size:
+        return x
+    return jnp.pad(x, [(0, size - x.shape[0])] + [(0, 0)] * (x.ndim - 1))
+
+
 def _flatten(x: Sequence) -> list:
     """Flatten one level of nesting (ref data.py:59)."""
     return [item for sublist in x for item in sublist]

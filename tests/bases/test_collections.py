@@ -222,7 +222,7 @@ def test_collection_pure_update_matches_stateful():
 
 def test_collection_pure_sync_over_mesh():
     import jax
-    from jax import shard_map
+    from metrics_tpu._compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     n = len(jax.devices())

@@ -1,0 +1,122 @@
+"""Parity tests for negative ``ignore_index`` handling.
+
+The classification pipeline has two equivalent implementations for a negative
+``ignore_index``: the historical eager row-drop (data-dependent shapes, cannot
+trace) and the ``where``-masked static-shape variant used for micro/macro
+reduces so the hot path stays jit-clean end to end. Both must agree bit-for-bit
+with each other, eagerly and under ``jax.jit``.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import importlib
+
+from metrics_tpu.functional import accuracy
+from metrics_tpu.functional.classification.stat_scores import _stat_scores_update
+from metrics_tpu.utilities.enums import DataType
+
+ss_mod = importlib.import_module("metrics_tpu.functional.classification.stat_scores")
+
+NUM_CLASSES = 6
+
+
+def _inputs(rng, b=64, with_probs=True):
+    """Targets include -1 rows that a negative ignore_index must drop."""
+    target = jnp.asarray(rng.randint(-1, NUM_CLASSES, b))
+    if with_probs:
+        logits = rng.rand(b, NUM_CLASSES).astype(np.float32)
+        preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    else:
+        preds = jnp.asarray(rng.randint(0, NUM_CLASSES, b))
+    return preds, target
+
+
+@pytest.mark.parametrize("reduce", ["micro", "macro"])
+@pytest.mark.parametrize("with_probs", [True, False])
+def test_masked_matches_eager_drop(reduce, with_probs):
+    rng = np.random.RandomState(0)
+    preds, target = _inputs(rng, with_probs=with_probs)
+
+    masked = _stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce="global",
+        num_classes=NUM_CLASSES, ignore_index=-1, mode=DataType.MULTICLASS,
+    )
+
+    # reference: explicit eager row-drop before computing the counts
+    keep = np.asarray(target) != -1
+    dropped = _stat_scores_update(
+        preds[keep], target[keep], reduce=reduce, mdmc_reduce="global",
+        num_classes=NUM_CLASSES, ignore_index=None,
+    )
+    for got, want in zip(masked, dropped):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("reduce", ["micro", "macro"])
+def test_masked_variant_is_jit_clean(reduce):
+    """The masked path must trace: same numbers under jax.jit as eagerly."""
+    rng = np.random.RandomState(1)
+    preds, target = _inputs(rng)
+    fn = partial(
+        _stat_scores_update, reduce=reduce, mdmc_reduce="global",
+        num_classes=NUM_CLASSES, ignore_index=-1, mode=DataType.MULTICLASS,
+    )
+    eager = fn(preds, target)
+    jitted = jax.jit(fn)(preds, target)
+    for got, want in zip(jitted, eager):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_accuracy_negative_ignore_jit_parity(average):
+    rng = np.random.RandomState(2)
+    preds, target = _inputs(rng)
+    fn = partial(accuracy, average=average, num_classes=NUM_CLASSES, ignore_index=-1)
+    eager = float(fn(preds, target))
+    jitted = float(jax.jit(fn)(preds, target))
+    assert jitted == pytest.approx(eager)
+    # cross-check against accuracy over the manually cleaned batch
+    keep = np.asarray(target) != -1
+    clean = float(fn(preds[keep], target[keep]))
+    assert eager == pytest.approx(clean)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_mdmc_global_negative_ignore_jit_parity(average):
+    rng = np.random.RandomState(3)
+    preds = jnp.asarray(rng.rand(8, NUM_CLASSES, 10).astype(np.float32))
+    target = jnp.asarray(rng.randint(-1, NUM_CLASSES, (8, 10)))
+    fn = partial(accuracy, average=average, mdmc_average="global",
+                 num_classes=NUM_CLASSES, ignore_index=-1)
+    eager = float(fn(preds, target))
+    jitted = float(jax.jit(fn)(preds, target))
+    assert jitted == pytest.approx(eager)
+
+
+def test_samples_reduce_keeps_eager_drop_fallback():
+    """Shape-changing reduces cannot mask (one output row per kept sample);
+    they must still route through the documented eager row-drop."""
+    rng = np.random.RandomState(4)
+    preds, target = _inputs(rng, b=40)
+    res = accuracy(preds, target, average="samples",
+                   num_classes=NUM_CLASSES, ignore_index=-1)
+    keep = np.asarray(target) != -1
+    want = accuracy(preds[keep], target[keep], average="samples",
+                    num_classes=NUM_CLASSES)
+    assert float(res) == pytest.approx(float(want))
+
+
+def test_mask_and_drop_helpers_agree():
+    """Direct check of the two transforms feeding identical count totals."""
+    rng = np.random.RandomState(5)
+    preds, target = _inputs(rng, b=32)
+    p_drop, t_drop = ss_mod._drop_negative_ignored_indices(preds, target, -1, DataType.MULTICLASS)
+    p_mask, t_mask, mask = ss_mod._mask_negative_ignored_indices(preds, target, -1, DataType.MULTICLASS, None)
+    assert p_mask.shape == preds.shape  # static shape preserved
+    assert int(mask.sum()) == t_drop.shape[0]  # same surviving rows
+    np.testing.assert_array_equal(np.asarray(t_mask)[np.asarray(mask)], np.asarray(t_drop))
+    np.testing.assert_array_equal(np.asarray(p_mask)[np.asarray(mask)], np.asarray(p_drop))

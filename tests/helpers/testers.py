@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from metrics_tpu._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu.metric import Metric

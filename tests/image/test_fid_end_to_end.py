@@ -38,6 +38,8 @@ import os
 import sys
 
 import jax
+
+from metrics_tpu._compat import enable_x64
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -79,7 +81,7 @@ def repo_fid_from_npz(npz, real_u8, fake_u8):
     """Checkpoint file → extractor → FID, both state layouts, f64 eigh."""
     from metrics_tpu.image import FrechetInceptionDistance, InceptionV3FeatureExtractor
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         ext = InceptionV3FeatureExtractor(weights_path=npz, dtype=jnp.float64)
         fid_list = FrechetInceptionDistance(feature_extractor=ext, sqrtm_method="eigh")
         fid_mom = FrechetInceptionDistance(

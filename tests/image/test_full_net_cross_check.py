@@ -16,6 +16,8 @@ import os
 import sys
 
 import jax
+
+from metrics_tpu._compat import enable_x64
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -181,7 +183,7 @@ def test_inception_full_forward_matches_torch():
 
     from metrics_tpu.image.inception_net import InceptionV3
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         state = _make_inception_state(seed=21)
         flat = convert_state_dict(state)
         variables = unflatten_dict(
@@ -206,7 +208,7 @@ def test_inception_e_blocks_match_torch():
 
     from metrics_tpu.image.inception_net import InceptionE
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         state = _make_inception_state(seed=21)
         flat = convert_state_dict(state)
         variables = unflatten_dict(
@@ -483,7 +485,7 @@ def test_inception_intermediate_taps_match_torch():
 
     from metrics_tpu.image.inception_net import InceptionV3FeatureExtractor
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         state = _make_inception_state(seed=21)
         flat = convert_state_dict(state)
         x = np.random.RandomState(23).rand(2, 3, 75, 75).astype(np.float64)

@@ -30,6 +30,8 @@ import os
 import sys
 
 import jax
+
+from metrics_tpu._compat import enable_x64
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -96,7 +98,7 @@ def repo_is_from_npz(npz, fake_u8):
     both the list path and the fixed-shape streaming path."""
     from metrics_tpu.image import InceptionScore, InceptionV3FeatureExtractor
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         ext = InceptionV3FeatureExtractor(
             weights_path=npz, output="logits_unbiased", dtype=jnp.float64
         )
@@ -112,7 +114,7 @@ def repo_is_from_npz(npz, fake_u8):
 def repo_kid_from_npz(npz, real_u8, fake_u8, n):
     from metrics_tpu.image import InceptionV3FeatureExtractor, KernelInceptionDistance
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         ext = InceptionV3FeatureExtractor(weights_path=npz, dtype=jnp.float64)
         kid = KernelInceptionDistance(feature_extractor=ext, subsets=2, subset_size=n)
         kid.update(jnp.asarray(real_u8), real=True)

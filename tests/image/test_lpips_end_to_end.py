@@ -35,6 +35,8 @@ import os
 import sys
 
 import jax
+
+from metrics_tpu._compat import enable_x64
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -95,7 +97,7 @@ def repo_lpips_from_npz(npz, net, batches):
         lpips_sum.update(jnp.asarray(img1), jnp.asarray(img2))
     mean_f32, sum_f32 = float(lpips_f32.compute()), float(lpips_sum.compute())
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         net64 = LPIPSNet(net_type=net, weights_path=npz, dtype=jnp.float64)
         lpips_f64 = LearnedPerceptualImagePatchSimilarity(net=net64)
         for img1, img2 in batches:

@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from metrics_tpu._compat import enable_x64
 from metrics_tpu.image.fid import FrechetInceptionDistance
 from metrics_tpu.image.inception import InceptionScore
 from metrics_tpu.image.kid import KernelInceptionDistance
@@ -134,7 +135,7 @@ class TestStreamingFID:
         # sum-reduced moment states sync with ONE collective per state
         # over a mesh axis; the synced state equals single-device totals
         import jax
-        from jax import shard_map
+        from metrics_tpu._compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
 
         devices = jax.devices()
@@ -315,7 +316,7 @@ class TestStreamingKID:
     def test_x64_buffer_update(self):
         # regression: int32 count vs int64 literal index crashed under x64,
         # and the buffer must follow x64 so f64 features aren't downcast
-        with jax.enable_x64(True):
+        with enable_x64(True):
             kid = KernelInceptionDistance(feature_dim=D, max_samples=64)
             feats = jnp.asarray(np.random.RandomState(0).rand(8, D))  # float64
             assert feats.dtype == jnp.float64
