@@ -221,6 +221,109 @@ def _cfg_dispatch_engine(detail: dict) -> None:
     detail["retrace_count_bucketed_latency_pair"] = m2.dispatch_stats["retraces"]
 
 
+def _cfg_sync_engine(detail: dict) -> None:
+    """Fused sync engine observability: structural collective / bucket /
+    wire-byte counts from ``metrics_tpu.profiling.track_syncs`` plus
+    fused-vs-per-leaf sync latency.
+
+    Like the dispatch counts above, the collective count is a structural
+    property: syncing a 5-member classification collection (17 fixed-shape
+    int32-sum leaves) is ONE packed collective under the fused engine vs
+    one per leaf on the legacy path, independent of interconnect health.
+    A world-2 loopback env keeps the measurement in-process — every
+    collective sees its own state twice, so values stay exact while the
+    counts and byte totals are the real wire schedule."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import (
+        Accuracy, ConfusionMatrix, F1Score, MetricCollection, Precision, Recall, profiling,
+    )
+    from metrics_tpu.parallel.dist_env import NoOpEnv
+
+    class _Loopback2(NoOpEnv):
+        def world_size(self):
+            return 2
+
+        def all_gather(self, x):
+            x = jnp.atleast_1d(x)
+            return [x, x]
+
+        def all_reduce(self, x, op):
+            stacked = jnp.stack([jnp.atleast_1d(x)] * 2)
+            red = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}.get(op)
+            return None if red is None else red(stacked, axis=0)
+
+    C = 32
+    rng = np.random.RandomState(11)
+    logits = rng.rand(256, C).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, C, 256))
+    env = _Loopback2()
+
+    def build():
+        mc = MetricCollection(
+            {"acc": Accuracy(num_classes=C, average="macro"),
+             "f1": F1Score(num_classes=C, average="macro"),
+             "prec": Precision(num_classes=C, average="macro"),
+             "rec": Recall(num_classes=C, average="macro"),
+             "cm": ConfusionMatrix(num_classes=C)},
+            compute_groups=False,
+        )
+        mc.update(preds, target)
+        jax.block_until_ready(mc["acc"].tp)
+        return mc
+
+    def timed_roundtrips(sync_fn, unsync_fn):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(20):
+                sync_fn()
+                unsync_fn()
+            best = min(best, (time.perf_counter() - t0) / 20 * 1e6)
+        return round(best, 1)
+
+    # (1) fused: structural counts for ONE collection-level sync, then latency
+    mc = build()
+    with profiling.track_syncs() as t:
+        mc.sync(env=env)
+    mc.unsync()
+    detail["sync_collectives_fused_collection"] = t.collectives
+    detail["sync_bucket_count_fused_collection"] = t.buckets
+    detail["sync_bytes_fused_collection"] = t.bytes_on_wire
+    detail["sync_us_fused_collection"] = timed_roundtrips(
+        lambda: mc.sync(env=env), mc.unsync)
+
+    # (2) kill switch: the same sync per-leaf (one collective per state leaf)
+    prev = os.environ.get("METRICS_TPU_FUSED_SYNC")
+    os.environ["METRICS_TPU_FUSED_SYNC"] = "0"
+    try:
+        mc0 = build()
+        with profiling.track_syncs() as t0:
+            for m in mc0.values():
+                m.sync(env=env)
+        for m in mc0.values():
+            m.unsync()
+        detail["sync_collectives_perleaf_collection"] = t0.collectives
+        detail["sync_bytes_perleaf_collection"] = t0.bytes_on_wire
+
+        def sync_all():
+            for m in mc0.values():
+                m.sync(env=env)
+
+        def unsync_all():
+            for m in mc0.values():
+                m.unsync()
+
+        detail["sync_us_perleaf_collection"] = timed_roundtrips(sync_all, unsync_all)
+    finally:
+        if prev is None:
+            os.environ.pop("METRICS_TPU_FUSED_SYNC", None)
+        else:
+            os.environ["METRICS_TPU_FUSED_SYNC"] = prev
+
+
 def _machinery_device(detail: dict):
     """Host CPU device for the compute-group machinery configs.
 
@@ -817,6 +920,7 @@ def _bench_detail() -> dict:
         ("bertscore_update_ms_256_sents", _cfg_bertscore),
         ("wer_update_ms_1k_pairs", _cfg_wer),
         ("collection_dist_sync_8dev_us", _cfg_dist_sync),
+        ("sync_collectives_fused_collection", _cfg_sync_engine),
     ]
     detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
     return detail
@@ -1031,6 +1135,7 @@ def _bench_detail_fast() -> dict:
     configs = [
         ("collection", _cfg_collection),
         ("dispatch_engine", _cfg_dispatch_engine),
+        ("sync_engine", _cfg_sync_engine),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),

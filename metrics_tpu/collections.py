@@ -13,14 +13,18 @@ work inside one compiled step — the compiler-native counterpart of compute
 groups.
 """
 from collections import OrderedDict
+from contextlib import contextmanager
 from copy import deepcopy
-from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, Generator, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu import sync_engine
 from metrics_tpu.metric import Metric, _donation_argnums, _raise_if_list_state, _scan_fold
+from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, default_env
 from metrics_tpu.utilities.data import _flatten_dict, _squeeze_if_scalar
+from metrics_tpu.utilities.exceptions import MetricsUserError
 from metrics_tpu.utilities.prints import rank_zero_debug, rank_zero_warn
 
 
@@ -93,6 +97,11 @@ class MetricCollection:
         self._fused_forward_fn = None
         self._dispatcher = None  # AOT fast-dispatch engine for fused updates
         self._dispatch_stats: Dict[str, int] = {"dispatches": 0, "retraces": 0}
+        # comms counters for the fused collection-level sync (profiling.py)
+        self._sync_stats: Dict[str, int] = {"collectives": 0, "buckets": 0, "bytes_on_wire": 0}
+        # (member, saved _to_sync, saved _should_unsync) while a collection
+        # sync is active; None when not synced
+        self._synced_members: Optional[List[Tuple[Metric, bool, bool]]] = None
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -110,6 +119,8 @@ class MetricCollection:
         self._fused_forward_fn = None
         self._dispatcher = None
         self._dispatch_stats = dict(self.__dict__.get("_dispatch_stats") or {"dispatches": 0, "retraces": 0})
+        self._sync_stats = dict(self.__dict__.get("_sync_stats") or {"collectives": 0, "buckets": 0, "bytes_on_wire": 0})
+        self._synced_members = self.__dict__.get("_synced_members", None)
 
     # --------------------------------------------------------------- mapping
     def __getitem__(self, key: str) -> Metric:
@@ -478,10 +489,181 @@ class MetricCollection:
                     object.__setattr__(mi, state, list(value) if isinstance(value, list) else value)
                 mi._update_count = m0._update_count
 
-    def compute(self) -> Dict[str, Any]:
-        """Compute every metric, sharing leader state within groups (ref :215-227)."""
+    # ------------------------------------------------------------------ sync
+    @property
+    def sync_stats(self) -> Dict[str, int]:
+        """Comms counters for the collection-level fused sync: collectives
+        issued on behalf of the whole collection, fused buckets among them,
+        and payload bytes (see :mod:`metrics_tpu.profiling`). Collectives a
+        member issues for its own non-bucketed leaves land in that member's
+        ``Metric.sync_stats`` instead."""
+        return dict(self._sync_stats)
+
+    @staticmethod
+    def _sync_fusable(m: Metric, env: DistEnv) -> bool:
+        # only metrics on the stock sync protocol can join the shared bucket
+        # pass: custom gathers must see every state, subclassed sync
+        # machinery (CompositionalMetric) keeps its own semantics, an
+        # explicit foreign env picks different peers, and already-synced or
+        # memoized members have nothing to sync
+        return (
+            type(m)._sync_dist is Metric._sync_dist
+            and type(m).sync is Metric.sync
+            and type(m).unsync is Metric.unsync
+            and m.dist_sync_fn is None
+            and not m._is_synced
+            and m._computed is None
+            and (m._sync_env is None or m._sync_env is env)
+        )
+
+    def sync(self, env: Optional[DistEnv] = None, should_sync: bool = True) -> None:
+        """Sync every member across the ambient environment ONCE.
+
+        Fixed-shape reduce-states of every compute-group leader are packed
+        into shared per-(dtype, op) buckets — one collective per bucket for
+        the WHOLE collection (see :mod:`metrics_tpu.sync_engine`) instead of
+        one per member state leaf — then each leader syncs its remaining
+        list/ragged leaves, and followers adopt their leader's synced state
+        without touching the interconnect at all. Synced members are flagged
+        so their own ``compute()`` neither re-syncs nor self-unsyncs; call
+        :meth:`unsync` (or use :meth:`sync_context`, which ``compute`` does)
+        to restore local states.
+
+        No-ops when the env is not distributed or the fused engine is
+        disabled (``METRICS_TPU_FUSED_SYNC=0``) — members then sync
+        themselves inside their own ``compute()``, the pre-engine protocol.
+        """
+        if self._synced_members is not None:
+            # mirrors Metric.sync: an explicit re-sync raises, a
+            # should_sync=False request (compute inside a user-held
+            # sync_context) is a no-op
+            if should_sync:
+                raise MetricsUserError("The MetricCollection has already been synced.")
+            return
+        if env is None:
+            env = next(
+                (m._sync_env for _, m in self.items(keep_base=True) if m._sync_env is not None),
+                None,
+            ) or default_env()
+        if not should_sync or not env.is_distributed() or not sync_engine.fused_sync_enabled():
+            return
+
         self._compute_groups_create_state_ref()
-        res = {k: m.compute() for k, m in self.items(keep_base=True)}
+        use_groups = bool(self._enable_compute_groups and self._groups_checked)
+        if use_groups:
+            leaders = [self._modules[cg[0]] for cg in self._groups.values()]
+        else:
+            leaders = [m for _, m in self.items(keep_base=True)]
+        fused_members = [m for m in leaders if self._sync_fusable(m, env)]
+
+        synced: List[Metric] = []
+        try:
+            for m in fused_members:
+                m._cache = m._copy_state()
+            # one shared bucket pass across every fusable leader
+            specs: List[Any] = []
+            handled: Dict[int, set] = {}
+            for i, m in enumerate(fused_members):
+                member_specs = sync_engine.plan_metric_leaves(
+                    m, {a: getattr(m, a) for a in m._reductions}, tag=i
+                )
+                specs.extend(member_specs)
+                handled[i] = {spec.key[1] for spec in member_specs}
+            results = sync_engine.execute_buckets(
+                env, specs, owner="MetricCollection", stats=self._sync_stats
+            )
+            for (i, attr), val in results.items():
+                object.__setattr__(fused_members[i], attr, val)
+            # remaining leaves (list/ragged/custom-reduced) per leader
+            for i, m in enumerate(fused_members):
+                m._sync_dist(None, env=env, exclude=tuple(handled[i]))
+                m._is_synced = True
+                synced.append(m)
+        except Exception:
+            for m in fused_members:
+                if m not in synced and m._cache is not None:
+                    m._load_state(m._cache)
+                    m._cache = None
+            for m in synced:
+                m.unsync()
+            raise
+
+        # followers adopt their leader's synced state — zero collectives;
+        # their unsync cache is the leader's pre-sync state, which is what
+        # the legacy flow (state ref copy, then self-sync) restored too
+        if use_groups:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                if m0 not in fused_members:
+                    continue
+                for name in cg[1:]:
+                    mi = self._modules[name]
+                    if mi._is_synced or mi._computed is not None:
+                        continue
+                    mi._cache = {
+                        k: (list(v) if isinstance(v, list) else v) for k, v in m0._cache.items()
+                    }
+                    for state in m0._defaults:
+                        value = getattr(m0, state)
+                        object.__setattr__(mi, state, list(value) if isinstance(value, list) else value)
+                    mi._update_count = m0._update_count
+                    mi._is_synced = True
+                    synced.append(mi)
+
+        self._synced_members = []
+        for m in synced:
+            # a synced member's compute must neither re-sync nor self-unsync
+            self._synced_members.append((m, m._to_sync, m._should_unsync))
+            m._to_sync = False
+            m._should_unsync = False
+        # members the bucket pass could not cover (custom dist_sync_fn,
+        # foreign env, overridden sync) still sync themselves inside their
+        # own compute — the per-member protocol, unchanged
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore every member the last :meth:`sync` touched."""
+        if not should_unsync:
+            return  # mirrors Metric.unsync: the collection stays synced
+        members = self._synced_members
+        self._synced_members = None
+        if members is None:
+            return
+        for m, to_sync, should in members:
+            m._to_sync = to_sync
+            m._should_unsync = should
+            if m._is_synced:
+                m.unsync()
+
+    @contextmanager
+    def sync_context(
+        self,
+        env: Optional[DistEnv] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+    ) -> Generator[None, None, None]:
+        """Context manager: fused collection sync → compute → unsync."""
+        self.sync(env=env, should_sync=should_sync)
+        try:
+            yield
+        finally:
+            self.unsync(should_unsync=should_unsync)
+
+    def compute(self) -> Dict[str, Any]:
+        """Compute every metric, sharing leader state within groups (ref :215-227).
+
+        Under a distributed env the whole collection syncs up front through
+        :meth:`sync_context` — one fused bucket pass for every compute-group
+        leader — so the member computes below find their states already
+        synced instead of each issuing its own per-leaf collectives.
+        """
+        # inside a user-held sync_context the states are already synced:
+        # don't re-sync, and leave the user's sync in place afterwards
+        already_synced = self._synced_members is not None
+        with self.sync_context(
+            should_sync=not already_synced, should_unsync=not already_synced
+        ):
+            self._compute_groups_create_state_ref()
+            res = {k: m.compute() for k, m in self.items(keep_base=True)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
 
@@ -536,8 +718,39 @@ class MetricCollection:
         self, states: Dict[str, Dict[str, Any]], axis_name: Union[str, Tuple[str, ...]]
     ) -> Dict[str, Dict[str, Any]]:
         """Cross-device sync of every metric's state over a mesh axis (or
-        an axis tuple for one collective over several axes at once)."""
-        return {name: m.pure_sync(states[name], axis_name) for name, m in self.items(keep_base=True)}
+        an axis tuple for one collective over several axes at once).
+
+        With the fused engine on (``METRICS_TPU_FUSED_SYNC``), fixed-shape
+        reduce-type leaves of ALL members share one collective per
+        (dtype, op) bucket inside the trace — the in-SPMD counterpart of
+        :meth:`sync` — and only list/ragged leaves gather per member.
+        """
+        if not sync_engine.fused_sync_enabled():
+            return {name: m.pure_sync(states[name], axis_name) for name, m in self.items(keep_base=True)}
+        env = AxisEnv(axis_name)
+        specs: List[Any] = []
+        for name, m in self.items(keep_base=True):
+            if type(m)._sync_dist is not Metric._sync_dist:
+                continue  # subclassed sync semantics stay member-local
+            member_states = {k: v for k, v in states[name].items() if k in m._reductions}
+            specs.extend(sync_engine.plan_metric_leaves(m, member_states, tag=name))
+        fused = sync_engine.execute_buckets(env, specs, owner="MetricCollection", stats=self._sync_stats)
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, m in self.items(keep_base=True):
+            handled = {attr: val for (n, attr), val in fused.items() if n == name}
+            if not handled:
+                out[name] = m.pure_sync(states[name], axis_name)
+                continue
+            saved = m._copy_state()
+            try:
+                m._load_state(states[name])
+                m._sync_dist(dist_sync_fn=None, env=env, exclude=tuple(handled))
+                synced = m._copy_state()
+            finally:
+                m._load_state(saved)
+            synced.update(handled)
+            out[name] = synced
+        return out
 
     def scan_update(self, states: Dict[str, Dict[str, Any]], *batched_args: Any, **batched_kwargs: Any) -> Dict[str, Dict[str, Any]]:
         """Fold a stack of batches into every metric's state in ONE ``lax.scan``.

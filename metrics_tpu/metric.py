@@ -31,13 +31,13 @@ from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
 from enum import Enum
-from typing import Any, Callable, Dict, Generator, List, Optional, Tuple, Union
+from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import profiling
+from metrics_tpu import profiling, sync_engine
 from metrics_tpu.dispatch import fast_dispatch_enabled
 from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, default_env
 from metrics_tpu.utilities.data import (
@@ -217,6 +217,9 @@ class Metric(ABC):
         self._dispatcher = None
         self._fast_dispatch_failed = False
         self._dispatch_stats: Dict[str, int] = {"dispatches": 0, "retraces": 0}
+        # comms counters for the sync path (see metrics_tpu.profiling):
+        # every collective this metric issues, fused buckets, and wire bytes
+        self._sync_stats: Dict[str, int] = {"collectives": 0, "buckets": 0, "bytes_on_wire": 0}
 
         self._update_signature = inspect.signature(self.update)
         self._update_impl: Callable = self.update
@@ -647,6 +650,13 @@ class Metric(ABC):
         and compile-time ``retraces`` (see :mod:`metrics_tpu.profiling`)."""
         return dict(self._dispatch_stats)
 
+    @property
+    def sync_stats(self) -> Dict[str, int]:
+        """Comms counters for this metric's sync path: cross-participant
+        ``collectives`` issued, fused ``buckets`` among them, and payload
+        ``bytes_on_wire`` (see :mod:`metrics_tpu.profiling`)."""
+        return dict(self._sync_stats)
+
     def _move_list_states_to_cpu(self) -> None:
         """Move accumulated list states to host CPU (ref metric.py:282-287)."""
         cpu = jax.devices("cpu")[0]
@@ -657,16 +667,54 @@ class Metric(ABC):
 
     # ----------------------------------------------------------------- sync
     def _sync_dist(
-        self, dist_sync_fn: Optional[Callable] = None, env: Optional[DistEnv] = None
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        env: Optional[DistEnv] = None,
+        exclude: Sequence[str] = (),
     ) -> None:
-        """Gather every state across participants and reduce (ref metric.py:243-268)."""
+        """Gather every state across participants and reduce (ref metric.py:243-268).
+
+        ``exclude`` names states a caller already synced out-of-band — the
+        collection-level fused bucket pass (collections.py) reduces leader
+        states across ALL members at once and delegates only the remaining
+        leaves (list/ragged/custom-reduced) here.
+        """
         env = env or self._resolve_env()
-        # documented custom-gather contract: (state_tensor, env) -> List[Array]
-        base_gather = (lambda x: dist_sync_fn(x, env)) if dist_sync_fn is not None else (lambda x: env.all_gather(x))
 
         # a collective actually runs when the env is distributed OR the user
         # supplied their own gather (which may communicate regardless)
         will_communicate = env.is_distributed() or dist_sync_fn is not None
+
+        def _record(kind: str, x: Any) -> None:
+            # comms observability: every collective this sync issues is
+            # counted with its payload bytes (see metrics_tpu.profiling)
+            if not will_communicate:
+                return
+            nbytes = int(np.prod(jnp.shape(x))) * jnp.dtype(x.dtype).itemsize
+            self._sync_stats["collectives"] += 1
+            self._sync_stats["bytes_on_wire"] += nbytes
+            profiling.record_collective(type(self).__name__, kind, nbytes)
+
+        if dist_sync_fn is not None:
+            # documented custom-gather contract: (state_tensor, env) -> List[Array]
+            def base_gather(x):
+                _record("gather", x)
+                return dist_sync_fn(x, env)
+
+            uniform_gather = base_gather  # custom gathers see every state as-is
+        else:
+
+            def base_gather(x):
+                _record("gather", x)
+                return env.all_gather(x)
+
+            def uniform_gather(x):
+                # fixed-shape states are equal-shaped on every rank by
+                # construction, so the env may skip any shape-agreement
+                # round trip (ProcessEnv drops its per-leaf size exchange)
+                _record("gather", x)
+                return env.all_gather_uniform(x)
+
         if self.sync_dtype is not None and will_communicate:
             # Reduced-precision collective in the spirit of EQuARX
             # (PAPERS.md): float states cross the interconnect in the
@@ -674,14 +722,19 @@ class Metric(ABC):
             # Integer/bool states are never compressed; nothing is quantized
             # when no collective will run or when the state is already as
             # narrow as the compressed dtype (no bytes would be saved).
-            def gather(x):
-                if jnp.issubdtype(x.dtype, jnp.floating) and jnp.dtype(x.dtype).itemsize > self.sync_dtype.itemsize:
-                    return [g.astype(x.dtype) for g in base_gather(x.astype(self.sync_dtype))]
-                return base_gather(x)
-        else:
-            gather = base_gather
+            def _compressed(inner):
+                def gather(x):
+                    if jnp.issubdtype(x.dtype, jnp.floating) and jnp.dtype(x.dtype).itemsize > self.sync_dtype.itemsize:
+                        return [g.astype(x.dtype) for g in inner(x.astype(self.sync_dtype))]
+                    return inner(x)
 
-        input_dict = {attr: getattr(self, attr) for attr in self._reductions}
+                return gather
+        else:
+
+            def _compressed(inner):
+                return inner
+
+        input_dict = {attr: getattr(self, attr) for attr in self._reductions if attr not in exclude}
 
         # Structure-preserving ("ragged") list states — declared via
         # ``_ragged_state_specs`` — hold one array PER ELEMENT (e.g. mAP's
@@ -721,8 +774,10 @@ class Metric(ABC):
             ]
             if probe_attrs:
                 # ALL counts cross in one int32-vector collective (the
-                # lengths_group amortization of _gather_ragged, applied here)
-                counts_vec = base_gather(
+                # lengths_group amortization of _gather_ragged, applied
+                # here); the counts vector is uniform across ranks by
+                # construction, so the shape-agnostic gather is skipped
+                counts_vec = uniform_gather(
                     jnp.asarray([len(input_dict[a]) for a in probe_attrs], jnp.int32)
                 )
                 if not any(isinstance(c, jax.core.Tracer) for c in counts_vec):
@@ -745,6 +800,26 @@ class Metric(ABC):
                             )
                 # else: empty list inside a trace — identical on every shard,
                 # the probe is discarded
+
+        # Fused bucketed sync (metrics_tpu.sync_engine): every fixed-shape
+        # reduce-type leaf is packed into per-(dtype, op) flat buffers and
+        # ONE collective runs per bucket instead of one per leaf, with the
+        # sync_dtype compression cast applied once per packed float buffer.
+        # Custom gathers are never bucketed (their documented contract feeds
+        # them every state), and METRICS_TPU_FUSED_SYNC=0 restores the
+        # per-leaf protocol below exactly. Runs after the emptiness probe (a
+        # probe raise must leave every state untouched) and before the
+        # ragged gathers, so the collective ORDER stays identical on every
+        # participant.
+        if dist_sync_fn is None and will_communicate and sync_engine.fused_sync_enabled():
+            specs = sync_engine.plan_metric_leaves(self, input_dict)
+            if specs:
+                fused = sync_engine.execute_buckets(
+                    env, specs, owner=type(self).__name__, stats=self._sync_stats
+                )
+                for attr, val in fused.items():
+                    object.__setattr__(self, attr, val)
+                    del input_dict[attr]
 
         lengths_cache: Dict[str, Any] = {}
         for attr in ragged_attrs:
@@ -786,6 +861,7 @@ class Metric(ABC):
                 if op is not None:
                     reduced = env.all_reduce(value, op)
                     if reduced is not None:
+                        _record("reduce", value)
                         object.__setattr__(self, attr, reduced)
                         continue
             # Never compress sample-accumulating states (list states and
@@ -800,11 +876,14 @@ class Metric(ABC):
                 # IS the retained state, so quantization would be permanent
                 or attr in getattr(self, "_sample_state_names", ())
             )
-            attr_gather = base_gather if samples else gather
             if isinstance(value, list):
-                output_dict[attr] = [attr_gather(v) for v in value]  # list of lists-of-rank-tensors
+                output_dict[attr] = [base_gather(v) for v in value]  # list of lists-of-rank-tensors
             else:
-                output_dict[attr] = attr_gather(value)
+                # only cat-reduced tensors may carry rank-dependent leading
+                # dims (pre-concatenated list states); every other non-list
+                # state is uniform-shaped and skips the size exchange
+                inner = base_gather if self._reductions[attr] is dim_zero_cat else uniform_gather
+                output_dict[attr] = inner(value) if samples else _compressed(inner)(value)
 
         for attr in output_dict:
             reduction_fn = self._reductions[attr]
@@ -1052,6 +1131,7 @@ class Metric(ABC):
         self._dispatcher = None
         self._dispatch_stats = dict(self.__dict__.get("_dispatch_stats") or {"dispatches": 0, "retraces": 0})
         self._fast_dispatch_failed = bool(self.__dict__.get("_fast_dispatch_failed", False))
+        self._sync_stats = dict(self.__dict__.get("_sync_stats") or {"collectives": 0, "buckets": 0, "bytes_on_wire": 0})
 
     def __setattr__(self, name: str, value: Any) -> None:
         if name in ("higher_is_better", "is_differentiable", "full_state_update"):
@@ -1360,7 +1440,7 @@ class CompositionalMetric(Metric):
         else:
             self.metric_b = metric_b
 
-    def _sync_dist(self, dist_sync_fn=None, env=None) -> None:
+    def _sync_dist(self, dist_sync_fn=None, env=None, exclude=()) -> None:
         # No syncing on compositions; the leaves sync themselves (ref metric.py:758-760)
         pass
 

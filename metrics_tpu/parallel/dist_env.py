@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu._compat import axis_size
+
 Array = jax.Array
 
 
@@ -43,6 +45,17 @@ class DistEnv:
     def all_gather(self, x: Array) -> List[Array]:
         """Gather ``x`` from every participant; returns a list of per-rank arrays."""
         raise NotImplementedError
+
+    def all_gather_uniform(self, x: Array) -> List[Array]:
+        """``all_gather`` for tensors whose shape is the SAME on every rank.
+
+        Fixed-shape metric states (everything except list states) are
+        uniform by construction, so an env may skip any shape-agreement
+        round trip here — :class:`ProcessEnv` drops its per-leaf size
+        exchange over DCN. Default: plain ``all_gather`` (subclasses that
+        override only ``all_gather`` — tests, custom envs — stay correct).
+        """
+        return self.all_gather(x)
 
     def all_reduce(self, x: Array, op: str) -> Optional[Array]:
         """Fused cross-participant reduction (``op`` in sum/mean/max/min),
@@ -85,8 +98,8 @@ class AxisEnv(DistEnv):
         self.axis_name = axis_name
 
     def world_size(self) -> int:
-        from metrics_tpu._compat import axis_size
-
+        # axis_size imported at module level: this runs inside every traced
+        # collective, and a per-call import is pure hot-path overhead
         return axis_size(self.axis_name)
 
     def all_gather(self, x: Array) -> List[Array]:
@@ -132,6 +145,38 @@ class ProcessEnv(DistEnv):
             x = jnp.pad(x, pad)
         gathered = multihost_utils.process_allgather(x)  # (world, max, ...)
         return [jnp.asarray(gathered[i][: int(all_sizes[i])]) for i in range(self._world)]
+
+    def all_gather_uniform(self, x: Array) -> List[Array]:
+        """Uniform-shape gather: ONE ``process_allgather``, no size exchange.
+
+        The generic :meth:`all_gather` pays an extra DCN round trip per leaf
+        just to learn leading-dim sizes; fixed-shape states are equal-shaped
+        on every process by construction, so that exchange is pure latency.
+        """
+        from jax.experimental import multihost_utils
+
+        x = jnp.atleast_1d(x)
+        gathered = multihost_utils.process_allgather(x)  # (world, ...)
+        return [jnp.asarray(gathered[i]) for i in range(self._world)]
+
+    def all_reduce(self, x: Array, op: str) -> Optional[Array]:
+        """Host-level reduction in ONE ``process_allgather`` + local reduce.
+
+        Before this existed the per-leaf sync fell back to the generic
+        gather+stack form — paying the size-exchange round trip AND
+        materializing the ``(world, ...)`` stacked intermediate through the
+        trim path. One uniform gather and an axis-0 reduce replace both.
+        ``atleast_1d`` mirrors :class:`AxisEnv` exactly: scalar states come
+        back ``(1,)`` on every path.
+        """
+        from jax.experimental import multihost_utils
+
+        reducer = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}.get(op)
+        if reducer is None:
+            return None
+        x = jnp.atleast_1d(x)
+        gathered = multihost_utils.process_allgather(x)  # (world, ...)
+        return reducer(jnp.asarray(gathered), axis=0)
 
 
 def default_env() -> DistEnv:

@@ -31,6 +31,26 @@ whatever ran inside the context. Trackers nest — each active context sees
 every event recorded while it is open. Counting is host-side bookkeeping
 (no JAX hooks, no device work), so leaving it always-on costs a few dict
 increments per update.
+
+The same structure exists for the sync path (:mod:`metrics_tpu.sync_engine`):
+every cross-participant collective the library issues at ``sync()`` time is
+recorded with its wire-byte size:
+
+* ``fused``  — one bucketed collective covering MANY state leaves (the fused
+  sync engine). Each record is one bucket: one launch on the interconnect.
+* ``gather`` — one per-leaf all-gather (list/ragged states, custom
+  ``dist_sync_fn``, or the ``METRICS_TPU_FUSED_SYNC=0`` legacy path).
+* ``reduce`` — one per-leaf native all-reduce (legacy fused-collective path).
+
+Usage::
+
+    with track_syncs() as tracker:
+        collection.compute()                  # syncs once, fused
+    assert tracker.collectives == tracker.buckets   # one launch per bucket
+    assert tracker.bytes_on_wire < naive_bytes
+
+Per-owner counters live on the objects (``Metric.sync_stats`` /
+``MetricCollection.sync_stats``).
 """
 import threading
 from contextlib import contextmanager
@@ -38,6 +58,7 @@ from typing import Dict, Generator, List, Tuple
 
 _lock = threading.Lock()
 _active_trackers: List["DispatchTracker"] = []
+_active_sync_trackers: List["SyncTracker"] = []
 
 
 class DispatchTracker:
@@ -115,3 +136,68 @@ def track_dispatches() -> Generator[DispatchTracker, None, None]:
     finally:
         with _lock:
             _active_trackers.remove(tracker)
+
+
+class SyncTracker:
+    """Aggregated sync-collective counts recorded while a context is open.
+
+    Attributes:
+        collectives: total cross-participant launches recorded (all kinds).
+        buckets: how many of those were fused bucket collectives.
+        bytes_on_wire: total payload bytes crossing the interconnect, summed
+            over every recorded collective (the *launch* payload; an
+            all-gather additionally returns ``world x`` that many bytes).
+        events: ``(owner, kind, nbytes)`` tuples in record order.
+    """
+
+    def __init__(self) -> None:
+        self.collectives = 0
+        self.buckets = 0
+        self.bytes_on_wire = 0
+        self.events: List[Tuple[str, str, int]] = []
+        self._by_kind: Dict[str, int] = {}
+
+    def collective_count(self, kind: str = None, owner: str = None) -> int:
+        """Collectives filtered by ``kind`` and/or an ``owner`` substring."""
+        if kind is None and owner is None:
+            return self.collectives
+        if owner is None:
+            return self._by_kind.get(kind, 0)
+        return sum(1 for o, k, _ in self.events if (kind is None or k == kind) and owner in o)
+
+    def bytes_count(self, kind: str = None, owner: str = None) -> int:
+        """Wire bytes filtered by ``kind`` and/or an ``owner`` substring."""
+        if kind is None and owner is None:
+            return self.bytes_on_wire
+        return sum(n for o, k, n in self.events if (kind is None or k == kind) and (owner is None or owner in o))
+
+    def _record(self, owner: str, kind: str, nbytes: int) -> None:
+        self.collectives += 1
+        self.bytes_on_wire += nbytes
+        if kind == "fused":
+            self.buckets += 1
+        self._by_kind[kind] = self._by_kind.get(kind, 0) + 1
+        self.events.append((owner, kind, nbytes))
+
+
+def record_collective(owner: str, kind: str, nbytes: int) -> None:
+    """Record one sync collective (``fused``/``gather``/``reduce``) of
+    ``nbytes`` payload bytes issued on behalf of ``owner``."""
+    if not _active_sync_trackers:
+        return
+    with _lock:
+        for tracker in _active_sync_trackers:
+            tracker._record(owner, kind, nbytes)
+
+
+@contextmanager
+def track_syncs() -> Generator[SyncTracker, None, None]:
+    """Count every sync collective (and its wire bytes) issued inside the block."""
+    tracker = SyncTracker()
+    with _lock:
+        _active_sync_trackers.append(tracker)
+    try:
+        yield tracker
+    finally:
+        with _lock:
+            _active_sync_trackers.remove(tracker)

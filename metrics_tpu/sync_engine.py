@@ -1,0 +1,209 @@
+"""Fused bucketed state-sync engine: one collective per (dtype, op) bucket.
+
+``Metric._sync_dist`` historically issued one collective per state leaf, so
+a ``MetricCollection`` of K metrics with L leaves each paid K·L small
+launches per ``compute()`` — each a full interconnect round trip on a real
+slice (ICI inside a pod, DCN across hosts). This module is the metric-state
+analogue of DDP gradient bucketing / flat-buffer allreduce (see PAPERS.md:
+EQuARX and "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training"): all *fixed-shape reduce-type* leaves — within one
+metric, or across every compute-group leader of a collection — are packed
+into one flat buffer per ``(wire dtype, reduce op)`` bucket, ONE collective
+runs per bucket, and the result is unpacked in deterministic leaf order.
+
+What is (and is not) bucketed:
+
+* Eligible: non-list array states whose declared reduction is one of the
+  four named ops (``sum``/``mean``/``max``/``min``) and whose dtype/op pair
+  has exact packed semantics — floats take every op; integers take
+  sum/max/min (an integer ``mean`` keeps its historical dtype-promotion
+  behavior on the per-leaf path); bools take max/min and cross the wire as
+  int32 (cast back on unpack).
+* Everything else — list states, ``dim_zero_cat`` sample states, custom
+  reductions, custom ``dist_sync_fn`` gathers, ragged states — keeps the
+  existing per-leaf protocol, issued AFTER the buckets in the same
+  deterministic order on every participant.
+
+``sync_dtype`` compression (EQuARX-style) applies ONCE per packed float
+buffer instead of once per leaf: a compressed bucket gathers the narrow
+buffer and reduces per-leaf at full precision after the cast-back, exactly
+matching the per-leaf compression semantics (wire bytes compressed,
+accumulation not). Uncompressed buckets prefer ``env.all_reduce`` — a
+single ``psum``/``pmean``/``pmax``/``pmin`` on :class:`AxisEnv` that never
+materializes the ``(world, ...)`` stacked intermediate — and fall back to
+one packed gather + host reduce when the env has no native reduction.
+
+The engine is on by default and gated by ``METRICS_TPU_FUSED_SYNC``
+(``0``/``false``/``off`` restores the per-leaf protocol bit-for-bit). Every
+bucket collective is recorded via :func:`metrics_tpu.profiling.record_collective`
+(kind ``"fused"``) and counted in the owner's ``sync_stats``.
+"""
+import os
+from typing import Any, Dict, Hashable, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import profiling
+from metrics_tpu.utilities.data import dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
+
+Array = jax.Array
+
+# reductions expressible as one named collective op (mirrors metric.py's
+# native_reduce_ops — the contract both files share)
+NATIVE_REDUCE_OPS = {
+    dim_zero_sum: "sum",
+    dim_zero_mean: "mean",
+    dim_zero_max: "max",
+    dim_zero_min: "min",
+}
+
+_HOST_REDUCE = {
+    "sum": lambda x: jnp.sum(x, axis=0),
+    "mean": lambda x: jnp.mean(x, axis=0),
+    "max": lambda x: jnp.max(x, axis=0),
+    "min": lambda x: jnp.min(x, axis=0),
+}
+
+
+def fused_sync_enabled() -> bool:
+    """Is the fused bucketed sync engine enabled? (default: yes)
+
+    Kill switch: ``METRICS_TPU_FUSED_SYNC=0`` (or ``false``/``off``)
+    restores the per-leaf sync protocol exactly.
+    """
+    return os.environ.get("METRICS_TPU_FUSED_SYNC", "1").strip().lower() not in ("0", "false", "off")
+
+
+class LeafSpec(NamedTuple):
+    """One fixed-shape reduce-state leaf scheduled into a bucket.
+
+    ``key`` is the caller's handle for routing the unpacked result back
+    (the attr name for a single metric; ``(member_index, attr)`` for a
+    collection-level pass). ``shape`` is the POST-sync shape — the per-leaf
+    protocol's ``atleast_1d`` semantics turn scalar states into ``(1,)``,
+    and the fused path must match on either branch.
+    """
+
+    key: Hashable
+    value: Array
+    op: str
+    wire_dtype: Any
+    dtype: Any
+    shape: Tuple[int, ...]
+
+
+def plan_metric_leaves(metric: Any, states: Dict[str, Any], tag: Optional[Hashable] = None) -> List[LeafSpec]:
+    """Select the bucket-eligible leaves of ``metric`` from ``states``.
+
+    Applies the metric's own sync policy: its ``_reductions`` pick the op,
+    ``sync_dtype`` picks the (possibly compressed) wire dtype for wide
+    float leaves, and ``_sample_state_names`` are exempt from compression
+    (the gathered stack IS the retained state there — quantization would be
+    permanent, see metric.py). Ineligible leaves are simply not returned;
+    the caller leaves them on the per-leaf path.
+    """
+    specs: List[LeafSpec] = []
+    sync_dtype = metric.sync_dtype
+    sample_names = getattr(metric, "_sample_state_names", ()) or ()
+    ragged = getattr(metric, "_ragged_state_specs", None) or {}
+    for attr, value in states.items():
+        if isinstance(value, list) or attr in ragged or not isinstance(value, jax.Array):
+            continue
+        op = NATIVE_REDUCE_OPS.get(metric._reductions[attr])
+        if op is None:
+            continue
+        dt = jnp.dtype(value.dtype)
+        if dt == jnp.bool_:
+            if op not in ("max", "min"):
+                continue  # a bool `sum` promotes on reduce; keep per-leaf semantics
+            wire = jnp.dtype(jnp.int32)
+        elif jnp.issubdtype(dt, jnp.floating):
+            wire = dt
+            if sync_dtype is not None and attr not in sample_names and dt.itemsize > sync_dtype.itemsize:
+                wire = sync_dtype
+        elif jnp.issubdtype(dt, jnp.integer):
+            if op == "mean":
+                continue  # integer mean keeps its historical promotion behavior
+            wire = dt
+        else:
+            continue  # complex &c. stay on the per-leaf path
+        shape = tuple(value.shape) or (1,)  # post-sync atleast_1d semantics
+        specs.append(
+            LeafSpec(
+                key=attr if tag is None else (tag, attr),
+                value=value,
+                op=op,
+                wire_dtype=wire,
+                dtype=dt,
+                shape=shape,
+            )
+        )
+    return specs
+
+
+def execute_buckets(
+    env: Any,
+    specs: List[LeafSpec],
+    owner: str = "Metric",
+    stats: Optional[Dict[str, int]] = None,
+) -> Dict[Hashable, Array]:
+    """Issue ONE collective per (wire dtype, op) bucket; return ``{key: reduced}``.
+
+    Buckets are iterated in sorted ``(dtype name, op)`` order and leaves
+    keep their planning order within a bucket, so every participant issues
+    the identical collective sequence — the same determinism contract the
+    per-leaf path documents (metric.py ragged sync). All packing/unpacking
+    is ``jnp`` with static shapes, so the whole pass traces cleanly inside
+    ``shard_map`` (AxisEnv) and runs eagerly host-side (ProcessEnv).
+    """
+    if not specs:
+        return {}
+    buckets: Dict[Tuple[str, str], List[LeafSpec]] = {}
+    for s in specs:
+        buckets.setdefault((jnp.dtype(s.wire_dtype).name, s.op), []).append(s)
+
+    out: Dict[Hashable, Array] = {}
+    for wire_name, op in sorted(buckets):
+        leaves = buckets[(wire_name, op)]
+        wire = jnp.dtype(wire_name)
+        flat = [jnp.ravel(s.value).astype(wire) for s in leaves]
+        buf = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+        nbytes = int(buf.size) * wire.itemsize
+        sizes = [int(np.prod(s.shape)) for s in leaves]
+        offsets = np.concatenate([[0], np.cumsum(sizes)])
+
+        # a bucket is "compressed" when any float leaf crosses the wire
+        # narrower than its state dtype — then accumulation must happen at
+        # full precision AFTER the cast-back, so the native all_reduce
+        # (which reduces in wire dtype) is off the table
+        compressed = any(
+            jnp.issubdtype(s.dtype, jnp.floating) and jnp.dtype(s.dtype) != wire for s in leaves
+        )
+
+        if compressed:
+            gather = getattr(env, "all_gather_uniform", env.all_gather)
+            stacked = jnp.stack([jnp.ravel(g) for g in gather(buf)])  # (world, total)
+            for s, o, n in zip(leaves, offsets, sizes):
+                seg = stacked[:, o : o + n].astype(s.dtype)
+                out[s.key] = _HOST_REDUCE[op](seg).reshape(s.shape)
+        else:
+            reduced = env.all_reduce(buf, op)
+            if reduced is None:
+                gather = getattr(env, "all_gather_uniform", env.all_gather)
+                stacked = jnp.stack([jnp.ravel(g) for g in gather(buf)])
+                reduced = _HOST_REDUCE[op](stacked)
+            reduced = jnp.ravel(reduced)
+            for s, o, n in zip(leaves, offsets, sizes):
+                seg = reduced[o : o + n]
+                if jnp.dtype(seg.dtype) != s.dtype:
+                    seg = seg.astype(s.dtype)  # bool leaves rode the wire as int32
+                out[s.key] = seg.reshape(s.shape)
+
+        profiling.record_collective(owner, "fused", nbytes)
+        if stats is not None:
+            stats["collectives"] = stats.get("collectives", 0) + 1
+            stats["buckets"] = stats.get("buckets", 0) + 1
+            stats["bytes_on_wire"] = stats.get("bytes_on_wire", 0) + nbytes
+    return out

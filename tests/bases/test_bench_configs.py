@@ -143,8 +143,29 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 17
+    assert len(skipped) == 18
     assert "detail_elapsed_s" in detail
+
+
+def test_sync_engine_config_counts_and_keys(monkeypatch):
+    """Pin the fused-sync bench config: the structural claim it exists to
+    record is 'one collective per (dtype, op) bucket across the WHOLE
+    collection'. The 5-member classification suite is 17 int32-sum leaves
+    -> exactly one fused bucket vs 17 per-leaf collectives, moving the
+    same number of wire bytes."""
+    monkeypatch.delenv("METRICS_TPU_FUSED_SYNC", raising=False)
+    detail = {}
+    bench._cfg_sync_engine(detail)
+    assert detail["sync_collectives_fused_collection"] == 1
+    assert detail["sync_bucket_count_fused_collection"] == 1
+    assert detail["sync_collectives_perleaf_collection"] == 17
+    assert (detail["sync_bytes_fused_collection"]
+            == detail["sync_bytes_perleaf_collection"] > 0)
+    assert detail["sync_us_fused_collection"] > 0
+    assert detail["sync_us_perleaf_collection"] > 0
+    # the config must restore the kill switch it toggles
+    assert os.environ.get("METRICS_TPU_FUSED_SYNC") is None or (
+        os.environ["METRICS_TPU_FUSED_SYNC"] != "0")
 
 
 def test_cg_configs_record_host_pinning():

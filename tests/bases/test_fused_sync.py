@@ -1,0 +1,644 @@
+"""Fused bucketed sync engine (metrics_tpu/sync_engine.py) coverage.
+
+Structural guarantees: syncing a whole MetricCollection issues exactly ONE
+collective per (wire dtype, reduce op) bucket — counted through
+``profiling.track_syncs`` / ``sync_stats`` — instead of K metrics x L
+leaves; values match the per-leaf protocol bitwise; and the
+``METRICS_TPU_FUSED_SYNC=0`` kill switch restores the old behavior exactly.
+Parity runs under the emulated 8-device AxisEnv mesh (real XLA collectives
+inside ``shard_map``), a ProcessEnv loopback (monkeypatched
+``process_allgather``), and plain fake envs.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import MetricCollection, profiling, sync_engine
+from metrics_tpu._compat import shard_map
+from metrics_tpu.metric import Metric
+from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, NoOpEnv, ProcessEnv
+
+WORLD = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:WORLD]), ("r",))
+
+
+class Loopback2(NoOpEnv):
+    """2-rank loopback env: both ranks contribute the identical local state,
+    with AxisEnv/ProcessEnv ``atleast_1d`` shape semantics."""
+
+    def world_size(self):
+        return 2
+
+    def all_gather(self, x):
+        x = jnp.atleast_1d(x)
+        return [x, x]
+
+    def all_reduce(self, x, op):
+        stacked = jnp.stack([jnp.atleast_1d(x)] * 2)
+        return {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}[op](stacked, axis=0)
+
+
+class GatherOnly2(Loopback2):
+    """Same, but with no native reduction — forces the packed-gather fallback."""
+
+    def all_reduce(self, x, op):
+        return None
+
+
+class Recording2(Loopback2):
+    """Loopback that records every collective it is asked to issue."""
+
+    def __init__(self):
+        self.calls = []  # (method, shape, dtype)
+
+    def all_gather(self, x):
+        self.calls.append(("gather", tuple(jnp.shape(x)), str(jnp.asarray(x).dtype)))
+        return super().all_gather(x)
+
+    def all_reduce(self, x, op):
+        self.calls.append((f"reduce:{op}", tuple(jnp.shape(x)), str(jnp.asarray(x).dtype)))
+        return super().all_reduce(x, op)
+
+
+class MultiLeaf(Metric):
+    """Four fixed-shape leaves spanning 4 distinct (wire dtype, op) buckets:
+    (f32, sum), (f32, max), (int32, sum), and bool-max (int32 wire)."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("fsum", jnp.zeros(16), dist_reduce_fx="sum")
+        self.add_state("fmax", jnp.full((4,), -1e9), dist_reduce_fx="max")
+        self.add_state("isum", jnp.zeros(8, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("flag", jnp.asarray(False), dist_reduce_fx="max")
+
+    def update(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        self.fsum = self.fsum + x[:16]
+        self.fmax = jnp.maximum(self.fmax, x[:4])
+        self.isum = self.isum + (x[:8] * 10).astype(jnp.int32)
+        self.flag = jnp.logical_or(self.flag, jnp.any(x > 0.5))
+
+    def compute(self):
+        return jnp.sum(self.fsum) + jnp.sum(self.fmax) + jnp.sum(self.isum) + self.flag.astype(jnp.float32).sum()
+
+
+N_BUCKETS = 4  # distinct (wire dtype, op) pairs of MultiLeaf, however many metrics
+N_LEAVES = 4
+
+
+def _collection(n=5, env=None, **kwargs):
+    return MetricCollection(
+        {f"m{i}": MultiLeaf(sync_env=env) for i in range(n)}, compute_groups=False, **kwargs
+    )
+
+
+def _payload(seed=0):
+    return jnp.asarray(np.random.RandomState(seed).rand(16).astype(np.float32))
+
+
+def _member_states(mc):
+    return {
+        name: {k: np.asarray(getattr(m, k)) for k in m._defaults}
+        for name, m in mc.items(keep_base=True)
+    }
+
+
+# --------------------------------------------------------------- structural
+def test_collection_sync_one_collective_per_bucket():
+    """ISSUE 2 acceptance: a 5-metric x 4-leaf collection syncs in exactly
+    ``bucket_count`` collectives (= #distinct (dtype, op) pairs), not K*L,
+    and values match the per-leaf path bitwise."""
+    env = Loopback2()
+    mc = _collection(env=env)
+    mc.update(_payload())
+    with profiling.track_syncs() as t:
+        mc.sync(env=env)
+        fused_states = _member_states(mc)
+    mc.unsync()
+
+    assert t.buckets == N_BUCKETS
+    assert t.collectives == N_BUCKETS  # one launch per bucket, nothing else
+    assert t.collectives < 5 * N_LEAVES  # the K*L regime this replaces
+    assert t.collective_count(kind="fused", owner="MetricCollection") == N_BUCKETS
+    assert mc.sync_stats["buckets"] == N_BUCKETS
+    assert mc.sync_stats["collectives"] == N_BUCKETS
+    assert mc.sync_stats["bytes_on_wire"] > 0
+
+    # per-leaf reference run: kill switch off -> members sync themselves
+    mc0 = _collection(env=env)
+    mc0.update(_payload())
+    os.environ["METRICS_TPU_FUSED_SYNC"] = "0"
+    try:
+        with profiling.track_syncs() as t0:
+            for _, m in mc0.items(keep_base=True):
+                m.sync(env=env)
+            legacy_states = _member_states(mc0)
+            for _, m in mc0.items(keep_base=True):
+                m.unsync()
+    finally:
+        os.environ.pop("METRICS_TPU_FUSED_SYNC", None)
+
+    assert t0.collectives == 5 * N_LEAVES  # the old one-per-leaf protocol
+    assert t0.buckets == 0
+    for name in legacy_states:
+        for attr in legacy_states[name]:
+            got, want = fused_states[name][attr], legacy_states[name][attr]
+            assert got.dtype == want.dtype, (name, attr)
+            np.testing.assert_array_equal(got, want, err_msg=f"{name}.{attr}")
+
+
+def test_collection_compute_issues_bucket_count_collectives():
+    """A full ``MetricCollection.compute()`` under a distributed env rides
+    the fused collection sync: exactly ``bucket_count`` collectives."""
+    env = Loopback2()
+    mc = _collection(env=env)
+    mc.update(_payload(1))
+    with profiling.track_syncs() as t:
+        values = mc.compute()
+    assert t.collectives == t.buckets == N_BUCKETS
+
+    # kill switch: same values, per-leaf collectives
+    mc0 = _collection(env=env)
+    mc0.update(_payload(1))
+    os.environ["METRICS_TPU_FUSED_SYNC"] = "0"
+    try:
+        with profiling.track_syncs() as t0:
+            values0 = mc0.compute()
+    finally:
+        os.environ.pop("METRICS_TPU_FUSED_SYNC", None)
+    assert t0.collectives == 5 * N_LEAVES
+    assert t0.buckets == 0
+    assert set(values) == set(values0)
+    for k in values:
+        np.testing.assert_array_equal(np.asarray(values[k]), np.asarray(values0[k]), err_msg=k)
+    # compute unsynced: local states restored on every member
+    for _, m in mc.items(keep_base=True):
+        assert not m._is_synced
+
+
+def test_collection_compute_unsync_restores_and_is_repeatable():
+    env = Loopback2()
+    mc = _collection(env=env)
+    mc.update(_payload(2))
+    local = _member_states(mc)
+    first = {k: np.asarray(v) for k, v in mc.compute().items()}
+    after = _member_states(mc)
+    for name in local:
+        for attr in local[name]:
+            np.testing.assert_array_equal(local[name][attr], after[name][attr])
+    # memoization cleared by further updates; a second compute still works
+    mc.update(_payload(3))
+    second = mc.compute()
+    assert set(first) == set(second)
+
+
+def test_compute_groups_sync_leaders_once():
+    """With compute groups active, only the leader's leaves enter the bucket
+    pass; followers adopt the synced state with zero extra collectives."""
+    env = Loopback2()
+    mc = MetricCollection(
+        {"a": MultiLeaf(sync_env=env), "b": MultiLeaf(sync_env=env)},
+        compute_groups=[["a", "b"]],
+    )
+    mc.update(_payload(4))
+    mc._groups_checked = True  # explicit groups; mark validated as update() would
+    with profiling.track_syncs() as t:
+        mc.sync(env=env)
+        a_state = {k: np.asarray(getattr(mc["a"], k)) for k in mc["a"]._defaults}
+        b_state = {k: np.asarray(getattr(mc["b"], k)) for k in mc["b"]._defaults}
+    assert t.collectives == N_BUCKETS  # one metric's worth, not two
+    for attr in a_state:
+        np.testing.assert_array_equal(a_state[attr], b_state[attr])
+    assert mc["a"]._is_synced and mc["b"]._is_synced
+    mc.unsync()
+    assert not mc["a"]._is_synced and not mc["b"]._is_synced
+
+
+def test_collection_sync_not_distributed_is_noop():
+    mc = _collection()
+    mc.update(_payload())
+    with profiling.track_syncs() as t:
+        mc.sync()  # ambient env is NoOpEnv -> nothing to do
+        mc.unsync()
+    assert t.collectives == 0
+    for _, m in mc.items(keep_base=True):
+        assert not m._is_synced
+
+
+def test_compute_inside_user_sync_context_does_not_resync():
+    """``compute()`` under a user-held ``sync_context`` must neither raise
+    "already synced" nor release the user's sync on exit — mirroring the
+    ``Metric`` flag semantics."""
+    env = Loopback2()
+    mc = _collection(env=env)
+    mc.update(_payload(1))
+    baseline = mc.compute()  # self-managed sync
+
+    mc2 = _collection(env=env)
+    mc2.update(_payload(1))
+    with profiling.track_syncs() as t:
+        with mc2.sync_context(env=env):
+            values = mc2.compute()
+            # the user's sync is still held inside the context
+            for _, m in mc2.items(keep_base=True):
+                assert m._is_synced
+    assert t.collectives == N_BUCKETS  # synced once, not twice
+    for _, m in mc2.items(keep_base=True):
+        assert not m._is_synced  # released by the OUTER context only
+    for k in baseline:
+        np.testing.assert_array_equal(
+            np.asarray(values[k]), np.asarray(baseline[k]), err_msg=k)
+
+    # sync_context(should_unsync=False) leaves the collection synced
+    mc3 = _collection(env=env)
+    mc3.update(_payload(1))
+    with mc3.sync_context(env=env, should_unsync=False):
+        pass
+    assert all(m._is_synced for _, m in mc3.items(keep_base=True))
+    mc3.unsync()
+    assert not any(m._is_synced for _, m in mc3.items(keep_base=True))
+
+
+def test_collection_double_sync_raises():
+    env = Loopback2()
+    mc = _collection(env=env)
+    mc.update(_payload())
+    mc.sync(env=env)
+    with pytest.raises(Exception, match="already been synced"):
+        mc.sync(env=env)
+    mc.unsync()
+
+
+# ------------------------------------------------------------- single metric
+def test_metric_fused_sync_parity_gather_fallback():
+    """An env with no native all_reduce falls back to ONE packed gather per
+    bucket — same bucket count, identical values."""
+    env = GatherOnly2()
+    m = MultiLeaf()
+    m.update(_payload(5))
+    with profiling.track_syncs() as t:
+        m.sync(env=env)
+    fused = {k: np.asarray(getattr(m, k)) for k in m._defaults}
+    m.unsync()
+    assert t.buckets == N_BUCKETS
+    assert m.sync_stats["buckets"] == N_BUCKETS
+
+    m0 = MultiLeaf()
+    m0.update(_payload(5))
+    os.environ["METRICS_TPU_FUSED_SYNC"] = "0"
+    try:
+        m0.sync(env=env)
+    finally:
+        os.environ.pop("METRICS_TPU_FUSED_SYNC", None)
+    for attr in fused:
+        want = np.asarray(getattr(m0, attr))
+        assert fused[attr].dtype == want.dtype, attr
+        np.testing.assert_array_equal(fused[attr], want, err_msg=attr)
+    m0.unsync()
+
+
+def test_kill_switch_env_var_parsing(monkeypatch):
+    assert sync_engine.fused_sync_enabled()
+    for off in ("0", "false", "OFF", " 0 "):
+        monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", off)
+        assert not sync_engine.fused_sync_enabled()
+    monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", "1")
+    assert sync_engine.fused_sync_enabled()
+
+
+def test_mixed_dtype_buckets_exact_unpacking():
+    """int counts + f32 sums + bool flags land in separate buckets and
+    unpack exactly: dtypes preserved, every leaf bitwise-correct."""
+
+    class Mixed(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("fa", jnp.zeros(3), dist_reduce_fx="sum")
+            self.add_state("fb", jnp.zeros(5), dist_reduce_fx="sum")
+            self.add_state("count", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+            self.add_state("imax", jnp.zeros(2, jnp.int32), dist_reduce_fx="max")
+            self.add_state("seen", jnp.asarray(False), dist_reduce_fx="max")
+            self.add_state("clean", jnp.asarray(True), dist_reduce_fx="min")
+
+        def update(self):
+            self.fa = self.fa + jnp.asarray([1.5, -2.0, 3.25])
+            self.fb = self.fb + jnp.arange(5, dtype=jnp.float32)
+            self.count = self.count + 7
+            self.imax = jnp.maximum(self.imax, jnp.asarray([3, -1], jnp.int32))
+            self.seen = jnp.asarray(True)
+            self.clean = jnp.asarray(False)
+
+        def compute(self):
+            return self.count
+
+    env = Recording2()
+    m = Mixed()
+    m.update()
+    with profiling.track_syncs() as t:
+        m.sync(env=env)
+    # buckets: (f32,sum) (int32,sum) (int32,max incl. bool wire) (int32,min bool wire)
+    assert t.buckets == 4
+    assert t.collectives == 4
+    np.testing.assert_array_equal(np.asarray(m.fa), [3.0, -4.0, 6.5])
+    np.testing.assert_array_equal(np.asarray(m.fb), 2 * np.arange(5, dtype=np.float32))
+    assert np.asarray(m.count).item() == 14 and m.count.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(m.imax), [3, 0])  # max(default 0, -1)
+    assert m.imax.dtype == jnp.int32
+    assert m.seen.dtype == jnp.bool_ and bool(np.asarray(m.seen).item()) is True
+    assert m.clean.dtype == jnp.bool_ and bool(np.asarray(m.clean).item()) is False
+    # the two f32 sum leaves crossed in ONE packed f32 buffer of 3+5 elems
+    f32_sums = [c for c in env.calls if c[2] == "float32"]
+    assert f32_sums == [("reduce:sum", (8,), "float32")]
+    m.unsync()
+
+
+def test_sync_dtype_cast_once_on_packed_buffer():
+    """With ``sync_dtype``, ALL wide float leaves cross in one compressed
+    bucket buffer (a single bf16 collective of summed size) and accumulate
+    at full precision after the cast-back, matching per-leaf semantics."""
+
+    class TwoFloats(Metric):
+        full_state_update = False
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("a", jnp.zeros(16), dist_reduce_fx="sum")
+            self.add_state("b", jnp.zeros(8), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.a = self.a + x[:16]
+            self.b = self.b + x[:8] * 3.0
+
+        def compute(self):
+            return jnp.sum(self.a) + jnp.sum(self.b)
+
+    env = Recording2()
+    m = TwoFloats(sync_dtype=jnp.bfloat16)
+    m.update(_payload(6))
+    with profiling.track_syncs() as t:
+        m.sync(env=env)
+    # one bucket; the wire saw exactly one bf16 gather of 16+8 elements
+    assert t.buckets == 1
+    assert env.calls == [("gather", (24,), "bfloat16")]
+    assert t.bytes_on_wire == 24 * 2
+    # states come back in full precision
+    assert m.a.dtype == jnp.float32 and m.b.dtype == jnp.float32
+    fused_a, fused_b = np.asarray(m.a), np.asarray(m.b)
+    m.unsync()
+
+    # parity with the per-leaf compressed path (two bf16 gathers)
+    m0 = TwoFloats(sync_dtype=jnp.bfloat16)
+    m0.update(_payload(6))
+    env0 = Recording2()
+    os.environ["METRICS_TPU_FUSED_SYNC"] = "0"
+    try:
+        m0.sync(env=env0)
+    finally:
+        os.environ.pop("METRICS_TPU_FUSED_SYNC", None)
+    assert env0.calls == [("gather", (16,), "bfloat16"), ("gather", (8,), "bfloat16")]
+    np.testing.assert_array_equal(fused_a, np.asarray(m0.a))
+    np.testing.assert_array_equal(fused_b, np.asarray(m0.b))
+    m0.unsync()
+    # and within compression tolerance of the uncompressed truth
+    m1 = TwoFloats()
+    m1.update(_payload(6))
+    m1.sync(env=Loopback2())
+    np.testing.assert_allclose(fused_a, np.asarray(m1.a), rtol=1e-2)
+    np.testing.assert_allclose(fused_b, np.asarray(m1.b), rtol=1e-2)
+
+
+def test_list_and_cat_states_stay_on_per_leaf_path():
+    """List/cat sample states are never bucketed — they keep the existing
+    gather protocol alongside the fused buckets."""
+
+    class WithList(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("vals", [], dist_reduce_fx="cat")
+
+        def update(self, x):
+            self.total = self.total + jnp.sum(x)
+            self.vals.append(x)
+
+        def compute(self):
+            from metrics_tpu.utilities.data import dim_zero_cat
+
+            return jnp.sum(dim_zero_cat(self.vals)) + self.total
+
+    env = Loopback2()
+    m = WithList()
+    m.update(jnp.asarray([1.0, 2.0]))
+    with profiling.track_syncs() as t:
+        m.sync(env=env)
+    # 1 fused bucket (total) + 1 emptiness probe + 1 list gather
+    assert t.buckets == 1
+    assert t.collective_count(kind="gather") == 2
+    assert np.asarray(m.total).item() == pytest.approx(6.0)
+    # cat reduction concatenates the gathered rank lists, as always
+    np.testing.assert_array_equal(np.asarray(m.vals), [1.0, 2.0, 1.0, 2.0])
+    m.unsync()
+    assert isinstance(m.vals, list) and len(m.vals) == 1
+
+
+# ------------------------------------------------------------------ AxisEnv
+def test_axis_env_fused_parity_inside_shard_map(monkeypatch):
+    """Fused vs per-leaf parity with REAL XLA collectives over the 8-device
+    mesh: identical synced states either way."""
+    metric = MultiLeaf()
+    data = jnp.asarray(np.random.RandomState(7).rand(WORLD, 16).astype(np.float32))
+
+    def worker(x):
+        state = metric.pure_update(metric.default_state(), x[0])  # (1, 16) shard -> (16,)
+        return metric.pure_sync(state, "r")
+
+    run = shard_map(worker, mesh=_mesh(), in_specs=(P("r"),), out_specs=P(), check_vma=False)
+    fused = jax.tree_util.tree_map(np.asarray, run(data))
+
+    monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", "0")
+    legacy = jax.tree_util.tree_map(np.asarray, run(data))
+
+    assert set(fused) == set(legacy)
+    for attr in fused:
+        assert fused[attr].dtype == legacy[attr].dtype, attr
+        np.testing.assert_allclose(fused[attr], legacy[attr], rtol=1e-6, err_msg=attr)
+
+
+def test_axis_env_fused_lowers_to_single_psum(monkeypatch):
+    """Three same-dtype sum leaves lower to ONE psum when fused (three when
+    not) and never to an all_gather — the structural de-fusion regression
+    guard at the jaxpr level."""
+
+    class ThreeSums(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("a", jnp.zeros(4), dist_reduce_fx="sum")
+            self.add_state("b", jnp.zeros(2), dist_reduce_fx="sum")
+            self.add_state("c", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.a, self.b, self.c = self.a + x[:4], self.b + x[:2], self.c + jnp.sum(x)
+
+        def compute(self):
+            return self.c
+
+    metric = ThreeSums()
+
+    def count_psums():
+        jaxpr = str(
+            jax.make_jaxpr(
+                shard_map(
+                    lambda s: metric.pure_sync(s, "r"),
+                    mesh=_mesh(),
+                    in_specs=(P(),),
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            )(metric.default_state())
+        )
+        assert "all_gather" not in jaxpr
+        return jaxpr.count("psum")
+
+    assert count_psums() == 1  # one bucket, one collective
+    monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", "0")
+    assert count_psums() == 3  # per-leaf: one psum per state
+
+
+def test_axis_env_collection_pure_sync_fuses_across_members(monkeypatch):
+    """Collection-level ``pure_sync`` shares buckets across ALL members
+    inside the trace — one psum for every same-bucket leaf of every metric —
+    with values identical to the per-member path."""
+    from metrics_tpu import MaxMetric, MeanMetric, SumMetric
+
+    mc = MetricCollection(
+        {"s1": SumMetric(), "s2": SumMetric(), "mx": MaxMetric(), "mn": MeanMetric()},
+        compute_groups=False,
+    )
+    states = {
+        "s1": {"value": jnp.asarray([1.0, 2.0])},
+        "s2": {"value": jnp.asarray([3.0])},
+        "mx": {"value": jnp.asarray(-1e9)},
+        "mn": {"value": jnp.asarray(5.0), "weight": jnp.asarray(1.0)},
+    }
+
+    def jaxpr_of():
+        return str(
+            jax.make_jaxpr(
+                shard_map(
+                    lambda s: mc.pure_sync(s, "r"),
+                    mesh=_mesh(),
+                    in_specs=(P(),),
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            )(states)
+        )
+
+    fused_jaxpr = jaxpr_of()
+    assert "all_gather" not in fused_jaxpr
+    # buckets: (f32, sum) covering s1+s2+mn.value+mn.weight -> 1 psum, (f32, max) -> 1 pmax
+    assert fused_jaxpr.count("psum") == 1
+    assert fused_jaxpr.count("pmax") == 1
+
+    run = shard_map(lambda s: mc.pure_sync(s, "r"), mesh=_mesh(), in_specs=(P(),), out_specs=P(), check_vma=False)
+    fused_out = jax.tree_util.tree_map(np.asarray, run(states))
+    monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", "0")
+    legacy_out = jax.tree_util.tree_map(np.asarray, run(states))
+    jax.tree_util.tree_map(np.testing.assert_allclose, fused_out, legacy_out)
+
+
+# ---------------------------------------------------------------- ProcessEnv
+def _loopback_process_env(monkeypatch, world=2):
+    """ProcessEnv whose ``process_allgather`` is a recording loopback."""
+    from jax.experimental import multihost_utils
+
+    calls = []
+
+    def fake_allgather(x):
+        calls.append((tuple(np.shape(x)), str(np.asarray(x).dtype)))
+        return np.stack([np.asarray(x)] * world)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+    env = ProcessEnv.__new__(ProcessEnv)
+    env._world = world
+    return env, calls
+
+
+def test_process_env_all_reduce(monkeypatch):
+    env, calls = _loopback_process_env(monkeypatch)
+    out = env.all_reduce(jnp.asarray([1.0, 2.5]), "sum")
+    np.testing.assert_allclose(np.asarray(out), [2.0, 5.0])
+    assert len(calls) == 1  # ONE collective: no size exchange
+    np.testing.assert_allclose(np.asarray(env.all_reduce(jnp.asarray([4.0]), "mean")), [4.0])
+    np.testing.assert_allclose(np.asarray(env.all_reduce(jnp.asarray(3.0), "max")), [3.0])  # atleast_1d
+    assert env.all_reduce(jnp.asarray(1.0), "bogus") is None
+    # the base-env fallback contract is untouched
+    assert DistEnv().all_reduce(jnp.asarray(1.0), "sum") is None
+
+
+def test_process_env_uniform_gather_skips_size_exchange(monkeypatch):
+    env, calls = _loopback_process_env(monkeypatch)
+    out = env.all_gather_uniform(jnp.arange(6.0))
+    assert len(out) == 2 and out[0].shape == (6,)
+    assert len(calls) == 1  # generic all_gather pays 2 (sizes + data)
+    calls.clear()
+    out = env.all_gather(jnp.arange(6.0))
+    assert len(out) == 2 and len(calls) == 2
+
+
+def test_process_env_fused_sync_parity(monkeypatch):
+    env, calls = _loopback_process_env(monkeypatch)
+    m = MultiLeaf()
+    m.update(_payload(8))
+    with profiling.track_syncs() as t:
+        m.sync(env=env)
+    fused = {k: np.asarray(getattr(m, k)) for k in m._defaults}
+    m.unsync()
+    assert t.buckets == N_BUCKETS
+    # one process_allgather per bucket — no size exchanges anywhere
+    assert len(calls) == N_BUCKETS
+
+    calls.clear()
+    m0 = MultiLeaf()
+    m0.update(_payload(8))
+    os.environ["METRICS_TPU_FUSED_SYNC"] = "0"
+    try:
+        m0.sync(env=env)
+    finally:
+        os.environ.pop("METRICS_TPU_FUSED_SYNC", None)
+    assert len(calls) == N_LEAVES  # per-leaf all_reduce: one DCN trip per state
+    for attr in fused:
+        want = np.asarray(getattr(m0, attr))
+        assert fused[attr].dtype == want.dtype, attr
+        np.testing.assert_array_equal(fused[attr], want, err_msg=attr)
+    m0.unsync()
+
+
+def test_sync_stats_survive_pickling():
+    import pickle
+
+    m = MultiLeaf()
+    m.update(_payload())
+    m.sync(env=Loopback2())
+    m.unsync()
+    assert m.sync_stats["collectives"] > 0
+    m2 = pickle.loads(pickle.dumps(m))
+    assert m2.sync_stats == m.sync_stats
+    mc = _collection(n=2)
+    mc2 = pickle.loads(pickle.dumps(mc))
+    assert mc2.sync_stats == {"collectives": 0, "buckets": 0, "bytes_on_wire": 0}
