@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity doctest bench tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity doctest bench bench-forward tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -55,6 +55,11 @@ doctest:
 
 bench:
 	python bench.py
+
+# forward-engine numbers only: launch/retrace pins + engine-vs-eager step
+# latency, without the rest of the detail suite
+bench-forward:
+	python -c "import json, bench; d = {}; bench._cfg_forward_engine(d); print(json.dumps(d, indent=2))"
 
 clean:
 	rm -rf .pytest_cache

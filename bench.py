@@ -324,6 +324,91 @@ def _cfg_sync_engine(detail: dict) -> None:
             os.environ["METRICS_TPU_FUSED_SYNC"] = prev
 
 
+def _cfg_forward_engine(detail: dict) -> None:
+    """Fused forward engine observability: structural launch / retrace
+    counts for the step path plus engine-vs-eager forward latency.
+
+    The structural pins: a jitted ``Accuracy.forward`` (reduce-state
+    branch, ``full_state_update=False`` — one update per batch, not the
+    reference's two) is exactly ONE engine launch per step, a whole fused
+    collection's forward is ONE launch per step, and ragged batch sizes
+    within a ``bucket_pow2`` bucket share one executable. Latency keys
+    compare the single-launch step against the eager five-phase
+    (copy → reset → update → compute → merge) fallback the kill switch
+    restores."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall, profiling
+
+    rng = np.random.RandomState(13)
+    C = 32
+
+    def batch(b):
+        logits = rng.rand(b, C).astype(np.float32)
+        return jnp.asarray(logits / logits.sum(-1, keepdims=True)), jnp.asarray(rng.randint(0, C, b))
+
+    def timed_forward(step, ready):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(50):
+                step()
+            jax.block_until_ready(ready())
+            best = min(best, (time.perf_counter() - t0) / 50 * 1e6)
+        return round(best, 1)
+
+    # (1) single metric: 10 steps over ragged sizes in the 256-bucket are
+    # 10 launches, zero retraces after the warmup compile
+    m = Accuracy(num_classes=C, average="macro", jit_update=True)
+    warm = batch(256)
+    m.forward(*warm)  # compile
+    jax.block_until_ready(m.tp)
+    sizes = [batch(b) for b in (256, 200, 255, 129, 256, 256, 180, 256, 129, 256)]
+    with profiling.track_forwards() as t:
+        for p, tg in sizes:
+            m.forward(p, tg)
+        jax.block_until_ready(m.tp)
+    detail["forward_launches_single_metric_10_steps"] = t.launch_count(kind="aot")
+    detail["forward_retraces_single_metric_steady"] = t.retrace_count()
+
+    p, tg = warm
+    detail["forward_us_single_metric"] = timed_forward(lambda: m.forward(p, tg), lambda: m.tp)
+
+    # (2) kill switch: the eager five-phase step the engine replaces
+    prev = os.environ.get("METRICS_TPU_FUSED_FORWARD")
+    os.environ["METRICS_TPU_FUSED_FORWARD"] = "0"
+    try:
+        m0 = Accuracy(num_classes=C, average="macro", jit_update=True)
+        m0.forward(p, tg)
+        jax.block_until_ready(m0.tp)
+        detail["forward_us_single_metric_eager"] = timed_forward(
+            lambda: m0.forward(p, tg), lambda: m0.tp)
+    finally:
+        if prev is None:
+            os.environ.pop("METRICS_TPU_FUSED_FORWARD", None)
+        else:
+            os.environ["METRICS_TPU_FUSED_FORWARD"] = prev
+
+    # (3) fused collection: 4 metrics -> ONE launch per forward step
+    col = MetricCollection(
+        {"acc": Accuracy(num_classes=C, average="macro"),
+         "f1": F1Score(num_classes=C, average="macro"),
+         "prec": Precision(num_classes=C, average="macro"),
+         "rec": Recall(num_classes=C, average="macro")},
+        fused_update=True,
+    )
+    col(p, tg)  # compile
+    jax.block_until_ready(col["acc"].tp)
+    with profiling.track_forwards() as t:
+        for _ in range(10):
+            col(p, tg)
+        jax.block_until_ready(col["acc"].tp)
+    detail["forward_launches_fused_collection_10_steps"] = t.launch_count(kind="fused-aot")
+    detail["forward_us_fused_collection"] = timed_forward(
+        lambda: col(p, tg), lambda: col["acc"].tp)
+
+
 def _machinery_device(detail: dict):
     """Host CPU device for the compute-group machinery configs.
 
@@ -921,6 +1006,7 @@ def _bench_detail() -> dict:
         ("wer_update_ms_1k_pairs", _cfg_wer),
         ("collection_dist_sync_8dev_us", _cfg_dist_sync),
         ("sync_collectives_fused_collection", _cfg_sync_engine),
+        ("forward_launches_single_metric_10_steps", _cfg_forward_engine),
     ]
     detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
     return detail
@@ -1136,6 +1222,7 @@ def _bench_detail_fast() -> dict:
         ("collection", _cfg_collection),
         ("dispatch_engine", _cfg_dispatch_engine),
         ("sync_engine", _cfg_sync_engine),
+        ("forward_engine", _cfg_forward_engine),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),

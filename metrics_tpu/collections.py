@@ -19,6 +19,7 @@ from typing import Any, Dict, Generator, Hashable, Iterable, List, Optional, Seq
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu import sync_engine
 from metrics_tpu.metric import Metric, _donation_argnums, _raise_if_list_state, _scan_fold
@@ -97,6 +98,13 @@ class MetricCollection:
         self._fused_forward_fn = None
         self._dispatcher = None  # AOT fast-dispatch engine for fused updates
         self._dispatch_stats: Dict[str, int] = {"dispatches": 0, "retraces": 0}
+        # step-path counters for the fused forward engine (profiling.py)
+        self._forward_stats: Dict[str, Any] = {"launches": 0, "retraces": 0, "engine_us": 0.0}
+        # per-(member, kwarg-names) memo of _filter_kwargs results: the
+        # accepted key set depends only on the update signature and the
+        # kwarg NAMES, so the eager loops need not re-bind signatures
+        # every batch
+        self._filter_kwargs_cache: Dict[Tuple[str, Tuple[str, ...]], Tuple[str, ...]] = {}
         # comms counters for the fused collection-level sync (profiling.py)
         self._sync_stats: Dict[str, int] = {"collectives": 0, "buckets": 0, "bytes_on_wire": 0}
         # (member, saved _to_sync, saved _should_unsync) while a collection
@@ -120,6 +128,10 @@ class MetricCollection:
         self._dispatcher = None
         self._dispatch_stats = dict(self.__dict__.get("_dispatch_stats") or {"dispatches": 0, "retraces": 0})
         self._sync_stats = dict(self.__dict__.get("_sync_stats") or {"collectives": 0, "buckets": 0, "bytes_on_wire": 0})
+        self._forward_stats = dict(
+            self.__dict__.get("_forward_stats") or {"launches": 0, "retraces": 0, "engine_us": 0.0}
+        )
+        self._filter_kwargs_cache = {}
         self._synced_members = self.__dict__.get("_synced_members", None)
 
     # --------------------------------------------------------------- mapping
@@ -128,6 +140,7 @@ class MetricCollection:
 
     def __setitem__(self, key: str, value: Metric) -> None:
         self._modules[key] = value
+        self._filter_kwargs_cache.clear()  # member set changed
 
     def __contains__(self, key: str) -> bool:
         return key in self._modules
@@ -175,13 +188,28 @@ class MetricCollection:
         (rank_zero_warn if self._fused_update is True else rank_zero_debug)(msg)
         self._fuse_failed = True
 
+    def _filtered_kwargs(self, name: str, metric: Metric, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        """``metric._filter_kwargs`` with the accepted key set memoized per
+        (member, kwarg-name tuple) — the eager loops call this every batch
+        and the answer never changes for a fixed call pattern."""
+        if not kwargs:
+            return kwargs
+        cache_key = (name, tuple(sorted(kwargs)))
+        keep = self._filter_kwargs_cache.get(cache_key)
+        if keep is None:
+            keep = tuple(metric._filter_kwargs(**kwargs))
+            self._filter_kwargs_cache[cache_key] = keep
+        return {k: kwargs[k] for k in keep}
+
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Call forward on every metric; kwargs filtered per metric (ref :128-136)."""
         if self._fusion_enabled:
             fused = self._try_fused_forward(*args, **kwargs)
             if fused is not None:
                 return fused
-        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True)}
+        res = {
+            k: m(*args, **self._filtered_kwargs(k, m, kwargs)) for k, m in self.items(keep_base=True)
+        }
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
 
@@ -194,10 +222,10 @@ class MetricCollection:
         if self._groups_checked:
             for _, cg in self._groups.items():
                 m0 = self._modules[cg[0]]
-                m0.update(*args, **m0._filter_kwargs(**kwargs))
+                m0.update(*args, **self._filtered_kwargs(cg[0], m0, kwargs))
         else:
-            for _, m in self.items(keep_base=True):
-                m.update(*args, **m._filter_kwargs(**kwargs))
+            for name, m in self.items(keep_base=True):
+                m.update(*args, **self._filtered_kwargs(name, m, kwargs))
             if self._enable_compute_groups:
                 self._merge_compute_groups()
                 self._groups_checked = True
@@ -214,8 +242,6 @@ class MetricCollection:
     # host-side metrics) falls back to the eager loop permanently for this
     # collection.
     def _fusable(self, args: tuple, kwargs: dict) -> bool:
-        import numpy as _np
-
         for m in self._modules.values():
             if m.compute_on_cpu or m.dist_sync_on_step:
                 return False
@@ -226,7 +252,7 @@ class MetricCollection:
                 # the pure save/restore cannot cover it
                 return False
         leaves = jax.tree_util.tree_leaves((args, kwargs))
-        return all(isinstance(x, (jax.Array, _np.ndarray, int, float, bool, _np.number)) for x in leaves)
+        return all(isinstance(x, (jax.Array, np.ndarray, int, float, bool, np.number)) for x in leaves)
 
     def _make_dispatcher(self):
         """AOT engine for the fused update: all member states cross as ONE
@@ -279,6 +305,10 @@ class MetricCollection:
         def masking_ok():
             return all(m._masked_update_supported() for m in self._modules.values())
 
+        from metrics_tpu.forward_engine import make_collection_forward_factories
+
+        make_forward, make_masked_forward = make_collection_forward_factories(self, unflatten, flatten)
+
         return FastDispatcher(
             "MetricCollection",
             read_leaves,
@@ -287,12 +317,22 @@ class MetricCollection:
             make_masked_update,
             masking_ok=masking_ok,
             stats=self._dispatch_stats,
+            make_forward=make_forward,
+            make_masked_forward=make_masked_forward,
+            forward_stats=self._forward_stats,
         )
 
     @property
     def dispatch_stats(self) -> Dict[str, int]:
         """Fused-path counters: executable ``dispatches`` / ``retraces``."""
         return dict(self._dispatch_stats)
+
+    @property
+    def forward_stats(self) -> Dict[str, Any]:
+        """Step-path counters for the fused forward engine: single-launch
+        ``launches`` covering the whole collection, forward-program
+        ``retraces``, and cumulative host-side ``engine_us``."""
+        return dict(self._forward_stats)
 
     def _try_fused_update(self, *args: Any, **kwargs: Any) -> bool:
         try:
@@ -335,33 +375,57 @@ class MetricCollection:
         return new_states, batch_vals
 
     def _try_fused_forward(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
+        engine = False
         try:
             if not self._fusable(args, kwargs):
                 self._fuse_fallback("forward", "unfusable member or non-array inputs")
                 return None
-            if self._fused_forward_fn is None:
-                self._fused_forward_fn = jax.jit(self._fused_forward_impl, donate_argnums=_donation_argnums())
+            from metrics_tpu.dispatch import fast_dispatch_enabled
+            from metrics_tpu.forward_engine import fused_forward_enabled
+
             # merge counts ride as traced leaves so growing counts don't retrace
             counts = {
                 name: jnp.asarray(m._update_count + 1, dtype=jnp.float32)
                 for name, m in self.items(keep_base=True)
             }
-            fn = self._fused_forward_fn
-            size_before = fn._cache_size() if hasattr(fn, "_cache_size") else None
-            new_states, batch_vals = fn(self.state(), counts, *args, **kwargs)
-            from metrics_tpu import profiling
+            engine = fast_dispatch_enabled() and fused_forward_enabled()
+            if engine:
+                # forward engine: the whole suite's step is ONE cached
+                # executable launch, state leaves read/written in place
+                # (group followers adopt leader state first — the leaves
+                # cross as-is, with no state() copies)
+                self._compute_groups_create_state_ref()
+                if self._dispatcher is None:
+                    self._dispatcher = self._make_dispatcher()
+                batch_vals = self._dispatcher.forward(counts, {}, (), args, kwargs)
+            else:
+                # legacy fused path: one jit with per-call signature hashing
+                if self._fused_forward_fn is None:
+                    self._fused_forward_fn = jax.jit(self._fused_forward_impl, donate_argnums=_donation_argnums())
+                fn = self._fused_forward_fn
+                size_before = fn._cache_size() if hasattr(fn, "_cache_size") else None
+                new_states, batch_vals = fn(self.state(), counts, *args, **kwargs)
+                from metrics_tpu import profiling
 
-            if size_before is not None and fn._cache_size() > size_before:
-                self._dispatch_stats["retraces"] += 1
-                profiling.record_retrace("MetricCollection", "jit")
-            self._dispatch_stats["dispatches"] += 1
-            profiling.record_dispatch("MetricCollection", "jit")
+                if size_before is not None and fn._cache_size() > size_before:
+                    self._dispatch_stats["retraces"] += 1
+                    profiling.record_retrace("MetricCollection", "jit")
+                self._dispatch_stats["dispatches"] += 1
+                profiling.record_dispatch("MetricCollection", "jit")
         except Exception as err:
             self._fuse_fallback("forward", err)
             return None
-        self.load_pure_state(new_states, increment=True)
-        for name, m in self.items(keep_base=True):
-            m._forward_cache = batch_vals[name]
+        if engine:
+            # leaves already written in place; mirror load_pure_state's
+            # bookkeeping without the copies
+            for name, m in self.items(keep_base=True):
+                m._update_count += 1
+                m._computed = None
+                m._forward_cache = batch_vals[name]
+        else:
+            self.load_pure_state(new_states, increment=True)
+            for name, m in self.items(keep_base=True):
+                m._forward_cache = batch_vals[name]
         res = _flatten_dict(batch_vals)
         return {self._set_name(k): v for k, v in res.items()}
 

@@ -24,6 +24,14 @@ This engine removes all three:
   padded rows exact no-ops. Varying batch sizes within a bucket therefore
   hit ONE executable — zero retraces — instead of one trace per shape.
 
+The cache is **multi-output**: besides ``(leaves) -> leaves`` update
+programs it holds ``(count, leaves, batch) -> (leaves, batch_value)``
+forward programs (see :mod:`metrics_tpu.forward_engine`), which advance the
+state AND produce the step's batch value in the same single launch. Both
+program families share the bucketing, masked-padding, donation, and
+ownership machinery; they differ only in their cache-key prefix and which
+profiling stream records them.
+
 Every executable launch and every compile is recorded with
 :mod:`metrics_tpu.profiling`, which is what lets tests assert "one dispatch
 per fused update" and "zero retraces within a bucket" structurally.
@@ -33,6 +41,7 @@ fall back to the legacy ``jax.jit`` path); ``MIN_BUCKET`` is the smallest
 pad target (tiny batches share one bucket instead of minting executables).
 """
 import os
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -82,10 +91,19 @@ class FastDispatcher:
         make_masked_update: same shape but
             ``fn(n_valid, leaves, *args, **dyn)``; ``None`` if the owner has
             no masked-update support (exact-shape executables only).
+        make_forward: ``(static_kwargs) -> fn(count, leaves, *args, **dyn)
+            -> (leaves, batch_value)`` — the multi-output forward program
+            (state advance + batch value in one launch); ``None`` if the
+            owner only dispatches updates.
+        make_masked_forward: same shape but
+            ``fn(count, n_valid, leaves, *args, **dyn)``.
         masking_ok: ``() -> bool`` — owner-level eligibility for padded
             (masked) execution given its current configuration.
         stats: optional shared mutable dict with ``dispatches``/``retraces``
             keys (the owner's per-metric counters).
+        forward_stats: optional shared mutable dict with ``launches`` /
+            ``retraces`` / ``engine_us`` keys (the owner's forward-path
+            counters).
     """
 
     def __init__(
@@ -97,14 +115,24 @@ class FastDispatcher:
         make_masked_update: Optional[Callable[[Dict], Callable]] = None,
         masking_ok: Optional[Callable[[], bool]] = None,
         stats: Optional[Dict[str, int]] = None,
+        make_forward: Optional[Callable[[Dict], Callable]] = None,
+        make_masked_forward: Optional[Callable[[Dict], Callable]] = None,
+        forward_stats: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.label = label
         self._read_leaves = read_leaves
         self._write_leaves = write_leaves
         self._make_update = make_update
         self._make_masked_update = make_masked_update
+        self._make_forward = make_forward
+        self._make_masked_forward = make_masked_forward
         self._masking_ok = masking_ok or (lambda: False)
         self.stats = stats if stats is not None else {"dispatches": 0, "retraces": 0}
+        self.forward_stats = (
+            forward_stats
+            if forward_stats is not None
+            else {"launches": 0, "retraces": 0, "engine_us": 0.0}
+        )
         self._cache: Dict[Tuple, Any] = {}
         # id()s of the leaves the engine itself produced last; anything else
         # is a foreign buffer that must be copied before donation
@@ -113,14 +141,16 @@ class FastDispatcher:
         self._kind = "fused-aot" if label.startswith("MetricCollection") else "aot"
 
     # ------------------------------------------------------------------ call
-    def update(self, static: Dict, static_key: Tuple, args: Tuple, dyn_kwargs: Dict) -> None:
-        """Run one update through a cached executable (compiling on miss)."""
+    def _prepare_call(self, args: Tuple, dyn_kwargs: Dict, masked_factory) -> Tuple:
+        """Shared input prep for update/forward launches: canonicalize the
+        flattened batch, decide masked (bucketed) vs exact-shape execution,
+        pad, and read + validate the state leaves."""
         flat_inputs, treedef = jax.tree_util.tree_flatten((args, dyn_kwargs))
         flat_inputs = [self._canonicalize(x) for x in flat_inputs]
 
         batch = self._uniform_batch(flat_inputs)
         masked = (
-            self._make_masked_update is not None
+            masked_factory is not None
             # B=1 inputs can hit squeeze-style formatting whose semantics
             # change with the padded length; keep them on exact shapes
             and batch is not None
@@ -132,13 +162,19 @@ class FastDispatcher:
             bucket = bucket_pow2(batch, minimum=MIN_BUCKET)
             call_inputs = [pad_axis0(x, bucket) for x in flat_inputs]
         else:
-            bucket = None
             call_inputs = flat_inputs
 
         leaves = self._read_leaves()
         for leaf in leaves:
             if not isinstance(leaf, jax.Array):
                 raise FastDispatchUnsupported(f"non-array state leaf of type {type(leaf).__name__}")
+        return treedef, call_inputs, leaves, masked, batch
+
+    def update(self, static: Dict, static_key: Tuple, args: Tuple, dyn_kwargs: Dict) -> None:
+        """Run one update through a cached executable (compiling on miss)."""
+        treedef, call_inputs, leaves, masked, batch = self._prepare_call(
+            args, dyn_kwargs, self._make_masked_update
+        )
 
         key = (
             masked,
@@ -163,6 +199,51 @@ class FastDispatcher:
 
         self._write_leaves(out)
         self._owned = tuple(id(x) for x in out)
+
+    def forward(self, counts: Any, static: Dict, static_key: Tuple, args: Tuple, dyn_kwargs: Dict) -> Any:
+        """Run one fused forward — state advance AND batch value in a single
+        launch — through a cached multi-output executable (compiling on
+        miss). ``counts`` is a pytree of traced merge-count scalars (one for
+        a metric, ``{name: scalar}`` for a collection) so growing counts
+        never retrace. New state leaves are written in place; the batch
+        value is returned."""
+        if self._make_forward is None:
+            raise FastDispatchUnsupported("owner wired no forward program factory")
+        treedef, call_inputs, leaves, masked, batch = self._prepare_call(
+            args, dyn_kwargs, self._make_masked_forward
+        )
+
+        counts_flat, counts_def = jax.tree_util.tree_flatten(counts)
+        key = (
+            "fwd",
+            masked,
+            static_key,
+            treedef,
+            counts_def,
+            tuple(_aval_key(self._canonicalize(x)) for x in counts_flat),
+            tuple(_aval_key(x) for x in call_inputs),
+            tuple(_aval_key(x) for x in leaves),
+        )
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile_forward(key, masked, static, treedef, leaves, call_inputs, counts)
+
+        leaves = self._prepare_donation(leaves)
+        t0 = time.perf_counter()
+        if masked:
+            out_leaves, batch_val = compiled(counts, self._n_valid(batch), leaves, *call_inputs)
+        else:
+            out_leaves, batch_val = compiled(counts, leaves, *call_inputs)
+        out_leaves = tuple(out_leaves)
+        elapsed_us = (time.perf_counter() - t0) * 1e6
+
+        profiling.record_forward(self.label, self._kind, elapsed_us)
+        self.forward_stats["launches"] += 1
+        self.forward_stats["engine_us"] += elapsed_us
+
+        self._write_leaves(out_leaves)
+        self._owned = tuple(id(x) for x in out_leaves)
+        return batch_val
 
     # --------------------------------------------------------------- helpers
     @staticmethod
@@ -220,5 +301,36 @@ class FastDispatcher:
 
         profiling.record_retrace(self.label, self._kind)
         self.stats["retraces"] += 1
+        self._cache[key] = compiled
+        return compiled
+
+    def _compile_forward(self, key, masked, static, treedef, example_leaves, example_inputs, example_counts):
+        """Lower + compile one multi-output forward program
+        ``(counts, [n_valid,] leaves, batch) -> (leaves, batch_value)``."""
+        if masked:
+            inner = self._make_masked_forward(dict(static))
+
+            def fn(counts, n_valid, leaves, *flat):
+                args, dyn = jax.tree_util.tree_unflatten(treedef, list(flat))
+                new_leaves, batch_val = inner(counts, n_valid, tuple(leaves), *args, **dyn)
+                return tuple(new_leaves), batch_val
+
+            jitted = jax.jit(fn, donate_argnums=(2,) if _donation_enabled() else ())
+            compiled = jitted.lower(
+                example_counts, jnp.asarray(0, jnp.int32), tuple(example_leaves), *example_inputs
+            ).compile()
+        else:
+            inner = self._make_forward(dict(static))
+
+            def fn(counts, leaves, *flat):
+                args, dyn = jax.tree_util.tree_unflatten(treedef, list(flat))
+                new_leaves, batch_val = inner(counts, tuple(leaves), *args, **dyn)
+                return tuple(new_leaves), batch_val
+
+            jitted = jax.jit(fn, donate_argnums=(1,) if _donation_enabled() else ())
+            compiled = jitted.lower(example_counts, tuple(example_leaves), *example_inputs).compile()
+
+        profiling.record_forward_retrace(self.label, self._kind)
+        self.forward_stats["retraces"] += 1
         self._cache[key] = compiled
         return compiled

@@ -1,0 +1,174 @@
+"""Fused forward engine: single-launch update+batch-compute for the step path.
+
+``forward`` is the per-step hot path of the whole library — every
+training/logging step calls it — and the reference implementation
+(ref metric.py:198-241) executes it as five eager phases: copy state →
+reset → update → compute → merge, with the ``full_state_update`` branch
+running ``update`` **twice** per batch. This engine collapses the entire
+step into ONE device program per call: an AOT-compiled executable (cached
+per static-flag key, pow2 shape bucket, and dtype via the
+:mod:`metrics_tpu.dispatch` machinery) that takes the current global state
+leaves plus the batch and returns ``(new_global_state_leaves, batch_value)``.
+
+Two program shapes, matching the two reference branches:
+
+* ``full_state_update=False`` — ONE update, not two: the program runs
+  ``pure_update`` on a fresh default state, computes the batch value from
+  that batch state with ``pure_compute``, and folds the global state in
+  with ``pure_merge`` (the declared per-state reductions, with the update
+  count riding as a traced scalar so growing counts never retrace).
+* ``full_state_update=True`` (or ``None``) — the reference's double-update
+  semantics compiled inside the trace: ``pure_update`` on the global state
+  AND on a fresh default state, batch value from the latter. Exact parity
+  with the eager branch while still costing a single launch.
+
+State leaves are donated off-CPU (the dispatcher's ownership tracking makes
+that safe); padded rows in shape-bucketed launches are exact no-ops via the
+owner's masked-update support. The engine only engages where it is exact:
+
+* metrics constructed with ``jit_update=True`` (eager metrics keep
+  value-dependent Python validation in their step);
+* fixed-shape array states only — list states fall back to the eager path;
+* ``dist_sync_on_step=False`` — a per-step sync is a collective the engine
+  will not trace through; such metrics keep the eager full-state path;
+* any engine failure demotes the metric to the eager path permanently
+  (same contract as the fast-dispatch update engine).
+
+``METRICS_TPU_FUSED_FORWARD=0`` disables the engine process-wide:
+``Metric.forward`` falls back to the eager reference-parity branches and
+``MetricCollection`` forward to its legacy single-jit fused program.
+Every launch/compile is recorded with :mod:`metrics_tpu.profiling`
+(``track_forwards`` / per-owner ``forward_stats``), which is what lets
+tests pin "one launch per step" structurally.
+"""
+import os
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import _squeeze_if_scalar
+
+
+def fused_forward_enabled() -> bool:
+    """Engine kill switch (env ``METRICS_TPU_FUSED_FORWARD``, default on)."""
+    return os.environ.get("METRICS_TPU_FUSED_FORWARD", "1").lower() not in ("0", "false", "off")
+
+
+def _padded_mask(args: Tuple, dyn: Dict, n_valid: Any) -> jax.Array:
+    """Axis-0 validity mask for a shape-bucketed (padded) batch."""
+    padded_len = next(
+        x.shape[0] for x in jax.tree_util.tree_leaves((args, dyn)) if getattr(x, "ndim", 0) >= 1
+    )
+    return jnp.arange(padded_len, dtype=jnp.int32) < n_valid
+
+
+def make_metric_forward_factories(metric: Any, names: list) -> Tuple[Callable, Callable]:
+    """Forward-program factories for one ``Metric`` (wired into its
+    :class:`~metrics_tpu.dispatch.FastDispatcher` next to the update
+    factories). Each factory closes over the static kwargs and returns the
+    pure program the dispatcher lowers: ``fn(count, [n_valid,] leaves,
+    *args, **dyn) -> (new_leaves, batch_value)``."""
+    # None means "unknown, assume full" — same resolution as Metric.forward's
+    # eager branch selection
+    full_state = bool(metric.full_state_update) or metric.full_state_update is None
+
+    def _program(update_fn: Callable, static: Dict) -> Callable:
+        def fn(count, leaves, *args, **dyn):
+            state = dict(zip(names, leaves))
+            batch_state = update_fn(metric.default_state(), *args, **dyn, **static)
+            if full_state:
+                new_state = update_fn(state, *args, **dyn, **static)
+            else:
+                new_state = metric.pure_merge(state, batch_state, count=count)
+            batch_val = _squeeze_if_scalar(metric.pure_compute(batch_state))
+            return tuple(new_state[k] for k in names), batch_val
+
+        return fn
+
+    def make_forward(static: Dict) -> Callable:
+        return _program(metric.pure_update, static)
+
+    def make_masked_forward(static: Dict) -> Callable:
+        def fn(count, n_valid, leaves, *args, **dyn):
+            mask = _padded_mask(args, dyn, n_valid)
+
+            def masked_update(state, *a, **kw):
+                return metric._masked_pure_update(state, mask, *a, **kw)
+
+            return _program(masked_update, static)(count, leaves, *args, **dyn)
+
+        return fn
+
+    return make_forward, make_masked_forward
+
+
+def make_collection_forward_factories(
+    collection: Any, unflatten: Callable, flatten: Callable
+) -> Tuple[Callable, Callable]:
+    """Forward-program factories for a ``MetricCollection``: the whole
+    suite advances and yields its batch values in ONE compiled launch.
+    ``counts`` is a ``{name: traced scalar}`` pytree (per-member merge
+    counts); the unmasked program is ``_fused_forward_impl`` itself, so the
+    engine's semantics are pinned to the legacy fused-jit path."""
+
+    def make_forward(static: Dict) -> Callable:
+        def fn(counts, leaves, *args, **kwargs):
+            new_states, batch_vals = collection._fused_forward_impl(
+                unflatten(leaves), counts, *args, **kwargs
+            )
+            return flatten(new_states), batch_vals
+
+        return fn
+
+    def make_masked_forward(static: Dict) -> Callable:
+        def fn(counts, n_valid, leaves, *args, **kwargs):
+            mask = _padded_mask(args, kwargs, n_valid)
+            states = unflatten(leaves)
+            new_states, batch_vals = {}, {}
+            for name, m in collection.items(keep_base=True):
+                kw = m._filter_kwargs(**kwargs)
+                batch_state = m._masked_pure_update(m.default_state(), mask, *args, **kw)
+                if m.full_state_update or m.full_state_update is None:
+                    new_states[name] = m._masked_pure_update(states[name], mask, *args, **kw)
+                else:
+                    new_states[name] = m.pure_merge(states[name], batch_state, count=counts[name])
+                batch_vals[name] = _squeeze_if_scalar(m.pure_compute(batch_state))
+            return flatten(new_states), batch_vals
+
+        return fn
+
+    return make_forward, make_masked_forward
+
+
+def metric_forward(metric: Any, args: Tuple, kwargs: Dict) -> Any:
+    """Run one ``Metric.forward`` step through the engine; returns the batch
+    value. State leaves are written in place by the dispatcher; this driver
+    mirrors the eager path's host bookkeeping (update count, memo
+    invalidation). Any exception is the caller's cue to demote the metric
+    to the eager path permanently."""
+    from metrics_tpu.metric import _is_static_scalar, _split_static_kwargs
+
+    # same static/dynamic partition as the jitted update path: flag kwargs
+    # (e.g. FID's ``real=True``) select Python control flow, so they join
+    # the executable cache key instead of being traced
+    if any(_is_static_scalar(v) for v in args) or any(
+        _is_static_scalar(v) for v in kwargs.values()
+    ):
+        args, kwargs = metric._normalize_update_args(args, kwargs)
+        static, dynamic = _split_static_kwargs(kwargs, numeric_static=False)
+        key = tuple(sorted(static.items()))
+    else:
+        static, dynamic, key = {}, kwargs, ()
+
+    if metric._dispatcher is None:
+        metric._dispatcher = metric._make_dispatcher()
+    # the merge count rides as a traced scalar so step N+1 reuses step N's
+    # executable (mean merges divide by it; everything else ignores it)
+    count = jnp.asarray(metric._update_count + 1, dtype=jnp.float32)
+    with jax.named_scope(f"metrics_tpu.{type(metric).__name__}.forward"):
+        batch_val = metric._dispatcher.forward(count, static, key, args, dynamic)
+
+    metric._update_count += 1
+    metric._computed = None
+    return batch_val

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import profiling, sync_engine
+from metrics_tpu import forward_engine, profiling, sync_engine
 from metrics_tpu.dispatch import fast_dispatch_enabled
 from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, default_env
 from metrics_tpu.utilities.data import (
@@ -217,6 +217,11 @@ class Metric(ABC):
         self._dispatcher = None
         self._fast_dispatch_failed = False
         self._dispatch_stats: Dict[str, int] = {"dispatches": 0, "retraces": 0}
+        # fused forward engine (single-launch update+batch-compute, see
+        # metrics_tpu.forward_engine); shares the dispatcher's executable
+        # cache, permanently demoted to the eager forward path on error
+        self._fused_forward_failed = False
+        self._forward_stats: Dict[str, Any] = {"launches": 0, "retraces": 0, "engine_us": 0.0}
         # comms counters for the sync path (see metrics_tpu.profiling):
         # every collective this metric issues, fused buckets, and wire bytes
         self._sync_stats: Dict[str, int] = {"collectives": 0, "buckets": 0, "bytes_on_wire": 0}
@@ -427,12 +432,39 @@ class Metric(ABC):
 
     # ------------------------------------------------------------ fwd/update
     def forward(self, *args: Any, **kwargs: Any) -> Any:
-        """Accumulate *and* return the batch-local value (ref metric.py:198-241)."""
+        """Accumulate *and* return the batch-local value (ref metric.py:198-241).
+
+        For ``jit_update=True`` metrics with fixed-shape states the whole
+        step — state advance AND batch value — runs as ONE cached AOT
+        executable launch (:mod:`metrics_tpu.forward_engine`); the eager
+        reference-parity branches below stay as the fallback and as the
+        ``METRICS_TPU_FUSED_FORWARD=0`` kill-switch path.
+        """
         if self._is_synced:
             raise MetricsUserError(
                 "The Metric shouldn't be synced when performing ``forward``. "
                 "HINT: Did you forget to call ``unsync``?"
             )
+        if (
+            self._jit_update_requested
+            # per-step sync is a collective the engine won't trace through
+            and not self.dist_sync_on_step
+            and not self._fused_forward_failed
+            and not self._fast_dispatch_failed
+            and forward_engine.fused_forward_enabled()
+            and fast_dispatch_enabled()
+            and not any(isinstance(v, list) for v in self._defaults.values())
+        ):
+            try:
+                self._forward_cache = forward_engine.metric_forward(self, args, kwargs)
+                return self._forward_cache
+            except Exception as err:  # noqa: BLE001 — any engine failure
+                # demotes to the eager forward path for good
+                self._fused_forward_failed = True
+                rank_zero_debug(
+                    f"fused forward disabled for {type(self).__name__}"
+                    f" ({type(err).__name__}: {err}); using the eager path."
+                )
         if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
             self._forward_cache = self._forward_full_state_update(*args, **kwargs)
         else:
@@ -634,6 +666,8 @@ class Metric(ABC):
 
             return fn
 
+        make_forward, make_masked_forward = forward_engine.make_metric_forward_factories(self, names)
+
         return FastDispatcher(
             type(self).__name__,
             read_leaves,
@@ -642,6 +676,9 @@ class Metric(ABC):
             make_masked_update,
             masking_ok=self._masked_update_supported,
             stats=self._dispatch_stats,
+            make_forward=make_forward,
+            make_masked_forward=make_masked_forward,
+            forward_stats=self._forward_stats,
         )
 
     @property
@@ -649,6 +686,13 @@ class Metric(ABC):
         """Hot-path counters for this metric: device-program ``dispatches``
         and compile-time ``retraces`` (see :mod:`metrics_tpu.profiling`)."""
         return dict(self._dispatch_stats)
+
+    @property
+    def forward_stats(self) -> Dict[str, Any]:
+        """Step-path counters for this metric: fused-forward engine
+        ``launches``, forward-program ``retraces``, and cumulative
+        host-side ``engine_us`` (see :mod:`metrics_tpu.profiling`)."""
+        return dict(self._forward_stats)
 
     @property
     def sync_stats(self) -> Dict[str, int]:
@@ -1132,6 +1176,10 @@ class Metric(ABC):
         self._dispatch_stats = dict(self.__dict__.get("_dispatch_stats") or {"dispatches": 0, "retraces": 0})
         self._fast_dispatch_failed = bool(self.__dict__.get("_fast_dispatch_failed", False))
         self._sync_stats = dict(self.__dict__.get("_sync_stats") or {"collectives": 0, "buckets": 0, "bytes_on_wire": 0})
+        self._forward_stats = dict(
+            self.__dict__.get("_forward_stats") or {"launches": 0, "retraces": 0, "engine_us": 0.0}
+        )
+        self._fused_forward_failed = bool(self.__dict__.get("_fused_forward_failed", False))
 
     def __setattr__(self, name: str, value: Any) -> None:
         if name in ("higher_is_better", "is_differentiable", "full_state_update"):

@@ -51,6 +51,29 @@ Usage::
 
 Per-owner counters live on the objects (``Metric.sync_stats`` /
 ``MetricCollection.sync_stats``).
+
+And for the step path (:mod:`metrics_tpu.forward_engine`): every
+single-launch fused ``forward`` — the program that advances the state AND
+produces the batch value in one executable call — is recorded with its
+host-side dispatch time:
+
+* ``aot``       — one metric's fused forward launch.
+* ``fused-aot`` — one launch covering a whole ``MetricCollection``'s step.
+
+Forward launches are deliberately NOT mirrored into the dispatch trackers:
+``track_dispatches`` counts the *update* path, ``track_forwards`` the
+*step* path, so a test can pin "10 forwards = 10 launches, 0 update
+dispatches" without cross-contamination.
+
+Usage::
+
+    with track_forwards() as tracker:
+        metric(preds, target)                 # forward: ONE launch
+    assert tracker.launches == 1
+    assert tracker.retraces == 0              # steady state: cached
+
+Per-owner counters live on the objects (``Metric.forward_stats`` /
+``MetricCollection.forward_stats``).
 """
 import threading
 from contextlib import contextmanager
@@ -59,6 +82,7 @@ from typing import Dict, Generator, List, Tuple
 _lock = threading.Lock()
 _active_trackers: List["DispatchTracker"] = []
 _active_sync_trackers: List["SyncTracker"] = []
+_active_forward_trackers: List["ForwardTracker"] = []
 
 
 class DispatchTracker:
@@ -201,3 +225,86 @@ def track_syncs() -> Generator[SyncTracker, None, None]:
     finally:
         with _lock:
             _active_sync_trackers.remove(tracker)
+
+
+class ForwardTracker:
+    """Aggregated forward-engine counts recorded while a context is open.
+
+    Attributes:
+        launches: total single-launch fused forwards recorded (all kinds).
+        retraces: total forward-program compilations recorded.
+        engine_us: cumulative host-side dispatch time of the recorded
+            launches in microseconds (wall time of the executable call —
+            on async backends this is the dispatch cost, not device time).
+        events: ``(owner, kind, us)`` tuples in record order; retrace
+            events carry ``kind="retrace:<kind>"`` and zero µs.
+    """
+
+    def __init__(self) -> None:
+        self.launches = 0
+        self.retraces = 0
+        self.engine_us = 0.0
+        self.events: List[Tuple[str, str, float]] = []
+        self._launch_by_kind: Dict[str, int] = {}
+        self._retrace_by_kind: Dict[str, int] = {}
+
+    def launch_count(self, kind: str = None, owner: str = None) -> int:
+        """Launches filtered by ``kind`` and/or an ``owner`` substring."""
+        if kind is None and owner is None:
+            return self.launches
+        if owner is None:
+            return self._launch_by_kind.get(kind, 0)
+        return sum(
+            1
+            for o, k, _ in self.events
+            if not k.startswith("retrace:")
+            and (kind is None or k == kind)
+            and owner in o
+        )
+
+    def retrace_count(self, kind: str = None) -> int:
+        if kind is None:
+            return self.retraces
+        return self._retrace_by_kind.get(kind, 0)
+
+    def _record_launch(self, owner: str, kind: str, us: float) -> None:
+        self.launches += 1
+        self.engine_us += us
+        self._launch_by_kind[kind] = self._launch_by_kind.get(kind, 0) + 1
+        self.events.append((owner, kind, us))
+
+    def _record_retrace(self, owner: str, kind: str) -> None:
+        self.retraces += 1
+        self._retrace_by_kind[kind] = self._retrace_by_kind.get(kind, 0) + 1
+        self.events.append((owner, f"retrace:{kind}", 0.0))
+
+
+def record_forward(owner: str, kind: str, us: float) -> None:
+    """Record one fused-forward launch of ``us`` microseconds for ``owner``."""
+    if not _active_forward_trackers:
+        return
+    with _lock:
+        for tracker in _active_forward_trackers:
+            tracker._record_launch(owner, kind, us)
+
+
+def record_forward_retrace(owner: str, kind: str) -> None:
+    """Record one forward-program compilation on behalf of ``owner``."""
+    if not _active_forward_trackers:
+        return
+    with _lock:
+        for tracker in _active_forward_trackers:
+            tracker._record_retrace(owner, kind)
+
+
+@contextmanager
+def track_forwards() -> Generator[ForwardTracker, None, None]:
+    """Count every fused-forward launch/retrace issued inside the block."""
+    tracker = ForwardTracker()
+    with _lock:
+        _active_forward_trackers.append(tracker)
+    try:
+        yield tracker
+    finally:
+        with _lock:
+            _active_forward_trackers.remove(tracker)

@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 18
+    assert len(skipped) == 19
     assert "detail_elapsed_s" in detail
 
 
@@ -166,6 +166,27 @@ def test_sync_engine_config_counts_and_keys(monkeypatch):
     # the config must restore the kill switch it toggles
     assert os.environ.get("METRICS_TPU_FUSED_SYNC") is None or (
         os.environ["METRICS_TPU_FUSED_SYNC"] != "0")
+
+
+def test_forward_engine_config_counts_and_keys(monkeypatch):
+    """Pin the forward-engine bench config: the structural claim is 'one
+    engine launch per forward step' — 10 jitted Accuracy.forward steps over
+    ragged batch sizes in one pow2 bucket are exactly 10 launches and zero
+    steady-state retraces, and a 4-member fused collection's forward is
+    likewise one launch per step. The latency keys must exist alongside
+    (engine vs the eager five-phase step the kill switch restores)."""
+    monkeypatch.delenv("METRICS_TPU_FUSED_FORWARD", raising=False)
+    detail = {}
+    bench._cfg_forward_engine(detail)
+    assert detail["forward_launches_single_metric_10_steps"] == 10
+    assert detail["forward_retraces_single_metric_steady"] == 0
+    assert detail["forward_launches_fused_collection_10_steps"] == 10
+    assert detail["forward_us_single_metric"] > 0
+    assert detail["forward_us_single_metric_eager"] > 0
+    assert detail["forward_us_fused_collection"] > 0
+    # the config must restore the kill switch it toggles
+    assert os.environ.get("METRICS_TPU_FUSED_FORWARD") is None or (
+        os.environ["METRICS_TPU_FUSED_FORWARD"] != "0")
 
 
 def test_cg_configs_record_host_pinning():

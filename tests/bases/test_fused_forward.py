@@ -1,0 +1,269 @@
+"""Parity + structural tests for the fused forward engine
+(metrics_tpu/forward_engine.py).
+
+The engine collapses the per-step hot path — state advance AND batch value —
+into ONE cached AOT executable launch. These tests pin the two properties
+the bench prose claims: exact value parity with the eager reference
+branches (both ``full_state_update`` flavors, plus every fallback), and the
+structural launch/retrace counts (one launch per step, zero retraces within
+a ``bucket_pow2`` bucket) via :func:`metrics_tpu.profiling.track_forwards`.
+"""
+import copy
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, CatMetric, F1Score, MetricCollection, Precision, Recall, profiling
+from metrics_tpu.forward_engine import fused_forward_enabled
+from metrics_tpu.metric import Metric
+
+NUM_CLASSES = 7
+
+
+def _batch(rng, b, num_classes=NUM_CLASSES):
+    logits = rng.rand(b, num_classes).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, num_classes, b))
+    return preds, target
+
+
+def _assert_states_equal(a, b):
+    for name in a._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            err_msg=f"state {name!r} diverged",
+        )
+
+
+class RunningMax(Metric):
+    """Minimal ``full_state_update = True`` metric: forward must use the
+    reference double-update semantics (the engine compiles them in-trace)."""
+
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("maximum", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+    def update(self, values):
+        self.maximum = jnp.maximum(self.maximum, jnp.max(values))
+
+    def compute(self):
+        return self.maximum
+
+
+# --------------------------------------------------------------------- parity
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_engine_forward_matches_eager_reduce_state_branch(average):
+    """full_state_update=False: engine (one update + merge) vs the eager
+    ``_forward_reduce_state_update`` branch, across ragged batch sizes."""
+    rng = np.random.RandomState(0)
+    m = Accuracy(num_classes=NUM_CLASSES, average=average, jit_update=True)
+    ref = Accuracy(num_classes=NUM_CLASSES, average=average)
+    assert m.full_state_update is False
+    for b in (64, 64, 48, 65, 100, 2):
+        preds, target = _batch(rng, b)
+        got, want = m.forward(preds, target), ref.forward(preds, target)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+    _assert_states_equal(m, ref)  # integer stat-score states: exact
+    np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(ref.compute()), rtol=1e-6)
+    assert m.forward_stats["launches"] == 6
+
+
+def test_engine_forward_matches_eager_full_state_branch():
+    """full_state_update=True: the engine's in-trace double update must
+    reproduce the eager reference branch bit-for-bit."""
+    rng = np.random.RandomState(1)
+    m = RunningMax(jit_update=True)
+    ref = RunningMax()
+    for _ in range(4):
+        values = jnp.asarray(rng.randn(17).astype(np.float32))
+        got, want = m.forward(values), ref.forward(values)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    _assert_states_equal(m, ref)
+    assert m.forward_stats["launches"] == 4
+
+
+def test_forward_engine_single_launch_per_step():
+    """The acceptance pin: jitted Accuracy.forward (reduce-state branch) is
+    exactly ONE engine launch per step, and no update-path dispatch rides
+    along (one update per batch, not two)."""
+    rng = np.random.RandomState(2)
+    m = Accuracy(num_classes=NUM_CLASSES, average="macro", jit_update=True)
+    m.forward(*_batch(rng, 64))  # compile
+    with profiling.track_forwards() as fwd, profiling.track_dispatches() as disp:
+        for _ in range(10):
+            m.forward(*_batch(rng, 64))
+    assert fwd.launch_count(kind="aot") == 10
+    assert fwd.retrace_count() == 0
+    assert disp.dispatches == 0  # the step IS the launch; no separate update
+    assert m.forward_stats["launches"] == 11
+    assert m.forward_stats["engine_us"] > 0
+
+
+def test_ragged_batches_share_one_bucket_executable():
+    """65..128 all pad to the 128 bucket: one forward compile, zero
+    intra-bucket retraces after it."""
+    rng = np.random.RandomState(3)
+    m = Accuracy(num_classes=NUM_CLASSES, average="macro", jit_update=True)
+    ref = Accuracy(num_classes=NUM_CLASSES, average="macro")
+    with profiling.track_forwards() as t:
+        for b in (65, 100, 127, 128):
+            preds, target = _batch(rng, b)
+            np.testing.assert_allclose(
+                np.asarray(m.forward(preds, target)),
+                np.asarray(ref.forward(preds, target)), rtol=1e-6,
+            )
+    assert t.retrace_count() == 1  # ONE compile for the whole bucket
+    assert t.launch_count(kind="aot") == 4
+    _assert_states_equal(m, ref)
+
+
+# ------------------------------------------------------------------ fallbacks
+def test_dist_sync_on_step_falls_back_to_eager():
+    rng = np.random.RandomState(4)
+    m = Accuracy(num_classes=NUM_CLASSES, dist_sync_on_step=True, jit_update=True)
+    ref = Accuracy(num_classes=NUM_CLASSES, dist_sync_on_step=True)
+    preds, target = _batch(rng, 16)
+    with profiling.track_forwards() as t:
+        got = m.forward(preds, target)
+    assert t.launches == 0  # engine must not trace through a per-step sync
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref.forward(preds, target)), rtol=1e-6)
+
+
+def test_list_state_falls_back_to_eager():
+    m = CatMetric(jit_update=True)
+    with profiling.track_forwards() as t:
+        m.forward(jnp.asarray([1.0, 2.0]))
+        m.forward(jnp.asarray([3.0]))
+    assert t.launches == 0
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_eager_metrics_never_engage_the_engine():
+    rng = np.random.RandomState(5)
+    m = Accuracy(num_classes=NUM_CLASSES)  # jit_update=False
+    with profiling.track_forwards() as t:
+        m.forward(*_batch(rng, 32))
+    assert t.launches == 0 and m.forward_stats["launches"] == 0
+
+
+def test_kill_switch_restores_eager_path(monkeypatch):
+    """METRICS_TPU_FUSED_FORWARD=0 short-circuits the engine; results and
+    states match the always-eager metric bit-for-bit (it IS the same code
+    path, which is the point of the pin)."""
+    monkeypatch.setenv("METRICS_TPU_FUSED_FORWARD", "0")
+    assert not fused_forward_enabled()
+    rng = np.random.RandomState(6)
+    m = Accuracy(num_classes=NUM_CLASSES, average="macro", jit_update=True)
+    ref = Accuracy(num_classes=NUM_CLASSES, average="macro")
+    with profiling.track_forwards() as t:
+        for b in (64, 48):
+            preds, target = _batch(rng, b)
+            got, want = m.forward(preds, target), ref.forward(preds, target)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert t.launches == 0 and m.forward_stats["launches"] == 0
+    _assert_states_equal(m, ref)
+
+
+def test_engine_failure_demotes_permanently():
+    """A metric whose COMPUTE needs host values cannot be traced by the
+    engine (update alone jits fine): forward falls back to the eager path
+    and never retries the engine."""
+
+    class HostCompute(Metric):
+        full_state_update = False
+
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, values):
+            self.total = self.total + jnp.sum(values)
+
+        def compute(self):
+            # host sync: fine eagerly, a ConcretizationError under trace
+            return jnp.asarray(float(self.total))
+
+    m = HostCompute(jit_update=True)
+    values = jnp.asarray([1.0, 2.0, 3.0])
+    out = m.forward(values)
+    assert m._fused_forward_failed
+    np.testing.assert_allclose(np.asarray(out), 6.0)
+    np.testing.assert_allclose(np.asarray(m.forward(values)), 6.0)
+    np.testing.assert_allclose(np.asarray(m.compute()), 12.0)
+    assert m.forward_stats["launches"] == 0
+
+
+# ----------------------------------------------------------------- collection
+def _suite(**kwargs):
+    return MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="macro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "prec": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "rec": Recall(num_classes=NUM_CLASSES, average="macro"),
+        },
+        **kwargs,
+    )
+
+
+def test_fused_collection_forward_is_one_launch_per_step():
+    rng = np.random.RandomState(7)
+    col = _suite(fused_update=True)
+    eager = _suite(fused_update=False)
+    warm = _batch(rng, 64)
+    col(*warm)  # compile
+    eager(*warm)  # same stream: accumulated states must stay comparable
+    with profiling.track_forwards() as t:
+        for b in (64, 64, 48):
+            preds, target = _batch(rng, b)
+            got, want = col(preds, target), eager(preds, target)
+            assert set(got) == set(want)
+            for k in got:
+                np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), rtol=1e-6, err_msg=k)
+    assert t.launch_count(kind="fused-aot") == 3
+    assert col.forward_stats["launches"] == 4
+    c_got, c_want = col.compute(), eager.compute()
+    for k in c_got:
+        np.testing.assert_allclose(np.asarray(c_got[k]), np.asarray(c_want[k]), rtol=1e-6, err_msg=k)
+
+
+def test_collection_kill_switch_uses_legacy_jit(monkeypatch):
+    """With the engine off the collection keeps its pre-engine fused path
+    (one jit, per-call signature hashing) — same values, zero engine
+    launches, dispatches recorded as ``jit``."""
+    monkeypatch.setenv("METRICS_TPU_FUSED_FORWARD", "0")
+    rng = np.random.RandomState(8)
+    col = _suite(fused_update=True)
+    eager = _suite(fused_update=False)
+    preds, target = _batch(rng, 32)
+    with profiling.track_forwards() as fwd, profiling.track_dispatches() as disp:
+        got, want = col(preds, target), eager(preds, target)
+    assert fwd.launches == 0
+    assert disp.dispatch_count(kind="jit") == 1
+    for k in got:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), rtol=1e-6, err_msg=k)
+
+
+def test_engine_metric_survives_pickle_clone_reset():
+    rng = np.random.RandomState(9)
+    m = Accuracy(num_classes=NUM_CLASSES, average="macro", jit_update=True)
+    preds, target = _batch(rng, 32)
+    m.forward(preds, target)
+
+    m2 = pickle.loads(pickle.dumps(m))
+    assert m2._dispatcher is None  # executables don't pickle; rebuilt lazily
+    ref = Accuracy(num_classes=NUM_CLASSES, average="macro")
+    ref._load_state(m._copy_state())
+    ref._update_count = m._update_count
+    np.testing.assert_allclose(
+        np.asarray(m2.forward(preds, target)), np.asarray(m.forward(preds, target)), rtol=1e-6
+    )
+
+    m3 = copy.deepcopy(m)
+    m3.reset()
+    assert np.asarray(m3.forward(preds, target)).shape == ()
+    assert m3.forward_stats["launches"] >= 1
