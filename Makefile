@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity doctest bench bench-forward tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity doctest bench bench-forward trace tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -60,6 +60,12 @@ bench:
 # latency, without the rest of the detail suite
 bench-forward:
 	python -c "import json, bench; d = {}; bench._cfg_forward_engine(d); print(json.dumps(d, indent=2))"
+
+# short instrumented eval with telemetry export, then the human-readable
+# replay: launches, retraces by cause, collectives/bytes, p50/p95 span µs.
+# Leaves /tmp/metrics_tpu_trace.trace.json for Perfetto (ui.perfetto.dev).
+trace:
+	python tools/trace_report.py --bench /tmp/metrics_tpu_trace.jsonl
 
 clean:
 	rm -rf .pytest_cache

@@ -409,6 +409,66 @@ def _cfg_forward_engine(detail: dict) -> None:
         lambda: col(p, tg), lambda: col["acc"].tp)
 
 
+def _cfg_telemetry_overhead(detail: dict) -> None:
+    """Enabled-but-idle telemetry overhead on the fused forward path.
+
+    The telemetry engine (:mod:`metrics_tpu.telemetry`) bumps process-level
+    counters on every hot-path event even with no subscriber attached; the
+    claim it must keep is "costs nothing measurable when idle". This config
+    times the same warm single-metric fused forward step as the round-8
+    ``forward_us_single_metric`` methodology under three states — engine
+    killed (``METRICS_TPU_TELEMETRY=0``), enabled-but-idle (the default
+    every user runs), and with a live ``instrument()`` subscriber — and
+    pins the idle/off ratio as the structural key. The process's
+    retrace-cause counters are mirrored alongside (BASELINE round-9 records
+    WHY compiles happen, not just how many)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, telemetry
+
+    rng = np.random.RandomState(23)
+    C = 32
+    logits = rng.rand(256, C).astype(np.float32)
+    p = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    tg = jnp.asarray(rng.randint(0, C, 256))
+
+    m = Accuracy(num_classes=C, average="macro", jit_update=True)
+    m.forward(p, tg)  # compile
+    jax.block_until_ready(m.tp)
+
+    def timed(step):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(50):
+                step()
+            jax.block_until_ready(m.tp)
+            best = min(best, (time.perf_counter() - t0) / 50 * 1e6)
+        return round(best, 1)
+
+    prev = os.environ.get("METRICS_TPU_TELEMETRY")
+    os.environ["METRICS_TPU_TELEMETRY"] = "0"
+    try:
+        detail["telemetry_off_forward_us"] = timed(lambda: m.forward(p, tg))
+    finally:
+        if prev is None:
+            os.environ.pop("METRICS_TPU_TELEMETRY", None)
+        else:
+            os.environ["METRICS_TPU_TELEMETRY"] = prev
+
+    detail["telemetry_idle_forward_us"] = timed(lambda: m.forward(p, tg))
+    with telemetry.instrument():
+        detail["telemetry_instrumented_forward_us"] = timed(lambda: m.forward(p, tg))
+
+    detail["telemetry_idle_overhead_ratio"] = round(
+        detail["telemetry_idle_forward_us"] / max(detail["telemetry_off_forward_us"], 1e-9), 3
+    )
+    for key, count in sorted(telemetry.snapshot().items()):
+        if key.startswith("compile:cause:"):
+            detail[f"telemetry_retrace_cause_{key.rsplit(':', 1)[1]}"] = int(count)
+
+
 def _machinery_device(detail: dict):
     """Host CPU device for the compute-group machinery configs.
 
@@ -1007,6 +1067,7 @@ def _bench_detail() -> dict:
         ("collection_dist_sync_8dev_us", _cfg_dist_sync),
         ("sync_collectives_fused_collection", _cfg_sync_engine),
         ("forward_launches_single_metric_10_steps", _cfg_forward_engine),
+        ("telemetry_idle_overhead_ratio", _cfg_telemetry_overhead),
     ]
     detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
     return detail
@@ -1223,6 +1284,7 @@ def _bench_detail_fast() -> dict:
         ("dispatch_engine", _cfg_dispatch_engine),
         ("sync_engine", _cfg_sync_engine),
         ("forward_engine", _cfg_forward_engine),
+        ("telemetry_overhead", _cfg_telemetry_overhead),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),

@@ -11,9 +11,15 @@ gaps when running on an older jax (accelerator images pin 0.4.x):
   off the named axis frame.
 * ``enable_x64`` — the ``jax.enable_x64`` context manager is jax>=0.5; older
   jax ships it as ``jax.experimental.enable_x64``.
+* ``profiler_annotation`` — ``jax.profiler.TraceAnnotation`` when this jax
+  build has one (it names host-side regions in ``jax.profiler.trace`` /
+  TensorBoard captures), a no-op context otherwise. Engine launches wrap
+  themselves in it (dispatch.py) so device traces line up with the
+  telemetry span stream.
 """
+import contextlib
 
-__all__ = ["shard_map", "axis_size", "enable_x64"]
+__all__ = ["shard_map", "axis_size", "enable_x64", "profiler_annotation"]
 
 try:
     from jax import shard_map as _new_shard_map  # jax>=0.5
@@ -49,3 +55,17 @@ try:
     from jax import enable_x64  # jax>=0.5
 except ImportError:
     from jax.experimental import enable_x64
+
+
+try:
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except ImportError:  # very old jax: no TraceAnnotation at all
+    _TraceAnnotation = None
+
+
+def profiler_annotation(name: str):
+    """Context manager naming a host-side region in jax profiler traces;
+    a no-op context on builds without ``jax.profiler.TraceAnnotation``."""
+    if _TraceAnnotation is None:
+        return contextlib.nullcontext()
+    return _TraceAnnotation(name)

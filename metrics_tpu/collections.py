@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import sync_engine
+from metrics_tpu import sync_engine, telemetry
 from metrics_tpu.metric import Metric, _donation_argnums, _raise_if_list_state, _scan_fold
 from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, default_env
 from metrics_tpu.utilities.data import _flatten_dict, _squeeze_if_scalar
@@ -98,14 +98,14 @@ class MetricCollection:
         self._fused_forward_fn = None
         self._dispatcher = None  # AOT fast-dispatch engine for fused updates
         self._dispatch_stats: Dict[str, int] = {"dispatches": 0, "retraces": 0}
-        # step-path counters for the fused forward engine (profiling.py)
+        # step-path counters for the fused forward engine (telemetry.py)
         self._forward_stats: Dict[str, Any] = {"launches": 0, "retraces": 0, "engine_us": 0.0}
         # per-(member, kwarg-names) memo of _filter_kwargs results: the
         # accepted key set depends only on the update signature and the
         # kwarg NAMES, so the eager loops need not re-bind signatures
         # every batch
         self._filter_kwargs_cache: Dict[Tuple[str, Tuple[str, ...]], Tuple[str, ...]] = {}
-        # comms counters for the fused collection-level sync (profiling.py)
+        # comms counters for the fused collection-level sync (telemetry.py)
         self._sync_stats: Dict[str, int] = {"collectives": 0, "buckets": 0, "bytes_on_wire": 0}
         # (member, saved _to_sync, saved _should_unsync) while a collection
         # sync is active; None when not synced
@@ -404,14 +404,22 @@ class MetricCollection:
                     self._fused_forward_fn = jax.jit(self._fused_forward_impl, donate_argnums=_donation_argnums())
                 fn = self._fused_forward_fn
                 size_before = fn._cache_size() if hasattr(fn, "_cache_size") else None
+                t0 = telemetry.clock()
                 new_states, batch_vals = fn(self.state(), counts, *args, **kwargs)
-                from metrics_tpu import profiling
-
                 if size_before is not None and fn._cache_size() > size_before:
                     self._dispatch_stats["retraces"] += 1
-                    profiling.record_retrace("MetricCollection", "jit")
+                    telemetry.emit(
+                        "compile",
+                        "MetricCollection",
+                        "jit",
+                        stream="dispatch",
+                        cause="first-compile" if size_before == 0 else "new-input-signature",
+                    )
                 self._dispatch_stats["dispatches"] += 1
-                profiling.record_dispatch("MetricCollection", "jit")
+                # the legacy fused step historically counts as an update-path
+                # dispatch (one jit launch), so the event rides the dispatch
+                # stream — but it IS a forward, and the span name says so
+                telemetry.emit("forward", "MetricCollection", "jit", t0=t0, stream="dispatch")
         except Exception as err:
             self._fuse_fallback("forward", err)
             return None
@@ -558,10 +566,23 @@ class MetricCollection:
     def sync_stats(self) -> Dict[str, int]:
         """Comms counters for the collection-level fused sync: collectives
         issued on behalf of the whole collection, fused buckets among them,
-        and payload bytes (see :mod:`metrics_tpu.profiling`). Collectives a
+        and payload bytes (see :mod:`metrics_tpu.telemetry`). Collectives a
         member issues for its own non-bucketed leaves land in that member's
         ``Metric.sync_stats`` instead."""
         return dict(self._sync_stats)
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        """Collection-level merged observability report: the fused-path
+        ``dispatch``/``sync``/``forward`` counters this collection owns,
+        plus each member's own :meth:`Metric.telemetry_snapshot` under
+        ``"members"`` (see ``docs/observability.md``)."""
+        return {
+            "owner": "MetricCollection",
+            "dispatch": dict(self._dispatch_stats),
+            "sync": dict(self._sync_stats),
+            "forward": dict(self._forward_stats),
+            "members": {name: m.telemetry_snapshot() for name, m in self.items(keep_base=True)},
+        }
 
     @staticmethod
     def _sync_fusable(m: Metric, env: DistEnv) -> bool:
@@ -612,67 +633,68 @@ class MetricCollection:
         if not should_sync or not env.is_distributed() or not sync_engine.fused_sync_enabled():
             return
 
-        self._compute_groups_create_state_ref()
-        use_groups = bool(self._enable_compute_groups and self._groups_checked)
-        if use_groups:
-            leaders = [self._modules[cg[0]] for cg in self._groups.values()]
-        else:
-            leaders = [m for _, m in self.items(keep_base=True)]
-        fused_members = [m for m in leaders if self._sync_fusable(m, env)]
+        with telemetry.span("sync", "MetricCollection", "collection"):
+            self._compute_groups_create_state_ref()
+            use_groups = bool(self._enable_compute_groups and self._groups_checked)
+            if use_groups:
+                leaders = [self._modules[cg[0]] for cg in self._groups.values()]
+            else:
+                leaders = [m for _, m in self.items(keep_base=True)]
+            fused_members = [m for m in leaders if self._sync_fusable(m, env)]
 
-        synced: List[Metric] = []
-        try:
-            for m in fused_members:
-                m._cache = m._copy_state()
-            # one shared bucket pass across every fusable leader
-            specs: List[Any] = []
-            handled: Dict[int, set] = {}
-            for i, m in enumerate(fused_members):
-                member_specs = sync_engine.plan_metric_leaves(
-                    m, {a: getattr(m, a) for a in m._reductions}, tag=i
+            synced: List[Metric] = []
+            try:
+                for m in fused_members:
+                    m._cache = m._copy_state()
+                # one shared bucket pass across every fusable leader
+                specs: List[Any] = []
+                handled: Dict[int, set] = {}
+                for i, m in enumerate(fused_members):
+                    member_specs = sync_engine.plan_metric_leaves(
+                        m, {a: getattr(m, a) for a in m._reductions}, tag=i
+                    )
+                    specs.extend(member_specs)
+                    handled[i] = {spec.key[1] for spec in member_specs}
+                results = sync_engine.execute_buckets(
+                    env, specs, owner="MetricCollection", stats=self._sync_stats
                 )
-                specs.extend(member_specs)
-                handled[i] = {spec.key[1] for spec in member_specs}
-            results = sync_engine.execute_buckets(
-                env, specs, owner="MetricCollection", stats=self._sync_stats
-            )
-            for (i, attr), val in results.items():
-                object.__setattr__(fused_members[i], attr, val)
-            # remaining leaves (list/ragged/custom-reduced) per leader
-            for i, m in enumerate(fused_members):
-                m._sync_dist(None, env=env, exclude=tuple(handled[i]))
-                m._is_synced = True
-                synced.append(m)
-        except Exception:
-            for m in fused_members:
-                if m not in synced and m._cache is not None:
-                    m._load_state(m._cache)
-                    m._cache = None
-            for m in synced:
-                m.unsync()
-            raise
+                for (i, attr), val in results.items():
+                    object.__setattr__(fused_members[i], attr, val)
+                # remaining leaves (list/ragged/custom-reduced) per leader
+                for i, m in enumerate(fused_members):
+                    m._sync_dist(None, env=env, exclude=tuple(handled[i]))
+                    m._is_synced = True
+                    synced.append(m)
+            except Exception:
+                for m in fused_members:
+                    if m not in synced and m._cache is not None:
+                        m._load_state(m._cache)
+                        m._cache = None
+                for m in synced:
+                    m.unsync()
+                raise
 
-        # followers adopt their leader's synced state — zero collectives;
-        # their unsync cache is the leader's pre-sync state, which is what
-        # the legacy flow (state ref copy, then self-sync) restored too
-        if use_groups:
-            for cg in self._groups.values():
-                m0 = self._modules[cg[0]]
-                if m0 not in fused_members:
-                    continue
-                for name in cg[1:]:
-                    mi = self._modules[name]
-                    if mi._is_synced or mi._computed is not None:
+            # followers adopt their leader's synced state — zero collectives;
+            # their unsync cache is the leader's pre-sync state, which is what
+            # the legacy flow (state ref copy, then self-sync) restored too
+            if use_groups:
+                for cg in self._groups.values():
+                    m0 = self._modules[cg[0]]
+                    if m0 not in fused_members:
                         continue
-                    mi._cache = {
-                        k: (list(v) if isinstance(v, list) else v) for k, v in m0._cache.items()
-                    }
-                    for state in m0._defaults:
-                        value = getattr(m0, state)
-                        object.__setattr__(mi, state, list(value) if isinstance(value, list) else value)
-                    mi._update_count = m0._update_count
-                    mi._is_synced = True
-                    synced.append(mi)
+                    for name in cg[1:]:
+                        mi = self._modules[name]
+                        if mi._is_synced or mi._computed is not None:
+                            continue
+                        mi._cache = {
+                            k: (list(v) if isinstance(v, list) else v) for k, v in m0._cache.items()
+                        }
+                        for state in m0._defaults:
+                            value = getattr(m0, state)
+                            object.__setattr__(mi, state, list(value) if isinstance(value, list) else value)
+                        mi._update_count = m0._update_count
+                        mi._is_synced = True
+                        synced.append(mi)
 
         self._synced_members = []
         for m in synced:

@@ -30,11 +30,20 @@ forward programs (see :mod:`metrics_tpu.forward_engine`), which advance the
 state AND produce the step's batch value in the same single launch. Both
 program families share the bucketing, masked-padding, donation, and
 ownership machinery; they differ only in their cache-key prefix and which
-profiling stream records them.
+telemetry stream records them.
 
-Every executable launch and every compile is recorded with
-:mod:`metrics_tpu.profiling`, which is what lets tests assert "one dispatch
-per fused update" and "zero retraces within a bucket" structurally.
+Every executable launch and every compile is emitted on the
+:mod:`metrics_tpu.telemetry` stream (which the legacy
+``profiling.track_*`` trackers subscribe to), which is what lets tests
+assert "one dispatch per fused update" and "zero retraces within a
+bucket" structurally. Compiles additionally carry a ``cause`` attr — the
+engine keeps, per program family, the static keys / input shapes / input
+dtypes it has already compiled, and names the first unseen component of a
+cache miss (``first-compile`` / ``new-static-key`` / ``new-shape-bucket``
+/ ``new-dtype``, else ``new-signature``) so a retrace storm is a one-line
+diagnosis instead of a mystery. Launches are also wrapped in
+``jax.profiler`` trace annotations (via ``_compat``) so device-level
+profiler captures line up with the telemetry spans.
 
 ``METRICS_TPU_FAST_DISPATCH=0`` disables the engine process-wide (updates
 fall back to the legacy ``jax.jit`` path); ``MIN_BUCKET`` is the smallest
@@ -48,7 +57,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import profiling
+from metrics_tpu import telemetry
+from metrics_tpu._compat import profiler_annotation
 from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
 
 Array = jax.Array
@@ -139,6 +149,13 @@ class FastDispatcher:
         self._owned: Tuple[int, ...] = ()
         self._nvalid_cache: Dict[int, Array] = {}
         self._kind = "fused-aot" if label.startswith("MetricCollection") else "aot"
+        # retrace-cause attribution: per program family, the static keys /
+        # input shapes / input dtypes already compiled — the first unseen
+        # component of a cache miss is WHY it recompiled
+        self._seen: Dict[str, Dict[str, set]] = {
+            "update": {"static": set(), "shapes": set(), "dtypes": set()},
+            "forward": {"static": set(), "shapes": set(), "dtypes": set()},
+        }
 
     # ------------------------------------------------------------------ call
     def _prepare_call(self, args: Tuple, dyn_kwargs: Dict, masked_factory) -> Tuple:
@@ -185,16 +202,27 @@ class FastDispatcher:
         )
         compiled = self._cache.get(key)
         if compiled is None:
-            compiled = self._compile(key, masked, static, treedef, leaves, call_inputs)
+            compiled = self._compile(key, masked, static, treedef, leaves, call_inputs, static_key)
 
         leaves = self._prepare_donation(leaves)
-        if masked:
-            out = compiled(self._n_valid(batch), leaves, *call_inputs)
-        else:
-            out = compiled(leaves, *call_inputs)
-        out = tuple(out)
+        t0 = telemetry.clock()
+        with profiler_annotation(f"metrics_tpu.{self.label}.update[{self._kind}]"):
+            if masked:
+                out = compiled(self._n_valid(batch), leaves, *call_inputs)
+            else:
+                out = compiled(leaves, *call_inputs)
+            out = tuple(out)
 
-        profiling.record_dispatch(self.label, self._kind)
+        telemetry.emit(
+            "update",
+            self.label,
+            self._kind,
+            t0=t0,
+            stream="dispatch",
+            masked=masked,
+            bucket=bucket_pow2(batch, minimum=MIN_BUCKET) if masked else None,
+            static_key=static_key or None,
+        )
         self.stats["dispatches"] += 1
 
         self._write_leaves(out)
@@ -226,18 +254,29 @@ class FastDispatcher:
         )
         compiled = self._cache.get(key)
         if compiled is None:
-            compiled = self._compile_forward(key, masked, static, treedef, leaves, call_inputs, counts)
+            compiled = self._compile_forward(key, masked, static, treedef, leaves, call_inputs, counts, static_key)
 
         leaves = self._prepare_donation(leaves)
         t0 = time.perf_counter()
-        if masked:
-            out_leaves, batch_val = compiled(counts, self._n_valid(batch), leaves, *call_inputs)
-        else:
-            out_leaves, batch_val = compiled(counts, leaves, *call_inputs)
-        out_leaves = tuple(out_leaves)
+        with profiler_annotation(f"metrics_tpu.{self.label}.forward[{self._kind}]"):
+            if masked:
+                out_leaves, batch_val = compiled(counts, self._n_valid(batch), leaves, *call_inputs)
+            else:
+                out_leaves, batch_val = compiled(counts, leaves, *call_inputs)
+            out_leaves = tuple(out_leaves)
         elapsed_us = (time.perf_counter() - t0) * 1e6
 
-        profiling.record_forward(self.label, self._kind, elapsed_us)
+        telemetry.emit(
+            "forward",
+            self.label,
+            self._kind,
+            t0=t0,
+            dur_us=elapsed_us,
+            stream="forward",
+            masked=masked,
+            bucket=bucket_pow2(batch, minimum=MIN_BUCKET) if masked else None,
+            static_key=static_key or None,
+        )
         self.forward_stats["launches"] += 1
         self.forward_stats["engine_us"] += elapsed_us
 
@@ -277,7 +316,32 @@ class FastDispatcher:
         # once so donation can never delete an array another owner holds
         return tuple(jnp.array(x) for x in leaves)
 
-    def _compile(self, key, masked, static, treedef, example_leaves, example_inputs):
+    def _retrace_cause(self, family: str, static_key: Tuple, call_inputs) -> str:
+        """Name WHY this cache miss compiles: the first component of the key
+        (static flags, then input shapes, then input dtypes) this family has
+        never compiled before. ``new-signature`` covers the remainder — a
+        state-layout, treedef, or weak-type change with familiar inputs."""
+        shapes = tuple(getattr(x, "shape", ()) for x in call_inputs)
+        dtypes = tuple(str(getattr(x, "dtype", "?")) for x in call_inputs)
+        seen = self._seen[family]
+        if not seen["static"] and not seen["shapes"]:
+            cause = "first-compile"
+        elif static_key not in seen["static"]:
+            cause = "new-static-key"
+        elif shapes not in seen["shapes"]:
+            cause = "new-shape-bucket"
+        elif dtypes not in seen["dtypes"]:
+            cause = "new-dtype"
+        else:
+            cause = "new-signature"
+        seen["static"].add(static_key)
+        seen["shapes"].add(shapes)
+        seen["dtypes"].add(dtypes)
+        return cause
+
+    def _compile(self, key, masked, static, treedef, example_leaves, example_inputs, static_key=()):
+        cause = self._retrace_cause("update", static_key, example_inputs)
+        t0 = time.perf_counter()
         if masked:
             inner = self._make_masked_update(dict(static))
 
@@ -299,14 +363,25 @@ class FastDispatcher:
             jitted = jax.jit(fn, donate_argnums=(0,) if _donation_enabled() else ())
             compiled = jitted.lower(tuple(example_leaves), *example_inputs).compile()
 
-        profiling.record_retrace(self.label, self._kind)
+        telemetry.emit(
+            "compile",
+            self.label,
+            self._kind,
+            t0=t0,
+            stream="dispatch",
+            cause=cause,
+            masked=masked,
+            static_key=static_key or None,
+        )
         self.stats["retraces"] += 1
         self._cache[key] = compiled
         return compiled
 
-    def _compile_forward(self, key, masked, static, treedef, example_leaves, example_inputs, example_counts):
+    def _compile_forward(self, key, masked, static, treedef, example_leaves, example_inputs, example_counts, static_key=()):
         """Lower + compile one multi-output forward program
         ``(counts, [n_valid,] leaves, batch) -> (leaves, batch_value)``."""
+        cause = self._retrace_cause("forward", static_key, example_inputs)
+        t0 = time.perf_counter()
         if masked:
             inner = self._make_masked_forward(dict(static))
 
@@ -330,7 +405,16 @@ class FastDispatcher:
             jitted = jax.jit(fn, donate_argnums=(1,) if _donation_enabled() else ())
             compiled = jitted.lower(example_counts, tuple(example_leaves), *example_inputs).compile()
 
-        profiling.record_forward_retrace(self.label, self._kind)
+        telemetry.emit(
+            "compile",
+            self.label,
+            self._kind,
+            t0=t0,
+            stream="forward",
+            cause=cause,
+            masked=masked,
+            static_key=static_key or None,
+        )
         self.forward_stats["retraces"] += 1
         self._cache[key] = compiled
         return compiled

@@ -37,9 +37,10 @@ owner's masked-update support. The engine only engages where it is exact:
 ``METRICS_TPU_FUSED_FORWARD=0`` disables the engine process-wide:
 ``Metric.forward`` falls back to the eager reference-parity branches and
 ``MetricCollection`` forward to its legacy single-jit fused program.
-Every launch/compile is recorded with :mod:`metrics_tpu.profiling`
-(``track_forwards`` / per-owner ``forward_stats``), which is what lets
-tests pin "one launch per step" structurally.
+Every launch/compile is emitted as a timed ``forward``/``compile`` span on
+the :mod:`metrics_tpu.telemetry` stream (the legacy
+``profiling.track_forwards`` tracker and per-owner ``forward_stats`` ride
+it), which is what lets tests pin "one launch per step" structurally.
 """
 import os
 from typing import Any, Callable, Dict, Tuple
@@ -47,6 +48,7 @@ from typing import Any, Callable, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu._compat import profiler_annotation
 from metrics_tpu.utilities.data import _squeeze_if_scalar
 
 
@@ -166,7 +168,9 @@ def metric_forward(metric: Any, args: Tuple, kwargs: Dict) -> Any:
     # the merge count rides as a traced scalar so step N+1 reuses step N's
     # executable (mean merges divide by it; everything else ignores it)
     count = jnp.asarray(metric._update_count + 1, dtype=jnp.float32)
-    with jax.named_scope(f"metrics_tpu.{type(metric).__name__}.forward"):
+    with jax.named_scope(f"metrics_tpu.{type(metric).__name__}.forward"), profiler_annotation(
+        f"metrics_tpu.{type(metric).__name__}.forward_step"
+    ):
         batch_val = metric._dispatcher.forward(count, static, key, args, dynamic)
 
     metric._update_count += 1

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import forward_engine, profiling, sync_engine
+from metrics_tpu import forward_engine, sync_engine, telemetry
 from metrics_tpu.dispatch import fast_dispatch_enabled
 from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, default_env
 from metrics_tpu.utilities.data import (
@@ -222,7 +222,7 @@ class Metric(ABC):
         # cache, permanently demoted to the eager forward path on error
         self._fused_forward_failed = False
         self._forward_stats: Dict[str, Any] = {"launches": 0, "retraces": 0, "engine_us": 0.0}
-        # comms counters for the sync path (see metrics_tpu.profiling):
+        # comms counters for the sync path (see metrics_tpu.telemetry):
         # every collective this metric issues, fused buckets, and wire bytes
         self._sync_stats: Dict[str, int] = {"collectives": 0, "buckets": 0, "bytes_on_wire": 0}
 
@@ -616,17 +616,32 @@ class Metric(ABC):
                                 donate_argnums=_donation_argnums(),
                             )
                         size_before = fn._cache_size() if hasattr(fn, "_cache_size") else None
+                        t0 = telemetry.clock()
                         new_state = fn(self.state(), *args, **dynamic)
                         self._load_state(new_state)
                         if size_before is not None and fn._cache_size() > size_before:
                             self._dispatch_stats["retraces"] += 1
-                            profiling.record_retrace(type(self).__name__, "jit")
+                            telemetry.emit(
+                                "compile",
+                                type(self).__name__,
+                                "jit",
+                                stream="dispatch",
+                                # the jit cache key is opaque here; all the
+                                # path can attest is whether this signature
+                                # family ever compiled before
+                                cause="first-compile" if size_before == 0 else "new-input-signature",
+                                static_key=key or None,
+                            )
                         self._dispatch_stats["dispatches"] += 1
-                        profiling.record_dispatch(type(self).__name__, "jit")
+                        telemetry.emit(
+                            "update", type(self).__name__, "jit", t0=t0,
+                            stream="dispatch", static_key=key or None,
+                        )
                 else:
+                    t0 = telemetry.clock()
                     update(*args, **kwargs)
                     self._dispatch_stats["dispatches"] += 1
-                    profiling.record_dispatch(type(self).__name__, "eager")
+                    telemetry.emit("update", type(self).__name__, "eager", t0=t0, stream="dispatch")
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -684,22 +699,34 @@ class Metric(ABC):
     @property
     def dispatch_stats(self) -> Dict[str, int]:
         """Hot-path counters for this metric: device-program ``dispatches``
-        and compile-time ``retraces`` (see :mod:`metrics_tpu.profiling`)."""
+        and compile-time ``retraces`` (see :mod:`metrics_tpu.telemetry`)."""
         return dict(self._dispatch_stats)
 
     @property
     def forward_stats(self) -> Dict[str, Any]:
         """Step-path counters for this metric: fused-forward engine
         ``launches``, forward-program ``retraces``, and cumulative
-        host-side ``engine_us`` (see :mod:`metrics_tpu.profiling`)."""
+        host-side ``engine_us`` (see :mod:`metrics_tpu.telemetry`)."""
         return dict(self._forward_stats)
 
     @property
     def sync_stats(self) -> Dict[str, int]:
         """Comms counters for this metric's sync path: cross-participant
         ``collectives`` issued, fused ``buckets`` among them, and payload
-        ``bytes_on_wire`` (see :mod:`metrics_tpu.profiling`)."""
+        ``bytes_on_wire`` (see :mod:`metrics_tpu.telemetry`)."""
         return dict(self._sync_stats)
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        """The three per-owner stats dicts merged into one report:
+        ``{"owner", "dispatch", "sync", "forward"}`` (update-path launches/
+        retraces, sync collectives/buckets/wire bytes, fused-forward
+        launches/retraces/µs — see ``docs/observability.md``)."""
+        return {
+            "owner": type(self).__name__,
+            "dispatch": dict(self._dispatch_stats),
+            "sync": dict(self._sync_stats),
+            "forward": dict(self._forward_stats),
+        }
 
     def _move_list_states_to_cpu(self) -> None:
         """Move accumulated list states to host CPU (ref metric.py:282-287)."""
@@ -731,13 +758,16 @@ class Metric(ABC):
 
         def _record(kind: str, x: Any) -> None:
             # comms observability: every collective this sync issues is
-            # counted with its payload bytes (see metrics_tpu.profiling)
+            # counted with its payload bytes (see metrics_tpu.telemetry)
             if not will_communicate:
                 return
             nbytes = int(np.prod(jnp.shape(x))) * jnp.dtype(x.dtype).itemsize
             self._sync_stats["collectives"] += 1
             self._sync_stats["bytes_on_wire"] += nbytes
-            profiling.record_collective(type(self).__name__, kind, nbytes)
+            telemetry.emit(
+                "collective", type(self).__name__, kind,
+                nbytes=nbytes, dtype=jnp.dtype(x.dtype).name,
+            )
 
         if dist_sync_fn is not None:
             # documented custom-gather contract: (state_tensor, env) -> List[Array]
@@ -1048,7 +1078,8 @@ class Metric(ABC):
 
         # cache prior to syncing
         self._cache = self._copy_state()
-        self._sync_dist(dist_sync_fn, env=env)
+        with telemetry.span("sync", type(self).__name__, "metric"):
+            self._sync_dist(dist_sync_fn, env=env)
         self._is_synced = True
 
     def unsync(self, should_unsync: bool = True) -> None:
@@ -1101,7 +1132,9 @@ class Metric(ABC):
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
-            ), jax.named_scope(f"metrics_tpu.{type(self).__name__}.compute"):
+            ), jax.named_scope(f"metrics_tpu.{type(self).__name__}.compute"), telemetry.span(
+                "compute", type(self).__name__, "metric"
+            ):
                 value = compute(*args, **kwargs)
                 self._computed = _squeeze_if_scalar(value)
             return self._computed
@@ -1120,6 +1153,7 @@ class Metric(ABC):
     # ---------------------------------------------------------------- reset
     def reset(self) -> None:
         """Restore all states to their defaults (ref metric.py:420-435)."""
+        telemetry.emit("reset", type(self).__name__, "metric")
         self._update_count = 0
         self._forward_cache = None
         self._computed = None

@@ -1,88 +1,132 @@
-"""Dispatch-count and retrace-count observability for the metric hot path.
+"""Dispatch/sync/forward trackers — compatibility shims over telemetry.
 
-The round-5 benchmark prose argued the fused/AOT paths are "RTT-bound, not
-compute-bound" — this module turns that claim into structure. Every device
-program the library launches on the update hot path is *counted* at the
-call site:
+The three tracker contexts below predate :mod:`metrics_tpu.telemetry`;
+they are kept API-identical (zero break: every existing assertion on
+``dispatches``/``retraces``/``collectives``/``bytes_on_wire``/``launches``
+/``events`` holds unchanged) but are now thin subscribers of the ONE
+span stream. Each hot-path call site emits a single
+:class:`~metrics_tpu.telemetry.TelemetryEvent`; a module-level adapter —
+attached to the stream only while at least one tracker context is open —
+routes each event to the tracker family it historically belonged to:
 
-* ``aot``       — a cached ahead-of-time compiled executable call (the
-  fast-dispatch engine, :mod:`metrics_tpu.dispatch`). Exactly one device
-  program per record.
-* ``fused-aot`` — the same, for a whole ``MetricCollection`` (N metrics,
-  one launch).
-* ``jit``       — a ``jax.jit`` dispatch on the legacy ``jit_update`` path.
-* ``eager``     — one eager ``update()`` call. This is a *metric-level*
-  count: an eager update issues one-or-more op-by-op device dispatches that
-  XLA never fuses, so each record stands for "at least one" program.
+* ``update`` events (and ``forward`` events tagged ``stream="dispatch"``,
+  the legacy collection jit step) → :class:`DispatchTracker` dispatches;
+  ``compile`` events tagged ``stream="dispatch"`` → its retraces.
+* ``forward`` events → :class:`ForwardTracker` launches (with the span's
+  µs); ``compile`` events tagged ``stream="forward"`` → its retraces.
+* ``collective`` events → :class:`SyncTracker`, with the ``nbytes`` attr.
 
-Retrace records count compilations: the engine records one per
-``lower().compile()`` and the legacy jit path one per trace-cache growth.
+Phase spans telemetry adds beyond the legacy streams (``compute``,
+``sync``, ``reset``) are deliberately NOT routed anywhere — the legacy
+counters keep their historical meaning exactly.
 
-Usage::
+Event kinds, and what one record stands for, are unchanged:
+
+* dispatch ``aot``/``fused-aot``/``jit``/``eager`` — one update-path
+  device program (``eager`` is metric-level: "at least one").
+* sync ``fused``/``gather``/``reduce`` — one interconnect launch with its
+  payload bytes.
+* forward ``aot``/``fused-aot`` — one single-launch fused step with its
+  host-side dispatch µs.
+
+Forward launches are deliberately NOT mirrored into the dispatch
+trackers: ``track_dispatches`` counts the *update* path,
+``track_forwards`` the *step* path, so a test can pin "10 forwards = 10
+launches, 0 update dispatches" without cross-contamination.
+
+Usage (all three nest; each open context sees every event)::
 
     with track_dispatches() as tracker:
         collection.update(preds, target)
     assert tracker.dispatches == 1          # one fused launch for N metrics
     assert tracker.retraces == 1            # compiled once, cached after
 
-Per-metric counters live on the objects themselves (``Metric.dispatch_stats``
-/ ``MetricCollection.dispatch_stats``); this module only aggregates across
-whatever ran inside the context. Trackers nest — each active context sees
-every event recorded while it is open. Counting is host-side bookkeeping
-(no JAX hooks, no device work), so leaving it always-on costs a few dict
-increments per update.
-
-The same structure exists for the sync path (:mod:`metrics_tpu.sync_engine`):
-every cross-participant collective the library issues at ``sync()`` time is
-recorded with its wire-byte size:
-
-* ``fused``  — one bucketed collective covering MANY state leaves (the fused
-  sync engine). Each record is one bucket: one launch on the interconnect.
-* ``gather`` — one per-leaf all-gather (list/ragged states, custom
-  ``dist_sync_fn``, or the ``METRICS_TPU_FUSED_SYNC=0`` legacy path).
-* ``reduce`` — one per-leaf native all-reduce (legacy fused-collective path).
-
-Usage::
-
     with track_syncs() as tracker:
         collection.compute()                  # syncs once, fused
     assert tracker.collectives == tracker.buckets   # one launch per bucket
-    assert tracker.bytes_on_wire < naive_bytes
-
-Per-owner counters live on the objects (``Metric.sync_stats`` /
-``MetricCollection.sync_stats``).
-
-And for the step path (:mod:`metrics_tpu.forward_engine`): every
-single-launch fused ``forward`` — the program that advances the state AND
-produces the batch value in one executable call — is recorded with its
-host-side dispatch time:
-
-* ``aot``       — one metric's fused forward launch.
-* ``fused-aot`` — one launch covering a whole ``MetricCollection``'s step.
-
-Forward launches are deliberately NOT mirrored into the dispatch trackers:
-``track_dispatches`` counts the *update* path, ``track_forwards`` the
-*step* path, so a test can pin "10 forwards = 10 launches, 0 update
-dispatches" without cross-contamination.
-
-Usage::
 
     with track_forwards() as tracker:
         metric(preds, target)                 # forward: ONE launch
     assert tracker.launches == 1
-    assert tracker.retraces == 0              # steady state: cached
 
-Per-owner counters live on the objects (``Metric.forward_stats`` /
-``MetricCollection.forward_stats``).
+Per-owner counters live on the objects themselves
+(``Metric.dispatch_stats`` / ``sync_stats`` / ``forward_stats``, merged by
+``Metric.telemetry_snapshot()``); this module only aggregates across
+whatever ran inside a context. Counting is host-side bookkeeping (no JAX
+hooks, no device work). Because the trackers ride the telemetry stream,
+``METRICS_TPU_TELEMETRY=0`` silences them too (the per-owner stats dicts
+stay live — they are bumped at the call sites).
+
+The ``record_*`` functions remain as public entry points for out-of-tree
+callers; they forward onto the telemetry stream, which is also where the
+in-tree call sites now emit directly (with richer attrs: shape bucket,
+static key, retrace cause).
 """
 import threading
 from contextlib import contextmanager
-from typing import Dict, Generator, List, Tuple
+from typing import Dict, Generator, List, Optional, Tuple
+
+from metrics_tpu import telemetry
 
 _lock = threading.Lock()
 _active_trackers: List["DispatchTracker"] = []
 _active_sync_trackers: List["SyncTracker"] = []
 _active_forward_trackers: List["ForwardTracker"] = []
+# how many tracker contexts are open across all three families; the
+# telemetry adapter is subscribed while nonzero (so an idle process keeps
+# telemetry's no-subscriber fast path)
+_adapter_refs = 0
+
+
+def _snapshot(trackers: List) -> List:
+    # the satellite fix this module's rewrite bakes in structurally: every
+    # record path iterates a snapshot taken UNDER the lock, so a tracker
+    # unregistering on another thread can never raise mid-record
+    with _lock:
+        return list(trackers)
+
+
+def _route_event(event: telemetry.TelemetryEvent) -> None:
+    """Fan one telemetry event out to the legacy tracker family it maps to."""
+    name = event.name
+    stream = event.attrs.get("stream")
+    if name == "update" or (name == "forward" and stream == "dispatch"):
+        for tracker in _snapshot(_active_trackers):
+            tracker._record_dispatch(event.owner, event.kind)
+    elif name == "compile":
+        if stream == "forward":
+            for tracker in _snapshot(_active_forward_trackers):
+                tracker._record_retrace(event.owner, event.kind)
+        else:
+            for tracker in _snapshot(_active_trackers):
+                tracker._record_retrace(event.owner, event.kind)
+    elif name == "forward":
+        for tracker in _snapshot(_active_forward_trackers):
+            tracker._record_launch(event.owner, event.kind, event.dur_us)
+    elif name == "collective":
+        nbytes = int(event.attrs.get("nbytes", 0))
+        for tracker in _snapshot(_active_sync_trackers):
+            tracker._record(event.owner, event.kind, nbytes)
+
+
+def _activate(trackers: List, tracker) -> None:
+    global _adapter_refs
+    with _lock:
+        trackers.append(tracker)
+        _adapter_refs += 1
+        attach = _adapter_refs == 1
+    if attach:
+        telemetry._subscribe(_route_event)
+
+
+def _deactivate(trackers: List, tracker) -> None:
+    global _adapter_refs
+    with _lock:
+        trackers.remove(tracker)
+        _adapter_refs -= 1
+        detach = _adapter_refs == 0
+    if detach:
+        telemetry._unsubscribe(_route_event)
 
 
 class DispatchTracker:
@@ -101,7 +145,7 @@ class DispatchTracker:
         self._dispatch_by_kind: Dict[str, int] = {}
         self._retrace_by_kind: Dict[str, int] = {}
 
-    def dispatch_count(self, kind: str = None, owner: str = None) -> int:
+    def dispatch_count(self, kind: Optional[str] = None, owner: Optional[str] = None) -> int:
         """Dispatches filtered by ``kind`` and/or an ``owner`` substring."""
         if kind is None and owner is None:
             return self.dispatches
@@ -115,7 +159,7 @@ class DispatchTracker:
             and owner in o
         )
 
-    def retrace_count(self, kind: str = None) -> int:
+    def retrace_count(self, kind: Optional[str] = None) -> int:
         if kind is None:
             return self.retraces
         return self._retrace_by_kind.get(kind, 0)
@@ -132,34 +176,24 @@ class DispatchTracker:
 
 
 def record_dispatch(owner: str, kind: str) -> None:
-    """Record one device-program launch on behalf of ``owner``."""
-    if not _active_trackers:
-        return
-    with _lock:
-        for tracker in _active_trackers:
-            tracker._record_dispatch(owner, kind)
+    """Record one update-path device-program launch on behalf of ``owner``."""
+    telemetry.emit("update", owner, kind, stream="dispatch")
 
 
 def record_retrace(owner: str, kind: str) -> None:
-    """Record one compilation (trace + compile) on behalf of ``owner``."""
-    if not _active_trackers:
-        return
-    with _lock:
-        for tracker in _active_trackers:
-            tracker._record_retrace(owner, kind)
+    """Record one update-path compilation on behalf of ``owner``."""
+    telemetry.emit("compile", owner, kind, stream="dispatch", cause="unattributed")
 
 
 @contextmanager
 def track_dispatches() -> Generator[DispatchTracker, None, None]:
     """Count every hot-path dispatch/retrace issued inside the block."""
     tracker = DispatchTracker()
-    with _lock:
-        _active_trackers.append(tracker)
+    _activate(_active_trackers, tracker)
     try:
         yield tracker
     finally:
-        with _lock:
-            _active_trackers.remove(tracker)
+        _deactivate(_active_trackers, tracker)
 
 
 class SyncTracker:
@@ -181,7 +215,7 @@ class SyncTracker:
         self.events: List[Tuple[str, str, int]] = []
         self._by_kind: Dict[str, int] = {}
 
-    def collective_count(self, kind: str = None, owner: str = None) -> int:
+    def collective_count(self, kind: Optional[str] = None, owner: Optional[str] = None) -> int:
         """Collectives filtered by ``kind`` and/or an ``owner`` substring."""
         if kind is None and owner is None:
             return self.collectives
@@ -189,7 +223,7 @@ class SyncTracker:
             return self._by_kind.get(kind, 0)
         return sum(1 for o, k, _ in self.events if (kind is None or k == kind) and owner in o)
 
-    def bytes_count(self, kind: str = None, owner: str = None) -> int:
+    def bytes_count(self, kind: Optional[str] = None, owner: Optional[str] = None) -> int:
         """Wire bytes filtered by ``kind`` and/or an ``owner`` substring."""
         if kind is None and owner is None:
             return self.bytes_on_wire
@@ -207,24 +241,18 @@ class SyncTracker:
 def record_collective(owner: str, kind: str, nbytes: int) -> None:
     """Record one sync collective (``fused``/``gather``/``reduce``) of
     ``nbytes`` payload bytes issued on behalf of ``owner``."""
-    if not _active_sync_trackers:
-        return
-    with _lock:
-        for tracker in _active_sync_trackers:
-            tracker._record(owner, kind, nbytes)
+    telemetry.emit("collective", owner, kind, nbytes=nbytes)
 
 
 @contextmanager
 def track_syncs() -> Generator[SyncTracker, None, None]:
     """Count every sync collective (and its wire bytes) issued inside the block."""
     tracker = SyncTracker()
-    with _lock:
-        _active_sync_trackers.append(tracker)
+    _activate(_active_sync_trackers, tracker)
     try:
         yield tracker
     finally:
-        with _lock:
-            _active_sync_trackers.remove(tracker)
+        _deactivate(_active_sync_trackers, tracker)
 
 
 class ForwardTracker:
@@ -248,7 +276,7 @@ class ForwardTracker:
         self._launch_by_kind: Dict[str, int] = {}
         self._retrace_by_kind: Dict[str, int] = {}
 
-    def launch_count(self, kind: str = None, owner: str = None) -> int:
+    def launch_count(self, kind: Optional[str] = None, owner: Optional[str] = None) -> int:
         """Launches filtered by ``kind`` and/or an ``owner`` substring."""
         if kind is None and owner is None:
             return self.launches
@@ -262,7 +290,7 @@ class ForwardTracker:
             and owner in o
         )
 
-    def retrace_count(self, kind: str = None) -> int:
+    def retrace_count(self, kind: Optional[str] = None) -> int:
         if kind is None:
             return self.retraces
         return self._retrace_by_kind.get(kind, 0)
@@ -281,30 +309,20 @@ class ForwardTracker:
 
 def record_forward(owner: str, kind: str, us: float) -> None:
     """Record one fused-forward launch of ``us`` microseconds for ``owner``."""
-    if not _active_forward_trackers:
-        return
-    with _lock:
-        for tracker in _active_forward_trackers:
-            tracker._record_launch(owner, kind, us)
+    telemetry.emit("forward", owner, kind, dur_us=us, stream="forward")
 
 
 def record_forward_retrace(owner: str, kind: str) -> None:
     """Record one forward-program compilation on behalf of ``owner``."""
-    if not _active_forward_trackers:
-        return
-    with _lock:
-        for tracker in _active_forward_trackers:
-            tracker._record_retrace(owner, kind)
+    telemetry.emit("compile", owner, kind, stream="forward", cause="unattributed")
 
 
 @contextmanager
 def track_forwards() -> Generator[ForwardTracker, None, None]:
     """Count every fused-forward launch/retrace issued inside the block."""
     tracker = ForwardTracker()
-    with _lock:
-        _active_forward_trackers.append(tracker)
+    _activate(_active_forward_trackers, tracker)
     try:
         yield tracker
     finally:
-        with _lock:
-            _active_forward_trackers.remove(tracker)
+        _deactivate(_active_forward_trackers, tracker)

@@ -35,8 +35,11 @@ one packed gather + host reduce when the env has no native reduction.
 
 The engine is on by default and gated by ``METRICS_TPU_FUSED_SYNC``
 (``0``/``false``/``off`` restores the per-leaf protocol bit-for-bit). Every
-bucket collective is recorded via :func:`metrics_tpu.profiling.record_collective`
-(kind ``"fused"``) and counted in the owner's ``sync_stats``.
+bucket collective is emitted on the :mod:`metrics_tpu.telemetry` stream
+(``collective`` span, kind ``"fused"``, attrs: payload ``nbytes``, reduce
+``op``, ``wire_dtype``, packed ``nleaves``) — the legacy
+``profiling.track_syncs`` tracker rides that stream — and counted in the
+owner's ``sync_stats``.
 """
 import os
 from typing import Any, Dict, Hashable, List, NamedTuple, Optional, Tuple
@@ -45,7 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import profiling
+from metrics_tpu import telemetry
 from metrics_tpu.utilities.data import dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
 
 Array = jax.Array
@@ -166,6 +169,7 @@ def execute_buckets(
 
     out: Dict[Hashable, Array] = {}
     for wire_name, op in sorted(buckets):
+        t0 = telemetry.clock()
         leaves = buckets[(wire_name, op)]
         wire = jnp.dtype(wire_name)
         flat = [jnp.ravel(s.value).astype(wire) for s in leaves]
@@ -201,7 +205,16 @@ def execute_buckets(
                     seg = seg.astype(s.dtype)  # bool leaves rode the wire as int32
                 out[s.key] = seg.reshape(s.shape)
 
-        profiling.record_collective(owner, "fused", nbytes)
+        telemetry.emit(
+            "collective",
+            owner,
+            "fused",
+            t0=t0,
+            nbytes=nbytes,
+            op=op,
+            wire_dtype=wire_name,
+            nleaves=len(leaves),
+        )
         if stats is not None:
             stats["collectives"] = stats.get("collectives", 0) + 1
             stats["buckets"] = stats.get("buckets", 0) + 1

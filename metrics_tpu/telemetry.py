@@ -1,0 +1,345 @@
+"""Unified telemetry engine: one span stream for every hot-path phase.
+
+Three perf PRs (fast dispatch, fused sync, fused forward) each bolted its
+own tracker onto :mod:`metrics_tpu.profiling` — three context managers,
+three per-owner stats dicts, no timestamps on most events, and no answer
+to "why did this retrace?". This module is the single event stream they
+all feed now. Every hot-path phase is one :class:`TelemetryEvent`:
+
+========== ============================================================
+``name``   what one event stands for
+========== ============================================================
+update     one update-path device-program launch (kinds ``aot`` /
+           ``fused-aot`` / ``jit`` / ``eager``)
+forward    one fused forward-step launch (state advance + batch value,
+           kinds ``aot`` / ``fused-aot``; the legacy collection jit
+           step carries ``kind="jit"`` and ``stream="dispatch"``)
+compute    one actual (non-memoized) ``compute()`` body
+sync       one cross-participant state sync pass
+reset      one ``reset()`` (instant — zero duration)
+compile    one compilation, tagged with WHY it happened (``cause`` attr:
+           ``first-compile`` / ``new-static-key`` / ``new-shape-bucket``
+           / ``new-dtype`` / ``new-signature`` / ``new-input-signature``
+           / ``unattributed``)
+collective one interconnect launch (kinds ``fused``/``gather``/
+           ``reduce``), with payload ``nbytes`` in the attrs
+========== ============================================================
+
+Events carry the owner (metric class name or ``MetricCollection``), a
+kind, a wall-clock timestamp + duration in µs, the emitting thread id,
+and structured attrs (wire bytes, shape bucket, dtypes, static key,
+retrace cause). Two consumption tiers:
+
+* **Always-on counters.** Every emit bumps a process-level counter keyed
+  ``"<name>:<kind>"`` (plus ``"collective:bytes"`` and
+  ``"compile:cause:<cause>"``) — read with :func:`snapshot`, clear with
+  :func:`reset_counters`. When no subscriber is attached this is the
+  whole cost of an event: a couple of dict increments, no clock reads
+  for the launch-path spans (:func:`clock` returns ``None`` idle, so
+  callers skip ``perf_counter`` entirely).
+* **Subscribed sessions.** ``with telemetry.instrument() as session:``
+  captures every event into ``session.events`` with real timestamps and
+  durations; export with :meth:`TelemetrySession.export_chrome_trace`
+  (loads in Perfetto / ``chrome://tracing``) or
+  :meth:`TelemetrySession.export_jsonl` (replay with
+  ``tools/trace_report.py``). Sessions nest: each sees every event
+  emitted while it is open.
+
+The legacy ``profiling.track_dispatches`` / ``track_syncs`` /
+``track_forwards`` contexts are thin shims subscribed to this stream
+(see :mod:`metrics_tpu.profiling`) — same counts, same API, one source
+of truth.
+
+``METRICS_TPU_TELEMETRY=0`` (or ``false``/``off``) kills the whole
+engine: no counters, no events, and — because the legacy trackers are
+shims over this stream — no tracker records either. Per-owner stats
+dicts (``Metric.dispatch_stats`` &c.) are bumped at the call sites and
+stay live regardless.
+"""
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Generator, Iterable, List, NamedTuple, Optional, Tuple
+
+__all__ = [
+    "TelemetryEvent",
+    "TelemetrySession",
+    "telemetry_enabled",
+    "instrument",
+    "emit",
+    "span",
+    "clock",
+    "snapshot",
+    "reset_counters",
+    "export_chrome_trace",
+    "export_jsonl",
+]
+
+# all timestamps are µs since this process-level epoch (perf_counter is
+# monotonic but has an arbitrary zero; pinning one epoch makes every
+# exported trace internally consistent)
+_EPOCH = time.perf_counter()
+
+_lock = threading.Lock()
+# immutable tuple swapped atomically under _lock: emit() reads the module
+# global ONCE and iterates that snapshot, so a subscriber detaching on
+# another thread can never mutate the sequence mid-record
+_subscribers: Tuple[Callable[["TelemetryEvent"], None], ...] = ()
+_counters: Dict[str, float] = {}
+
+
+def telemetry_enabled() -> bool:
+    """Engine kill switch (env ``METRICS_TPU_TELEMETRY``, default on)."""
+    return os.environ.get("METRICS_TPU_TELEMETRY", "1").strip().lower() not in ("0", "false", "off")
+
+
+class TelemetryEvent(NamedTuple):
+    """One timestamped span (or instant, when ``dur_us == 0``) on the stream.
+
+    Attributes:
+        name: the phase (``update``/``forward``/``compute``/``sync``/
+            ``reset``/``compile``/``collective``).
+        owner: who emitted it — a metric class name or ``MetricCollection``.
+        kind: the launch flavor within the phase (``aot``/``fused-aot``/
+            ``jit``/``eager``/``fused``/``gather``/``reduce``/...).
+        ts_us: start time, µs since the process telemetry epoch.
+        dur_us: wall duration in µs (0.0 for instants and for spans whose
+            start predates the first subscriber).
+        tid: emitting thread id (Chrome-trace lane).
+        attrs: structured payload — ``nbytes``, ``bucket``, ``masked``,
+            ``static_key``, ``cause``, ``stream``, ``dtypes``, ...
+    """
+
+    name: str
+    owner: str
+    kind: str
+    ts_us: float
+    dur_us: float
+    tid: int
+    attrs: Dict[str, Any]
+
+
+# ----------------------------------------------------------------- emission
+def _subscribe(callback: Callable[[TelemetryEvent], None]) -> None:
+    global _subscribers
+    with _lock:
+        _subscribers = _subscribers + (callback,)
+
+
+def _unsubscribe(callback: Callable[[TelemetryEvent], None]) -> None:
+    global _subscribers
+    with _lock:
+        subs = list(_subscribers)
+        if callback in subs:
+            subs.remove(callback)
+        _subscribers = tuple(subs)
+
+
+def clock() -> Optional[float]:
+    """Span start marker: ``perf_counter()`` when someone will receive the
+    span, else ``None`` — so idle hot paths never pay the clock read. Pass
+    the result to :func:`emit` as ``t0``."""
+    if _subscribers and telemetry_enabled():
+        return time.perf_counter()
+    return None
+
+
+def emit(
+    name: str,
+    owner: str,
+    kind: str = "",
+    t0: Optional[float] = None,
+    dur_us: Optional[float] = None,
+    **attrs: Any,
+) -> None:
+    """Record one event on the stream.
+
+    ``t0`` (a :func:`clock` result) sets the span start; the duration is
+    measured to now unless ``dur_us`` is given explicitly (callers that
+    already timed the work pass both). With neither, the event is an
+    instant at now. Counters are bumped even with no subscriber attached;
+    full events are built and delivered only when someone is listening.
+    """
+    if not telemetry_enabled():
+        return
+    subs = _subscribers
+    ckey = f"{name}:{kind}" if kind else name
+    with _lock:
+        _counters[ckey] = _counters.get(ckey, 0) + 1
+        if name == "collective":
+            _counters["collective:bytes"] = _counters.get("collective:bytes", 0) + attrs.get("nbytes", 0)
+        elif name == "compile":
+            cause = attrs.get("cause", "unattributed")
+            _counters[f"compile:cause:{cause}"] = _counters.get(f"compile:cause:{cause}", 0) + 1
+    if not subs:
+        return
+    now = time.perf_counter()
+    if dur_us is None:
+        dur_us = 0.0 if t0 is None else (now - t0) * 1e6
+    if t0 is not None:
+        ts_us = (t0 - _EPOCH) * 1e6
+    else:
+        ts_us = (now - _EPOCH) * 1e6 - dur_us
+    event = TelemetryEvent(name, owner, kind, ts_us, dur_us, threading.get_ident(), attrs)
+    for callback in subs:
+        callback(event)
+
+
+@contextmanager
+def span(name: str, owner: str, kind: str = "", **attrs: Any) -> Generator[None, None, None]:
+    """Wrap a block in one timed span (emitted on exit, even on raise)."""
+    t0 = clock()
+    try:
+        yield
+    finally:
+        emit(name, owner, kind, t0=t0, **attrs)
+
+
+# ----------------------------------------------------------------- counters
+def snapshot() -> Dict[str, float]:
+    """Copy of the process-level counters (``"<name>:<kind>"`` keys, plus
+    ``"collective:bytes"`` and ``"compile:cause:<cause>"``)."""
+    with _lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    """Zero the process-level counters (subscribed sessions are untouched)."""
+    with _lock:
+        _counters.clear()
+
+
+# ------------------------------------------------------------------ sessions
+class TelemetrySession:
+    """The event stream captured by one :func:`instrument` context.
+
+    ``events`` is append-only in emission order; the helpers below are
+    conveniences over it. Safe to read concurrently with emission — the
+    recorder holds a session-local lock around the append.
+    """
+
+    def __init__(self) -> None:
+        self.events: List[TelemetryEvent] = []
+        self._session_lock = threading.Lock()
+
+    def _record(self, event: TelemetryEvent) -> None:
+        with self._session_lock:
+            self.events.append(event)
+
+    # -------------------------------------------------------------- queries
+    def spans(
+        self,
+        name: Optional[str] = None,
+        kind: Optional[str] = None,
+        owner: Optional[str] = None,
+    ) -> List[TelemetryEvent]:
+        """Events filtered by exact ``name``/``kind`` and ``owner`` substring."""
+        with self._session_lock:
+            events = list(self.events)
+        return [
+            e
+            for e in events
+            if (name is None or e.name == name)
+            and (kind is None or e.kind == kind)
+            and (owner is None or owner in e.owner)
+        ]
+
+    def count(self, name: Optional[str] = None, kind: Optional[str] = None, owner: Optional[str] = None) -> int:
+        return len(self.spans(name=name, kind=kind, owner=owner))
+
+    def retrace_causes(self) -> Dict[str, int]:
+        """``{cause: count}`` over every ``compile`` event in the session."""
+        causes: Dict[str, int] = {}
+        for e in self.spans(name="compile"):
+            cause = e.attrs.get("cause", "unattributed")
+            causes[cause] = causes.get(cause, 0) + 1
+        return causes
+
+    def collective_bytes(self) -> int:
+        """Total payload bytes over every ``collective`` event."""
+        return sum(int(e.attrs.get("nbytes", 0)) for e in self.spans(name="collective"))
+
+    # ------------------------------------------------------------- exporters
+    def export_chrome_trace(self, path: str) -> None:
+        export_chrome_trace(self.spans(), path)
+
+    def export_jsonl(self, path: str) -> None:
+        export_jsonl(self.spans(), path)
+
+
+@contextmanager
+def instrument() -> Generator[TelemetrySession, None, None]:
+    """Capture every telemetry event emitted inside the block.
+
+    Contexts nest: each open session receives every event, so an inner
+    session's stream is a contiguous subsequence of the outer's.
+    """
+    session = TelemetrySession()
+    _subscribe(session._record)
+    try:
+        yield session
+    finally:
+        _unsubscribe(session._record)
+
+
+# ------------------------------------------------------------------ exporters
+def _jsonable(value: Any) -> Any:
+    """Best-effort JSON coercion for attr payloads (dtypes, shape tuples,
+    static-key tuples) — containers recurse, leaves fall back to ``str``."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    return str(value)
+
+
+def export_jsonl(events: Iterable[TelemetryEvent], path: str) -> None:
+    """One JSON object per line per event — the ``tools/trace_report.py``
+    interchange format."""
+    with open(path, "w") as f:
+        for e in events:
+            f.write(
+                json.dumps(
+                    {
+                        "name": e.name,
+                        "owner": e.owner,
+                        "kind": e.kind,
+                        "ts_us": round(e.ts_us, 3),
+                        "dur_us": round(e.dur_us, 3),
+                        "tid": e.tid,
+                        "attrs": _jsonable(e.attrs),
+                    }
+                )
+                + "\n"
+            )
+
+
+def export_chrome_trace(events: Iterable[TelemetryEvent], path: str) -> None:
+    """Chrome trace-event JSON (the ``traceEvents`` array form) — open in
+    Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``. Timed spans
+    become complete (``ph="X"``) events; zero-duration events become
+    instants (``ph="i"``)."""
+    pid = os.getpid()
+    trace: List[Dict[str, Any]] = []
+    for e in events:
+        entry: Dict[str, Any] = {
+            "name": f"{e.owner}.{e.name}" + (f" [{e.kind}]" if e.kind else ""),
+            "cat": e.name,
+            "pid": pid,
+            "tid": e.tid,
+            "ts": round(e.ts_us, 3),
+            "args": {"owner": e.owner, "kind": e.kind, **_jsonable(e.attrs)},
+        }
+        if e.dur_us > 0:
+            entry["ph"] = "X"
+            entry["dur"] = round(e.dur_us, 3)
+        else:
+            entry["ph"] = "i"
+            entry["s"] = "t"
+        trace.append(entry)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)

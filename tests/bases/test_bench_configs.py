@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 19
+    assert len(skipped) == 20
     assert "detail_elapsed_s" in detail
 
 
@@ -187,6 +187,26 @@ def test_forward_engine_config_counts_and_keys(monkeypatch):
     # the config must restore the kill switch it toggles
     assert os.environ.get("METRICS_TPU_FUSED_FORWARD") is None or (
         os.environ["METRICS_TPU_FUSED_FORWARD"] != "0")
+
+
+def test_telemetry_overhead_config_counts_and_keys(monkeypatch):
+    """Pin the telemetry-overhead bench config: the structural claim is
+    'enabled-but-idle telemetry costs nothing measurable on the fused
+    forward path' — the idle/off ratio key must exist and stay near 1
+    (the bound is lenient for CI noise; BASELINE.md records the real
+    number), and the retrace-cause mirror must name at least one cause
+    (this process compiled at least once to warm the metric)."""
+    monkeypatch.delenv("METRICS_TPU_TELEMETRY", raising=False)
+    detail = {}
+    bench._cfg_telemetry_overhead(detail)
+    assert detail["telemetry_off_forward_us"] > 0
+    assert detail["telemetry_idle_forward_us"] > 0
+    assert detail["telemetry_instrumented_forward_us"] > 0
+    assert 0 < detail["telemetry_idle_overhead_ratio"] < 2.0
+    assert any(k.startswith("telemetry_retrace_cause_") for k in detail)
+    # the config must restore the kill switch it toggles
+    assert os.environ.get("METRICS_TPU_TELEMETRY") is None or (
+        os.environ["METRICS_TPU_TELEMETRY"] != "0")
 
 
 def test_cg_configs_record_host_pinning():

@@ -1,0 +1,366 @@
+"""Tests for the unified telemetry engine (metrics_tpu/telemetry.py).
+
+Pins the contracts the observability PR ships: one span stream carrying
+every hot-path phase with timestamps and structured attrs, retrace events
+tagged with WHY they compiled, Perfetto-loadable Chrome-trace and JSONL
+exporters, always-on counters, the ``METRICS_TPU_TELEMETRY=0`` kill
+switch, legacy ``profiling.track_*`` behavior through the shims, tracker
+thread-safety under concurrent updates, and nested ``instrument()``
+contexts seeing disjoint-but-complete streams.
+"""
+import importlib.util
+import json
+import os
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    F1Score,
+    MetricCollection,
+    Precision,
+    profiling,
+    telemetry,
+)
+from metrics_tpu.metric import Metric
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+C = 5
+
+
+def _batch(rng, b, c=C):
+    logits = rng.rand(b, c).astype(np.float32)
+    return jnp.asarray(logits), jnp.asarray(rng.randint(0, c, b))
+
+
+class FlagMetric(Metric):
+    """Minimal metric with a bool flag kwarg: the flag is a static scalar,
+    so flipping it mints a new executable (the ``new-static-key`` cause)."""
+
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x, flag=True):
+        if flag:
+            self.total = self.total + jnp.sum(x)
+        else:
+            self.total = self.total - jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+# ------------------------------------------------------------------ acceptance
+def test_instrumented_fused_collection_eval(tmp_path):
+    """The PR's acceptance scenario: ONE instrument() block around a
+    10-step fused-collection eval yields >=10 forward spans with nonzero
+    µs, every compile event carries a cause, and the Chrome-trace export is
+    structurally Perfetto-loadable."""
+    rng = np.random.RandomState(0)
+    col = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=C, average="macro"),
+            "f1": F1Score(num_classes=C, average="macro"),
+            "prec": Precision(num_classes=C, average="macro"),
+        },
+        fused_update=True,
+    )
+    with telemetry.instrument() as session:
+        for step in range(10):
+            col(*_batch(rng, 64 + step))  # ragged sizes, one pow2 bucket
+        vals = col.compute()
+        jax.block_until_ready(vals["acc"])
+
+    forwards = session.spans(name="forward")
+    assert len(forwards) >= 10
+    assert all(e.dur_us > 0 for e in forwards)
+
+    compiles = session.spans(name="compile")
+    assert compiles, "a cold eval must compile at least once"
+    assert all("cause" in e.attrs for e in compiles)
+    assert session.retrace_causes().get("first-compile", 0) >= 1
+
+    # compute phase spans exist (new vs the legacy trackers)
+    assert session.count(name="compute") >= 1
+
+    # Chrome trace export: valid JSON, complete spans with the fields
+    # Perfetto/chrome://tracing require
+    chrome = tmp_path / "trace.json"
+    session.export_chrome_trace(str(chrome))
+    doc = json.loads(chrome.read_text())
+    events = doc["traceEvents"]
+    assert len(events) == len(session.events)
+    for entry in events:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(entry)
+        if entry["ph"] == "X":
+            assert entry["dur"] > 0
+    assert any(entry["ph"] == "X" for entry in events)
+
+
+def test_jsonl_roundtrip_through_trace_report(tmp_path):
+    """The JSONL export replays through tools/trace_report.py into a
+    summary that names launches, causes, and percentiles."""
+    rng = np.random.RandomState(1)
+    m = Accuracy(num_classes=C, jit_update=True)
+    with telemetry.instrument() as session:
+        for _ in range(3):
+            m.update(*_batch(rng, 32))
+        m.compute()
+    path = tmp_path / "t.jsonl"
+    session.export_jsonl(str(path))
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report",
+        os.path.join(os.path.dirname(__file__), "..", "..", "tools", "trace_report.py"),
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+
+    events = trace_report.load_events(str(path))
+    assert len(events) == len(session.events)
+    report = trace_report.summarize(events)
+    assert "update:aot" in report
+    assert "cause first-compile" in report
+    assert "p50 us" in report
+
+
+# -------------------------------------------------------------- cause tagging
+def test_retrace_cause_new_shape_bucket():
+    rng = np.random.RandomState(2)
+    m = Accuracy(num_classes=C, average="macro", jit_update=True)
+    with telemetry.instrument() as session:
+        m.update(*_batch(rng, 16))   # bucket 16
+        m.update(*_batch(rng, 300))  # bucket 512
+    causes = session.retrace_causes()
+    assert causes.get("first-compile") == 1
+    assert causes.get("new-shape-bucket") == 1
+
+
+def test_retrace_cause_new_dtype():
+    m = FlagMetric(jit_update=True)
+    with telemetry.instrument() as session:
+        m.update(jnp.ones((8,), jnp.float32))
+        m.update(jnp.ones((8,), jnp.int32))  # same shape, new input dtype
+    causes = session.retrace_causes()
+    assert causes.get("first-compile") == 1
+    assert causes.get("new-dtype") == 1
+
+
+def test_retrace_cause_new_static_key():
+    m = FlagMetric(jit_update=True)
+    with telemetry.instrument() as session:
+        m.update(jnp.ones((8,), jnp.float32), flag=True)
+        m.update(jnp.ones((8,), jnp.float32), flag=False)
+    causes = session.retrace_causes()
+    assert causes.get("first-compile") == 1
+    assert causes.get("new-static-key") == 1
+    assert float(m.compute()) == 0.0  # +8 then -8: both executables ran
+
+
+def test_compile_events_carry_stream_and_kind():
+    rng = np.random.RandomState(3)
+    m = Accuracy(num_classes=C, average="macro", jit_update=True)
+    with telemetry.instrument() as session:
+        m.forward(*_batch(rng, 32))
+    streams = {e.attrs.get("stream") for e in session.spans(name="compile")}
+    assert "forward" in streams
+
+
+# ------------------------------------------------------------------- counters
+def test_counters_always_on_and_resettable():
+    telemetry.reset_counters()
+    rng = np.random.RandomState(4)
+    m = Accuracy(num_classes=C, jit_update=True)
+    m.update(*_batch(rng, 32))  # NO subscriber attached
+    snap = telemetry.snapshot()
+    assert snap.get("update:aot", 0) >= 1
+    assert any(k.startswith("compile:cause:") for k in snap)
+    telemetry.reset_counters()
+    assert telemetry.snapshot() == {}
+
+
+def test_kill_switch_silences_stream_and_counters(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_TELEMETRY", "0")
+    telemetry.reset_counters()
+    rng = np.random.RandomState(5)
+    m = Accuracy(num_classes=C, jit_update=True)
+    with telemetry.instrument() as session, profiling.track_dispatches() as t:
+        m.update(*_batch(rng, 32))
+    assert session.events == []
+    assert telemetry.snapshot() == {}
+    # the legacy trackers are shims over the stream, so they go quiet too —
+    # but the per-owner stats dicts are call-site-owned and stay live
+    assert t.dispatches == 0
+    assert m.dispatch_stats["dispatches"] == 1
+
+
+# ------------------------------------------------------------- legacy shims
+def test_legacy_trackers_ride_the_one_stream():
+    """All three tracker families and an instrument() session see the same
+    events at once, with the historical stream separation intact (forward
+    launches never leak into the dispatch tracker)."""
+    rng = np.random.RandomState(6)
+    m = Accuracy(num_classes=C, average="macro", jit_update=True)
+    with telemetry.instrument() as session, profiling.track_dispatches() as d, profiling.track_forwards() as f:
+        m.update(*_batch(rng, 32))
+        m.forward(*_batch(rng, 32))
+    assert d.dispatches == 1  # the update; the forward rode its own stream
+    assert d.dispatch_count("aot") == 1
+    assert f.launches == 1
+    assert f.engine_us > 0
+    assert session.count(name="update") == 1
+    assert session.count(name="forward") == 1
+    # legacy events lists keep their historical tuple shapes
+    assert d.events[-1] == ("Accuracy", "aot")
+    owner, kind, us = f.events[-1]
+    assert (owner, kind) == ("Accuracy", "aot") and us > 0
+
+
+def test_record_functions_still_feed_trackers():
+    """Out-of-tree callers of profiling.record_* keep working through the
+    telemetry wrappers."""
+    with profiling.track_dispatches() as d, profiling.track_syncs() as s, profiling.track_forwards() as f:
+        profiling.record_dispatch("X", "jit")
+        profiling.record_retrace("X", "jit")
+        profiling.record_collective("X", "gather", 128)
+        profiling.record_forward("X", "aot", 7.5)
+        profiling.record_forward_retrace("X", "aot")
+    assert (d.dispatches, d.retraces) == (1, 1)
+    assert (s.collectives, s.bytes_on_wire) == (1, 128)
+    assert (f.launches, f.retraces) == (1, 1)
+    assert f.engine_us == 7.5
+
+
+# ------------------------------------------------- thread safety & nesting
+def test_tracker_thread_safety_under_concurrent_updates():
+    """Concurrent eager updates while tracker/instrument contexts churn on
+    another thread: no lost records in the outer session, no raises from a
+    tracker unregistering mid-record."""
+    UPDATES, WORKERS = 30, 3
+    errors = []
+    stop = threading.Event()
+
+    def churn():
+        # enter/exit short-lived contexts as fast as possible
+        while not stop.is_set():
+            with profiling.track_dispatches(), telemetry.instrument():
+                pass
+
+    def work():
+        try:
+            m = FlagMetric()  # eager: every update emits one event
+            x = jnp.ones((4,), jnp.float32)
+            for _ in range(UPDATES):
+                m.update(x)
+        except Exception as err:  # noqa: BLE001 — the test IS the absence of this
+            errors.append(err)
+
+    with telemetry.instrument() as outer:
+        churner = threading.Thread(target=churn)
+        churner.start()
+        workers = [threading.Thread(target=work) for _ in range(WORKERS)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join()
+        stop.set()
+        churner.join()
+
+    assert errors == []
+    assert outer.count(name="update", kind="eager") == UPDATES * WORKERS
+
+
+def test_nested_instrument_contexts_disjoint_but_complete():
+    rng = np.random.RandomState(7)
+    m = Accuracy(num_classes=C, jit_update=True)
+    m.update(*_batch(rng, 32))  # warm: the nested windows see steady state
+    with telemetry.instrument() as outer:
+        m.update(*_batch(rng, 32))
+        with telemetry.instrument() as inner:
+            m.update(*_batch(rng, 32))
+        m.update(*_batch(rng, 32))
+
+    assert outer.count(name="update") == 3
+    assert inner.count(name="update") == 1
+    # the inner stream is a contiguous subsequence of the outer one
+    start = outer.events.index(inner.events[0])
+    assert outer.events[start : start + len(inner.events)] == inner.events
+
+
+# ------------------------------------------------------------- phase spans
+def test_sync_and_compute_spans_under_distributed_env():
+    from metrics_tpu.parallel.dist_env import NoOpEnv
+
+    class Loopback2(NoOpEnv):
+        # 2-rank loopback: both ranks contribute the identical local state,
+        # so the real sync machinery (and its collective events) runs
+        def world_size(self):
+            return 2
+
+        def all_gather(self, x):
+            x = jnp.atleast_1d(x)
+            return [x, x]
+
+        def all_reduce(self, x, op):
+            stacked = jnp.stack([jnp.atleast_1d(x)] * 2)
+            return {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max,
+                    "min": jnp.min}[op](stacked, axis=0)
+
+    rng = np.random.RandomState(8)
+    m = Accuracy(num_classes=C, sync_env=Loopback2())
+    m.update(*_batch(rng, 16))
+    with telemetry.instrument() as session:
+        m.compute()
+    assert session.count(name="sync") == 1
+    assert session.count(name="compute") == 1
+    collectives = session.spans(name="collective")
+    assert collectives
+    assert all(e.attrs.get("nbytes", 0) > 0 for e in collectives)
+    assert session.collective_bytes() == sum(e.attrs["nbytes"] for e in collectives)
+
+
+def test_reset_emits_instant_event():
+    m = FlagMetric()
+    with telemetry.instrument() as session:
+        m.reset()
+    events = session.spans(name="reset")
+    assert len(events) == 1
+    assert events[0].dur_us == 0.0
+
+
+# ------------------------------------------------------------ snapshots
+def test_metric_telemetry_snapshot_merges_three_stats():
+    rng = np.random.RandomState(9)
+    m = Accuracy(num_classes=C, average="macro", jit_update=True)
+    m.update(*_batch(rng, 32))
+    m.forward(*_batch(rng, 32))
+    snap = m.telemetry_snapshot()
+    assert snap["owner"] == "Accuracy"
+    assert snap["dispatch"] == m.dispatch_stats
+    assert snap["sync"] == m.sync_stats
+    assert snap["forward"] == m.forward_stats
+    assert snap["dispatch"]["dispatches"] >= 1
+    assert snap["forward"]["launches"] == 1
+
+
+def test_collection_telemetry_snapshot_includes_members():
+    rng = np.random.RandomState(10)
+    col = MetricCollection(
+        {"acc": Accuracy(num_classes=C), "prec": Precision(num_classes=C)},
+        fused_update=True,
+    )
+    col.update(*_batch(rng, 32))
+    snap = col.telemetry_snapshot()
+    assert snap["owner"] == "MetricCollection"
+    assert set(snap["members"]) == {"acc", "prec"}
+    assert snap["members"]["acc"]["owner"] == "Accuracy"
+    assert snap["dispatch"]["dispatches"] >= 1  # the fused update launch

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Replay a telemetry JSON-lines export into a human-readable summary.
+
+Usage::
+
+    python tools/trace_report.py TRACE.jsonl          # summarize an export
+    python tools/trace_report.py --bench TRACE.jsonl  # run a short
+        # instrumented eval (10 fused-collection forward steps + compute),
+        # write TRACE.jsonl (and TRACE.trace.json for Perfetto), then
+        # summarize it — this is what `make trace` runs
+
+The input is what ``TelemetrySession.export_jsonl`` (or module-level
+``telemetry.export_jsonl``) writes: one JSON object per event with
+``name``/``owner``/``kind``/``ts_us``/``dur_us``/``attrs``. The summary
+answers the questions the raw stream exists for: how many launches of
+each flavor, why every compile happened, what crossed the wire, and the
+p50/p95 of each span family.
+"""
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending list (no numpy needed)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    events = []
+    with open(path) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as err:
+                raise SystemExit(f"{path}:{line_no}: not a telemetry JSONL line ({err})")
+    return events
+
+
+def summarize(events: List[Dict[str, Any]]) -> str:
+    """Render the report the bench trajectory reads: launches by
+    (name, kind), retraces by cause, collectives + wire bytes, and
+    p50/p95 span µs per family."""
+    lines: List[str] = []
+    if not events:
+        return "(empty trace: no telemetry events)"
+
+    span_start = min(e.get("ts_us", 0.0) for e in events)
+    span_end = max(e.get("ts_us", 0.0) + e.get("dur_us", 0.0) for e in events)
+    lines.append(f"events: {len(events)}   trace window: {(span_end - span_start) / 1000.0:.2f} ms")
+
+    # launches / phases by (name, kind)
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        key = f"{e['name']}:{e['kind']}" if e.get("kind") else e["name"]
+        groups.setdefault(key, []).append(e)
+
+    lines.append("")
+    lines.append(f"{'span':<28}{'count':>7}{'p50 us':>12}{'p95 us':>12}{'total us':>14}")
+    for key in sorted(groups):
+        durs = sorted(e.get("dur_us", 0.0) for e in groups[key])
+        lines.append(
+            f"{key:<28}{len(durs):>7}{_percentile(durs, 50):>12.1f}"
+            f"{_percentile(durs, 95):>12.1f}{sum(durs):>14.1f}"
+        )
+
+    compiles = [e for e in events if e["name"] == "compile"]
+    lines.append("")
+    lines.append(f"retraces: {len(compiles)}")
+    causes: Dict[str, int] = {}
+    for e in compiles:
+        cause = (e.get("attrs") or {}).get("cause", "unattributed")
+        causes[cause] = causes.get(cause, 0) + 1
+    for cause in sorted(causes):
+        lines.append(f"  cause {cause:<22}{causes[cause]:>5}")
+
+    collectives = [e for e in events if e["name"] == "collective"]
+    total_bytes = sum(int((e.get("attrs") or {}).get("nbytes", 0)) for e in collectives)
+    lines.append("")
+    lines.append(f"collectives: {len(collectives)}   bytes on wire: {total_bytes}")
+    by_kind: Dict[str, List[int]] = {}
+    for e in collectives:
+        by_kind.setdefault(e.get("kind", "?"), []).append(int((e.get("attrs") or {}).get("nbytes", 0)))
+    for kind in sorted(by_kind):
+        lines.append(f"  {kind:<8}{len(by_kind[kind]):>5} launches, {sum(by_kind[kind]):>10} bytes")
+    return "\n".join(lines)
+
+
+def run_instrumented_bench(path: str) -> None:
+    """Ten fused-collection forward steps + one compute under a single
+    ``telemetry.instrument()`` block (the acceptance scenario of the
+    telemetry PR), exported as JSONL to ``path`` and as a Chrome trace next
+    to it (open the ``.trace.json`` in https://ui.perfetto.dev)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, telemetry
+
+    rng = np.random.RandomState(7)
+    C = 16
+    col = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=C, average="macro"),
+            "f1": F1Score(num_classes=C, average="macro"),
+            "prec": Precision(num_classes=C, average="macro"),
+        },
+        fused_update=True,
+    )
+
+    def batch(b):
+        logits = rng.rand(b, C).astype(np.float32)
+        return jnp.asarray(logits), jnp.asarray(rng.randint(0, C, b))
+
+    with telemetry.instrument() as session:
+        for step in range(10):
+            col(*batch(128 + step))  # ragged sizes inside one pow2 bucket
+        vals = col.compute()
+        jax.block_until_ready(vals["acc"])
+    session.export_jsonl(path)
+    chrome_path = path.rsplit(".", 1)[0] + ".trace.json"
+    session.export_chrome_trace(chrome_path)
+    print(f"wrote {path} and {chrome_path} (Perfetto-loadable)", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("trace", help="telemetry JSONL file to summarize (written first with --bench)")
+    parser.add_argument(
+        "--bench",
+        action="store_true",
+        help="run a short instrumented fused-collection eval and export it to TRACE first",
+    )
+    args = parser.parse_args(argv)
+    if args.bench:
+        run_instrumented_bench(args.trace)
+    print(summarize(load_events(args.trace)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
