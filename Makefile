@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity doctest bench bench-forward trace tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos doctest bench bench-forward trace tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -36,6 +36,18 @@ test-fast:
 # reference checkout or torch is absent; included in `make test` too)
 parity:
 	python -m pytest tests/parity/ -q
+
+# fault-injection lane: the chaos-marked resilience suite (also part of the
+# default `make test` selection — each fault class is forced on via
+# faults.inject inside the tests), plus one ambient-chaos parity pass per
+# fault class forced process-wide through the env knob: every degrade path
+# must still serve values bit-identical to the eager reference
+chaos:
+	python -m pytest -m chaos tests/ -q
+	for f in compile launch collective nan-input state-corruption oom; do \
+		echo "=== ambient fault: $$f ==="; \
+		METRICS_TPU_INJECT_FAULT=$$f python -m pytest tests/bases/test_chaos.py -k ambient -q || exit 1; \
+	done
 
 # on-device smoke suite: needs a live TPU backend (skips itself otherwise)
 tpu-smoke:

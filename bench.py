@@ -469,6 +469,59 @@ def _cfg_telemetry_overhead(detail: dict) -> None:
             detail[f"telemetry_retrace_cause_{key.rsplit(':', 1)[1]}"] = int(count)
 
 
+def _cfg_resilience_overhead(detail: dict) -> None:
+    """Idle cost of the resilience engine on the fused forward path.
+
+    The resilience layer (:mod:`metrics_tpu.resilience`) sits on every
+    engine call: a policy ``allow()`` tick, a snapshot-before-engine-call
+    (leaf references on CPU — no copies while donation is off), and a
+    structural post-call verification. Its claim is "near-free when
+    nothing faults": this config times the same warm single-metric fused
+    forward step as ``_cfg_telemetry_overhead`` with the engine killed
+    (``METRICS_TPU_RESILIENCE=0`` — the legacy permanent-demotion posture,
+    no snapshots or verification) and at the default-on state, and pins
+    the on/off ratio as the structural key."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+
+    rng = np.random.RandomState(29)
+    C = 32
+    logits = rng.rand(256, C).astype(np.float32)
+    p = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    tg = jnp.asarray(rng.randint(0, C, 256))
+
+    m = Accuracy(num_classes=C, average="macro", jit_update=True)
+    m.forward(p, tg)  # compile
+    jax.block_until_ready(m.tp)
+
+    def timed(step):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(50):
+                step()
+            jax.block_until_ready(m.tp)
+            best = min(best, (time.perf_counter() - t0) / 50 * 1e6)
+        return round(best, 1)
+
+    prev = os.environ.get("METRICS_TPU_RESILIENCE")
+    os.environ["METRICS_TPU_RESILIENCE"] = "0"
+    try:
+        detail["resilience_off_forward_us"] = timed(lambda: m.forward(p, tg))
+    finally:
+        if prev is None:
+            os.environ.pop("METRICS_TPU_RESILIENCE", None)
+        else:
+            os.environ["METRICS_TPU_RESILIENCE"] = prev
+
+    detail["resilience_on_forward_us"] = timed(lambda: m.forward(p, tg))
+    detail["resilience_idle_overhead_ratio"] = round(
+        detail["resilience_on_forward_us"] / max(detail["resilience_off_forward_us"], 1e-9), 3
+    )
+
+
 def _machinery_device(detail: dict):
     """Host CPU device for the compute-group machinery configs.
 
@@ -1068,6 +1121,7 @@ def _bench_detail() -> dict:
         ("sync_collectives_fused_collection", _cfg_sync_engine),
         ("forward_launches_single_metric_10_steps", _cfg_forward_engine),
         ("telemetry_idle_overhead_ratio", _cfg_telemetry_overhead),
+        ("resilience_idle_overhead_ratio", _cfg_resilience_overhead),
     ]
     detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
     return detail
@@ -1285,6 +1339,7 @@ def _bench_detail_fast() -> dict:
         ("sync_engine", _cfg_sync_engine),
         ("forward_engine", _cfg_forward_engine),
         ("telemetry_overhead", _cfg_telemetry_overhead),
+        ("resilience_overhead", _cfg_resilience_overhead),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),

@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import sync_engine, telemetry
+from metrics_tpu import resilience, sync_engine, telemetry
+from metrics_tpu.dispatch import FastDispatchUnsupported
 from metrics_tpu.metric import Metric, _donation_argnums, _raise_if_list_state, _scan_fold
 from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, default_env
 from metrics_tpu.utilities.data import _flatten_dict, _squeeze_if_scalar
@@ -93,7 +94,12 @@ class MetricCollection:
         self._groups_checked: bool = False
         self._groups: Dict[int, List[str]] = {}
         self._fused_update = fused_update
+        # structural ineligibility (list states, string inputs, wrapper
+        # members): permanent — retrying cannot help
         self._fuse_failed: bool = False
+        # runtime engine failures: exponential-backoff demotion + re-promotion
+        # through the unified policy (see metrics_tpu.resilience)
+        self._fuse_resilience = resilience.ResiliencePolicy()
         self._fused_update_fn = None
         self._fused_forward_fn = None
         self._dispatcher = None  # AOT fast-dispatch engine for fused updates
@@ -133,6 +139,7 @@ class MetricCollection:
         )
         self._filter_kwargs_cache = {}
         self._synced_members = self.__dict__.get("_synced_members", None)
+        self._fuse_resilience = self.__dict__.get("_fuse_resilience") or resilience.ResiliencePolicy()
 
     # --------------------------------------------------------------- mapping
     def __getitem__(self, key: str) -> Metric:
@@ -181,12 +188,28 @@ class MetricCollection:
 
     def _fuse_fallback(self, what: str, reason: Union[str, Exception]) -> None:
         if isinstance(reason, Exception):
-            reason = f"{type(reason).__name__}: {reason}"
-        msg = f"MetricCollection could not fuse `{what}` ({reason}); falling back to eager dispatch."
+            # runtime engine failure: eager serves this call, the fused path
+            # is benched for a backoff cooldown (permanent only for
+            # structurally-unsupported programs or METRICS_TPU_RESILIENCE=0)
+            permanent = isinstance(reason, FastDispatchUnsupported)
+            self._fuse_resilience.note_failure(resilience.classify(reason), permanent=permanent)
+            resilience.record_degrade("MetricCollection", what, reason, self._fuse_resilience)
+            if self._fuse_resilience.permanent:
+                self._fuse_failed = True
+            reason_msg = f"{type(reason).__name__}: {reason}"
+            msg = (
+                f"MetricCollection could not fuse `{what}` ({reason_msg}); "
+                f"falling back to eager dispatch"
+                + ("." if self._fuse_failed else f" (cooldown {self._fuse_resilience.cooldown} calls).")
+            )
+        else:
+            # structural: this collection/input shape can never fuse
+            self._fuse_failed = True
+            telemetry.emit("degrade", "MetricCollection", what, cause="unfusable", permanent=True)
+            msg = f"MetricCollection could not fuse `{what}` ({reason}); falling back to eager dispatch."
         # auto mode falls back quietly (the user never asked for fusion);
         # an explicit fused_update=True gets a visible warning
         (rank_zero_warn if self._fused_update is True else rank_zero_debug)(msg)
-        self._fuse_failed = True
 
     def _filtered_kwargs(self, name: str, metric: Metric, kwargs: Dict[str, Any]) -> Dict[str, Any]:
         """``metric._filter_kwargs`` with the accepted key set memoized per
@@ -324,36 +347,73 @@ class MetricCollection:
 
     @property
     def dispatch_stats(self) -> Dict[str, int]:
-        """Fused-path counters: executable ``dispatches`` / ``retraces``."""
-        return dict(self._dispatch_stats)
+        """Fused-path counters: executable ``dispatches`` / ``retraces``,
+        plus the shared fuse policy's degradation state."""
+        stats: Dict[str, Any] = dict(self._dispatch_stats)
+        stats.update(self._fuse_resilience.stats())
+        return stats
 
     @property
     def forward_stats(self) -> Dict[str, Any]:
         """Step-path counters for the fused forward engine: single-launch
         ``launches`` covering the whole collection, forward-program
-        ``retraces``, and cumulative host-side ``engine_us``."""
-        return dict(self._forward_stats)
+        ``retraces``, and cumulative host-side ``engine_us``, plus the
+        shared fuse policy's degradation state (``demotions`` /
+        ``repromotions`` / ``cooldown`` / ``permanent`` / ``last_cause``)."""
+        stats: Dict[str, Any] = dict(self._forward_stats)
+        stats.update(self._fuse_resilience.stats())
+        return stats
+
+    def _snapshot_members(self) -> Optional[Dict[str, Dict[str, Any]]]:
+        """Transactional snapshot of every member's engine-visible state
+        (leaf refs on CPU — free; copies where donation could invalidate
+        buffers). ``None`` with the resilience engine off."""
+        if not resilience.resilience_enabled():
+            return None
+        return {name: resilience.snapshot_state(m) for name, m in self.items(keep_base=True)}
+
+    def _restore_members(self, snaps: Dict[str, Dict[str, Any]]) -> None:
+        for name, m in self.items(keep_base=True):
+            if name in snaps:
+                resilience.restore_state(m, snaps[name])
+
+    def _verify_members(self, snaps: Dict[str, Dict[str, Any]], where: str) -> None:
+        for name, m in self.items(keep_base=True):
+            if name in snaps:
+                resilience.verify_engine_state(m, snaps[name], where=f"{where}:{name}")
 
     def _try_fused_update(self, *args: Any, **kwargs: Any) -> bool:
+        if not self._fuse_resilience.allow():
+            return False  # cooling down after an engine failure
+        snap = None
         try:
             if not self._fusable(args, kwargs):
                 self._fuse_fallback("update", "unfusable member or non-array inputs")
                 return False
             from metrics_tpu.dispatch import fast_dispatch_enabled
 
+            snap = self._snapshot_members()
             if fast_dispatch_enabled():
                 if self._dispatcher is None:
                     self._dispatcher = self._make_dispatcher()
                 self._dispatcher.update({}, (), args, kwargs)
+                if snap is not None:
+                    self._verify_members(snap, "fused-update")
             else:
                 if self._fused_update_fn is None:
                     self._fused_update_fn = jax.jit(self.pure_update, donate_argnums=_donation_argnums())
                 new_states = self._fused_update_fn(self.state(), *args, **kwargs)
                 self.load_pure_state(new_states, increment=True)
+                if snap is not None:
+                    self._verify_members(snap, "fused-update")
+                self._fuse_resilience.note_success()
                 return True
         except Exception as err:
+            if snap is not None:
+                self._restore_members(snap)
             self._fuse_fallback("update", err)
             return False
+        self._fuse_resilience.note_success()
         # engine path wrote the new leaves in place; mirror load_pure_state's
         # bookkeeping without the copies
         for _, m in self.items(keep_base=True):
@@ -375,7 +435,10 @@ class MetricCollection:
         return new_states, batch_vals
 
     def _try_fused_forward(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
+        if not self._fuse_resilience.allow():
+            return None  # cooling down after an engine failure
         engine = False
+        snap = None
         try:
             if not self._fusable(args, kwargs):
                 self._fuse_fallback("forward", "unfusable member or non-array inputs")
@@ -397,7 +460,10 @@ class MetricCollection:
                 self._compute_groups_create_state_ref()
                 if self._dispatcher is None:
                     self._dispatcher = self._make_dispatcher()
+                snap = self._snapshot_members()
                 batch_vals = self._dispatcher.forward(counts, {}, (), args, kwargs)
+                if snap is not None:
+                    self._verify_members(snap, "fused-forward")
             else:
                 # legacy fused path: one jit with per-call signature hashing
                 if self._fused_forward_fn is None:
@@ -421,8 +487,11 @@ class MetricCollection:
                 # stream — but it IS a forward, and the span name says so
                 telemetry.emit("forward", "MetricCollection", "jit", t0=t0, stream="dispatch")
         except Exception as err:
+            if snap is not None:
+                self._restore_members(snap)
             self._fuse_fallback("forward", err)
             return None
+        self._fuse_resilience.note_success()
         if engine:
             # leaves already written in place; mirror load_pure_state's
             # bookkeeping without the copies
@@ -578,9 +647,13 @@ class MetricCollection:
         ``"members"`` (see ``docs/observability.md``)."""
         return {
             "owner": "MetricCollection",
-            "dispatch": dict(self._dispatch_stats),
+            "dispatch": self.dispatch_stats,
             "sync": dict(self._sync_stats),
-            "forward": dict(self._forward_stats),
+            "forward": self.forward_stats,
+            "resilience": {
+                "fused": self._fuse_resilience.stats(),
+                "fuse_failed": self._fuse_failed,
+            },
             "members": {name: m.telemetry_snapshot() for name, m in self.items(keep_base=True)},
         }
 
@@ -665,14 +738,24 @@ class MetricCollection:
                     m._sync_dist(None, env=env, exclude=tuple(handled[i]))
                     m._is_synced = True
                     synced.append(m)
-            except Exception:
+            except Exception as err:
                 for m in fused_members:
                     if m not in synced and m._cache is not None:
                         m._load_state(m._cache)
                         m._cache = None
                 for m in synced:
                     m.unsync()
-                raise
+                if not resilience.resilience_enabled():
+                    raise
+                # every member's pre-sync state is restored — degrade to the
+                # per-member protocol (each member syncs itself inside its
+                # own compute) instead of surfacing the engine failure
+                resilience.record_degrade("MetricCollection", "sync", err)
+                rank_zero_warn(
+                    f"fused collection sync failed ({type(err).__name__}: {err}); "
+                    "members will sync individually inside compute()"
+                )
+                return
 
             # followers adopt their leader's synced state — zero collectives;
             # their unsync cache is the leader's pre-sync state, which is what
@@ -880,9 +963,14 @@ class MetricCollection:
         destination: Dict[str, Any] = {}
         for name, m in self.items(keep_base=True):
             m.state_dict(destination, prefix=f"{prefix}{name}.")
+        # integrity checksums finalized once over the whole payload (the
+        # member calls pass a shared destination, so they skip their own)
+        resilience.attach_checksums(destination)
         return destination
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+        if not prefix:
+            resilience.verify_checksums(state_dict)
         for name, m in self.items(keep_base=True):
             m.load_state_dict(state_dict, prefix=f"{prefix}{name}.", strict=strict)
 

@@ -57,7 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import telemetry
+from metrics_tpu import faults, telemetry
 from metrics_tpu._compat import profiler_annotation
 from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
 
@@ -181,6 +181,12 @@ class FastDispatcher:
         else:
             call_inputs = flat_inputs
 
+        if faults.any_active():
+            faults.check_oom(
+                sum(int(getattr(x, "nbytes", 0)) for x in call_inputs), self.label
+            )
+            call_inputs = list(faults.maybe_poison(call_inputs))
+
         leaves = self._read_leaves()
         for leaf in leaves:
             if not isinstance(leaf, jax.Array):
@@ -205,6 +211,7 @@ class FastDispatcher:
             compiled = self._compile(key, masked, static, treedef, leaves, call_inputs, static_key)
 
         leaves = self._prepare_donation(leaves)
+        faults.check("launch", self.label)
         t0 = telemetry.clock()
         with profiler_annotation(f"metrics_tpu.{self.label}.update[{self._kind}]"):
             if masked:
@@ -225,6 +232,7 @@ class FastDispatcher:
         )
         self.stats["dispatches"] += 1
 
+        out = faults.maybe_corrupt_leaves(out)
         self._write_leaves(out)
         self._owned = tuple(id(x) for x in out)
 
@@ -257,6 +265,7 @@ class FastDispatcher:
             compiled = self._compile_forward(key, masked, static, treedef, leaves, call_inputs, counts, static_key)
 
         leaves = self._prepare_donation(leaves)
+        faults.check("launch", self.label)
         t0 = time.perf_counter()
         with profiler_annotation(f"metrics_tpu.{self.label}.forward[{self._kind}]"):
             if masked:
@@ -280,6 +289,7 @@ class FastDispatcher:
         self.forward_stats["launches"] += 1
         self.forward_stats["engine_us"] += elapsed_us
 
+        out_leaves = faults.maybe_corrupt_leaves(out_leaves)
         self._write_leaves(out_leaves)
         self._owned = tuple(id(x) for x in out_leaves)
         return batch_val
@@ -340,6 +350,7 @@ class FastDispatcher:
         return cause
 
     def _compile(self, key, masked, static, treedef, example_leaves, example_inputs, static_key=()):
+        faults.check("compile", self.label)
         cause = self._retrace_cause("update", static_key, example_inputs)
         t0 = time.perf_counter()
         if masked:
@@ -380,6 +391,7 @@ class FastDispatcher:
     def _compile_forward(self, key, masked, static, treedef, example_leaves, example_inputs, example_counts, static_key=()):
         """Lower + compile one multi-output forward program
         ``(counts, [n_valid,] leaves, batch) -> (leaves, batch_value)``."""
+        faults.check("compile", self.label)
         cause = self._retrace_cause("forward", static_key, example_inputs)
         t0 = time.perf_counter()
         if masked:

@@ -1,0 +1,265 @@
+"""Fault-injection harness for the resilience engine.
+
+A registry of **named injectable faults** that the execution engines
+(:mod:`metrics_tpu.dispatch`, :mod:`metrics_tpu.forward_engine`,
+:mod:`metrics_tpu.sync_engine`) and the ``ProcessEnv`` collectives probe
+at their failure-prone seams. Chaos tests activate a fault and exercise
+the *real* recovery path — the same snapshot/restore/degrade code that
+runs on a genuine compile error or wedged collective — instead of
+mocking internals.
+
+========================= ==============================================
+fault name                where it fires
+========================= ==============================================
+``compile``               inside ``FastDispatcher._compile*`` — the
+                          lowering/compile step raises
+``launch``                just before a cached executable is invoked —
+                          the launch raises
+``collective``            inside a ``ProcessEnv`` collective body (fires
+                          within the retry loop, so bounded-retry and
+                          degrade-to-local paths are both reachable)
+``nan-input``             engine call inputs are silently poisoned with
+                          NaNs (caught by post-call state verification,
+                          not by an exception at the injection point)
+``state-corruption``      one engine-written state leaf is silently
+                          replaced with a wrong-shape array (caught by
+                          verification); also used by checkpoint tests
+                          to corrupt ``state_dict`` payloads
+``oom``                   engine call whose input payload exceeds the
+                          injected byte cap raises (OOM simulation)
+========================= ==============================================
+
+Activation is per-test via the context manager::
+
+    with faults.inject("compile"):
+        metric(preds, target)      # engine demotes, eager serves the call
+
+or process-wide via ``METRICS_TPU_INJECT_FAULT=<name>[:prob]`` (e.g.
+``compile:0.5``). ``inject(..., count=N)`` makes a **transient** fault:
+it fires N times then goes inert — that is how re-promotion after
+backoff is tested without wall-clock sleeps.
+
+Every probe is designed to be near-free when nothing is injected: one
+dict check plus one ``os.environ`` lookup (parse cached on the raw env
+string).
+"""
+import os
+import random
+import threading
+import zlib
+from contextlib import contextmanager
+from typing import Any, Dict, Generator, List, Optional, Tuple
+
+__all__ = [
+    "InjectedFault",
+    "FAULT_NAMES",
+    "inject",
+    "check",
+    "should_fire",
+    "check_oom",
+    "maybe_poison",
+    "maybe_corrupt_leaves",
+    "corrupt_payload",
+    "any_active",
+    "fired_count",
+]
+
+FAULT_NAMES = ("compile", "launch", "collective", "nan-input", "state-corruption", "oom")
+
+_ENV_VAR = "METRICS_TPU_INJECT_FAULT"
+
+
+class InjectedFault(RuntimeError):
+    """Raised at an injection point when the named fault is active."""
+
+    def __init__(self, name: str, where: str = "") -> None:
+        self.fault_name = name
+        msg = f"injected fault: {name}" + (f" (at {where})" if where else "")
+        super().__init__(msg)
+
+
+class _FaultSpec:
+    """One active fault: name, fire probability, optional remaining-fire
+    count (transient faults go inert at zero), fired tally, free-form
+    params (e.g. ``cap`` bytes for ``oom``)."""
+
+    __slots__ = ("name", "prob", "count", "fired", "params")
+
+    def __init__(self, name: str, prob: float = 1.0, count: Optional[int] = None, **params: Any) -> None:
+        self.name = name
+        self.prob = float(prob)
+        self.count = count
+        self.fired = 0
+        self.params = params
+
+    def take(self) -> bool:
+        """Decide one probe: fire (and consume a count slot) or not."""
+        if self.count is not None and self.count <= 0:
+            return False
+        if self.prob < 1.0 and random.random() >= self.prob:
+            return False
+        if self.count is not None:
+            self.count -= 1
+        self.fired += 1
+        return True
+
+
+_lock = threading.Lock()
+# context-manager-injected specs, innermost last (last one wins per name)
+_specs: List[_FaultSpec] = []
+# env parse cache: (raw env string, parsed spec or None)
+_env_cache: Tuple[Optional[str], Optional[_FaultSpec]] = (None, None)
+
+
+def _env_spec() -> Optional[_FaultSpec]:
+    raw = os.environ.get(_ENV_VAR)
+    if not raw:
+        return None
+    global _env_cache
+    cached_raw, cached_spec = _env_cache
+    if raw == cached_raw:
+        return cached_spec
+    name, _, prob = raw.partition(":")
+    try:
+        spec = _FaultSpec(name.strip(), float(prob) if prob else 1.0)
+    except ValueError:
+        spec = _FaultSpec(name.strip(), 1.0)
+    with _lock:
+        _env_cache = (raw, spec)
+    return spec
+
+
+def _lookup(name: str) -> Optional[_FaultSpec]:
+    # innermost context-manager spec wins over the env var
+    for spec in reversed(_specs):
+        if spec.name == name:
+            return spec
+    env = _env_spec()
+    if env is not None and env.name == name:
+        return env
+    return None
+
+
+@contextmanager
+def inject(
+    name: str, prob: float = 1.0, count: Optional[int] = None, **params: Any
+) -> Generator[_FaultSpec, None, None]:
+    """Activate fault ``name`` for the block. ``count=N`` makes it
+    transient (fires N times, then inert — the spec stays inspectable via
+    ``.fired``). Extra ``params`` reach the fault point (``oom`` reads
+    ``cap`` bytes, ``state-corruption`` reads ``leaf`` index)."""
+    spec = _FaultSpec(name, prob=prob, count=count, **params)
+    with _lock:
+        _specs.append(spec)
+    try:
+        yield spec
+    finally:
+        with _lock:
+            _specs.remove(spec)
+
+
+def any_active() -> bool:
+    """True when any fault is injected (context manager or env var).
+    Verification layers use this to turn on the expensive checks only
+    while chaos is running."""
+    return bool(_specs) or _env_spec() is not None
+
+
+def should_fire(name: str) -> bool:
+    """Non-raising probe: consume one fire slot of ``name`` if active."""
+    if not _specs and _ENV_VAR not in os.environ:
+        return False
+    spec = _lookup(name)
+    return spec is not None and spec.take()
+
+
+def check(name: str, where: str = "") -> None:
+    """Raising probe: raise :class:`InjectedFault` if ``name`` fires."""
+    if should_fire(name):
+        raise InjectedFault(name, where)
+
+
+def fired_count(name: str) -> int:
+    """How many times ``name`` has fired across active specs (tests)."""
+    total = sum(s.fired for s in _specs if s.name == name)
+    env = _env_spec()
+    if env is not None and env.name == name:
+        total += env.fired
+    return total
+
+
+# --------------------------------------------------------- typed fault points
+def check_oom(nbytes: int, where: str = "") -> None:
+    """OOM simulation: raise when an active ``oom`` fault's byte cap
+    (param ``cap``, default 0 = everything overflows) is exceeded."""
+    if not _specs and _ENV_VAR not in os.environ:
+        return
+    spec = _lookup("oom")
+    if spec is None:
+        return
+    cap = int(spec.params.get("cap", 0))
+    if nbytes > cap and spec.take():
+        raise InjectedFault("oom", where or f"payload {nbytes}B > cap {cap}B")
+
+
+def maybe_poison(tree: Any) -> Any:
+    """NaN/Inf input poisoning: when ``nan-input`` fires, every float
+    array leaf in ``tree`` is replaced with NaNs. Silent by design — the
+    fault is meant to be caught by post-call state verification."""
+    if not _specs and _ENV_VAR not in os.environ:
+        return tree
+    if not should_fire("nan-input"):
+        return tree
+    import jax
+    import jax.numpy as jnp
+
+    def poison(leaf: Any) -> Any:
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            return jnp.full_like(leaf, jnp.nan)
+        return leaf
+
+    return jax.tree_util.tree_map(poison, tree)
+
+
+def maybe_corrupt_leaves(leaves: Tuple) -> Tuple:
+    """State-leaf corruption: when ``state-corruption`` fires, one leaf
+    (param ``leaf``, default 0) is silently replaced with a wrong-shape
+    array. Caught by structural state verification, never by the engine
+    call itself."""
+    if not _specs and _ENV_VAR not in os.environ:
+        return leaves
+    if not leaves or not should_fire("state-corruption"):
+        return leaves
+    spec = _lookup("state-corruption")
+    idx = int(spec.params.get("leaf", 0)) % len(leaves) if spec is not None else 0
+    import jax.numpy as jnp
+
+    bad = jnp.full((3, 7), -1.0, dtype=jnp.float32)
+    out = list(leaves)
+    out[idx] = bad
+    return tuple(out)
+
+
+def corrupt_payload(payload: Dict[str, Any], key: Optional[str] = None) -> Dict[str, Any]:
+    """Deterministically corrupt one array entry of a ``state_dict``-style
+    payload (checkpoint chaos tests). Flips bytes in place of the chosen
+    entry so shape/dtype survive but the checksum does not."""
+    import numpy as np
+
+    keys = [
+        k for k, v in payload.items() if hasattr(v, "dtype") and not str(k).startswith("__checksum__")
+    ]
+    if not keys:
+        return payload
+    target = key if key in payload else keys[0]
+    arr = np.asarray(payload[target])
+    raw = bytearray(arr.tobytes())
+    for i in range(min(4, len(raw))):
+        raw[i] ^= 0xFF
+    payload[target] = np.frombuffer(bytes(raw), dtype=arr.dtype).reshape(arr.shape)
+    return payload
+
+
+def crc(data: bytes, seed: int = 0) -> int:
+    """Shared crc32 helper (resilience checksums + tests)."""
+    return zlib.crc32(data, seed) & 0xFFFFFFFF

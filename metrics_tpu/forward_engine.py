@@ -31,8 +31,11 @@ owner's masked-update support. The engine only engages where it is exact:
 * fixed-shape array states only — list states fall back to the eager path;
 * ``dist_sync_on_step=False`` — a per-step sync is a collective the engine
   will not trace through; such metrics keep the eager full-state path;
-* any engine failure demotes the metric to the eager path permanently
-  (same contract as the fast-dispatch update engine).
+* any engine failure degrades the call to the eager path through the
+  unified resilience policy (:mod:`metrics_tpu.resilience`): state is
+  restored from the pre-call snapshot, a cause-tagged ``degrade`` span is
+  emitted, and the engine is retried after an exponential-backoff
+  cooldown (permanent demotion only for structurally-unsupported inputs).
 
 ``METRICS_TPU_FUSED_FORWARD=0`` disables the engine process-wide:
 ``Metric.forward`` falls back to the eager reference-parity branches and
@@ -147,8 +150,9 @@ def metric_forward(metric: Any, args: Tuple, kwargs: Dict) -> Any:
     """Run one ``Metric.forward`` step through the engine; returns the batch
     value. State leaves are written in place by the dispatcher; this driver
     mirrors the eager path's host bookkeeping (update count, memo
-    invalidation). Any exception is the caller's cue to demote the metric
-    to the eager path permanently."""
+    invalidation). Any exception is the caller's cue to roll back to its
+    pre-call snapshot and degrade the call to the eager path (see
+    :mod:`metrics_tpu.resilience`)."""
     from metrics_tpu.metric import _is_static_scalar, _split_static_kwargs
 
     # same static/dynamic partition as the jitted update path: flag kwargs

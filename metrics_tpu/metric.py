@@ -37,8 +37,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import forward_engine, sync_engine, telemetry
-from metrics_tpu.dispatch import fast_dispatch_enabled
+from metrics_tpu import forward_engine, resilience, sync_engine, telemetry
+from metrics_tpu.dispatch import FastDispatchUnsupported, fast_dispatch_enabled
+from metrics_tpu.resilience import StateCorruptionError  # noqa: F401 — re-exported
 from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, default_env
 from metrics_tpu.utilities.data import (
     _flatten,
@@ -213,14 +214,17 @@ class Metric(ABC):
         # None = empty cache; populated lazily as {static-kwarg-key: jitted fn}
         self._jitted_update: Optional[Dict] = None
         # fast-dispatch engine (AOT executable cache); built lazily on the
-        # first jitted update, permanently disabled for this metric on error
+        # first jitted update. Failures route through the resilience policy:
+        # eager serves the call, the engine is benched for an exponential-
+        # backoff cooldown (permanent only for structurally-unsupported
+        # inputs or with METRICS_TPU_RESILIENCE=0) — see metrics_tpu.resilience
         self._dispatcher = None
-        self._fast_dispatch_failed = False
+        self._dispatch_resilience = resilience.ResiliencePolicy()
         self._dispatch_stats: Dict[str, int] = {"dispatches": 0, "retraces": 0}
         # fused forward engine (single-launch update+batch-compute, see
         # metrics_tpu.forward_engine); shares the dispatcher's executable
-        # cache, permanently demoted to the eager forward path on error
-        self._fused_forward_failed = False
+        # cache, same degradation policy as the update path
+        self._forward_resilience = resilience.ResiliencePolicy()
         self._forward_stats: Dict[str, Any] = {"launches": 0, "retraces": 0, "engine_us": 0.0}
         # comms counters for the sync path (see metrics_tpu.telemetry):
         # every collective this metric issues, fused buckets, and wire bytes
@@ -449,21 +453,35 @@ class Metric(ABC):
             self._jit_update_requested
             # per-step sync is a collective the engine won't trace through
             and not self.dist_sync_on_step
-            and not self._fused_forward_failed
-            and not self._fast_dispatch_failed
+            and not self._dispatch_resilience.permanent
             and forward_engine.fused_forward_enabled()
             and fast_dispatch_enabled()
             and not any(isinstance(v, list) for v in self._defaults.values())
+            # resilience gate LAST: allow() burns one cooldown slot
+            and self._forward_resilience.allow()
         ):
+            # transactional step: snapshot-before-engine-call (leaf refs on
+            # CPU — free; copies where donation could invalidate buffers),
+            # restore + degrade to the eager branches below on any fault
+            snap = resilience.snapshot_state(self) if resilience.resilience_enabled() else None
             try:
-                self._forward_cache = forward_engine.metric_forward(self, args, kwargs)
+                batch_val = forward_engine.metric_forward(self, args, kwargs)
+                if snap is not None:
+                    resilience.verify_engine_state(self, snap, where="forward")
+                self._forward_resilience.note_success()
+                self._forward_cache = batch_val
                 return self._forward_cache
-            except Exception as err:  # noqa: BLE001 — any engine failure
-                # demotes to the eager forward path for good
-                self._fused_forward_failed = True
+            except Exception as err:  # noqa: BLE001 — degrade, never escape
+                if snap is not None:
+                    resilience.restore_state(self, snap)
+                self._forward_resilience.note_failure(
+                    resilience.classify(err), permanent=isinstance(err, FastDispatchUnsupported)
+                )
+                resilience.record_degrade(type(self).__name__, "forward", err, self._forward_resilience)
                 rank_zero_debug(
-                    f"fused forward disabled for {type(self).__name__}"
-                    f" ({type(err).__name__}: {err}); using the eager path."
+                    f"fused forward degraded for {type(self).__name__}"
+                    f" ({type(err).__name__}: {err}); serving this call eagerly"
+                    f" (cooldown {self._forward_resilience.cooldown} calls)."
                 )
         if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
             self._forward_cache = self._forward_full_state_update(*args, **kwargs)
@@ -592,19 +610,41 @@ class Metric(ABC):
                     else:
                         static, dynamic, key = {}, kwargs, ()
                     dispatched = False
-                    if not self._fast_dispatch_failed and fast_dispatch_enabled():
+                    if fast_dispatch_enabled() and self._dispatch_resilience.allow():
+                        # counters already advanced above and the jit fallback
+                        # below serves the same call, so the snapshot covers
+                        # state leaves only
+                        snap = (
+                            resilience.snapshot_state(self, counters=False)
+                            if resilience.resilience_enabled()
+                            else None
+                        )
                         try:
                             if self._dispatcher is None:
                                 self._dispatcher = self._make_dispatcher()
                             self._dispatcher.update(static, key, args, dynamic)
+                            if snap is not None:
+                                resilience.verify_engine_state(self, snap, where="update")
+                            self._dispatch_resilience.note_success()
                             dispatched = True
-                        except Exception as err:  # noqa: BLE001 — any engine
-                            # failure demotes to the legacy jit path for good
-                            self._fast_dispatch_failed = True
-                            self._dispatcher = None
+                        except Exception as err:  # noqa: BLE001 — degrade to
+                            # the legacy jit path (backoff; permanent only for
+                            # structurally-unsupported inputs)
+                            if snap is not None:
+                                resilience.restore_state(self, snap)
+                            permanent = isinstance(err, FastDispatchUnsupported)
+                            self._dispatch_resilience.note_failure(
+                                resilience.classify(err), permanent=permanent
+                            )
+                            resilience.record_degrade(
+                                type(self).__name__, "dispatch", err, self._dispatch_resilience
+                            )
+                            if self._dispatch_resilience.permanent:
+                                self._dispatcher = None
                             rank_zero_debug(
-                                f"fast dispatch disabled for {type(self).__name__}"
-                                f" ({type(err).__name__}: {err}); using jax.jit."
+                                f"fast dispatch degraded for {type(self).__name__}"
+                                f" ({type(err).__name__}: {err}); using jax.jit"
+                                f" (cooldown {self._dispatch_resilience.cooldown} calls)."
                             )
                     if not dispatched:
                         if self._jitted_update is None:
@@ -699,15 +739,23 @@ class Metric(ABC):
     @property
     def dispatch_stats(self) -> Dict[str, int]:
         """Hot-path counters for this metric: device-program ``dispatches``
-        and compile-time ``retraces`` (see :mod:`metrics_tpu.telemetry`)."""
-        return dict(self._dispatch_stats)
+        and compile-time ``retraces`` (see :mod:`metrics_tpu.telemetry`),
+        plus the resilience policy's degradation state (``demotions`` /
+        ``repromotions`` / ``cooldown`` / ``permanent`` / ``last_cause``)."""
+        stats: Dict[str, Any] = dict(self._dispatch_stats)
+        stats.update(self._dispatch_resilience.stats())
+        return stats
 
     @property
     def forward_stats(self) -> Dict[str, Any]:
         """Step-path counters for this metric: fused-forward engine
         ``launches``, forward-program ``retraces``, and cumulative
-        host-side ``engine_us`` (see :mod:`metrics_tpu.telemetry`)."""
-        return dict(self._forward_stats)
+        host-side ``engine_us`` (see :mod:`metrics_tpu.telemetry`), plus
+        the resilience policy's degradation state (``demotions`` /
+        ``repromotions`` / ``cooldown`` / ``permanent`` / ``last_cause``)."""
+        stats: Dict[str, Any] = dict(self._forward_stats)
+        stats.update(self._forward_resilience.stats())
+        return stats
 
     @property
     def sync_stats(self) -> Dict[str, int]:
@@ -723,9 +771,13 @@ class Metric(ABC):
         launches/retraces/µs — see ``docs/observability.md``)."""
         return {
             "owner": type(self).__name__,
-            "dispatch": dict(self._dispatch_stats),
+            "dispatch": self.dispatch_stats,
             "sync": dict(self._sync_stats),
-            "forward": dict(self._forward_stats),
+            "forward": self.forward_stats,
+            "resilience": {
+                "dispatch": self._dispatch_resilience.stats(),
+                "forward": self._forward_resilience.stats(),
+            },
         }
 
     def _move_list_states_to_cpu(self) -> None:
@@ -886,14 +938,25 @@ class Metric(ABC):
         # ragged gathers, so the collective ORDER stays identical on every
         # participant.
         if dist_sync_fn is None and will_communicate and sync_engine.fused_sync_enabled():
-            specs = sync_engine.plan_metric_leaves(self, input_dict)
-            if specs:
-                fused = sync_engine.execute_buckets(
-                    env, specs, owner=type(self).__name__, stats=self._sync_stats
+            try:
+                specs = sync_engine.plan_metric_leaves(self, input_dict)
+                if specs:
+                    fused = sync_engine.execute_buckets(
+                        env, specs, owner=type(self).__name__, stats=self._sync_stats
+                    )
+                    for attr, val in fused.items():
+                        object.__setattr__(self, attr, val)
+                        del input_dict[attr]
+            except Exception as err:  # noqa: BLE001 — degrade to the per-leaf
+                # protocol below (input_dict still holds every unfused leaf;
+                # nothing was written unless the whole bucket pass succeeded)
+                if not resilience.resilience_enabled():
+                    raise
+                resilience.record_degrade(type(self).__name__, "sync", err)
+                rank_zero_warn(
+                    f"fused sync engine failed for {type(self).__name__} "
+                    f"({type(err).__name__}: {err}); syncing per-leaf instead"
                 )
-                for attr, val in fused.items():
-                    object.__setattr__(self, attr, val)
-                    del input_dict[attr]
 
         lengths_cache: Dict[str, Any] = {}
         for attr in ragged_attrs:
@@ -1208,12 +1271,12 @@ class Metric(ABC):
         self._jitted_update = None
         self._dispatcher = None
         self._dispatch_stats = dict(self.__dict__.get("_dispatch_stats") or {"dispatches": 0, "retraces": 0})
-        self._fast_dispatch_failed = bool(self.__dict__.get("_fast_dispatch_failed", False))
+        self._dispatch_resilience = self.__dict__.get("_dispatch_resilience") or resilience.ResiliencePolicy()
         self._sync_stats = dict(self.__dict__.get("_sync_stats") or {"collectives": 0, "buckets": 0, "bytes_on_wire": 0})
         self._forward_stats = dict(
             self.__dict__.get("_forward_stats") or {"launches": 0, "retraces": 0, "engine_us": 0.0}
         )
-        self._fused_forward_failed = bool(self.__dict__.get("_fused_forward_failed", False))
+        self._forward_resilience = self.__dict__.get("_forward_resilience") or resilience.ResiliencePolicy()
 
     def __setattr__(self, name: str, value: Any) -> None:
         if name in ("higher_is_better", "is_differentiable", "full_state_update"):
@@ -1326,7 +1389,15 @@ class Metric(ABC):
             self._persistent[key] = mode
 
     def state_dict(self, destination: Optional[Dict] = None, prefix: str = "") -> Dict[str, Any]:
-        """Serializable (numpy) snapshot of persistent states (ref metric.py:535-553)."""
+        """Serializable (numpy) snapshot of persistent states (ref metric.py:535-553).
+
+        The finished payload carries flat ``__checksum__::<key>`` string
+        entries (crc32 over bytes + shape + dtype, added once at the top
+        level of the recursion) that :meth:`load_state_dict` verifies —
+        a corrupted checkpoint raises
+        :class:`~metrics_tpu.resilience.StateCorruptionError` instead of
+        exploding shapes deep inside restore."""
+        top_level = destination is None
         destination = {} if destination is None else destination
         for key in self._defaults:
             if not self._persistent[key]:
@@ -1342,10 +1413,20 @@ class Metric(ABC):
                 destination[f"{prefix}aux:{name}"] = value.value if isinstance(value, Enum) else value
         for name, child in self._children():
             child.state_dict(destination, prefix=f"{prefix}{name}.")
+        if top_level:
+            resilience.attach_checksums(destination)
         return destination
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
-        """Restore states from :meth:`state_dict` (ref metric.py:555-573)."""
+        """Restore states from :meth:`state_dict` (ref metric.py:555-573).
+
+        Payloads carrying ``__checksum__::<key>`` entries are verified
+        before any state is touched; a mismatch raises
+        :class:`~metrics_tpu.resilience.StateCorruptionError` naming the
+        corrupted key. Checksum-free payloads (older checkpoints) load
+        unverified."""
+        if not prefix:
+            resilience.verify_checksums(state_dict)
         for key in self._defaults:
             name = prefix + key
             if name in state_dict:

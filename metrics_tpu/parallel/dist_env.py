@@ -123,7 +123,18 @@ class AxisEnv(DistEnv):
 
 
 class ProcessEnv(DistEnv):
-    """Host-level multi-process gather (multi-host TPU pods over DCN)."""
+    """Host-level multi-process gather (multi-host TPU pods over DCN).
+
+    Every collective body runs under the resilience engine's
+    :func:`~metrics_tpu.resilience.run_collective` harness: bounded
+    retries (``METRICS_TPU_COLLECTIVE_RETRIES``, optionally each under a
+    ``METRICS_TPU_COLLECTIVE_TIMEOUT_S`` wall-clock deadline), then
+    degrade to **local-only** state with a cause-tagged ``degrade`` span
+    and a user-facing warning — a wedged or partially-failed DCN
+    collective costs this sync its cross-process view instead of hanging
+    the process. :class:`AxisEnv` collectives are traced into the
+    surrounding XLA program and cannot be retried host-side.
+    """
 
     def __init__(self) -> None:
         self._world = jax.process_count()
@@ -134,17 +145,25 @@ class ProcessEnv(DistEnv):
     def all_gather(self, x: Array) -> List[Array]:
         from jax.experimental import multihost_utils
 
+        from metrics_tpu.resilience import run_collective
+
         x = jnp.atleast_1d(x)
-        # Exchange leading-dim sizes, pad to max, gather, trim — the same
-        # algorithm as ref distributed.py:139-151, expressed host-side.
-        local_size = np.asarray([x.shape[0]])
-        all_sizes = np.asarray(multihost_utils.process_allgather(local_size)).reshape(-1)
-        max_size = int(all_sizes.max())
-        if x.shape[0] != max_size:
-            pad = [(0, max_size - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
-            x = jnp.pad(x, pad)
-        gathered = multihost_utils.process_allgather(x)  # (world, max, ...)
-        return [jnp.asarray(gathered[i][: int(all_sizes[i])]) for i in range(self._world)]
+
+        def attempt() -> List[Array]:
+            # Exchange leading-dim sizes, pad to max, gather, trim — the same
+            # algorithm as ref distributed.py:139-151, expressed host-side.
+            local_size = np.asarray([x.shape[0]])
+            all_sizes = np.asarray(multihost_utils.process_allgather(local_size)).reshape(-1)
+            max_size = int(all_sizes.max())
+            padded = x
+            if x.shape[0] != max_size:
+                pad = [(0, max_size - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+                padded = jnp.pad(x, pad)
+            gathered = multihost_utils.process_allgather(padded)  # (world, max, ...)
+            return [jnp.asarray(gathered[i][: int(all_sizes[i])]) for i in range(self._world)]
+
+        # local-only degradation = world-size-1 semantics for this leaf
+        return run_collective(attempt, lambda: [x], "ProcessEnv", "all_gather")
 
     def all_gather_uniform(self, x: Array) -> List[Array]:
         """Uniform-shape gather: ONE ``process_allgather``, no size exchange.
@@ -155,9 +174,15 @@ class ProcessEnv(DistEnv):
         """
         from jax.experimental import multihost_utils
 
+        from metrics_tpu.resilience import run_collective
+
         x = jnp.atleast_1d(x)
-        gathered = multihost_utils.process_allgather(x)  # (world, ...)
-        return [jnp.asarray(gathered[i]) for i in range(self._world)]
+
+        def attempt() -> List[Array]:
+            gathered = multihost_utils.process_allgather(x)  # (world, ...)
+            return [jnp.asarray(gathered[i]) for i in range(self._world)]
+
+        return run_collective(attempt, lambda: [x], "ProcessEnv", "all_gather_uniform")
 
     def all_reduce(self, x: Array, op: str) -> Optional[Array]:
         """Host-level reduction in ONE ``process_allgather`` + local reduce.
@@ -171,12 +196,21 @@ class ProcessEnv(DistEnv):
         """
         from jax.experimental import multihost_utils
 
+        from metrics_tpu.resilience import run_collective
+
         reducer = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}.get(op)
         if reducer is None:
             return None
         x = jnp.atleast_1d(x)
-        gathered = multihost_utils.process_allgather(x)  # (world, ...)
-        return reducer(jnp.asarray(gathered), axis=0)
+
+        def attempt() -> Array:
+            gathered = multihost_utils.process_allgather(x)  # (world, ...)
+            return reducer(jnp.asarray(gathered), axis=0)
+
+        # local-only degradation: reduce over this process's contribution
+        return run_collective(
+            attempt, lambda: reducer(jnp.asarray(x[None]), axis=0), "ProcessEnv", f"all_reduce[{op}]"
+        )
 
 
 def default_env() -> DistEnv:

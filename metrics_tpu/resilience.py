@@ -1,0 +1,424 @@
+"""Resilience engine: unified graceful degradation + verified state recovery.
+
+Before this module each engine improvised its own failure story: the
+fused forward engine silently and *permanently* demoted a metric on any
+exception, fast dispatch did the same on its own flag, and nothing
+guaranteed metric state survived a mid-update fault uncorrupted. This is
+the single policy they all route through now:
+
+* **Graceful degradation.** Every engine call site holds a
+  :class:`ResiliencePolicy`. On failure the call is served by the
+  eager/legacy path (the failure never escapes to the caller when eager
+  can serve it), a cause-tagged ``degrade`` span lands on the
+  :mod:`metrics_tpu.telemetry` stream, and the engine is benched for an
+  **exponential-backoff cooldown** (``base * 2^(failures-1)`` calls,
+  capped) instead of forever. A success after the cooldown re-promotes;
+  structurally-unsupported shapes (``FastDispatchUnsupported``) stay
+  permanent because retrying cannot help.
+* **Verified state recovery.** Engine-eligible paths snapshot the
+  pre-flattened state leaves before the engine call (by reference on
+  CPU where donation is off — near-free; real copies where donation
+  could alias) and restore them on fault, so a half-applied engine call
+  can never leave corrupt state behind. After the call, state is
+  verified structurally (shape/dtype vs the snapshot) and — while fault
+  injection is active or ``METRICS_TPU_VERIFY_STATE=1`` — numerically
+  (finiteness), so silently-poisoned results are caught and rolled back.
+* **Checkpoint checksums.** ``state_dict()`` payloads carry flat
+  ``__checksum__::<key>`` entries (crc32 over bytes + shape + dtype);
+  ``load_state_dict`` verifies them and raises
+  :class:`StateCorruptionError` naming the corrupted key, instead of a
+  shape explosion three layers into restore.
+* **Collective retry.** ``ProcessEnv`` collectives run under
+  :func:`run_collective` — bounded retries (optionally under a
+  thread-based timeout), then degrade to **local-only** state with a
+  telemetry warning rather than a hang.
+
+Env knobs (see ``docs/reliability.md``):
+
+=============================== ========================================
+``METRICS_TPU_RESILIENCE=0``    restore legacy behavior: permanent
+                                demotion, no snapshots, no verification
+``METRICS_TPU_VERIFY_STATE=1``  force numeric (finiteness) verification
+                                even without injected faults
+``METRICS_TPU_BACKOFF_BASE``    first cooldown length in calls (def. 4)
+``METRICS_TPU_BACKOFF_MAX``     cooldown cap in calls (default 256)
+``METRICS_TPU_COLLECTIVE_RETRIES``  retry budget per collective (def. 2)
+``METRICS_TPU_COLLECTIVE_TIMEOUT_S`` per-attempt timeout (default none)
+=============================== ========================================
+"""
+import os
+import threading
+import zlib
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from metrics_tpu import faults, telemetry
+
+__all__ = [
+    "StateCorruptionError",
+    "ResiliencePolicy",
+    "resilience_enabled",
+    "verification_enabled",
+    "classify",
+    "record_degrade",
+    "snapshot_state",
+    "restore_state",
+    "verify_engine_state",
+    "attach_checksums",
+    "verify_checksums",
+    "strip_checksums",
+    "run_collective",
+]
+
+CHECKSUM_PREFIX = "__checksum__::"
+
+
+class StateCorruptionError(RuntimeError):
+    """A checkpoint payload or restored state failed integrity checks."""
+
+
+def resilience_enabled() -> bool:
+    """Engine kill switch (env ``METRICS_TPU_RESILIENCE``, default on).
+    Off restores the legacy posture: permanent demotion on first engine
+    failure, no snapshot/restore, no verification — the bench baseline
+    for the idle-cost pin."""
+    return os.environ.get("METRICS_TPU_RESILIENCE", "1").strip().lower() not in ("0", "false", "off")
+
+
+def verification_enabled() -> bool:
+    """Numeric (finiteness) state verification: forced by
+    ``METRICS_TPU_VERIFY_STATE=1``, suppressed by ``=0``, and otherwise
+    on exactly while fault injection is active (chaos runs pay for the
+    checks; production idle paths don't)."""
+    raw = os.environ.get("METRICS_TPU_VERIFY_STATE")
+    if raw is not None:
+        return raw.strip().lower() not in ("0", "false", "off", "")
+    return faults.any_active()
+
+
+def _backoff_base() -> int:
+    try:
+        return max(1, int(os.environ.get("METRICS_TPU_BACKOFF_BASE", "4")))
+    except ValueError:
+        return 4
+
+
+def _backoff_max() -> int:
+    try:
+        return max(1, int(os.environ.get("METRICS_TPU_BACKOFF_MAX", "256")))
+    except ValueError:
+        return 256
+
+
+class ResiliencePolicy:
+    """Per-owner (metric/collection/engine) degradation state machine.
+
+    The unit of time is an *engine-eligible call*: while ``cooldown > 0``
+    each :meth:`allow` tick decrements it and routes the call to the
+    eager path; at zero the next call retries the engine. Consecutive
+    failures double the cooldown (``base * 2^(failures-1)``, capped at
+    ``METRICS_TPU_BACKOFF_MAX``); a success resets the clock and counts
+    a re-promotion. Plain attributes only — instances pickle with the
+    metric."""
+
+    __slots__ = ("failures", "cooldown", "demotions", "repromotions", "last_cause", "permanent")
+
+    def __init__(self) -> None:
+        self.failures = 0
+        self.cooldown = 0
+        self.demotions = 0
+        self.repromotions = 0
+        self.last_cause: Optional[str] = None
+        self.permanent = False
+
+    # ------------------------------------------------------------- decisions
+    def allow(self) -> bool:
+        """Mutating tick: may this call use the engine? ``False`` burns
+        one cooldown slot."""
+        if self.permanent:
+            return False
+        if self.cooldown > 0:
+            self.cooldown -= 1
+            return False
+        return True
+
+    @property
+    def blocked(self) -> bool:
+        """Non-mutating view of :meth:`allow` (stats/introspection)."""
+        return self.permanent or self.cooldown > 0
+
+    # ------------------------------------------------------------ transitions
+    def note_failure(self, cause: str, permanent: bool = False) -> int:
+        """Record one engine failure; returns the new cooldown length."""
+        self.failures += 1
+        self.demotions += 1
+        self.last_cause = cause
+        if permanent or not resilience_enabled():
+            self.permanent = True
+            self.cooldown = 0
+            return 0
+        self.cooldown = min(_backoff_base() << (self.failures - 1), _backoff_max())
+        return self.cooldown
+
+    def note_success(self) -> None:
+        """Engine call (incl. post-call verification) succeeded: reset the
+        backoff clock; if we were in a failure streak, that's a re-promotion."""
+        if self.failures:
+            self.repromotions += 1
+        self.failures = 0
+        self.cooldown = 0
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "demotions": self.demotions,
+            "repromotions": self.repromotions,
+            "cooldown": self.cooldown,
+            "permanent": self.permanent,
+            "last_cause": self.last_cause,
+        }
+
+
+def classify(err: BaseException) -> str:
+    """Cause tag for an engine failure (mirrors compile-cause attribution)."""
+    if isinstance(err, faults.InjectedFault):
+        return f"injected:{err.fault_name}"
+    if isinstance(err, StateCorruptionError):
+        return "state-corruption"
+    # by-name check avoids importing dispatch here (metric.py imports both)
+    if type(err).__name__ == "FastDispatchUnsupported":
+        return "unsupported"
+    return type(err).__name__
+
+
+def record_degrade(
+    owner: str,
+    engine: str,
+    err: BaseException,
+    policy: Optional[ResiliencePolicy] = None,
+    **attrs: Any,
+) -> str:
+    """Emit the cause-tagged ``degrade`` span for one demotion; returns
+    the cause tag. ``engine`` is the span kind (``forward``/``dispatch``/
+    ``fused``/``collective``)."""
+    cause = classify(err)
+    if policy is not None:
+        attrs.setdefault("cooldown", policy.cooldown)
+        attrs.setdefault("permanent", policy.permanent)
+        attrs.setdefault("failures", policy.failures)
+    telemetry.emit("degrade", owner, kind=engine, cause=cause, error=str(err)[:200], **attrs)
+    return cause
+
+
+# ------------------------------------------------------------ state snapshots
+def _array_leaf_names(metric: Any) -> Tuple[str, ...]:
+    return tuple(k for k in metric._defaults if not isinstance(getattr(metric, k), list))
+
+
+def snapshot_state(metric: Any, counters: bool = True) -> Dict[str, Any]:
+    """Transactional snapshot of a metric's engine-visible state, taken
+    just before an engine call. On CPU (donation off) jax arrays are
+    immutable and never aliased by the engine, so holding references is
+    free; where donation is enabled the engine may invalidate the input
+    buffers, so we materialize copies."""
+    from metrics_tpu.dispatch import _donation_enabled
+
+    copy = _donation_enabled()
+    leaves: Dict[str, Any] = {}
+    for name in _array_leaf_names(metric):
+        leaf = getattr(metric, name)
+        if copy and hasattr(leaf, "dtype"):
+            import jax.numpy as jnp
+
+            leaf = jnp.array(leaf)
+        leaves[name] = leaf
+    snap: Dict[str, Any] = {"leaves": leaves}
+    if counters:
+        snap["update_count"] = metric._update_count
+        snap["computed"] = metric._computed
+    return snap
+
+
+def restore_state(metric: Any, snap: Dict[str, Any]) -> None:
+    """Roll the metric back to a :func:`snapshot_state` snapshot."""
+    for name, leaf in snap["leaves"].items():
+        setattr(metric, name, leaf)
+    if "update_count" in snap:
+        metric._update_count = snap["update_count"]
+        metric._computed = snap["computed"]
+
+
+def verify_engine_state(metric: Any, snap: Dict[str, Any], where: str = "") -> None:
+    """Post-engine-call integrity check against the pre-call snapshot.
+
+    Structural (shape/dtype must match what the engine was supposed to
+    write back) always; numeric (all-finite, catching NaN-poisoned
+    inputs that flowed into integer-free float state) only when
+    :func:`verification_enabled`. Raises :class:`StateCorruptionError`.
+    """
+    check_values = verification_enabled()
+    for name, before in snap["leaves"].items():
+        after = getattr(metric, name)
+        if not hasattr(before, "shape") or not hasattr(after, "shape"):
+            continue
+        if tuple(after.shape) != tuple(before.shape) or after.dtype != before.dtype:
+            raise StateCorruptionError(
+                f"engine call left state leaf '{name}' with shape {tuple(getattr(after, 'shape', ()))} "
+                f"dtype {getattr(after, 'dtype', '?')} (expected {tuple(before.shape)} {before.dtype})"
+                + (f" at {where}" if where else "")
+            )
+        if check_values:
+            import jax.numpy as jnp
+            import numpy as np
+
+            if jnp.issubdtype(after.dtype, jnp.floating) and not bool(np.all(np.isfinite(np.asarray(after)))):
+                raise StateCorruptionError(
+                    f"engine call left non-finite values in state leaf '{name}'"
+                    + (f" at {where}" if where else "")
+                )
+
+
+# --------------------------------------------------------- checkpoint checksums
+def _leaf_checksum(value: Any) -> Optional[str]:
+    import numpy as np
+
+    if isinstance(value, str) or not hasattr(value, "dtype"):
+        return None
+    arr = np.asarray(value)
+    crc = zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+    return f"crc32:{crc:08x}:{'x'.join(str(d) for d in arr.shape)}:{arr.dtype}"
+
+
+def attach_checksums(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Add flat ``__checksum__::<key>`` string entries for every array
+    entry of a ``state_dict`` payload (flat strings survive orbax/np
+    serialization unchanged; a nested dict would not round-trip)."""
+    sums = {}
+    for key, value in payload.items():
+        if str(key).startswith(CHECKSUM_PREFIX):
+            continue
+        digest = _leaf_checksum(value)
+        if digest is not None:
+            sums[f"{CHECKSUM_PREFIX}{key}"] = digest
+    payload.update(sums)
+    return payload
+
+
+def strip_checksums(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Copy of ``payload`` without checksum entries."""
+    return {k: v for k, v in payload.items() if not str(k).startswith(CHECKSUM_PREFIX)}
+
+
+def verify_checksums(payload: Dict[str, Any]) -> None:
+    """Verify every ``__checksum__::<key>`` entry; raise
+    :class:`StateCorruptionError` naming the first corrupted key.
+    Payloads without checksum entries (older checkpoints) pass."""
+    for key, expected in payload.items():
+        key = str(key)
+        if not key.startswith(CHECKSUM_PREFIX):
+            continue
+        target = key[len(CHECKSUM_PREFIX):]
+        if target not in payload:
+            raise StateCorruptionError(
+                f"checkpoint payload has a checksum for '{target}' but no such entry"
+            )
+        actual = _leaf_checksum(payload[target])
+        expected = expected if isinstance(expected, str) else str(expected)
+        if actual is not None and actual != expected:
+            raise StateCorruptionError(
+                f"checkpoint state entry '{target}' failed its integrity check "
+                f"(stored {expected}, restored payload hashes to {actual}); "
+                "the checkpoint is corrupt — refusing to load it into live metric state"
+            )
+
+
+# ------------------------------------------------------------ collective retry
+def _collective_retries() -> int:
+    try:
+        return max(0, int(os.environ.get("METRICS_TPU_COLLECTIVE_RETRIES", "2")))
+    except ValueError:
+        return 2
+
+
+def _collective_timeout() -> Optional[float]:
+    raw = os.environ.get("METRICS_TPU_COLLECTIVE_TIMEOUT_S")
+    if not raw:
+        return None
+    try:
+        timeout = float(raw)
+    except ValueError:
+        return None
+    return timeout if timeout > 0 else None
+
+
+class _CollectiveTimeout(RuntimeError):
+    pass
+
+
+def _call_with_timeout(fn: Callable[[], Any], timeout: Optional[float], desc: str) -> Any:
+    """Run ``fn`` under an optional wall-clock deadline. The timeout path
+    uses a worker thread — the wedged collective can't be killed, but the
+    caller is unblocked and degrades instead of hanging the process."""
+    if timeout is None:
+        return fn()
+    result: Dict[str, Any] = {}
+
+    def worker() -> None:
+        try:
+            result["value"] = fn()
+        except BaseException as err:  # noqa: BLE001 - re-raised on the caller thread
+            result["error"] = err
+
+    thread = threading.Thread(target=worker, name=f"metrics-tpu-collective-{desc}", daemon=True)
+    thread.start()
+    thread.join(timeout)
+    if thread.is_alive():
+        raise _CollectiveTimeout(f"collective '{desc}' exceeded {timeout}s")
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+def run_collective(
+    attempt: Callable[[], Any],
+    fallback: Callable[[], Any],
+    owner: str,
+    desc: str,
+) -> Any:
+    """Bounded-retry harness for one ``ProcessEnv`` collective.
+
+    ``attempt`` runs up to ``1 + METRICS_TPU_COLLECTIVE_RETRIES`` times
+    (each under ``METRICS_TPU_COLLECTIVE_TIMEOUT_S`` when set, and each
+    probing the ``collective`` injection point, so chaos tests reach both
+    the retry-then-succeed and the exhausted paths). On exhaustion a
+    ``degrade`` span + user-facing warning are emitted and ``fallback``
+    (local-only, world-size-1 semantics) serves the call — partial data
+    beats a hang, and state stays valid for a later successful sync."""
+    retries = _collective_retries() if resilience_enabled() else 0
+    timeout = _collective_timeout()
+    last_err: Optional[BaseException] = None
+    for attempt_idx in range(1 + retries):
+
+        def guarded() -> Any:
+            faults.check("collective", desc)
+            return attempt()
+
+        try:
+            result = _call_with_timeout(guarded, timeout, desc)
+            if attempt_idx:
+                telemetry.emit("degrade", owner, kind="collective", cause="recovered", retries=attempt_idx, op=desc)
+            return result
+        except BaseException as err:  # noqa: BLE001 - degrade, never hang or crash the sync
+            last_err = err
+    assert last_err is not None
+    cause = classify(last_err)
+    telemetry.emit(
+        "degrade", owner, kind="collective", cause=cause,
+        error=str(last_err)[:200], retries=retries, op=desc, local_only=True,
+    )
+    from metrics_tpu.utilities.prints import rank_zero_warn
+
+    rank_zero_warn(
+        f"collective '{desc}' failed after {1 + retries} attempt(s) ({cause}); "
+        "degrading to local-only state for this sync — cross-process results "
+        "will reflect this process only until a later sync succeeds"
+    )
+    return fallback()

@@ -23,6 +23,12 @@ compile    one compilation, tagged with WHY it happened (``cause`` attr:
            / ``unattributed``)
 collective one interconnect launch (kinds ``fused``/``gather``/
            ``reduce``), with payload ``nbytes`` in the attrs
+degrade    one resilience-engine demotion (kinds ``forward`` /
+           ``dispatch`` / ``fused`` / ``collective``), tagged with WHY
+           (``cause`` attr: ``injected:<fault>`` / ``unsupported`` /
+           ``state-corruption`` / the exception type name /
+           ``recovered`` for a retry that then succeeded) plus the
+           backoff cooldown — see :mod:`metrics_tpu.resilience`
 ========== ============================================================
 
 Events carry the owner (metric class name or ``MetricCollection``), a
@@ -173,6 +179,9 @@ def emit(
         elif name == "compile":
             cause = attrs.get("cause", "unattributed")
             _counters[f"compile:cause:{cause}"] = _counters.get(f"compile:cause:{cause}", 0) + 1
+        elif name == "degrade":
+            cause = attrs.get("cause", "unattributed")
+            _counters[f"degrade:cause:{cause}"] = _counters.get(f"degrade:cause:{cause}", 0) + 1
     if not subs:
         return
     now = time.perf_counter()
@@ -200,7 +209,8 @@ def span(name: str, owner: str, kind: str = "", **attrs: Any) -> Generator[None,
 # ----------------------------------------------------------------- counters
 def snapshot() -> Dict[str, float]:
     """Copy of the process-level counters (``"<name>:<kind>"`` keys, plus
-    ``"collective:bytes"`` and ``"compile:cause:<cause>"``)."""
+    ``"collective:bytes"``, ``"compile:cause:<cause>"`` and
+    ``"degrade:cause:<cause>"``)."""
     with _lock:
         return dict(_counters)
 

@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 20
+    assert len(skipped) == 21
     assert "detail_elapsed_s" in detail
 
 
@@ -207,6 +207,21 @@ def test_telemetry_overhead_config_counts_and_keys(monkeypatch):
     # the config must restore the kill switch it toggles
     assert os.environ.get("METRICS_TPU_TELEMETRY") is None or (
         os.environ["METRICS_TPU_TELEMETRY"] != "0")
+
+
+def test_resilience_overhead_config_counts_and_keys(monkeypatch):
+    """Pin the resilience-overhead bench config: 'the resilience engine is
+    near-free when nothing faults' — the on/off ratio key must exist and
+    stay near 1 (lenient bound for CI noise), and the config must restore
+    the kill switch it toggles."""
+    monkeypatch.delenv("METRICS_TPU_RESILIENCE", raising=False)
+    detail = {}
+    bench._cfg_resilience_overhead(detail)
+    assert detail["resilience_off_forward_us"] > 0
+    assert detail["resilience_on_forward_us"] > 0
+    assert 0 < detail["resilience_idle_overhead_ratio"] < 2.0
+    assert os.environ.get("METRICS_TPU_RESILIENCE") is None or (
+        os.environ["METRICS_TPU_RESILIENCE"] != "0")
 
 
 def test_cg_configs_record_host_pinning():

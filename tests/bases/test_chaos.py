@@ -1,0 +1,310 @@
+"""Chaos suite for the resilience engine (metrics_tpu/resilience.py +
+metrics_tpu/faults.py).
+
+Every injectable fault class — compile, launch, oom, NaN-poisoned inputs,
+state-leaf corruption, collective failure — is forced on through the REAL
+injection points inside the engines, and each scenario must end with:
+
+1. the call served by the eager/legacy path **bit-identical** to a
+   never-faulted run (the failure never escapes to the caller),
+2. metric state verified uncorrupted after recovery (right shape/dtype,
+   finite, exact values), and
+3. a cause-tagged ``degrade`` span on the telemetry stream.
+
+Re-promotion after a transient fault is pinned **structurally** — via the
+documented call-count backoff schedule and the launch/demotion counters —
+never with wall-clock sleeps.
+"""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import faults, resilience, telemetry
+from metrics_tpu.metric import Metric
+from metrics_tpu.parallel.dist_env import NoOpEnv
+
+pytestmark = pytest.mark.chaos
+
+
+class FloatSum(Metric):
+    """Minimal engine-eligible metric with a FLOAT state leaf: NaN-poisoned
+    inputs flow straight into the state, so numeric verification can see
+    them (an integer-state metric would launder NaNs into finite garbage)."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+
+    def compute(self):
+        return self.total
+
+
+class Loopback2(NoOpEnv):
+    """2-rank loopback env (same idiom as test_fused_sync.Loopback2)."""
+
+    def world_size(self):
+        return 2
+
+    def all_gather(self, x):
+        x = jnp.atleast_1d(x)
+        return [x, x]
+
+    def all_reduce(self, x, op):
+        stacked = jnp.stack([jnp.atleast_1d(x)] * 2)
+        return {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}[op](stacked, axis=0)
+
+
+def _batches(n=3, size=8):
+    rng = np.random.RandomState(11)
+    return [jnp.asarray(rng.rand(size).astype(np.float32)) for _ in range(n)]
+
+
+# the degrade-span cause each fault class must be attributed to: raising
+# faults carry their injection tag; silent faults (poisoned inputs,
+# corrupted leaves) are caught by post-call state verification instead
+EXPECTED_CAUSE = {
+    "compile": "injected:compile",
+    "launch": "injected:launch",
+    "oom": "injected:oom",
+    "nan-input": "state-corruption",
+    "state-corruption": "state-corruption",
+}
+
+
+# ------------------------------------------------------------- update path
+@pytest.mark.parametrize("fault", sorted(EXPECTED_CAUSE))
+def test_update_fault_degrades_to_eager_parity(fault):
+    batches = _batches()
+    ref = FloatSum()
+    for v in batches:
+        ref.update(v)
+
+    m = FloatSum(jit_update=True)
+    with telemetry.instrument() as t, faults.inject(fault) as spec:
+        for v in batches:
+            m.update(v)
+    assert spec.fired >= 1, "fault never reached its injection point"
+
+    # (1) every call was served — bit-identical to the never-faulted run
+    np.testing.assert_array_equal(np.asarray(m.total), np.asarray(ref.total))
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+    # (2) state verified uncorrupted after recovery
+    assert tuple(m.total.shape) == tuple(ref.total.shape)
+    assert m.total.dtype == ref.total.dtype
+    assert bool(np.all(np.isfinite(np.asarray(m.total))))
+    # (3) cause-tagged degrade span + mirrored always-on counter
+    spans = t.spans(name="degrade", kind="dispatch")
+    assert spans, "no degrade span emitted"
+    assert EXPECTED_CAUSE[fault] in {e.attrs["cause"] for e in spans}
+    assert telemetry.snapshot().get(f"degrade:cause:{EXPECTED_CAUSE[fault]}", 0) >= 1
+    stats = m.dispatch_stats
+    assert stats["demotions"] >= 1 and not stats["permanent"]
+
+
+# ------------------------------------------------------------ forward path
+@pytest.mark.parametrize("fault", ["launch", "nan-input", "state-corruption"])
+def test_forward_fault_degrades_to_eager_parity(fault):
+    batches = _batches()
+    ref = FloatSum(jit_update=True)
+    fwd_ref = [np.asarray(ref.forward(v)) for v in batches]
+
+    m = FloatSum(jit_update=True)
+    with telemetry.instrument() as t, faults.inject(fault) as spec:
+        fwd = [np.asarray(m.forward(v)) for v in batches]
+    assert spec.fired >= 1
+
+    for got, want in zip(fwd, fwd_ref):
+        np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(np.asarray(m.total), np.asarray(ref.total))
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+    assert bool(np.all(np.isfinite(np.asarray(m.total))))
+    spans = t.spans(name="degrade", kind="forward")
+    assert spans, "no forward degrade span emitted"
+    assert EXPECTED_CAUSE[fault] in {e.attrs["cause"] for e in spans}
+    assert m.forward_stats["demotions"] >= 1 and not m.forward_stats["permanent"]
+
+
+# --------------------------------------------------- backoff + re-promotion
+def test_transient_fault_repromotes_within_backoff_window():
+    """One injected launch fault (count=1) must cost exactly the documented
+    cooldown — METRICS_TPU_BACKOFF_BASE eager calls — then the engine is
+    retried and re-promoted. Pinned via demotion/dispatch counters only."""
+    m = FloatSum(jit_update=True)
+    v = jnp.asarray([1.0, 2.0])
+
+    with telemetry.instrument() as t:
+        with faults.inject("launch", count=1) as spec:
+            m.update(v)  # engine attempt faults once, the jit path serves
+        assert spec.fired == 1
+        stats = m.dispatch_stats
+        assert stats["demotions"] == 1 and not stats["permanent"]
+        cooldown = stats["cooldown"]
+        assert cooldown == 4  # documented METRICS_TPU_BACKOFF_BASE default
+        assert t.count(name="update", kind="aot") == 0  # never launched
+
+        for _ in range(cooldown):  # cooldown window: engine benched
+            m.update(v)
+        assert m.dispatch_stats["cooldown"] == 0
+        assert t.count(name="update", kind="aot") == 0
+
+        m.update(v)  # first post-cooldown call retries the engine — and wins
+        stats = m.dispatch_stats
+        assert stats["repromotions"] == 1
+        assert stats["demotions"] == 1  # no new failure
+        assert t.count(name="update", kind="aot") == 1
+
+    ref = FloatSum()
+    for _ in range(cooldown + 2):
+        ref.update(v)
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+
+def test_backoff_schedule_doubles_and_caps():
+    """The policy state machine alone: base-4 doubling, 256 cap, success
+    resets the clock and counts one re-promotion per failure streak."""
+    p = resilience.ResiliencePolicy()
+    assert p.allow()
+    assert p.note_failure("boom") == 4
+    for _ in range(4):
+        assert not p.allow()
+    assert p.allow()
+    assert p.note_failure("boom") == 8
+    p.failures = 20  # deep streak: next cooldown must hit the cap
+    assert p.note_failure("boom") == 256
+    p.note_success()
+    assert p.cooldown == 0 and p.failures == 0 and p.repromotions == 1
+    assert p.allow()
+
+
+def test_resilience_kill_switch_restores_permanent_demotion(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_RESILIENCE", "0")
+    m = FloatSum(jit_update=True)
+    with telemetry.instrument() as t:
+        with faults.inject("launch", count=1):
+            m.update(jnp.asarray([1.0]))
+        stats = m.dispatch_stats
+        assert stats["permanent"]  # legacy posture: first failure benches forever
+        m.update(jnp.asarray([1.0]))
+        assert t.count(name="update", kind="aot") == 0  # engine never retried
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(2.0, dtype=np.float32))
+
+
+def test_env_var_fault_activation(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_INJECT_FAULT", "launch")
+    m = FloatSum(jit_update=True)
+    with telemetry.instrument() as t:
+        m.update(jnp.asarray([1.0, 2.0]))
+    assert t.spans(name="degrade", kind="dispatch")
+    assert m.dispatch_stats["demotions"] == 1
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(3.0, dtype=np.float32))
+
+
+def test_ambient_env_fault_parity():
+    """The `make chaos` env-forced lane: whatever fault class
+    ``METRICS_TPU_INJECT_FAULT`` forces process-wide (any of the six, any
+    probability), a full update/forward/compute run must stay bit-identical
+    to the never-faulted eager reference — no assertions here depend on
+    WHICH fault is ambient. Without the env var this is a plain engine-vs-
+    eager parity check."""
+    batches = _batches(n=6)
+    ref = FloatSum()
+    fwd_ref = [np.asarray(ref.forward(v)) for v in batches]
+
+    m = FloatSum(jit_update=True)
+    fwd = [np.asarray(m.forward(v)) for v in batches]
+
+    for got, want in zip(fwd, fwd_ref):
+        np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(np.asarray(m.total), np.asarray(ref.total))
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+    assert bool(np.all(np.isfinite(np.asarray(m.total))))
+
+
+# ------------------------------------------------------------- collectives
+def _loopback_process_env(monkeypatch, world=2):
+    from jax.experimental import multihost_utils
+
+    from metrics_tpu.parallel import dist_env as de
+
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", lambda x: np.stack([np.asarray(x)] * world)
+    )
+    env = de.ProcessEnv.__new__(de.ProcessEnv)
+    env._world = world
+    return env
+
+
+def test_collective_transient_fault_retries_and_recovers(monkeypatch):
+    env = _loopback_process_env(monkeypatch)
+    x = jnp.asarray([3.0, 4.0])
+    with telemetry.instrument() as t, faults.inject("collective", count=1) as spec:
+        out = env.all_gather_uniform(x)
+    assert spec.fired == 1
+    assert len(out) == 2  # retry succeeded: full cross-process view
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x))
+    rec = t.spans(name="degrade", kind="collective")
+    assert len(rec) == 1
+    assert rec[0].attrs["cause"] == "recovered" and rec[0].attrs["retries"] == 1
+
+
+def test_collective_exhaustion_degrades_to_local_only(monkeypatch):
+    env = _loopback_process_env(monkeypatch)
+    x = jnp.asarray([3.0, 4.0])
+    with telemetry.instrument() as t, faults.inject("collective") as spec:
+        with pytest.warns(UserWarning, match="local-only"):
+            out = env.all_gather_uniform(x)
+    assert spec.fired == 3  # 1 + METRICS_TPU_COLLECTIVE_RETRIES default
+    assert len(out) == 1  # local-only: world-size-1 semantics for this sync
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x))
+    span = t.spans(name="degrade", kind="collective")[-1]
+    assert span.attrs["cause"] == "injected:collective"
+    assert span.attrs["local_only"] is True and span.attrs["retries"] == 2
+
+
+def test_collective_timeout_unblocks_instead_of_hanging(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_COLLECTIVE_TIMEOUT_S", "0.05")
+    monkeypatch.setenv("METRICS_TPU_COLLECTIVE_RETRIES", "0")
+
+    def wedged():
+        time.sleep(5.0)
+
+    with telemetry.instrument() as t, pytest.warns(UserWarning, match="local-only"):
+        out = resilience.run_collective(wedged, lambda: "local", "ChaosTest", "wedge")
+    assert out == "local"
+    assert t.spans(name="degrade", kind="collective")[0].attrs["cause"] == "_CollectiveTimeout"
+
+
+def test_all_reduce_exhaustion_keeps_local_reduction(monkeypatch):
+    env = _loopback_process_env(monkeypatch)
+    x = jnp.asarray([1.0, 2.0])
+    with faults.inject("collective"), pytest.warns(UserWarning, match="local-only"):
+        out = env.all_reduce(x, "sum")
+    # local-only degradation reduces this process's contribution alone
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+# -------------------------------------------------------------- fused sync
+def test_fused_sync_engine_failure_degrades_to_per_leaf(monkeypatch):
+    from metrics_tpu import sync_engine
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("bucket pass exploded")
+
+    monkeypatch.setattr(sync_engine, "execute_buckets", boom)
+
+    m = FloatSum(sync_env=Loopback2())
+    m.update(jnp.asarray([1.0, 2.0]))
+    with telemetry.instrument() as t, pytest.warns(UserWarning, match="per-leaf"):
+        # compute()'s auto-sync rides the fused engine, which now explodes:
+        # the per-leaf protocol must still produce the 2-rank reduction
+        total = np.asarray(m.compute())
+    spans = t.spans(name="degrade", kind="sync")
+    assert spans and spans[0].attrs["cause"] == "RuntimeError"
+    np.testing.assert_array_equal(total, np.asarray(6.0, dtype=np.float32))

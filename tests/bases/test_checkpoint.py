@@ -97,6 +97,64 @@ def test_curve_metrics_mode_resumes(tmp_path):
             np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-7)
 
 
+@pytest.mark.chaos
+def test_corrupted_checkpoint_raises_state_corruption_error(tmp_path):
+    """Payload integrity: ``state_dict`` carries flat ``__checksum__::``
+    entries through orbax; a byte-flipped state entry makes the restore
+    raise a clear :class:`StateCorruptionError` naming the corrupted key
+    BEFORE any live metric state is touched, while the uncorrupted payload
+    round-trips bit-exactly."""
+    from metrics_tpu import faults
+    from metrics_tpu.resilience import CHECKSUM_PREFIX, StateCorruptionError
+
+    metric = Accuracy(num_classes=3, average="macro")
+    metric.persistent(True)
+    preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6]])
+    target = jnp.asarray([0, 1, 0])
+    metric.update(preds, target)
+
+    payload = metric.state_dict()
+    assert any(str(k).startswith(CHECKSUM_PREFIX) for k in payload)
+    restored = _ckpt(tmp_path, "integrity", payload)
+
+    # clean payload: exact (bit-identical) state round-trip
+    resumed = Accuracy(num_classes=3, average="macro")
+    resumed.load_state_dict(restored)
+    for name in metric._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(resumed, name)), np.asarray(getattr(metric, name))
+        )
+
+    # injected state-leaf corruption: refuse the load, name the key
+    corrupt = faults.corrupt_payload(dict(restored))
+    fresh = Accuracy(num_classes=3, average="macro")
+    with pytest.raises(StateCorruptionError, match="integrity check"):
+        fresh.load_state_dict(corrupt)
+    # the failed load left the fresh metric's state untouched (still default)
+    blank = Accuracy(num_classes=3, average="macro")
+    for name in blank._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fresh, name)), np.asarray(getattr(blank, name))
+        )
+
+
+@pytest.mark.chaos
+def test_corrupted_collection_checkpoint_raises(tmp_path):
+    from metrics_tpu import faults
+    from metrics_tpu.resilience import StateCorruptionError
+
+    mc = MetricCollection({"acc": Accuracy(num_classes=3), "loss": MeanMetric()})
+    mc.persistent(True)
+    mc["acc"].update(jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]), jnp.asarray([0, 1]))
+    mc["loss"].update(jnp.asarray(0.5))
+
+    restored = _ckpt(tmp_path, "collection-integrity", mc.state_dict())
+    corrupt = faults.corrupt_payload(dict(restored))
+    mc2 = MetricCollection({"acc": Accuracy(num_classes=3), "loss": MeanMetric()})
+    with pytest.raises(StateCorruptionError, match="integrity check"):
+        mc2.load_state_dict(corrupt)
+
+
 def test_list_state_orbax_roundtrip(tmp_path):
     """Appendable (cat) states serialize as a list-of-arrays pytree."""
     from metrics_tpu import PrecisionRecallCurve

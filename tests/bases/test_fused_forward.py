@@ -168,10 +168,11 @@ def test_kill_switch_restores_eager_path(monkeypatch):
     _assert_states_equal(m, ref)
 
 
-def test_engine_failure_demotes_permanently():
+def test_engine_failure_degrades_with_backoff():
     """A metric whose COMPUTE needs host values cannot be traced by the
-    engine (update alone jits fine): forward falls back to the eager path
-    and never retries the engine."""
+    engine (update alone jits fine): forward degrades the call to the eager
+    path, records a cause-tagged demotion, and holds the engine in an
+    exponential-backoff cooldown instead of retrying on the very next call."""
 
     class HostCompute(Metric):
         full_state_update = False
@@ -190,11 +191,15 @@ def test_engine_failure_demotes_permanently():
     m = HostCompute(jit_update=True)
     values = jnp.asarray([1.0, 2.0, 3.0])
     out = m.forward(values)
-    assert m._fused_forward_failed
+    stats = m.forward_stats
+    assert stats["demotions"] == 1
+    assert not stats["permanent"]
+    assert stats["cooldown"] > 0  # backoff armed: next calls go eager
     np.testing.assert_allclose(np.asarray(out), 6.0)
     np.testing.assert_allclose(np.asarray(m.forward(values)), 6.0)
     np.testing.assert_allclose(np.asarray(m.compute()), 12.0)
     assert m.forward_stats["launches"] == 0
+    assert m.forward_stats["demotions"] == 1  # cooldown absorbed the retry
 
 
 # ----------------------------------------------------------------- collection

@@ -1,0 +1,96 @@
+"""Kill-switch matrix: all 2^3 combinations of the three execution-engine
+switches — ``METRICS_TPU_FAST_DISPATCH``, ``METRICS_TPU_FUSED_FORWARD``,
+``METRICS_TPU_FUSED_SYNC`` — must produce results **bit-identical** to the
+all-on default on a standard classification suite (forward per step,
+extra updates, synced compute under a 2-rank loopback env). Any drift
+between an engine and its legacy fallback is a correctness bug the
+switches would otherwise let users "fix" silently.
+"""
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
+from metrics_tpu.parallel.dist_env import NoOpEnv
+
+NUM_CLASSES = 5
+SWITCHES = ("METRICS_TPU_FAST_DISPATCH", "METRICS_TPU_FUSED_FORWARD", "METRICS_TPU_FUSED_SYNC")
+
+
+class Loopback2(NoOpEnv):
+    def world_size(self):
+        return 2
+
+    def all_gather(self, x):
+        x = jnp.atleast_1d(x)
+        return [x, x]
+
+    def all_reduce(self, x, op):
+        stacked = jnp.stack([jnp.atleast_1d(x)] * 2)
+        return {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}[op](stacked, axis=0)
+
+
+def _suite(env):
+    return MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="macro", sync_env=env),
+            "prec": Precision(num_classes=NUM_CLASSES, average="macro", sync_env=env),
+            "rec": Recall(num_classes=NUM_CLASSES, average="macro", sync_env=env),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro", sync_env=env),
+        },
+        fused_update=True,
+    )
+
+
+def _run_suite():
+    """One standard classification run: 3 forwards + 2 updates + synced
+    compute. Fresh metrics, fresh RNG — byte-comparable across combos."""
+    rng = np.random.RandomState(1234)
+    col = _suite(Loopback2())
+    step_vals = []
+    for b in (33, 64, 33):
+        logits = rng.rand(b, NUM_CLASSES).astype(np.float32)
+        preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, b))
+        step_vals.append({k: np.asarray(v) for k, v in col.forward(preds, target).items()})
+    for b in (48, 17):
+        logits = rng.rand(b, NUM_CLASSES).astype(np.float32)
+        preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, b))
+        col.update(preds, target)
+    final = {k: np.asarray(v) for k, v in col.compute().items()}
+    return step_vals, final
+
+
+@pytest.fixture(scope="module")
+def all_on_baseline():
+    import os
+
+    assert not any(os.environ.get(s, "").strip() for s in SWITCHES), (
+        "baseline must run with every engine at its default-on state"
+    )
+    return _run_suite()
+
+
+@pytest.mark.parametrize(
+    "combo", list(itertools.product(("1", "0"), repeat=3)),
+    ids=lambda c: "dispatch%s-forward%s-sync%s" % c,
+)
+def test_kill_switch_combo_bit_identical(combo, all_on_baseline, monkeypatch):
+    for switch, value in zip(SWITCHES, combo):
+        monkeypatch.setenv(switch, value)
+    step_vals, final = _run_suite()
+    base_steps, base_final = all_on_baseline
+    for i, (got, want) in enumerate(zip(step_vals, base_steps)):
+        assert got.keys() == want.keys()
+        for name in want:
+            np.testing.assert_array_equal(
+                got[name], want[name], err_msg=f"step {i} metric {name!r} combo {combo}"
+            )
+    assert final.keys() == base_final.keys()
+    for name in base_final:
+        np.testing.assert_array_equal(
+            final[name], base_final[name], err_msg=f"final {name!r} combo {combo}"
+        )
