@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos doctest bench bench-forward trace tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos doctest bench bench-forward serve-bench trace tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -44,7 +44,7 @@ parity:
 # must still serve values bit-identical to the eager reference
 chaos:
 	python -m pytest -m chaos tests/ -q
-	for f in compile launch collective nan-input state-corruption oom; do \
+	for f in compile launch collective nan-input state-corruption oom cache-corruption; do \
 		echo "=== ambient fault: $$f ==="; \
 		METRICS_TPU_INJECT_FAULT=$$f python -m pytest tests/bases/test_chaos.py -k ambient -q || exit 1; \
 	done
@@ -72,6 +72,12 @@ bench:
 # latency, without the rest of the detail suite
 bench-forward:
 	python -c "import json, bench; d = {}; bench._cfg_forward_engine(d); print(json.dumps(d, indent=2))"
+
+# serving numbers only: cold/warm cold-start-to-first-result via a
+# subprocess pair sharing one persistent AOT cache dir, 1k-session
+# throughput, and the structural coalescing pin (launches per flush == 1)
+serve-bench:
+	python -c "import json, bench; d = {}; bench._cfg_serving(d); print(json.dumps(d, indent=2))"
 
 # short instrumented eval with telemetry export, then the human-readable
 # replay: launches, retraces by cause, collectives/bytes, p50/p95 span µs.

@@ -522,6 +522,93 @@ def _cfg_resilience_overhead(detail: dict) -> None:
     )
 
 
+def _cfg_serving(detail: dict, sessions: int = 1024, coldstart: bool = True) -> None:
+    """Serving-harness numbers (:mod:`metrics_tpu.serve` + persistent AOT
+    cache, :mod:`metrics_tpu.aot_cache`).
+
+    Three claims. (1) **Zero-warmup cold start**: a subprocess pair shares
+    one persistent cache dir — the cold child populates it paying the real
+    lowering+compile, the warm child deserializes; both report
+    first-update-to-first-result µs. (2) **Multi-tenant throughput**: the
+    service sustains ~1k concurrent sessions, reported as session-updates
+    per second through one steady-state flush. (3) **Coalescing** is pinned
+    STRUCTURALLY: 1k concurrent same-executable updates must cost exactly
+    ONE stacked launch per flush (launch counts, not wall time).
+
+    ``sessions``/``coldstart`` let the bench-config pin test run the same
+    code path at test-budget scale (fewer sessions, no subprocess pair)."""
+    import subprocess
+    import sys
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, telemetry
+    from metrics_tpu.serve import MetricsService
+
+    child = r"""
+import os, time
+import jax, jax.numpy as jnp
+import numpy as np
+from metrics_tpu import Accuracy
+rng = np.random.RandomState(0)
+p = jnp.asarray(rng.rand(256, 32).astype(np.float32))
+t = jnp.asarray(rng.randint(0, 32, 256))
+m = Accuracy(num_classes=32, average="macro", jit_update=True)
+t0 = time.perf_counter()
+m.update(p, t)
+v = m.compute()
+jax.block_until_ready(v)
+print((time.perf_counter() - t0) * 1e6)
+"""
+    if coldstart:
+        with tempfile.TemporaryDirectory() as cache_dir:
+            env = dict(os.environ)
+            # same isolation as _bench_dist_subprocess: empty PYTHONPATH keeps
+            # site hooks (and any chip tunnel client) out of the children
+            env["PYTHONPATH"] = ""
+            env["JAX_PLATFORMS"] = "cpu"
+            env["METRICS_TPU_AOT_CACHE"] = cache_dir
+            for phase in ("cold", "warm"):
+                proc = None
+                try:
+                    proc = subprocess.run(
+                        [sys.executable, "-c", child], capture_output=True, text=True,
+                        timeout=300, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+                    )
+                    detail[f"coldstart_first_result_us_{phase}"] = round(
+                        float(proc.stdout.strip().splitlines()[-1]), 1
+                    )
+                except Exception as err:
+                    stderr = proc.stderr if proc is not None else ""
+                    print(f"# serving coldstart ({phase}) failed: {err}\n{stderr}", file=sys.stderr, flush=True)
+
+    rng = np.random.RandomState(11)
+    C, B, S = 8, 16, sessions
+    svc = MetricsService(Accuracy(task="multiclass", num_classes=C))
+
+    def submit_all():
+        preds = jnp.asarray(rng.randint(0, C, (S, B)))
+        targs = jnp.asarray(rng.randint(0, C, (S, B)))
+        for i in range(S):
+            svc.submit(f"s{i}", preds[i], targs[i])
+
+    submit_all()
+    svc.flush()
+    svc.drain()  # warmup: session table built, stacked program compiled
+    with telemetry.instrument() as session:
+        submit_all()
+        t0 = time.perf_counter()
+        svc.flush()
+        svc.drain()
+        elapsed = time.perf_counter() - t0
+    detail["serve_coalesced_launches_per_step"] = sum(
+        1 for e in session.events if e.name == "update" and e.kind == "stacked-aot"
+    )
+    detail["serve_sessions"] = svc.session_count
+    detail["serve_updates_per_sec_1k_sessions"] = round(S / max(elapsed, 1e-9), 1)
+
+
 def _machinery_device(detail: dict):
     """Host CPU device for the compute-group machinery configs.
 
@@ -1122,6 +1209,7 @@ def _bench_detail() -> dict:
         ("forward_launches_single_metric_10_steps", _cfg_forward_engine),
         ("telemetry_idle_overhead_ratio", _cfg_telemetry_overhead),
         ("resilience_idle_overhead_ratio", _cfg_resilience_overhead),
+        ("serve_updates_per_sec_1k_sessions", _cfg_serving),
     ]
     detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
     return detail
@@ -1340,6 +1428,7 @@ def _bench_detail_fast() -> dict:
         ("forward_engine", _cfg_forward_engine),
         ("telemetry_overhead", _cfg_telemetry_overhead),
         ("resilience_overhead", _cfg_resilience_overhead),
+        ("serving", _cfg_serving),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),

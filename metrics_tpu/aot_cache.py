@@ -1,0 +1,373 @@
+"""Persistent AOT executable cache: compiled programs that survive the process.
+
+Every engine (:mod:`metrics_tpu.dispatch` update + forward families, the
+:mod:`metrics_tpu.serve` stacked-session programs) compiles once per
+``(owner, static-key, pow2 shape bucket, dtype)`` — but those caches die
+with the process, so a fleet autoscaling under load pays full
+lowering+compile on every cold start. This module is the disk tier under
+all of them: on a compile-path miss the engine first asks here, and a hit
+installs a ready executable so a **fresh process hits warm p50 on its
+first request**.
+
+Storage model
+=============
+
+``METRICS_TPU_AOT_CACHE=<dir>`` names the store (unset / ``0`` / ``off``
+disables it — the default — restoring in-process-only caching exactly).
+Entries live at::
+
+    <dir>/<fingerprint>/<entry-digest>.aot
+
+* **fingerprint** — jax/jaxlib version, backend platform, device kind and
+  count, x64 flag, plus ``METRICS_TPU_AOT_CACHE_SALT`` (ops cache-busting
+  knob). A jax upgrade, platform change, or topology change makes every
+  old entry a clean miss; nothing is ever loaded across fingerprints.
+* **entry digest** — sha256 over the engine's own in-process cache key
+  (static-flag key, input treedef, shape-bucketed avals, state-leaf
+  avals) plus an **owner namespace** (:func:`owner_namespace`: class
+  identity, scalar config attrs, state layout, small array-attr crcs) so
+  two different owners whose inputs merely look alike can never share an
+  executable.
+
+Each file is ``magic + sha256(body) + body``; the body is a pickled
+payload in one of two formats:
+
+* ``executable`` — the compiled executable serialized via
+  ``jax.experimental.serialize_executable`` (with its arg treedefs).
+  Loading is deserialize-and-go: no trace, no lower, no compile.
+* ``stablehlo`` — ``jax.export`` portable bytes, the ``_compat``-guarded
+  fallback for jax builds without executable serialization. Loading
+  recompiles locally from the persisted StableHLO — the XLA compile is
+  paid again, but Python tracing and lowering (the host-side majority of
+  a metrics-program cold start) are not.
+
+Corruption safety
+=================
+
+A persistent cache must never be able to crash or corrupt serving: every
+load verifies the checksum, and **any** failure (truncated file, flipped
+bits, unpicklable body, incompatible payload) is treated as a miss — the
+poisoned entry is unlinked best-effort, a cause-tagged ``degrade`` span
+(``cause="cache-corruption"``) lands on the telemetry stream via
+:mod:`metrics_tpu.resilience`, and the caller falls through to a fresh
+compile. The ``cache-corruption`` fault class in :mod:`metrics_tpu.faults`
+injects exactly this (bit-flipping the blob after read) so chaos tests
+exercise the real recovery path.
+
+Observability
+=============
+
+Loads/stores emit ``aot-cache`` telemetry events (kinds ``hit`` /
+``miss`` / ``store`` / ``corrupt``), mirrored in the process counters
+(``telemetry.snapshot()``) and in :func:`stats`; a successful load is
+additionally announced by the engine as a ``compile`` span with the new
+cause tag ``persistent-cache-hit``, so ``tools/trace_report.py`` can
+report warm starts next to the retrace-by-cause table.
+"""
+import hashlib
+import os
+import pickle
+import threading
+import zlib
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from metrics_tpu import faults, telemetry
+
+__all__ = [
+    "CacheCorruptionError",
+    "cache_dir",
+    "cache_enabled",
+    "fingerprint",
+    "owner_namespace",
+    "entry_path",
+    "load",
+    "store",
+    "stats",
+    "reset_stats",
+]
+
+_ENV_VAR = "METRICS_TPU_AOT_CACHE"
+_SALT_VAR = "METRICS_TPU_AOT_CACHE_SALT"
+_FORMAT_VAR = "METRICS_TPU_AOT_CACHE_FORMAT"
+_MAGIC = b"MTPUAOT1\n"
+
+# capability probes (this jax build may lack either serialization tier)
+try:  # executable serialization: deserialize-and-go, no recompile
+    from jax.experimental import serialize_executable as _serialize_executable
+except ImportError:  # pragma: no cover - depends on jax build
+    _serialize_executable = None
+try:  # portable StableHLO export: persists lowering, recompiles locally
+    from jax import export as _jax_export
+except ImportError:  # pragma: no cover - depends on jax build
+    _jax_export = None
+
+
+class CacheCorruptionError(RuntimeError):
+    """A persistent cache entry failed its integrity/decode checks.
+
+    Never escapes :func:`load` — it is the cause carried by the ``degrade``
+    span while the load is converted into a miss."""
+
+
+_lock = threading.Lock()
+_stats: Dict[str, int] = {"hits": 0, "misses": 0, "stores": 0, "corrupt": 0, "store_errors": 0}
+_fingerprint_cache: Tuple[Optional[str], Optional[str]] = (None, None)
+
+
+def cache_dir() -> Optional[str]:
+    """The persistent store directory, or ``None`` when disabled.
+
+    ``METRICS_TPU_AOT_CACHE`` unset, empty, ``0``, ``false`` or ``off``
+    disables the whole tier — in-process behavior is then bit-for-bit
+    identical to a build without this module."""
+    raw = os.environ.get(_ENV_VAR, "").strip()
+    if not raw or raw.lower() in ("0", "false", "off"):
+        return None
+    return raw
+
+
+def cache_enabled() -> bool:
+    """True when a store directory is configured and a serialization tier
+    (executable or StableHLO) exists on this jax build."""
+    return cache_dir() is not None and (
+        _serialize_executable is not None or _jax_export is not None
+    )
+
+
+def _entry_format() -> Optional[str]:
+    """Which payload format new stores use: ``executable`` when this jax
+    can serialize compiled executables, else ``stablehlo``; overridable via
+    ``METRICS_TPU_AOT_CACHE_FORMAT`` (tests pin the fallback with it)."""
+    raw = os.environ.get(_FORMAT_VAR, "").strip().lower()
+    if raw == "executable":
+        return "executable" if _serialize_executable is not None else None
+    if raw == "stablehlo":
+        return "stablehlo" if _jax_export is not None else None
+    if _serialize_executable is not None:
+        return "executable"
+    if _jax_export is not None:
+        return "stablehlo"
+    return None
+
+
+def fingerprint() -> str:
+    """Environment fingerprint isolating incompatible executables.
+
+    Folds jax/jaxlib versions, backend platform, device kind, local device
+    count, the x64 flag, and ``METRICS_TPU_AOT_CACHE_SALT``. Entries are
+    only ever loaded from the directory matching the current fingerprint,
+    so a version bump or topology change is a clean all-miss, never a
+    wrong-executable load."""
+    salt = os.environ.get(_SALT_VAR, "")
+    global _fingerprint_cache
+    cached_salt, cached = _fingerprint_cache
+    if cached is not None and cached_salt == salt:
+        return cached
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "?")
+    except ImportError:  # pragma: no cover - jax without jaxlib
+        jaxlib_version = "?"
+    devices = jax.local_devices()
+    parts = (
+        jax.__version__,
+        jaxlib_version,
+        jax.default_backend(),
+        getattr(devices[0], "device_kind", "?") if devices else "?",
+        len(devices),
+        bool(jax.config.jax_enable_x64),
+        salt,
+    )
+    digest = hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
+    with _lock:
+        _fingerprint_cache = (salt, digest)
+    return digest
+
+
+def _crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def owner_namespace(owner: Any) -> Tuple:
+    """Deterministic cross-process identity for one program owner.
+
+    The in-process cache key never leaves the dispatcher that built it, so
+    it can afford to be owner-blind; the on-disk key cannot — two owners
+    with look-alike inputs (any two ``MetricCollection``\\ s with the same
+    leaf layout, say) must never share an executable. This folds in the
+    class identity, every scalar public config attr (``num_classes``,
+    ``average``, ``threshold``, ...), the state layout, and — for small
+    array-valued config attrs — a content crc; large arrays contribute
+    shape+dtype only. Callable attrs contribute their qualname."""
+    import numpy as np
+
+    cls = type(owner)
+    entries = []
+    state_names = set(getattr(owner, "_defaults", {}) or {})
+    for name in sorted(vars(owner)):
+        # state leaves are mutable accumulators, not config — their avals
+        # already live in the engine key; folding VALUES in would make the
+        # namespace drift over the owner's lifetime
+        if name.startswith("_") or name in state_names:
+            continue
+        value = vars(owner)[name]
+        if isinstance(value, (bool, int, float, str, type(None))):
+            entries.append((name, value))
+        elif isinstance(value, (tuple, list)) and all(
+            isinstance(v, (bool, int, float, str, type(None))) for v in value
+        ):
+            entries.append((name, tuple(value)))
+        elif hasattr(value, "dtype") and hasattr(value, "shape"):
+            arr = np.asarray(value)
+            if arr.nbytes <= 65536:
+                entries.append((name, ("array", arr.shape, str(arr.dtype), _crc(np.ascontiguousarray(arr).tobytes()))))
+            else:
+                entries.append((name, ("array", arr.shape, str(arr.dtype))))
+        elif callable(value):
+            entries.append((name, getattr(value, "__qualname__", type(value).__name__)))
+    state_layout = tuple(getattr(owner, "_defaults", {}).keys())
+    return (cls.__module__, cls.__qualname__, state_layout, tuple(entries))
+
+
+def entry_path(label: str, family: str, key: Any, namespace: Any = ()) -> Optional[str]:
+    """On-disk path for one program, or ``None`` when the cache is off."""
+    base = cache_dir()
+    if base is None:
+        return None
+    digest = hashlib.sha256(repr((label, family, namespace, key)).encode()).hexdigest()[:40]
+    return os.path.join(base, fingerprint(), f"{digest}.aot")
+
+
+def _bump(counter: str, label: str) -> None:
+    with _lock:
+        _stats[counter] = _stats.get(counter, 0) + 1
+    kind = {"hits": "hit", "misses": "miss", "stores": "store",
+            "corrupt": "corrupt", "store_errors": "store-error"}[counter]
+    telemetry.emit("aot-cache", label, kind)
+
+
+def load(label: str, family: str, key: Any, namespace: Any = ()) -> Optional[Callable]:
+    """Look one program up in the persistent store.
+
+    Returns a ready executable-like callable (same calling convention the
+    engine compiled) on a hit, ``None`` on a miss. Corruption of any kind
+    is converted into a miss: checksum verified before unpickling, the
+    poisoned file unlinked best-effort, and a ``degrade`` span with
+    ``cause="cache-corruption"`` emitted through the resilience engine.
+    Never raises."""
+    path = entry_path(label, family, key, namespace)
+    if path is None or not cache_enabled():
+        return None
+    if not os.path.exists(path):
+        _bump("misses", label)
+        return None
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+        if faults.should_fire("cache-corruption"):
+            # simulate a bit-flipped entry AFTER the read: the checksum
+            # tier below must convert it into a miss, never a crash
+            mid = len(blob) // 2
+            blob = blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:] if blob else blob
+        if not blob.startswith(_MAGIC):
+            raise CacheCorruptionError(f"bad magic in {os.path.basename(path)}")
+        digest, _, body = blob[len(_MAGIC):].partition(b"\n")
+        if hashlib.sha256(body).hexdigest().encode() != digest:
+            raise CacheCorruptionError(f"checksum mismatch in {os.path.basename(path)}")
+        payload = pickle.loads(body)
+        fmt = payload.get("format")
+        if fmt == "executable":
+            if _serialize_executable is None:
+                raise CacheCorruptionError("entry needs executable deserialization this jax lacks")
+            compiled = _serialize_executable.deserialize_and_load(
+                payload["payload"], payload["in_tree"], payload["out_tree"]
+            )
+        elif fmt == "stablehlo":
+            if _jax_export is None:
+                raise CacheCorruptionError("entry needs jax.export this jax lacks")
+            import jax
+
+            exported = _jax_export.deserialize(payload["payload"])
+            # recompiles from the persisted StableHLO on first call — the
+            # XLA compile is paid, the Python trace+lower is not
+            compiled = jax.jit(exported.call)
+        else:
+            raise CacheCorruptionError(f"unknown payload format {fmt!r}")
+    except Exception as err:  # noqa: BLE001 - ANY load failure is a miss
+        corrupt = err if isinstance(err, CacheCorruptionError) else CacheCorruptionError(
+            f"{type(err).__name__}: {err}"
+        )
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        _bump("corrupt", label)
+        from metrics_tpu import resilience
+
+        resilience.record_degrade(label, "aot-cache", corrupt, family=family)
+        return None
+    _bump("hits", label)
+    return compiled
+
+
+def store(
+    label: str,
+    family: str,
+    key: Any,
+    compiled: Any = None,
+    export_fn: Optional[Callable[[], Any]] = None,
+    namespace: Any = (),
+) -> bool:
+    """Persist one freshly-compiled program; returns True on success.
+
+    ``compiled`` feeds the ``executable`` format; ``export_fn`` is a lazy
+    thunk producing a ``jax.export.Exported`` for the ``stablehlo``
+    fallback (lazy because export re-traces — only worth it when it is the
+    format actually being written). Failures are counted and swallowed: a
+    broken disk must never break serving."""
+    path = entry_path(label, family, key, namespace)
+    fmt = _entry_format()
+    if path is None or fmt is None:
+        return False
+    try:
+        if fmt == "executable" and compiled is not None:
+            payload_bytes, in_tree, out_tree = _serialize_executable.serialize(compiled)
+            payload = {"format": "executable", "payload": payload_bytes,
+                       "in_tree": in_tree, "out_tree": out_tree}
+        elif export_fn is not None and _jax_export is not None:
+            payload = {"format": "stablehlo", "payload": export_fn().serialize()}
+        else:
+            return False
+        body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        blob = _MAGIC + hashlib.sha256(body).hexdigest().encode() + b"\n" + body
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)  # atomic: concurrent readers see old or new, never torn
+    except Exception:  # noqa: BLE001 - persistence is an optimization only
+        _bump("store_errors", label)
+        return False
+    _bump("stores", label)
+    return True
+
+
+def stats() -> Dict[str, Any]:
+    """Process-level persistent-cache counters plus configuration state
+    (the same keys ``tools/trace_report.py`` reports and
+    ``Metric.telemetry_snapshot()`` surfaces)."""
+    with _lock:
+        snap: Dict[str, Any] = dict(_stats)
+    snap["enabled"] = cache_enabled()
+    snap["dir"] = cache_dir()
+    return snap
+
+
+def reset_stats() -> None:
+    """Zero the counters (tests/bench)."""
+    with _lock:
+        for k in list(_stats):
+            _stats[k] = 0

@@ -332,6 +332,15 @@ class MetricCollection:
 
         make_forward, make_masked_forward = make_collection_forward_factories(self, unflatten, flatten)
 
+        from metrics_tpu import aot_cache
+
+        # the label is the shared "MetricCollection", so the persistent
+        # namespace must carry the actual membership: every member's own
+        # identity keyed by its name in the collection
+        namespace = tuple(
+            (name, aot_cache.owner_namespace(m)) for name, m in self._modules.items()
+        )
+
         return FastDispatcher(
             "MetricCollection",
             read_leaves,
@@ -343,6 +352,7 @@ class MetricCollection:
             make_forward=make_forward,
             make_masked_forward=make_masked_forward,
             forward_stats=self._forward_stats,
+            cache_namespace=namespace,
         )
 
     @property
@@ -644,7 +654,10 @@ class MetricCollection:
         """Collection-level merged observability report: the fused-path
         ``dispatch``/``sync``/``forward`` counters this collection owns,
         plus each member's own :meth:`Metric.telemetry_snapshot` under
-        ``"members"`` (see ``docs/observability.md``)."""
+        ``"members"``, and the process-wide persistent AOT-cache counters
+        under ``"aot_cache"`` (see ``docs/observability.md``)."""
+        from metrics_tpu import aot_cache
+
         return {
             "owner": "MetricCollection",
             "dispatch": self.dispatch_stats,
@@ -654,6 +667,7 @@ class MetricCollection:
                 "fused": self._fuse_resilience.stats(),
                 "fuse_failed": self._fuse_failed,
             },
+            "aot_cache": aot_cache.stats(),
             "members": {name: m.telemetry_snapshot() for name, m in self.items(keep_base=True)},
         }
 

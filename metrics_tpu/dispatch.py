@@ -45,19 +45,33 @@ diagnosis instead of a mystery. Launches are also wrapped in
 ``jax.profiler`` trace annotations (via ``_compat``) so device-level
 profiler captures line up with the telemetry spans.
 
+The in-process cache is **bounded and tiered**. Bounded: executables live
+in an LRU keyed dict capped at ``METRICS_TPU_CACHE_MAX`` entries (default
+256, ``0`` = unlimited) so a long-lived server with churning static keys
+cannot leak compiled programs; evictions emit an ``evict`` telemetry
+event and bump the owner's ``evictions`` stat. Tiered: on a compile-path
+miss the engine first consults the persistent on-disk store
+(:mod:`metrics_tpu.aot_cache`, ``METRICS_TPU_AOT_CACHE=<dir>``) — a hit
+installs a deserialized executable and is announced as a ``compile`` span
+with cause ``persistent-cache-hit`` (no retrace counted); a real compile
+is stored back so the NEXT process starts warm. The store is keyed by
+this engine's own cache key plus an owner namespace and an
+environment fingerprint — see :mod:`metrics_tpu.aot_cache`.
+
 ``METRICS_TPU_FAST_DISPATCH=0`` disables the engine process-wide (updates
 fall back to the legacy ``jax.jit`` path); ``MIN_BUCKET`` is the smallest
 pad target (tiny batches share one bucket instead of minting executables).
 """
 import os
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import faults, telemetry
+from metrics_tpu import aot_cache, faults, telemetry
 from metrics_tpu._compat import profiler_annotation
 from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
 
@@ -69,6 +83,17 @@ MIN_BUCKET = 8
 def fast_dispatch_enabled() -> bool:
     """Engine kill switch (env ``METRICS_TPU_FAST_DISPATCH``, default on)."""
     return os.environ.get("METRICS_TPU_FAST_DISPATCH", "1").lower() not in ("0", "false", "off")
+
+
+def cache_max() -> int:
+    """Per-dispatcher executable-cache cap (env ``METRICS_TPU_CACHE_MAX``,
+    default 256 entries, ``0`` = unlimited). Generous on purpose: eviction
+    exists to bound a churning long-lived server, not to be hit in a
+    steady-state training loop."""
+    try:
+        return int(os.environ.get("METRICS_TPU_CACHE_MAX", "256"))
+    except ValueError:
+        return 256
 
 
 class FastDispatchUnsupported(Exception):
@@ -114,6 +139,11 @@ class FastDispatcher:
         forward_stats: optional shared mutable dict with ``launches`` /
             ``retraces`` / ``engine_us`` keys (the owner's forward-path
             counters).
+        cache_namespace: deterministic cross-process owner identity (see
+            :func:`metrics_tpu.aot_cache.owner_namespace`) mixed into the
+            persistent store key so look-alike owners never share an
+            on-disk executable. ``None`` keeps the persistent tier off for
+            this dispatcher (in-process caching only).
     """
 
     def __init__(
@@ -128,6 +158,7 @@ class FastDispatcher:
         make_forward: Optional[Callable[[Dict], Callable]] = None,
         make_masked_forward: Optional[Callable[[Dict], Callable]] = None,
         forward_stats: Optional[Dict[str, Any]] = None,
+        cache_namespace: Any = None,
     ) -> None:
         self.label = label
         self._read_leaves = read_leaves
@@ -143,7 +174,9 @@ class FastDispatcher:
             if forward_stats is not None
             else {"launches": 0, "retraces": 0, "engine_us": 0.0}
         )
-        self._cache: Dict[Tuple, Any] = {}
+        self._cache_namespace = cache_namespace
+        # LRU over compiled executables (both families); see cache_max()
+        self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         # id()s of the leaves the engine itself produced last; anything else
         # is a foreign buffer that must be copied before donation
         self._owned: Tuple[int, ...] = ()
@@ -206,7 +239,7 @@ class FastDispatcher:
             tuple(_aval_key(x) for x in call_inputs),
             tuple(_aval_key(x) for x in leaves),
         )
-        compiled = self._cache.get(key)
+        compiled = self._cache_get(key)
         if compiled is None:
             compiled = self._compile(key, masked, static, treedef, leaves, call_inputs, static_key)
 
@@ -260,7 +293,7 @@ class FastDispatcher:
             tuple(_aval_key(x) for x in call_inputs),
             tuple(_aval_key(x) for x in leaves),
         )
-        compiled = self._cache.get(key)
+        compiled = self._cache_get(key)
         if compiled is None:
             compiled = self._compile_forward(key, masked, static, treedef, leaves, call_inputs, counts, static_key)
 
@@ -311,6 +344,21 @@ class FastDispatcher:
             return None
         return sizes.pop()
 
+    def _cache_get(self, key: Tuple) -> Any:
+        compiled = self._cache.get(key)
+        if compiled is not None:
+            self._cache.move_to_end(key)
+        return compiled
+
+    def _cache_put(self, key: Tuple, compiled: Any) -> None:
+        self._cache[key] = compiled
+        self._cache.move_to_end(key)
+        limit = cache_max()
+        while limit > 0 and len(self._cache) > limit:
+            self._cache.popitem(last=False)
+            self.stats["evictions"] = self.stats.get("evictions", 0) + 1
+            telemetry.emit("evict", self.label, self._kind, stream="dispatch")
+
     def _n_valid(self, batch: int) -> Array:
         cached = self._nvalid_cache.get(batch)
         if cached is None:
@@ -349,10 +397,54 @@ class FastDispatcher:
         seen["dtypes"].add(dtypes)
         return cause
 
+    def _persistent_load(self, family, seen_family, key, static_key, example_inputs, masked, stream, trace_fn, trace_args):
+        """Persistent-tier lookup for one compile-path miss. A hit installs
+        the deserialized executable in the LRU and is announced as a
+        ``compile`` span with cause ``persistent-cache-hit`` — no retrace is
+        counted, because no lowering/compile happened. The Python trace IS
+        replayed abstractly (``jax.eval_shape``): some owners carry host
+        side effects in their first trace (lazy mode/shape determination)
+        that the rest of the call path relies on, and an abstract trace is
+        cheap next to the lowering+XLA-compile a hit skips."""
+        if self._cache_namespace is None or not aot_cache.cache_enabled():
+            return None
+        t0 = time.perf_counter()
+        loaded = aot_cache.load(self.label, family, key, namespace=self._cache_namespace)
+        if loaded is None:
+            return None
+        jax.eval_shape(trace_fn, *trace_args)
+        # feed the seen-sets anyway so LATER real misses attribute correctly
+        self._retrace_cause(seen_family, static_key, example_inputs)
+        telemetry.emit(
+            "compile",
+            self.label,
+            self._kind,
+            t0=t0,
+            stream=stream,
+            cause="persistent-cache-hit",
+            masked=masked,
+            static_key=static_key or None,
+        )
+        self._cache_put(key, loaded)
+        return loaded
+
+    def _persist(self, family, key, compiled, jitted, export_args) -> None:
+        """Best-effort write-back of a freshly-compiled program to the
+        persistent store (no-op unless ``METRICS_TPU_AOT_CACHE`` is set)."""
+        if self._cache_namespace is None:
+            return
+        aot_cache.store(
+            self.label,
+            family,
+            key,
+            compiled=compiled,
+            # lazy: only invoked when the store writes the StableHLO format
+            export_fn=lambda: jax.export.export(jitted)(*export_args),
+            namespace=self._cache_namespace,
+        )
+
     def _compile(self, key, masked, static, treedef, example_leaves, example_inputs, static_key=()):
         faults.check("compile", self.label)
-        cause = self._retrace_cause("update", static_key, example_inputs)
-        t0 = time.perf_counter()
         if masked:
             inner = self._make_masked_update(dict(static))
 
@@ -361,9 +453,7 @@ class FastDispatcher:
                 return tuple(inner(n_valid, tuple(leaves), *args, **dyn))
 
             jitted = jax.jit(fn, donate_argnums=(1,) if _donation_enabled() else ())
-            compiled = jitted.lower(
-                jnp.asarray(0, jnp.int32), tuple(example_leaves), *example_inputs
-            ).compile()
+            export_args = (jnp.asarray(0, jnp.int32), tuple(example_leaves), *example_inputs)
         else:
             inner = self._make_update(dict(static))
 
@@ -372,7 +462,17 @@ class FastDispatcher:
                 return tuple(inner(tuple(leaves), *args, **dyn))
 
             jitted = jax.jit(fn, donate_argnums=(0,) if _donation_enabled() else ())
-            compiled = jitted.lower(tuple(example_leaves), *example_inputs).compile()
+            export_args = (tuple(example_leaves), *example_inputs)
+
+        loaded = self._persistent_load(
+            "update", "update", key, static_key, example_inputs, masked, "dispatch", fn, export_args
+        )
+        if loaded is not None:
+            return loaded
+        cause = self._retrace_cause("update", static_key, example_inputs)
+        t0 = time.perf_counter()
+        compiled = jitted.lower(*export_args).compile()
+        self._persist("update", key, compiled, jitted, export_args)
 
         telemetry.emit(
             "compile",
@@ -385,15 +485,13 @@ class FastDispatcher:
             static_key=static_key or None,
         )
         self.stats["retraces"] += 1
-        self._cache[key] = compiled
+        self._cache_put(key, compiled)
         return compiled
 
     def _compile_forward(self, key, masked, static, treedef, example_leaves, example_inputs, example_counts, static_key=()):
         """Lower + compile one multi-output forward program
         ``(counts, [n_valid,] leaves, batch) -> (leaves, batch_value)``."""
         faults.check("compile", self.label)
-        cause = self._retrace_cause("forward", static_key, example_inputs)
-        t0 = time.perf_counter()
         if masked:
             inner = self._make_masked_forward(dict(static))
 
@@ -403,9 +501,9 @@ class FastDispatcher:
                 return tuple(new_leaves), batch_val
 
             jitted = jax.jit(fn, donate_argnums=(2,) if _donation_enabled() else ())
-            compiled = jitted.lower(
+            export_args = (
                 example_counts, jnp.asarray(0, jnp.int32), tuple(example_leaves), *example_inputs
-            ).compile()
+            )
         else:
             inner = self._make_forward(dict(static))
 
@@ -415,7 +513,17 @@ class FastDispatcher:
                 return tuple(new_leaves), batch_val
 
             jitted = jax.jit(fn, donate_argnums=(1,) if _donation_enabled() else ())
-            compiled = jitted.lower(example_counts, tuple(example_leaves), *example_inputs).compile()
+            export_args = (example_counts, tuple(example_leaves), *example_inputs)
+
+        loaded = self._persistent_load(
+            "fwd", "forward", key, static_key, example_inputs, masked, "forward", fn, export_args
+        )
+        if loaded is not None:
+            return loaded
+        cause = self._retrace_cause("forward", static_key, example_inputs)
+        t0 = time.perf_counter()
+        compiled = jitted.lower(*export_args).compile()
+        self._persist("fwd", key, compiled, jitted, export_args)
 
         telemetry.emit(
             "compile",
@@ -428,5 +536,5 @@ class FastDispatcher:
             static_key=static_key or None,
         )
         self.forward_stats["retraces"] += 1
-        self._cache[key] = compiled
+        self._cache_put(key, compiled)
         return compiled

@@ -27,6 +27,11 @@ fault name                where it fires
                           to corrupt ``state_dict`` payloads
 ``oom``                   engine call whose input payload exceeds the
                           injected byte cap raises (OOM simulation)
+``cache-corruption``      a persistent AOT-cache entry is bit-flipped
+                          after read (inside :func:`aot_cache.load`) —
+                          the checksum tier must convert it into a miss
+                          plus a cause-tagged ``degrade`` span, and the
+                          engine must fall through to a fresh compile
 ========================= ==============================================
 
 Activation is per-test via the context manager::
@@ -64,7 +69,15 @@ __all__ = [
     "fired_count",
 ]
 
-FAULT_NAMES = ("compile", "launch", "collective", "nan-input", "state-corruption", "oom")
+FAULT_NAMES = (
+    "compile",
+    "launch",
+    "collective",
+    "nan-input",
+    "state-corruption",
+    "oom",
+    "cache-corruption",
+)
 
 _ENV_VAR = "METRICS_TPU_INJECT_FAULT"
 

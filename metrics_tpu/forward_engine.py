@@ -37,6 +37,13 @@ owner's masked-update support. The engine only engages where it is exact:
   emitted, and the engine is retried after an exponential-backoff
   cooldown (permanent demotion only for structurally-unsupported inputs).
 
+Forward programs are the ``"fwd"`` family of the dispatcher's executable
+cache, so they ride the persistent AOT tier too: with
+``METRICS_TPU_AOT_CACHE`` set, a fresh process deserializes its forward
+executables (compile cause ``persistent-cache-hit``) instead of paying
+the step path's largest cold-start cost — see
+:mod:`metrics_tpu.aot_cache`.
+
 ``METRICS_TPU_FUSED_FORWARD=0`` disables the engine process-wide:
 ``Metric.forward`` falls back to the eager reference-parity branches and
 ``MetricCollection`` forward to its legacy single-jit fused program.

@@ -723,6 +723,8 @@ class Metric(ABC):
 
         make_forward, make_masked_forward = forward_engine.make_metric_forward_factories(self, names)
 
+        from metrics_tpu import aot_cache
+
         return FastDispatcher(
             type(self).__name__,
             read_leaves,
@@ -734,6 +736,7 @@ class Metric(ABC):
             make_forward=make_forward,
             make_masked_forward=make_masked_forward,
             forward_stats=self._forward_stats,
+            cache_namespace=aot_cache.owner_namespace(self),
         )
 
     @property
@@ -765,10 +768,15 @@ class Metric(ABC):
         return dict(self._sync_stats)
 
     def telemetry_snapshot(self) -> Dict[str, Any]:
-        """The three per-owner stats dicts merged into one report:
-        ``{"owner", "dispatch", "sync", "forward"}`` (update-path launches/
-        retraces, sync collectives/buckets/wire bytes, fused-forward
-        launches/retraces/µs — see ``docs/observability.md``)."""
+        """The per-owner stats dicts merged into one report:
+        ``{"owner", "dispatch", "sync", "forward", "resilience",
+        "aot_cache"}`` (update-path launches/retraces, sync collectives/
+        buckets/wire bytes, fused-forward launches/retraces/µs, persistent
+        AOT-cache hits/misses/stores/corrupt — see
+        ``docs/observability.md``). The ``aot_cache`` block is process-wide:
+        the persistent store is shared by every owner."""
+        from metrics_tpu import aot_cache
+
         return {
             "owner": type(self).__name__,
             "dispatch": self.dispatch_stats,
@@ -778,6 +786,7 @@ class Metric(ABC):
                 "dispatch": self._dispatch_resilience.stats(),
                 "forward": self._forward_resilience.stats(),
             },
+            "aot_cache": aot_cache.stats(),
         }
 
     def _move_list_states_to_cpu(self) -> None:

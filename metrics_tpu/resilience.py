@@ -183,9 +183,11 @@ def classify(err: BaseException) -> str:
         return f"injected:{err.fault_name}"
     if isinstance(err, StateCorruptionError):
         return "state-corruption"
-    # by-name check avoids importing dispatch here (metric.py imports both)
+    # by-name checks avoid importing dispatch/aot_cache here (import cycles)
     if type(err).__name__ == "FastDispatchUnsupported":
         return "unsupported"
+    if type(err).__name__ == "CacheCorruptionError":
+        return "cache-corruption"
     return type(err).__name__
 
 

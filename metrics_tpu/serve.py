@@ -1,0 +1,685 @@
+"""Serving harness: multi-tenant metric sessions over the shared engines.
+
+A production evaluation service tracks one metric suite per model /
+experiment / cohort — thousands of independent accumulators receiving
+interleaved traffic. Running them as thousands of ``Metric`` objects
+multiplies every per-step cost by the tenant count: each session would
+launch its own update program per request. This module is the
+multi-tenant layer that makes tenant count nearly free on the hot path:
+
+* **Sessions are rows, not objects.** A :class:`MetricsService` is built
+  from ONE template metric; every named session is a row in a stacked
+  state array per leaf (``(capacity, *leaf_shape)``). Opening a session
+  writes a default row; closing frees it. Capacity grows by powers of
+  two, so thousands of tenants cost exactly the state memory and nothing
+  per-request.
+* **Request coalescing → one launch.** ``submit()`` enqueues; ``flush()``
+  drains the queue, concatenates same-session requests along the batch
+  axis, groups everything by executable signature (input treedef, padded
+  batch bucket, dtypes, static flags), and advances EVERY session in a
+  group with ONE stacked launch: gather the touched rows, ``vmap`` the
+  template's masked pure update across them, scatter the new rows back.
+  Concurrent updates targeting the same executable therefore cost one
+  device program per flush — the structural pin the bench asserts.
+  Padded lanes are exact no-ops twice over: the per-session validity
+  mask zeroes their contribution, and their scatter index is
+  ``capacity`` (out of bounds), which jax scatter semantics drop.
+* **Double-buffered dispatch.** Launches are asynchronous; the service
+  keeps up to ``max_inflight`` result generations pending and only
+  blocks on the oldest when the window fills, so host-side batching
+  overlaps device execution. ``drain()`` barriers everything.
+* **Warm from disk.** The stacked executables ride the same persistent
+  AOT tier as the engines (:mod:`metrics_tpu.aot_cache`, family
+  ``"serve"``): with ``METRICS_TPU_AOT_CACHE`` set, a freshly-started
+  replica deserializes its serving programs instead of compiling them.
+* **Checkpointed state.** ``checkpoint()`` snapshots every session in
+  one fused pass — the stacked leaves ARE the fused layout — with the
+  crc32 checksums from :mod:`metrics_tpu.resilience` attached;
+  ``restore()`` verifies them and raises
+  :class:`~metrics_tpu.resilience.StateCorruptionError` naming the
+  corrupt key rather than silently serving garbage. With
+  ``checkpoint_dir`` set, a checkpoint is written every
+  ``checkpoint_every`` flushes (failures degrade, never crash serving).
+
+Any stacked-launch failure degrades that group to per-request eager
+updates through a :class:`~metrics_tpu.resilience.ResiliencePolicy`
+(cause-tagged ``degrade`` span, exponential-backoff re-promotion), so a
+poisoned request or engine fault costs latency, not correctness.
+Telemetry: every stacked launch is an ``update`` span with kind
+``stacked-aot`` on the ``serve`` stream; compiles carry the usual cause
+tags (``first-compile`` / ``new-signature`` / ``persistent-cache-hit``).
+
+Session handles::
+
+    svc = MetricsService(Accuracy(task="multiclass", num_classes=10))
+    svc.submit("model-a", preds, target)     # or svc.session("model-a").update(...)
+    svc.flush()
+    svc.compute("model-a")
+
+See ``docs/serving.md`` for the full session model and ops guidance.
+"""
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import aot_cache, faults, resilience, telemetry
+from metrics_tpu._compat import profiler_annotation
+from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
+
+__all__ = ["MetricsService", "MetricSession"]
+
+_MIN_SESSION_BUCKET = 8
+_MIN_CAPACITY = 64
+
+
+class MetricSession:
+    """Thin named handle over one service row: ``update`` submits to the
+    shared queue, ``compute`` flushes pending work and evaluates the row."""
+
+    def __init__(self, service: "MetricsService", name: str) -> None:
+        self._service = service
+        self.name = name
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._service.submit(self.name, *args, **kwargs)
+
+    def compute(self) -> Any:
+        return self._service.compute(self.name)
+
+    def close(self) -> None:
+        self._service.close_session(self.name)
+
+
+class MetricsService:
+    """Multi-tenant evaluation service over one template metric.
+
+    Args:
+        template: the metric whose pure update/compute defines every
+            session's semantics. Must hold fixed-shape array state (list
+            states cannot stack) — a template with list state raises
+            ``TypeError``. ``MetricCollection`` templates are rejected:
+            wrap one service per member (the stacked layout needs a single
+            flat leaf row per session).
+        coalesce: concatenate same-session requests along the batch axis
+            before launching (default on; off keeps one launch wave per
+            duplicate submission).
+        checkpoint_dir: directory for periodic checkpoints (``None``
+            disables them; explicit :meth:`checkpoint` calls still work).
+        checkpoint_every: write a checkpoint every N flushes (0 = never).
+        max_inflight: pending result generations before the dispatcher
+            blocks on the oldest (double buffering at the default 2).
+    """
+
+    def __init__(
+        self,
+        template: Any,
+        *,
+        coalesce: bool = True,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 0,
+        max_inflight: int = 2,
+    ) -> None:
+        from metrics_tpu.collections import MetricCollection
+        from metrics_tpu.metric import Metric
+
+        if isinstance(template, MetricCollection):
+            raise TypeError(
+                "MetricsService takes a single Metric template; build one service "
+                "per collection member (stacked session rows need one flat leaf "
+                "layout per session)"
+            )
+        if not isinstance(template, Metric):
+            raise TypeError(f"template must be a Metric, got {type(template).__name__}")
+        defaults = template.default_state()
+        for name, leaf in defaults.items():
+            if isinstance(leaf, list):
+                raise TypeError(
+                    f"template state {name!r} is a list state; sessions need "
+                    "fixed-shape array state to stack"
+                )
+        self.template = template
+        self.label = f"MetricsService[{type(template).__name__}]"
+        self.coalesce = coalesce
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.max_inflight = max(1, int(max_inflight))
+
+        self._names: List[str] = list(defaults)
+        self._default_rows = {k: jnp.asarray(defaults[k]) for k in self._names}
+        self._capacity = _MIN_CAPACITY
+        # the stacked per-leaf state: leaf k has shape (capacity, *leaf_shape)
+        self._stacked: Dict[str, jax.Array] = {
+            k: jnp.broadcast_to(v[None], (self._capacity,) + v.shape).copy()
+            for k, v in self._default_rows.items()
+        }
+        self._rows: Dict[str, int] = {}
+        self._free: List[int] = list(range(self._capacity - 1, -1, -1))
+
+        self._queue: List[Tuple[str, Tuple, Dict]] = []
+        self._queue_lock = threading.Lock()
+        # reentrant: the periodic checkpoint inside flush() drains, and
+        # drain() re-enters flush() on the same thread (the queue is empty
+        # by then, so the inner pass is a no-op)
+        self._flush_lock = threading.RLock()
+        self._inflight: deque = deque()
+
+        self._exec_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._compute_one = None
+        self._compute_stack = None
+        self._seen_signatures: set = set()
+        self._namespace = aot_cache.owner_namespace(template)
+        self._policy = resilience.ResiliencePolicy()
+        self._flushes = 0
+        self.stats: Dict[str, int] = {
+            "submits": 0,
+            "flushes": 0,
+            "launches": 0,
+            "coalesced_requests": 0,
+            "fallback_requests": 0,
+            "retraces": 0,
+            "checkpoints": 0,
+            "evictions": 0,
+        }
+
+    # -------------------------------------------------------------- sessions
+    @property
+    def session_count(self) -> int:
+        return len(self._rows)
+
+    def session(self, name: str) -> MetricSession:
+        """Named handle (opens the session lazily on first use)."""
+        return MetricSession(self, name)
+
+    def open_session(self, name: str) -> int:
+        """Assign a state row to ``name`` (idempotent); returns the row."""
+        row = self._rows.get(name)
+        if row is not None:
+            return row
+        if not self._free:
+            self._grow()
+        row = self._free.pop()
+        self._rows[name] = row
+        return row
+
+    def close_session(self, name: str) -> None:
+        """Release ``name``'s row back to the pool (state reset to default)."""
+        row = self._rows.pop(name, None)
+        if row is None:
+            return
+        for k in self._names:
+            self._stacked[k] = self._stacked[k].at[row].set(self._default_rows[k])
+        self._free.append(row)
+
+    def reset_session(self, name: str) -> None:
+        """Reset one session's accumulator to the default state."""
+        row = self.open_session(name)
+        for k in self._names:
+            self._stacked[k] = self._stacked[k].at[row].set(self._default_rows[k])
+
+    def _grow(self) -> None:
+        old = self._capacity
+        self._capacity = old * 2
+        for k in self._names:
+            pad = jnp.broadcast_to(
+                self._default_rows[k][None], (old,) + self._default_rows[k].shape
+            )
+            self._stacked[k] = jnp.concatenate([self._stacked[k], pad], axis=0)
+        self._free.extend(range(self._capacity - 1, old - 1, -1))
+        # capacity is part of every executable signature; a growth step
+        # retires the old programs
+        self._exec_cache.clear()
+        self._compute_stack = None
+
+    # --------------------------------------------------------------- intake
+    def submit(self, name: str, *args: Any, **kwargs: Any) -> None:
+        """Enqueue one update for session ``name`` (thread-safe, non-blocking;
+        the device work happens at the next :meth:`flush`)."""
+        self.open_session(name)
+        with self._queue_lock:
+            self._queue.append((name, args, kwargs))
+            self.stats["submits"] += 1
+
+    def update(self, name: str, *args: Any, **kwargs: Any) -> None:
+        """Synchronous convenience: submit + flush."""
+        self.submit(name, *args, **kwargs)
+        self.flush()
+
+    # ---------------------------------------------------------------- flush
+    def flush(self) -> int:
+        """Drain the request queue into stacked launches; returns the number
+        of requests served. Coalesces same-session requests, groups by
+        executable signature, and issues ONE launch per group per wave."""
+        with self._flush_lock:
+            with self._queue_lock:
+                pending, self._queue = self._queue, []
+            if not pending:
+                return 0
+            served = len(pending)
+            if self.coalesce:
+                pending = self._coalesce(pending)
+            # waves: a session may appear once per launch (its row is
+            # gathered/scattered exactly once), so duplicates that survived
+            # coalescing serialize across waves
+            while pending:
+                wave: "OrderedDict[str, Tuple[str, Tuple, Dict]]" = OrderedDict()
+                rest: List[Tuple[str, Tuple, Dict]] = []
+                for entry in pending:
+                    if entry[0] in wave:
+                        rest.append(entry)
+                    else:
+                        wave[entry[0]] = entry
+                self._run_wave(list(wave.values()))
+                pending = rest
+            self._flushes += 1
+            self.stats["flushes"] += 1
+            if (
+                self.checkpoint_every > 0
+                and self.checkpoint_dir is not None
+                and self._flushes % self.checkpoint_every == 0
+            ):
+                try:
+                    self.checkpoint()
+                except Exception as err:  # noqa: BLE001 - checkpointing must
+                    # never take serving down; the span records the cause
+                    resilience.record_degrade(self.label, "checkpoint", err)
+            return served
+
+    def drain(self) -> None:
+        """Barrier: flush the queue and block until every launch retired."""
+        self.flush()
+        while self._inflight:
+            leaves = self._inflight.popleft()
+            for leaf in leaves:
+                leaf.block_until_ready()
+
+    def _coalesce(self, pending: List[Tuple[str, Tuple, Dict]]) -> List[Tuple[str, Tuple, Dict]]:
+        """Concatenate same-session requests along the batch axis where the
+        shapes allow it (same treedef, every leaf batched, same trailing
+        dims); anything else passes through untouched."""
+        by_session: "OrderedDict[str, List[Tuple[str, Tuple, Dict]]]" = OrderedDict()
+        for entry in pending:
+            by_session.setdefault(entry[0], []).append(entry)
+        out: List[Tuple[str, Tuple, Dict]] = []
+        for name, entries in by_session.items():
+            if len(entries) > 1:
+                merged = self._try_concat(name, entries)
+                if merged is not None:
+                    self.stats["coalesced_requests"] += len(entries) - 1
+                    out.append(merged)
+                    continue
+            out.extend(entries)
+        return out
+
+    def _try_concat(self, name: str, entries) -> Optional[Tuple[str, Tuple, Dict]]:
+        flats, treedefs = [], []
+        for _, args, kwargs in entries:
+            flat, treedef = jax.tree_util.tree_flatten((args, kwargs))
+            flat = [jnp.asarray(x) for x in flat]
+            # every leaf batched on a shared axis 0, or the request cannot
+            # merge (scalar/static-flag requests stay separate waves)
+            if not flat or any(x.ndim < 1 for x in flat):
+                return None
+            if len({int(x.shape[0]) for x in flat}) != 1:
+                return None
+            flats.append(flat)
+            treedefs.append(treedef)
+        if any(t != treedefs[0] for t in treedefs[1:]):
+            return None
+        for leaves in zip(*flats):
+            if any(
+                x.shape[1:] != leaves[0].shape[1:] or x.dtype != leaves[0].dtype
+                for x in leaves[1:]
+            ):
+                return None
+        merged = [jnp.concatenate(list(leaves), axis=0) for leaves in zip(*flats)]
+        args, kwargs = jax.tree_util.tree_unflatten(treedefs[0], merged)
+        return (name, args, kwargs)
+
+    # --------------------------------------------------------------- launch
+    def _run_wave(self, entries: List[Tuple[str, Tuple, Dict]]) -> None:
+        """Group one wave by executable signature and launch each group."""
+        from metrics_tpu.metric import _is_static_scalar, _split_static_kwargs
+
+        groups: "OrderedDict[Tuple, List]" = OrderedDict()
+        for name, args, kwargs in entries:
+            if any(_is_static_scalar(v) for v in args) or any(
+                _is_static_scalar(v) for v in kwargs.values()
+            ):
+                args, kwargs = self.template._normalize_update_args(args, kwargs)
+                static, dynamic = _split_static_kwargs(kwargs, numeric_static=False)
+                static_key = tuple(sorted(static.items()))
+            else:
+                static, dynamic, static_key = {}, kwargs, ()
+            try:
+                flat, treedef = jax.tree_util.tree_flatten((args, dynamic))
+                flat = [jnp.asarray(x) for x in flat]
+                batches = {int(x.shape[0]) for x in flat if x.ndim >= 1}
+                if len(batches) != 1 or not all(x.ndim >= 1 for x in flat):
+                    raise ValueError("non-uniform batch axis")
+                batch = batches.pop()
+                sig = (
+                    static_key,
+                    treedef,
+                    tuple((x.shape[1:], x.dtype) for x in flat),
+                    bucket_pow2(batch, minimum=_MIN_SESSION_BUCKET),
+                )
+                groups.setdefault(sig, []).append(
+                    (name, args, dynamic, static, flat, batch)
+                )
+            except Exception:  # noqa: BLE001 - unstackable request shapes
+                self._eager_entry(name, args, dynamic, static)
+        for sig, group in groups.items():
+            self._launch_group(sig, group)
+
+    def _launch_group(self, sig: Tuple, group: List) -> None:
+        static_key, treedef, _, b_bucket = sig
+        static = group[0][3]
+        if not (self.template._masked_update_supported() and self._policy.allow()):
+            for name, args, dynamic, static_kw, _, _ in group:
+                self._eager_entry(name, args, dynamic, static_kw)
+            return
+        s_real = len(group)
+        s_bucket = bucket_pow2(s_real, minimum=_MIN_SESSION_BUCKET)
+
+        idx = np.full((s_bucket,), self._capacity, dtype=np.int32)  # OOB pad: scatter drops
+        n_valid = np.zeros((s_bucket,), dtype=np.int32)
+        flat_rows = None
+        for i, (name, _, _, _, flat, batch) in enumerate(group):
+            idx[i] = self._rows[name]
+            n_valid[i] = batch
+            padded = [pad_axis0(x, b_bucket) for x in flat]
+            if flat_rows is None:
+                flat_rows = [[p] for p in padded]
+            else:
+                for slot, p in zip(flat_rows, padded):
+                    slot.append(p)
+        stacked_flat = [
+            jnp.stack(slot + [jnp.zeros_like(slot[0])] * (s_bucket - s_real))
+            for slot in (flat_rows or [])
+        ]
+
+        key = (
+            "serve",
+            static_key,
+            treedef,
+            s_bucket,
+            b_bucket,
+            self._capacity,
+            tuple((x.shape, str(x.dtype)) for x in stacked_flat),
+            tuple((self._stacked[k].shape, str(self._stacked[k].dtype)) for k in self._names),
+        )
+        try:
+            compiled = self._exec_cache.get(key)
+            if compiled is not None:
+                self._exec_cache.move_to_end(key)
+            else:
+                compiled = self._compile_stacked(key, static, treedef, stacked_flat)
+            faults.check("launch", self.label)
+            state_leaves = tuple(self._stacked[k] for k in self._names)
+            t0 = telemetry.clock()
+            with profiler_annotation(f"metrics_tpu.{self.label}.update[stacked-aot]"):
+                out = compiled(
+                    state_leaves,
+                    jnp.asarray(idx),
+                    jnp.asarray(n_valid),
+                    *stacked_flat,
+                )
+                out = tuple(out)
+            telemetry.emit(
+                "update",
+                self.label,
+                "stacked-aot",
+                t0=t0,
+                stream="serve",
+                sessions=s_real,
+                session_bucket=s_bucket,
+                bucket=b_bucket,
+                static_key=static_key or None,
+            )
+            out = faults.maybe_corrupt_leaves(out)
+            for k, leaf in zip(self._names, out):
+                self._stacked[k] = leaf
+            self.stats["launches"] += 1
+            self._policy.note_success()
+            self._inflight.append(out)
+            while len(self._inflight) > self.max_inflight:
+                for leaf in self._inflight.popleft():
+                    leaf.block_until_ready()
+        except Exception as err:  # noqa: BLE001 - degrade the group, keep serving
+            self._policy.note_failure(resilience.classify(err))
+            resilience.record_degrade(self.label, "serve", err, self._policy)
+            for name, args, dynamic, static_kw, _, _ in group:
+                self._eager_entry(name, args, dynamic, static_kw)
+
+    def _compile_stacked(self, key: Tuple, static: Dict, treedef, example_flat) -> Callable:
+        faults.check("compile", self.label)
+        template, names = self.template, self._names
+
+        def fn(state_leaves, idx, n_valid, *flat):
+            # gather: OOB pad indices clamp (harmless — those lanes are
+            # masked out and their scatter index is dropped)
+            rows = tuple(leaf[idx] for leaf in state_leaves)
+
+            def per_session(row_leaves, nv, flat_leaves):
+                args, dyn = jax.tree_util.tree_unflatten(treedef, list(flat_leaves))
+                b_padded = next(x.shape[0] for x in flat_leaves if x.ndim >= 1)
+                mask = jnp.arange(b_padded, dtype=jnp.int32) < nv
+                new = template._masked_pure_update(
+                    dict(zip(names, row_leaves)), mask, *args, **dyn, **static
+                )
+                return tuple(new[k] for k in names)
+
+            new_rows = jax.vmap(per_session)(rows, n_valid, list(flat))
+            return tuple(
+                leaf.at[idx].set(rows_k, mode="drop")
+                for leaf, rows_k in zip(state_leaves, new_rows)
+            )
+
+        example_args = (
+            tuple(self._stacked[k] for k in self._names),
+            jnp.zeros(key[3], jnp.int32),
+            jnp.zeros(key[3], jnp.int32),
+            *example_flat,
+        )
+        t0 = time.perf_counter()
+        loaded = None
+        if aot_cache.cache_enabled():
+            loaded = aot_cache.load(self.label, "serve", key, namespace=self._namespace)
+        if loaded is not None:
+            jax.eval_shape(fn, *example_args)  # replay host trace effects
+            self._seen_signatures.add(key)
+            telemetry.emit(
+                "compile", self.label, "stacked-aot", t0=t0, stream="serve",
+                cause="persistent-cache-hit",
+            )
+            self._cache_put(key, loaded)
+            return loaded
+        cause = "first-compile" if not self._seen_signatures else "new-signature"
+        self._seen_signatures.add(key)
+        jitted = jax.jit(fn)
+        compiled = jitted.lower(*example_args).compile()
+        aot_cache.store(
+            self.label, "serve", key, compiled=compiled,
+            export_fn=lambda: jax.export.export(jitted)(*example_args),
+            namespace=self._namespace,
+        )
+        telemetry.emit(
+            "compile", self.label, "stacked-aot", t0=t0, stream="serve", cause=cause,
+        )
+        self.stats["retraces"] += 1
+        self._cache_put(key, compiled)
+        return compiled
+
+    def _cache_put(self, key: Tuple, compiled: Any) -> None:
+        from metrics_tpu.dispatch import cache_max
+
+        self._exec_cache[key] = compiled
+        self._exec_cache.move_to_end(key)
+        limit = cache_max()
+        while limit > 0 and len(self._exec_cache) > limit:
+            self._exec_cache.popitem(last=False)
+            self.stats["evictions"] += 1
+            telemetry.emit("evict", self.label, "stacked-aot", stream="serve")
+
+    def _eager_entry(self, name: str, args: Tuple, dynamic: Dict, static: Dict) -> None:
+        """Per-request fallback: unstacked pure update on one row (exact
+        semantics, no coalescing) — serves requests the stacked path cannot
+        or while the resilience policy holds it in cooldown."""
+        row = self._rows[name]
+        state = {k: self._stacked[k][row] for k in self._names}
+        new = self.template.pure_update(state, *args, **dynamic, **static)
+        for k in self._names:
+            self._stacked[k] = self._stacked[k].at[row].set(new[k])
+        self.stats["fallback_requests"] += 1
+
+    # -------------------------------------------------------------- results
+    def compute(self, name: str) -> Any:
+        """Flush pending work, then evaluate one session's metric value."""
+        self.flush()
+        row = self._rows.get(name)
+        if row is None:
+            raise KeyError(f"unknown session {name!r}")
+        if self._compute_one is None:
+            template, names = self.template, self._names
+
+            def compute_one(leaves, idx):
+                return template.pure_compute({k: leaf[idx] for k, leaf in zip(names, leaves)})
+
+            self._compute_one = jax.jit(compute_one)
+        return self._compute_one(
+            tuple(self._stacked[k] for k in self._names), jnp.asarray(row, jnp.int32)
+        )
+
+    def compute_all(self) -> Dict[str, Any]:
+        """Flush, then evaluate EVERY open session in one vmapped program
+        (per-session fallback if the compute does not vmap)."""
+        self.flush()
+        if not self._rows:
+            return {}
+        names_sorted = sorted(self._rows)
+        idx = jnp.asarray([self._rows[n] for n in names_sorted], jnp.int32)
+        try:
+            if self._compute_stack is None:
+                template, names = self.template, self._names
+
+                def compute_rows(leaves, idx):
+                    return jax.vmap(
+                        lambda i: template.pure_compute(
+                            {k: leaf[i] for k, leaf in zip(names, leaves)}
+                        )
+                    )(idx)
+
+                self._compute_stack = jax.jit(compute_rows)
+            stacked_vals = self._compute_stack(
+                tuple(self._stacked[k] for k in self._names), idx
+            )
+            return {
+                n: jax.tree_util.tree_map(lambda v: v[i], stacked_vals)
+                for i, n in enumerate(names_sorted)
+            }
+        except Exception as err:  # noqa: BLE001 - e.g. value-dependent compute
+            resilience.record_degrade(self.label, "compute", err)
+            return {n: self.compute(n) for n in names_sorted}
+
+    # ----------------------------------------------------------- checkpoint
+    def _checkpoint_path(self, path: Optional[str]) -> str:
+        if path is not None:
+            return path
+        if self.checkpoint_dir is None:
+            raise ValueError("no checkpoint path given and no checkpoint_dir configured")
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        return os.path.join(self.checkpoint_dir, "metrics_service.ckpt.npz")
+
+    def checkpoint(self, path: Optional[str] = None) -> str:
+        """Write every session's state in one fused pass: the stacked leaves
+        plus the session table, crc32-checksummed
+        (:func:`metrics_tpu.resilience.attach_checksums`), written atomically.
+        Returns the path."""
+        path = self._checkpoint_path(path)
+        self.drain()
+        # scalar template attrs ride along: some metrics determine config
+        # lazily from their first inputs (e.g. a task mode), and a restored
+        # service must be able to compute() before its first update
+        template_attrs = {
+            k: v
+            for k, v in vars(self.template).items()
+            if not k.startswith("_")
+            and k not in self._names
+            and isinstance(v, (bool, int, float, str, type(None)))
+        }
+        meta = json.dumps(
+            {
+                "rows": self._rows,
+                "capacity": self._capacity,
+                "template": type(self.template).__name__,
+                "template_attrs": template_attrs,
+            }
+        )
+        payload: Dict[str, Any] = {
+            f"state::{k}": np.asarray(self._stacked[k]) for k in self._names
+        }
+        payload["__meta__"] = np.frombuffer(meta.encode(), dtype=np.uint8)
+        payload = resilience.attach_checksums(payload)
+        t0 = telemetry.clock()
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+        telemetry.emit(
+            "checkpoint", self.label, "serve", t0=t0, stream="serve",
+            sessions=len(self._rows), path=os.path.basename(path),
+        )
+        self.stats["checkpoints"] += 1
+        return path
+
+    def restore(self, path: Optional[str] = None) -> None:
+        """Install a checkpoint written by :meth:`checkpoint`. Checksums are
+        verified first — corruption raises
+        :class:`~metrics_tpu.resilience.StateCorruptionError` naming the
+        corrupt key instead of silently serving wrong values."""
+        path = self._checkpoint_path(path)
+        with np.load(path) as data:
+            payload = {k: data[k] for k in data.files}
+        resilience.verify_checksums(payload)
+        payload = resilience.strip_checksums(payload)
+        meta = json.loads(bytes(payload.pop("__meta__")).decode())
+        if meta["template"] != type(self.template).__name__:
+            raise resilience.StateCorruptionError(
+                f"checkpoint holds {meta['template']} state, service template is "
+                f"{type(self.template).__name__}"
+            )
+        self._capacity = int(meta["capacity"])
+        for k, v in meta.get("template_attrs", {}).items():
+            try:
+                setattr(self.template, k, v)
+            except Exception:  # noqa: BLE001 - read-only/derived attrs
+                pass
+        self._stacked = {
+            k: jnp.asarray(payload[f"state::{k}"]) for k in self._names
+        }
+        self._rows = {str(n): int(r) for n, r in meta["rows"].items()}
+        used = set(self._rows.values())
+        self._free = [r for r in range(self._capacity - 1, -1, -1) if r not in used]
+        self._exec_cache.clear()
+        self._compute_stack = None
+        self._compute_one = None
+
+    # ---------------------------------------------------------------- stats
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        """Service counters + resilience state + the process-wide persistent
+        AOT-cache stats (same shape as ``Metric.telemetry_snapshot``)."""
+        return {
+            "owner": self.label,
+            "serve": dict(self.stats),
+            "sessions": self.session_count,
+            "capacity": self._capacity,
+            "resilience": self._policy.stats(),
+            "aot_cache": aot_cache.stats(),
+        }

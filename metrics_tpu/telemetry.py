@@ -10,7 +10,9 @@ all feed now. Every hot-path phase is one :class:`TelemetryEvent`:
 ``name``   what one event stands for
 ========== ============================================================
 update     one update-path device-program launch (kinds ``aot`` /
-           ``fused-aot`` / ``jit`` / ``eager``)
+           ``fused-aot`` / ``jit`` / ``eager``; the serving harness's
+           multi-session launches carry ``stacked-aot`` with a
+           ``sessions`` attr — see :mod:`metrics_tpu.serve`)
 forward    one fused forward-step launch (state advance + batch value,
            kinds ``aot`` / ``fused-aot``; the legacy collection jit
            step carries ``kind="jit"`` and ``stream="dispatch"``)
@@ -20,15 +22,26 @@ reset      one ``reset()`` (instant — zero duration)
 compile    one compilation, tagged with WHY it happened (``cause`` attr:
            ``first-compile`` / ``new-static-key`` / ``new-shape-bucket``
            / ``new-dtype`` / ``new-signature`` / ``new-input-signature``
-           / ``unattributed``)
+           / ``unattributed`` / ``persistent-cache-hit`` — the last
+           means the executable was DESERIALIZED from the on-disk AOT
+           store (:mod:`metrics_tpu.aot_cache`) instead of compiled; it
+           counts no retrace)
 collective one interconnect launch (kinds ``fused``/``gather``/
            ``reduce``), with payload ``nbytes`` in the attrs
 degrade    one resilience-engine demotion (kinds ``forward`` /
            ``dispatch`` / ``fused`` / ``collective``), tagged with WHY
            (``cause`` attr: ``injected:<fault>`` / ``unsupported`` /
-           ``state-corruption`` / the exception type name /
-           ``recovered`` for a retry that then succeeded) plus the
-           backoff cooldown — see :mod:`metrics_tpu.resilience`
+           ``state-corruption`` / ``cache-corruption`` / the exception
+           type name / ``recovered`` for a retry that then succeeded)
+           plus the backoff cooldown — see :mod:`metrics_tpu.resilience`
+evict      one LRU eviction from an in-process executable cache
+           (``METRICS_TPU_CACHE_MAX``; kinds mirror the evicting
+           engine's launch kinds)
+aot-cache  one persistent-store access (kinds ``hit`` / ``miss`` /
+           ``store`` / ``corrupt`` / ``store-error`` — see
+           :mod:`metrics_tpu.aot_cache`)
+checkpoint one fused serving-state checkpoint write with crc32
+           checksums attached (:mod:`metrics_tpu.serve`)
 ========== ============================================================
 
 Events carry the owner (metric class name or ``MetricCollection``), a

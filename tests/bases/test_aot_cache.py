@@ -1,0 +1,314 @@
+"""Persistent AOT executable cache (metrics_tpu/aot_cache.py).
+
+The acceptance scenario of the zero-warmup PR: subprocess A populates a
+persistent store for the standard 5-member classification suite,
+subprocess B (a genuinely fresh interpreter) runs the same eval and must
+see ZERO fresh-compile events — every executable deserializes from disk
+(compile cause ``persistent-cache-hit``) — with bit-identical results.
+Alongside: fingerprint/salt isolation, corruption-to-miss conversion,
+the default-off kill switch, and the in-process LRU cap the executable
+dicts gained in the same PR.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import aot_cache, faults, telemetry
+
+_REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+
+
+# ------------------------------------------------------------- unit tier
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_AOT_CACHE", raising=False)
+    assert aot_cache.cache_dir() is None
+    assert not aot_cache.cache_enabled()
+    assert aot_cache.entry_path("x", "update", ("k",)) is None
+    assert aot_cache.load("x", "update", ("k",)) is None
+    assert not aot_cache.store("x", "update", ("k",), compiled=object())
+
+
+@pytest.mark.parametrize("off", ["0", "false", "off", ""])
+def test_kill_switch_values(monkeypatch, off):
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", off)
+    assert aot_cache.cache_dir() is None
+
+
+def test_roundtrip_executable(tmp_path, monkeypatch):
+    """store -> load round trip of a real compiled executable: the loaded
+    callable computes the same values without tracing anything."""
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", str(tmp_path))
+    x = jnp.arange(8, dtype=jnp.float32)
+    jitted = jax.jit(lambda a: a * 2 + 1)
+    compiled = jitted.lower(x).compile()
+    assert aot_cache.store("t", "update", ("k1",), compiled=compiled,
+                           export_fn=lambda: jax.export.export(jitted)(x))
+
+    loaded = aot_cache.load("t", "update", ("k1",))
+    assert loaded is not None
+    np.testing.assert_array_equal(np.asarray(loaded(x)), np.asarray(compiled(x)))
+    # a different key is a clean miss
+    assert aot_cache.load("t", "update", ("k2",)) is None
+
+
+def test_corruption_is_a_miss_with_degrade_span(tmp_path, monkeypatch):
+    """Any on-disk damage — here a byte flip in the body — must convert the
+    load into a miss: poisoned file unlinked, ``corrupt`` counter bumped,
+    cause-tagged degrade span emitted, and NEVER an exception."""
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", str(tmp_path))
+    x = jnp.arange(4, dtype=jnp.float32)
+    jitted = jax.jit(lambda a: a + 1)
+    compiled = jitted.lower(x).compile()
+    assert aot_cache.store("t", "update", ("k",), compiled=compiled,
+                           export_fn=lambda: jax.export.export(jitted)(x))
+    path = aot_cache.entry_path("t", "update", ("k",))
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+
+    before = aot_cache.stats()["corrupt"]
+    with telemetry.instrument() as t:
+        assert aot_cache.load("t", "update", ("k",)) is None
+    assert aot_cache.stats()["corrupt"] == before + 1
+    assert not os.path.exists(path)  # poisoned entry unlinked
+    spans = t.spans(name="degrade", kind="aot-cache")
+    assert spans and spans[0].attrs["cause"] == "cache-corruption"
+
+
+def test_truncated_and_garbage_files_are_misses(tmp_path, monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", str(tmp_path))
+    path = aot_cache.entry_path("t", "update", ("k",))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    for payload in (b"", b"not-the-magic", aot_cache._MAGIC + b"deadbeef\nshort"):
+        with open(path, "wb") as f:
+            f.write(payload)
+        assert aot_cache.load("t", "update", ("k",)) is None
+
+
+def test_injected_cache_corruption_fault(tmp_path, monkeypatch):
+    """The ``cache-corruption`` fault class flips bits AFTER the read — the
+    checksum tier must catch it exactly like real disk damage."""
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", str(tmp_path))
+    x = jnp.arange(4, dtype=jnp.float32)
+    jitted = jax.jit(lambda a: a + 1)
+    assert aot_cache.store("t", "update", ("k",), compiled=jitted.lower(x).compile(),
+                           export_fn=lambda: jax.export.export(jitted)(x))
+    with faults.inject("cache-corruption") as spec:
+        assert aot_cache.load("t", "update", ("k",)) is None
+    assert spec.fired == 1
+
+
+def test_owner_namespace_separates_lookalike_owners(tmp_path, monkeypatch):
+    """Two owners with identical engine keys but different config must map
+    to different entry paths (the namespace folds class + config in)."""
+    from metrics_tpu import Accuracy
+
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", str(tmp_path))
+    ns_a = aot_cache.owner_namespace(Accuracy(num_classes=4))
+    ns_b = aot_cache.owner_namespace(Accuracy(num_classes=8))
+    ns_a2 = aot_cache.owner_namespace(Accuracy(num_classes=4))
+    assert ns_a == ns_a2  # deterministic across instances
+    assert ns_a != ns_b
+    key = ("k",)
+    assert aot_cache.entry_path("t", "update", key, ns_a) != aot_cache.entry_path(
+        "t", "update", key, ns_b
+    )
+
+
+def test_owner_namespace_excludes_mutable_state(monkeypatch):
+    """State leaves are accumulators: updating the metric must NOT move its
+    namespace (or a long-lived process would stop matching its own disk
+    entries). Config attrs a metric determines lazily on first update
+    (e.g. Accuracy's ``mode``) ARE allowed to join then — the dispatcher
+    captures the namespace once, at its own creation."""
+    from tests.bases.test_chaos import FloatSum
+
+    m = FloatSum()
+    ns_fresh = aot_cache.owner_namespace(m)
+    m.update(jnp.asarray([1.0, 2.0, 3.0]))
+    m.update(jnp.asarray([4.0]))
+    assert aot_cache.owner_namespace(m) == ns_fresh
+
+
+def test_salt_changes_fingerprint(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_AOT_CACHE_SALT", raising=False)
+    fp = aot_cache.fingerprint()
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE_SALT", "v2")
+    assert aot_cache.fingerprint() != fp
+    monkeypatch.delenv("METRICS_TPU_AOT_CACHE_SALT", raising=False)
+    assert aot_cache.fingerprint() == fp
+
+
+# -------------------------------------------------- engine wiring (in-proc)
+def test_dispatcher_persists_and_reloads_in_process(tmp_path, monkeypatch):
+    """A fresh dispatcher (new metric instance, same config) must serve its
+    first compile from the persistent tier with cause
+    ``persistent-cache-hit`` and zero value drift."""
+    from metrics_tpu import Accuracy
+
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", str(tmp_path))
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(32, 4).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 4, 32))
+
+    producer = Accuracy(num_classes=4, average="macro", jit_update=True)
+    producer.update(preds, target)
+    ref = np.asarray(producer.compute())
+    assert aot_cache.stats()["stores"] >= 1
+
+    consumer = Accuracy(num_classes=4, average="macro", jit_update=True)
+    with telemetry.instrument() as t:
+        consumer.update(preds, target)
+    causes = {e.attrs.get("cause") for e in t.spans(name="compile")}
+    assert causes == {"persistent-cache-hit"}
+    np.testing.assert_array_equal(np.asarray(consumer.compute()), ref)
+
+
+def test_cache_off_matches_todays_behavior(monkeypatch):
+    """``METRICS_TPU_AOT_CACHE=0`` restores the pre-PR path exactly: first
+    compile carries the classic cause, no aot-cache events at all."""
+    from metrics_tpu import Accuracy
+
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", "0")
+    m = Accuracy(num_classes=4, average="macro", jit_update=True)
+    with telemetry.instrument() as t:
+        m.update(jnp.asarray(np.eye(4, dtype=np.float32)), jnp.asarray([0, 1, 2, 3]))
+    causes = {e.attrs.get("cause") for e in t.spans(name="compile")}
+    assert "persistent-cache-hit" not in causes
+    assert not t.spans(name="aot-cache")
+    np.testing.assert_allclose(np.asarray(m.compute()), 1.0)
+
+
+def test_lru_cap_evicts_with_telemetry(monkeypatch):
+    """``METRICS_TPU_CACHE_MAX`` bounds the in-process executable dicts:
+    distinct shape buckets beyond the cap evict the oldest entry with an
+    ``evict`` telemetry event and an ``evictions`` stat bump."""
+    from metrics_tpu import dispatch
+    from tests.bases.test_chaos import FloatSum
+
+    monkeypatch.delenv("METRICS_TPU_AOT_CACHE", raising=False)
+    monkeypatch.setenv("METRICS_TPU_CACHE_MAX", "2")
+    assert dispatch.cache_max() == 2
+    m = FloatSum(jit_update=True)
+    with telemetry.instrument() as t:
+        for size in (8, 16, 32, 64):  # four pow2 buckets -> four executables
+            m.update(jnp.ones((size,), dtype=jnp.float32))
+    assert len(m._dispatcher._cache) <= 2
+    assert m.dispatch_stats["evictions"] >= 2
+    assert len(t.spans(name="evict")) >= 2
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(120.0, dtype=np.float32))
+
+
+def test_cache_max_default_and_invalid(monkeypatch):
+    from metrics_tpu import dispatch
+
+    monkeypatch.delenv("METRICS_TPU_CACHE_MAX", raising=False)
+    assert dispatch.cache_max() == 256
+    monkeypatch.setenv("METRICS_TPU_CACHE_MAX", "not-a-number")
+    assert dispatch.cache_max() == 256
+    monkeypatch.setenv("METRICS_TPU_CACHE_MAX", "0")
+    assert dispatch.cache_max() == 0  # unlimited
+
+
+# ------------------------------------------------- cross-process warm start
+_CHILD = r"""
+import json
+import jax, jax.numpy as jnp
+import numpy as np
+from metrics_tpu import (
+    Accuracy, ConfusionMatrix, F1Score, MetricCollection, Precision, Recall, telemetry,
+)
+
+C = 8
+rng = np.random.RandomState(3)
+logits = rng.rand(64, C).astype(np.float32)
+preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+target = jnp.asarray(rng.randint(0, C, 64))
+col = MetricCollection(
+    {
+        "acc": Accuracy(num_classes=C, average="macro"),
+        "cm": ConfusionMatrix(num_classes=C),
+        "f1": F1Score(num_classes=C, average="macro"),
+        "prec": Precision(num_classes=C, average="macro"),
+        "rec": Recall(num_classes=C, average="macro"),
+    },
+    fused_update=True,
+    compute_groups=False,
+)
+for _ in range(3):
+    col.update(preds, target)
+vals = col.compute()
+snap = telemetry.snapshot()
+causes = {k.split("compile:cause:", 1)[1]: int(v)
+          for k, v in snap.items() if k.startswith("compile:cause:")}
+print(json.dumps({
+    "values": {k: np.asarray(v).tolist() for k, v in vals.items()},
+    "causes": causes,
+}))
+"""
+
+
+def _run_child(cache_dir, salt=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["METRICS_TPU_AOT_CACHE"] = str(cache_dir)
+    env.pop("METRICS_TPU_INJECT_FAULT", None)
+    if salt is not None:
+        env["METRICS_TPU_AOT_CACHE_SALT"] = salt
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD], capture_output=True, text=True,
+        timeout=240, env=env, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_cross_process_warm_start(tmp_path):
+    """THE acceptance pin: process A populates the store for the 5-member
+    classification suite; fresh process B must pay ZERO fresh compiles —
+    every executable arrives via ``persistent-cache-hit`` — and produce
+    bit-identical values."""
+    cold = _run_child(tmp_path)
+    assert sum(cold["causes"].values()) >= 1
+    assert cold["causes"].get("persistent-cache-hit", 0) == 0
+
+    warm = _run_child(tmp_path)
+    fresh_compiles = {c: n for c, n in warm["causes"].items()
+                      if c != "persistent-cache-hit" and n}
+    assert not fresh_compiles, f"warm process still compiled: {fresh_compiles}"
+    assert warm["causes"].get("persistent-cache-hit", 0) >= 1
+    assert warm["values"] == cold["values"]  # bit-identical round trip
+
+
+def test_fingerprint_mismatch_is_clean_all_miss(tmp_path, monkeypatch):
+    """A different deployment fingerprint (here: the salt knob; same
+    mechanism as a jax upgrade or topology change) must never load another
+    fingerprint's entries — fresh compile, same values."""
+    from metrics_tpu import Accuracy
+
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", str(tmp_path))
+    rng = np.random.RandomState(5)
+    preds = jnp.asarray(rng.rand(16, 4).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 4, 16))
+
+    producer = Accuracy(num_classes=4, average="macro", jit_update=True)
+    producer.update(preds, target)
+    ref = np.asarray(producer.compute())
+
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE_SALT", "other-deployment")
+    hits_before = aot_cache.stats()["hits"]
+    consumer = Accuracy(num_classes=4, average="macro", jit_update=True)
+    with telemetry.instrument() as t:
+        consumer.update(preds, target)
+    assert aot_cache.stats()["hits"] == hits_before  # nothing crossed over
+    causes = {e.attrs.get("cause") for e in t.spans(name="compile")}
+    assert "persistent-cache-hit" not in causes and causes
+    np.testing.assert_array_equal(np.asarray(consumer.compute()), ref)

@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 21
+    assert len(skipped) == 22
     assert "detail_elapsed_s" in detail
 
 
@@ -222,6 +222,21 @@ def test_resilience_overhead_config_counts_and_keys(monkeypatch):
     assert 0 < detail["resilience_idle_overhead_ratio"] < 2.0
     assert os.environ.get("METRICS_TPU_RESILIENCE") is None or (
         os.environ["METRICS_TPU_RESILIENCE"] != "0")
+
+
+def test_serving_config_counts_and_keys(monkeypatch):
+    """Pin the serving bench config at test-budget scale: the structural
+    claim is 'N concurrent same-executable session updates cost exactly ONE
+    stacked launch per flush'. The coldstart subprocess pair is exercised by
+    the warm-start tests in tests/bases/test_aot_cache.py; here it is
+    skipped so tier-1 stays inside its time budget."""
+    monkeypatch.delenv("METRICS_TPU_AOT_CACHE", raising=False)
+    detail = {}
+    bench._cfg_serving(detail, sessions=96, coldstart=False)
+    assert detail["serve_coalesced_launches_per_step"] == 1
+    assert detail["serve_sessions"] == 96
+    assert detail["serve_updates_per_sec_1k_sessions"] > 0
+    assert "coldstart_first_result_us_cold" not in detail
 
 
 def test_cg_configs_record_host_pinning():

@@ -2,8 +2,9 @@
 metrics_tpu/faults.py).
 
 Every injectable fault class — compile, launch, oom, NaN-poisoned inputs,
-state-leaf corruption, collective failure — is forced on through the REAL
-injection points inside the engines, and each scenario must end with:
+state-leaf corruption, collective failure, persistent-cache corruption —
+is forced on through the REAL injection points inside the engines, and
+each scenario must end with:
 
 1. the call served by the eager/legacy path **bit-identical** to a
    never-faulted run (the failure never escapes to the caller),
@@ -208,7 +209,7 @@ def test_env_var_fault_activation(monkeypatch):
 
 def test_ambient_env_fault_parity():
     """The `make chaos` env-forced lane: whatever fault class
-    ``METRICS_TPU_INJECT_FAULT`` forces process-wide (any of the six, any
+    ``METRICS_TPU_INJECT_FAULT`` forces process-wide (any of the seven, any
     probability), a full update/forward/compute run must stay bit-identical
     to the never-faulted eager reference — no assertions here depend on
     WHICH fault is ambient. Without the env var this is a plain engine-vs-
@@ -308,3 +309,75 @@ def test_fused_sync_engine_failure_degrades_to_per_leaf(monkeypatch):
     spans = t.spans(name="degrade", kind="sync")
     assert spans and spans[0].attrs["cause"] == "RuntimeError"
     np.testing.assert_array_equal(total, np.asarray(6.0, dtype=np.float32))
+
+
+# ------------------------------------------------- persistent cache (aot)
+def test_cache_corruption_degrades_to_fresh_compile(tmp_path, monkeypatch):
+    """A poisoned persistent-cache entry must degrade to a fresh compile —
+    never a crash, never a wrong value. The fault bit-flips every blob
+    after read, so the checksum tier converts each load into a miss with a
+    cause-tagged degrade span, and the call is served by a REAL compile
+    (no ``persistent-cache-hit`` may appear)."""
+    from metrics_tpu import aot_cache
+
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", str(tmp_path))
+    batches = _batches()
+    ref = FloatSum()
+    for v in batches:
+        ref.update(v)
+
+    # populate the store with a healthy producer process-alike
+    warm = FloatSum(jit_update=True)
+    for v in batches:
+        warm.update(v)
+    assert aot_cache.stats()["stores"] >= 1
+
+    # a fresh owner consults the persistent tier; every load is poisoned
+    m = FloatSum(jit_update=True)
+    with telemetry.instrument() as t, faults.inject("cache-corruption") as spec:
+        for v in batches:
+            m.update(v)
+    assert spec.fired >= 1, "fault never reached the cache load path"
+
+    np.testing.assert_array_equal(np.asarray(m.total), np.asarray(ref.total))
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+    spans = t.spans(name="degrade", kind="aot-cache")
+    assert spans, "no cache-corruption degrade span emitted"
+    assert {s.attrs["cause"] for s in spans} == {"cache-corruption"}
+    causes = {e.attrs.get("cause") for e in t.spans(name="compile")}
+    assert "persistent-cache-hit" not in causes and causes  # real compile served it
+    # the poisoned file was unlinked and the fresh compile re-stored it
+    assert aot_cache.stats()["corrupt"] >= 1
+
+
+def test_ambient_persistent_cache_parity(tmp_path, monkeypatch):
+    """Ambient-chaos lane for the persistent tier (`make chaos` forces each
+    fault class through ``METRICS_TPU_INJECT_FAULT`` over the ``-k
+    ambient`` selection): with a cache dir configured, a producer
+    populates the store and a fresh consumer reads through it — whatever
+    fault is ambient, the consumer's values must stay bit-identical to the
+    never-faulted eager reference."""
+    import os as _os
+
+    from metrics_tpu import aot_cache
+
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", str(tmp_path))
+    batches = _batches()
+    ref = FloatSum()
+    for v in batches:
+        ref.update(v)
+
+    producer = FloatSum(jit_update=True)
+    for v in batches:
+        producer.update(v)
+
+    corrupt_before = aot_cache.stats()["corrupt"]
+    consumer = FloatSum(jit_update=True)
+    for v in batches:
+        consumer.update(v)
+    np.testing.assert_array_equal(np.asarray(consumer.total), np.asarray(ref.total))
+    np.testing.assert_array_equal(np.asarray(consumer.compute()), np.asarray(ref.compute()))
+    assert bool(np.all(np.isfinite(np.asarray(consumer.total))))
+    if _os.environ.get("METRICS_TPU_INJECT_FAULT", "").split(":")[0] == "cache-corruption":
+        # the ambient fault actually reached the real injection point
+        assert aot_cache.stats()["corrupt"] > corrupt_before

@@ -1,0 +1,339 @@
+"""Multi-tenant metrics service (metrics_tpu/serve.py).
+
+Per-session values must stay bit-identical to a dedicated ``Metric``
+instance per tenant — the stacked gather→vmap(masked-update)→scatter
+program is an optimization, never a semantics change. Launch counts are
+pinned STRUCTURALLY via telemetry: N same-signature session updates per
+flush are exactly ONE ``update:stacked-aot`` span.
+"""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MetricCollection, faults, resilience, telemetry
+from metrics_tpu.serve import MetricsService
+from tests.bases.test_chaos import FloatSum
+
+
+def _acc_service(**kwargs):
+    return MetricsService(Accuracy(task="multiclass", num_classes=8), **kwargs)
+
+
+def _acc_ref():
+    return Accuracy(task="multiclass", num_classes=8)
+
+
+def _batches(n_sessions, steps=2, batch=16, C=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        [
+            (jnp.asarray(rng.randint(0, C, batch)), jnp.asarray(rng.randint(0, C, batch)))
+            for _ in range(steps)
+        ]
+        for _ in range(n_sessions)
+    ]
+
+
+# ---------------------------------------------------------------- semantics
+def test_per_session_parity_with_dedicated_metrics():
+    """20 tenants through the stacked path == 20 dedicated Accuracy
+    instances, bit for bit, via both compute(name) and compute_all()."""
+    n = 20
+    svc = _acc_service()
+    refs = {f"s{i}": _acc_ref() for i in range(n)}
+    for i, steps in enumerate(_batches(n)):
+        for preds, target in steps:
+            svc.submit(f"s{i}", preds, target)
+            refs[f"s{i}"].update(preds, target)
+    svc.drain()
+    all_vals = svc.compute_all()
+    for name, ref in refs.items():
+        want = np.asarray(ref.compute())
+        np.testing.assert_array_equal(np.asarray(svc.compute(name)), want)
+        np.testing.assert_array_equal(np.asarray(all_vals[name]), want)
+
+
+def test_one_stacked_launch_per_flush():
+    """The coalescing pin: one flush serving N same-signature sessions is
+    exactly ONE stacked launch, tagged with the real session count."""
+    n = 24
+    svc = _acc_service()
+    data = _batches(n, steps=1)
+    with telemetry.instrument() as t:
+        for i in range(n):
+            preds, target = data[i][0]
+            svc.submit(f"s{i}", preds, target)
+        svc.flush()
+    spans = t.spans(name="update", kind="stacked-aot")
+    assert len(spans) == 1
+    assert spans[0].attrs["sessions"] == n
+    assert svc.stats["launches"] == 1 and svc.stats["fallback_requests"] == 0
+
+
+def test_same_session_requests_coalesce_along_batch():
+    """Two submissions for ONE session coalesce into one concatenated
+    batch — one launch, values identical to sequential updates."""
+    svc = _acc_service()
+    ref = _acc_ref()
+    a = (jnp.asarray([1, 2, 3, 4]), jnp.asarray([1, 2, 0, 4]))
+    b = (jnp.asarray([5, 6]), jnp.asarray([5, 0]))
+    ref.update(*a)
+    ref.update(*b)
+    with telemetry.instrument() as t:
+        svc.submit("tenant", *a)
+        svc.submit("tenant", *b)
+        svc.flush()
+    assert len(t.spans(name="update", kind="stacked-aot")) == 1
+    assert svc.stats["coalesced_requests"] >= 1
+    np.testing.assert_array_equal(
+        np.asarray(svc.compute("tenant")), np.asarray(ref.compute())
+    )
+
+
+def test_coalesce_off_serializes_across_waves():
+    svc = _acc_service(coalesce=False)
+    ref = _acc_ref()
+    a = (jnp.asarray([1, 2, 3, 4]), jnp.asarray([1, 2, 0, 4]))
+    ref.update(*a)
+    ref.update(*a)
+    with telemetry.instrument() as t:
+        svc.submit("tenant", *a)
+        svc.submit("tenant", *a)
+        svc.flush()
+    # duplicate session entries may not share a scatter: two waves
+    assert len(t.spans(name="update", kind="stacked-aot")) == 2
+    assert svc.stats["coalesced_requests"] == 0
+    np.testing.assert_array_equal(
+        np.asarray(svc.compute("tenant")), np.asarray(ref.compute())
+    )
+
+
+def test_mixed_signatures_split_into_groups():
+    """Different batch buckets are different executables — each group costs
+    one launch, and values still match per-tenant references."""
+    svc = _acc_service()
+    refs = {"small": _acc_ref(), "large": _acc_ref()}
+    rng = np.random.RandomState(2)
+    small = (jnp.asarray(rng.randint(0, 8, 4)), jnp.asarray(rng.randint(0, 8, 4)))
+    large = (jnp.asarray(rng.randint(0, 8, 64)), jnp.asarray(rng.randint(0, 8, 64)))
+    refs["small"].update(*small)
+    refs["large"].update(*large)
+    with telemetry.instrument() as t:
+        svc.submit("small", *small)
+        svc.submit("large", *large)
+        svc.flush()
+    assert len(t.spans(name="update", kind="stacked-aot")) == 2
+    for name, ref in refs.items():
+        np.testing.assert_array_equal(
+            np.asarray(svc.compute(name)), np.asarray(ref.compute())
+        )
+
+
+def test_steady_state_is_retrace_free():
+    svc = _acc_service()
+    data = _batches(8, steps=4, seed=3)
+    for step in range(4):
+        for i in range(8):
+            svc.submit(f"s{i}", *data[i][step])
+        svc.flush()
+    svc.drain()
+    assert svc.stats["retraces"] == 1  # one signature, compiled once
+
+
+# ---------------------------------------------------------------- sessions
+def test_session_lifecycle_and_growth():
+    """200 tenants force two capacity doublings (64 -> 256); closing a
+    session frees its row and resets the state behind it."""
+    n = 200
+    svc = MetricsService(FloatSum())
+    for i in range(n):
+        svc.submit(f"s{i}", jnp.full((4,), float(i), dtype=jnp.float32))
+    svc.drain()
+    assert svc.session_count == n
+    assert svc._capacity == 256
+    np.testing.assert_array_equal(
+        np.asarray(svc.compute("s7")), np.asarray(28.0, dtype=np.float32)
+    )
+
+    svc.close_session("s7")
+    assert svc.session_count == n - 1
+    with pytest.raises(KeyError):
+        svc.compute("s7")
+    # a reopened name starts from the default state (the row was scrubbed)
+    svc.update("s7", jnp.asarray([1.0], dtype=jnp.float32))
+    np.testing.assert_array_equal(
+        np.asarray(svc.compute("s7")), np.asarray(1.0, dtype=np.float32)
+    )
+
+    svc.reset_session("s3")
+    np.testing.assert_array_equal(
+        np.asarray(svc.compute("s3")), np.asarray(0.0, dtype=np.float32)
+    )
+
+
+def test_session_handle_proxies_service():
+    svc = MetricsService(FloatSum())
+    handle = svc.session("tenant")
+    handle.update(jnp.asarray([2.0, 3.0], dtype=jnp.float32))
+    np.testing.assert_array_equal(
+        np.asarray(handle.compute()), np.asarray(5.0, dtype=np.float32)
+    )
+    handle.close()
+    assert svc.session_count == 0
+
+
+def test_template_rejections():
+    with pytest.raises(TypeError, match="single Metric template"):
+        MetricsService(MetricCollection({"acc": Accuracy(num_classes=4)}))
+    with pytest.raises(TypeError, match="must be a Metric"):
+        MetricsService(object())
+
+    class ListState(FloatSum):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("history", [], dist_reduce_fx="cat")
+
+    with pytest.raises(TypeError, match="list state"):
+        MetricsService(ListState())
+
+
+# -------------------------------------------------------------- resilience
+def test_launch_fault_degrades_to_eager_parity():
+    """An injected launch fault must not lose a single request: the group
+    degrades to per-row eager updates with a cause-tagged span, and the
+    values stay bit-identical."""
+    n = 6
+    svc = _acc_service()
+    refs = {f"s{i}": _acc_ref() for i in range(n)}
+    data = _batches(n, steps=1, seed=4)
+    with telemetry.instrument() as t, faults.inject("launch") as spec:
+        for i in range(n):
+            svc.submit(f"s{i}", *data[i][0])
+        svc.flush()
+    assert spec.fired >= 1
+    spans = t.spans(name="degrade", kind="serve")
+    assert spans and spans[0].attrs["cause"] == "injected:launch"
+    assert svc.stats["fallback_requests"] == n
+    for i in range(n):
+        refs[f"s{i}"].update(*data[i][0])
+        np.testing.assert_array_equal(
+            np.asarray(svc.compute(f"s{i}")), np.asarray(refs[f"s{i}"].compute())
+        )
+
+
+def test_unstackable_and_unmaskable_requests_fall_back_per_row():
+    """Requests the stacked path cannot take still serve exactly: a 0-d
+    (batch-axis-free) request fails signature building, and FloatSum has no
+    masked-update support, so even its vector request skips the stacked
+    launch — everything lands on the per-row eager fallback."""
+    svc = MetricsService(FloatSum())
+    assert not svc.template._masked_update_supported()
+    with telemetry.instrument() as t:
+        svc.submit("scalar", jnp.asarray(2.5))  # 0-d: no batch axis to stack
+        svc.submit("vec", jnp.asarray([1.0, 2.0], dtype=jnp.float32))
+        svc.flush()
+    assert svc.stats["fallback_requests"] == 2 and svc.stats["launches"] == 0
+    assert not t.spans(name="update", kind="stacked-aot")
+    np.testing.assert_array_equal(
+        np.asarray(svc.compute("scalar")), np.asarray(2.5, dtype=np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(svc.compute("vec")), np.asarray(3.0, dtype=np.float32)
+    )
+
+
+# -------------------------------------------------------------- checkpoint
+def test_checkpoint_restore_roundtrip(tmp_path):
+    n = 10
+    svc = _acc_service()
+    data = _batches(n, steps=2, seed=5)
+    for i, steps in enumerate(data):
+        for preds, target in steps:
+            svc.submit(f"s{i}", preds, target)
+    svc.drain()
+    want = {f"s{i}": np.asarray(svc.compute(f"s{i}")) for i in range(n)}
+    path = svc.checkpoint(str(tmp_path / "svc.npz"))
+    assert svc.stats["checkpoints"] == 1
+
+    fresh = _acc_service()
+    fresh.restore(path)
+    assert fresh.session_count == n
+    for name, val in want.items():
+        # restore-then-compute: template config persisted in the meta makes
+        # a never-traced fresh service computable immediately
+        np.testing.assert_array_equal(np.asarray(fresh.compute(name)), val)
+    # and the restored service keeps serving
+    fresh.update("s0", *data[0][0])
+    ref = _acc_ref()
+    for preds, target in data[0] + [data[0][0]]:
+        ref.update(preds, target)
+    np.testing.assert_array_equal(np.asarray(fresh.compute("s0")), np.asarray(ref.compute()))
+
+
+def test_corrupted_checkpoint_raises_not_serves(tmp_path):
+    svc = MetricsService(FloatSum())
+    svc.update("tenant", jnp.asarray([1.0, 2.0], dtype=jnp.float32))
+    path = svc.checkpoint(str(tmp_path / "svc.npz"))
+
+    import numpy as _np
+
+    with _np.load(path) as data:
+        payload = {k: data[k] for k in data.files}
+    key = next(k for k in payload if k.startswith("state::"))
+    payload[key] = payload[key] + 1  # silent bit drift
+    with open(path, "wb") as f:
+        _np.savez(f, **payload)
+
+    with pytest.raises(resilience.StateCorruptionError):
+        MetricsService(FloatSum()).restore(path)
+
+
+def test_periodic_checkpointing_rides_flushes(tmp_path):
+    svc = MetricsService(
+        FloatSum(), checkpoint_dir=str(tmp_path), checkpoint_every=2
+    )
+    with telemetry.instrument() as t:
+        for step in range(4):
+            svc.update("tenant", jnp.asarray([float(step)], dtype=jnp.float32))
+    assert svc.stats["checkpoints"] == 2  # flushes 2 and 4
+    assert len(t.spans(name="checkpoint")) == 2
+    assert os.path.exists(os.path.join(str(tmp_path), "metrics_service.ckpt.npz"))
+
+
+# ------------------------------------------------------------ persistence
+def test_serve_programs_ride_the_persistent_tier(tmp_path, monkeypatch):
+    """A fresh service instance (same template config) must deserialize its
+    stacked program from the persistent store instead of compiling."""
+    monkeypatch.setenv("METRICS_TPU_AOT_CACHE", str(tmp_path))
+    data = _batches(4, steps=1, seed=6)
+
+    producer = _acc_service()
+    for i in range(4):
+        producer.submit(f"s{i}", *data[i][0])
+    producer.drain()
+    assert producer.stats["retraces"] == 1
+
+    consumer = _acc_service()
+    with telemetry.instrument() as t:
+        for i in range(4):
+            consumer.submit(f"s{i}", *data[i][0])
+        consumer.drain()
+    causes = {e.attrs.get("cause") for e in t.spans(name="compile")}
+    assert causes == {"persistent-cache-hit"}
+    assert consumer.stats["retraces"] == 0
+    for i in range(4):
+        np.testing.assert_array_equal(
+            np.asarray(consumer.compute(f"s{i}")), np.asarray(producer.compute(f"s{i}"))
+        )
+
+
+def test_telemetry_snapshot_shape():
+    svc = _acc_service()
+    svc.update("tenant", jnp.asarray([1, 2]), jnp.asarray([1, 0]))
+    snap = svc.telemetry_snapshot()
+    assert snap["owner"] == "MetricsService[Accuracy]"
+    assert snap["sessions"] == 1 and snap["capacity"] >= 64
+    assert snap["serve"]["submits"] == 1 and snap["serve"]["launches"] == 1
+    assert set(snap) == {"owner", "serve", "sessions", "capacity", "resilience", "aot_cache"}

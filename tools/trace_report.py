@@ -93,6 +93,34 @@ def summarize(events: List[Dict[str, Any]]) -> str:
         by_kind.setdefault(e.get("kind", "?"), []).append(int((e.get("attrs") or {}).get("nbytes", 0)))
     for kind in sorted(by_kind):
         lines.append(f"  {kind:<8}{len(by_kind[kind]):>5} launches, {sum(by_kind[kind]):>10} bytes")
+
+    # persistent AOT cache + in-process LRU churn (metrics_tpu.aot_cache):
+    # hits are warm starts (compile cause persistent-cache-hit above),
+    # corrupt entries degraded to fresh compiles, evictions are LRU churn
+    cache = {e.get("kind", "?"): 0 for e in events if e["name"] == "aot-cache"}
+    for e in events:
+        if e["name"] == "aot-cache":
+            cache[e.get("kind", "?")] += 1
+    evictions = sum(1 for e in events if e["name"] == "evict")
+    lines.append("")
+    lines.append(
+        "persistent cache: "
+        + "   ".join(
+            f"{k}: {cache.get(k, 0)}" for k in ("hit", "miss", "store", "corrupt")
+        )
+        + f"   evictions: {evictions}"
+    )
+
+    # cold start to first result: process start (trace window origin) to the
+    # retirement of the first value-producing span — the number the
+    # persistent cache exists to shrink
+    first_result = [
+        e for e in events if e["name"] in ("update", "forward", "compute")
+    ]
+    if first_result:
+        first = min(first_result, key=lambda e: e.get("ts_us", 0.0))
+        cold_us = first.get("ts_us", 0.0) + first.get("dur_us", 0.0) - span_start
+        lines.append(f"cold start -> first result: {cold_us:.1f} us ({first['name']}:{first.get('kind', '?')})")
     return "\n".join(lines)
 
 
