@@ -1,8 +1,16 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos doctest bench bench-forward serve-bench trace tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos doctest audit bench bench-forward serve-bench trace tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
+
+# two-front static audit (jaxpr + AST) ratcheted against the checked-in
+# STATIC_AUDIT.json: fails on new findings, on fixed-but-not-rebaselined
+# ones, on unexplained P0s, and on capstone collective-count drift.
+# CPU-only, seconds. Re-accept an intentional change with:
+#   python tools/static_audit.py --write-baseline
+audit:
+	python tools/static_audit.py --diff
 
 # fast iteration lane (VERDICT r3 item 5): one representative file per
 # subsystem — base-class contract incl. real sync machinery + the

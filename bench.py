@@ -324,6 +324,26 @@ def _cfg_sync_engine(detail: dict) -> None:
             os.environ["METRICS_TPU_FUSED_SYNC"] = prev
 
 
+def _cfg_static_audit(detail: dict) -> None:
+    """Static-analysis sweep health: size/latency of the registry audit,
+    the ratchet verdict against the checked-in STATIC_AUDIT.json, and the
+    statically-derived capstone collective counts — the same numbers
+    ``_cfg_sync_engine`` measures dynamically, derived without executing
+    a single collective (tests pin the two equal)."""
+    t0 = time.perf_counter()
+    from metrics_tpu.analysis import report as report_mod
+
+    report = report_mod.build_report()
+    d = report_mod.diff(report, report_mod.load_baseline())
+    detail["audit_metrics_swept"] = report["summary"]["metrics_swept"]
+    detail["audit_device_traced"] = report["summary"]["device_traced"]
+    detail["audit_findings_p0"] = report["summary"]["findings"].get("P0", 0)
+    detail["audit_ratchet_ok"] = bool(d["ok"])
+    detail["audit_capstone_fused_collectives"] = report["capstone"]["fused_collectives"]
+    detail["audit_capstone_perleaf_collectives"] = report["capstone"]["perleaf_collectives"]
+    detail["audit_elapsed_s"] = round(time.perf_counter() - t0, 2)
+
+
 def _cfg_forward_engine(detail: dict) -> None:
     """Fused forward engine observability: structural launch / retrace
     counts for the step path plus engine-vs-eager forward latency.
@@ -1206,6 +1226,7 @@ def _bench_detail() -> dict:
         ("wer_update_ms_1k_pairs", _cfg_wer),
         ("collection_dist_sync_8dev_us", _cfg_dist_sync),
         ("sync_collectives_fused_collection", _cfg_sync_engine),
+        ("audit_metrics_swept", _cfg_static_audit),
         ("forward_launches_single_metric_10_steps", _cfg_forward_engine),
         ("telemetry_idle_overhead_ratio", _cfg_telemetry_overhead),
         ("resilience_idle_overhead_ratio", _cfg_resilience_overhead),

@@ -1,0 +1,40 @@
+"""Static-analysis subsystem: prove the engine invariants instead of timing them.
+
+Two fronts over the whole metric registry:
+
+* :mod:`~metrics_tpu.analysis.jaxpr_audit` — abstract-traces every
+  registered metric's ``pure_update`` / ``pure_compute`` / ``pure_merge``
+  (``jax.make_jaxpr`` / ``jax.eval_shape`` only, no device execution) and
+  walks the jaxprs for dtype-unstable state, host callbacks, collective
+  counts, donation eligibility, and retrace hazards.
+* :mod:`~metrics_tpu.analysis.ast_lint` — ``ast``-based tracer-safety
+  rules over the metric sources (host conversions in pure paths, mutable
+  ``add_state`` defaults, invalid reductions, numpy-on-tracer, Python
+  branching on state).
+
+:mod:`~metrics_tpu.analysis.report` merges both into the checked-in
+``STATIC_AUDIT.json`` baseline with a ratchet (new findings fail; fixed
+ones must be re-baselined); :mod:`~metrics_tpu.analysis.hazards` is the
+tiny read-side the dispatcher uses to tag compile spans with
+predicted-vs-observed retrace hazards. CLI: ``tools/static_audit.py``
+(``make audit``). Docs: ``docs/static_analysis.md``.
+
+This ``__init__`` stays import-light (lazy submodules): the hot path
+imports ``analysis.hazards`` at module load, and the heavy fronts import
+``metrics_tpu`` itself.
+"""
+import importlib
+
+_SUBMODULES = ("ast_lint", "hazards", "jaxpr_audit", "registry", "report")
+
+__all__ = list(_SUBMODULES)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f"metrics_tpu.analysis.{name}")
+    raise AttributeError(f"module 'metrics_tpu.analysis' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

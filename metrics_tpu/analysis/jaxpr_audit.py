@@ -1,0 +1,395 @@
+"""Front 1: abstract jaxpr audit of every registered metric's pure paths.
+
+For each ``device``-scope :class:`~metrics_tpu.analysis.registry.AuditCase`
+this module traces ``pure_update`` / ``pure_compute`` / ``pure_merge``
+with ``jax.make_jaxpr`` (abstract — no device execution anywhere) and
+derives per-metric facts the engines otherwise only *assume*:
+
+* state-leaf dtype/shape/weak-type, declared reduce op, and whether the
+  update is an **aval fixed point** (donation-eligible, retrace-free);
+* host callbacks (``pure_callback``/``debug_print``/…) hiding in pure
+  paths, and collective primitives where none belong;
+* trace failures classified by cause — a ``TracerBoolConversionError``
+  *is* a hidden host sync, a non-concrete boolean index *is* a
+  dynamic-shape op that defeats pow2 bucketing;
+* dtype widening under x64 (the weak-f32→f64 promotion class);
+* the static collective schedule of the fused sync engine, derived from
+  :func:`metrics_tpu.sync_engine.plan_metric_leaves` +
+  :func:`~metrics_tpu.sync_engine.bucket_plan` — the same planning code
+  the runtime executes, so the statically-derived counts are provably
+  the counts the benches pin dynamically.
+
+Rule codes (see docs/static_analysis.md):
+
+====== ==== =========================================================
+JX000  P0   registry gap (exported metric with no audit classification)
+JX101  P1   dtype/aval-unstable state (update output aval != input)
+JX102  P0   weak-typed state default (f64 under x64 + guaranteed retrace)
+JX103  P2   state widens under x64 (e.g. int32 -> int64 accumulators)
+JX201  P0   host callback primitive inside a pure path
+JX301  P0   hidden host sync (trace fails concretizing a traced value)
+JX401  P0   dynamic-shape op in a pure path (defeats pow2 bucketing)
+JX501  P1   collective primitive inside update/compute (none belong)
+====== ==== =========================================================
+"""
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu._compat import enable_x64
+from metrics_tpu import sync_engine
+from metrics_tpu.analysis import registry
+
+# primitive names, matched against eqn.primitive.name across nested jaxprs
+COLLECTIVE_PRIMS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "reduce_scatter", "ppermute", "pbroadcast",
+}
+CALLBACK_PRIMS = {"pure_callback", "debug_callback", "io_callback", "callback"}
+
+
+class Finding(NamedTuple):
+    code: str
+    severity: str  # P0 | P1 | P2
+    metric: str
+    where: str  # state name or program name
+    detail: str
+
+    @property
+    def key(self) -> str:
+        """Stable ratchet identity (no line numbers, no shapes)."""
+        return f"{self.code}:{self.metric}:{self.where}"
+
+
+# ----------------------------------------------------------------- jaxpr walk
+def _extract_jaxprs(value: Any):
+    """Sub-jaxprs buried in an eqn's params (pjit/scan/cond/closed calls)."""
+    if hasattr(value, "jaxpr") and hasattr(value.jaxpr, "eqns"):  # ClosedJaxpr
+        yield value.jaxpr
+    elif hasattr(value, "eqns"):  # raw Jaxpr
+        yield value
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _extract_jaxprs(v)
+
+
+def iter_eqns(jaxpr):
+    """Every equation in ``jaxpr``, recursing into nested call jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for param in eqn.params.values():
+            for sub in _extract_jaxprs(param):
+                yield from iter_eqns(sub)
+
+
+def _classify_trace_error(err: Exception) -> Tuple[str, str]:
+    """Map an abstract-trace failure to its rule code."""
+    name = type(err).__name__
+    if name == "NonConcreteBooleanIndexError":
+        return "JX401", "dynamic-shape op (boolean indexing on traced values)"
+    if "Tracer" in name or name == "ConcretizationTypeError":
+        return "JX301", "hidden host sync (concretizes a traced value)"
+    return "JX301", f"pure path does not trace ({name})"
+
+
+def _program_facts(fn: Callable, *trace_args: Any) -> Dict[str, Any]:
+    """Abstract-trace one pure program; count primitives of interest."""
+    try:
+        closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*trace_args)
+    except Exception as err:  # noqa: BLE001 — the failure IS the finding
+        code, why = _classify_trace_error(err)
+        return {
+            "error": {"rule": code, "type": type(err).__name__, "why": why},
+            "collectives": None, "callbacks": None, "eqns": None, "out": None,
+        }
+    collectives = callbacks = total = 0
+    for eqn in iter_eqns(closed.jaxpr):
+        total += 1
+        prim = eqn.primitive.name
+        if prim in COLLECTIVE_PRIMS:
+            collectives += 1
+        elif prim in CALLBACK_PRIMS or "callback" in prim or prim == "debug_print":
+            callbacks += 1
+    return {
+        "error": None,
+        "collectives": collectives,
+        "callbacks": callbacks,
+        "eqns": total,
+        "out": out_shape,
+    }
+
+
+def _aval_facts(x: Any) -> Dict[str, Any]:
+    return {
+        "dtype": str(jnp.dtype(x.dtype)),
+        "shape": list(getattr(x, "shape", ())),
+        "weak": bool(getattr(x, "weak_type", False)),
+    }
+
+
+def _reduce_name(metric: Any, attr: str) -> Optional[str]:
+    from metrics_tpu.utilities.data import dim_zero_cat
+
+    fx = metric._reductions.get(attr)
+    if fx is None:
+        return None
+    native = sync_engine.NATIVE_REDUCE_OPS.get(fx)
+    if native is not None:
+        return native
+    return "cat" if fx is dim_zero_cat else "custom"
+
+
+def _update_hazards(metric: Any) -> Dict[str, bool]:
+    """Signature-derived retrace hazards (see analysis.hazards)."""
+    import inspect
+
+    static_key = False
+    try:
+        sig = metric._update_signature
+    except AttributeError:
+        sig = inspect.signature(metric.update)
+    for name, p in sig.parameters.items():
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            continue
+        if isinstance(p.default, (bool, str)):
+            static_key = True
+    return {"static-key": static_key, "signature": False}  # signature set from aval facts
+
+
+# --------------------------------------------------------------- metric audit
+def audit_metric(case: registry.AuditCase, pools: Dict[str, Any]) -> Tuple[Dict[str, Any], List[Finding]]:
+    """Facts + findings for one device-scope case (no device execution)."""
+    metric = case.build()
+    args = case.args(pools)
+    name = case.name
+    findings: List[Finding] = []
+
+    state = metric.default_state()
+    states_facts: Dict[str, Any] = {}
+    for attr, leaf in state.items():
+        if isinstance(leaf, list):
+            states_facts[attr] = {"list": True, "reduce": _reduce_name(metric, attr)}
+            continue
+        f = _aval_facts(leaf)
+        f.update({"list": False, "reduce": _reduce_name(metric, attr)})
+        states_facts[attr] = f
+        if f["weak"]:
+            findings.append(Finding(
+                "JX102", "P0", name, attr,
+                f"weak-typed default ({f['dtype']}): mints f64 under x64 and"
+                " guarantees an aval-flip retrace after the first update",
+            ))
+
+    upd = _program_facts(lambda s, *a: metric.pure_update(s, *a), state, *args)
+    facts: Dict[str, Any] = {"scope": case.scope, "states": states_facts, "programs": {"update": upd}}
+
+    post_state = state
+    if upd["error"] is None:
+        # aval fixed point per leaf: donation-eligible + retrace-free
+        out_shape = upd.pop("out")
+        for attr, leaf in state.items():
+            out_leaf = out_shape[attr]
+            sf = states_facts[attr]
+            if isinstance(leaf, list) or isinstance(out_leaf, list):
+                sf["donation_eligible"] = False
+                sf["stable"] = False  # list states grow; engines exclude them
+                continue
+            of = _aval_facts(out_leaf)
+            stable = (of["dtype"], of["shape"], of["weak"]) == (sf["dtype"], sf["shape"], sf["weak"])
+            sf["donation_eligible"] = stable
+            sf["stable"] = stable
+            if not stable:
+                findings.append(Finding(
+                    "JX101", "P1", name, attr,
+                    f"update is not an aval fixed point: {sf['dtype']}{sf['shape']}"
+                    f"{' weak' if sf['weak'] else ''} -> {of['dtype']}{of['shape']}"
+                    f"{' weak' if of['weak'] else ''}",
+                ))
+        # x64: trace the same program with the x64 flag on; a dtype change
+        # is a widened accumulator (scan-carry instability, doubled compiles)
+        try:
+            with enable_x64():
+                upd64 = _program_facts(lambda s, *a: metric.pure_update(s, *a), state, *args)
+            if upd64["error"] is None:
+                for attr, leaf in state.items():
+                    if isinstance(leaf, list):
+                        continue
+                    d32 = states_facts[attr].get("dtype")
+                    out64 = upd64["out"][attr]
+                    if not isinstance(out64, list) and str(jnp.dtype(out64.dtype)) not in (d32,):
+                        states_facts[attr]["x64_widens"] = str(jnp.dtype(out64.dtype))
+                        findings.append(Finding(
+                            "JX103", "P2", name, attr,
+                            f"state widens under x64: {d32} -> {jnp.dtype(out64.dtype)}",
+                        ))
+        except Exception:  # noqa: BLE001 — x64 re-trace is advisory
+            pass
+        # a zero-filled post-update-shaped state lets compute/merge trace
+        # even for list states (empty-list cat would not)
+        post_state = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), out_shape,
+            is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"),
+        )
+    else:
+        findings.append(Finding(
+            upd["error"]["rule"], "P0", name, "pure_update",
+            f"{upd['error']['why']} [{upd['error']['type']}]",
+        ))
+
+    comp = _program_facts(metric.pure_compute, post_state)
+    facts["programs"]["compute"] = comp
+    if comp["error"] is not None:
+        findings.append(Finding(
+            comp["error"]["rule"], "P0", name, "pure_compute",
+            f"{comp['error']['why']} [{comp['error']['type']}]",
+        ))
+    else:
+        comp.pop("out", None)
+
+    merge = _program_facts(lambda a, b: metric.pure_merge(a, b), post_state, post_state)
+    facts["programs"]["merge"] = merge
+    if merge["error"] is not None:
+        findings.append(Finding(
+            merge["error"]["rule"], "P0", name, "pure_merge",
+            f"{merge['error']['why']} [{merge['error']['type']}]",
+        ))
+    else:
+        merge.pop("out", None)
+
+    # the fused forward engine's single-launch step program, traced exactly
+    # as the dispatcher lowers it (forward_engine.audit_forward_program) —
+    # only meaningful where the engine itself is eligible (fixed-shape
+    # state, traceable update+compute)
+    if (
+        upd["error"] is None and comp["error"] is None
+        and not any(isinstance(v, list) for v in state.values())
+    ):
+        from metrics_tpu import forward_engine
+
+        try:
+            leaf_names, fwd_fn = forward_engine.audit_forward_program(metric)
+            leaves = tuple(post_state[n] for n in leaf_names)
+            fwd = _program_facts(fwd_fn, jnp.asarray(1, jnp.int32), leaves, *args)
+        except Exception as err:  # noqa: BLE001 — record, engine falls back at runtime
+            fwd = {"error": {"rule": "JX301", "type": type(err).__name__,
+                             "why": "forward program does not build"},
+                   "collectives": None, "callbacks": None, "eqns": None}
+        fwd.pop("out", None)
+        facts["programs"]["forward"] = fwd
+
+    # collectives belong in pure_sync only
+    for prog in list(facts["programs"]):
+        pf = facts["programs"][prog]
+        if pf.get("collectives"):
+            findings.append(Finding(
+                "JX501", "P1", name, f"pure_{prog}",
+                f"{pf['collectives']} collective primitive(s) inside pure_{prog}",
+            ))
+        if pf.get("callbacks"):
+            findings.append(Finding(
+                "JX201", "P0", name, f"pure_{prog}",
+                f"{pf['callbacks']} host-callback primitive(s) inside pure_{prog}",
+            ))
+
+    # static sync schedule: the same planner the runtime executes
+    sync_states = {a: getattr(metric, a) for a in metric._reductions}
+    specs = sync_engine.plan_metric_leaves(metric, sync_states)
+    buckets = sync_engine.bucket_plan(specs)
+    facts["sync"] = {
+        "fused_collectives": len(buckets),
+        "perleaf_collectives": len(specs),
+        "buckets": {f"{k[0]}:{k[1]}": len(v) for k, v in sorted(buckets.items())},
+        "unbucketed": sorted(
+            a for a, v in state.items()
+            if not isinstance(v, list) and a not in {s.key for s in specs}
+        ),
+    }
+
+    hazards = _update_hazards(metric)
+    hazards["signature"] = any(
+        sf.get("list") is False and sf.get("stable") is False for sf in states_facts.values()
+    ) or bool(upd["error"])
+    facts["hazards"] = hazards
+    return facts, findings
+
+
+def audit_structural(case: registry.AuditCase) -> Dict[str, Any]:
+    """Facts for non-device scopes: states (when constructible), no traces."""
+    facts: Dict[str, Any] = {"scope": case.scope, "states": {}, "programs": {}, "hazards": {"static-key": False, "signature": False}}
+    if case.build is not None:
+        metric = case.build()
+        for attr, leaf in metric.default_state().items():
+            if isinstance(leaf, list):
+                facts["states"][attr] = {"list": True, "reduce": _reduce_name(metric, attr)}
+            else:
+                f = _aval_facts(leaf)
+                f.update({"list": False, "reduce": _reduce_name(metric, attr)})
+                facts["states"][attr] = f
+    return facts
+
+
+def run_audit(cases: Optional[List[registry.AuditCase]] = None) -> Tuple[Dict[str, Any], List[Finding]]:
+    """Sweep the registry: ``{metric: facts}`` + the full finding list."""
+    if cases is None:
+        cases = registry.audit_cases()
+    pools = registry.example_inputs()
+    all_facts: Dict[str, Any] = {}
+    findings: List[Finding] = []
+    for case in cases:
+        if case.scope == "device":
+            try:
+                facts, fs = audit_metric(case, pools)
+            except Exception as err:  # noqa: BLE001 — a broken case must not hide the rest
+                facts = {"scope": "device", "states": {}, "programs": {},
+                         "hazards": {"static-key": False, "signature": False}}
+                fs = [Finding("JX000", "P0", case.name, "registry",
+                              f"audit case failed outside tracing: {type(err).__name__}: {err}")]
+            all_facts[case.name] = facts
+            findings.extend(fs)
+        elif case.scope == "unclassified":
+            all_facts[case.name] = {"scope": case.scope, "states": {}, "programs": {},
+                                    "hazards": {"static-key": False, "signature": False}}
+            findings.append(Finding("JX000", "P0", case.name, "registry",
+                                    "exported Metric subclass with no audit classification"))
+        else:
+            all_facts[case.name] = audit_structural(case)
+    return all_facts, findings
+
+
+# ------------------------------------------------------------------ capstone
+def collection_sync_plan(members: Dict[str, Any]) -> Dict[str, Any]:
+    """Statically derive the fused-sync collective schedule of a collection.
+
+    Mirrors ``MetricCollection.sync``'s planning pass exactly (same
+    ``plan_metric_leaves`` + ``bucket_plan`` calls the runtime makes), so
+    the returned counts are the counts ``execute_buckets`` will launch:
+    one collective per bucket, ``perleaf_collectives`` on the legacy path.
+    """
+    specs: List[Any] = []
+    for name, m in members.items():
+        states = {a: getattr(m, a) for a in m._reductions}
+        specs.extend(sync_engine.plan_metric_leaves(m, states, tag=name))
+    buckets = sync_engine.bucket_plan(specs)
+    return {
+        "fused_collectives": len(buckets),
+        "perleaf_collectives": len(specs),
+        "buckets": {f"{k[0]}:{k[1]}": len(v) for k, v in sorted(buckets.items())},
+    }
+
+
+def classification_suite_sync_plan() -> Dict[str, Any]:
+    """The 5-member classification suite of ``bench._cfg_sync_engine``,
+    derived statically — ``test_bench_configs.py`` pins this equal to the
+    dynamic ``sync_collectives_*`` counts (the tentpole cross-check)."""
+    from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, Precision, Recall
+
+    C = 32
+    members = {
+        "acc": Accuracy(num_classes=C, average="macro"),
+        "f1": F1Score(num_classes=C, average="macro"),
+        "prec": Precision(num_classes=C, average="macro"),
+        "rec": Recall(num_classes=C, average="macro"),
+        "cm": ConfusionMatrix(num_classes=C),
+    }
+    return collection_sync_plan(members)

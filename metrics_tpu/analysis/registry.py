@@ -1,0 +1,254 @@
+"""Audit-case registry: every exported metric, classified and exemplified.
+
+The jaxpr front needs three things per metric that the class alone cannot
+provide: a *construction* (some classes take required args), *example
+update inputs* (abstract tracing still needs avals), and a *scope* that
+says which rules apply:
+
+* ``device`` — fixed-shape or list-state metric whose pure paths must
+  trace; full jaxpr rule set.
+* ``host_only`` — declared ``Metric.host_only`` (text/detection/PESQ):
+  update paths run host-side by design, jaxpr rules out of scope (AST
+  lint still applies to their sources).
+* ``extractor`` — embedding-network-backed image metrics (FID/IS/KID/
+  LPIPS): device-side but construction materializes a conv net; audited
+  structurally (states, reductions) without abstract-tracing the
+  extractor forward, which would dominate the <60 s budget.
+* ``wrapper`` — metrics that own inner sub-metrics (BootStrapper &c.):
+  their state pytree does not close over the wrapped metric's state, so
+  ``pure_update`` is not a self-contained reducer to trace; state facts
+  and AST lint only.
+* ``abstract`` — bases that cannot be constructed.
+
+Example shapes are deliberately tiny (the audit traces, never executes);
+they mirror tests/bases/test_pure_api_matrix.py so the statically-audited
+programs are the same programs the parity matrix proves correct.
+"""
+import inspect
+from typing import Any, Callable, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+
+class AuditCase(NamedTuple):
+    name: str
+    scope: str  # device | host_only | extractor | wrapper | abstract
+    build: Optional[Callable[[], Any]]  # None when scope forbids/skips construction
+    args: Optional[Callable[[], Tuple]]  # example update inputs (device scope)
+    note: str = ""
+
+
+_ABSTRACT = {"Metric", "RetrievalMetric", "CompositionalMetric"}
+_EXTRACTOR = {
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+}
+_WRAPPER = {"BootStrapper", "ClasswiseWrapper", "MinMaxMetric", "MultioutputWrapper", "MetricTracker"}
+
+_B, _C = 16, 4
+
+
+def _inputs():
+    """Deterministic example-input pools (fresh per call; tiny shapes)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(41)
+    probs = rng.rand(_B, _C).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    pools = {
+        "probs": probs,
+        "labels": rng.randint(0, _C, _B),
+        "bin_scores": rng.rand(_B).astype(np.float32),
+        "bin_labels": rng.randint(0, 2, _B),
+        "ml_scores": rng.rand(_B, _C).astype(np.float32),
+        "ml_labels": rng.randint(0, 2, (_B, _C)),
+        "reg_p": rng.rand(_B).astype(np.float32),
+        "reg_t": rng.rand(_B).astype(np.float32),
+        "reg2d_p": rng.rand(_B, 3).astype(np.float32),
+        "reg2d_t": rng.rand(_B, 3).astype(np.float32),
+        "audio_p": rng.randn(2, 200).astype(np.float32),
+        "audio_t": rng.randn(2, 200).astype(np.float32),
+        "stoi_t": rng.randn(1, 12000).astype(np.float32),
+        "pit_p": rng.randn(2, 2, 100).astype(np.float32),
+        "pit_t": rng.randn(2, 2, 100).astype(np.float32),
+        "img_p": rng.rand(2, 3, 16, 16).astype(np.float32),
+        "img_t": rng.rand(2, 3, 16, 16).astype(np.float32),
+        "imgL_p": rng.rand(1, 3, 180, 180).astype(np.float32),
+        "imgL_t": rng.rand(1, 3, 180, 180).astype(np.float32),
+        "ret_idx": rng.randint(0, 4, _B),
+    }
+    pools["stoi_p"] = (pools["stoi_t"] + 0.8 * rng.randn(1, 12000)).astype(np.float32)
+    return {k: jnp.asarray(v) for k, v in pools.items()}
+
+
+def _device_table():
+    """(ctor, example-args) per device-scope metric. Indirection through
+    input-pool KEYS keeps array construction lazy (one pool per sweep)."""
+    import metrics_tpu as M
+    import metrics_tpu.functional as F
+
+    def cls_args(build, *keys):
+        return build, (lambda pools: tuple(pools[k] for k in keys))
+
+    t: dict = {
+        # classification — fixed shape
+        "Accuracy": cls_args(lambda: M.Accuracy(num_classes=_C, average="macro"), "probs", "labels"),
+        "Precision": cls_args(lambda: M.Precision(num_classes=_C, average="macro"), "probs", "labels"),
+        "Recall": cls_args(lambda: M.Recall(num_classes=_C, average="macro"), "probs", "labels"),
+        "Specificity": cls_args(lambda: M.Specificity(num_classes=_C, average="macro"), "probs", "labels"),
+        "F1Score": cls_args(lambda: M.F1Score(num_classes=_C, average="macro"), "probs", "labels"),
+        "FBetaScore": cls_args(lambda: M.FBetaScore(num_classes=_C, beta=2.0, average="macro"), "probs", "labels"),
+        "StatScores": cls_args(lambda: M.StatScores(num_classes=_C, reduce="macro"), "probs", "labels"),
+        "HammingDistance": cls_args(lambda: M.HammingDistance(), "ml_scores", "ml_labels"),
+        "ConfusionMatrix": cls_args(lambda: M.ConfusionMatrix(num_classes=_C), "probs", "labels"),
+        "CohenKappa": cls_args(lambda: M.CohenKappa(num_classes=_C), "probs", "labels"),
+        "MatthewsCorrCoef": cls_args(lambda: M.MatthewsCorrCoef(num_classes=_C), "probs", "labels"),
+        "JaccardIndex": cls_args(lambda: M.JaccardIndex(num_classes=_C), "probs", "labels"),
+        "BinnedPrecisionRecallCurve": cls_args(
+            lambda: M.BinnedPrecisionRecallCurve(num_classes=_C, thresholds=8), "probs", "ml_labels"
+        ),
+        "BinnedAveragePrecision": cls_args(
+            lambda: M.BinnedAveragePrecision(num_classes=_C, thresholds=8), "probs", "ml_labels"
+        ),
+        "BinnedRecallAtFixedPrecision": cls_args(
+            lambda: M.BinnedRecallAtFixedPrecision(num_classes=_C, min_precision=0.5, thresholds=8),
+            "probs", "ml_labels",
+        ),
+        "KLDivergence": cls_args(lambda: M.KLDivergence(), "probs", "probs"),
+        "HingeLoss": cls_args(lambda: M.HingeLoss(), "bin_scores", "bin_labels"),
+        "CoverageError": cls_args(lambda: M.CoverageError(), "ml_scores", "ml_labels"),
+        "LabelRankingAveragePrecision": cls_args(
+            lambda: M.LabelRankingAveragePrecision(), "ml_scores", "ml_labels"
+        ),
+        "LabelRankingLoss": cls_args(lambda: M.LabelRankingLoss(), "ml_scores", "ml_labels"),
+        # classification — list states (curves; device-side, not engine-eligible)
+        "AUC": cls_args(lambda: M.AUC(), "reg_p", "reg_t"),
+        "AUROC": cls_args(lambda: M.AUROC(), "bin_scores", "bin_labels"),
+        "AveragePrecision": cls_args(lambda: M.AveragePrecision(), "bin_scores", "bin_labels"),
+        "PrecisionRecallCurve": cls_args(lambda: M.PrecisionRecallCurve(), "bin_scores", "bin_labels"),
+        "ROC": cls_args(lambda: M.ROC(), "bin_scores", "bin_labels"),
+        "CalibrationError": cls_args(lambda: M.CalibrationError(), "bin_scores", "bin_labels"),
+        # regression
+        "MeanSquaredError": cls_args(lambda: M.MeanSquaredError(), "reg_p", "reg_t"),
+        "MeanAbsoluteError": cls_args(lambda: M.MeanAbsoluteError(), "reg_p", "reg_t"),
+        "MeanSquaredLogError": cls_args(lambda: M.MeanSquaredLogError(), "reg_p", "reg_t"),
+        "MeanAbsolutePercentageError": cls_args(lambda: M.MeanAbsolutePercentageError(), "reg_p", "reg_t"),
+        "SymmetricMeanAbsolutePercentageError": cls_args(
+            lambda: M.SymmetricMeanAbsolutePercentageError(), "reg_p", "reg_t"
+        ),
+        "WeightedMeanAbsolutePercentageError": cls_args(
+            lambda: M.WeightedMeanAbsolutePercentageError(), "reg_p", "reg_t"
+        ),
+        "ExplainedVariance": cls_args(lambda: M.ExplainedVariance(), "reg_p", "reg_t"),
+        "R2Score": cls_args(lambda: M.R2Score(), "reg_p", "reg_t"),
+        "TweedieDevianceScore": cls_args(lambda: M.TweedieDevianceScore(power=1.5), "reg_p", "reg_t"),
+        "PearsonCorrCoef": cls_args(lambda: M.PearsonCorrCoef(), "reg_p", "reg_t"),
+        "CosineSimilarity": cls_args(lambda: M.CosineSimilarity(), "reg2d_p", "reg2d_t"),
+        "SpearmanCorrCoef": cls_args(lambda: M.SpearmanCorrCoef(), "reg_p", "reg_t"),
+        # aggregation
+        "MaxMetric": cls_args(lambda: M.MaxMetric(), "reg_p"),
+        "MinMetric": cls_args(lambda: M.MinMetric(), "reg_p"),
+        "SumMetric": cls_args(lambda: M.SumMetric(), "reg_p"),
+        "MeanMetric": cls_args(lambda: M.MeanMetric(), "reg_p"),
+        "CatMetric": cls_args(lambda: M.CatMetric(), "reg_p"),
+        # audio (PESQ is host_only; the rest trace)
+        "SignalNoiseRatio": cls_args(lambda: M.SignalNoiseRatio(), "audio_p", "audio_t"),
+        "ScaleInvariantSignalNoiseRatio": cls_args(
+            lambda: M.ScaleInvariantSignalNoiseRatio(), "audio_p", "audio_t"
+        ),
+        "SignalDistortionRatio": cls_args(lambda: M.SignalDistortionRatio(), "audio_p", "audio_t"),
+        "ScaleInvariantSignalDistortionRatio": cls_args(
+            lambda: M.ScaleInvariantSignalDistortionRatio(), "audio_p", "audio_t"
+        ),
+        "ShortTimeObjectiveIntelligibility": cls_args(
+            lambda: M.ShortTimeObjectiveIntelligibility(10000), "stoi_p", "stoi_t"
+        ),
+        "PermutationInvariantTraining": cls_args(
+            lambda: M.PermutationInvariantTraining(F.scale_invariant_signal_noise_ratio),
+            "pit_p", "pit_t",
+        ),
+        # image (extractor-backed classes are scoped out above)
+        "PeakSignalNoiseRatio": cls_args(lambda: M.PeakSignalNoiseRatio(data_range=1.0), "ml_scores", "ml_scores"),
+        "StructuralSimilarityIndexMeasure": cls_args(
+            lambda: M.StructuralSimilarityIndexMeasure(), "img_p", "img_t"
+        ),
+        "MultiScaleStructuralSimilarityIndexMeasure": cls_args(
+            lambda: M.MultiScaleStructuralSimilarityIndexMeasure(), "imgL_p", "imgL_t"
+        ),
+        "UniversalImageQualityIndex": cls_args(lambda: M.UniversalImageQualityIndex(), "img_p", "img_t"),
+        "ErrorRelativeGlobalDimensionlessSynthesis": cls_args(
+            lambda: M.ErrorRelativeGlobalDimensionlessSynthesis(), "img_p", "img_t"
+        ),
+        "SpectralAngleMapper": cls_args(lambda: M.SpectralAngleMapper(), "img_p", "img_t"),
+        "SpectralDistortionIndex": cls_args(lambda: M.SpectralDistortionIndex(), "img_p", "img_t"),
+    }
+    # retrieval: (preds, target, indexes)
+    for name in (
+        "RetrievalFallOut", "RetrievalHitRate", "RetrievalMAP", "RetrievalMRR",
+        "RetrievalNormalizedDCG", "RetrievalPrecision", "RetrievalRecall", "RetrievalRPrecision",
+    ):
+        cls = getattr(M, name)
+        t[name] = (
+            (lambda c=cls: c()),
+            (lambda pools: (pools["bin_scores"], pools["bin_labels"], pools["ret_idx"])),
+        )
+    return t
+
+
+def _wrapper_builds():
+    import metrics_tpu as M
+
+    return {
+        "BootStrapper": lambda: M.BootStrapper(M.MeanSquaredError(), num_bootstraps=2),
+        "ClasswiseWrapper": lambda: M.ClasswiseWrapper(M.Accuracy(num_classes=3, average=None)),
+        "MinMaxMetric": lambda: M.MinMaxMetric(M.MeanSquaredError()),
+        "MultioutputWrapper": lambda: M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=3),
+        "MetricTracker": None,  # tracks a collection, not a self-contained Metric state
+    }
+
+
+def example_inputs():
+    """One pool of example input arrays shared by a whole audit sweep."""
+    return _inputs()
+
+
+def audit_cases() -> List[AuditCase]:
+    """Every exported :class:`~metrics_tpu.metric.Metric` subclass, scoped.
+
+    The companion test asserts this covers ``metrics_tpu.__all__``
+    exhaustively — a newly exported metric without a registry entry fails
+    the audit instead of silently escaping it.
+    """
+    import metrics_tpu as M
+    from metrics_tpu.metric import Metric
+
+    table = _device_table()
+    wrappers = _wrapper_builds()
+    cases: List[AuditCase] = []
+    for name in M.__all__:
+        obj = getattr(M, name)
+        if not (inspect.isclass(obj) and issubclass(obj, Metric)):
+            continue
+        if name in _ABSTRACT:
+            cases.append(AuditCase(name, "abstract", None, None, "base class"))
+        elif getattr(obj, "host_only", False):
+            cases.append(AuditCase(name, "host_only", None, None, "declared Metric.host_only"))
+        elif name in _EXTRACTOR:
+            cases.append(AuditCase(name, "extractor", None, None, "embedding-net-backed; structural facts only"))
+        elif name in wrappers:
+            cases.append(AuditCase(name, "wrapper", wrappers[name], None, "inner-metric state not in own pytree"))
+        elif name in table:
+            build, args = table[name]
+            cases.append(AuditCase(name, "device", build, args))
+        else:
+            # unclassified: surfaces as a P0 registry gap in the report
+            cases.append(AuditCase(name, "unclassified", None, None, "no registry entry"))
+    # detection lives in a subpackage (not in the top-level __all__) but is
+    # still part of the audited surface — its update eats Python dicts
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    assert getattr(MeanAveragePrecision, "host_only", False), "MeanAveragePrecision must stay host_only"
+    cases.append(AuditCase("MeanAveragePrecision", "host_only", None, None, "declared Metric.host_only"))
+    return cases

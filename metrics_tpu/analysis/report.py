@@ -1,0 +1,156 @@
+"""Merge both analysis fronts into the checked-in ``STATIC_AUDIT.json``.
+
+The baseline is a *ratchet*: the findings present when it was written are
+the accepted set — each with a ``why`` explaining the acceptance (or a
+fix obligation). ``diff()`` fails on **new** findings (regressions) and
+on **stale** ones (you fixed something — re-baseline so the ratchet
+tightens). P0 findings additionally must carry a non-empty ``why``:
+``unexplained_p0`` is the acceptance gate ``make audit`` enforces.
+
+The file also carries the per-metric facts (states, program primitive
+counts, sync buckets), the statically-derived capstone collective counts
+(pinned against the dynamic bench counters in ``test_bench_configs.py``),
+and the retrace-hazard table ``metrics_tpu.analysis.hazards`` serves to
+the dispatcher's compile spans at runtime.
+"""
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.analysis import ast_lint, hazards, jaxpr_audit
+
+VERSION = 1
+
+# Standing explanations stamped onto known-accepted finding classes when a
+# baseline is (re)written, so regeneration never loses the acceptance
+# rationale. Order matters: first match wins.
+_CURVE_METRICS = {"ROC", "PrecisionRecallCurve", "AUROC", "AveragePrecision"}
+DEFAULT_EXPLANATIONS: List[Tuple[str, Optional[set], str]] = [
+    (
+        "JX301",
+        _CURVE_METRICS,
+        "curve compute thresholds on observed score values; list-state "
+        "metrics never enter the fused dispatch path, so compute is "
+        "eager by design (JX301 accepted, not a hot-path sync)",
+    ),
+    (
+        "JX301",
+        None,  # the remaining JX301s are the retrieval group-by computes
+        "retrieval compute groups by observed `indexes` (host group-by "
+        "over dynamic group counts); list-state, eager by design — see "
+        "ROADMAP: topk-based on-device grouping would retire this",
+    ),
+    (
+        "JX103",
+        None,
+        "int32 accumulators widen to int64 only when the USER enables "
+        "x64 globally; the engines canonicalize state dtypes at dispatch "
+        "boundaries, so default-mode programs never see the wide dtype",
+    ),
+]
+
+
+def build_report() -> Dict[str, Any]:
+    """Run both fronts + the capstone; return the merged report dict."""
+    facts, jx_findings = jaxpr_audit.run_audit()
+    lint_violations = ast_lint.lint_paths()
+    findings: List[Dict[str, Any]] = []
+    for f in jx_findings:
+        findings.append({
+            "key": f.key, "code": f.code, "severity": f.severity,
+            "metric": f.metric, "where": f.where, "detail": f.detail,
+        })
+    for v in lint_violations:
+        findings.append({
+            "key": v.key, "code": v.code, "severity": v.severity,
+            "metric": v.qualname, "where": f"{v.path}:{v.lineno}", "detail": v.detail,
+        })
+    findings.sort(key=lambda d: (d["severity"], d["key"]))
+    counts: Dict[str, int] = {}
+    for d in findings:
+        counts[d["severity"]] = counts.get(d["severity"], 0) + 1
+    return {
+        "version": VERSION,
+        "summary": {
+            "metrics_swept": len(facts),
+            "device_traced": sum(1 for v in facts.values() if v.get("scope") == "device"),
+            "findings": counts,
+        },
+        "capstone": jaxpr_audit.classification_suite_sync_plan(),
+        "hazards": {
+            name: v["hazards"] for name, v in sorted(facts.items())
+            if any(v.get("hazards", {}).values())
+        },
+        "findings": findings,
+        "facts": {name: facts[name] for name in sorted(facts)},
+    }
+
+
+def _explain(finding: Dict[str, Any], previous: Dict[str, str]) -> str:
+    """Carry forward an existing ``why`` else stamp the standing one."""
+    prev = previous.get(finding["key"], "")
+    if prev:
+        return prev
+    for code, metrics, why in DEFAULT_EXPLANATIONS:
+        if finding["code"] == code and (metrics is None or finding["metric"] in metrics):
+            return why
+    return ""
+
+
+def load_baseline(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    path = path or hazards.baseline_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def write_baseline(report: Dict[str, Any], path: Optional[str] = None) -> str:
+    """Persist ``report`` as the new accepted baseline (ratchet reset)."""
+    path = path or hazards.baseline_path()
+    previous: Dict[str, str] = {}
+    old = load_baseline(path)
+    if old:
+        previous = {f["key"]: f.get("why", "") for f in old.get("findings", [])}
+    out = dict(report)
+    out["findings"] = [
+        {**f, "why": _explain(f, previous)} for f in report["findings"]
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(out, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    hazards.invalidate()
+    return path
+
+
+def unexplained_p0(report: Dict[str, Any], baseline: Optional[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """P0 findings with no acceptance rationale — the ``make audit`` gate."""
+    whys = {f["key"]: f.get("why", "") for f in (baseline or {}).get("findings", [])}
+    return [f for f in report["findings"] if f["severity"] == "P0" and not whys.get(f["key"], "")]
+
+
+def diff(report: Dict[str, Any], baseline: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Ratchet comparison: new findings fail, fixed findings require a
+    re-baseline, a capstone drift fails outright."""
+    if baseline is None:
+        return {
+            "ok": False,
+            "error": f"no baseline at {hazards.baseline_path()} — run tools/static_audit.py --write-baseline",
+            "new": report["findings"], "fixed": [], "unexplained_p0": [],
+        }
+    base_keys = {f["key"]: f for f in baseline.get("findings", [])}
+    run_keys = {f["key"]: f for f in report["findings"]}
+    new = [f for k, f in sorted(run_keys.items()) if k not in base_keys]
+    fixed = [f for k, f in sorted(base_keys.items()) if k not in run_keys]
+    missing_why = unexplained_p0(report, baseline)
+    capstone_drift = report["capstone"] != baseline.get("capstone")
+    return {
+        "ok": not new and not fixed and not missing_why and not capstone_drift,
+        "new": new,
+        "fixed": fixed,
+        "unexplained_p0": missing_why,
+        "capstone_drift": (
+            {"run": report["capstone"], "baseline": baseline.get("capstone")}
+            if capstone_drift else None
+        ),
+    }

@@ -32,6 +32,9 @@ class PerceptualEvaluationSpeechQuality(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    # host-side update path (see Metric.host_only): engines refuse
+    # cleanly, jaxpr audit classifies this class out of scope
+    host_only = True
 
     def __init__(self, fs: int, mode: str, backend: str = "auto", **kwargs: Any) -> None:
         super().__init__(**kwargs)

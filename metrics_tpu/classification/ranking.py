@@ -51,7 +51,10 @@ class CoverageError(Metric):
             self.weight = self.weight + sample_weight
 
     def compute(self) -> Array:
-        return _coverage_error_compute(self.coverage, self.numel, self.weight if bool(self.weight != 0) else None)
+        # pass the weight state through unconditionally: the compute helper
+        # selects the denominator on-device (a `bool(...)` guard here was a
+        # hidden host sync that broke jit(pure_compute))
+        return _coverage_error_compute(self.coverage, self.numel, self.weight)
 
 
 class LabelRankingAveragePrecision(Metric):
@@ -84,9 +87,7 @@ class LabelRankingAveragePrecision(Metric):
             self.sample_weight = self.sample_weight + sample_weight
 
     def compute(self) -> Array:
-        return _label_ranking_average_precision_compute(
-            self.score, self.numel, self.sample_weight if bool(self.sample_weight != 0) else None
-        )
+        return _label_ranking_average_precision_compute(self.score, self.numel, self.sample_weight)
 
 
 class LabelRankingLoss(Metric):
@@ -119,6 +120,4 @@ class LabelRankingLoss(Metric):
             self.sample_weight = self.sample_weight + sample_weight
 
     def compute(self) -> Array:
-        return _label_ranking_loss_compute(
-            self.loss, self.numel, self.sample_weight if bool(self.sample_weight != 0) else None
-        )
+        return _label_ranking_loss_compute(self.loss, self.numel, self.sample_weight)

@@ -83,6 +83,9 @@ class MeanAveragePrecision(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = True
+    # host-side update path (see Metric.host_only): engines refuse
+    # cleanly, jaxpr audit classifies this class out of scope
+    host_only = True
 
     def __init__(
         self,

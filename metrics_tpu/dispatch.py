@@ -73,6 +73,7 @@ import numpy as np
 
 from metrics_tpu import aot_cache, faults, telemetry
 from metrics_tpu._compat import profiler_annotation
+from metrics_tpu.analysis import hazards
 from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
 
 Array = jax.Array
@@ -144,6 +145,11 @@ class FastDispatcher:
             persistent store key so look-alike owners never share an
             on-disk executable. ``None`` keeps the persistent tier off for
             this dispatcher (in-process caching only).
+        host_only: the owner declared itself inherently host-side
+            (``Metric.host_only`` — string/tokenizer/native-library update
+            paths). Every call refuses with a clean
+            :class:`FastDispatchUnsupported` instead of a runtime trace
+            error deep inside lowering.
     """
 
     def __init__(
@@ -159,8 +165,10 @@ class FastDispatcher:
         make_masked_forward: Optional[Callable[[Dict], Callable]] = None,
         forward_stats: Optional[Dict[str, Any]] = None,
         cache_namespace: Any = None,
+        host_only: bool = False,
     ) -> None:
         self.label = label
+        self._host_only = bool(host_only)
         self._read_leaves = read_leaves
         self._write_leaves = write_leaves
         self._make_update = make_update
@@ -195,6 +203,11 @@ class FastDispatcher:
         """Shared input prep for update/forward launches: canonicalize the
         flattened batch, decide masked (bucketed) vs exact-shape execution,
         pad, and read + validate the state leaves."""
+        if self._host_only:
+            raise FastDispatchUnsupported(
+                f"{self.label} is host_only: its update runs host-side code"
+                " (strings/tokenizers/native libraries) the engine cannot trace"
+            )
         flat_inputs, treedef = jax.tree_util.tree_flatten((args, dyn_kwargs))
         flat_inputs = [self._canonicalize(x) for x in flat_inputs]
 
@@ -374,6 +387,14 @@ class FastDispatcher:
         # once so donation can never delete an array another owner holds
         return tuple(jnp.array(x) for x in leaves)
 
+    def _predicted_attr(self, cause: str) -> Dict[str, Any]:
+        """Predicted-vs-observed hazard attr for a compile span: for the
+        causes the static auditor models (``new-static-key`` /
+        ``new-signature``) attach whether the audit baseline predicted this
+        owner would retrace that way; other causes attach nothing."""
+        predicted = hazards.predicted(self.label, cause)
+        return {} if predicted is None else {"predicted": predicted}
+
     def _retrace_cause(self, family: str, static_key: Tuple, call_inputs) -> str:
         """Name WHY this cache miss compiles: the first component of the key
         (static flags, then input shapes, then input dtypes) this family has
@@ -483,6 +504,7 @@ class FastDispatcher:
             cause=cause,
             masked=masked,
             static_key=static_key or None,
+            **self._predicted_attr(cause),
         )
         self.stats["retraces"] += 1
         self._cache_put(key, compiled)
@@ -534,6 +556,7 @@ class FastDispatcher:
             cause=cause,
             masked=masked,
             static_key=static_key or None,
+            **self._predicted_attr(cause),
         )
         self.forward_stats["retraces"] += 1
         self._cache_put(key, compiled)

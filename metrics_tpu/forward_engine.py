@@ -115,6 +115,20 @@ def make_metric_forward_factories(metric: Any, names: list) -> Tuple[Callable, C
     return make_forward, make_masked_forward
 
 
+def audit_forward_program(metric: Any) -> Tuple[list, Callable]:
+    """The unmasked single-metric forward program, for static analysis.
+
+    Returns ``(names, fn)`` where ``fn(count, leaves, *args) ->
+    (new_leaves, batch_value)`` is byte-for-byte the program the
+    dispatcher lowers for the step path (no static kwargs), so
+    :mod:`metrics_tpu.analysis.jaxpr_audit` traces the engine's actual
+    launch — not a reconstruction of it.
+    """
+    names = list(metric._defaults)
+    make_forward, _ = make_metric_forward_factories(metric, names)
+    return names, make_forward({})
+
+
 def make_collection_forward_factories(
     collection: Any, unflatten: Callable, flatten: Callable
 ) -> Tuple[Callable, Callable]:

@@ -56,9 +56,13 @@ def _coverage_error_update(
 
 
 def _coverage_error_compute(coverage: Array, n_elements: int, sample_weight: Optional[Array] = None) -> Array:
-    if sample_weight is not None and sample_weight != 0.0:
-        return coverage / sample_weight
-    return coverage / n_elements
+    if sample_weight is None:
+        return coverage / n_elements
+    # `sample_weight != 0.0` as a Python bool is a host sync (and a
+    # TracerBoolConversionError under jit/eval_shape); select the
+    # denominator on-device instead — identical values on every branch
+    sample_weight = jnp.asarray(sample_weight)
+    return coverage / jnp.where(sample_weight != 0, sample_weight, n_elements)
 
 
 def coverage_error(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
@@ -110,9 +114,13 @@ def _label_ranking_average_precision_update(
 def _label_ranking_average_precision_compute(
     score: Array, n_elements: int, sample_weight: Optional[Array] = None
 ) -> Array:
-    if sample_weight is not None and sample_weight != 0.0:
-        return score / sample_weight
-    return score / n_elements
+    if sample_weight is None:
+        return score / n_elements
+    # `sample_weight != 0.0` as a Python bool is a host sync (and a
+    # TracerBoolConversionError under jit/eval_shape); select the
+    # denominator on-device instead — identical values on every branch
+    sample_weight = jnp.asarray(sample_weight)
+    return score / jnp.where(sample_weight != 0, sample_weight, n_elements)
 
 
 def label_ranking_average_precision(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
@@ -156,9 +164,13 @@ def _label_ranking_loss_update(
 
 
 def _label_ranking_loss_compute(loss: Array, n_elements: int, sample_weight: Optional[Array] = None) -> Array:
-    if sample_weight is not None and sample_weight != 0.0:
-        return loss / sample_weight
-    return loss / n_elements
+    if sample_weight is None:
+        return loss / n_elements
+    # `sample_weight != 0.0` as a Python bool is a host sync (and a
+    # TracerBoolConversionError under jit/eval_shape); select the
+    # denominator on-device instead — identical values on every branch
+    sample_weight = jnp.asarray(sample_weight)
+    return loss / jnp.where(sample_weight != 0, sample_weight, n_elements)
 
 
 def label_ranking_loss(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:

@@ -79,19 +79,24 @@ def _bleu_score_compute(
     smooth: bool = False,
 ) -> Array:
     """Geometric-mean precision with brevity penalty (ref bleu.py:106-138)."""
-    if float(numerator.min()) == 0.0:
-        return jnp.asarray(0.0)
+    # `float(numerator.min()) == 0.0` as a Python bool is a forced host
+    # sync (and a TracerBoolConversionError under jit) — select the zero
+    # score on-device instead. The substituted ones only feed the branch
+    # that `where` discards, so no NaN/-inf reaches the selected lane.
+    any_zero_ngram = numerator.min() == 0
+    safe_numerator = jnp.where(any_zero_ngram, jnp.ones_like(numerator), numerator)
+    safe_denominator = jnp.where(any_zero_ngram, jnp.ones_like(denominator), denominator)
 
     if smooth:
-        precision_scores = (numerator + 1.0) / (denominator + 1.0)
-        precision_scores = precision_scores.at[0].set(numerator[0] / denominator[0])
+        precision_scores = (safe_numerator + 1.0) / (safe_denominator + 1.0)
+        precision_scores = precision_scores.at[0].set(safe_numerator[0] / safe_denominator[0])
     else:
-        precision_scores = numerator / denominator
+        precision_scores = safe_numerator / safe_denominator
 
     log_precision_scores = (1.0 / n_gram) * jnp.log(precision_scores)
     geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
     brevity_penalty = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - (target_len / preds_len)))
-    return brevity_penalty * geometric_mean
+    return jnp.where(any_zero_ngram, 0.0, brevity_penalty * geometric_mean)
 
 
 def bleu_score(

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu import forward_engine, resilience, sync_engine, telemetry
+from metrics_tpu.analysis import hazards
 from metrics_tpu.dispatch import FastDispatchUnsupported, fast_dispatch_enabled
 from metrics_tpu.resilience import StateCorruptionError  # noqa: F401 — re-exported
 from metrics_tpu.parallel.dist_env import AxisEnv, DistEnv, default_env
@@ -61,6 +62,29 @@ def _as_array(x: Any) -> Array:
     if isinstance(x, jax.Array):
         return x
     return jnp.asarray(x)
+
+
+# canonical strong dtype per jax dtype kind for weak-typed state defaults
+_CANONICAL_STATE_DTYPES = {"f": jnp.float32, "i": jnp.int32, "u": jnp.uint32, "c": jnp.complex64}
+
+
+def _stable_default(x: Array) -> Array:
+    """Pin a weak-typed state default to its strong canonical 32-bit dtype.
+
+    ``jnp.asarray(0.0)`` (and every Python-literal default) is *weak*-typed:
+    under x64 it silently mints an f64 accumulator, and in every mode the
+    leaf turns strong after the first update — an aval flip, i.e. a
+    guaranteed second compile, because the dispatcher caches executables on
+    ``(shape, dtype, weak_type)``. State accumulators are dtype contracts,
+    not literals, so floats pin to f32 and ints to int32 at declaration
+    time; a metric that genuinely wants a wider accumulator passes an
+    explicit-dtype array. The static auditor flags regressions as JX102
+    (see docs/static_analysis.md).
+    """
+    if not getattr(x, "weak_type", False):
+        return x
+    target = _CANONICAL_STATE_DTYPES.get(jnp.dtype(x.dtype).kind)
+    return x if target is None else jnp.asarray(x, target)
 
 
 def jit_distributed_available() -> bool:
@@ -172,6 +196,12 @@ class Metric(ABC):
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = True
+    # Inherently host-side metrics (string/tokenizer/native-library update
+    # paths: text, detection, PESQ) declare ``host_only = True``: the
+    # engines refuse them with a clean FastDispatchUnsupported instead of a
+    # trace error, and the static auditor classifies them out of jaxpr
+    # scope (AST lint still applies).
+    host_only: bool = False
     # Auxiliary (non-array) attributes that belong in checkpoints but not in
     # the jit-able ``state()`` pytree — e.g. a lazily-inferred input mode.
     # Subclasses extend; values must be None or plain str/int/float/bool.
@@ -210,6 +240,14 @@ class Metric(ABC):
             raise ValueError(f"Expected keyword argument `sync_dtype` to be a float dtype but got {sync_dtype}")
         self.sync_dtype = None if sync_dtype is None else jnp.dtype(sync_dtype)
         self._sync_env = sync_env
+        if jit_update and type(self).host_only:
+            # refuse up front with a visible reason instead of letting the
+            # jit fallback die on a trace error over string/host inputs
+            rank_zero_warn(
+                f"{type(self).__name__} is host_only (host-side update path); "
+                "ignoring jit_update=True — updates run eagerly."
+            )
+            jit_update = False
         self._jit_update_requested = jit_update
         # None = empty cache; populated lazily as {static-kwarg-key: jitted fn}
         self._jitted_update: Optional[Dict] = None
@@ -284,7 +322,7 @@ class Metric(ABC):
         if isinstance(default, list):
             default = []
         else:
-            default = _as_array(default)
+            default = _stable_default(_as_array(default))
 
         object.__setattr__(self, name, [] if isinstance(default, list) else default)
         self._defaults[name] = default if isinstance(default, list) else default
@@ -661,16 +699,19 @@ class Metric(ABC):
                         self._load_state(new_state)
                         if size_before is not None and fn._cache_size() > size_before:
                             self._dispatch_stats["retraces"] += 1
+                            # the jit cache key is opaque here; all the
+                            # path can attest is whether this signature
+                            # family ever compiled before
+                            cause = "first-compile" if size_before == 0 else "new-input-signature"
+                            predicted = hazards.predicted(type(self).__name__, cause)
                             telemetry.emit(
                                 "compile",
                                 type(self).__name__,
                                 "jit",
                                 stream="dispatch",
-                                # the jit cache key is opaque here; all the
-                                # path can attest is whether this signature
-                                # family ever compiled before
-                                cause="first-compile" if size_before == 0 else "new-input-signature",
+                                cause=cause,
                                 static_key=key or None,
+                                **({} if predicted is None else {"predicted": predicted}),
                             )
                         self._dispatch_stats["dispatches"] += 1
                         telemetry.emit(
@@ -737,6 +778,7 @@ class Metric(ABC):
             make_masked_forward=make_masked_forward,
             forward_stats=self._forward_stats,
             cache_namespace=aot_cache.owner_namespace(self),
+            host_only=type(self).host_only,
         )
 
     @property

@@ -146,6 +146,21 @@ def plan_metric_leaves(metric: Any, states: Dict[str, Any], tag: Optional[Hashab
     return specs
 
 
+def bucket_plan(specs: List[LeafSpec]) -> Dict[Tuple[str, str], List[LeafSpec]]:
+    """Group planned leaves into ``(wire dtype name, op)`` buckets.
+
+    This IS the engine's collective schedule: :func:`execute_buckets`
+    issues exactly one collective per returned bucket, in sorted key
+    order. Exposed separately so :mod:`metrics_tpu.analysis` can derive
+    the collective count statically (no env, no execution) and prove it
+    equal to the dynamic bench pins.
+    """
+    buckets: Dict[Tuple[str, str], List[LeafSpec]] = {}
+    for s in specs:
+        buckets.setdefault((jnp.dtype(s.wire_dtype).name, s.op), []).append(s)
+    return buckets
+
+
 def execute_buckets(
     env: Any,
     specs: List[LeafSpec],
@@ -163,9 +178,7 @@ def execute_buckets(
     """
     if not specs:
         return {}
-    buckets: Dict[Tuple[str, str], List[LeafSpec]] = {}
-    for s in specs:
-        buckets.setdefault((jnp.dtype(s.wire_dtype).name, s.op), []).append(s)
+    buckets = bucket_plan(specs)
 
     out: Dict[Hashable, Array] = {}
     for wire_name, op in sorted(buckets):

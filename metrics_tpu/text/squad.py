@@ -31,6 +31,9 @@ class SQuAD(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    # host-side update path (see Metric.host_only): engines refuse
+    # cleanly, jaxpr audit classifies this class out of scope
+    host_only = True
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -40,6 +40,9 @@ class WordErrorRate(Metric):
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
+    # host-side update path (see Metric.host_only): engines refuse
+    # cleanly, jaxpr audit classifies this class out of scope
+    host_only = True
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -70,6 +73,9 @@ class CharErrorRate(Metric):
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
+    # host-side update path (see Metric.host_only): engines refuse
+    # cleanly, jaxpr audit classifies this class out of scope
+    host_only = True
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -99,6 +105,9 @@ class MatchErrorRate(Metric):
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
+    # host-side update path (see Metric.host_only): engines refuse
+    # cleanly, jaxpr audit classifies this class out of scope
+    host_only = True
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -128,6 +137,9 @@ class WordInfoLost(Metric):
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
+    # host-side update path (see Metric.host_only): engines refuse
+    # cleanly, jaxpr audit classifies this class out of scope
+    host_only = True
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -159,6 +171,9 @@ class WordInfoPreserved(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    # host-side update path (see Metric.host_only): engines refuse
+    # cleanly, jaxpr audit classifies this class out of scope
+    host_only = True
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 22
+    assert len(skipped) == 23
     assert "detail_elapsed_s" in detail
 
 
@@ -166,6 +166,23 @@ def test_sync_engine_config_counts_and_keys(monkeypatch):
     # the config must restore the kill switch it toggles
     assert os.environ.get("METRICS_TPU_FUSED_SYNC") is None or (
         os.environ["METRICS_TPU_FUSED_SYNC"] != "0")
+
+
+def test_static_audit_config_counts_and_keys():
+    """The tentpole capstone: the STATICALLY derived collective counts
+    (jaxpr/plan analysis, no collective executed) must EQUAL the dynamic
+    counters ``test_sync_engine_config_counts_and_keys`` pins — 1 fused
+    bucket vs 17 per-leaf collectives for the 5-member classification
+    suite. If these ever diverge, either the analyzer or the engine is
+    lying about the schedule."""
+    detail = {}
+    bench._cfg_static_audit(detail)
+    assert detail["audit_capstone_fused_collectives"] == 1
+    assert detail["audit_capstone_perleaf_collectives"] == 17
+    assert detail["audit_metrics_swept"] >= 85
+    assert detail["audit_device_traced"] >= 60
+    assert detail["audit_ratchet_ok"] is True
+    assert detail["audit_elapsed_s"] < 60
 
 
 def test_forward_engine_config_counts_and_keys(monkeypatch):

@@ -1,0 +1,433 @@
+"""The static-analysis subsystem: registry coverage, both audit fronts,
+the ratchet against the checked-in STATIC_AUDIT.json, seeded-violation
+fixtures pinned to exact rule codes, the host_only contract, the P0
+fixes shipped with the audit (ranking / bleu host syncs, weak-typed
+state defaults), and the predicted-hazard feed to compile telemetry."""
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from metrics_tpu import Metric  # noqa: E402
+from metrics_tpu.analysis import ast_lint, hazards, jaxpr_audit, registry, report  # noqa: E402
+
+
+# ----------------------------------------------------------- registry sweep
+def test_registry_covers_every_exported_metric():
+    """Every Metric subclass in the public API must carry an audit scope;
+    an `unclassified` case is itself a P0 (JX000) — the registry is the
+    completeness contract of the whole subsystem."""
+    cases = registry.audit_cases()
+    assert len(cases) >= 85
+    unclassified = [c.name for c in cases if c.scope == "unclassified"]
+    assert unclassified == []
+    scopes = {c.scope for c in cases}
+    assert {"device", "host_only", "wrapper", "extractor", "abstract"} <= scopes
+
+
+def test_full_sweep_is_clean_fast_and_matches_baseline():
+    """The acceptance gate: the full-registry audit on a CPU-only box has
+    zero unexplained P0s and zero drift from the checked-in baseline.
+    This is exactly what `make audit` enforces in CI."""
+    import time
+
+    t0 = time.monotonic()
+    rep = report.build_report()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60, f"audit took {elapsed:.1f}s; must stay CPU-cheap"
+    d = report.diff(rep, report.load_baseline())
+    assert d["new"] == [], f"unbaselined findings: {[f['key'] for f in d['new']]}"
+    assert d["fixed"] == [], f"stale baseline entries: {[f['key'] for f in d['fixed']]}"
+    assert d["unexplained_p0"] == []
+    assert d["capstone_drift"] is None
+    assert d["ok"]
+
+
+def test_capstone_static_counts_equal_dynamic_pins():
+    """Statically derived fused/per-leaf collective counts for the bench's
+    5-member classification suite must equal the dynamic counters pinned
+    in test_bench_configs.py::test_sync_engine_config_counts_and_keys."""
+    plan = jaxpr_audit.classification_suite_sync_plan()
+    assert plan["fused_collectives"] == 1
+    assert plan["perleaf_collectives"] == 17
+    assert plan["buckets"] == {"int32:sum": 17}
+
+
+# ------------------------------------------------------------------ ratchet
+def test_ratchet_fails_on_seeded_new_finding(tmp_path):
+    rep = report.build_report()
+    base = tmp_path / "BASE.json"
+    path = report.write_baseline(rep, str(base))
+    assert path == str(base)
+    seeded = dict(rep)
+    seeded["findings"] = rep["findings"] + [{
+        "key": "JX301:EvilMetric:pure_update", "code": "JX301", "severity": "P0",
+        "metric": "EvilMetric", "where": "pure_update", "detail": "seeded",
+    }]
+    d = report.diff(seeded, report.load_baseline(str(base)))
+    assert not d["ok"]
+    assert [f["key"] for f in d["new"]] == ["JX301:EvilMetric:pure_update"]
+    # the seeded finding is P0 with no `why` -> also the acceptance gate
+    assert [f["key"] for f in d["unexplained_p0"]] == ["JX301:EvilMetric:pure_update"]
+
+
+def test_ratchet_fails_on_fixed_but_not_rebaselined(tmp_path):
+    rep = report.build_report()
+    report.write_baseline(rep, str(tmp_path / "BASE.json"))
+    tightened = dict(rep)
+    tightened["findings"] = rep["findings"][1:]
+    d = report.diff(tightened, report.load_baseline(str(tmp_path / "BASE.json")))
+    assert not d["ok"] and len(d["fixed"]) == 1
+
+
+def test_rebaseline_preserves_hand_written_why(tmp_path):
+    rep = report.build_report()
+    base = str(tmp_path / "BASE.json")
+    report.write_baseline(rep, base)
+    data = json.load(open(base))
+    key = data["findings"][0]["key"]
+    data["findings"][0]["why"] = "reviewed by a human; accepted"
+    json.dump(data, open(base, "w"))
+    report.write_baseline(rep, base)  # regen must not lose the annotation
+    data2 = json.load(open(base))
+    assert {f["key"]: f["why"] for f in data2["findings"]}[key] == "reviewed by a human; accepted"
+
+
+def test_checked_in_baseline_explains_every_p0():
+    base = report.load_baseline()
+    assert base is not None, "STATIC_AUDIT.json must be checked in"
+    for f in base["findings"]:
+        if f["severity"] == "P0":
+            assert f.get("why"), f"P0 {f['key']} has no acceptance rationale"
+
+
+# ------------------------------------------- seeded jaxpr-front violations
+def _device_case(m, *args):
+    return registry.AuditCase(
+        name=type(m).__name__, scope="device", build=lambda: m,
+        args=lambda pools: args, note="seeded fixture",
+    )
+
+
+def _audit_one(m, *args):
+    facts, findings = jaxpr_audit.audit_metric(_device_case(m, *args), registry.example_inputs())
+    return facts, {f.code for f in findings}, findings
+
+
+class _HostSyncMetric(Metric):
+    def __init__(self):
+        super().__init__(jit_update=False)
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        if bool(jnp.sum(preds) > 0):  # forces a host sync under tracing
+            self.total = self.total + jnp.sum(preds)
+
+    def compute(self):
+        return self.total
+
+
+class _DynamicShapeMetric(Metric):
+    def __init__(self):
+        super().__init__(jit_update=False)
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        self.total = self.total + jnp.sum(preds[preds > 0])  # data-dependent shape
+
+    def compute(self):
+        return self.total
+
+
+class _CallbackMetric(Metric):
+    def __init__(self):
+        super().__init__(jit_update=False)
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        jax.debug.print("total={t}", t=self.total)
+        self.total = self.total + jnp.sum(preds)
+
+    def compute(self):
+        return self.total
+
+
+class _DtypeUnstableMetric(Metric):
+    def __init__(self):
+        super().__init__(jit_update=False)
+        self.add_state("count", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        self.count = self.count + 0.5  # int32 -> f32 flip on first update
+
+    def compute(self):
+        return self.count
+
+
+def test_seeded_host_sync_is_jx301():
+    x = jnp.ones((4,))
+    _, codes, findings = _audit_one(_HostSyncMetric(), x)
+    assert "JX301" in codes
+    f = next(f for f in findings if f.code == "JX301")
+    assert f.severity == "P0" and f.where == "pure_update"
+
+
+def test_seeded_dynamic_shape_is_jx401():
+    x = jnp.ones((4,))
+    _, codes, _ = _audit_one(_DynamicShapeMetric(), x)
+    assert "JX401" in codes
+
+
+def test_seeded_callback_is_jx201():
+    x = jnp.ones((4,))
+    facts, codes, _ = _audit_one(_CallbackMetric(), x)
+    assert "JX201" in codes
+    assert facts["programs"]["update"]["callbacks"] >= 1
+
+
+def test_seeded_dtype_instability_is_jx101_and_signature_hazard():
+    x = jnp.ones((4,))
+    facts, codes, _ = _audit_one(_DtypeUnstableMetric(), x)
+    assert "JX101" in codes
+    assert facts["states"]["count"]["donation_eligible"] is False
+    assert facts["hazards"]["signature"] is True
+
+
+def test_seeded_weak_default_is_jx102():
+    m = _HostSyncMetric()
+    # add_state pins weak scalars to strong dtypes (the shipped fix), so a
+    # weak default can only be seeded by corrupting the installed default
+    m._defaults["total"] = jnp.asarray(0.0)
+    assert m.default_state()["total"].weak_type
+    _, codes, findings = _audit_one(m, jnp.ones((4,)))
+    assert "JX102" in codes
+    f = next(f for f in findings if f.code == "JX102")
+    assert f.severity == "P0" and f.where == "total"
+
+
+# --------------------------------------------- seeded AST-front violations
+def test_seeded_lint_fixtures_pin_exact_rule_codes():
+    src = '''
+import numpy as np
+import jax
+import jax.numpy as jnp
+from metrics_tpu import Metric
+
+class Bad(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("bag", default={}, dist_reduce_fx="sum")
+        self.add_state("x", default=jnp.asarray(0.0), dist_reduce_fx="product")
+
+    def update(self, preds, target):
+        if float(preds.sum()) > 0:
+            self.total = self.total + np.mean(preds)
+        jax.debug.print("t={}", self.total)
+
+    def compute(self):
+        if self.total > 0:
+            return self.total
+        return jnp.asarray(0.0)
+
+def _bad_compute(x):
+    return np.clip(x, 0, 1)
+'''
+    vs = ast_lint.lint_source(src, "fixture.py")
+    got = {(v.code, v.qualname) for v in vs}
+    assert ("MT101", "Bad.update") in got        # float() on traced value
+    assert ("MT102", "Bad.compute") in got       # Python branch on state
+    assert ("MT201", "Bad.__init__") in got      # mutable add_state default
+    assert ("MT202", "Bad.__init__") in got      # invalid dist_reduce_fx
+    assert ("MT301", "Bad.update") in got        # numpy on traced value
+    assert ("MT301", "_bad_compute") in got      # ...and in functional helpers
+    assert ("MT401", "Bad.update") in got        # callback in pure path
+    by_code = {v.code: v.severity for v in vs}
+    assert by_code["MT101"] == by_code["MT201"] == by_code["MT301"] == by_code["MT401"] == "P0"
+    assert by_code["MT102"] == by_code["MT202"] == "P1"
+
+
+def test_lint_understands_concreteness_guard_and_host_only():
+    guarded = '''
+import jax
+import jax.numpy as jnp
+def _guarded_update(preds, target):
+    concrete = not isinstance(preds, jax.core.Tracer)
+    if concrete and bool((preds < 0).any()):
+        raise ValueError("negative")
+    return jnp.sum(preds)
+'''
+    assert ast_lint.lint_source(guarded, "g.py") == []
+    host_only = '''
+import numpy as np
+from metrics_tpu import Metric
+class HostThing(Metric):
+    host_only = True
+    def update(self, preds):
+        self.vals.append(float(np.mean(preds)))
+    def compute(self):
+        return sum(self.vals)
+'''
+    assert ast_lint.lint_source(host_only, "h.py") == []
+
+
+def test_production_tree_lints_clean():
+    assert ast_lint.lint_paths() == []
+
+
+# --------------------------------------------------------------- host_only
+def test_host_only_metrics_are_marked_and_refused():
+    from metrics_tpu import WordErrorRate
+    from metrics_tpu.dispatch import FastDispatchUnsupported
+
+    assert WordErrorRate.host_only is True
+    with pytest.warns(UserWarning, match="host_only"):
+        m = WordErrorRate(jit_update=True)  # downgraded, not broken
+    m.update(["hello world"], ["hello world"])
+    assert float(m.compute()) == 0.0
+    with pytest.raises(FastDispatchUnsupported, match="host_only"):
+        m._make_dispatcher()._prepare_call((), (), ())
+
+
+def test_host_only_cases_cover_the_text_and_detection_suites():
+    names = {c.name for c in registry.audit_cases() if c.scope == "host_only"}
+    for expected in ("WordErrorRate", "SQuAD", "ROUGEScore", "SacreBLEUScore",
+                     "BLEUScore", "CHRFScore", "MeanAveragePrecision"):
+        assert expected in names
+
+
+# ----------------------------------------------- the P0 fixes shipped here
+def test_ranking_compute_is_trace_safe_with_parity():
+    from metrics_tpu import CoverageError, LabelRankingAveragePrecision, LabelRankingLoss
+
+    rng = np.random.RandomState(7)
+    preds = jnp.asarray(rng.rand(12, 5).astype(np.float32))
+    target = jnp.asarray((rng.rand(12, 5) > 0.5).astype(np.int32))
+    w = jnp.asarray(rng.rand(12).astype(np.float32))
+    for cls in (CoverageError, LabelRankingAveragePrecision, LabelRankingLoss):
+        for weights in (None, w):
+            m = cls()
+            m.update(preds, target, sample_weight=weights)
+            eager = m.compute()
+            # the compute path must now trace (it used to bool() the weight)
+            traced = jax.jit(m.pure_compute)({a: getattr(m, a) for a in m._defaults})
+            np.testing.assert_allclose(np.asarray(eager), np.asarray(traced), rtol=1e-6)
+
+
+def test_bleu_compute_is_trace_safe_with_parity():
+    from metrics_tpu.functional.text.bleu import _bleu_score_compute, bleu_score
+
+    num = jnp.asarray([3.0, 2.0, 1.0, 1.0])
+    den = jnp.asarray([6.0, 5.0, 4.0, 3.0])
+    pl, tl = jnp.asarray(6.0), jnp.asarray(7.0)
+    jitted = jax.jit(_bleu_score_compute, static_argnames=("n_gram", "smooth"))
+    np.testing.assert_allclose(
+        np.asarray(jitted(pl, tl, num, den)),
+        np.asarray(_bleu_score_compute(pl, tl, num, den)), rtol=1e-6)
+    # the zero-ngram early-out must survive as an on-device select
+    assert float(jitted(pl, tl, num.at[3].set(0.0), den)) == 0.0
+    assert float(bleu_score(["no overlap here"], [["completely different"]])) == 0.0
+
+
+def test_state_defaults_are_strong_typed_everywhere():
+    """The JX102 fix: weak scalar defaults are pinned to canonical strong
+    dtypes at add_state time, so the first update can never flip the
+    state aval (weak->strong) and force a guaranteed retrace."""
+    for case in registry.audit_cases():
+        if case.scope not in ("device", "wrapper") or case.build is None:
+            continue
+        m = case.build()
+        for attr, leaf in m.default_state().items():
+            if not isinstance(leaf, list):
+                assert not leaf.weak_type, f"{case.name}.{attr} is weak-typed"
+
+
+# ------------------------------------------------------- hazard prediction
+def test_hazard_feed_and_predicted_compile_attr(tmp_path, monkeypatch):
+    base = tmp_path / "AUDIT.json"
+    base.write_text(json.dumps({
+        "version": 1,
+        "hazards": {"Spiky": {"static-key": True, "signature": False}},
+        "findings": [],
+    }))
+    monkeypatch.setenv("METRICS_TPU_STATIC_AUDIT", str(base))
+    hazards.invalidate()
+    try:
+        assert hazards.predicted("Spiky", "new-static-key") is True
+        assert hazards.predicted("Spiky", "new-signature") is False
+        assert hazards.predicted("Unknown", "new-static-key") is False
+        # causes outside the mapping carry no prediction at all
+        assert hazards.predicted("Spiky", "new-shape-bucket") is None
+        assert hazards.predicted("Spiky", "first-compile") is None
+    finally:
+        monkeypatch.delenv("METRICS_TPU_STATIC_AUDIT")
+        hazards.invalidate()
+
+
+class _Spiky(Metric):
+    """A bool update kwarg = a static-key retrace hazard by construction."""
+
+    def __init__(self):
+        super().__init__(jit_update=True)
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, normalize=False):
+        self.total = self.total + (jnp.mean(preds) if normalize else jnp.sum(preds))
+
+    def compute(self):
+        return self.total
+
+
+def test_static_key_hazard_is_derived_from_the_update_signature():
+    facts, _, _ = _audit_one(_Spiky(), jnp.ones((4,)))
+    assert facts["hazards"]["static-key"] is True
+
+
+def test_compile_spans_carry_predicted_attr(tmp_path, monkeypatch):
+    from metrics_tpu import telemetry
+
+    base = tmp_path / "AUDIT.json"
+    base.write_text(json.dumps({
+        "version": 1,
+        "hazards": {"_Spiky": {"static-key": True, "signature": False}},
+        "findings": [],
+    }))
+    monkeypatch.setenv("METRICS_TPU_STATIC_AUDIT", str(base))
+    hazards.invalidate()
+    try:
+        with telemetry.instrument() as sess:
+            m = _Spiky()
+            p = jnp.ones((4,))
+            m.update(p)
+            m.update(p, normalize=True)  # static-key flip -> recompile
+        compiles = [e for e in sess.events if e.name == "compile"]
+        causes = {e.attrs.get("cause") for e in compiles}
+        assert "new-static-key" in causes, causes
+        for e in compiles:
+            cause = e.attrs.get("cause")
+            if cause == "new-static-key":
+                assert e.attrs.get("predicted") is True, e.attrs
+            elif cause == "first-compile":
+                assert "predicted" not in e.attrs  # no prediction for cold start
+    finally:
+        monkeypatch.delenv("METRICS_TPU_STATIC_AUDIT")
+        hazards.invalidate()
+
+
+# ----------------------------------------------------------------- the CLI
+def test_cli_diff_and_json(tmp_path):
+    import subprocess
+
+    root = os.path.join(os.path.dirname(__file__), "..", "..")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "tools/static_audit.py", "--diff"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK: audit matches baseline" in out.stdout

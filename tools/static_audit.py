@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Run the two-front static audit and check it against STATIC_AUDIT.json.
+
+Usage::
+
+    python tools/static_audit.py                   # human summary of this run
+    python tools/static_audit.py --diff            # ratchet vs the checked-in
+        # baseline: exit 1 on NEW findings, on FIXED-but-not-rebaselined
+        # ones, on unexplained P0s, or on capstone drift — `make audit`
+    python tools/static_audit.py --json            # full report as JSON
+    python tools/static_audit.py --write-baseline  # accept this run as the
+        # new baseline (carries forward existing `why` annotations)
+
+Everything here is abstract: ``jax.make_jaxpr`` traces + ``ast`` walks,
+no device execution — it runs on a CPU-only box in seconds and proves
+the invariants the benches measure (the statically-derived capstone
+collective counts are pinned equal to the dynamic bench counters in
+``tests/bases/test_bench_configs.py``).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # the audit never needs a device
+
+
+def summarize(report: Dict[str, Any], elapsed_s: float) -> str:
+    lines = []
+    s = report["summary"]
+    lines.append("== static audit ==")
+    lines.append(
+        f"  swept {s['metrics_swept']} metrics ({s['device_traced']} device-traced)"
+        f" in {elapsed_s:.1f}s"
+    )
+    cap = report["capstone"]
+    lines.append(
+        f"  capstone (5-member classification suite): {cap['fused_collectives']} fused"
+        f" collective / {cap['perleaf_collectives']} per-leaf — buckets {cap['buckets']}"
+    )
+    lines.append(f"  hazard table: {len(report['hazards'])} metrics with predicted retrace hazards")
+    lines.append("")
+    lines.append("== findings ==")
+    if not report["findings"]:
+        lines.append("  none")
+    by_code: Dict[str, int] = {}
+    for f in report["findings"]:
+        by_code[f["code"]] = by_code.get(f["code"], 0) + 1
+    for code in sorted(by_code):
+        sev = next(f["severity"] for f in report["findings"] if f["code"] == code)
+        lines.append(f"  {code} ({sev}) x{by_code[code]}")
+    for f in report["findings"]:
+        if f["severity"] == "P0":
+            lines.append(f"    {f['code']} {f['metric']} [{f['where']}]: {f['detail']}")
+    return "\n".join(lines)
+
+
+def summarize_diff(d: Dict[str, Any]) -> str:
+    lines = []
+    if d.get("error"):
+        return f"FAIL: {d['error']}"
+    if d["new"]:
+        lines.append(f"FAIL: {len(d['new'])} NEW finding(s) not in baseline (fix or re-baseline with --write-baseline):")
+        for f in d["new"]:
+            lines.append(f"  + {f['severity']} {f['code']} {f['metric']} [{f['where']}]: {f['detail']}")
+    if d["fixed"]:
+        lines.append(f"FAIL: {len(d['fixed'])} baselined finding(s) no longer occur — tighten the ratchet (--write-baseline):")
+        for f in d["fixed"]:
+            lines.append(f"  - {f['severity']} {f['code']} {f['metric']} [{f['where']}]")
+    if d["unexplained_p0"]:
+        lines.append(f"FAIL: {len(d['unexplained_p0'])} P0 finding(s) without a `why` in the baseline:")
+        for f in d["unexplained_p0"]:
+            lines.append(f"  ? {f['code']} {f['metric']} [{f['where']}]: {f['detail']}")
+    if d.get("capstone_drift"):
+        lines.append(
+            "FAIL: capstone collective counts drifted:"
+            f" run={d['capstone_drift']['run']} baseline={d['capstone_drift']['baseline']}"
+        )
+    if d["ok"]:
+        lines.append("OK: audit matches baseline (no new findings, no stale entries, all P0s explained)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--json", action="store_true", help="emit the full report as JSON")
+    parser.add_argument("--diff", action="store_true", help="ratchet against the checked-in baseline; exit 1 on drift")
+    parser.add_argument("--write-baseline", action="store_true", help="accept this run as the new STATIC_AUDIT.json")
+    parser.add_argument("--baseline", default=None, help="baseline path override (default: repo STATIC_AUDIT.json)")
+    args = parser.parse_args(argv)
+
+    from metrics_tpu.analysis import report as report_mod
+
+    t0 = time.monotonic()
+    report = report_mod.build_report()
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline:
+        path = report_mod.write_baseline(report, args.baseline)
+        print(f"wrote {path} ({len(report['findings'])} accepted findings)")
+        return 0
+    if args.diff:
+        d = report_mod.diff(report, report_mod.load_baseline(args.baseline))
+        print(summarize_diff(d))
+        return 0 if d["ok"] else 1
+    if args.json:
+        json.dump(report, sys.stdout, indent=1)
+        print()
+        return 0
+    print(summarize(report, elapsed))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -84,6 +84,26 @@ def summarize(events: List[Dict[str, Any]]) -> str:
     for cause in sorted(causes):
         lines.append(f"  cause {cause:<22}{causes[cause]:>5}")
 
+    # predicted vs observed: the static audit (STATIC_AUDIT.json hazard
+    # table, served by metrics_tpu.analysis.hazards) stamps `predicted`
+    # onto compile spans whose cause class it models (static-key /
+    # signature flips). An `unpredicted` retrace means the audit's model
+    # of that owner is stale — rerun `make audit`.
+    attributable = [
+        e for e in compiles
+        if "predicted" in (e.get("attrs") or {})
+    ]
+    if attributable:
+        predicted = sum(1 for e in attributable if (e.get("attrs") or {}).get("predicted"))
+        lines.append(f"  predicted by static audit: {predicted}/{len(attributable)}")
+        unpredicted: Dict[str, int] = {}
+        for e in attributable:
+            if not (e.get("attrs") or {}).get("predicted"):
+                key = f"{e.get('owner', '?')}:{(e.get('attrs') or {}).get('cause', '?')}"
+                unpredicted[key] = unpredicted.get(key, 0) + 1
+        for key in sorted(unpredicted):
+            lines.append(f"  UNPREDICTED {key:<28}{unpredicted[key]:>5}  (stale audit?)")
+
     collectives = [e for e in events if e["name"] == "collective"]
     total_bytes = sum(int((e.get("attrs") or {}).get("nbytes", 0)) for e in collectives)
     lines.append("")
