@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos doctest audit bench bench-forward serve-bench trace tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos crash doctest audit bench bench-forward serve-bench trace tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -56,6 +56,16 @@ chaos:
 		echo "=== ambient fault: $$f ==="; \
 		METRICS_TPU_INJECT_FAULT=$$f python -m pytest tests/bases/test_chaos.py -k ambient -q || exit 1; \
 	done
+	$(MAKE) crash
+
+# kill-and-recover loop: for EVERY registered crash point a subprocess is
+# SIGKILLed at that instruction, then a fresh process recover()s
+# (checkpoint + sequence-fenced journal replay) and must reach a state
+# bit-identical to an uncrashed twin. The full matrix is slow-marked, so
+# the -m override here runs all of it (the default tier keeps one
+# representative point).
+crash:
+	python -m pytest tests/bases/test_crash_recovery.py -q -m 'chaos or slow'
 
 # on-device smoke suite: needs a live TPU backend (skips itself otherwise)
 tpu-smoke:

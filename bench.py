@@ -629,6 +629,68 @@ print((time.perf_counter() - t0) * 1e6)
     detail["serve_updates_per_sec_1k_sessions"] = round(S / max(elapsed, 1e-9), 1)
 
 
+def _cfg_crash_recovery(detail: dict, sessions: int = 64, steps: int = 4, tail: int = 1000) -> None:
+    """Write-ahead journal costs (:mod:`metrics_tpu.wal` + serve recovery).
+
+    Two claims. (1) **Journal append overhead**: the same steady-state
+    submit+flush loop with and without a ``journal_dir`` — the ratio is
+    the full durability tax (frame build + fsync per submit), reported
+    alongside the fsync latency percentiles that dominate it. (2)
+    **Recovery replay**: a ``tail``-record journal with no checkpoint is
+    recovered by a fresh service; replay queues every record through one
+    batched flush, so the wall time is journal scan + one stacked launch
+    wave, reported in µs.
+
+    ``sessions``/``steps``/``tail`` let the bench-config pin test run the
+    same code path at test-budget scale."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+    from metrics_tpu.serve import MetricsService
+
+    rng = np.random.RandomState(23)
+    C, B, S = 8, 16, sessions
+    preds = jnp.asarray(rng.randint(0, C, (S, B)))
+    targs = jnp.asarray(rng.randint(0, C, (S, B)))
+
+    def steady_state(journal_dir):
+        svc = MetricsService(Accuracy(task="multiclass", num_classes=C), journal_dir=journal_dir)
+        for i in range(S):  # warmup: table built, stacked program compiled
+            svc.submit(f"s{i}", preds[i], targs[i])
+        svc.drain()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            for i in range(S):
+                svc.submit(f"s{i}", preds[i], targs[i])
+            svc.flush()
+        svc.drain()
+        return time.perf_counter() - t0, svc
+
+    with tempfile.TemporaryDirectory() as root:
+        t_wal, svc_wal = steady_state(os.path.join(root, "wal"))
+        t_off, _ = steady_state(None)
+        detail["wal_append_overhead_ratio"] = round(t_wal / max(t_off, 1e-9), 3)
+        stats = svc_wal.journal.stats()
+        detail["wal_fsync_us_p50"] = stats["fsync_us_p50"]
+        detail["wal_fsync_us_p95"] = stats["fsync_us_p95"]
+        detail["wal_append_bytes_per_record"] = round(stats["bytes"] / max(stats["appends"], 1), 1)
+
+        replay_dir = os.path.join(root, "replay")
+        producer = MetricsService(Accuracy(task="multiclass", num_classes=C), journal_dir=replay_dir)
+        for j in range(tail):
+            producer.submit(f"s{j % S}", preds[j % S], targs[j % S])
+        producer.drain()
+        producer.journal.close()
+        consumer = MetricsService(Accuracy(task="multiclass", num_classes=C), journal_dir=replay_dir)
+        t0 = time.perf_counter()
+        consumer.recover()
+        key = "wal_replay_us_1k_tail" if tail == 1000 else f"wal_replay_us_{tail}_tail"
+        detail[key] = round((time.perf_counter() - t0) * 1e6, 1)
+        detail["wal_replay_records"] = consumer.stats["replayed_records"]
+
+
 def _machinery_device(detail: dict):
     """Host CPU device for the compute-group machinery configs.
 
@@ -1231,6 +1293,7 @@ def _bench_detail() -> dict:
         ("telemetry_idle_overhead_ratio", _cfg_telemetry_overhead),
         ("resilience_idle_overhead_ratio", _cfg_resilience_overhead),
         ("serve_updates_per_sec_1k_sessions", _cfg_serving),
+        ("wal_append_overhead_ratio", _cfg_crash_recovery),
     ]
     detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
     return detail
@@ -1450,6 +1513,7 @@ def _bench_detail_fast() -> dict:
         ("telemetry_overhead", _cfg_telemetry_overhead),
         ("resilience_overhead", _cfg_resilience_overhead),
         ("serving", _cfg_serving),
+        ("crash_recovery", lambda d: _cfg_crash_recovery(d, sessions=32, steps=2, tail=200)),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),

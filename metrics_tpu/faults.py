@@ -47,9 +47,24 @@ backoff is tested without wall-clock sleeps.
 Every probe is designed to be near-free when nothing is injected: one
 dict check plus one ``os.environ`` lookup (parse cached on the raw env
 string).
+
+Crash points
+------------
+
+Orthogonal to the recoverable faults above, the **crash-point registry**
+(:data:`CRASH_POINTS`) simulates the unrecoverable failure mode: the
+process is SIGKILLed *at a specific instruction* inside the serving
+write-ahead-journal / checkpoint machinery (:mod:`metrics_tpu.wal`,
+:mod:`metrics_tpu.serve`). Arm one with :func:`crash` (or
+``METRICS_TPU_CRASH=<point>[:nth]`` — fire on the nth probe), then the
+kill-and-recover harness (``tests/bases/test_crash_recovery.py``,
+``make crash``) restarts the process and asserts recovery is
+bit-identical to an uncrashed twin. There is no context manager: a fired
+crash point never returns.
 """
 import os
 import random
+import signal
 import threading
 import zlib
 from contextlib import contextmanager
@@ -58,6 +73,7 @@ from typing import Any, Dict, Generator, List, Optional, Tuple
 __all__ = [
     "InjectedFault",
     "FAULT_NAMES",
+    "CRASH_POINTS",
     "inject",
     "check",
     "should_fire",
@@ -67,6 +83,10 @@ __all__ = [
     "corrupt_payload",
     "any_active",
     "fired_count",
+    "crash",
+    "crash_armed",
+    "crash_will_fire",
+    "crash_point",
 ]
 
 FAULT_NAMES = (
@@ -276,3 +296,92 @@ def corrupt_payload(payload: Dict[str, Any], key: Optional[str] = None) -> Dict[
 def crc(data: bytes, seed: int = 0) -> int:
     """Shared crc32 helper (resilience checksums + tests)."""
     return zlib.crc32(data, seed) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------- crash points
+# SIGKILL-at-an-instruction simulation for the crash-recovery harness.
+# Unlike the faults above these never raise and never recover: a fired
+# probe terminates the process with SIGKILL, exactly like a TPU
+# preemption or OOM-killer event, so no `finally:`/`atexit` cleanup runs.
+CRASH_POINTS = (
+    "post-journal",        # serve.submit: record journaled, not yet queued
+    "mid-journal-append",  # wal.append: half a frame written (torn tail)
+    "mid-flush",           # serve.flush: some waves launched, rest pending
+    "mid-checkpoint",      # serve.checkpoint: payload written, not renamed
+    "mid-truncate",        # wal.truncate: some retired segments unlinked
+)
+
+_CRASH_ENV = "METRICS_TPU_CRASH"
+
+# armed spec: (point name, remaining probe count before firing)
+_crash_spec: Optional[List[Any]] = None
+# env parse cache, same shape as the fault env cache
+_crash_env_cache: Tuple[Optional[str], Optional[List[Any]]] = (None, None)
+
+
+def crash(after: str, nth: int = 1) -> None:
+    """Arm crash point ``after`` process-wide: the ``nth`` probe of that
+    point SIGKILLs the process. Programmatic twin of
+    ``METRICS_TPU_CRASH=<point>[:nth]``. Pass ``nth=0`` to disarm."""
+    global _crash_spec
+    if after not in CRASH_POINTS:
+        raise ValueError(f"unknown crash point {after!r}; choose from {CRASH_POINTS}")
+    with _lock:
+        _crash_spec = None if nth <= 0 else [after, int(nth)]
+
+
+def _crash_lookup() -> Optional[List[Any]]:
+    if _crash_spec is not None:
+        return _crash_spec
+    raw = os.environ.get(_CRASH_ENV)
+    if not raw:
+        return None
+    global _crash_env_cache
+    cached_raw, cached = _crash_env_cache
+    if raw == cached_raw:
+        return cached
+    name, _, nth = raw.partition(":")
+    name = name.strip()
+    spec: Optional[List[Any]] = None
+    if name in CRASH_POINTS:
+        try:
+            spec = [name, int(nth) if nth else 1]
+        except ValueError:
+            spec = [name, 1]
+    with _lock:
+        _crash_env_cache = (raw, spec)
+    return spec
+
+
+def crash_armed(name: str) -> bool:
+    """True when crash point ``name`` is armed (any remaining count)."""
+    if _crash_spec is None and _CRASH_ENV not in os.environ:
+        return False
+    spec = _crash_lookup()
+    return spec is not None and spec[0] == name and spec[1] > 0
+
+
+def crash_will_fire(name: str) -> bool:
+    """Non-consuming look-ahead: True when the *next* probe of ``name``
+    will kill the process. ``wal.append`` uses this to write only half a
+    frame (a genuine torn tail) before its ``mid-journal-append`` probe."""
+    if _crash_spec is None and _CRASH_ENV not in os.environ:
+        return False
+    spec = _crash_lookup()
+    return spec is not None and spec[0] == name and spec[1] == 1
+
+
+def crash_point(name: str, where: str = "") -> None:
+    """Probe crash point ``name``: consume one count tick; at zero,
+    SIGKILL the current process (never returns). Near-free when
+    disarmed — one global check plus one env lookup."""
+    if _crash_spec is None and _CRASH_ENV not in os.environ:
+        return
+    spec = _crash_lookup()
+    if spec is None or spec[0] != name or spec[1] <= 0:
+        return
+    with _lock:
+        spec[1] -= 1
+        fire = spec[1] == 0
+    if fire:
+        os.kill(os.getpid(), signal.SIGKILL)

@@ -200,7 +200,9 @@ def record_degrade(
 ) -> str:
     """Emit the cause-tagged ``degrade`` span for one demotion; returns
     the cause tag. ``engine`` is the span kind (``forward``/``dispatch``/
-    ``fused``/``collective``)."""
+    ``fused``/``collective``/``serve``/``checkpoint``/``session`` — the
+    last is the serving circuit breaker; admission-control degrades emit
+    their own ``admission``-kind spans directly in serve.py)."""
     cause = classify(err)
     if policy is not None:
         attrs.setdefault("cooldown", policy.cooldown)

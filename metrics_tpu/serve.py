@@ -49,6 +49,31 @@ Telemetry: every stacked launch is an ``update`` span with kind
 ``stacked-aot`` on the ``serve`` stream; compiles carry the usual cause
 tags (``first-compile`` / ``new-signature`` / ``persistent-cache-hit``).
 
+* **Crash consistency.** With ``journal_dir`` set, every ``submit()``
+  appends a checksummed, sequence-numbered record to a write-ahead
+  journal (:mod:`metrics_tpu.wal`) *before* the request becomes eligible
+  for ``flush()``. Checkpoints embed the journal high-water mark
+  (``journal_seq``) and truncate retired segments; :meth:`restore`
+  replays the un-checkpointed tail idempotently (sequence-fenced — a
+  record is applied exactly once no matter where the process died), so a
+  SIGKILL at *any* instruction loses nothing. ``METRICS_TPU_WAL=0``
+  restores checkpoint-only durability. See ``docs/serving.md``, "Crash
+  consistency".
+* **Admission control.** ``max_queue`` bounds the submit queue with a
+  configurable overload policy — ``block`` (wait, optionally up to
+  ``admission_timeout_s``), ``reject`` (:class:`QueueFullError`), or
+  ``shed-oldest`` (drop the oldest queued request). ``request_deadline_s``
+  expires stale queued work at flush time. Every shed, rejected, or
+  expired request is exactly one cause-tagged ``degrade`` span
+  (``queue-full-shed`` / ``queue-full-reject`` / ``deadline-expired``)
+  and — when journaled — one ``DROP`` record, so recovery replays
+  exactly what the live process served. A per-session **circuit
+  breaker** (the same :class:`~metrics_tpu.resilience.ResiliencePolicy`
+  backoff machinery the engines use) trips after repeated per-request
+  failures: further submits for that session raise
+  :class:`CircuitOpenError` until the cooldown expires, so one poisoned
+  tenant cannot monopolize the flush path.
+
 Session handles::
 
     svc = MetricsService(Accuracy(task="multiclass", num_classes=10))
@@ -69,14 +94,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import aot_cache, faults, resilience, telemetry
+from metrics_tpu import aot_cache, faults, resilience, telemetry, wal
 from metrics_tpu._compat import profiler_annotation
 from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
 
-__all__ = ["MetricsService", "MetricSession"]
+__all__ = ["MetricsService", "MetricSession", "QueueFullError", "CircuitOpenError"]
 
 _MIN_SESSION_BUCKET = 8
 _MIN_CAPACITY = 64
+
+_ADMISSION_POLICIES = ("block", "reject", "shed-oldest")
+
+
+class QueueFullError(RuntimeError):
+    """Admission control rejected a submit: the bounded queue is full and
+    the policy is ``reject`` (or ``block`` timed out)."""
+
+
+class CircuitOpenError(RuntimeError):
+    """The per-session circuit breaker is open: this session failed
+    repeatedly and is in backoff cooldown (counted in submits)."""
 
 
 class MetricSession:
@@ -115,6 +152,21 @@ class MetricsService:
         checkpoint_every: write a checkpoint every N flushes (0 = never).
         max_inflight: pending result generations before the dispatcher
             blocks on the oldest (double buffering at the default 2).
+        journal_dir: write-ahead-journal directory (:mod:`metrics_tpu.wal`).
+            ``None`` (default) or ``METRICS_TPU_WAL=0`` keeps the
+            checkpoint-only durability of PR 7. One directory per service.
+        max_queue: submit-queue bound (``None`` = unbounded, the legacy
+            posture). A full queue engages the ``admission`` policy.
+        admission: overload policy for a full queue — ``"block"`` (wait
+            for space, optionally up to ``admission_timeout_s``, then
+            :class:`QueueFullError`), ``"reject"`` (raise immediately), or
+            ``"shed-oldest"`` (drop the oldest queued request with a
+            ``queue-full-shed`` degrade span + journal ``DROP`` record).
+        admission_timeout_s: max seconds a ``block``-policy submit waits
+            for queue space (``None`` = forever).
+        request_deadline_s: queued requests older than this at flush time
+            are expired (``deadline-expired`` degrade span + ``DROP``
+            record) instead of served (``None`` = no deadline).
     """
 
     def __init__(
@@ -125,6 +177,11 @@ class MetricsService:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 0,
         max_inflight: int = 2,
+        journal_dir: Optional[str] = None,
+        max_queue: Optional[int] = None,
+        admission: str = "block",
+        admission_timeout_s: Optional[float] = None,
+        request_deadline_s: Optional[float] = None,
     ) -> None:
         from metrics_tpu.collections import MetricCollection
         from metrics_tpu.metric import Metric
@@ -144,12 +201,21 @@ class MetricsService:
                     f"template state {name!r} is a list state; sessions need "
                     "fixed-shape array state to stack"
                 )
+        if admission not in _ADMISSION_POLICIES:
+            raise ValueError(
+                f"admission must be one of {_ADMISSION_POLICIES}, got {admission!r}"
+            )
         self.template = template
         self.label = f"MetricsService[{type(template).__name__}]"
         self.coalesce = coalesce
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = int(checkpoint_every)
         self.max_inflight = max(1, int(max_inflight))
+        self.journal_dir = journal_dir
+        self.max_queue = None if max_queue is None else max(1, int(max_queue))
+        self.admission = admission
+        self.admission_timeout_s = admission_timeout_s
+        self.request_deadline_s = request_deadline_s
 
         self._names: List[str] = list(defaults)
         self._default_rows = {k: jnp.asarray(defaults[k]) for k in self._names}
@@ -162,13 +228,28 @@ class MetricsService:
         self._rows: Dict[str, int] = {}
         self._free: List[int] = list(range(self._capacity - 1, -1, -1))
 
-        self._queue: List[Tuple[str, Tuple, Dict]] = []
-        self._queue_lock = threading.Lock()
+        # queue entries: (name, args, kwargs, journal seq or None, enqueue
+        # monotonic ts or None). The condition doubles as the queue lock;
+        # flush() notifies blocked submitters after every pop.
+        self._queue: List[Tuple[str, Tuple, Dict, Optional[int], Optional[float]]] = []
+        self._queue_cond = threading.Condition()
         # reentrant: the periodic checkpoint inside flush() drains, and
         # drain() re-enters flush() on the same thread (the queue is empty
         # by then, so the inner pass is a no-op)
         self._flush_lock = threading.RLock()
         self._inflight: deque = deque()
+
+        self._wal: Optional[wal.WriteAheadLog] = None
+        if journal_dir is not None and wal.wal_enabled():
+            self._wal = wal.WriteAheadLog(journal_dir, owner=self.label)
+        # sessions explicitly closed: submit() for one raises KeyError until
+        # open_session() reclaims the name (never-seen names still auto-open)
+        self._closed: set = set()
+        # per-session circuit breakers, created lazily on first failure
+        self._breakers: Dict[str, resilience.ResiliencePolicy] = {}
+        # True while restore() replays the journal tail: suppresses
+        # re-journaling, deadline expiry, and periodic checkpoints
+        self._replaying = False
 
         self._exec_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._compute_one = None
@@ -186,6 +267,12 @@ class MetricsService:
             "retraces": 0,
             "checkpoints": 0,
             "evictions": 0,
+            "shed_requests": 0,
+            "rejected_requests": 0,
+            "expired_requests": 0,
+            "breaker_rejected": 0,
+            "failed_requests": 0,
+            "replayed_records": 0,
         }
 
     # -------------------------------------------------------------- sessions
@@ -198,7 +285,9 @@ class MetricsService:
         return MetricSession(self, name)
 
     def open_session(self, name: str) -> int:
-        """Assign a state row to ``name`` (idempotent); returns the row."""
+        """Assign a state row to ``name`` (idempotent); returns the row.
+        Explicitly reclaims a name retired by :meth:`close_session`."""
+        self._closed.discard(name)
         row = self._rows.get(name)
         if row is not None:
             return row
@@ -209,17 +298,28 @@ class MetricsService:
         return row
 
     def close_session(self, name: str) -> None:
-        """Release ``name``'s row back to the pool (state reset to default)."""
+        """Release ``name``'s row back to the pool (state reset to default).
+        Further :meth:`submit` calls for the name raise ``KeyError`` until
+        :meth:`open_session` reclaims it."""
         row = self._rows.pop(name, None)
         if row is None:
             return
+        self._closed.add(name)
+        self._breakers.pop(name, None)  # the name may be reclaimed by a new tenant
+        if self._wal is not None and not self._replaying:
+            self._wal.append(wal.CLOSE, name)
         for k in self._names:
             self._stacked[k] = self._stacked[k].at[row].set(self._default_rows[k])
         self._free.append(row)
 
     def reset_session(self, name: str) -> None:
-        """Reset one session's accumulator to the default state."""
+        """Reset one session's accumulator to the default state. Also clears
+        the session's circuit breaker — a reset is the operator's explicit
+        "this tenant is healthy again" signal."""
         row = self.open_session(name)
+        if self._wal is not None and not self._replaying:
+            self._wal.append(wal.RESET, name)
+        self._breakers.pop(name, None)
         for k in self._names:
             self._stacked[k] = self._stacked[k].at[row].set(self._default_rows[k])
 
@@ -239,12 +339,86 @@ class MetricsService:
 
     # --------------------------------------------------------------- intake
     def submit(self, name: str, *args: Any, **kwargs: Any) -> None:
-        """Enqueue one update for session ``name`` (thread-safe, non-blocking;
-        the device work happens at the next :meth:`flush`)."""
+        """Enqueue one update for session ``name`` (thread-safe; the device
+        work happens at the next :meth:`flush`).
+
+        Order of gates: a closed session raises ``KeyError`` immediately
+        (never deep inside the coalescer); an open circuit breaker raises
+        :class:`CircuitOpenError`; a full bounded queue engages the
+        admission policy. Only an *admitted* request is journaled — by the
+        time this returns, the record is durable and the request is
+        eligible for flush, in that order (the write-ahead contract)."""
+        if name in self._closed:
+            raise KeyError(
+                f"session {name!r} has been closed; call open_session({name!r}) "
+                "to reuse the name"
+            )
+        breaker = self._breakers.get(name)
+        if breaker is not None and not breaker.allow():
+            self.stats["breaker_rejected"] += 1
+            telemetry.emit(
+                "degrade", self.label, kind="session", cause="breaker-open",
+                session=name, cooldown=breaker.cooldown,
+            )
+            raise CircuitOpenError(
+                f"session {name!r} circuit breaker is open after "
+                f"{breaker.failures} failure(s); retry after the cooldown "
+                f"({breaker.cooldown} more submits) or reset_session()"
+            )
         self.open_session(name)
-        with self._queue_lock:
-            self._queue.append((name, args, kwargs))
+        with self._queue_cond:
+            if self.max_queue is not None and len(self._queue) >= self.max_queue:
+                self._admit_locked(name)
+            seq: Optional[int] = None
+            if self._wal is not None and not self._replaying:
+                seq = self._wal.append(wal.UPDATE, name, args, kwargs)
+                faults.crash_point("post-journal", self.label)
+            ts = time.monotonic() if self.request_deadline_s is not None else None
+            self._queue.append((name, args, kwargs, seq, ts))
             self.stats["submits"] += 1
+
+    def _admit_locked(self, name: str) -> None:
+        """Resolve a full queue under the admission policy (queue condition
+        held). Returns with space available, or raises
+        :class:`QueueFullError`. Every victim/rejection is one cause-tagged
+        ``degrade`` span; shed victims also get a journal ``DROP`` record
+        so recovery replays exactly what live served."""
+        assert self.max_queue is not None
+        if self.admission == "shed-oldest":
+            while len(self._queue) >= self.max_queue:
+                v_name, _, _, v_seq, _ = self._queue.pop(0)
+                if self._wal is not None and v_seq is not None:
+                    self._wal.append(
+                        wal.DROP, v_name, drop_seq=v_seq, drop_cause="queue-full-shed"
+                    )
+                self.stats["shed_requests"] += 1
+                telemetry.emit(
+                    "degrade", self.label, kind="admission",
+                    cause="queue-full-shed", session=v_name, seq=v_seq,
+                )
+            return
+        if self.admission == "block":
+            deadline = (
+                None
+                if self.admission_timeout_s is None
+                else time.monotonic() + self.admission_timeout_s
+            )
+            while len(self._queue) >= self.max_queue:
+                timeout = None if deadline is None else deadline - time.monotonic()
+                if timeout is not None and timeout <= 0:
+                    break
+                self._queue_cond.wait(timeout)
+            if len(self._queue) < self.max_queue:
+                return
+        self.stats["rejected_requests"] += 1
+        telemetry.emit(
+            "degrade", self.label, kind="admission", cause="queue-full-reject",
+            session=name, policy=self.admission,
+        )
+        raise QueueFullError(
+            f"submit queue is full ({self.max_queue} requests); admission "
+            f"policy {self.admission!r} rejected session {name!r}"
+        )
 
     def update(self, name: str, *args: Any, **kwargs: Any) -> None:
         """Synchronous convenience: submit + flush."""
@@ -257,8 +431,12 @@ class MetricsService:
         of requests served. Coalesces same-session requests, groups by
         executable signature, and issues ONE launch per group per wave."""
         with self._flush_lock:
-            with self._queue_lock:
-                pending, self._queue = self._queue, []
+            with self._queue_cond:
+                queued, self._queue = self._queue, []
+                self._queue_cond.notify_all()
+            if not queued:
+                return 0
+            pending = self._expire_stale(queued)
             if not pending:
                 return 0
             served = len(pending)
@@ -276,11 +454,13 @@ class MetricsService:
                     else:
                         wave[entry[0]] = entry
                 self._run_wave(list(wave.values()))
+                faults.crash_point("mid-flush", self.label)
                 pending = rest
             self._flushes += 1
             self.stats["flushes"] += 1
             if (
-                self.checkpoint_every > 0
+                not self._replaying
+                and self.checkpoint_every > 0
                 and self.checkpoint_dir is not None
                 and self._flushes % self.checkpoint_every == 0
             ):
@@ -298,6 +478,34 @@ class MetricsService:
             leaves = self._inflight.popleft()
             for leaf in leaves:
                 leaf.block_until_ready()
+
+    def _expire_stale(self, queued: List[Tuple]) -> List[Tuple[str, Tuple, Dict]]:
+        """Deadline gate at the head of flush: queued requests older than
+        ``request_deadline_s`` are expired — one ``deadline-expired``
+        degrade span + journal ``DROP`` each — instead of served. Replayed
+        records carry no timestamp and are never expired (the live process
+        already made their deadline decision). Returns live (name, args,
+        kwargs) triples for the wave machinery."""
+        deadline = self.request_deadline_s
+        if deadline is None or self._replaying:
+            return [(n, a, k) for n, a, k, _, _ in queued]
+        now = time.monotonic()
+        live: List[Tuple[str, Tuple, Dict]] = []
+        for name, args, kwargs, seq, ts in queued:
+            if ts is not None and now - ts > deadline:
+                if self._wal is not None and seq is not None:
+                    self._wal.append(
+                        wal.DROP, name, drop_seq=seq, drop_cause="deadline-expired"
+                    )
+                self.stats["expired_requests"] += 1
+                telemetry.emit(
+                    "degrade", self.label, kind="admission",
+                    cause="deadline-expired", session=name, seq=seq,
+                    age_s=round(now - ts, 3),
+                )
+            else:
+                live.append((name, args, kwargs))
+        return live
 
     def _coalesce(self, pending: List[Tuple[str, Tuple, Dict]]) -> List[Tuple[str, Tuple, Dict]]:
         """Concatenate same-session requests along the batch axis where the
@@ -448,6 +656,12 @@ class MetricsService:
                 self._stacked[k] = leaf
             self.stats["launches"] += 1
             self._policy.note_success()
+            if self._breakers:
+                # a served request closes its session's circuit breaker
+                for g_name, *_ in group:
+                    g_breaker = self._breakers.get(g_name)
+                    if g_breaker is not None:
+                        g_breaker.note_success()
             self._inflight.append(out)
             while len(self._inflight) > self.max_inflight:
                 for leaf in self._inflight.popleft():
@@ -531,13 +745,29 @@ class MetricsService:
     def _eager_entry(self, name: str, args: Tuple, dynamic: Dict, static: Dict) -> None:
         """Per-request fallback: unstacked pure update on one row (exact
         semantics, no coalescing) — serves requests the stacked path cannot
-        or while the resilience policy holds it in cooldown."""
-        row = self._rows[name]
-        state = {k: self._stacked[k][row] for k in self._names}
-        new = self.template.pure_update(state, *args, **dynamic, **static)
-        for k in self._names:
-            self._stacked[k] = self._stacked[k].at[row].set(new[k])
-        self.stats["fallback_requests"] += 1
+        or while the resilience policy holds it in cooldown.
+
+        This is also the per-session failure boundary: a request that fails
+        even here (poisoned inputs, closed row) is dropped with a
+        cause-tagged ``degrade`` span and trips the session's circuit
+        breaker — one bad tenant costs its own requests, never the flush."""
+        try:
+            row = self._rows[name]
+            state = {k: self._stacked[k][row] for k in self._names}
+            new = self.template.pure_update(state, *args, **dynamic, **static)
+            for k in self._names:
+                self._stacked[k] = self._stacked[k].at[row].set(new[k])
+            self.stats["fallback_requests"] += 1
+            breaker = self._breakers.get(name)
+            if breaker is not None:
+                breaker.note_success()
+        except Exception as err:  # noqa: BLE001 - isolate the poisoned request
+            breaker = self._breakers.setdefault(name, resilience.ResiliencePolicy())
+            breaker.note_failure(resilience.classify(err))
+            resilience.record_degrade(
+                self.label, "session", err, breaker, session=name
+            )
+            self.stats["failed_requests"] += 1
 
     # -------------------------------------------------------------- results
     def compute(self, name: str) -> Any:
@@ -601,55 +831,117 @@ class MetricsService:
         """Write every session's state in one fused pass: the stacked leaves
         plus the session table, crc32-checksummed
         (:func:`metrics_tpu.resilience.attach_checksums`), written atomically.
-        Returns the path."""
+        Returns the path.
+
+        With a journal attached, the meta embeds the journal high-water
+        sequence (``journal_seq``) — the exactly-once fence: :meth:`restore`
+        replays only records above it — and fully-retired journal segments
+        are truncated after the atomic rename. The fence is captured while
+        the queue is empty under the flush lock, so every record at or
+        below it is provably applied to the checkpointed state."""
         path = self._checkpoint_path(path)
-        self.drain()
-        # scalar template attrs ride along: some metrics determine config
-        # lazily from their first inputs (e.g. a task mode), and a restored
-        # service must be able to compute() before its first update
-        template_attrs = {
-            k: v
-            for k, v in vars(self.template).items()
-            if not k.startswith("_")
-            and k not in self._names
-            and isinstance(v, (bool, int, float, str, type(None)))
-        }
-        meta = json.dumps(
-            {
-                "rows": self._rows,
-                "capacity": self._capacity,
-                "template": type(self.template).__name__,
-                "template_attrs": template_attrs,
+        with self._flush_lock:
+            # drain until the queue stays empty: the fence must cover
+            # exactly the records applied to the state being written
+            while True:
+                self.drain()
+                with self._queue_cond:
+                    if not self._queue:
+                        fence = self._wal.last_seq if self._wal is not None else 0
+                        break
+            # scalar template attrs ride along: some metrics determine config
+            # lazily from their first inputs (e.g. a task mode), and a restored
+            # service must be able to compute() before its first update
+            template_attrs = {
+                k: v
+                for k, v in vars(self.template).items()
+                if not k.startswith("_")
+                and k not in self._names
+                and isinstance(v, (bool, int, float, str, type(None)))
             }
-        )
-        payload: Dict[str, Any] = {
-            f"state::{k}": np.asarray(self._stacked[k]) for k in self._names
-        }
-        payload["__meta__"] = np.frombuffer(meta.encode(), dtype=np.uint8)
-        payload = resilience.attach_checksums(payload)
-        t0 = telemetry.clock()
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **payload)
-        os.replace(tmp, path)
-        telemetry.emit(
-            "checkpoint", self.label, "serve", t0=t0, stream="serve",
-            sessions=len(self._rows), path=os.path.basename(path),
-        )
-        self.stats["checkpoints"] += 1
+            meta = json.dumps(
+                {
+                    "rows": self._rows,
+                    "capacity": self._capacity,
+                    "template": type(self.template).__name__,
+                    "template_attrs": template_attrs,
+                    "journal_seq": fence,
+                    "closed": sorted(self._closed),
+                }
+            )
+            payload: Dict[str, Any] = {
+                f"state::{k}": np.asarray(self._stacked[k]) for k in self._names
+            }
+            payload["__meta__"] = np.frombuffer(meta.encode(), dtype=np.uint8)
+            payload = resilience.attach_checksums(payload)
+            t0 = telemetry.clock()
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, **payload)
+            faults.crash_point("mid-checkpoint", self.label)
+            os.replace(tmp, path)
+            telemetry.emit(
+                "checkpoint", self.label, "serve", t0=t0, stream="serve",
+                sessions=len(self._rows), path=os.path.basename(path),
+                journal_seq=fence,
+            )
+            self.stats["checkpoints"] += 1
+            if self._wal is not None:
+                self._wal.truncate(fence)
         return path
 
-    def restore(self, path: Optional[str] = None) -> None:
-        """Install a checkpoint written by :meth:`checkpoint`. Checksums are
-        verified first — corruption raises
-        :class:`~metrics_tpu.resilience.StateCorruptionError` naming the
-        corrupt key instead of silently serving wrong values."""
+    def restore(
+        self,
+        path: Optional[str] = None,
+        *,
+        missing_ok: bool = False,
+        replay: bool = True,
+    ) -> bool:
+        """Install a checkpoint written by :meth:`checkpoint`, then replay
+        the un-checkpointed journal tail (``replay=True``, the default) to
+        recover every update the crashed process had durably accepted.
+
+        Returns ``True`` when a checkpoint was installed. A missing
+        checkpoint raises :class:`~metrics_tpu.resilience.StateCorruptionError`
+        unless ``missing_ok=True`` — the documented first-boot path: no
+        state is installed, the journal (if any) is replayed from sequence
+        0, and ``False`` is returned. A truncated or unreadable checkpoint
+        always raises ``StateCorruptionError`` (never a raw loader error).
+
+        Replay is exactly-once: only records above the checkpoint's
+        ``journal_seq`` fence apply, in sequence order, with shed/expired
+        requests excluded — so restoring twice, or restoring after a crash
+        at any instruction, reconstructs the same state."""
+        if path is None and self.checkpoint_dir is None and missing_ok:
+            # journal-only recovery: no checkpoint tier configured at all
+            if replay and self._wal is not None:
+                self._replay_journal(0)
+            return False
         path = self._checkpoint_path(path)
-        with np.load(path) as data:
-            payload = {k: data[k] for k in data.files}
+        if not os.path.exists(path):
+            if not missing_ok:
+                raise resilience.StateCorruptionError(
+                    f"checkpoint {path!r} does not exist; pass missing_ok=True "
+                    "if this is a first boot (the journal tail, if any, still replays)"
+                )
+            if replay and self._wal is not None:
+                self._replay_journal(0)
+            return False
+        try:
+            with np.load(path) as data:
+                payload = {k: data[k] for k in data.files}
+        except Exception as err:  # noqa: BLE001 - torn write, not-a-zip, ...
+            raise resilience.StateCorruptionError(
+                f"checkpoint {path!r} is unreadable (truncated or corrupt): {err}"
+            ) from err
         resilience.verify_checksums(payload)
         payload = resilience.strip_checksums(payload)
-        meta = json.loads(bytes(payload.pop("__meta__")).decode())
+        try:
+            meta = json.loads(bytes(payload.pop("__meta__")).decode())
+        except Exception as err:  # noqa: BLE001 - missing/garbled meta entry
+            raise resilience.StateCorruptionError(
+                f"checkpoint {path!r} has a missing or garbled __meta__ entry: {err}"
+            ) from err
         if meta["template"] != type(self.template).__name__:
             raise resilience.StateCorruptionError(
                 f"checkpoint holds {meta['template']} state, service template is "
@@ -667,14 +959,79 @@ class MetricsService:
         self._rows = {str(n): int(r) for n, r in meta["rows"].items()}
         used = set(self._rows.values())
         self._free = [r for r in range(self._capacity - 1, -1, -1) if r not in used]
+        self._closed = set(meta.get("closed", []))
         self._exec_cache.clear()
         self._compute_stack = None
         self._compute_one = None
+        fence = int(meta.get("journal_seq", 0))
+        if self._wal is not None:
+            # a journal whose segments were all truncated must never
+            # re-issue sequence numbers at or below the fence
+            self._wal.ensure_seq(fence)
+            if replay:
+                self._replay_journal(fence)
+        return True
+
+    def recover(self, path: Optional[str] = None) -> bool:
+        """Crash-recovery convenience: :meth:`restore` tolerating a missing
+        checkpoint (first boot) and always replaying the journal tail.
+        Returns ``True`` when a checkpoint was installed."""
+        return self.restore(path, missing_ok=True, replay=True)
+
+    def _replay_journal(self, fence: int) -> int:
+        """Apply the journal tail above ``fence`` in sequence order through
+        the normal flush machinery. Updates queue and flush in batches;
+        close/reset records are ordering barriers (flush, then apply).
+        Replayed work is never re-journaled, never deadline-expired, and
+        never triggers a periodic checkpoint (a mid-replay fence would
+        orphan the unapplied suffix)."""
+        assert self._wal is not None
+        records = self._wal.read_tail(fence)
+        if not records:
+            return 0
+        t0 = telemetry.clock()
+        self._replaying = True
+        try:
+            for rec in records:
+                if rec.kind == wal.UPDATE:
+                    # bypass submit(): the closed-set evolves via CLOSE
+                    # records, and a journaled update was legal when written
+                    self.open_session(rec.session)
+                    with self._queue_cond:
+                        self._queue.append(
+                            (rec.session, rec.args, rec.kwargs, rec.seq, None)
+                        )
+                elif rec.kind == wal.CLOSE:
+                    self.flush()
+                    self.close_session(rec.session)
+                elif rec.kind == wal.RESET:
+                    self.flush()
+                    self.reset_session(rec.session)
+            self.drain()
+        finally:
+            self._replaying = False
+        self.stats["replayed_records"] += len(records)
+        telemetry.emit(
+            "journal", self.label, "replay", t0=t0, stream="serve",
+            records=len(records), fence=fence,
+        )
+        return len(records)
 
     # ---------------------------------------------------------------- stats
+    @property
+    def journal(self) -> Optional[wal.WriteAheadLog]:
+        """The attached write-ahead journal (``None`` when ``journal_dir``
+        is unset or ``METRICS_TPU_WAL=0``)."""
+        return self._wal
+
     def telemetry_snapshot(self) -> Dict[str, Any]:
         """Service counters + resilience state + the process-wide persistent
-        AOT-cache stats (same shape as ``Metric.telemetry_snapshot``)."""
+        AOT-cache stats (same shape as ``Metric.telemetry_snapshot``), plus
+        the journal counters (appends / replayed / truncated segments /
+        fsync µs percentiles) under ``"wal"`` — ``None`` with no journal.
+        Shed / expired / breaker-tripped request counts live under
+        ``"serve"`` (``shed_requests`` / ``expired_requests`` /
+        ``breaker_rejected``)."""
         return {
             "owner": self.label,
             "serve": dict(self.stats),
@@ -682,4 +1039,5 @@ class MetricsService:
             "capacity": self._capacity,
             "resilience": self._policy.stats(),
             "aot_cache": aot_cache.stats(),
+            "wal": self._wal.stats() if self._wal is not None else None,
         }

@@ -42,7 +42,18 @@ aot-cache  one persistent-store access (kinds ``hit`` / ``miss`` /
            :mod:`metrics_tpu.aot_cache`)
 checkpoint one fused serving-state checkpoint write with crc32
            checksums attached (:mod:`metrics_tpu.serve`)
+journal    one write-ahead-journal operation (:mod:`metrics_tpu.wal`):
+           kinds ``append`` (per durable submit, with frame ``nbytes``
+           and ``seq``; bytes also aggregate into the
+           ``journal:bytes`` counter), ``replay`` (one recovery replay
+           pass, with the replayed record count), ``truncate`` (retired
+           segments removed at a checkpoint fence)
 ========== ============================================================
+
+The serving admission layer reuses the ``degrade`` name for shed work:
+kinds ``admission`` (causes ``queue-full-shed`` / ``queue-full-reject``
+/ ``deadline-expired``) and ``session`` (cause ``breaker-open``) — every
+rejected, shed, or expired request is exactly one cause-tagged span.
 
 Events carry the owner (metric class name or ``MetricCollection``), a
 kind, a wall-clock timestamp + duration in µs, the emitting thread id,
@@ -195,6 +206,8 @@ def emit(
         elif name == "degrade":
             cause = attrs.get("cause", "unattributed")
             _counters[f"degrade:cause:{cause}"] = _counters.get(f"degrade:cause:{cause}", 0) + 1
+        elif name == "journal" and kind == "append":
+            _counters["journal:bytes"] = _counters.get("journal:bytes", 0) + attrs.get("nbytes", 0)
     if not subs:
         return
     now = time.perf_counter()

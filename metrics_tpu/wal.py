@@ -1,0 +1,510 @@
+"""Write-ahead update journal for crash-consistent serving.
+
+PR 7's :class:`~metrics_tpu.serve.MetricsService` made durability stop at
+checkpoint granularity: a SIGKILL (TPU preemption, OOM killer) between
+checkpoints silently lost every update since the last one. This module is
+the durability layer underneath it — every ``submit()`` appends one
+checksummed, monotonically-sequenced record here *before* the request
+becomes eligible for ``flush()``, so the request stream itself survives a
+kill at any instruction and ``restore()`` can replay the un-checkpointed
+tail to reconstruct bit-identical state (see ``docs/serving.md``,
+"Crash consistency").
+
+Frame format (one record)::
+
+    MAGIC  b"MTWL"                        4 bytes
+    HEAD   struct "<QBIII"               21 bytes
+             seq    u64   monotonic sequence number (never reused)
+             kind   u8    UPDATE / DROP / CLOSE / RESET
+             hlen   u32   header length in bytes
+             plen   u32   payload length in bytes
+             crc    u32   crc32 over header bytes + payload bytes
+    header JSON: session name, per-leaf [shape, dtype] summary (DROP
+           frames carry the dropped seq + cause instead of leaves)
+    payload: pickled ``(args, kwargs)`` with array leaves converted to
+           numpy (empty for DROP/CLOSE/RESET)
+
+Records append to segment files ``wal-{first_seq:020d}.seg`` (the name
+carries the seq the segment's first frame will hold, so an *empty*
+segment still pins the sequence floor after truncation retires every
+frame). Appends are atomic at frame granularity: write, flush, fsync
+(unless ``fsync=False`` / ``METRICS_TPU_WAL_FSYNC=0``) — a crash can tear
+at most the in-flight frame. On open, a torn frame at the tail of the
+**last** segment is discarded and physically truncated (that submit never
+returned, so the record legitimately does not exist); a torn frame in any
+earlier segment, or a crc mismatch on a *complete* frame anywhere, is
+real corruption and raises
+:class:`~metrics_tpu.resilience.StateCorruptionError` — the journal
+refuses to replay garbage into live state.
+
+Exactly-once fencing: :meth:`WriteAheadLog.read_tail` returns only
+records with ``seq > fence`` where the fence is the journal high-water
+mark embedded in the checkpoint (``meta["journal_seq"]``); replaying a
+tail twice is idempotent because the fence moves with the checkpoint.
+``DROP`` frames (admission shed / deadline expiry) are resolved during
+the read — a dropped update is excluded from replay, matching what the
+live process served. :meth:`WriteAheadLog.truncate` deletes segments
+wholly at or below the fence (crash-safe in any order: replay is fenced,
+so a half-truncated journal only wastes disk, never double-applies).
+
+The payload codec is :mod:`pickle` guarded by the frame crc — the journal
+is a private on-disk format written and read by the same service, not an
+interchange format.
+
+Env knobs (see ``docs/serving.md``):
+
+================================ =======================================
+``METRICS_TPU_WAL=0``            kill switch: ``MetricsService`` skips
+                                 journaling entirely (PR 7
+                                 checkpoint-only semantics)
+``METRICS_TPU_WAL_FSYNC=0``      skip the per-append fsync (fast, but a
+                                 host crash can lose OS-buffered frames;
+                                 a process kill alone cannot)
+``METRICS_TPU_WAL_SEGMENT_BYTES`` segment roll threshold (default 4 MiB)
+================================ =======================================
+"""
+import json
+import os
+import pickle
+import struct
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu import faults, telemetry
+
+__all__ = [
+    "WriteAheadLog",
+    "WalRecord",
+    "wal_enabled",
+    "UPDATE",
+    "DROP",
+    "CLOSE",
+    "RESET",
+]
+
+# record kinds (u8 in the frame header)
+UPDATE = 1  # one submit(): payload is the (args, kwargs) tree
+DROP = 2    # admission shed / deadline expiry of an earlier UPDATE seq
+CLOSE = 3   # close_session(name)
+RESET = 4   # reset_session(name)
+
+_KIND_NAMES = {UPDATE: "update", DROP: "drop", CLOSE: "close", RESET: "reset"}
+
+_MAGIC = b"MTWL"
+_HEAD = struct.Struct("<QBIII")  # seq, kind, hlen, plen, crc
+_FRAME_OVERHEAD = len(_MAGIC) + _HEAD.size
+
+_DEFAULT_SEGMENT_BYTES = 4 * 1024 * 1024
+
+
+def wal_enabled() -> bool:
+    """Journal kill switch (env ``METRICS_TPU_WAL``, default on). Off
+    restores PR 7 checkpoint-only durability exactly — no segment files
+    are written even when a ``journal_dir`` is configured."""
+    return os.environ.get("METRICS_TPU_WAL", "1").strip().lower() not in ("0", "false", "off")
+
+
+def _fsync_default() -> bool:
+    return os.environ.get("METRICS_TPU_WAL_FSYNC", "1").strip().lower() not in ("0", "false", "off")
+
+
+def _segment_bytes_default() -> int:
+    try:
+        return max(4096, int(os.environ.get("METRICS_TPU_WAL_SEGMENT_BYTES", str(_DEFAULT_SEGMENT_BYTES))))
+    except ValueError:
+        return _DEFAULT_SEGMENT_BYTES
+
+
+class WalRecord(NamedTuple):
+    """One replayable journal record (DROP frames are resolved away by
+    :meth:`WriteAheadLog.read_tail` and never surface here)."""
+
+    seq: int
+    kind: int
+    session: str
+    args: Tuple
+    kwargs: Dict[str, Any]
+
+    @property
+    def kind_name(self) -> str:
+        return _KIND_NAMES.get(self.kind, str(self.kind))
+
+
+def _to_numpy(tree: Any) -> Any:
+    """Array leaves (anything with a dtype — jax or numpy) become host
+    numpy arrays; python scalars/strings pass through untouched so static
+    kwargs replay with their original types (same executable signature)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "dtype") else x, tree
+    )
+
+
+def _leaf_summary(args: Tuple, kwargs: Dict[str, Any]) -> List[List[Any]]:
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten((args, kwargs))
+    return [
+        [list(np.shape(x)), str(x.dtype)] for x in flat if hasattr(x, "dtype")
+    ]
+
+
+class _Segment(NamedTuple):
+    """Init-scan summary of one on-disk segment file."""
+
+    path: str
+    first_seq: int  # from the file name: seq of the first frame it holds
+    last_seq: int   # seq of its last complete frame (first_seq - 1 if empty)
+    nbytes: int     # valid byte length (torn tail already excluded)
+
+
+class WriteAheadLog:
+    """Append-only, segmented, crc-framed journal under one directory.
+
+    Args:
+        directory: segment directory (created if missing). One journal
+            per directory — two live writers would interleave frames.
+        owner: telemetry owner label for ``journal`` spans.
+        fsync: fsync after every append (default from
+            ``METRICS_TPU_WAL_FSYNC``). Off trades host-crash durability
+            for speed; process-kill durability is unaffected.
+        segment_max_bytes: roll to a new segment past this size (default
+            from ``METRICS_TPU_WAL_SEGMENT_BYTES``).
+
+    Thread-safe: one lock serializes appends (the fsync dominates, so
+    finer grain buys nothing).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        owner: str = "wal",
+        fsync: Optional[bool] = None,
+        segment_max_bytes: Optional[int] = None,
+    ) -> None:
+        self.directory = directory
+        self.owner = owner
+        self.fsync = _fsync_default() if fsync is None else bool(fsync)
+        self.segment_max_bytes = (
+            _segment_bytes_default() if segment_max_bytes is None else max(4096, int(segment_max_bytes))
+        )
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._active: Optional[Any] = None  # open file handle of the last segment
+        self._active_path: Optional[str] = None
+        self._fsync_us: deque = deque(maxlen=512)
+        self._stats: Dict[str, int] = {
+            "appends": 0,
+            "bytes": 0,
+            "fsyncs": 0,
+            "replayed": 0,
+            "truncated_segments": 0,
+            "discarded_frames": 0,
+            "drops": 0,
+        }
+        self._segments: List[_Segment] = self._scan()
+        self._last_seq = self._segments[-1].last_seq if self._segments else 0
+
+    # ------------------------------------------------------------------ scan
+    def _segment_paths(self) -> List[str]:
+        names = sorted(
+            n for n in os.listdir(self.directory)
+            if n.startswith("wal-") and n.endswith(".seg")
+        )
+        return [os.path.join(self.directory, n) for n in names]
+
+    @staticmethod
+    def _name_seq(path: str) -> int:
+        base = os.path.basename(path)
+        return int(base[len("wal-"):-len(".seg")])
+
+    def _scan(self) -> List[_Segment]:
+        """Validate every segment on open: crc-check all frames, assert
+        monotonic seqs, discard+truncate a torn tail on the LAST segment
+        only. Raises ``StateCorruptionError`` on anything else."""
+        from metrics_tpu.resilience import StateCorruptionError
+
+        paths = self._segment_paths()
+        segments: List[_Segment] = []
+        expected = None
+        for i, path in enumerate(paths):
+            is_last = i == len(paths) - 1
+            first_seq = self._name_seq(path)
+            if expected is not None and first_seq != expected:
+                raise StateCorruptionError(
+                    f"journal segment {os.path.basename(path)} starts at seq {first_seq}, "
+                    f"expected {expected} (missing or reordered segment)"
+                )
+            last_seq = first_seq - 1
+            with open(path, "rb") as f:
+                data = f.read()
+            offset = 0
+            while offset < len(data):
+                frame = self._parse_frame(data, offset, path)
+                if frame is None:  # torn frame
+                    if not is_last:
+                        raise StateCorruptionError(
+                            f"journal segment {os.path.basename(path)} has a torn frame at "
+                            f"offset {offset} but is not the last segment — the journal is corrupt"
+                        )
+                    # a crash tore the in-flight append; that submit never
+                    # returned, so the frame legitimately does not exist
+                    with open(path, "r+b") as f:
+                        f.truncate(offset)
+                    self._stats["discarded_frames"] += 1
+                    break
+                seq, _, _, _, frame_len = frame
+                if seq != last_seq + 1:
+                    raise StateCorruptionError(
+                        f"journal segment {os.path.basename(path)} frame at offset {offset} "
+                        f"carries seq {seq}, expected {last_seq + 1} (sequence gap)"
+                    )
+                last_seq = seq
+                offset += frame_len
+            segments.append(_Segment(path, first_seq, last_seq, min(offset, len(data))))
+            expected = last_seq + 1
+        return segments
+
+    def _parse_frame(self, data: bytes, offset: int, path: str):
+        """Parse one frame at ``offset``. Returns ``(seq, kind, header,
+        payload, frame_len)``; ``None`` for an incomplete (torn) frame;
+        raises on a complete-but-corrupt one."""
+        from metrics_tpu.resilience import StateCorruptionError
+
+        if offset + _FRAME_OVERHEAD > len(data):
+            return None
+        if data[offset:offset + len(_MAGIC)] != _MAGIC:
+            raise StateCorruptionError(
+                f"journal segment {os.path.basename(path)} frame at offset {offset} "
+                "has a bad magic — the journal is corrupt"
+            )
+        seq, kind, hlen, plen, crc = _HEAD.unpack_from(data, offset + len(_MAGIC))
+        body_start = offset + _FRAME_OVERHEAD
+        if body_start + hlen + plen > len(data):
+            return None
+        body = data[body_start:body_start + hlen + plen]
+        if faults.crc(body) != crc:
+            raise StateCorruptionError(
+                f"journal segment {os.path.basename(path)} frame seq {seq} failed its "
+                "crc32 check — refusing to replay a corrupt record"
+            )
+        header = json.loads(body[:hlen].decode())
+        payload = body[hlen:hlen + plen]
+        return seq, kind, header, payload, _FRAME_OVERHEAD + hlen + plen
+
+    # ---------------------------------------------------------------- append
+    @property
+    def last_seq(self) -> int:
+        """High-water sequence number (0 before the first append)."""
+        return self._last_seq
+
+    def ensure_seq(self, floor: int) -> None:
+        """Raise the sequence floor to at least ``floor`` (restore() calls
+        this with the checkpoint fence so a journal whose segments were all
+        truncated can never re-issue fenced sequence numbers)."""
+        with self._lock:
+            if floor > self._last_seq:
+                self._last_seq = int(floor)
+
+    def _open_segment(self, first_seq: int) -> None:
+        path = os.path.join(self.directory, f"wal-{first_seq:020d}.seg")
+        self._active = open(path, "ab")
+        self._active_path = path
+        if not any(s.path == path for s in self._segments):
+            self._segments.append(_Segment(path, first_seq, first_seq - 1, 0))
+
+    def _timed_fsync(self, f: Any) -> None:
+        if not self.fsync:
+            return
+        t0 = time.perf_counter()
+        os.fsync(f.fileno())
+        self._fsync_us.append((time.perf_counter() - t0) * 1e6)
+        self._stats["fsyncs"] += 1
+
+    def append(
+        self,
+        kind: int,
+        session: str,
+        args: Tuple = (),
+        kwargs: Optional[Dict[str, Any]] = None,
+        *,
+        drop_seq: Optional[int] = None,
+        drop_cause: Optional[str] = None,
+    ) -> int:
+        """Durably append one record; returns its sequence number. The
+        record is on disk (fsync'd, unless disabled) before this returns —
+        the contract ``submit()`` relies on. ``DROP`` frames carry the
+        dropped seq + cause in the header and no payload."""
+        kwargs = kwargs or {}
+        header: Dict[str, Any] = {"session": session}
+        if kind == UPDATE:
+            args = _to_numpy(args)
+            kwargs = _to_numpy(kwargs)
+            header["leaves"] = _leaf_summary(args, kwargs)
+            payload = pickle.dumps((args, kwargs))
+        elif kind == DROP:
+            header["drop"] = int(drop_seq if drop_seq is not None else 0)
+            if drop_cause:
+                header["cause"] = drop_cause
+            payload = b""
+        else:
+            payload = b""
+        hbytes = json.dumps(header).encode()
+        body = hbytes + payload
+
+        t0 = telemetry.clock()
+        with self._lock:
+            seq = self._last_seq + 1
+            frame = (
+                _MAGIC
+                + _HEAD.pack(seq, kind, len(hbytes), len(payload), faults.crc(body))
+                + body
+            )
+            if self._active is None:
+                self._open_segment(seq)
+            f = self._active
+            if faults.crash_will_fire("mid-journal-append"):
+                # genuine torn tail: half a frame reaches disk, then SIGKILL
+                f.write(frame[: max(1, len(frame) // 2)])
+                f.flush()
+                self._timed_fsync(f)
+                faults.crash_point("mid-journal-append", self.owner)
+            f.write(frame)
+            f.flush()
+            self._timed_fsync(f)
+            faults.crash_point("mid-journal-append", self.owner)
+            self._last_seq = seq
+            seg = self._segments[-1]
+            self._segments[-1] = seg._replace(last_seq=seq, nbytes=seg.nbytes + len(frame))
+            self._stats["appends"] += 1
+            self._stats["bytes"] += len(frame)
+            if kind == DROP:
+                self._stats["drops"] += 1
+            roll = self._segments[-1].nbytes >= self.segment_max_bytes
+            if roll:
+                f.close()
+                self._active = None
+                self._active_path = None
+        telemetry.emit(
+            "journal", self.owner, "append", t0=t0, stream="serve",
+            seq=seq, record=_KIND_NAMES.get(kind, str(kind)), nbytes=len(frame),
+        )
+        if roll:
+            # next append opens wal-{seq+1}.seg; opening lazily keeps an
+            # idle service from leaving empty segments behind
+            pass
+        return seq
+
+    # ----------------------------------------------------------------- read
+    def read_tail(self, after_seq: int = 0) -> List[WalRecord]:
+        """All replayable records with ``seq > after_seq`` in order, with
+        DROP frames resolved: an update the live process shed or expired is
+        excluded, exactly as it was excluded from live state."""
+        frames: List[Tuple[int, int, Dict[str, Any], bytes]] = []
+        dropped: set = set()
+        with self._lock:
+            segments = list(self._segments)
+        for seg in segments:
+            if seg.last_seq <= after_seq:
+                continue
+            with open(seg.path, "rb") as f:
+                data = f.read()
+            offset = 0
+            while offset < len(data):
+                frame = self._parse_frame(data, offset, seg.path)
+                if frame is None:
+                    break  # live-writer tail (concurrent append); scan() handled crashes
+                seq, kind, header, payload, frame_len = frame
+                offset += frame_len
+                if kind == DROP:
+                    dropped.add(int(header.get("drop", 0)))
+                    continue
+                if seq <= after_seq:
+                    continue
+                frames.append((seq, kind, header, payload))
+        records: List[WalRecord] = []
+        for seq, kind, header, payload in frames:
+            if kind == UPDATE and seq in dropped:
+                continue
+            if kind == UPDATE:
+                args, kwargs = pickle.loads(payload)
+            else:
+                args, kwargs = (), {}
+            records.append(WalRecord(seq, kind, str(header.get("session", "")), args, kwargs))
+        with self._lock:
+            self._stats["replayed"] += len(records)
+        return records
+
+    # ------------------------------------------------------------- truncate
+    def truncate(self, upto_seq: int) -> int:
+        """Delete segments wholly retired by a checkpoint fence at
+        ``upto_seq``; returns how many were removed. If the active segment
+        itself is fully retired, a fresh (empty) successor segment is
+        created *first* — its name pins the sequence floor — so a crash at
+        any point leaves a journal that still opens with the right
+        ``last_seq``. Idempotent: replay is fenced, so a half-truncated
+        journal wastes disk, never correctness."""
+        removed = 0
+        t0 = telemetry.clock()
+        with self._lock:
+            retire = [s for s in self._segments if s.last_seq <= upto_seq]
+            keep = [s for s in self._segments if s.last_seq > upto_seq]
+            if not retire:
+                return 0
+            if not keep:
+                # every frame is retired: open the successor segment before
+                # unlinking anything so the sequence floor survives a crash
+                if self._active is not None:
+                    self._active.close()
+                    self._active = None
+                    self._active_path = None
+                self._segments = []
+                self._open_segment(self._last_seq + 1)
+                keep = list(self._segments)
+            for seg in retire:
+                if seg.path == self._active_path:
+                    continue  # unreachable once keep includes the successor
+                faults.crash_point("mid-truncate", self.owner)
+                try:
+                    os.remove(seg.path)
+                except FileNotFoundError:
+                    pass  # a prior half-truncation already removed it
+                removed += 1
+            self._segments = keep
+            self._stats["truncated_segments"] += removed
+        telemetry.emit(
+            "journal", self.owner, "truncate", t0=t0, stream="serve",
+            segments=removed, fence=upto_seq,
+        )
+        return removed
+
+    # ---------------------------------------------------------------- admin
+    def close(self) -> None:
+        with self._lock:
+            if self._active is not None:
+                self._active.close()
+                self._active = None
+                self._active_path = None
+
+    def stats(self) -> Dict[str, Any]:
+        """Journal counters + fsync latency percentiles (µs) for
+        ``telemetry_snapshot()`` / ``tools/trace_report.py``."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self._stats)
+            out["last_seq"] = self._last_seq
+            out["segments"] = len(self._segments)
+            lat = sorted(self._fsync_us)
+        def pct(q: float) -> float:
+            if not lat:
+                return 0.0
+            idx = min(len(lat) - 1, max(0, int(round(q / 100.0 * (len(lat) - 1)))))
+            return round(lat[idx], 1)
+        out["fsync_us_p50"] = pct(50)
+        out["fsync_us_p95"] = pct(95)
+        return out

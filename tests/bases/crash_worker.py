@@ -1,0 +1,116 @@
+"""Subprocess body for the kill-and-recover crash harness.
+
+Runs a DETERMINISTIC request stream against a journaled
+``MetricsService`` and prints a bit-exact digest of ``compute_all()`` as
+the last stdout line. Two phases:
+
+``run``      execute the full stream from op 0. The parent either lets it
+             finish (the uncrashed twin) or arms ``METRICS_TPU_CRASH`` so
+             a crash point SIGKILLs it mid-stream.
+``recover``  ``recover()`` (checkpoint + fenced journal replay), then
+             resume the stream at op index ``journal.last_seq`` — every
+             journaled op is already durable, every later op is not — and
+             finish normally.
+
+The stream covers the whole journaled surface: 5 sessions of constant
+batch-16 Accuracy updates (one executable signature), one
+``close_session`` (+ later explicit reopen), one ``reset_session``, a
+flush every 4 ops, and a periodic checkpoint every 2 flushes. Segment
+size is forced tiny by the parent (``METRICS_TPU_WAL_SEGMENT_BYTES``) so
+checkpoints exercise multi-segment truncation. Ops map 1:1 to journal
+sequence numbers, which is what makes ``last_seq`` the resume cursor.
+
+Usage: ``python crash_worker.py {run|recover} WORKDIR``
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+N_OPS = 30
+N_SESSIONS = 5
+BATCH = 16
+
+
+def ops_list():
+    """The fixed op stream; op index i journals as sequence i + 1."""
+    ops = []
+    for i in range(N_OPS):
+        if i == 12:
+            ops.append(("close", "s1"))
+        elif i == 20:
+            ops.append(("reset", "s3"))
+        else:
+            ops.append(("update", f"s{i % N_SESSIONS}", i))
+    return ops
+
+
+def batch_for(i):
+    rng = np.random.RandomState(1000 + i)
+    return rng.randint(0, 8, BATCH), rng.randint(0, 8, BATCH)
+
+
+def digest(svc):
+    """Bit-exact leaf digest of every open session's computed value."""
+    import jax
+
+    out = {}
+    for name, val in sorted(svc.compute_all().items()):
+        leaves = jax.tree_util.tree_leaves(val)
+        out[name] = [
+            [str(np.asarray(leaf).dtype), list(np.shape(leaf)), np.asarray(leaf).tobytes().hex()]
+            for leaf in leaves
+        ]
+    return out
+
+
+def main():
+    phase, root = sys.argv[1], sys.argv[2]
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+    from metrics_tpu.serve import MetricsService
+
+    svc = MetricsService(
+        Accuracy(task="multiclass", num_classes=8),
+        journal_dir=os.path.join(root, "wal"),
+        checkpoint_dir=os.path.join(root, "ckpt"),
+        checkpoint_every=2,
+    )
+    start_seq = 0
+    if phase == "recover":
+        svc.recover()
+        start_seq = svc.journal.last_seq
+
+    closed = set()
+    for idx, op in enumerate(ops_list()):
+        seq = idx + 1
+        if seq <= start_seq:
+            # already durable before the crash (applied by replay); keep the
+            # local closed-set bookkeeping consistent with the stream
+            if op[0] == "close":
+                closed.add(op[1])
+            elif op[0] == "update":
+                closed.discard(op[1])
+            continue
+        if op[0] == "update":
+            _, name, i = op
+            if name in closed:
+                svc.open_session(name)  # explicit reclaim after close
+                closed.discard(name)
+            preds, target = batch_for(i)
+            svc.submit(name, jnp.asarray(preds), jnp.asarray(target))
+        elif op[0] == "close":
+            svc.close_session(op[1])
+            closed.add(op[1])
+        elif op[0] == "reset":
+            svc.reset_session(op[1])
+        if idx % 4 == 3:
+            svc.flush()
+    svc.drain()
+    print(json.dumps({"digest": digest(svc), "last_seq": svc.journal.last_seq}))
+
+
+if __name__ == "__main__":
+    main()

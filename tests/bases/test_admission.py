@@ -1,0 +1,194 @@
+"""Overload-safe admission control (the overload acceptance pin).
+
+Under every policy the bounded queue NEVER exceeds its bound, every
+shed/expired/rejected request emits exactly one cause-tagged ``degrade``
+span, and the accepted requests still produce exact results. Plus the
+per-session circuit breaker lifecycle: trip on eager failure, reject with
+:class:`CircuitOpenError` through the cooldown, clear on success or
+``reset_session``.
+"""
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, telemetry
+from metrics_tpu.serve import CircuitOpenError, MetricsService, QueueFullError
+
+
+def _svc(**kwargs):
+    return MetricsService(Accuracy(task="multiclass", num_classes=4), **kwargs)
+
+
+def _batch(i):
+    rng = np.random.RandomState(i)
+    return jnp.asarray(rng.randint(0, 4, 8)), jnp.asarray(rng.randint(0, 4, 8))
+
+
+def _poison(svc, name="bad"):
+    """A request that is unstackable AND fails the eager fallback (mismatched
+    leading dims), tripping ``name``'s circuit breaker at the next flush."""
+    svc.submit(name, jnp.zeros((4,), jnp.int32), jnp.zeros((5,), jnp.int32))
+    svc.flush()
+
+
+# ----------------------------------------------------------------- policies
+def test_reject_policy_bounds_queue_and_tags_every_rejection():
+    svc = _svc(max_queue=2, admission="reject")
+    with telemetry.instrument() as t:
+        svc.submit("a", *_batch(0))
+        svc.submit("b", *_batch(1))
+        for _ in range(3):
+            with pytest.raises(QueueFullError, match="admission policy 'reject'"):
+                svc.submit("c", *_batch(2))
+            assert len(svc._queue) <= 2
+    assert svc.stats["rejected_requests"] == 3
+    spans = t.spans(name="degrade", kind="admission")
+    assert len(spans) == 3
+    assert all(s.attrs["cause"] == "queue-full-reject" for s in spans)
+    # the accepted requests are served exactly once, exactly
+    assert svc.flush() == 2
+    ref = Accuracy(task="multiclass", num_classes=4)
+    ref.update(*_batch(0))
+    np.testing.assert_array_equal(svc.compute("a"), ref.compute())
+
+
+def test_shed_oldest_bounds_queue_and_tags_every_victim():
+    svc = _svc(max_queue=2, admission="shed-oldest")
+    with telemetry.instrument() as t:
+        for i in range(5):
+            svc.submit(f"s{i}", *_batch(i))
+            assert len(svc._queue) <= 2
+    assert svc.stats["shed_requests"] == 3
+    spans = t.spans(name="degrade", kind="admission")
+    assert [s.attrs["cause"] for s in spans] == ["queue-full-shed"] * 3
+    assert [s.attrs["session"] for s in spans] == ["s0", "s1", "s2"]  # oldest first
+    assert svc.flush() == 2  # only the survivors are served
+    ref = Accuracy(task="multiclass", num_classes=4)
+    ref.update(*_batch(4))
+    np.testing.assert_array_equal(svc.compute("s4"), ref.compute())
+
+
+def test_block_policy_times_out_to_rejection():
+    svc = _svc(max_queue=1, admission="block", admission_timeout_s=0.05)
+    svc.submit("a", *_batch(0))
+    t0 = time.monotonic()
+    with pytest.raises(QueueFullError):
+        svc.submit("b", *_batch(1))
+    assert time.monotonic() - t0 >= 0.05
+    assert svc.stats["rejected_requests"] == 1
+    assert len(svc._queue) == 1
+
+
+def test_block_policy_unblocks_on_flush():
+    svc = _svc(max_queue=1, admission="block")
+    svc.submit("a", *_batch(0))
+    done = threading.Event()
+
+    def second_submit():
+        svc.submit("b", *_batch(1))  # blocks until the flush drains the queue
+        done.set()
+
+    t = threading.Thread(target=second_submit, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not done.is_set()
+    svc.flush()
+    assert done.wait(5.0)
+    t.join(5.0)
+    svc.drain()
+    ref = Accuracy(task="multiclass", num_classes=4)
+    ref.update(*_batch(1))
+    np.testing.assert_array_equal(svc.compute("b"), ref.compute())
+
+
+def test_deadline_expires_stale_requests_with_cause():
+    svc = _svc(request_deadline_s=0.02)
+    svc.submit("a", *_batch(0))
+    time.sleep(0.06)
+    svc.submit("b", *_batch(1))  # fresh: makes its deadline
+    with telemetry.instrument() as t:
+        assert svc.flush() == 1  # only 'b' is served
+    assert svc.stats["expired_requests"] == 1
+    spans = t.spans(name="degrade", kind="admission")
+    assert len(spans) == 1
+    assert spans[0].attrs["cause"] == "deadline-expired"
+    assert spans[0].attrs["session"] == "a"
+    assert spans[0].attrs["age_s"] >= 0.02
+    # 'a' was never applied; 'b' is exact
+    ref = Accuracy(task="multiclass", num_classes=4)
+    ref.update(*_batch(1))
+    np.testing.assert_array_equal(svc.compute("b"), ref.compute())
+
+
+def test_admission_policy_validated():
+    with pytest.raises(ValueError, match="admission"):
+        _svc(max_queue=2, admission="drop-newest")
+
+
+# ------------------------------------------------------------------ breaker
+def test_breaker_trips_rejects_then_recovers():
+    svc = _svc()
+    with telemetry.instrument() as t:
+        _poison(svc)
+    assert svc.stats["failed_requests"] == 1
+    assert t.count(name="degrade", kind="session") == 1
+
+    # open: every submit burns one cooldown slot and is rejected with cause
+    with telemetry.instrument() as t:
+        rejected = 0
+        for _ in range(10):
+            try:
+                svc.submit("bad", *_batch(0))
+                break
+            except CircuitOpenError:
+                rejected += 1
+    assert rejected == svc.stats["breaker_rejected"] > 0
+    spans = t.spans(name="degrade", kind="session")
+    assert all(s.attrs["cause"] == "breaker-open" for s in spans)
+    assert len(spans) == rejected
+
+    # the post-cooldown submit above was accepted; success resets the streak
+    svc.flush()
+    assert svc._breakers["bad"].failures == 0
+    svc.submit("bad", *_batch(1))  # no raise: breaker closed again
+    svc.drain()
+
+
+def test_reset_session_clears_the_breaker():
+    svc = _svc()
+    _poison(svc)
+    with pytest.raises(CircuitOpenError):
+        svc.submit("bad", *_batch(0))
+    svc.reset_session("bad")  # the documented operator escape hatch
+    svc.submit("bad", *_batch(0))
+    svc.drain()
+    ref = Accuracy(task="multiclass", num_classes=4)
+    ref.update(*_batch(0))
+    np.testing.assert_array_equal(svc.compute("bad"), ref.compute())
+
+
+def test_close_session_clears_the_breaker_for_the_next_tenant():
+    svc = _svc()
+    _poison(svc)
+    svc.close_session("bad")
+    svc.open_session("bad")  # a new tenant reclaims the name with a clean slate
+    svc.submit("bad", *_batch(0))
+    svc.drain()
+
+
+def test_breaker_failure_does_not_poison_other_sessions():
+    svc = _svc()
+    svc.submit("good", *_batch(0))
+    svc.submit("bad", jnp.zeros((4,), jnp.int32), jnp.zeros((5,), jnp.int32))
+    svc.submit("good2", *_batch(1))
+    svc.flush()  # the poisoned request fails eagerly; the wave still lands
+    ref = Accuracy(task="multiclass", num_classes=4)
+    ref.update(*_batch(0))
+    np.testing.assert_array_equal(svc.compute("good"), ref.compute())
+    ref2 = Accuracy(task="multiclass", num_classes=4)
+    ref2.update(*_batch(1))
+    np.testing.assert_array_equal(svc.compute("good2"), ref2.compute())
+    assert svc.stats["failed_requests"] == 1

@@ -381,3 +381,90 @@ def test_ambient_persistent_cache_parity(tmp_path, monkeypatch):
     if _os.environ.get("METRICS_TPU_INJECT_FAULT", "").split(":")[0] == "cache-corruption":
         # the ambient fault actually reached the real injection point
         assert aot_cache.stats()["corrupt"] > corrupt_before
+
+
+# -------------------------------------------------------- WAL kill switch
+_WAL_MATRIX_SWITCHES = (
+    "METRICS_TPU_WAL",
+    "METRICS_TPU_RESILIENCE",
+    "METRICS_TPU_FAST_DISPATCH",
+)
+
+
+def _serving_stream(journal_dir):
+    """One deterministic MetricsService run (submits, a close, a reset,
+    interleaved flushes) returning a bit-exact digest of compute_all()."""
+    from metrics_tpu.serve import MetricsService
+
+    svc = MetricsService(FloatSum(), journal_dir=journal_dir)
+    rng = np.random.RandomState(77)
+    for i in range(12):
+        if i == 7:
+            svc.open_session("s1")  # explicit reclaim of the closed name
+        svc.submit(f"s{i % 3}", jnp.asarray(rng.rand(8).astype(np.float32)))
+        if i == 5:
+            svc.close_session("s1")
+        if i == 8:
+            svc.reset_session("s2")
+        if i % 4 == 3:
+            svc.flush()
+    svc.drain()
+    digest = {
+        name: np.asarray(val).tobytes()
+        for name, val in sorted(svc.compute_all().items())
+    }
+    return svc, digest
+
+
+@pytest.mark.parametrize(
+    "combo",
+    [("1", "1", "1"), ("0", "1", "1"), ("1", "0", "1"), ("1", "1", "0"),
+     ("0", "0", "1"), ("0", "1", "0"), ("1", "0", "0"), ("0", "0", "0")],
+    ids=lambda c: "wal%s-resilience%s-dispatch%s" % c,
+)
+def test_wal_kill_switch_matrix_bit_identical(combo, tmp_path, monkeypatch):
+    """The 2^3 matrix over (WAL, resilience, fast-dispatch): journaling is
+    pure durability plumbing — every combo's served values must be
+    bit-identical to the all-on default. The all-on leg runs inline as the
+    baseline so the comparison never crosses process state."""
+    for switch in _WAL_MATRIX_SWITCHES:
+        monkeypatch.delenv(switch, raising=False)
+    _, baseline = _serving_stream(str(tmp_path / "wal-base"))
+    for switch, value in zip(_WAL_MATRIX_SWITCHES, combo):
+        monkeypatch.setenv(switch, value)
+    svc, digest = _serving_stream(str(tmp_path / "wal-combo"))
+    assert digest == baseline, f"serving drift under switch combo {combo}"
+    if combo[0] == "0":
+        assert svc.journal is None  # the kill switch really disabled the WAL
+
+
+def test_wal_off_restores_checkpoint_only_semantics(tmp_path, monkeypatch):
+    """``METRICS_TPU_WAL=0`` with a ``journal_dir`` configured writes NO
+    segment files and makes restore checkpoint-only (the pre-journal
+    semantics): updates after the last checkpoint are simply lost."""
+    import os as _os
+
+    from metrics_tpu.serve import MetricsService
+
+    monkeypatch.setenv("METRICS_TPU_WAL", "0")
+    wal_dir = tmp_path / "wal"
+    svc = MetricsService(
+        FloatSum(), journal_dir=str(wal_dir), checkpoint_dir=str(tmp_path / "ckpt")
+    )
+    assert svc.journal is None
+    svc.update("tenant", jnp.asarray([2.0], dtype=jnp.float32))
+    svc.checkpoint()
+    svc.update("tenant", jnp.asarray([3.0], dtype=jnp.float32))
+    svc.drain()
+    assert not wal_dir.exists() or not _os.listdir(wal_dir)
+
+    fresh = MetricsService(
+        FloatSum(), journal_dir=str(wal_dir), checkpoint_dir=str(tmp_path / "ckpt")
+    )
+    assert fresh.recover() is True
+    # checkpoint-only: the post-checkpoint update did not survive
+    np.testing.assert_array_equal(
+        np.asarray(fresh.compute("tenant")), np.asarray(2.0, dtype=np.float32)
+    )
+    snap = fresh.telemetry_snapshot()
+    assert snap["wal"] is None

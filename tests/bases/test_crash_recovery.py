@@ -1,0 +1,124 @@
+"""Kill-and-recover crash harness (the crash-consistency acceptance pin).
+
+For EVERY registered crash point, a subprocess running the deterministic
+``crash_worker.py`` stream is SIGKILLed *at that instruction* —
+post-journal/pre-enqueue, mid-journal-append (a genuine torn frame on
+disk), mid-flush, mid-checkpoint (tmp written, not renamed), and
+mid-truncate (some retired segments already unlinked) — then a fresh
+subprocess ``recover()``\\ s (checkpoint + sequence-fenced journal replay)
+and resumes the stream. The recovered ``compute_all()`` digest must be
+BIT-IDENTICAL to an uncrashed twin fed the same stream: exactly-once, no
+lost and no double-applied updates.
+
+``make crash`` runs this module (it is also part of the ``chaos`` lane);
+the full matrix is ``slow``-marked, with one representative point kept in
+the default tier so every test run exercises the kill path.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from metrics_tpu import faults
+
+_REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+_WORKER = os.path.join(os.path.dirname(__file__), "crash_worker.py")
+
+pytestmark = pytest.mark.chaos
+
+# nth probe at which each point fires — chosen so the kill lands mid-stream
+# with prior checkpoints/segments on disk (mid-checkpoint needs a 2nd
+# checkpoint, mid-truncate a 2nd retired-segment unlink, &c.)
+_CRASH_NTH = {
+    "post-journal": 10,
+    "mid-journal-append": 10,
+    "mid-flush": 3,
+    "mid-checkpoint": 2,
+    "mid-truncate": 2,
+}
+
+
+def _env(aot_dir):
+    env = dict(os.environ)
+    # the worker runs by file path, so sys.path[0] is tests/bases — the
+    # repo root must come from PYTHONPATH (pinned, not inherited)
+    env["PYTHONPATH"] = os.path.abspath(_REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    # tiny segments: the stream spans several, so truncation really unlinks
+    env["METRICS_TPU_WAL_SEGMENT_BYTES"] = "4096"
+    # one shared persistent store across every subprocess: recover runs
+    # deserialize the stacked program instead of recompiling
+    env["METRICS_TPU_AOT_CACHE"] = str(aot_dir)
+    env.pop("METRICS_TPU_INJECT_FAULT", None)
+    env.pop("METRICS_TPU_CRASH", None)
+    return env
+
+
+def _run_worker(phase, workdir, env, crash=None, timeout=240):
+    if crash is not None:
+        env = dict(env)
+        env["METRICS_TPU_CRASH"] = crash
+    return subprocess.run(
+        [sys.executable, _WORKER, phase, str(workdir)],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=_REPO,
+    )
+
+
+@pytest.fixture(scope="module")
+def twin_digest(tmp_path_factory):
+    """The uncrashed twin: one full run of the stream; its digest is the
+    ground truth every recovered process must hit bit-for-bit."""
+    aot = tmp_path_factory.mktemp("aot-shared")
+    work = tmp_path_factory.mktemp("twin")
+    proc = _run_worker("run", work, _env(aot))
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    return {"aot": aot, "digest": out["digest"], "last_seq": out["last_seq"]}
+
+
+def _kill_and_recover(point, twin_digest, tmp_path):
+    nth = _CRASH_NTH[point]
+    work = tmp_path / point
+    work.mkdir()
+    env = _env(twin_digest["aot"])
+
+    crashed = _run_worker("run", work, env, crash=f"{point}:{nth}")
+    # the armed probe SIGKILLs the process: no exception, no cleanup
+    assert crashed.returncode in (-signal.SIGKILL, 128 + signal.SIGKILL), (
+        f"crash point {point} did not kill the worker "
+        f"(rc={crashed.returncode})\n{crashed.stderr}"
+    )
+    assert not crashed.stdout.strip(), "a killed worker must not have printed its digest"
+
+    recovered = _run_worker("recover", work, env)
+    assert recovered.returncode == 0, recovered.stderr
+    out = json.loads(recovered.stdout.strip().splitlines()[-1])
+    assert out["digest"] == twin_digest["digest"], (
+        f"recovery after {point} crash is not bit-identical to the uncrashed twin"
+    )
+    assert out["last_seq"] == twin_digest["last_seq"]
+
+
+def test_kill_and_recover_representative(twin_digest, tmp_path):
+    """Default-tier pin: the post-journal kill (record durable, request
+    never enqueued) recovers bit-identically — the core exactly-once case."""
+    _kill_and_recover("post-journal", twin_digest, tmp_path)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "point", [p for p in faults.CRASH_POINTS if p != "post-journal"]
+)
+def test_kill_and_recover_every_point(point, twin_digest, tmp_path):
+    """The full matrix (``make crash``): every remaining registered crash
+    point recovers bit-identically to the uncrashed twin."""
+    _kill_and_recover(point, twin_digest, tmp_path)
+
+
+def test_crash_points_registry_is_closed():
+    """The harness and the registry must not drift: every point the test
+    matrix knows is registered, and vice versa."""
+    assert set(_CRASH_NTH) == set(faults.CRASH_POINTS)

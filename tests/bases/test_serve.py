@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 
 from metrics_tpu import Accuracy, MetricCollection, faults, resilience, telemetry
+from metrics_tpu.resilience import StateCorruptionError
 from metrics_tpu.serve import MetricsService
 from tests.bases.test_chaos import FloatSum
 
@@ -161,7 +162,11 @@ def test_session_lifecycle_and_growth():
     assert svc.session_count == n - 1
     with pytest.raises(KeyError):
         svc.compute("s7")
-    # a reopened name starts from the default state (the row was scrubbed)
+    # a closed name refuses submits until explicitly reclaimed; the
+    # reopened row starts from the default state (it was scrubbed)
+    with pytest.raises(KeyError, match="closed"):
+        svc.update("s7", jnp.asarray([1.0], dtype=jnp.float32))
+    svc.open_session("s7")
     svc.update("s7", jnp.asarray([1.0], dtype=jnp.float32))
     np.testing.assert_array_equal(
         np.asarray(svc.compute("s7")), np.asarray(1.0, dtype=np.float32)
@@ -336,4 +341,52 @@ def test_telemetry_snapshot_shape():
     assert snap["owner"] == "MetricsService[Accuracy]"
     assert snap["sessions"] == 1 and snap["capacity"] >= 64
     assert snap["serve"]["submits"] == 1 and snap["serve"]["launches"] == 1
-    assert set(snap) == {"owner", "serve", "sessions", "capacity", "resilience", "aot_cache"}
+    assert set(snap) == {"owner", "serve", "sessions", "capacity", "resilience", "aot_cache", "wal"}
+    assert snap["wal"] is None  # no journal_dir configured
+
+
+def test_submit_after_close_names_the_session():
+    svc = MetricsService(FloatSum())
+    svc.update("tenant", jnp.asarray([1.0], dtype=jnp.float32))
+    svc.close_session("tenant")
+    with pytest.raises(KeyError, match=r"session 'tenant' has been closed"):
+        svc.submit("tenant", jnp.asarray([1.0], dtype=jnp.float32))
+    # the error also names the remedy
+    with pytest.raises(KeyError, match=r"open_session\('tenant'\)"):
+        svc.submit("tenant", jnp.asarray([1.0], dtype=jnp.float32))
+
+
+def test_restore_missing_checkpoint_raises_unless_first_boot(tmp_path):
+    svc = MetricsService(FloatSum(), checkpoint_dir=str(tmp_path / "ckpt"))
+    with pytest.raises(StateCorruptionError, match="does not exist"):
+        svc.restore()
+    # documented first-boot path: missing_ok tolerates the empty dir
+    assert svc.restore(missing_ok=True) is False
+    assert svc.recover() is False  # recover() is the missing_ok spelling
+
+
+def test_restore_truncated_checkpoint_raises_corruption(tmp_path):
+    svc = MetricsService(FloatSum(), checkpoint_dir=str(tmp_path))
+    svc.update("tenant", jnp.asarray([2.0], dtype=jnp.float32))
+    path = svc.checkpoint()
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[: len(data) // 2])  # torn write: half the npz
+    fresh = MetricsService(FloatSum(), checkpoint_dir=str(tmp_path))
+    with pytest.raises(StateCorruptionError, match="unreadable"):
+        fresh.restore()
+    # missing_ok does NOT excuse corruption — only absence
+    with pytest.raises(StateCorruptionError, match="unreadable"):
+        fresh.restore(missing_ok=True)
+
+
+def test_restore_missing_meta_raises_corruption(tmp_path):
+    svc = MetricsService(FloatSum(), checkpoint_dir=str(tmp_path))
+    svc.update("tenant", jnp.asarray([2.0], dtype=jnp.float32))
+    path = svc.checkpoint()
+    payload = dict(np.load(path, allow_pickle=False))
+    payload = {k: v for k, v in payload.items() if "__meta__" not in k}
+    np.savez(path[: -len(".npz")] if path.endswith(".npz") else path, **payload)
+    fresh = MetricsService(FloatSum(), checkpoint_dir=str(tmp_path))
+    with pytest.raises(StateCorruptionError, match="__meta__"):
+        fresh.restore()

@@ -1,0 +1,188 @@
+"""Write-ahead journal unit tier (metrics_tpu/wal.py).
+
+The frame/segment format contracts the crash harness
+(``test_crash_recovery.py``) relies on, tested without subprocesses:
+append→read round-trips, sequence fencing, DROP resolution, torn-tail
+discard vs hard-corruption refusal, truncation that preserves the
+sequence floor, and the stats surface.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from metrics_tpu import telemetry, wal
+from metrics_tpu.resilience import StateCorruptionError
+
+
+def _log(tmp_path, **kwargs):
+    kwargs.setdefault("segment_max_bytes", 4096)
+    return wal.WriteAheadLog(str(tmp_path / "wal"), owner="test", **kwargs)
+
+
+def _append_updates(log, n, start=0):
+    for i in range(start, start + n):
+        log.append(
+            wal.UPDATE, f"s{i % 3}",
+            (np.arange(4, dtype=np.float32) + i,),
+            {"flag": True},
+        )
+
+
+# ------------------------------------------------------------- round trip
+def test_append_read_roundtrip(tmp_path):
+    log = _log(tmp_path)
+    seq = log.append(wal.UPDATE, "tenant", (np.asarray([1.0, 2.0], np.float32),), {"k": 3})
+    assert seq == 1 and log.last_seq == 1
+    log.append(wal.CLOSE, "tenant")
+    log.append(wal.RESET, "other")
+    records = log.read_tail(0)
+    assert [r.kind for r in records] == [wal.UPDATE, wal.CLOSE, wal.RESET]
+    assert [r.seq for r in records] == [1, 2, 3]
+    assert records[0].session == "tenant"
+    np.testing.assert_array_equal(records[0].args[0], np.asarray([1.0, 2.0], np.float32))
+    assert records[0].kwargs == {"k": 3}  # non-array statics keep their types
+    assert isinstance(records[0].kwargs["k"], int)
+
+
+def test_reopen_resumes_sequence(tmp_path):
+    log = _log(tmp_path)
+    _append_updates(log, 5)
+    log.close()
+    log2 = _log(tmp_path)
+    assert log2.last_seq == 5
+    assert log2.append(wal.UPDATE, "s0", (np.zeros(2, np.float32),)) == 6
+
+
+def test_sequence_fencing_is_exact(tmp_path):
+    log = _log(tmp_path)
+    _append_updates(log, 8)
+    assert [r.seq for r in log.read_tail(5)] == [6, 7, 8]
+    assert log.read_tail(8) == []
+    # idempotent: reading the same tail twice returns the same records
+    assert [r.seq for r in log.read_tail(5)] == [6, 7, 8]
+
+
+def test_drop_records_resolve_away_their_victims(tmp_path):
+    log = _log(tmp_path)
+    _append_updates(log, 4)  # seqs 1-4
+    log.append(wal.DROP, "s1", drop_seq=2, drop_cause="queue-full-shed")
+    records = log.read_tail(0)
+    assert [r.seq for r in records] == [1, 3, 4]  # 2 shed, DROP itself resolved
+    assert all(r.kind == wal.UPDATE for r in records)
+
+
+# ------------------------------------------------------------- durability
+def test_torn_tail_is_discarded_and_truncated(tmp_path):
+    log = _log(tmp_path)
+    _append_updates(log, 3)
+    log.close()
+    path = sorted(os.listdir(tmp_path / "wal"))[-1]
+    full = os.path.join(str(tmp_path / "wal"), path)
+    size = os.path.getsize(full)
+    with open(full, "ab") as f:  # half a frame: a crash mid-append
+        f.write(b"MTWL" + b"\x07" * 9)
+    log2 = _log(tmp_path)
+    assert log2.last_seq == 3
+    assert log2.stats()["discarded_frames"] == 1
+    assert os.path.getsize(full) == size  # physically truncated back
+    assert len(log2.read_tail(0)) == 3
+
+
+def test_complete_frame_corruption_refuses_to_open(tmp_path):
+    log = _log(tmp_path)
+    _append_updates(log, 3)
+    log.close()
+    seg = sorted(os.listdir(tmp_path / "wal"))[-1]
+    full = os.path.join(str(tmp_path / "wal"), seg)
+    with open(full, "r+b") as f:
+        f.seek(40)  # inside frame 1's body: crc must catch it
+        f.write(b"\xff\xff\xff")
+    with pytest.raises(StateCorruptionError, match="crc32|magic"):
+        _log(tmp_path)
+
+
+def test_missing_middle_segment_refuses_to_open(tmp_path):
+    log = _log(tmp_path, segment_max_bytes=4096)
+    big = np.zeros(1200, np.float32)  # ~4.8KB payload: one frame per segment
+    for i in range(4):
+        log.append(wal.UPDATE, "s", (big + i,))
+    log.close()
+    segs = sorted(os.listdir(tmp_path / "wal"))
+    assert len(segs) >= 3
+    os.remove(os.path.join(str(tmp_path / "wal"), segs[1]))
+    with pytest.raises(StateCorruptionError, match="missing or reordered"):
+        _log(tmp_path)
+
+
+# ------------------------------------------------------------- truncation
+def test_truncate_preserves_sequence_floor(tmp_path):
+    log = _log(tmp_path, segment_max_bytes=4096)
+    big = np.zeros(800, np.float32)
+    for i in range(5):
+        log.append(wal.UPDATE, "s", (big + i,))
+    assert log.stats()["segments"] >= 3
+    removed = log.truncate(log.last_seq)  # everything retired
+    assert removed >= 1
+    assert log.read_tail(0) == []
+    assert log.last_seq == 5
+    log.close()
+    # the empty successor segment pins the floor across a restart
+    log2 = _log(tmp_path)
+    assert log2.last_seq == 5
+    assert log2.append(wal.UPDATE, "s", (big,)) == 6
+
+
+def test_truncate_is_fenced_and_idempotent(tmp_path):
+    log = _log(tmp_path, segment_max_bytes=4096)
+    big = np.zeros(800, np.float32)
+    for i in range(5):
+        log.append(wal.UPDATE, "s", (big + i,))
+    fence = 2
+    log.truncate(fence)
+    # records above the fence survive any truncation
+    assert [r.seq for r in log.read_tail(fence)] == [3, 4, 5]
+    log.truncate(fence)  # idempotent
+    assert [r.seq for r in log.read_tail(fence)] == [3, 4, 5]
+
+
+def test_ensure_seq_raises_floor_only(tmp_path):
+    log = _log(tmp_path)
+    log.ensure_seq(40)
+    assert log.last_seq == 40
+    log.ensure_seq(10)
+    assert log.last_seq == 40
+    assert log.append(wal.UPDATE, "s", (np.zeros(2, np.float32),)) == 41
+
+
+# ---------------------------------------------------------------- surface
+def test_stats_and_telemetry_surface(tmp_path):
+    telemetry.reset_counters()
+    log = _log(tmp_path)
+    with telemetry.instrument() as t:
+        _append_updates(log, 3)
+    stats = log.stats()
+    assert stats["appends"] == 3 and stats["last_seq"] == 3
+    assert stats["fsyncs"] == 3 and stats["fsync_us_p95"] >= stats["fsync_us_p50"] >= 0
+    spans = t.spans(name="journal", kind="append")
+    assert len(spans) == 3 and all(s.attrs["nbytes"] > 0 for s in spans)
+    counters = telemetry.snapshot()
+    assert counters["journal:append"] == 3
+    assert counters["journal:bytes"] == stats["bytes"]
+
+
+def test_fsync_off_still_durable_to_process_kill(tmp_path):
+    log = _log(tmp_path, fsync=False)
+    _append_updates(log, 2)
+    assert log.stats()["fsyncs"] == 0
+    log.close()
+    assert _log(tmp_path).last_seq == 2  # OS buffers survive a process exit
+
+
+def test_wal_kill_switch(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_WAL", "0")
+    assert not wal.wal_enabled()
+    monkeypatch.setenv("METRICS_TPU_WAL", "1")
+    assert wal.wal_enabled()
+    monkeypatch.delenv("METRICS_TPU_WAL")
+    assert wal.wal_enabled()

@@ -131,6 +131,36 @@ def summarize(events: List[Dict[str, Any]]) -> str:
         + f"   evictions: {evictions}"
     )
 
+    # write-ahead journal + admission control (metrics_tpu.wal + serve):
+    # appends are the per-request durability tax, replay/truncate bracket
+    # recovery, and every degraded request carries its admission cause
+    journal = [e for e in events if e["name"] == "journal"]
+    if journal:
+        by_jkind: Dict[str, int] = {}
+        for e in journal:
+            by_jkind[e.get("kind", "?")] = by_jkind.get(e.get("kind", "?"), 0) + 1
+        jbytes = sum(int((e.get("attrs") or {}).get("nbytes", 0)) for e in journal)
+        replayed = sum(int((e.get("attrs") or {}).get("records", 0)) for e in journal
+                       if e.get("kind") == "replay")
+        lines.append("")
+        lines.append(
+            "journal: "
+            + "   ".join(f"{k}: {by_jkind.get(k, 0)}" for k in ("append", "replay", "truncate"))
+            + f"   bytes appended: {jbytes}   records replayed: {replayed}"
+        )
+    degrades = [
+        e for e in events
+        if e["name"] == "degrade" and e.get("kind") in ("admission", "session")
+    ]
+    if degrades:
+        by_cause: Dict[str, int] = {}
+        for e in degrades:
+            cause = (e.get("attrs") or {}).get("cause", "unattributed")
+            by_cause[cause] = by_cause.get(cause, 0) + 1
+        lines.append("admission degrades: " + str(len(degrades)))
+        for cause in sorted(by_cause):
+            lines.append(f"  cause {cause:<22}{by_cause[cause]:>5}")
+
     # cold start to first result: process start (trace window origin) to the
     # retirement of the first value-producing span — the number the
     # persistent cache exists to shrink
