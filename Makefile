@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos crash doctest audit bench bench-forward serve-bench trace tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos crash doctest audit bench bench-forward serve-bench stream-bench trace tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -96,6 +96,12 @@ bench-forward:
 # throughput, and the structural coalescing pin (launches per flush == 1)
 serve-bench:
 	python -c "import json, bench; d = {}; bench._cfg_serving(d); print(json.dumps(d, indent=2))"
+
+# streaming numbers only: window-advance latency plus the structural pins
+# (zero retraces over a 1k-step SlidingWindow stream; a 2-replica sketch
+# sync is exactly one packed collective)
+stream-bench:
+	python -c "import json, bench; d = {}; bench._cfg_streaming(d); print(json.dumps(d, indent=2))"
 
 # short instrumented eval with telemetry export, then the human-readable
 # replay: launches, retraces by cause, collectives/bytes, p50/p95 span µs.

@@ -711,6 +711,79 @@ def _machinery_device(detail: dict):
     return dev
 
 
+def _cfg_streaming(detail: dict, steps: int = 1000) -> None:
+    """Streaming subsystem (:mod:`metrics_tpu.streaming`): window-advance
+    latency plus the two structural pins behind "windows ride the engines
+    unchanged".
+
+    (1) **Zero retraces**: ``steps`` updates of a
+    ``SlidingWindow(Accuracy, window=64)`` after the warmup compile are
+    ``steps`` cached dispatches and ZERO retraces — the traced ring
+    cursor keeps every leaf shape fixed, so one executable serves the
+    whole stream. (2) **One packed collective**: a 2-replica
+    ``QuantileSketch`` sync is exactly ONE collective — the (2·bins+1,)
+    float32-sum histogram is a single fixed-shape leaf the fused sync
+    engine packs like any other, with zero engine changes. The loopback
+    env keeps it in-process (each replica sees its own counts twice, so
+    the merged total exactly doubles — asserted, not assumed).
+
+    ``steps`` lets the bench-config pin test run the same code path at
+    test-budget scale."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, QuantileSketch, SlidingWindow, profiling
+    from metrics_tpu.parallel.dist_env import NoOpEnv
+
+    class _Loopback2(NoOpEnv):
+        def world_size(self):
+            return 2
+
+        def all_gather(self, x):
+            x = jnp.atleast_1d(x)
+            return [x, x]
+
+        def all_reduce(self, x, op):
+            stacked = jnp.stack([jnp.atleast_1d(x)] * 2)
+            red = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}.get(op)
+            return None if red is None else red(stacked, axis=0)
+
+    rng = np.random.RandomState(17)
+    C, B = 8, 64
+    preds = jnp.asarray(rng.rand(B, C).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, C, B))
+
+    # (1) window advance: steady-state update latency + zero-retrace pin
+    w = SlidingWindow(Accuracy(num_classes=C, average="macro"), window=64, jit_update=True)
+    w.update(preds, target)  # warmup compile
+    jax.block_until_ready(w.cursor)
+    with profiling.track_dispatches() as t:
+        for _ in range(steps):
+            w.update(preds, target)
+        jax.block_until_ready(w.cursor)
+    detail["window_retraces_1k_steps"] = t.retrace_count()
+    detail["window_dispatches_1k_steps"] = t.dispatch_count()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(50):
+            w.update(preds, target)
+        jax.block_until_ready(w.cursor)
+        best = min(best, (time.perf_counter() - t0) / 50 * 1e6)
+    detail["window_advance_us"] = round(best, 1)
+
+    # (2) sketch sync: one packed collective, exact doubling under loopback
+    s = QuantileSketch(bins=512)
+    s.update(jnp.asarray(rng.randn(4096).astype(np.float32)))
+    before = float(jnp.sum(s.value))
+    with profiling.track_syncs() as ts:
+        s.sync(env=_Loopback2())
+    assert float(jnp.sum(s.value)) == 2 * before, "loopback sum must exactly double"
+    s.unsync()
+    detail["sketch_sync_collectives_2replica"] = ts.collectives
+    detail["sketch_sync_bytes_2replica"] = ts.bytes_on_wire
+
+
 def _cfg_compute_group_detection(detail: dict, reps: int = 5) -> None:
     """First-update cost of auto compute-group detection (VERDICT r3 #7).
 
@@ -1294,6 +1367,7 @@ def _bench_detail() -> dict:
         ("resilience_idle_overhead_ratio", _cfg_resilience_overhead),
         ("serve_updates_per_sec_1k_sessions", _cfg_serving),
         ("wal_append_overhead_ratio", _cfg_crash_recovery),
+        ("window_advance_us", _cfg_streaming),
     ]
     detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
     return detail

@@ -1,12 +1,17 @@
 """Aggregation metrics: running max/min/sum/cat/mean over a stream of values.
 
 Behavioral parity: /root/reference/torchmetrics/aggregation.py (402 LoC).
-NaN handling is expressed with jnp.where masks (jit-friendly) instead of
-boolean indexing where possible; the 'error'/'warn' strategies require
-concrete values and run eagerly like the reference.
+NaN handling is trace-safe: :meth:`BaseAggregator._cast_and_nan_mask_input`
+returns ``(values, valid_mask)`` and every update applies the mask with a
+per-reduction neutral element, so ``nan_strategy="ignore"``/``"warn"``
+drop NaN contributions identically under eager and jit execution (the
+old boolean-indexing path silently KEPT NaNs inside traced updates).
+Raising/warning still needs concrete values and happens only on the
+eager path; the data-dependent row-drop survives solely in
+:class:`CatMetric`, whose list state is eager-only anyway.
 """
 import warnings
-from typing import Any, Callable, List, Union
+from typing import Any, Callable, List, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -38,11 +43,23 @@ class BaseAggregator(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        # validate eagerly: an unknown string (or a bool, which is not a
+        # weight) must fail HERE with a clear message, not at update time
+        # inside float(self.nan_strategy)
         allowed_nan_strategy = ("error", "warn", "ignore")
-        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+        if isinstance(nan_strategy, str):
+            if nan_strategy not in allowed_nan_strategy:
+                raise ValueError(
+                    f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} "
+                    f"but got {nan_strategy}."
+                )
+        elif isinstance(nan_strategy, bool) or not isinstance(nan_strategy, (int, float)):
             raise ValueError(
-                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} "
+                f"but got {nan_strategy}."
             )
+        else:
+            nan_strategy = float(nan_strategy)  # int impute values are fine
         self.nan_strategy = nan_strategy
         self.add_state("value", default=default_value, dist_reduce_fx=fn)
 
@@ -64,6 +81,37 @@ class BaseAggregator(Metric):
         else:
             x = jnp.where(jnp.isnan(x), jnp.asarray(float(self.nan_strategy), dtype=x.dtype), x)
         return x.astype(jnp.float32)
+
+    def _cast_and_nan_mask_input(self, x: Union[float, Array]) -> Tuple[Array, Array]:
+        """Trace-safe nan strategy: returns ``(values, valid_mask)``.
+
+        Unlike :meth:`_cast_and_nan_check_input` (whose data-dependent
+        row-drop cannot trace, so under jit it silently KEPT NaNs), this
+        never changes shape: the caller masks invalid lanes out with the
+        reduction's neutral element, so eager and jitted updates agree
+        bitwise. On the eager path ``"error"`` still raises and
+        ``"warn"`` still warns; under a tracer, ``"warn"``/``"ignore"``
+        mask (same arithmetic, no warning) and ``"error"`` keeps the NaN
+        so the poisoned result stays visible rather than silently
+        dropped. Impute strategies substitute and mark every lane valid.
+        """
+        if not isinstance(x, jax.Array):
+            x = jnp.asarray(x, dtype=jnp.float32)
+        x = x.astype(jnp.float32)
+        if isinstance(self.nan_strategy, str):
+            nans = jnp.isnan(x)
+            if not isinstance(x, jax.core.Tracer) and bool(nans.any()):
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encounted `nan` values in tensor")
+                if self.nan_strategy == "warn":
+                    warnings.warn("Encounted `nan` values in tensor. Will be removed.", UserWarning)
+            if self.nan_strategy == "error":
+                return x, jnp.ones_like(x, dtype=bool)
+            return x, ~nans
+        return (
+            jnp.where(jnp.isnan(x), jnp.asarray(float(self.nan_strategy), jnp.float32), x),
+            jnp.ones_like(x, dtype=bool),
+        )
 
     def update(self, value: Union[float, Array]) -> None:
         """Overwrite in child class."""
@@ -90,9 +138,11 @@ class MaxMetric(BaseAggregator):
         super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, **kwargs)
 
     def update(self, value: Union[float, Array]) -> None:
-        value = self._cast_and_nan_check_input(value)
-        if value.size:  # make sure tensor not empty
-            self.value = jnp.maximum(self.value, jnp.max(value))
+        value, mask = self._cast_and_nan_mask_input(value)
+        if not value.size:  # static shape: same branch eager and traced
+            return
+        masked = jnp.where(mask, value, -jnp.inf)
+        self.value = jnp.where(jnp.any(mask), jnp.maximum(self.value, jnp.max(masked)), self.value)
 
 
 class MinMetric(BaseAggregator):
@@ -113,9 +163,11 @@ class MinMetric(BaseAggregator):
         super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, **kwargs)
 
     def update(self, value: Union[float, Array]) -> None:
-        value = self._cast_and_nan_check_input(value)
-        if value.size:
-            self.value = jnp.minimum(self.value, jnp.min(value))
+        value, mask = self._cast_and_nan_mask_input(value)
+        if not value.size:
+            return
+        masked = jnp.where(mask, value, jnp.inf)
+        self.value = jnp.where(jnp.any(mask), jnp.minimum(self.value, jnp.min(masked)), self.value)
 
 
 class SumMetric(BaseAggregator):
@@ -134,12 +186,24 @@ class SumMetric(BaseAggregator):
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
 
     def update(self, value: Union[float, Array]) -> None:
-        value = self._cast_and_nan_check_input(value)
-        self.value = self.value + value.sum()
+        value, mask = self._cast_and_nan_mask_input(value)
+        self.value = self.value + jnp.where(mask, value, 0.0).sum()
 
 
 class CatMetric(BaseAggregator):
     """Concatenate all seen values (ref aggregation.py:273-324).
+
+    .. warning::
+        The list state grows **unboundedly** with the stream and cannot
+        ride the fused sync engine (list states are sync-unfusable) or
+        any AOT engine path. For continuous-traffic monitoring use the
+        bounded-memory alternatives instead:
+        :class:`~metrics_tpu.streaming.SlidingWindow` for windowed
+        values, :class:`~metrics_tpu.streaming.QuantileSketch` /
+        :class:`~metrics_tpu.streaming.HyperLogLog` /
+        :class:`~metrics_tpu.streaming.CountMinHeavyHitters` for
+        distribution, distinct-count, and frequency summaries. See
+        ``docs/streaming.md``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -182,13 +246,17 @@ class MeanMetric(BaseAggregator):
         self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
 
     def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
-        value = self._cast_and_nan_check_input(value)
-        weight = self._cast_and_nan_check_input(weight)
+        value, v_mask = self._cast_and_nan_mask_input(value)
+        weight, w_mask = self._cast_and_nan_mask_input(weight)
         if value.size == 0:
             return
+        # one joint mask (a NaN in either lane drops the pair) — the old
+        # independent row-drops could desync value/weight shapes for array
+        # weights, and kept NaNs entirely under jit
         weight = jnp.broadcast_to(weight, value.shape)
-        self.value = self.value + (value * weight).sum()
-        self.weight = self.weight + weight.sum()
+        mask = jnp.logical_and(v_mask, jnp.broadcast_to(w_mask, value.shape))
+        self.value = self.value + jnp.where(mask, value * weight, 0.0).sum()
+        self.weight = self.weight + jnp.where(mask, weight, 0.0).sum()
 
     def compute(self) -> Array:
         return self.value / self.weight

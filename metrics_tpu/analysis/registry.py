@@ -153,6 +153,21 @@ def _device_table():
         "SumMetric": cls_args(lambda: M.SumMetric(), "reg_p"),
         "MeanMetric": cls_args(lambda: M.MeanMetric(), "reg_p"),
         "CatMetric": cls_args(lambda: M.CatMetric(), "reg_p"),
+        # streaming: fixed-shape windows and sketches, fully traceable
+        "SlidingWindow": cls_args(
+            lambda: M.SlidingWindow(M.Accuracy(num_classes=_C, average="macro"), window=4, slide=2),
+            "probs", "labels",
+        ),
+        "TumblingWindow": cls_args(
+            lambda: M.TumblingWindow(M.Accuracy(num_classes=_C, average="macro"), window=4),
+            "probs", "labels",
+        ),
+        "ExponentialDecay": cls_args(
+            lambda: M.ExponentialDecay(M.MeanSquaredError(), halflife=8.0), "reg_p", "reg_t"
+        ),
+        "QuantileSketch": cls_args(lambda: M.QuantileSketch(bins=64), "reg_p"),
+        "HyperLogLog": cls_args(lambda: M.HyperLogLog(precision=6), "reg_p"),
+        "CountMinHeavyHitters": cls_args(lambda: M.CountMinHeavyHitters(depth=2, width=64), "reg_p"),
         # audio (PESQ is host_only; the rest trace)
         "SignalNoiseRatio": cls_args(lambda: M.SignalNoiseRatio(), "audio_p", "audio_t"),
         "ScaleInvariantSignalNoiseRatio": cls_args(

@@ -207,7 +207,12 @@ class MetricsService:
             )
         self.template = template
         self.label = f"MetricsService[{type(template).__name__}]"
-        self.coalesce = coalesce
+        from metrics_tpu.streaming.window import _StreamingWindow
+
+        # window wrappers count UPDATES (each submit is one window tick);
+        # batch-axis coalescing would silently merge ticks and change the
+        # horizon, so it is forced off for windowed templates
+        self.coalesce = coalesce and not isinstance(template, _StreamingWindow)
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = int(checkpoint_every)
         self.max_inflight = max(1, int(max_inflight))
@@ -817,6 +822,40 @@ class MetricsService:
         except Exception as err:  # noqa: BLE001 - e.g. value-dependent compute
             resilience.record_degrade(self.label, "compute", err)
             return {n: self.compute(n) for n in names_sorted}
+
+    def compute_window(self, name: Optional[str] = None) -> Any:
+        """Windowed read of a streaming-wrapper service.
+
+        Requires the service template to be a streaming window wrapper
+        (:class:`~metrics_tpu.streaming.SlidingWindow`,
+        :class:`~metrics_tpu.streaming.TumblingWindow`, or
+        :class:`~metrics_tpu.streaming.ExponentialDecay`); raises
+        ``TypeError`` otherwise so callers don't mistake a lifetime value
+        for a windowed one. With ``name`` evaluates one session, without
+        it evaluates every open session (same engine paths as
+        :meth:`compute` / :meth:`compute_all` — window gathering happens
+        inside the wrapper's ``pure_compute``). Emits a ``window``
+        telemetry span (kind ``serve-compute``).
+        """
+        from metrics_tpu.streaming.window import _StreamingWindow
+
+        if not isinstance(self.template, _StreamingWindow):
+            raise TypeError(
+                f"compute_window() needs a streaming window template "
+                f"(SlidingWindow/TumblingWindow/ExponentialDecay), got "
+                f"{type(self.template).__name__}; use compute()/compute_all() "
+                f"for lifetime values"
+            )
+        t0 = telemetry.clock()
+        out = self.compute(name) if name is not None else self.compute_all()
+        telemetry.emit(
+            "window",
+            type(self.template).__name__,
+            "serve-compute",
+            t0=t0,
+            sessions=1 if name is not None else len(self._rows),
+        )
+        return out
 
     # ----------------------------------------------------------- checkpoint
     def _checkpoint_path(self, path: Optional[str]) -> str:

@@ -1,0 +1,27 @@
+"""Streaming metrics: windowed wrappers + fixed-shape sketch aggregators.
+
+The online-evaluation workload class (drift detection, live A/B deltas,
+latency percentiles over continuous traffic) on the existing engines:
+every class here keeps **fixed-shape** state so it rides fast dispatch,
+the fused forward engine, the packed sync collectives, and the stacked
+serving launcher without any engine changes. See ``docs/streaming.md``.
+"""
+from metrics_tpu.streaming.sketch import (  # noqa: F401
+    CountMinHeavyHitters,
+    HyperLogLog,
+    QuantileSketch,
+)
+from metrics_tpu.streaming.window import (  # noqa: F401
+    ExponentialDecay,
+    SlidingWindow,
+    TumblingWindow,
+)
+
+__all__ = [
+    "CountMinHeavyHitters",
+    "ExponentialDecay",
+    "HyperLogLog",
+    "QuantileSketch",
+    "SlidingWindow",
+    "TumblingWindow",
+]

@@ -1,0 +1,409 @@
+"""Windowed metric wrappers: bounded-memory metrics over continuous traffic.
+
+Every base metric accumulates without bound — correct for a finite eval
+set, wrong for production monitoring where "accuracy" means "accuracy
+over the last hour", not "since process start". The wrappers here bound
+both the horizon and the memory with **fixed-shape** state, so they stay
+engine-eligible (fast dispatch, fused forward, fused sync, stacked
+serving) and never retrace as the window slides:
+
+* :class:`SlidingWindow` — a ring of ``window // slide`` per-bucket
+  state snapshots (the same stacked-leaf layout as ``serve.py`` session
+  rows). Each update folds into the bucket under a **traced cursor**;
+  ``compute()`` merges the buckets oldest-first through the inner
+  metric's :meth:`~metrics_tpu.metric.Metric.pure_merge`, so the value
+  covers the most recent ``window`` updates (to ``slide`` granularity).
+* :class:`TumblingWindow` — non-overlapping windows of exactly
+  ``window`` updates: a *current* accumulator and a *done* snapshot,
+  swapped by a traced predicate when the window fills.
+* :class:`ExponentialDecay` — no buckets at all: every state leaf is
+  scaled by ``0.5 ** (1 / halflife)`` before each update, giving an
+  exponentially-weighted value with O(1) state. Requires sum/mean
+  reductions (decay of a max is not meaningful).
+
+All three hold the inner metric's leaves as their OWN states (prefixed
+``ring_`` / ``cur_`` / ``done_`` / ``ew_``), declared with the inner
+leaf's reduction, so the fused sync engine packs them into its existing
+per-(dtype, op) buckets with zero engine changes. Cursors and counts are
+int32 scalars/vectors — every branch is a ``jnp.where``/scatter on a
+traced index, never Python control flow, which is what keeps the jaxpr
+shape-stable across the whole stream (the streaming analogue of the
+fixed-shape O(1) cache argument in PAPERS.md arxiv 2603.09555).
+
+Telemetry: eager-path updates and computes emit ``window`` spans (kinds
+``advance`` / ``update`` / ``compute``); under jit the Python body runs
+once at trace time, so emission is guarded on concreteness and the
+compiled paths are observed through the usual ``update``/``forward``
+launch spans instead.
+"""
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import telemetry
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.exceptions import MetricsUserError
+
+__all__ = ["SlidingWindow", "TumblingWindow", "ExponentialDecay"]
+
+Array = jax.Array
+
+
+def _describe(metric: Metric) -> str:
+    """Stable config string for the inner metric — folded into the AOT
+    persistent-cache namespace through the wrapper's public attrs (the
+    inner metric itself is held under an underscore attr, which
+    ``aot_cache.owner_namespace`` deliberately skips)."""
+    parts = [f"{type(metric).__module__}.{type(metric).__qualname__}"]
+    for k in sorted(vars(metric)):
+        if k.startswith("_"):
+            continue
+        v = getattr(metric, k)
+        if isinstance(v, (bool, int, float, str, type(None))):
+            parts.append(f"{k}={v!r}")
+    for k in sorted(metric._defaults):
+        d = metric._defaults[k]
+        if isinstance(d, list):
+            parts.append(f"{k}:list")
+        else:
+            parts.append(f"{k}:{d.shape}/{d.dtype}")
+    return ";".join(parts)
+
+
+def _check_inner(metric: Any, wrapper: str, allow_max_min: bool = True) -> None:
+    if not isinstance(metric, Metric):
+        raise MetricsUserError(f"{wrapper} expects a Metric instance, got {type(metric).__name__}")
+    if getattr(type(metric), "host_only", False):
+        raise MetricsUserError(
+            f"{wrapper} cannot wrap host_only metric {type(metric).__name__}: "
+            "windowing needs a traceable pure_update"
+        )
+    for name, default in metric._defaults.items():
+        if isinstance(default, list):
+            raise MetricsUserError(
+                f"{wrapper} cannot wrap {type(metric).__name__}: state {name!r} is a "
+                "list state (unbounded, cannot stack into a fixed-shape ring). "
+                "See docs/streaming.md for bounded-memory alternatives (sketches)."
+            )
+    if not allow_max_min:
+        from metrics_tpu.utilities.data import dim_zero_max, dim_zero_min
+
+        for name, red in metric._reductions.items():
+            if red in (dim_zero_max, dim_zero_min):
+                raise MetricsUserError(
+                    f"ExponentialDecay cannot wrap {type(metric).__name__}: state "
+                    f"{name!r} uses a max/min reduction, and decaying an extremum "
+                    "is not meaningful. Use SlidingWindow instead."
+                )
+
+
+def _emit_concrete(probe: Any, name: str, owner: str, kind: str, **attrs: Any) -> None:
+    """Emit only on the eager path: under jit/vmap the Python body runs
+    once at trace time, where ``probe`` is a Tracer — a span there would
+    count trace-time, not run-time."""
+    if not isinstance(probe, jax.core.Tracer):
+        telemetry.emit(name, owner, kind, **attrs)
+
+
+class _StreamingWindow(Metric):
+    """Shared plumbing: inner-metric validation, leaf bookkeeping, and
+    delegation of masked-update support to the wrapped metric."""
+
+    # the wrapper's batch value is the inner metric's value over just this
+    # batch; the double-update forward program computes exactly that from a
+    # fresh default, so the reference-parity semantics need full_state_update
+    full_state_update = True
+    is_differentiable = False
+
+    def __init__(self, metric: Metric, *, jit_update: bool = True, **kwargs: Any) -> None:
+        if not isinstance(metric, Metric):
+            raise MetricsUserError(
+                f"{type(self).__name__} expects a Metric instance, got {type(metric).__name__}"
+            )
+        super().__init__(jit_update=jit_update, **kwargs)
+        self._inner = metric
+        self.inner_spec = _describe(metric)
+        self._inner_names = tuple(metric._defaults)
+        self._inner_defaults = {
+            k: jnp.asarray(v) for k, v in metric._defaults.items()
+        }
+
+    def _masked_update_supported(self) -> bool:
+        return self._inner._masked_update_supported()
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({type(self._inner).__name__}())"
+
+
+class SlidingWindow(_StreamingWindow):
+    """Evaluate ``metric`` over the most recent ``window`` updates.
+
+    The state is a ring of ``window // slide`` buckets; each bucket is one
+    partial inner-metric state covering up to ``slide`` consecutive
+    updates. An update folds the batch into the current bucket via the
+    inner ``pure_update``; when the bucket holds ``slide`` updates the
+    (traced) cursor advances and the oldest bucket is re-initialized to
+    the inner defaults — O(window/slide) memory, O(1) per update, and a
+    single fixed-shape jaxpr for the whole stream.
+
+    ``compute()`` left-folds the buckets oldest-first through the inner
+    ``pure_merge``, so for sum/max/min-reduced states the result is
+    **bit-identical** to a fresh metric fed the same window of updates
+    (fp addition order matches; mean-reduced states get a bucket-weighted
+    running mean, exact when buckets are equally full). The horizon is
+    ``slide``-granular: between advances the value covers between
+    ``window - slide + 1`` and ``window`` updates.
+
+    Args:
+        metric: inner metric; fixed-shape array states only.
+        window: horizon in updates. Must be a positive multiple of ``slide``.
+        slide: advance granularity in updates (default 1 = exact horizon).
+        jit_update: engine eligibility (fast dispatch + fused forward);
+            default on — streaming exists for the hot path.
+
+    Example:
+        >>> import numpy as np, jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric
+        >>> from metrics_tpu.streaming import SlidingWindow
+        >>> w = SlidingWindow(SumMetric(), window=2, jit_update=False)
+        >>> for v in (1.0, 2.0, 4.0):
+        ...     w.update(jnp.asarray(v))
+        >>> float(w.compute())  # sum over the last 2 updates
+        6.0
+    """
+
+    def __init__(
+        self, metric: Metric, *, window: int, slide: int = 1, jit_update: bool = True, **kwargs: Any
+    ) -> None:
+        super().__init__(metric, jit_update=jit_update, **kwargs)
+        _check_inner(metric, "SlidingWindow")
+        window, slide = int(window), int(slide)
+        if window <= 0 or slide <= 0 or window % slide != 0:
+            raise MetricsUserError(
+                f"window must be a positive multiple of slide, got window={window} slide={slide}"
+            )
+        self.window = window
+        self.slide = slide
+        self.num_buckets = window // slide
+        for k, d in self._inner_defaults.items():
+            self.add_state(
+                f"ring_{k}",
+                jnp.broadcast_to(d[None], (self.num_buckets,) + d.shape) + jnp.zeros_like(d),
+                dist_reduce_fx=metric._reductions[k],
+            )
+        # replicas in lockstep hold the same bucket alignment: counts sum,
+        # cursors agree (max is a cheap idempotent reconciliation)
+        self.add_state("cursor", jnp.asarray(0, jnp.int32), dist_reduce_fx="max")
+        self.add_state("in_bucket", jnp.asarray(0, jnp.int32), dist_reduce_fx="max")
+        self.add_state(
+            "counts", jnp.zeros((self.num_buckets,), jnp.int32), dist_reduce_fx="sum"
+        )
+
+    # ------------------------------------------------------------- advance
+    def _advance(self, gate: Array) -> Tuple[Array, Array]:
+        """Lazy window advance: when the current bucket is full (and the
+        step is live — ``gate``), move the cursor and clear the bucket it
+        lands on. All traced: ``where`` + scatter, no Python branches."""
+        adv = jnp.logical_and(self.in_bucket >= self.slide, gate)
+        cursor = jnp.where(adv, (self.cursor + 1) % self.num_buckets, self.cursor)
+        counts = jnp.where(adv, self.counts.at[cursor].set(0), self.counts)
+        for k in self._inner_names:
+            ring = getattr(self, f"ring_{k}")
+            cleared = ring.at[cursor].set(self._inner_defaults[k])
+            object.__setattr__(self, f"ring_{k}", jnp.where(adv, cleared, ring))
+        self.counts = counts
+        self.cursor = cursor
+        self.in_bucket = jnp.where(adv, 0, self.in_bucket)
+        return adv, cursor
+
+    def _apply_bucket(self, cursor: Array, new_bucket: Dict[str, Array], gate: Array) -> None:
+        for k in self._inner_names:
+            ring = getattr(self, f"ring_{k}")
+            object.__setattr__(
+                self, f"ring_{k}", jnp.where(gate, ring.at[cursor].set(new_bucket[k]), ring)
+            )
+        live = gate.astype(jnp.int32)
+        self.counts = self.counts.at[cursor].add(live)
+        self.in_bucket = self.in_bucket + live
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        gate = jnp.asarray(True)
+        adv, cursor = self._advance(gate)
+        bucket = {k: getattr(self, f"ring_{k}")[cursor] for k in self._inner_names}
+        new_bucket = self._inner.pure_update(bucket, *args, **kwargs)
+        self._apply_bucket(cursor, new_bucket, gate)
+        if not isinstance(cursor, jax.core.Tracer):
+            telemetry.emit("window", type(self).__name__, "advance" if bool(adv) else "update",
+                           buckets=self.num_buckets, slide=self.slide)
+
+    def _masked_update(self, sample_mask: Array, *args: Any, **kwargs: Any) -> None:
+        # a fully-padded lane must not advance the cursor or count an update
+        gate = jnp.any(sample_mask)
+        _, cursor = self._advance(gate)
+        bucket = {k: getattr(self, f"ring_{k}")[cursor] for k in self._inner_names}
+        new_bucket = self._inner._masked_pure_update(bucket, sample_mask, *args, **kwargs)
+        self._apply_bucket(cursor, new_bucket, gate)
+
+    # -------------------------------------------------------------- compute
+    def compute(self) -> Any:
+        n = self.num_buckets
+        order = (self.cursor + 1 + jnp.arange(n, dtype=jnp.int32)) % n
+        buckets = {k: getattr(self, f"ring_{k}")[order] for k in self._inner_names}
+        counts = self.counts[order]
+        acc0 = {k: jnp.zeros_like(d) + d for k, d in self._inner_defaults.items()}
+
+        def step(carry, xs):
+            acc, seen = carry
+            bucket, c = xs
+            nonempty = c > 0
+            seen_new = seen + nonempty.astype(jnp.int32)
+            # count = #nonempty buckets so far: the running-mean merge law
+            # then weighs each bucket equally (and count=1 on the first
+            # live bucket drops the fold's default-state seed exactly)
+            merged = self._inner.pure_merge(
+                acc, bucket, count=jnp.maximum(seen_new, 1).astype(jnp.float32)
+            )
+            acc = {k: jnp.where(nonempty, merged[k], acc[k]) for k in acc}
+            return (acc, seen_new), None
+
+        (acc, _), _ = jax.lax.scan(step, (acc0, jnp.asarray(0, jnp.int32)), (buckets, counts))
+        if not isinstance(counts, jax.core.Tracer):
+            telemetry.emit("window", type(self).__name__, "compute",
+                           buckets=n, live=int(jnp.sum(counts)))
+        return self._inner.pure_compute(acc)
+
+
+class TumblingWindow(_StreamingWindow):
+    """Evaluate ``metric`` over non-overlapping windows of ``window`` updates.
+
+    Maintains a *current* accumulator and the snapshot of the last
+    *completed* window; when the current window fills, a traced predicate
+    swaps it into the snapshot and re-arms the accumulator — two copies of
+    the inner state, no ring. ``compute()`` evaluates the last completed
+    window (or the partial current one before any window has completed).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric
+        >>> from metrics_tpu.streaming import TumblingWindow
+        >>> w = TumblingWindow(SumMetric(), window=2, jit_update=False)
+        >>> for v in (1.0, 2.0, 4.0):
+        ...     w.update(jnp.asarray(v))
+        >>> float(w.compute())  # last completed window: 1 + 2
+        3.0
+    """
+
+    def __init__(self, metric: Metric, *, window: int, jit_update: bool = True, **kwargs: Any) -> None:
+        super().__init__(metric, jit_update=jit_update, **kwargs)
+        _check_inner(metric, "TumblingWindow")
+        window = int(window)
+        if window <= 0:
+            raise MetricsUserError(f"window must be positive, got {window}")
+        self.window = window
+        for k, d in self._inner_defaults.items():
+            red = metric._reductions[k]
+            self.add_state(f"cur_{k}", jnp.zeros_like(d) + d, dist_reduce_fx=red)
+            self.add_state(f"done_{k}", jnp.zeros_like(d) + d, dist_reduce_fx=red)
+        self.add_state("cur_count", jnp.asarray(0, jnp.int32), dist_reduce_fx="max")
+        self.add_state("done_count", jnp.asarray(0, jnp.int32), dist_reduce_fx="max")
+
+    def _step(self, new_cur: Dict[str, Array], gate: Array) -> None:
+        cnt = self.cur_count + gate.astype(jnp.int32)
+        full = jnp.logical_and(cnt >= self.window, gate)
+        for k in self._inner_names:
+            cur = jnp.where(gate, new_cur[k], getattr(self, f"cur_{k}"))
+            object.__setattr__(self, f"done_{k}", jnp.where(full, cur, getattr(self, f"done_{k}")))
+            object.__setattr__(self, f"cur_{k}", jnp.where(full, self._inner_defaults[k], cur))
+        self.done_count = jnp.where(full, cnt, self.done_count)
+        self.cur_count = jnp.where(full, 0, cnt)
+        if not isinstance(cnt, jax.core.Tracer):
+            telemetry.emit("window", type(self).__name__,
+                           "advance" if bool(full) else "update", window=self.window)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        cur = {k: getattr(self, f"cur_{k}") for k in self._inner_names}
+        self._step(self._inner.pure_update(cur, *args, **kwargs), jnp.asarray(True))
+
+    def _masked_update(self, sample_mask: Array, *args: Any, **kwargs: Any) -> None:
+        cur = {k: getattr(self, f"cur_{k}") for k in self._inner_names}
+        new_cur = self._inner._masked_pure_update(cur, sample_mask, *args, **kwargs)
+        self._step(new_cur, jnp.any(sample_mask))
+
+    def compute(self) -> Any:
+        use_done = self.done_count > 0
+        state = {
+            k: jnp.where(use_done, getattr(self, f"done_{k}"), getattr(self, f"cur_{k}"))
+            for k in self._inner_names
+        }
+        _emit_concrete(self.cur_count, "window", type(self).__name__, "compute", window=self.window)
+        return self._inner.pure_compute(state)
+
+
+class ExponentialDecay(_StreamingWindow):
+    """Exponentially-weighted ``metric``: O(1) state, smooth horizon.
+
+    Before each update every state leaf is scaled by
+    ``decay = 0.5 ** (1 / halflife)`` — a traced scalar multiply — so a
+    contribution ``halflife`` updates old carries half the weight of a
+    fresh one. Requires sum/mean-reduced float-compatible states (ratio
+    metrics like means, accuracies and moment-based scores); max/min
+    reductions are rejected. Integer leaves are re-declared as float32 so
+    the decay stays shape/dtype-stable under jit.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric
+        >>> from metrics_tpu.streaming import ExponentialDecay
+        >>> m = ExponentialDecay(MeanMetric(), halflife=10.0, jit_update=False)
+        >>> for v in (1.0, 2.0, 3.0):
+        ...     m.update(jnp.asarray(v))
+        >>> round(float(m.compute()), 3)  # recent updates weigh more
+        2.046
+    """
+
+    def __init__(self, metric: Metric, *, halflife: float, jit_update: bool = True, **kwargs: Any) -> None:
+        super().__init__(metric, jit_update=jit_update, **kwargs)
+        _check_inner(metric, "ExponentialDecay", allow_max_min=False)
+        halflife = float(halflife)
+        if not halflife > 0:
+            raise MetricsUserError(f"halflife must be positive, got {halflife}")
+        self.halflife = halflife
+        self.decay = float(0.5 ** (1.0 / halflife))
+        self._inner_defaults = {
+            k: (d if jnp.issubdtype(d.dtype, jnp.floating) else d.astype(jnp.float32))
+            for k, d in self._inner_defaults.items()
+        }
+        for k, d in self._inner_defaults.items():
+            self.add_state(f"ew_{k}", jnp.zeros_like(d) + d, dist_reduce_fx=metric._reductions[k])
+
+    def _decayed(self, gate: Array) -> Dict[str, Array]:
+        d = jnp.asarray(self.decay, jnp.float32)
+        return {
+            k: jnp.where(gate, d * getattr(self, f"ew_{k}"), getattr(self, f"ew_{k}"))
+            for k in self._inner_names
+        }
+
+    def _apply(self, new_state: Dict[str, Array], gate: Array) -> None:
+        for k in self._inner_names:
+            object.__setattr__(
+                self, f"ew_{k}", jnp.where(gate, new_state[k], getattr(self, f"ew_{k}"))
+            )
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        gate = jnp.asarray(True)
+        new = self._inner.pure_update(self._decayed(gate), *args, **kwargs)
+        self._apply(new, gate)
+        _emit_concrete(new[self._inner_names[0]], "window", type(self).__name__, "update",
+                       halflife=self.halflife)
+
+    def _masked_update(self, sample_mask: Array, *args: Any, **kwargs: Any) -> None:
+        gate = jnp.any(sample_mask)
+        new = self._inner._masked_pure_update(self._decayed(gate), sample_mask, *args, **kwargs)
+        self._apply(new, gate)
+
+    def compute(self) -> Any:
+        state = {k: getattr(self, f"ew_{k}") for k in self._inner_names}
+        _emit_concrete(state[self._inner_names[0]], "window", type(self).__name__, "compute",
+                       halflife=self.halflife)
+        return self._inner.pure_compute(state)

@@ -48,6 +48,18 @@ journal    one write-ahead-journal operation (:mod:`metrics_tpu.wal`):
            ``journal:bytes`` counter), ``replay`` (one recovery replay
            pass, with the replayed record count), ``truncate`` (retired
            segments removed at a checkpoint fence)
+window     one streaming-window operation (:mod:`metrics_tpu.streaming`):
+           kinds ``advance`` (ring cursor moved / tumbling bucket
+           sealed, with the landed ``cursor``), ``update`` (bucket
+           accumulate without an advance), ``compute`` (age-ordered
+           merge fold, with ``live`` bucket count), ``serve-compute``
+           (a :meth:`MetricsService.compute_window` read). Emitted only
+           on the eager path — traced updates stay silent by design
+sketch     one sketch-aggregator operation on the eager path
+           (:mod:`metrics_tpu.streaming.sketch`): kinds ``update`` /
+           ``compute``, owner = the sketch class name, with the sketch
+           geometry (``bins`` / ``registers`` / ``depth``+``width``) in
+           the attrs
 ========== ============================================================
 
 The serving admission layer reuses the ``degrade`` name for shed work:

@@ -19,6 +19,13 @@ Array = jax.Array
 class MetricTracker:
     """Track a metric (or collection) over multiple steps/epochs.
 
+    Keeps ONE full metric copy per ``increment()`` call — memory grows
+    with the number of tracked steps, and each snapshot accumulates from
+    its increment onward. For a bounded-memory "metric over the last N
+    updates" on a continuous stream, use
+    :class:`~metrics_tpu.streaming.SlidingWindow` instead (fixed ring of
+    partial states, engine-eligible; see ``docs/streaming.md``).
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import Accuracy

@@ -147,3 +147,61 @@ def test_mean_metric_broadcasting(weights, expected):
     values = jnp.arange(24.0).reshape(2, 3, 4)
     m = MeanMetric()
     assert float(m(values, weights)) == expected
+
+
+# ---- trace-safe nan strategies: eager/jit parity (the old boolean-indexing
+# path silently KEPT NaNs inside traced updates — the silent-leak fix)
+
+
+@pytest.mark.parametrize("nan_strategy", ["ignore", "warn", 5.0])
+@pytest.mark.parametrize("metric_cls", [MaxMetric, MinMetric, SumMetric, MeanMetric])
+@pytest.mark.parametrize("value", [_case_all_nan, _case_mixed], ids=["all_nan", "mixed"])
+def test_nan_strategy_eager_jit_parity(metric_cls, nan_strategy, value):
+    """The strategy's arithmetic must be identical under eager update and
+    jitted pure_update — jit drops only the warning, never the masking."""
+    import warnings
+
+    import jax
+
+    eager = metric_cls(nan_strategy=nan_strategy)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        eager.update(jnp.asarray(value))
+    jitted = metric_cls(nan_strategy=nan_strategy)
+    state = jax.jit(jitted.pure_update)(jitted.default_state(), jnp.asarray(value))
+    for k in state:
+        np.testing.assert_array_equal(np.asarray(state[k]), np.asarray(getattr(eager, k)))
+
+
+def test_nan_error_keeps_nan_visible_under_jit():
+    """'error' cannot raise inside a trace; the poisoned value must stay
+    NaN (visible downstream) rather than being silently dropped."""
+    import jax
+
+    m = SumMetric(nan_strategy="error")
+    state = jax.jit(m.pure_update)(m.default_state(), jnp.asarray(_case_mixed))
+    assert bool(jnp.isnan(state["value"]))
+
+
+def test_int_impute_accepted_at_construction():
+    """An int impute value is a fine float; it used to be rejected."""
+    m = SumMetric(nan_strategy=2)
+    assert m.nan_strategy == 2.0 and isinstance(m.nan_strategy, float)
+    m.update(jnp.asarray(_case_mixed))
+    assert float(m.compute()) == 14.0
+
+
+@pytest.mark.parametrize("bad", [True, None, [1.0], "weird"], ids=["bool", "none", "list", "string"])
+def test_invalid_nan_strategy_fails_at_construction(bad):
+    """Unknown strategies must die with the clear message at __init__ —
+    not opaquely at the first update."""
+    with pytest.raises(ValueError, match="Arg `nan_strategy` should"):
+        MeanMetric(nan_strategy=bad)
+
+
+def test_mean_array_weight_nan_drops_pair():
+    """A NaN in either lane drops the (value, weight) PAIR — the old
+    independent row-drops could desync value/weight for array weights."""
+    m = MeanMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([1.0, float("nan"), 1.0]))
+    assert float(m.compute()) == 2.0  # (1*1 + 3*1) / (1 + 1)

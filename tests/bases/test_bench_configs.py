@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 24
+    assert len(skipped) == 25
     assert "detail_elapsed_s" in detail
 
 
@@ -274,6 +274,22 @@ def test_crash_recovery_config_counts_and_keys(monkeypatch):
     assert detail["wal_append_bytes_per_record"] > 0
     assert detail["wal_replay_us_200_tail"] > 0
     assert detail["wal_replay_records"] == 200  # every journaled record replayed
+
+
+def test_streaming_config_counts_and_keys():
+    """Pin the streaming bench config at test-budget scale: the structural
+    claims are 'a SlidingWindow stream is one cached dispatch per step and
+    ZERO retraces after the warmup compile' (the traced ring cursor keeps
+    every leaf shape fixed) and 'a 2-replica QuantileSketch sync is exactly
+    ONE packed collective' (one fixed-shape float32-sum leaf — the fused
+    engine needs no streaming-specific handling)."""
+    detail = {}
+    bench._cfg_streaming(detail, steps=40)
+    assert detail["window_retraces_1k_steps"] == 0
+    assert detail["window_dispatches_1k_steps"] == 40
+    assert detail["window_advance_us"] > 0
+    assert detail["sketch_sync_collectives_2replica"] == 1
+    assert detail["sketch_sync_bytes_2replica"] > 0
 
 
 def test_cg_configs_record_host_pinning():

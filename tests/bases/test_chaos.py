@@ -468,3 +468,93 @@ def test_wal_off_restores_checkpoint_only_semantics(tmp_path, monkeypatch):
     )
     snap = fresh.telemetry_snapshot()
     assert snap["wal"] is None
+
+
+# ----------------------------------------------------------- streaming lane
+def _streaming_builds():
+    """One engine-eligible instance per streaming class, all fed the same
+    float batches (windows wrap FloatSum so the fault parity check sees
+    float state, same reasoning as the top of this file)."""
+    from metrics_tpu.streaming import (
+        CountMinHeavyHitters,
+        ExponentialDecay,
+        HyperLogLog,
+        QuantileSketch,
+        SlidingWindow,
+        TumblingWindow,
+    )
+
+    return {
+        "sliding": lambda: SlidingWindow(FloatSum(), window=4, slide=2, jit_update=True),
+        "tumbling": lambda: TumblingWindow(FloatSum(), window=3, jit_update=True),
+        "decay": lambda: ExponentialDecay(FloatSum(), halflife=4.0, jit_update=True),
+        "quantile": lambda: QuantileSketch(bins=64, jit_update=True),
+        "hll": lambda: HyperLogLog(precision=5, jit_update=True),
+        "cms": lambda: CountMinHeavyHitters(depth=2, width=64, jit_update=True),
+    }
+
+
+@pytest.mark.parametrize("name", sorted(_streaming_builds()))
+def test_streaming_launch_fault_degrades_to_eager_parity(name):
+    """A launch fault mid-stream (mid-window-advance for the ring: slide=2
+    over 6 updates crosses three bucket boundaries) must degrade to the
+    eager path with every state leaf bit-identical to a never-faulted run —
+    ring cursor, bucket counts and sketch tables included."""
+    build = _streaming_builds()[name]
+    batches = _batches(n=6)
+
+    ref = build()
+    for v in batches:
+        ref.update(v)
+
+    m = build()
+    with telemetry.instrument() as t, faults.inject("launch") as spec:
+        for v in batches:
+            m.update(v)
+    assert spec.fired >= 1, "fault never reached its injection point"
+
+    for k in ref.default_state():
+        np.testing.assert_array_equal(np.asarray(getattr(m, k)), np.asarray(getattr(ref, k)))
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+    spans = t.spans(name="degrade", kind="dispatch")
+    assert spans and "injected:launch" in {e.attrs["cause"] for e in spans}
+    assert m.dispatch_stats["demotions"] >= 1 and not m.dispatch_stats["permanent"]
+
+
+@pytest.mark.parametrize("name", ["quantile", "hll", "cms"])
+def test_sketch_checkpoint_corruption_raises_not_loads(name):
+    """A byte-flipped sketch state entry must make load_state_dict raise
+    StateCorruptionError (crc32 verification) instead of silently serving
+    estimates from a corrupted table."""
+    from metrics_tpu.resilience import CHECKSUM_PREFIX, StateCorruptionError
+
+    build = _streaming_builds()[name]
+    m = build()
+    m.persistent(True)
+    for v in _batches(n=2):
+        m.update(v)
+    payload = m.state_dict()
+    assert any(str(k).startswith(CHECKSUM_PREFIX) for k in payload)
+
+    clean = build()
+    clean.load_state_dict(dict(payload))
+    np.testing.assert_array_equal(np.asarray(clean.value), np.asarray(m.value))
+
+    fresh = build()
+    with pytest.raises(StateCorruptionError):
+        fresh.load_state_dict(faults.corrupt_payload(dict(payload)))
+
+
+def test_window_checkpoint_corruption_raises_not_loads():
+    """Same integrity fence for a window wrapper's ring state."""
+    from metrics_tpu.resilience import StateCorruptionError
+    from metrics_tpu.streaming import SlidingWindow
+
+    m = SlidingWindow(FloatSum(), window=4, slide=2, jit_update=False)
+    m.persistent(True)
+    for v in _batches(n=5):
+        m.update(v)
+    payload = m.state_dict()
+    fresh = SlidingWindow(FloatSum(), window=4, slide=2, jit_update=False)
+    with pytest.raises(StateCorruptionError):
+        fresh.load_state_dict(faults.corrupt_payload(dict(payload)))

@@ -39,6 +39,9 @@ _WRAPPED = {  # wrappers: construct around a simple base metric
     "PermutationInvariantTraining": lambda cls: cls(
         metrics_tpu.functional.scale_invariant_signal_noise_ratio, "max"
     ),
+    "SlidingWindow": lambda cls: cls(metrics_tpu.MeanSquaredError(), window=4, slide=2),
+    "TumblingWindow": lambda cls: cls(metrics_tpu.MeanSquaredError(), window=4),
+    "ExponentialDecay": lambda cls: cls(metrics_tpu.MeanSquaredError(), halflife=8.0),
 }
 _ABSTRACT = {"Metric", "RetrievalMetric", "BaseAggregator", "CompositionalMetric"}
 

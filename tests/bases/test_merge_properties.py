@@ -1,0 +1,147 @@
+"""Property-style coverage of the ``pure_merge`` algebra.
+
+``pure_merge`` is the primitive every aggregation layer leans on — the
+fused sync engine, windowed compute folds, and checkpoint reconciliation
+all assume the declared reductions behave like the algebra they name:
+
+* **identity**: merging a fresh default state into a partial one (with
+  ``count`` covering only the partial's updates) is a bitwise no-op for
+  sum/max/min/cat reductions;
+* **commutativity**: sum/max/min merges are order-independent (integer
+  count states bitwise; float sums to fp tolerance);
+* **associativity**: any bucketing of a stream merges to the same value
+  (exact for integer-count states).
+
+The mean reduction is deliberately NOT commutative — it is the running
+formula ``((count-1)*a + b)/count``, asymmetric by construction — so the
+test pins the documented direction instead (fold semantics: ``a`` is the
+accumulator, ``b`` the increment).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MaxMetric, MeanSquaredError, MinMetric, StatScores, SumMetric
+
+_C = 4
+
+
+def _states(metric, updates):
+    """One partial state per update batch, via the pure API."""
+    return [metric.pure_update(metric.default_state(), *u) for u in updates]
+
+
+def _batches(seed, n=3):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(8, _C).astype(np.float32)),
+            jnp.asarray(rng.randint(0, _C, 8)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _reg_batches(seed, n=3):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(8).astype(np.float32)),
+            jnp.asarray(rng.rand(8).astype(np.float32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _agg_batches(seed, n=3):
+    rng = np.random.RandomState(seed)
+    return [(jnp.asarray(rng.randn(8).astype(np.float32)),) for _ in range(n)]
+
+
+_CASES = [
+    ("accuracy", lambda: Accuracy(num_classes=_C, average="macro"), _batches),
+    ("stat_scores", lambda: StatScores(num_classes=_C, reduce="macro"), _batches),
+    ("sum", SumMetric, _agg_batches),
+    ("max", MaxMetric, _agg_batches),
+    ("min", MinMetric, _agg_batches),
+]
+
+
+@pytest.mark.parametrize("build,make_batches", [c[1:] for c in _CASES], ids=[c[0] for c in _CASES])
+def test_merge_identity_with_fresh_state(build, make_batches):
+    """default_state is the neutral element: merging it in (count=1, the
+    partial's own update count) changes nothing, bit for bit."""
+    m = build()
+    (s1,) = _states(m, make_batches(0, n=1))
+    for merged in (
+        m.pure_merge(m.default_state(), s1, count=1),
+        m.pure_merge(s1, m.default_state(), count=1),
+    ):
+        for k in s1:
+            np.testing.assert_array_equal(np.asarray(merged[k]), np.asarray(s1[k]))
+
+
+@pytest.mark.parametrize("build,make_batches", [c[1:] for c in _CASES], ids=[c[0] for c in _CASES])
+def test_merge_commutative(build, make_batches):
+    m = build()
+    s1, s2 = _states(m, make_batches(1, n=2))
+    ab = m.pure_merge(s1, s2, count=2)
+    ba = m.pure_merge(s2, s1, count=2)
+    for k in ab:
+        np.testing.assert_allclose(np.asarray(ab[k]), np.asarray(ba[k]), rtol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "build,make_batches",
+    [c[1:] for c in _CASES if c[0] != "sum"] + [(SumMetric, _agg_batches)],
+    ids=[c[0] for c in _CASES if c[0] != "sum"] + ["sum"],
+)
+def test_merge_associative(build, make_batches):
+    """(s1+s2)+s3 == s1+(s2+s3) — exact for integer-count states, fp
+    tolerance for float sums."""
+    m = build()
+    s1, s2, s3 = _states(m, make_batches(2, n=3))
+    left = m.pure_merge(m.pure_merge(s1, s2, count=2), s3, count=3)
+    right = m.pure_merge(s1, m.pure_merge(s2, s3, count=2), count=3)
+    for k in left:
+        if np.issubdtype(np.asarray(left[k]).dtype, np.integer):
+            np.testing.assert_array_equal(np.asarray(left[k]), np.asarray(right[k]))
+        else:
+            np.testing.assert_allclose(np.asarray(left[k]), np.asarray(right[k]), rtol=1e-5)
+
+
+def test_merge_fold_equals_streamed_updates():
+    """Merging per-batch partial states left-to-right equals one metric
+    that saw every batch — the exact contract the SlidingWindow compute
+    fold and the serve checkpoint reconciliation rely on."""
+    batches = _batches(3, n=4)
+    m = Accuracy(num_classes=_C, average="macro")
+    partials = _states(m, batches)
+    acc = partials[0]
+    for i, s in enumerate(partials[1:], start=2):
+        acc = m.pure_merge(acc, s, count=i)
+    streamed = Accuracy(num_classes=_C, average="macro")
+    for b in batches:
+        streamed.update(*b)
+    np.testing.assert_array_equal(
+        np.asarray(m.pure_compute(acc)), np.asarray(streamed.compute())
+    )
+
+
+def test_merge_mean_running_formula_pinned():
+    """The mean reduction is the RUNNING formula, not a symmetric average:
+    ((count-1)*a + b)/count. MeanSquaredError is mean-reduced via its
+    update count; three batches folded with growing count equal the
+    streamed metric to fp tolerance."""
+    batches = _reg_batches(4, n=3)
+    m = MeanSquaredError()
+    partials = _states(m, batches)
+    acc = partials[0]
+    for i, s in enumerate(partials[1:], start=2):
+        acc = m.pure_merge(acc, s, count=i)
+    streamed = MeanSquaredError()
+    for b in batches:
+        streamed.update(*b)
+    np.testing.assert_allclose(
+        np.asarray(m.pure_compute(acc)), np.asarray(streamed.compute()), rtol=1e-6
+    )

@@ -1,0 +1,182 @@
+"""Sketch aggregator coverage (metrics_tpu/streaming/sketch.py).
+
+Accuracy bounds per sketch (DDSketch relative error, HLL standard error,
+count-min never-underestimate), eager/jit parity, and the acceptance
+pin: a 2-replica fused sync of a sketch is exactly ONE packed collective
+per (dtype, op) bucket — the fixed-shape states ride the existing sync
+engine with zero streaming-specific handling.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import profiling
+from metrics_tpu.parallel.dist_env import NoOpEnv
+from metrics_tpu.streaming import CountMinHeavyHitters, HyperLogLog, QuantileSketch
+
+
+class Loopback2(NoOpEnv):
+    """World-2 env where every collective sees this process's state twice."""
+
+    def world_size(self):
+        return 2
+
+    def all_gather(self, x):
+        x = jnp.atleast_1d(x)
+        return [x, x]
+
+    def all_reduce(self, x, op):
+        stacked = jnp.stack([jnp.atleast_1d(x)] * 2)
+        red = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}.get(op)
+        return None if red is None else red(stacked, axis=0)
+
+
+# -------------------------------------------------------------- quantile
+def test_quantile_sketch_relative_error_bound():
+    rng = np.random.RandomState(0)
+    data = (np.abs(rng.randn(20000)) * 50 + 1).astype(np.float32)
+    s = QuantileSketch(alpha=0.01)
+    for chunk in np.split(data, 10):  # streamed in chunks, same answer
+        s.update(jnp.asarray(chunk))
+    for q in (0.05, 0.25, 0.5, 0.75, 0.95, 0.99):
+        got = float(s.quantile(q))
+        want = float(np.quantile(data, q))
+        assert abs(got - want) / want < 0.02, (q, got, want)
+
+
+def test_quantile_sketch_signs_and_zero():
+    s = QuantileSketch()
+    s.update(jnp.asarray([-10.0, -10.0, 0.0, 10.0, 10.0]))
+    assert float(s.quantile(0.0)) < 0
+    assert float(s.quantile(1.0)) > 0
+    np.testing.assert_allclose(float(s.quantile(0.5)), 0.0, atol=1e-6)
+
+
+def test_quantile_sketch_empty_is_nan():
+    with pytest.warns(UserWarning, match="called before"):
+        assert bool(jnp.isnan(QuantileSketch().compute()))
+
+
+def test_quantile_vector_ranks():
+    s = QuantileSketch()
+    s.update(jnp.asarray(np.linspace(1, 100, 1000, dtype=np.float32)))
+    vals = s.quantile(jnp.asarray([0.1, 0.5, 0.9]))
+    assert vals.shape == (3,)
+    assert float(vals[0]) < float(vals[1]) < float(vals[2])
+
+
+def test_quantile_nan_values_masked_out():
+    import warnings
+
+    s = QuantileSketch(nan_strategy="ignore")
+    s.update(jnp.asarray([np.nan, 5.0, np.nan]))
+    assert float(jnp.sum(s.value)) == 1.0  # only the real value counted
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        st = jax.jit(s.pure_update)(s.default_state(), jnp.asarray([np.nan, 5.0, np.nan]))
+    np.testing.assert_array_equal(np.asarray(st["value"]), np.asarray(s.value))
+
+
+# ------------------------------------------------------------------- hll
+def test_hll_error_within_three_sigma():
+    rng = np.random.RandomState(1)
+    h = HyperLogLog(precision=10)  # sigma ~ 1.04/sqrt(1024) ~ 3.3%
+    keys = rng.randint(0, 5000, 30000).astype(np.float32)
+    h.update(jnp.asarray(keys))
+    true = len(np.unique(keys))
+    assert abs(float(h.compute()) - true) / true < 0.10
+
+
+def test_hll_small_cardinality_linear_counting():
+    h = HyperLogLog(precision=10)
+    h.update(jnp.asarray(np.arange(20, dtype=np.float32)))
+    assert abs(float(h.compute()) - 20) <= 2
+
+
+def test_hll_duplicates_do_not_inflate():
+    h = HyperLogLog()
+    h.update(jnp.asarray([7.0] * 1000))
+    assert float(h.compute()) <= 2
+
+
+def test_hll_register_max_is_union():
+    """Syncing via register-wise max equals a sketch that saw both streams —
+    the property that makes dist_reduce_fx='max' THE merge."""
+    rng = np.random.RandomState(2)
+    a_keys = rng.randint(0, 1000, 5000).astype(np.float32)
+    b_keys = rng.randint(500, 1500, 5000).astype(np.float32)
+    a, b, u = HyperLogLog(), HyperLogLog(), HyperLogLog()
+    a.update(jnp.asarray(a_keys))
+    b.update(jnp.asarray(b_keys))
+    u.update(jnp.asarray(np.concatenate([a_keys, b_keys])))
+    merged = jnp.maximum(a.value, b.value)
+    np.testing.assert_array_equal(np.asarray(merged), np.asarray(u.value))
+
+
+# ------------------------------------------------------------- count-min
+def test_cms_never_underestimates_and_is_tight_when_sparse():
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 50, 2000).astype(np.float32)
+    c = CountMinHeavyHitters(depth=4, width=1024)
+    c.update(jnp.asarray(keys))
+    uniq, true_counts = np.unique(keys, return_counts=True)
+    est = np.asarray(c.estimate(jnp.asarray(uniq.astype(np.float32))))
+    assert (est >= true_counts - 1e-6).all()  # upper bound, never under
+    assert (est == true_counts).mean() > 0.9  # 50 keys in 1024 cols: mostly exact
+
+
+def test_cms_weighted_updates():
+    c = CountMinHeavyHitters()
+    c.update(jnp.asarray([7.0, 3.0]), weight=jnp.asarray([2.5, 0.5]))
+    est = np.asarray(c.estimate(jnp.asarray([7.0, 3.0])))
+    np.testing.assert_allclose(est, [2.5, 0.5])
+    np.testing.assert_allclose(float(c.compute()), 3.0)
+
+
+def test_cms_jit_parity():
+    rng = np.random.RandomState(4)
+    keys = jnp.asarray(rng.randint(0, 100, 500).astype(np.float32))
+    c = CountMinHeavyHitters(depth=2, width=128)
+    c.update(keys)
+    st = jax.jit(c.pure_update)(c.default_state(), keys)
+    np.testing.assert_array_equal(np.asarray(st["value"]), np.asarray(c.value))
+
+
+# ------------------------------------------------------------------ sync
+@pytest.mark.parametrize(
+    "build",
+    [
+        lambda: QuantileSketch(bins=64),
+        lambda: HyperLogLog(precision=6),
+        lambda: CountMinHeavyHitters(depth=2, width=64),
+    ],
+    ids=["quantile", "hll", "cms"],
+)
+def test_sketch_sync_is_one_packed_collective(build):
+    """Acceptance pin: a 2-replica sketch sync is exactly ONE collective
+    (one fixed-shape leaf, one (dtype, op) bucket), and the merged value
+    equals the self-merge of the loopback env (sum doubles, max is a
+    fixed point)."""
+    rng = np.random.RandomState(5)
+    s = build()
+    s.update(jnp.asarray(rng.rand(256).astype(np.float32) * 100))
+    before = np.asarray(s.value)
+    with profiling.track_syncs() as t:
+        s.sync(env=Loopback2())
+    assert t.collectives == 1
+    reduce_op = "max" if isinstance(s, HyperLogLog) else "sum"
+    want = before if reduce_op == "max" else 2 * before
+    np.testing.assert_array_equal(np.asarray(s.value), want)
+    s.unsync()
+    np.testing.assert_array_equal(np.asarray(s.value), before)
+
+
+def test_sketch_masked_update_padded_lane_is_noop():
+    rng = np.random.RandomState(6)
+    vals = jnp.asarray(rng.rand(32).astype(np.float32))
+    for s in (QuantileSketch(bins=32), HyperLogLog(precision=4), CountMinHeavyHitters(depth=2, width=32)):
+        s.update(vals)
+        before = np.asarray(s.value)
+        s._masked_update(jnp.zeros(32, bool), vals)
+        np.testing.assert_array_equal(np.asarray(s.value), before)

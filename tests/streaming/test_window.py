@@ -1,0 +1,230 @@
+"""Windowed wrapper coverage (metrics_tpu/streaming/window.py).
+
+The two acceptance pins of the streaming subsystem live here: (1) a
+1k-step ``SlidingWindow(Accuracy, window=64)`` stream is ZERO retraces
+after the warmup compile and every state leaf keeps a fixed shape
+(jaxpr-verified through ``jax.eval_shape``); (2) windowed results are
+**bit-identical** to an oracle that rebuilds a fresh inner metric from
+the window's raw updates (exact for slide=1; at slide>1 the oracle
+replays the wrapper's bucket bookkeeping so fp grouping matches).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MeanSquaredError,
+    SumMetric,
+    profiling,
+)
+from metrics_tpu.streaming import ExponentialDecay, SlidingWindow, TumblingWindow
+from metrics_tpu.utilities.exceptions import MetricsUserError
+
+_C = 4
+
+
+def _acc():
+    return Accuracy(num_classes=_C, average="macro")
+
+
+def _batch(rng, b=8):
+    return (
+        jnp.asarray(rng.rand(b, _C).astype(np.float32)),
+        jnp.asarray(rng.randint(0, _C, b)),
+    )
+
+
+# --------------------------------------------------------------- sliding
+def test_sliding_sum_matches_oracle_slide1():
+    """slide=1: the value over floats is bit-identical to a fresh metric
+    fed exactly the last `window` updates (the fold adds exact 0.0
+    defaults and accumulates in stream order)."""
+    w = SlidingWindow(SumMetric(), window=3, jit_update=False)
+    vals = [1.1, 2.2, 4.4, 8.8, 17.6, 0.3]
+    for i, v in enumerate(vals):
+        w.update(jnp.asarray(v))
+        oracle = SumMetric()
+        for u in vals[max(0, i - 2): i + 1]:
+            oracle.update(jnp.asarray(u))
+        np.testing.assert_array_equal(np.asarray(w.compute()), np.asarray(oracle.compute()))
+
+
+def test_sliding_accuracy_matches_oracle_slide2():
+    """slide>1: integer-count states (Accuracy tp/fp/...) are exact under
+    any grouping, so the oracle replays the wrapper's bucket layout and
+    the confusion counts must agree bitwise at every step."""
+    rng = np.random.RandomState(0)
+    n_buckets, slide = 2, 2
+    w = SlidingWindow(_acc(), window=4, slide=slide, jit_update=False)
+    cursor, in_bucket = 0, 0
+    buckets = [[] for _ in range(n_buckets)]
+    for _ in range(9):
+        p, t = _batch(rng)
+        if in_bucket >= slide:
+            cursor = (cursor + 1) % n_buckets
+            buckets[cursor] = []
+            in_bucket = 0
+        buckets[cursor].append((p, t))
+        in_bucket += 1
+        w.update(p, t)
+        oracle = _acc()
+        for b in [(cursor + 1 + j) % n_buckets for j in range(n_buckets)]:
+            for pp, tt in buckets[b]:
+                oracle.update(pp, tt)
+        np.testing.assert_array_equal(np.asarray(w.compute()), np.asarray(oracle.compute()))
+
+
+def test_sliding_zero_retraces_1k_steps_and_fixed_leaf_shapes():
+    """Acceptance pin: after the warmup compile, 1000 engine updates of
+    SlidingWindow(Accuracy, window=64) are 1000 cached dispatches and
+    ZERO retraces, and pure_update's output avals equal its input avals
+    (the jaxpr proof that the ring never changes shape)."""
+    rng = np.random.RandomState(1)
+    w = SlidingWindow(_acc(), window=64, jit_update=True)
+    p, t = _batch(rng, b=16)
+    w.update(p, t)  # warmup compile
+    jax.block_until_ready(w.cursor)
+    with profiling.track_dispatches() as tr:
+        for _ in range(1000):
+            w.update(p, t)
+        jax.block_until_ready(w.cursor)
+    assert tr.retrace_count() == 0
+    assert tr.dispatch_count() == 1000
+
+    state = w.default_state()
+    out = jax.eval_shape(w.pure_update, state, p, t)
+    assert {k: (v.shape, v.dtype) for k, v in out.items()} == {
+        k: (v.shape, v.dtype) for k, v in state.items()
+    }
+
+
+def test_sliding_jit_pure_update_matches_eager():
+    rng = np.random.RandomState(2)
+    w = SlidingWindow(_acc(), window=4, slide=2, jit_update=False)
+    state = w.default_state()
+    jit_up = jax.jit(w.pure_update)
+    for _ in range(6):
+        p, t = _batch(rng)
+        state = jit_up(state, p, t)
+        w.update(p, t)
+    for k in state:
+        np.testing.assert_array_equal(np.asarray(state[k]), np.asarray(getattr(w, k)))
+
+
+def test_sliding_masked_update_padded_lane_is_noop():
+    """A fully-padded serve lane must neither advance the cursor nor count
+    an update — the stacked launcher vmaps _masked_update over real and
+    padded rows alike."""
+    rng = np.random.RandomState(3)
+    w = SlidingWindow(_acc(), window=2, jit_update=False)
+    p, t = _batch(rng)
+    w.update(p, t)
+    before = {k: np.asarray(getattr(w, k)) for k in w.default_state()}
+    w._masked_update(jnp.zeros(p.shape[0], bool), p, t)
+    for k, v in before.items():
+        np.testing.assert_array_equal(np.asarray(getattr(w, k)), v)
+
+
+def test_sliding_forward_batch_value_matches_fresh_metric():
+    """full_state_update=True: forward's batch value is the inner metric
+    evaluated on just this batch."""
+    rng = np.random.RandomState(4)
+    w = SlidingWindow(_acc(), window=4, jit_update=False)
+    p, t = _batch(rng)
+    batch_val = w.forward(p, t)
+    fresh = _acc()
+    fresh.update(p, t)
+    np.testing.assert_allclose(np.asarray(batch_val), np.asarray(fresh.compute()), rtol=1e-6)
+
+
+def test_sliding_reset_restores_defaults():
+    rng = np.random.RandomState(5)
+    w = SlidingWindow(_acc(), window=2, jit_update=False)
+    w.update(*_batch(rng))
+    w.reset()
+    for k, v in w.default_state().items():
+        np.testing.assert_array_equal(np.asarray(getattr(w, k)), np.asarray(v))
+
+
+# -------------------------------------------------------------- tumbling
+def test_tumbling_semantics():
+    w = TumblingWindow(SumMetric(), window=2, jit_update=False)
+    w.update(jnp.asarray(1.0))
+    assert float(w.compute()) == 1.0  # partial current window before any completes
+    w.update(jnp.asarray(2.0))
+    assert float(w.compute()) == 3.0  # first window sealed
+    w.update(jnp.asarray(4.0))
+    assert float(w.compute()) == 3.0  # still the last COMPLETED window
+    w.update(jnp.asarray(8.0))
+    assert float(w.compute()) == 12.0  # second window sealed
+
+
+def test_tumbling_jit_parity():
+    rng = np.random.RandomState(6)
+    w = TumblingWindow(_acc(), window=3, jit_update=False)
+    state = w.default_state()
+    jit_up = jax.jit(w.pure_update)
+    for _ in range(7):
+        p, t = _batch(rng)
+        state = jit_up(state, p, t)
+        w.update(p, t)
+    for k in state:
+        np.testing.assert_array_equal(np.asarray(state[k]), np.asarray(getattr(w, k)))
+
+
+# ----------------------------------------------------------------- decay
+def test_decay_matches_closed_form():
+    m = ExponentialDecay(MeanMetric(), halflife=10.0, jit_update=False)
+    d = 0.5 ** (1.0 / 10.0)
+    num = den = 0.0
+    for v in (1.0, 2.0, 3.0, -1.0):
+        m.update(jnp.asarray(v))
+        num = d * num + v
+        den = d * den + 1.0
+    np.testing.assert_allclose(float(m.compute()), num / den, rtol=1e-6)
+
+
+def test_decay_recent_updates_dominate():
+    m = ExponentialDecay(MeanSquaredError(), halflife=2.0, jit_update=False)
+    rng = np.random.RandomState(7)
+    t = jnp.asarray(rng.rand(16).astype(np.float32))
+    for _ in range(20):
+        m.update(t + 1.0, t)  # old regime: error 1.0
+    for _ in range(20):
+        m.update(t, t)  # new regime: error 0.0
+    assert float(m.compute()) < 0.01  # halflife 2 -> old regime decayed away
+
+
+# ------------------------------------------------------------ validation
+def test_wrappers_reject_list_state_inner():
+    with pytest.raises(MetricsUserError, match="list state"):
+        SlidingWindow(CatMetric(), window=4)
+
+
+def test_sliding_rejects_bad_geometry():
+    with pytest.raises(MetricsUserError, match="positive multiple"):
+        SlidingWindow(SumMetric(), window=5, slide=2)
+
+
+def test_decay_rejects_max_min_reductions():
+    with pytest.raises(MetricsUserError, match="max/min"):
+        ExponentialDecay(MaxMetric(), halflife=4.0)
+
+
+def test_wrappers_reject_non_metric():
+    with pytest.raises(MetricsUserError, match="expects a Metric"):
+        TumblingWindow(lambda: None, window=4)
+
+
+def test_inner_spec_distinguishes_configs():
+    """The AOT persistent-cache namespace must see different inner metrics
+    (the inner lives under an underscore attr, which owner_namespace
+    skips — inner_spec is the public mirror)."""
+    a = SlidingWindow(_acc(), window=4)
+    b = SlidingWindow(Accuracy(num_classes=8, average="macro"), window=4)
+    assert a.inner_spec != b.inner_spec

@@ -148,6 +148,28 @@ def summarize(events: List[Dict[str, Any]]) -> str:
             + "   ".join(f"{k}: {by_jkind.get(k, 0)}" for k in ("append", "replay", "truncate"))
             + f"   bytes appended: {jbytes}   records replayed: {replayed}"
         )
+    # streaming subsystem (metrics_tpu.streaming): ring advances vs plain
+    # bucket accumulates, window reads with live-bucket counts, and sketch
+    # traffic by class — all eager-path spans (traced streams are silent)
+    windows = [e for e in events if e["name"] == "window"]
+    if windows:
+        by_wkind: Dict[str, int] = {}
+        for e in windows:
+            by_wkind[e.get("kind", "?")] = by_wkind.get(e.get("kind", "?"), 0) + 1
+        lines.append("")
+        lines.append(
+            "window ops: "
+            + "   ".join(
+                f"{k}: {by_wkind.get(k, 0)}"
+                for k in ("advance", "update", "compute", "serve-compute")
+            )
+        )
+    sketches = [e for e in events if e["name"] == "sketch"]
+    if sketches:
+        by_owner: Dict[str, int] = {}
+        for e in sketches:
+            by_owner[e.get("owner", "?")] = by_owner.get(e.get("owner", "?"), 0) + 1
+        lines.append("sketch ops: " + "   ".join(f"{o}: {n}" for o, n in sorted(by_owner.items())))
     degrades = [
         e for e in events
         if e["name"] == "degrade" and e.get("kind") in ("admission", "session")
