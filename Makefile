@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos crash doctest audit bench bench-forward serve-bench stream-bench trace tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos crash doctest audit bench bench-forward serve-bench stream-bench trace slo tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -108,6 +108,14 @@ stream-bench:
 # Leaves /tmp/metrics_tpu_trace.trace.json for Perfetto (ui.perfetto.dev).
 trace:
 	python tools/trace_report.py --bench /tmp/metrics_tpu_trace.jsonl
+
+# serving flight-recorder demo: a short mixed multi-tenant workload (incl.
+# a shed burst), then the live per-tenant SLO percentiles, health gauges,
+# and state-memory attribution, plus the request-latency trace summary.
+# Leaves /tmp/metrics_tpu_slo.trace.json for Perfetto (request spans are
+# linked submit -> launch -> retire by flow arrows).
+slo:
+	python tools/trace_report.py --slo /tmp/metrics_tpu_slo.jsonl
 
 clean:
 	rm -rf .pytest_cache

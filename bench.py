@@ -489,6 +489,77 @@ def _cfg_telemetry_overhead(detail: dict) -> None:
             detail[f"telemetry_retrace_cause_{key.rsplit(':', 1)[1]}"] = int(count)
 
 
+def _cfg_request_tracing(detail: dict, sessions: int = 64, reps: int = 3, loops: int = 4) -> None:
+    """Idle + per-request cost of the serving flight recorder.
+
+    The request flight recorder (:mod:`metrics_tpu.serve`) rides every
+    ``submit()``: a request id mint, an always-recorded enqueue timestamp,
+    and per-stage timing folded into the per-tenant SLO sketches at
+    retirement. Its claim is "costs nothing when nobody is listening":
+    with no subscriber the only additions over the telemetry-off state are
+    one counter increment and two monotonic clock reads per request. This
+    config times a warm steady-state submit+flush loop (``sessions``
+    submits coalesced per flush) with the telemetry engine killed
+    (``METRICS_TPU_TELEMETRY=0``), enabled-but-idle (the default), and
+    under a live ``instrument()`` subscriber, pinning the idle/off ratio
+    as the structural key plus the exactly-one-span-per-submit invariant
+    on the instrumented pass."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, telemetry
+    from metrics_tpu.serve import MetricsService
+
+    rng = np.random.RandomState(31)
+    C = 8
+    svc = MetricsService(Accuracy(task="multiclass", num_classes=C))
+    batches = [
+        (jnp.asarray(rng.randint(0, C, 64)), jnp.asarray(rng.randint(0, C, 64)))
+        for _ in range(sessions)
+    ]
+
+    def step():
+        for i, (p, tg) in enumerate(batches):
+            svc.submit(f"tenant-{i}", p, tg)
+        svc.flush()
+
+    step()
+    svc.drain()  # compile the stacked program before timing
+
+    def timed():
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(loops):
+                step()
+            svc.drain()
+            best = min(best, (time.perf_counter() - t0) / (loops * sessions) * 1e6)
+        return round(best, 2)
+
+    prev = os.environ.get("METRICS_TPU_TELEMETRY")
+    os.environ["METRICS_TPU_TELEMETRY"] = "0"
+    try:
+        detail["request_tracing_off_submit_us"] = timed()
+    finally:
+        if prev is None:
+            os.environ.pop("METRICS_TPU_TELEMETRY", None)
+        else:
+            os.environ["METRICS_TPU_TELEMETRY"] = prev
+
+    detail["request_tracing_idle_submit_us"] = timed()
+    submits_before = svc.stats["submits"]
+    with telemetry.instrument() as session:
+        detail["request_tracing_instrumented_submit_us"] = timed()
+    request_spans = len(session.spans(name="request"))
+    detail["request_tracing_spans_per_submit"] = round(
+        request_spans / max(svc.stats["submits"] - submits_before, 1), 3
+    )
+    detail["request_tracing_idle_overhead_ratio"] = round(
+        detail["request_tracing_idle_submit_us"]
+        / max(detail["request_tracing_off_submit_us"], 1e-9),
+        3,
+    )
+
+
 def _cfg_resilience_overhead(detail: dict) -> None:
     """Idle cost of the resilience engine on the fused forward path.
 
@@ -1368,6 +1439,7 @@ def _bench_detail() -> dict:
         ("serve_updates_per_sec_1k_sessions", _cfg_serving),
         ("wal_append_overhead_ratio", _cfg_crash_recovery),
         ("window_advance_us", _cfg_streaming),
+        ("request_tracing_idle_overhead_ratio", _cfg_request_tracing),
     ]
     detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
     return detail
@@ -1588,6 +1660,7 @@ def _bench_detail_fast() -> dict:
         ("resilience_overhead", _cfg_resilience_overhead),
         ("serving", _cfg_serving),
         ("crash_recovery", lambda d: _cfg_crash_recovery(d, sessions=32, steps=2, tail=200)),
+        ("request_tracing", lambda d: _cfg_request_tracing(d, sessions=32, reps=2, loops=3)),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),

@@ -650,11 +650,31 @@ class MetricCollection:
         ``Metric.sync_stats`` instead."""
         return dict(self._sync_stats)
 
+    def memory_snapshot(self, top_n: int = 10) -> Dict[str, Any]:
+        """Aggregated per-leaf state-byte attribution across every member:
+        leaves are named ``"<member>/<state>"``; ``total_bytes`` is exact
+        over all members' leaves, ``leaves`` holds the ``top_n`` largest
+        (same shape as :meth:`Metric.memory_snapshot`)."""
+        leaves: List[Dict[str, Any]] = []
+        total = 0
+        for name, m in self.items(keep_base=True):
+            member = m.memory_snapshot(top_n=len(m._defaults))
+            total += member["total_bytes"]
+            for leaf in member["leaves"]:
+                leaves.append({**leaf, "name": f"{name}/{leaf['name']}"})
+        leaves.sort(key=lambda leaf: (-leaf["nbytes"], leaf["name"]))
+        return {
+            "total_bytes": total,
+            "leaf_count": len(leaves),
+            "leaves": leaves[: max(0, int(top_n))],
+        }
+
     def telemetry_snapshot(self) -> Dict[str, Any]:
         """Collection-level merged observability report: the fused-path
         ``dispatch``/``sync``/``forward`` counters this collection owns,
         plus each member's own :meth:`Metric.telemetry_snapshot` under
-        ``"members"``, and the process-wide persistent AOT-cache counters
+        ``"members"``, the aggregated per-leaf state bytes under
+        ``"memory"``, and the process-wide persistent AOT-cache counters
         under ``"aot_cache"`` (see ``docs/observability.md``)."""
         from metrics_tpu import aot_cache
 
@@ -668,6 +688,7 @@ class MetricCollection:
                 "fuse_failed": self._fuse_failed,
             },
             "aot_cache": aot_cache.stats(),
+            "memory": self.memory_snapshot(),
             "members": {name: m.telemetry_snapshot() for name, m in self.items(keep_base=True)},
         }
 

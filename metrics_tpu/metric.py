@@ -809,14 +809,47 @@ class Metric(ABC):
         ``bytes_on_wire`` (see :mod:`metrics_tpu.telemetry`)."""
         return dict(self._sync_stats)
 
+    def memory_snapshot(self, top_n: int = 10) -> Dict[str, Any]:
+        """Per-leaf state-byte attribution: ``{"total_bytes", "leaf_count",
+        "leaves"}`` with the ``top_n`` largest leaves (descending) as
+        ``{"name", "shape", "dtype", "nbytes"}``. A list state contributes
+        one entry summing its elements (its footprint grows with the
+        stream; the shape reports the element count). ``total_bytes`` is
+        exact over ALL leaves — the input the sharding arc needs to decide
+        which states to place across the mesh."""
+        leaves: List[Dict[str, Any]] = []
+        for name in self._defaults:
+            current = getattr(self, name)
+            if isinstance(current, list):
+                leaves.append({
+                    "name": name,
+                    "shape": (len(current),),
+                    "dtype": str(current[0].dtype) if current else "empty-list",
+                    "nbytes": int(sum(int(v.nbytes) for v in current)),
+                })
+            else:
+                leaves.append({
+                    "name": name,
+                    "shape": tuple(int(d) for d in jnp.shape(current)),
+                    "dtype": str(jnp.asarray(current).dtype),
+                    "nbytes": int(jnp.asarray(current).nbytes),
+                })
+        total = sum(leaf["nbytes"] for leaf in leaves)
+        leaves.sort(key=lambda leaf: (-leaf["nbytes"], leaf["name"]))
+        return {
+            "total_bytes": total,
+            "leaf_count": len(leaves),
+            "leaves": leaves[: max(0, int(top_n))],
+        }
+
     def telemetry_snapshot(self) -> Dict[str, Any]:
         """The per-owner stats dicts merged into one report:
         ``{"owner", "dispatch", "sync", "forward", "resilience",
-        "aot_cache"}`` (update-path launches/retraces, sync collectives/
-        buckets/wire bytes, fused-forward launches/retraces/µs, persistent
-        AOT-cache hits/misses/stores/corrupt — see
-        ``docs/observability.md``). The ``aot_cache`` block is process-wide:
-        the persistent store is shared by every owner."""
+        "aot_cache", "memory"}`` (update-path launches/retraces, sync
+        collectives/buckets/wire bytes, fused-forward launches/retraces/µs,
+        persistent AOT-cache hits/misses/stores/corrupt, per-leaf state
+        bytes — see ``docs/observability.md``). The ``aot_cache`` block is
+        process-wide: the persistent store is shared by every owner."""
         from metrics_tpu import aot_cache
 
         return {
@@ -829,6 +862,7 @@ class Metric(ABC):
                 "forward": self._forward_resilience.stats(),
             },
             "aot_cache": aot_cache.stats(),
+            "memory": self.memory_snapshot(),
         }
 
     def _move_list_states_to_cpu(self) -> None:

@@ -74,6 +74,25 @@ tags (``first-compile`` / ``new-signature`` / ``persistent-cache-hit``).
   :class:`CircuitOpenError` until the cooldown expires, so one poisoned
   tenant cannot monopolize the flush path.
 
+* **Request flight recorder.** Every admitted ``submit()`` mints a
+  monotonically-increasing request id that rides the queue entry, the
+  journal record (so identity survives a crash), the coalesced batch
+  (a merged launch carries the rid *set*), and the stacked launch.
+  At retirement the service emits ONE ``request`` telemetry span per
+  submit — anchored at submit time, pinned to the submitting thread's
+  lane — with the full latency decomposition (``queue_us`` /
+  ``journal_us`` / ``launch_us`` / ``retire_us``) and the launch/retire
+  anchors the Chrome exporter turns into ``s``/``t``/``f`` flow arrows
+  (one clickable submit→launch→retire path in Perfetto). Independent of
+  telemetry, per-tenant SLOs accumulate host-side in
+  :class:`~metrics_tpu.streaming.HostQuantileSketch` histograms —
+  ``slo_snapshot()`` serves end-to-end + queue-wait p50/p95/p99 and
+  shed/reject/expire/breaker rates, ``health()`` the live gauges, and
+  ``memory_snapshot()`` per-leaf state-byte attribution. The recorder
+  is zero-cost idle: with no subscriber, no spans are built and the
+  only additions to the submit path are a counter increment and two
+  clock reads. See ``docs/observability.md``, "Request tracing".
+
 Session handles::
 
     svc = MetricsService(Accuracy(task="multiclass", num_classes=10))
@@ -114,6 +133,98 @@ class QueueFullError(RuntimeError):
 class CircuitOpenError(RuntimeError):
     """The per-session circuit breaker is open: this session failed
     repeatedly and is in backoff cooldown (counted in submits)."""
+
+
+class _Request:
+    """One admitted submit's flight record, threaded from the queue through
+    coalescing and the stacked launch to retirement. Monotonic timestamps
+    (``t_enq`` / ``t_launch_done``) drive the SLO math; the perf-counter
+    ``t0`` (None while telemetry is idle) anchors the ``request`` span."""
+
+    __slots__ = (
+        "name", "args", "kwargs", "seq", "rid", "t_enq", "t0", "submit_tid",
+        "journal_us", "queue_us", "launch_us", "launch_ts_us", "launch_tid",
+        "t_launch_done", "replayed", "members",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        args: Tuple,
+        kwargs: Dict,
+        seq: Optional[int],
+        rid: int,
+        t_enq: float,
+        t0: Optional[float],
+        submit_tid: int,
+        *,
+        journal_us: float = 0.0,
+        replayed: bool = False,
+    ) -> None:
+        self.name = name
+        self.args = args
+        self.kwargs = kwargs
+        self.seq = seq
+        self.rid = rid
+        self.t_enq = t_enq
+        self.t0 = t0
+        self.submit_tid = submit_tid
+        self.journal_us = journal_us
+        self.queue_us = 0.0
+        self.launch_us = 0.0
+        self.launch_ts_us: Optional[float] = None
+        self.launch_tid: Optional[int] = None
+        self.t_launch_done: Optional[float] = None
+        self.replayed = replayed
+        # a coalesced merge keeps the original requests here so every one
+        # of them retires (and traces) individually
+        self.members: Optional[List["_Request"]] = None
+
+    def all(self) -> List["_Request"]:
+        return self.members if self.members is not None else [self]
+
+
+class _SessionSLO:
+    """Per-tenant latency + outcome accounting. Host-side and always on —
+    feeding a device sketch per retirement would cost a launch per
+    observation — but shape-compatible with the device
+    :class:`~metrics_tpu.streaming.QuantileSketch` via ``to_device()``
+    when a tenant's histogram needs to enter the fused-sync world."""
+
+    __slots__ = ("e2e_us", "queue_us", "counts")
+
+    _OUTCOMES = (
+        "served", "fallback", "shed", "expired",
+        "rejected", "failed", "breaker_rejected",
+    )
+
+    def __init__(self) -> None:
+        from metrics_tpu.streaming.sketch import HostQuantileSketch
+
+        # alpha=0.05 over 512 bins/sign spans sub-µs .. hours with 5%
+        # relative error — plenty for p50/p95/p99 dashboards at 8 KiB/tenant
+        self.e2e_us = HostQuantileSketch(bins=512, alpha=0.05)
+        self.queue_us = HostQuantileSketch(bins=512, alpha=0.05)
+        self.counts: Dict[str, int] = {k: 0 for k in self._OUTCOMES}
+
+    def record(
+        self,
+        outcome: str,
+        e2e_us: Optional[float] = None,
+        queue_us: Optional[float] = None,
+    ) -> None:
+        self.counts[outcome] = self.counts.get(outcome, 0) + 1
+        if e2e_us is not None:
+            self.e2e_us.add(e2e_us)
+        if queue_us is not None:
+            self.queue_us.add(queue_us)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "e2e_us": self.e2e_us.snapshot(),
+            "queue_us": self.queue_us.snapshot(),
+            **self.counts,
+        }
 
 
 class MetricSession:
@@ -167,6 +278,11 @@ class MetricsService:
         request_deadline_s: queued requests older than this at flush time
             are expired (``deadline-expired`` degrade span + ``DROP``
             record) instead of served (``None`` = no deadline).
+        flush_interval_s: with a value, a daemon "flush-worker" thread
+            flushes the queue every interval (named in Chrome traces via
+            :func:`metrics_tpu.telemetry.set_thread_name`); call
+            :meth:`shutdown` to stop it. ``None`` (default) keeps the
+            caller-driven flush model.
     """
 
     def __init__(
@@ -182,6 +298,7 @@ class MetricsService:
         admission: str = "block",
         admission_timeout_s: Optional[float] = None,
         request_deadline_s: Optional[float] = None,
+        flush_interval_s: Optional[float] = None,
     ) -> None:
         from metrics_tpu.collections import MetricCollection
         from metrics_tpu.metric import Metric
@@ -233,11 +350,16 @@ class MetricsService:
         self._rows: Dict[str, int] = {}
         self._free: List[int] = list(range(self._capacity - 1, -1, -1))
 
-        # queue entries: (name, args, kwargs, journal seq or None, enqueue
-        # monotonic ts or None). The condition doubles as the queue lock;
-        # flush() notifies blocked submitters after every pop.
-        self._queue: List[Tuple[str, Tuple, Dict, Optional[int], Optional[float]]] = []
+        # the submit queue holds _Request flight records. The condition
+        # doubles as the queue lock; flush() notifies blocked submitters
+        # after every pop. Request ids are minted under the same condition
+        # so rid order matches queue order.
+        self._queue: List[_Request] = []
         self._queue_cond = threading.Condition()
+        self._rid = 0
+        # per-session SLO accounting (always on; host-side sketches)
+        self._slo: Dict[str, _SessionSLO] = {}
+        self._slo_lock = threading.Lock()
         # reentrant: the periodic checkpoint inside flush() drains, and
         # drain() re-enters flush() on the same thread (the queue is empty
         # by then, so the inner pass is a no-op)
@@ -279,6 +401,38 @@ class MetricsService:
             "failed_requests": 0,
             "replayed_records": 0,
         }
+
+        self.flush_interval_s = flush_interval_s
+        self._stop_flush = threading.Event()
+        self._flush_thread: Optional[threading.Thread] = None
+        if flush_interval_s is not None:
+            self._flush_thread = threading.Thread(
+                target=self._flush_loop, name="flush-worker", daemon=True
+            )
+            self._flush_thread.start()
+
+    def _flush_loop(self) -> None:
+        telemetry.set_thread_name("flush-worker")
+        while not self._stop_flush.wait(self.flush_interval_s):
+            try:
+                if self.flush() == 0:
+                    # quiet interval: retire whatever the device finished so
+                    # flight records (and SLO latencies) close out even when
+                    # no new traffic forces the double-buffer to roll over
+                    self._retire_all()
+            except Exception as err:  # noqa: BLE001 - the worker must survive
+                # a poisoned flush; the degrade span records the cause
+                resilience.record_degrade(self.label, "flush-worker", err)
+
+    def shutdown(self) -> None:
+        """Stop the background flush worker (if any), then flush and retire
+        everything outstanding. Idempotent; services without
+        ``flush_interval_s`` are unaffected beyond the final drain."""
+        self._stop_flush.set()
+        if self._flush_thread is not None:
+            self._flush_thread.join(timeout=5.0)
+            self._flush_thread = None
+        self.drain()
 
     # -------------------------------------------------------------- sessions
     @property
@@ -361,6 +515,7 @@ class MetricsService:
         breaker = self._breakers.get(name)
         if breaker is not None and not breaker.allow():
             self.stats["breaker_rejected"] += 1
+            self._slo_record(name, "breaker_rejected")
             telemetry.emit(
                 "degrade", self.label, kind="session", cause="breaker-open",
                 session=name, cooldown=breaker.cooldown,
@@ -371,15 +526,26 @@ class MetricsService:
                 f"({breaker.cooldown} more submits) or reset_session()"
             )
         self.open_session(name)
+        t0 = telemetry.clock()  # span anchor; None while telemetry is idle
         with self._queue_cond:
             if self.max_queue is not None and len(self._queue) >= self.max_queue:
                 self._admit_locked(name)
+            self._rid += 1
+            rid = self._rid
             seq: Optional[int] = None
+            journal_us = 0.0
             if self._wal is not None and not self._replaying:
-                seq = self._wal.append(wal.UPDATE, name, args, kwargs)
+                j0 = time.monotonic()
+                seq = self._wal.append(wal.UPDATE, name, args, kwargs, request_id=rid)
+                journal_us = (time.monotonic() - j0) * 1e6
                 faults.crash_point("post-journal", self.label)
-            ts = time.monotonic() if self.request_deadline_s is not None else None
-            self._queue.append((name, args, kwargs, seq, ts))
+            # the enqueue timestamp is always recorded (queue-wait must be
+            # measurable with or without a deadline configured)
+            self._queue.append(_Request(
+                name, args, kwargs, seq, rid,
+                time.monotonic(), t0, threading.get_ident(),
+                journal_us=journal_us,
+            ))
             self.stats["submits"] += 1
 
     def _admit_locked(self, name: str) -> None:
@@ -391,16 +557,18 @@ class MetricsService:
         assert self.max_queue is not None
         if self.admission == "shed-oldest":
             while len(self._queue) >= self.max_queue:
-                v_name, _, _, v_seq, _ = self._queue.pop(0)
-                if self._wal is not None and v_seq is not None:
+                victim = self._queue.pop(0)
+                if self._wal is not None and victim.seq is not None:
                     self._wal.append(
-                        wal.DROP, v_name, drop_seq=v_seq, drop_cause="queue-full-shed"
+                        wal.DROP, victim.name,
+                        drop_seq=victim.seq, drop_cause="queue-full-shed",
                     )
                 self.stats["shed_requests"] += 1
                 telemetry.emit(
                     "degrade", self.label, kind="admission",
-                    cause="queue-full-shed", session=v_name, seq=v_seq,
+                    cause="queue-full-shed", session=victim.name, seq=victim.seq,
                 )
+                self._finish_request(victim, "shed")
             return
         if self.admission == "block":
             deadline = (
@@ -416,6 +584,7 @@ class MetricsService:
             if len(self._queue) < self.max_queue:
                 return
         self.stats["rejected_requests"] += 1
+        self._slo_record(name, "rejected")
         telemetry.emit(
             "degrade", self.label, kind="admission", cause="queue-full-reject",
             session=name, policy=self.admission,
@@ -441,6 +610,9 @@ class MetricsService:
                 self._queue_cond.notify_all()
             if not queued:
                 return 0
+            now = time.monotonic()
+            for req in queued:
+                req.queue_us = max(0.0, (now - req.t_enq) * 1e6)
             pending = self._expire_stale(queued)
             if not pending:
                 return 0
@@ -451,18 +623,19 @@ class MetricsService:
             # gathered/scattered exactly once), so duplicates that survived
             # coalescing serialize across waves
             while pending:
-                wave: "OrderedDict[str, Tuple[str, Tuple, Dict]]" = OrderedDict()
-                rest: List[Tuple[str, Tuple, Dict]] = []
-                for entry in pending:
-                    if entry[0] in wave:
-                        rest.append(entry)
+                wave: "OrderedDict[str, _Request]" = OrderedDict()
+                rest: List[_Request] = []
+                for req in pending:
+                    if req.name in wave:
+                        rest.append(req)
                     else:
-                        wave[entry[0]] = entry
+                        wave[req.name] = req
                 self._run_wave(list(wave.values()))
                 faults.crash_point("mid-flush", self.label)
                 pending = rest
             self._flushes += 1
             self.stats["flushes"] += 1
+            self._emit_gauges()
             if (
                 not self._replaying
                 and self.checkpoint_every > 0
@@ -479,61 +652,80 @@ class MetricsService:
     def drain(self) -> None:
         """Barrier: flush the queue and block until every launch retired."""
         self.flush()
-        while self._inflight:
-            leaves = self._inflight.popleft()
-            for leaf in leaves:
-                leaf.block_until_ready()
+        self._retire_all()
 
-    def _expire_stale(self, queued: List[Tuple]) -> List[Tuple[str, Tuple, Dict]]:
+    def _retire_all(self) -> None:
+        """Retire every inflight generation. popleft() is the atomic claim,
+        so the caller thread and the background flush worker can race here
+        without double-retiring a generation."""
+        while True:
+            try:
+                generation = self._inflight.popleft()
+            except IndexError:
+                return
+            self._retire(generation)
+
+    def _retire(self, generation: Tuple[Tuple, List[_Request]]) -> None:
+        """Block one inflight generation to completion, then close every
+        request it carried (SLO record + ``request`` span)."""
+        leaves, reqs = generation
+        for leaf in leaves:
+            leaf.block_until_ready()
+        t_ret = time.monotonic()
+        for req in reqs:
+            self._finish_request(req, "served", t_ret=t_ret)
+
+    def _expire_stale(self, queued: List[_Request]) -> List[_Request]:
         """Deadline gate at the head of flush: queued requests older than
         ``request_deadline_s`` are expired — one ``deadline-expired``
         degrade span + journal ``DROP`` each — instead of served. Replayed
-        records carry no timestamp and are never expired (the live process
-        already made their deadline decision). Returns live (name, args,
-        kwargs) triples for the wave machinery."""
+        records are never expired (the live process already made their
+        deadline decision)."""
         deadline = self.request_deadline_s
         if deadline is None or self._replaying:
-            return [(n, a, k) for n, a, k, _, _ in queued]
+            return queued
         now = time.monotonic()
-        live: List[Tuple[str, Tuple, Dict]] = []
-        for name, args, kwargs, seq, ts in queued:
-            if ts is not None and now - ts > deadline:
-                if self._wal is not None and seq is not None:
+        live: List[_Request] = []
+        for req in queued:
+            if not req.replayed and now - req.t_enq > deadline:
+                if self._wal is not None and req.seq is not None:
                     self._wal.append(
-                        wal.DROP, name, drop_seq=seq, drop_cause="deadline-expired"
+                        wal.DROP, req.name,
+                        drop_seq=req.seq, drop_cause="deadline-expired",
                     )
                 self.stats["expired_requests"] += 1
                 telemetry.emit(
                     "degrade", self.label, kind="admission",
-                    cause="deadline-expired", session=name, seq=seq,
-                    age_s=round(now - ts, 3),
+                    cause="deadline-expired", session=req.name, seq=req.seq,
+                    age_s=round(now - req.t_enq, 3),
                 )
+                self._finish_request(req, "expired", t_ret=now)
             else:
-                live.append((name, args, kwargs))
+                live.append(req)
         return live
 
-    def _coalesce(self, pending: List[Tuple[str, Tuple, Dict]]) -> List[Tuple[str, Tuple, Dict]]:
+    def _coalesce(self, pending: List[_Request]) -> List[_Request]:
         """Concatenate same-session requests along the batch axis where the
         shapes allow it (same treedef, every leaf batched, same trailing
         dims); anything else passes through untouched."""
-        by_session: "OrderedDict[str, List[Tuple[str, Tuple, Dict]]]" = OrderedDict()
-        for entry in pending:
-            by_session.setdefault(entry[0], []).append(entry)
-        out: List[Tuple[str, Tuple, Dict]] = []
-        for name, entries in by_session.items():
-            if len(entries) > 1:
-                merged = self._try_concat(name, entries)
+        by_session: "OrderedDict[str, List[_Request]]" = OrderedDict()
+        for req in pending:
+            by_session.setdefault(req.name, []).append(req)
+        out: List[_Request] = []
+        for name, reqs in by_session.items():
+            if len(reqs) > 1:
+                merged = self._try_concat(name, reqs)
                 if merged is not None:
-                    self.stats["coalesced_requests"] += len(entries) - 1
+                    self.stats["coalesced_requests"] += len(reqs) - 1
                     out.append(merged)
                     continue
-            out.extend(entries)
+            out.extend(reqs)
         return out
 
-    def _try_concat(self, name: str, entries) -> Optional[Tuple[str, Tuple, Dict]]:
+    def _try_concat(self, name: str, reqs: List[_Request]) -> Optional[_Request]:
         flats, treedefs = [], []
-        for _, args, kwargs in entries:
-            flat, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        for req in reqs:
+            flat, treedef = jax.tree_util.tree_flatten((req.args, req.kwargs))
             flat = [jnp.asarray(x) for x in flat]
             # every leaf batched on a shared axis 0, or the request cannot
             # merge (scalar/static-flag requests stay separate waves)
@@ -551,17 +743,27 @@ class MetricsService:
                 for x in leaves[1:]
             ):
                 return None
-        merged = [jnp.concatenate(list(leaves), axis=0) for leaves in zip(*flats)]
-        args, kwargs = jax.tree_util.tree_unflatten(treedefs[0], merged)
-        return (name, args, kwargs)
+        merged_flat = [jnp.concatenate(list(leaves), axis=0) for leaves in zip(*flats)]
+        args, kwargs = jax.tree_util.tree_unflatten(treedefs[0], merged_flat)
+        head = reqs[0]
+        merged = _Request(
+            name, args, kwargs, head.seq, head.rid,
+            head.t_enq, head.t0, head.submit_tid,
+            journal_us=head.journal_us, replayed=head.replayed,
+        )
+        # the launch carries the full rid SET — every member retires
+        # individually with its own timings
+        merged.members = list(reqs)
+        return merged
 
     # --------------------------------------------------------------- launch
-    def _run_wave(self, entries: List[Tuple[str, Tuple, Dict]]) -> None:
+    def _run_wave(self, entries: List[_Request]) -> None:
         """Group one wave by executable signature and launch each group."""
         from metrics_tpu.metric import _is_static_scalar, _split_static_kwargs
 
         groups: "OrderedDict[Tuple, List]" = OrderedDict()
-        for name, args, kwargs in entries:
+        for req in entries:
+            args, kwargs = req.args, req.kwargs
             if any(_is_static_scalar(v) for v in args) or any(
                 _is_static_scalar(v) for v in kwargs.values()
             ):
@@ -584,10 +786,10 @@ class MetricsService:
                     bucket_pow2(batch, minimum=_MIN_SESSION_BUCKET),
                 )
                 groups.setdefault(sig, []).append(
-                    (name, args, dynamic, static, flat, batch)
+                    (req, args, dynamic, static, flat, batch)
                 )
             except Exception:  # noqa: BLE001 - unstackable request shapes
-                self._eager_entry(name, args, dynamic, static)
+                self._eager_entry(req, args, dynamic, static)
         for sig, group in groups.items():
             self._launch_group(sig, group)
 
@@ -595,8 +797,8 @@ class MetricsService:
         static_key, treedef, _, b_bucket = sig
         static = group[0][3]
         if not (self.template._masked_update_supported() and self._policy.allow()):
-            for name, args, dynamic, static_kw, _, _ in group:
-                self._eager_entry(name, args, dynamic, static_kw)
+            for req, args, dynamic, static_kw, _, _ in group:
+                self._eager_entry(req, args, dynamic, static_kw)
             return
         s_real = len(group)
         s_bucket = bucket_pow2(s_real, minimum=_MIN_SESSION_BUCKET)
@@ -604,8 +806,8 @@ class MetricsService:
         idx = np.full((s_bucket,), self._capacity, dtype=np.int32)  # OOB pad: scatter drops
         n_valid = np.zeros((s_bucket,), dtype=np.int32)
         flat_rows = None
-        for i, (name, _, _, _, flat, batch) in enumerate(group):
-            idx[i] = self._rows[name]
+        for i, (req, _, _, _, flat, batch) in enumerate(group):
+            idx[i] = self._rows[req.name]
             n_valid[i] = batch
             padded = [pad_axis0(x, b_bucket) for x in flat]
             if flat_rows is None:
@@ -636,7 +838,10 @@ class MetricsService:
                 compiled = self._compile_stacked(key, static, treedef, stacked_flat)
             faults.check("launch", self.label)
             state_leaves = tuple(self._stacked[k] for k in self._names)
+            reqs = [r for entry in group for r in entry[0].all()]
+            rids = [r.rid for r in reqs]
             t0 = telemetry.clock()
+            l0 = time.monotonic()
             with profiler_annotation(f"metrics_tpu.{self.label}.update[stacked-aot]"):
                 out = compiled(
                     state_leaves,
@@ -645,6 +850,7 @@ class MetricsService:
                     *stacked_flat,
                 )
                 out = tuple(out)
+            l1 = time.monotonic()
             telemetry.emit(
                 "update",
                 self.label,
@@ -655,7 +861,18 @@ class MetricsService:
                 session_bucket=s_bucket,
                 bucket=b_bucket,
                 static_key=static_key or None,
+                rid_count=len(rids),
+                rids=rids[:128],
             )
+            launch_us = (l1 - l0) * 1e6
+            launch_tid = threading.get_ident()
+            for r in reqs:
+                r.launch_us = launch_us
+                r.t_launch_done = l1
+                if t0 is not None:
+                    # flow-anchor inside the update span on the flush lane
+                    r.launch_ts_us = telemetry.stream_us(t0) + 1.0
+                    r.launch_tid = launch_tid
             out = faults.maybe_corrupt_leaves(out)
             for k, leaf in zip(self._names, out):
                 self._stacked[k] = leaf
@@ -663,19 +880,18 @@ class MetricsService:
             self._policy.note_success()
             if self._breakers:
                 # a served request closes its session's circuit breaker
-                for g_name, *_ in group:
-                    g_breaker = self._breakers.get(g_name)
+                for entry in group:
+                    g_breaker = self._breakers.get(entry[0].name)
                     if g_breaker is not None:
                         g_breaker.note_success()
-            self._inflight.append(out)
+            self._inflight.append((out, reqs))
             while len(self._inflight) > self.max_inflight:
-                for leaf in self._inflight.popleft():
-                    leaf.block_until_ready()
+                self._retire(self._inflight.popleft())
         except Exception as err:  # noqa: BLE001 - degrade the group, keep serving
             self._policy.note_failure(resilience.classify(err))
             resilience.record_degrade(self.label, "serve", err, self._policy)
-            for name, args, dynamic, static_kw, _, _ in group:
-                self._eager_entry(name, args, dynamic, static_kw)
+            for req, args, dynamic, static_kw, _, _ in group:
+                self._eager_entry(req, args, dynamic, static_kw)
 
     def _compile_stacked(self, key: Tuple, static: Dict, treedef, example_flat) -> Callable:
         faults.check("compile", self.label)
@@ -747,7 +963,7 @@ class MetricsService:
             self.stats["evictions"] += 1
             telemetry.emit("evict", self.label, "stacked-aot", stream="serve")
 
-    def _eager_entry(self, name: str, args: Tuple, dynamic: Dict, static: Dict) -> None:
+    def _eager_entry(self, req: _Request, args: Tuple, dynamic: Dict, static: Dict) -> None:
         """Per-request fallback: unstacked pure update on one row (exact
         semantics, no coalescing) — serves requests the stacked path cannot
         or while the resilience policy holds it in cooldown.
@@ -756,6 +972,8 @@ class MetricsService:
         even here (poisoned inputs, closed row) is dropped with a
         cause-tagged ``degrade`` span and trips the session's circuit
         breaker — one bad tenant costs its own requests, never the flush."""
+        name = req.name
+        l0 = time.monotonic()
         try:
             row = self._rows[name]
             state = {k: self._stacked[k][row] for k in self._names}
@@ -766,6 +984,11 @@ class MetricsService:
             breaker = self._breakers.get(name)
             if breaker is not None:
                 breaker.note_success()
+            t_ret = time.monotonic()
+            for r in req.all():
+                r.launch_us = (t_ret - l0) * 1e6
+                r.t_launch_done = t_ret
+                self._finish_request(r, "fallback", t_ret=t_ret)
         except Exception as err:  # noqa: BLE001 - isolate the poisoned request
             breaker = self._breakers.setdefault(name, resilience.ResiliencePolicy())
             breaker.note_failure(resilience.classify(err))
@@ -773,6 +996,148 @@ class MetricsService:
                 self.label, "session", err, breaker, session=name
             )
             self.stats["failed_requests"] += 1
+            t_ret = time.monotonic()
+            for r in req.all():
+                self._finish_request(r, "failed", t_ret=t_ret)
+
+    # ------------------------------------------------------ flight recorder
+    def _slo_record(
+        self,
+        name: str,
+        outcome: str,
+        e2e_us: Optional[float] = None,
+        queue_us: Optional[float] = None,
+    ) -> None:
+        with self._slo_lock:
+            slo = self._slo.get(name)
+            if slo is None:
+                slo = self._slo[name] = _SessionSLO()
+            slo.record(outcome, e2e_us, queue_us)
+
+    def _finish_request(
+        self, req: _Request, outcome: str, t_ret: Optional[float] = None
+    ) -> None:
+        """Close one request's flight record: fold its latency into the
+        session's SLO sketches (always on) and emit the ``request`` span
+        on the *submitting* thread's lane (only while instrumented).
+        Replayed requests emit spans tagged ``replayed=True`` but never
+        touch the SLOs — the live process already recorded them."""
+        t_ret = time.monotonic() if t_ret is None else t_ret
+        e2e_us = max(0.0, (t_ret - req.t_enq) * 1e6)
+        retire_us = 0.0
+        if req.t_launch_done is not None:
+            retire_us = max(0.0, (t_ret - req.t_launch_done) * 1e6)
+        if not req.replayed:
+            latencied = outcome in ("served", "fallback")
+            self._slo_record(
+                req.name, outcome,
+                e2e_us if latencied else None,
+                req.queue_us if latencied or outcome == "expired" else None,
+            )
+        if req.t0 is not None and telemetry.clock() is not None:
+            extra: Dict[str, Any] = {"replayed": True} if req.replayed else {}
+            if req.launch_ts_us is not None:
+                extra["launch_ts_us"] = round(req.launch_ts_us, 3)
+                extra["launch_tid"] = req.launch_tid
+            telemetry.emit(
+                "request", self.label, outcome,
+                t0=req.t0, tid=req.submit_tid, stream="serve",
+                rid=req.rid, session=req.name, seq=req.seq,
+                queue_us=round(req.queue_us, 1),
+                journal_us=round(req.journal_us, 1),
+                launch_us=round(req.launch_us, 1),
+                retire_us=round(retire_us, 1),
+                retire_ts_us=round(telemetry.stream_us(time.perf_counter()), 3),
+                **extra,
+            )
+
+    def _emit_gauges(self) -> None:
+        """One health + one memory ``gauge`` sample per flush, built only
+        while someone is subscribed (zero idle cost)."""
+        if telemetry.clock() is None:
+            return
+        h = self.health()
+        telemetry.emit(
+            "gauge", self.label, "health", stream="serve",
+            queue_depth=h["queue_depth"], inflight=h["inflight"],
+            sessions=h["sessions"], free_rows=h["free_rows"],
+            open_breakers=sum(1 for b in h["breakers"].values() if b["open"]),
+        )
+        mem = self.memory_snapshot(top_n=3)
+        telemetry.emit(
+            "gauge", self.label, "memory", stream="serve",
+            total_bytes=mem["total_bytes"], leaf_count=mem["leaf_count"],
+            top=[[leaf["name"], leaf["nbytes"]] for leaf in mem["leaves"]],
+        )
+
+    def health(self) -> Dict[str, Any]:
+        """Live operational gauges: queue depth, inflight generations,
+        session/row occupancy, admission posture, and per-session breaker
+        state. Read-only — breaker state comes from the non-mutating
+        ``blocked`` view, never ``allow()`` (which burns cooldown)."""
+        with self._queue_cond:
+            queue_depth = len(self._queue)
+        return {
+            "queue_depth": queue_depth,
+            "inflight": len(self._inflight),
+            "sessions": self.session_count,
+            "capacity": self._capacity,
+            "free_rows": len(self._free),
+            "queue_bound": self.max_queue,
+            "admission": self.admission,
+            "breakers": {
+                name: {
+                    "open": bool(b.blocked),
+                    "failures": int(b.failures),
+                    "cooldown": int(b.cooldown),
+                }
+                for name, b in self._breakers.items()
+            },
+        }
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """Per-tenant SLO view: end-to-end + queue-wait p50/p95/p99 (from
+        the host latency sketches; relative error ``alpha=0.05``) and
+        outcome counts per session, plus a cross-tenant ``"totals"``
+        aggregate built by the sketches' lossless elementwise merge."""
+        from metrics_tpu.streaming.sketch import HostQuantileSketch
+
+        e2e = HostQuantileSketch(bins=512, alpha=0.05)
+        qws = HostQuantileSketch(bins=512, alpha=0.05)
+        totals: Dict[str, Any] = {k: 0 for k in _SessionSLO._OUTCOMES}
+        with self._slo_lock:
+            sessions = {name: slo.snapshot() for name, slo in self._slo.items()}
+            for slo in self._slo.values():
+                for k in _SessionSLO._OUTCOMES:
+                    totals[k] += slo.counts.get(k, 0)
+                e2e.merge(slo.e2e_us)
+                qws.merge(slo.queue_us)
+        totals["e2e_us"] = e2e.snapshot()
+        totals["queue_us"] = qws.snapshot()
+        return {"sessions": sessions, "totals": totals}
+
+    def memory_snapshot(self, top_n: int = 10) -> Dict[str, Any]:
+        """Per-leaf byte attribution for the stacked session state — the
+        input the sharding arc needs to decide what to shard. ``leaves``
+        holds the ``top_n`` largest (desc); ``total_bytes`` is exact
+        (``sum(leaf.nbytes)`` over ALL leaves, not just the listed ones)."""
+        leaves = [
+            {
+                "name": k,
+                "shape": tuple(int(d) for d in self._stacked[k].shape),
+                "dtype": str(self._stacked[k].dtype),
+                "nbytes": int(self._stacked[k].nbytes),
+            }
+            for k in self._names
+        ]
+        total = sum(leaf["nbytes"] for leaf in leaves)
+        leaves.sort(key=lambda leaf: (-leaf["nbytes"], leaf["name"]))
+        return {
+            "total_bytes": total,
+            "leaf_count": len(leaves),
+            "per_session_bytes": total // max(1, self._capacity),
+            "leaves": leaves[: max(0, int(top_n))],
+        }
 
     # -------------------------------------------------------------- results
     def compute(self, name: str) -> Any:
@@ -1034,12 +1399,18 @@ class MetricsService:
             for rec in records:
                 if rec.kind == wal.UPDATE:
                     # bypass submit(): the closed-set evolves via CLOSE
-                    # records, and a journaled update was legal when written
+                    # records, and a journaled update was legal when written.
+                    # The journaled rid is reused (identity survives the
+                    # crash) and the mint counter advances past it.
                     self.open_session(rec.session)
                     with self._queue_cond:
-                        self._queue.append(
-                            (rec.session, rec.args, rec.kwargs, rec.seq, None)
-                        )
+                        if rec.rid > self._rid:
+                            self._rid = rec.rid
+                        self._queue.append(_Request(
+                            rec.session, rec.args, rec.kwargs, rec.seq,
+                            rec.rid, time.monotonic(), telemetry.clock(),
+                            threading.get_ident(), replayed=True,
+                        ))
                 elif rec.kind == wal.CLOSE:
                     self.flush()
                     self.close_session(rec.session)
@@ -1070,7 +1441,9 @@ class MetricsService:
         fsync µs percentiles) under ``"wal"`` — ``None`` with no journal.
         Shed / expired / breaker-tripped request counts live under
         ``"serve"`` (``shed_requests`` / ``expired_requests`` /
-        ``breaker_rejected``)."""
+        ``breaker_rejected``). ``"memory"`` carries the per-leaf state-byte
+        attribution (:meth:`memory_snapshot`) and ``"health"`` the live
+        gauges (:meth:`health`)."""
         return {
             "owner": self.label,
             "serve": dict(self.stats),
@@ -1079,4 +1452,6 @@ class MetricsService:
             "resilience": self._policy.stats(),
             "aot_cache": aot_cache.stats(),
             "wal": self._wal.stats() if self._wal is not None else None,
+            "memory": self.memory_snapshot(),
+            "health": self.health(),
         }

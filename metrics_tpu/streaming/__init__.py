@@ -8,6 +8,7 @@ serving launcher without any engine changes. See ``docs/streaming.md``.
 """
 from metrics_tpu.streaming.sketch import (  # noqa: F401
     CountMinHeavyHitters,
+    HostQuantileSketch,
     HyperLogLog,
     QuantileSketch,
 )
@@ -20,6 +21,7 @@ from metrics_tpu.streaming.window import (  # noqa: F401
 __all__ = [
     "CountMinHeavyHitters",
     "ExponentialDecay",
+    "HostQuantileSketch",
     "HyperLogLog",
     "QuantileSketch",
     "SlidingWindow",

@@ -29,12 +29,18 @@ from typing import Any, Optional, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from metrics_tpu import telemetry
 from metrics_tpu.aggregation import BaseAggregator
 
-__all__ = ["QuantileSketch", "HyperLogLog", "CountMinHeavyHitters"]
+__all__ = [
+    "QuantileSketch",
+    "HostQuantileSketch",
+    "HyperLogLog",
+    "CountMinHeavyHitters",
+]
 
 Array = jax.Array
 
@@ -150,6 +156,122 @@ class QuantileSketch(BaseAggregator):
         """Median estimate; use :meth:`quantile` for other ranks."""
         _emit_sketch(self.value, type(self).__name__, "compute", bins=self.bins)
         return self.quantile(0.5)
+
+
+class HostQuantileSketch:
+    """Host-side (numpy-only) twin of :class:`QuantileSketch`.
+
+    The serving flight recorder needs latency histograms fed from plain
+    Python floats on every ``submit()`` retirement — paths where a device
+    launch per observation would dwarf the thing being measured. This
+    class reproduces the device sketch's binning math exactly (same
+    ``gamma``, same key clipping, computed in float32 so a count vector
+    moved between the two via :meth:`to_device` / ``counts`` lands in
+    identical bins) but runs entirely on host: ``add`` is a couple of
+    scalar ops, ``merge`` is an elementwise sum.
+
+    The state is the same ``(2*bins + 1,)`` layout — ``bins`` negative
+    buckets, one zero bucket, ``bins`` positive — so two host sketches,
+    or a host and a device sketch with matching ``(bins, alpha)``, merge
+    losslessly.
+
+    Example:
+        >>> from metrics_tpu.streaming import HostQuantileSketch
+        >>> s = HostQuantileSketch()
+        >>> s.add_many([float(v) for v in range(1, 101)])
+        >>> bool(abs(s.quantile(0.5) - 50.0) < 1.0)
+        True
+    """
+
+    def __init__(self, bins: int = 512, alpha: float = 0.01) -> None:
+        bins, alpha = int(bins), float(alpha)
+        if bins <= 0:
+            raise ValueError(f"bins must be positive, got {bins}")
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+        self.bins = bins
+        self.alpha = alpha
+        self.gamma = (1.0 + alpha) / (1.0 - alpha)
+        self.min_key = -(bins // 2)
+        self.counts = np.zeros((2 * bins + 1,), np.float64)
+
+    @property
+    def count(self) -> float:
+        """Total weight absorbed so far."""
+        return float(self.counts.sum())
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.counts.nbytes)
+
+    def _index(self, x: float) -> int:
+        # mirror of QuantileSketch._index, scalar + float32 so the two
+        # paths bucket identical values identically
+        absx = abs(x)
+        if absx > 0:
+            key = float(np.ceil(np.log(np.float32(absx)) / np.log(np.float32(self.gamma))))
+            kidx = int(np.clip(key, self.min_key, self.min_key + self.bins - 1)) - self.min_key
+        else:
+            kidx = 0
+        if x > 0:
+            return self.bins + 1 + kidx
+        if x < 0:
+            return (self.bins - 1) - kidx
+        return self.bins
+
+    def add(self, value: float, weight: float = 1.0) -> None:
+        """Absorb one observation (NaN is dropped, matching the device
+        sketch's mask-out strategy)."""
+        value = float(value)
+        if value != value:  # NaN
+            return
+        self.counts[self._index(value)] += float(weight)
+
+    def add_many(self, values: Any) -> None:
+        for v in values:
+            self.add(v)
+
+    def merge(self, other: "HostQuantileSketch") -> "HostQuantileSketch":
+        """In-place elementwise-sum merge; shapes must match."""
+        if (other.bins, round(other.alpha, 12)) != (self.bins, round(self.alpha, 12)):
+            raise ValueError(
+                f"cannot merge sketches with different shapes: "
+                f"(bins={self.bins}, alpha={self.alpha}) vs (bins={other.bins}, alpha={other.alpha})"
+            )
+        self.counts += other.counts
+        return self
+
+    def quantile(self, q: float) -> float:
+        """Estimate quantile ``q`` in [0, 1]; NaN on an empty sketch."""
+        total = self.counts.sum()
+        if total <= 0:
+            return float("nan")
+        cum = np.cumsum(self.counts)
+        target = max(float(q) * total, 1.0)
+        idx = int(np.argmax(cum >= target))
+        rel = idx - self.bins
+        if rel == 0:
+            return 0.0
+        key = (rel - 1 if rel > 0 else -rel - 1) + self.min_key
+        mag = 2.0 * self.gamma ** key / (self.gamma + 1.0)
+        return mag if rel > 0 else -mag
+
+    def to_device(self) -> "QuantileSketch":
+        """A device :class:`QuantileSketch` preloaded with these counts —
+        the bridge from per-request host recording into the fused-sync /
+        stacked-serving world."""
+        sketch = QuantileSketch(bins=self.bins, alpha=self.alpha)
+        sketch.value = jnp.asarray(self.counts, jnp.float32)
+        return sketch
+
+    def snapshot(self) -> dict:
+        """Percentile summary for ``slo_snapshot()`` (plain floats)."""
+        return {
+            "count": self.count,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
 
 
 class HyperLogLog(BaseAggregator):

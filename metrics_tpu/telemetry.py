@@ -60,6 +60,23 @@ sketch     one sketch-aggregator operation on the eager path
            ``compute``, owner = the sketch class name, with the sketch
            geometry (``bins`` / ``registers`` / ``depth``+``width``) in
            the attrs
+request    one SERVED request's end-to-end flight record
+           (:mod:`metrics_tpu.serve`): kinds ``served`` (stacked
+           launch) / ``fallback`` (eager row update) / ``shed`` /
+           ``expired`` / ``failed``. Spans start at ``submit()`` and
+           end at retirement, carry the monotonically-minted ``rid``,
+           the ``session``, the latency decomposition
+           (``queue_us``/``journal_us``/``launch_us``/``retire_us``)
+           and — for replayed journal records — ``replayed=True``.
+           The Chrome exporter turns each one into a flow arrow
+           (``ph: s/t/f``) linking the submit lane to the launch and
+           retire slices (see :func:`export_chrome_trace`)
+gauge      one sampled health/memory reading (:mod:`metrics_tpu.serve`):
+           kinds ``health`` (queue depth, inflight, sessions, free
+           rows) and ``memory`` (state bytes total + top leaves),
+           emitted once per flush while a subscriber is attached
+retire     one inflight-generation retirement on the serving path —
+           the host-side wait for a launch wave's device results
 ========== ============================================================
 
 The serving admission layer reuses the ``degrade`` name for shed work:
@@ -113,6 +130,9 @@ __all__ = [
     "emit",
     "span",
     "clock",
+    "stream_us",
+    "set_thread_name",
+    "thread_names",
     "snapshot",
     "reset_counters",
     "export_chrome_trace",
@@ -130,6 +150,11 @@ _lock = threading.Lock()
 # another thread can never mutate the sequence mid-record
 _subscribers: Tuple[Callable[["TelemetryEvent"], None], ...] = ()
 _counters: Dict[str, float] = {}
+# tid -> human lane name for the Chrome exporter's ph:"M" thread_name
+# metadata records. Populated lazily at emit time from the emitting
+# thread's ``threading`` name and explicitly via :func:`set_thread_name`
+# (the serving flush worker names itself "flush-worker").
+_thread_names: Dict[int, str] = {}
 
 
 def telemetry_enabled() -> bool:
@@ -188,12 +213,36 @@ def clock() -> Optional[float]:
     return None
 
 
+def stream_us(t: float) -> float:
+    """Convert a ``perf_counter()`` reading to stream time (µs since the
+    process telemetry epoch — the ``ts_us`` unit every event carries).
+    Used by emitters that stash extra timeline anchors in span attrs
+    (e.g. the serving flight recorder's ``launch_ts_us``)."""
+    return (t - _EPOCH) * 1e6
+
+
+def set_thread_name(name: str, tid: Optional[int] = None) -> None:
+    """Name the Chrome-trace lane for a thread (default: the calling
+    thread). Exported traces then label the lane with ``name`` via a
+    ``ph:"M"`` ``thread_name`` metadata record instead of the raw tid."""
+    with _lock:
+        _thread_names[tid if tid is not None else threading.get_ident()] = str(name)
+
+
+def thread_names() -> Dict[int, str]:
+    """Copy of the tid -> lane-name registry (explicit
+    :func:`set_thread_name` entries plus names captured at emit time)."""
+    with _lock:
+        return dict(_thread_names)
+
+
 def emit(
     name: str,
     owner: str,
     kind: str = "",
     t0: Optional[float] = None,
     dur_us: Optional[float] = None,
+    tid: Optional[int] = None,
     **attrs: Any,
 ) -> None:
     """Record one event on the stream.
@@ -201,8 +250,11 @@ def emit(
     ``t0`` (a :func:`clock` result) sets the span start; the duration is
     measured to now unless ``dur_us`` is given explicitly (callers that
     already timed the work pass both). With neither, the event is an
-    instant at now. Counters are bumped even with no subscriber attached;
-    full events are built and delivered only when someone is listening.
+    instant at now. ``tid`` pins the event to another thread's lane (the
+    serving flight recorder emits ``request`` spans at retirement but on
+    the submitting thread's lane). Counters are bumped even with no
+    subscriber attached; full events are built and delivered only when
+    someone is listening.
     """
     if not telemetry_enabled():
         return
@@ -229,7 +281,15 @@ def emit(
         ts_us = (t0 - _EPOCH) * 1e6
     else:
         ts_us = (now - _EPOCH) * 1e6 - dur_us
-    event = TelemetryEvent(name, owner, kind, ts_us, dur_us, threading.get_ident(), attrs)
+    own_tid = threading.get_ident()
+    if own_tid not in _thread_names:
+        # lazy lane naming: capture the threading name once per thread so
+        # exported traces label lanes even without explicit registration
+        with _lock:
+            _thread_names.setdefault(own_tid, threading.current_thread().name)
+    event = TelemetryEvent(
+        name, owner, kind, ts_us, dur_us, own_tid if tid is None else tid, attrs
+    )
     for callback in subs:
         callback(event)
 
@@ -370,9 +430,32 @@ def export_chrome_trace(events: Iterable[TelemetryEvent], path: str) -> None:
     """Chrome trace-event JSON (the ``traceEvents`` array form) — open in
     Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``. Timed spans
     become complete (``ph="X"``) events; zero-duration events become
-    instants (``ph="i"``)."""
+    instants (``ph="i"``).
+
+    Two extra record families make the trace readable as a story rather
+    than a pile of slices:
+
+    * ``ph:"M"`` metadata — one ``process_name`` record plus a
+      ``thread_name`` per lane (from :func:`set_thread_name` / the
+      emit-time capture), so lanes read "flush-worker"/"submit-0"
+      instead of raw tids.
+    * ``ph:"s"/"t"/"f"`` flow events — synthesized from every
+      ``request`` span that carries launch/retire anchors
+      (``launch_ts_us``/``launch_tid``/``retire_ts_us``), so one
+      submit is a single clickable arrow from its submit-lane span
+      through the stacked launch to the retirement slice."""
     pid = os.getpid()
-    trace: List[Dict[str, Any]] = []
+    events = list(events)
+    trace: List[Dict[str, Any]] = [
+        {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+         "args": {"name": "metrics_tpu"}},
+    ]
+    names = thread_names()
+    for tid in sorted({e.tid for e in events}):
+        trace.append({
+            "ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+            "args": {"name": names.get(tid, f"thread-{tid}")},
+        })
     for e in events:
         entry: Dict[str, Any] = {
             "name": f"{e.owner}.{e.name}" + (f" [{e.kind}]" if e.kind else ""),
@@ -389,5 +472,29 @@ def export_chrome_trace(events: Iterable[TelemetryEvent], path: str) -> None:
             entry["ph"] = "i"
             entry["s"] = "t"
         trace.append(entry)
+        if e.name == "request" and "rid" in e.attrs:
+            trace.extend(_request_flow(e, pid))
     with open(path, "w") as f:
         json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
+
+
+def _request_flow(e: TelemetryEvent, pid: int) -> List[Dict[str, Any]]:
+    """Flow-event triple for one ``request`` span: start inside the span
+    on the submit lane, step inside the launch slice on the flush lane,
+    finish at the retirement point. Binding is positional — a flow record
+    attaches to the slice enclosing its timestamp on that thread — so the
+    anchors are placed strictly inside their slices."""
+    flow: List[Dict[str, Any]] = []
+    rid = e.attrs["rid"]
+    base = {"cat": "request", "name": "request-flow", "id": rid, "pid": pid}
+    flow.append({**base, "ph": "s", "tid": e.tid, "ts": round(e.ts_us + 0.001, 3)})
+    launch_ts = e.attrs.get("launch_ts_us")
+    launch_tid = e.attrs.get("launch_tid", e.tid)
+    if launch_ts is not None:
+        flow.append({**base, "ph": "t", "tid": launch_tid,
+                     "ts": round(float(launch_ts) + 0.001, 3)})
+    retire_ts = e.attrs.get("retire_ts_us")
+    if retire_ts is not None:
+        flow.append({**base, "ph": "f", "bp": "e", "tid": launch_tid,
+                     "ts": round(float(retire_ts), 3)})
+    return flow

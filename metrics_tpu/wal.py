@@ -128,6 +128,10 @@ class WalRecord(NamedTuple):
     session: str
     args: Tuple
     kwargs: Dict[str, Any]
+    # request id minted by MetricsService.submit() at admission time; 0 for
+    # pre-flight-recorder journals and non-UPDATE kinds. Replay reuses it so
+    # a request keeps its identity across a crash.
+    rid: int = 0
 
     @property
     def kind_name(self) -> str:
@@ -336,17 +340,22 @@ class WriteAheadLog:
         *,
         drop_seq: Optional[int] = None,
         drop_cause: Optional[str] = None,
+        request_id: Optional[int] = None,
     ) -> int:
         """Durably append one record; returns its sequence number. The
         record is on disk (fsync'd, unless disabled) before this returns —
         the contract ``submit()`` relies on. ``DROP`` frames carry the
-        dropped seq + cause in the header and no payload."""
+        dropped seq + cause in the header and no payload. ``request_id``
+        (UPDATE frames) persists the flight-recorder rid so replayed
+        requests keep their identity."""
         kwargs = kwargs or {}
         header: Dict[str, Any] = {"session": session}
         if kind == UPDATE:
             args = _to_numpy(args)
             kwargs = _to_numpy(kwargs)
             header["leaves"] = _leaf_summary(args, kwargs)
+            if request_id is not None:
+                header["rid"] = int(request_id)
             payload = pickle.dumps((args, kwargs))
         elif kind == DROP:
             header["drop"] = int(drop_seq if drop_seq is not None else 0)
@@ -391,9 +400,11 @@ class WriteAheadLog:
                 f.close()
                 self._active = None
                 self._active_path = None
+        extra = {} if request_id is None else {"rid": int(request_id)}
         telemetry.emit(
             "journal", self.owner, "append", t0=t0, stream="serve",
             seq=seq, record=_KIND_NAMES.get(kind, str(kind)), nbytes=len(frame),
+            **extra,
         )
         if roll:
             # next append opens wal-{seq+1}.seg; opening lazily keeps an
@@ -436,7 +447,10 @@ class WriteAheadLog:
                 args, kwargs = pickle.loads(payload)
             else:
                 args, kwargs = (), {}
-            records.append(WalRecord(seq, kind, str(header.get("session", "")), args, kwargs))
+            records.append(WalRecord(
+                seq, kind, str(header.get("session", "")), args, kwargs,
+                rid=int(header.get("rid", 0)),
+            ))
         with self._lock:
             self._stats["replayed"] += len(records)
         return records

@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 25
+    assert len(skipped) == 26
     assert "detail_elapsed_s" in detail
 
 
@@ -221,6 +221,26 @@ def test_telemetry_overhead_config_counts_and_keys(monkeypatch):
     assert detail["telemetry_instrumented_forward_us"] > 0
     assert 0 < detail["telemetry_idle_overhead_ratio"] < 2.0
     assert any(k.startswith("telemetry_retrace_cause_") for k in detail)
+    # the config must restore the kill switch it toggles
+    assert os.environ.get("METRICS_TPU_TELEMETRY") is None or (
+        os.environ["METRICS_TPU_TELEMETRY"] != "0")
+
+
+def test_request_tracing_config_counts_and_keys(monkeypatch):
+    """Pin the request-flight-recorder bench config: 'the recorder costs
+    nothing when nobody is listening' — the idle/off submit ratio key must
+    exist and stay near 1 (lenient bound for CI noise; BASELINE.md records
+    the real number, the acceptance target is <= 1.01 on a quiet machine),
+    and the instrumented pass must emit exactly one `request` span per
+    admitted submit."""
+    monkeypatch.delenv("METRICS_TPU_TELEMETRY", raising=False)
+    detail = {}
+    bench._cfg_request_tracing(detail, sessions=16, reps=2, loops=3)
+    assert detail["request_tracing_off_submit_us"] > 0
+    assert detail["request_tracing_idle_submit_us"] > 0
+    assert detail["request_tracing_instrumented_submit_us"] > 0
+    assert 0 < detail["request_tracing_idle_overhead_ratio"] < 2.0
+    assert detail["request_tracing_spans_per_submit"] == 1.0
     # the config must restore the kill switch it toggles
     assert os.environ.get("METRICS_TPU_TELEMETRY") is None or (
         os.environ["METRICS_TPU_TELEMETRY"] != "0")

@@ -1,0 +1,312 @@
+"""Request flight recorder (metrics_tpu/serve.py + telemetry.py).
+
+Every *admitted* submit is one ``request`` span carrying the four stage
+timings (``queue_us``/``journal_us``/``launch_us``/``retire_us``) and a
+request id that is unique per service, survives coalescing (the stacked
+launch span carries the rid *set*), survives a crash (journal replay
+reuses the journaled rid, tagged ``replayed=True``), and renders as one
+flow arrow (submit -> launch -> retire) in the Chrome export. The SLO
+sketches and memory attribution are always-on and exact where promised.
+"""
+import json
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, telemetry
+from metrics_tpu.serve import MetricsService, QueueFullError
+
+
+def _service(**kwargs):
+    return MetricsService(Accuracy(task="multiclass", num_classes=8), **kwargs)
+
+
+def _batch(rng, n=16, C=8):
+    return (
+        jnp.asarray(rng.randint(0, C, n)),
+        jnp.asarray(rng.randint(0, C, n)),
+    )
+
+
+# ---------------------------------------------------------------- tracing
+def test_one_request_span_per_admitted_submit_with_stage_attrs(tmp_path):
+    """The acceptance workload: a 1k-submit mixed multi-tenant run under
+    instrument() yields exactly one ``request`` span per admitted submit,
+    each with all four stage attrs and a unique rid; the SLO percentiles
+    agree with the raw span latencies within the sketch's relative error;
+    the memory total is exactly sum(leaf.nbytes)."""
+    rng = np.random.RandomState(0)
+    svc = _service(journal_dir=str(tmp_path / "wal"))
+    n_tenants, n_rounds = 8, 125  # 1000 submits total
+    with telemetry.instrument() as session:
+        for r in range(n_rounds):
+            for t in range(n_tenants):
+                svc.submit(f"tenant-{t}", *_batch(rng))
+            if r % 5 == 4:
+                svc.flush()
+        svc.drain()
+
+    spans = session.spans(name="request")
+    assert len(spans) == n_tenants * n_rounds == svc.stats["submits"]
+    rids = [e.attrs["rid"] for e in spans]
+    assert len(set(rids)) == len(rids)
+    assert sorted(rids) == list(range(1, len(rids) + 1))
+    for e in spans:
+        assert e.kind == "served"
+        for stage in ("queue_us", "journal_us", "launch_us", "retire_us"):
+            assert stage in e.attrs and e.attrs[stage] >= 0.0
+        # journaled service: the WAL write was timed, not skipped
+        assert e.attrs["journal_us"] > 0.0
+        assert e.attrs["session"].startswith("tenant-")
+
+    # SLO sketches vs the raw span durations (alpha=0.05, so allow a
+    # little beyond the nominal relative error for bin-edge effects)
+    slo = svc.slo_snapshot()
+    assert slo["totals"]["served"] == len(spans)
+    raw = np.asarray([e.dur_us for e in spans])
+    for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+        want = float(np.quantile(raw, q))
+        got = slo["totals"]["e2e_us"][key]
+        assert abs(got - want) / want < 0.15, (key, got, want)
+    for name, snap in slo["sessions"].items():
+        assert snap["served"] == n_rounds, name
+        assert snap["e2e_us"]["count"] == n_rounds, name
+
+    # memory accounting is exact
+    mem = svc.memory_snapshot(top_n=100)
+    assert mem["total_bytes"] == sum(leaf["nbytes"] for leaf in mem["leaves"])
+    assert mem["total_bytes"] == sum(
+        int(v.nbytes) for v in svc._stacked.values()
+    )
+    assert mem["leaf_count"] == len(svc._stacked)
+    snap = svc.telemetry_snapshot()
+    assert snap["memory"]["total_bytes"] == mem["total_bytes"]
+    assert "health" in snap
+
+
+def test_rid_uniqueness_under_concurrent_submits():
+    """rids are minted under the queue lock: 8 threads x 50 submits must
+    produce 400 distinct ids and 400 request spans."""
+    rng = np.random.RandomState(1)
+    svc = _service()
+    batches = [_batch(rng) for _ in range(8)]
+    errs = []
+
+    def worker(i):
+        try:
+            for _ in range(50):
+                svc.submit(f"t{i}", *batches[i])
+        except Exception as err:  # noqa: BLE001 - surfaced below
+            errs.append(err)
+
+    with telemetry.instrument() as session:
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        svc.drain()
+    assert not errs
+    spans = session.spans(name="request")
+    assert len(spans) == 400
+    rids = {e.attrs["rid"] for e in spans}
+    assert len(rids) == 400
+
+
+def test_coalescing_preserves_rid_set():
+    """Concatenating same-signature requests must not lose identity: the
+    stacked launch span carries every member rid, and every member still
+    retires as its own request span."""
+    rng = np.random.RandomState(2)
+    svc = _service()
+    with telemetry.instrument() as session:
+        for _ in range(4):  # 4 coalescable updates for one session
+            svc.submit("solo", *_batch(rng))
+        svc.drain()
+    assert svc.stats["coalesced_requests"] > 0
+
+    spans = session.spans(name="request")
+    assert len(spans) == 4
+    rids = sorted(e.attrs["rid"] for e in spans)
+
+    launches = [
+        e for e in session.spans(name="update") if "rids" in e.attrs
+    ]
+    assert launches
+    launched_rids = sorted(r for e in launches for r in e.attrs["rids"])
+    assert launched_rids == rids
+    assert all(e.attrs["rid_count"] == len(e.attrs["rids"]) for e in launches)
+
+
+def test_chrome_export_flow_arrows_and_thread_names(tmp_path):
+    """One admitted submit is one clickable arrow in Perfetto: flow start
+    (ph=s) inside the request slice on the submit lane, a step (ph=t) at
+    the launch, a finish (ph=f) at retirement — all sharing the rid as
+    the flow id — plus process/thread metadata records."""
+    rng = np.random.RandomState(3)
+    svc = _service()
+    with telemetry.instrument() as session:
+        for i in range(6):
+            svc.submit(f"s{i % 2}", *_batch(rng))
+        svc.drain()
+    path = tmp_path / "trace.json"
+    session.export_chrome_trace(str(path))
+    events = json.loads(path.read_text())["traceEvents"]
+
+    meta = [e for e in events if e["ph"] == "M"]
+    assert {"process_name", "thread_name"} <= {e["name"] for e in meta}
+
+    flows = [e for e in events if e["ph"] in ("s", "t", "f")]
+    spans = session.spans(name="request")
+    assert len([e for e in flows if e["ph"] == "s"]) == len(spans)
+    assert len([e for e in flows if e["ph"] == "f"]) == len(spans)
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e)
+    for rid, group in by_id.items():
+        phases = [e["ph"] for e in group]
+        assert phases[0] == "s" and phases[-1] == "f", (rid, phases)
+        # arrows point forward in time
+        ts = [e["ts"] for e in group]
+        assert ts == sorted(ts), (rid, ts)
+    span_rids = {e.attrs["rid"] for e in spans}
+    assert set(by_id) == span_rids
+
+
+def test_replay_emits_replayed_spans_and_skips_slo(tmp_path):
+    """Crash recovery: the journal tail replays every admitted submit as
+    a ``request`` span tagged ``replayed=True`` with the ORIGINAL rid —
+    but the recovered process's SLO counters stay clean (the crashed
+    process already served its callers... or never did; either way the
+    replay is bookkeeping, not traffic)."""
+    rng = np.random.RandomState(4)
+    wal_dir = str(tmp_path / "wal")
+    svc = _service(journal_dir=wal_dir)
+    batches = [_batch(rng) for _ in range(5)]
+    with telemetry.instrument() as session:
+        for i, b in enumerate(batches):
+            svc.submit(f"t{i % 2}", *b)
+        svc.drain()
+    live_rids = sorted(
+        e.attrs["rid"] for e in session.spans(name="request")
+    )
+    assert live_rids == [1, 2, 3, 4, 5]
+
+    fresh = _service(journal_dir=wal_dir)
+    with telemetry.instrument() as session2:
+        fresh.recover()
+        spans = session2.spans(name="request")
+        assert len(spans) == 5
+        assert all(e.attrs.get("replayed") is True for e in spans)
+        assert sorted(e.attrs["rid"] for e in spans) == live_rids
+
+        # replay never pollutes the SLOs...
+        slo = fresh.slo_snapshot()
+        assert slo["totals"]["served"] == 0
+        assert slo["sessions"] == {} or all(
+            s["served"] == 0 for s in slo["sessions"].values()
+        )
+        # ...and fresh traffic mints rids ABOVE the replayed ones
+        fresh.submit("t0", *batches[0])
+        fresh.drain()
+    assert fresh.slo_snapshot()["totals"]["served"] == 1
+    new = [
+        e for e in session2.spans(name="request")
+        if not e.attrs.get("replayed")
+    ]
+    assert len(new) == 1 and new[0].attrs["rid"] == max(live_rids) + 1
+
+    # recovered state matches the uncrashed twin
+    np.testing.assert_array_equal(
+        np.asarray(fresh.compute("t1")), np.asarray(svc.compute("t1"))
+    )
+
+
+def test_no_request_spans_while_idle():
+    """The recorder is subscription-gated: with no instrument() session
+    active, submits produce zero telemetry events but the SLO sketches
+    (always-on) still fill."""
+    rng = np.random.RandomState(5)
+    svc = _service()
+    for _ in range(3):
+        svc.submit("t", *_batch(rng))
+    svc.drain()
+    slo = svc.slo_snapshot()
+    assert slo["totals"]["served"] == 3
+    assert slo["sessions"]["t"]["served"] == 3
+    assert slo["sessions"]["t"]["e2e_us"]["count"] == 3
+
+
+# -------------------------------------------------------------------- SLOs
+def test_slo_counts_shed_and_breaker_outcomes():
+    rng = np.random.RandomState(6)
+    svc = _service(max_queue=4, admission="shed-oldest")
+    for i in range(10):
+        svc.submit("t", *_batch(rng))
+    svc.drain()
+    slo = svc.slo_snapshot()
+    assert slo["totals"]["shed"] == 6
+    assert slo["totals"]["served"] == 4
+    assert slo["sessions"]["t"]["shed"] == 6
+
+    svc2 = _service(max_queue=4, admission="reject")
+    for i in range(4):
+        svc2.submit("t", *_batch(rng))
+    with pytest.raises(QueueFullError):
+        svc2.submit("t", *_batch(rng))
+    svc2.drain()
+    assert svc2.slo_snapshot()["totals"]["rejected"] == 1
+
+
+def test_health_gauges_and_breaker_view_are_nonmutating():
+    rng = np.random.RandomState(7)
+    svc = _service()
+    for i in range(3):
+        svc.submit(f"t{i}", *_batch(rng))
+    h = svc.health()
+    assert h["queue_depth"] == 3
+    assert h["sessions"] == 3
+    assert h["free_rows"] == h["capacity"] - 3
+    svc.drain()
+    h = svc.health()
+    assert h["queue_depth"] == 0 and h["inflight"] == 0
+    # reading health() twice must not burn breaker cooldowns
+    assert svc.health()["breakers"] == h["breakers"]
+
+
+def test_gauge_spans_per_flush():
+    rng = np.random.RandomState(8)
+    svc = _service()
+    with telemetry.instrument() as session:
+        for _ in range(2):
+            svc.submit("t", *_batch(rng))
+            svc.flush()
+        svc.drain()
+    gauges = session.spans(name="gauge")
+    kinds = [e.kind for e in gauges]
+    assert kinds.count("health") == 2
+    assert kinds.count("memory") == 2
+    mem = [e for e in gauges if e.kind == "memory"][-1]
+    assert mem.attrs["total_bytes"] == svc.memory_snapshot()["total_bytes"]
+
+
+# ----------------------------------------------------------- flush worker
+def test_background_flush_worker_serves_without_explicit_flush():
+    rng = np.random.RandomState(9)
+    svc = _service(flush_interval_s=0.02)
+    try:
+        with telemetry.instrument() as session:
+            svc.submit("t", *_batch(rng))
+            deadline = threading.Event()
+            for _ in range(100):  # up to ~2s for the worker to pick it up
+                if svc.slo_snapshot()["totals"]["served"] == 1:
+                    break
+                deadline.wait(0.02)
+            assert svc.slo_snapshot()["totals"]["served"] == 1
+        assert "flush-worker" in telemetry.thread_names().values()
+    finally:
+        svc.shutdown()
+    assert svc._flush_thread is None
+    svc.shutdown()  # idempotent

@@ -341,7 +341,12 @@ def test_telemetry_snapshot_shape():
     assert snap["owner"] == "MetricsService[Accuracy]"
     assert snap["sessions"] == 1 and snap["capacity"] >= 64
     assert snap["serve"]["submits"] == 1 and snap["serve"]["launches"] == 1
-    assert set(snap) == {"owner", "serve", "sessions", "capacity", "resilience", "aot_cache", "wal"}
+    assert set(snap) == {
+        "owner", "serve", "sessions", "capacity", "resilience",
+        "aot_cache", "wal", "memory", "health",
+    }
+    assert snap["memory"]["total_bytes"] > 0
+    assert snap["health"]["sessions"] == 1
     assert snap["wal"] is None  # no journal_dir configured
 
 

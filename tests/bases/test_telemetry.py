@@ -98,12 +98,18 @@ def test_instrumented_fused_collection_eval(tmp_path):
     session.export_chrome_trace(str(chrome))
     doc = json.loads(chrome.read_text())
     events = doc["traceEvents"]
-    assert len(events) == len(session.events)
-    for entry in events:
+    # metadata records (ph "M": process/thread names) and request flow
+    # arrows (ph s/t/f) ride along; every telemetry event maps to exactly
+    # one slice/instant record
+    slices = [e for e in events if e["ph"] not in ("M", "s", "t", "f")]
+    assert len(slices) == len(session.events)
+    meta = [e for e in events if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in meta)
+    for entry in slices:
         assert {"name", "ph", "ts", "pid", "tid"} <= set(entry)
         if entry["ph"] == "X":
             assert entry["dur"] > 0
-    assert any(entry["ph"] == "X" for entry in events)
+    assert any(entry["ph"] == "X" for entry in slices)
 
 
 def test_jsonl_roundtrip_through_trace_report(tmp_path):
@@ -364,3 +370,36 @@ def test_collection_telemetry_snapshot_includes_members():
     assert set(snap["members"]) == {"acc", "prec"}
     assert snap["members"]["acc"]["owner"] == "Accuracy"
     assert snap["dispatch"]["dispatches"] >= 1  # the fused update launch
+
+
+def test_metric_memory_snapshot_is_exact():
+    rng = np.random.RandomState(11)
+    m = Accuracy(num_classes=C, average="macro")
+    m.update(*_batch(rng, 32))
+    mem = m.memory_snapshot(top_n=100)
+    assert mem["total_bytes"] == sum(leaf["nbytes"] for leaf in mem["leaves"])
+    assert mem["leaf_count"] == len(m._defaults)
+    for leaf in mem["leaves"]:
+        state = getattr(m, leaf["name"])
+        assert leaf["nbytes"] == int(jnp.asarray(state).nbytes)
+        assert leaf["shape"] == tuple(jnp.shape(state))
+    # desc order, exact total also in the full telemetry snapshot
+    sizes = [leaf["nbytes"] for leaf in mem["leaves"]]
+    assert sizes == sorted(sizes, reverse=True)
+    assert m.telemetry_snapshot()["memory"]["total_bytes"] == mem["total_bytes"]
+
+
+def test_collection_memory_snapshot_prefixes_members():
+    rng = np.random.RandomState(12)
+    col = MetricCollection(
+        {"acc": Accuracy(num_classes=C), "prec": Precision(num_classes=C)}
+    )
+    col.update(*_batch(rng, 32))
+    mem = col.memory_snapshot(top_n=100)
+    assert mem["total_bytes"] == sum(
+        col[k].memory_snapshot()["total_bytes"] for k in ("acc", "prec")
+    )
+    names = {leaf["name"] for leaf in mem["leaves"]}
+    assert all("/" in n for n in names)
+    assert any(n.startswith("acc/") for n in names)
+    assert any(n.startswith("prec/") for n in names)

@@ -180,3 +180,60 @@ def test_sketch_masked_update_padded_lane_is_noop():
         before = np.asarray(s.value)
         s._masked_update(jnp.zeros(32, bool), vals)
         np.testing.assert_array_equal(np.asarray(s.value), before)
+
+
+# ----------------------------------------------------------- host sketch
+def test_host_sketch_matches_device_binning():
+    """HostQuantileSketch fills the exact (2*bins+1,) bin layout the
+    device QuantileSketch uses — identical counts array, and quantiles
+    that agree to f32-vs-f64 magnitude rounding."""
+    from metrics_tpu.streaming import HostQuantileSketch
+
+    rng = np.random.RandomState(7)
+    data = (np.abs(rng.randn(5000)) * 40 + 0.5).astype(np.float32)
+    host = HostQuantileSketch(bins=128, alpha=0.01)
+    host.add_many(data)
+    dev = QuantileSketch(bins=128, alpha=0.01)
+    dev.update(jnp.asarray(data))
+    np.testing.assert_array_equal(host.counts, np.asarray(dev.value))
+    for q in (0.1, 0.5, 0.9, 0.99):
+        got, want = host.quantile(q), float(dev.quantile(q))
+        assert abs(got - want) / want < 1e-4, (q, got, want)
+
+
+def test_host_sketch_relative_error_and_merge():
+    from metrics_tpu.streaming import HostQuantileSketch
+
+    rng = np.random.RandomState(8)
+    a = (np.abs(rng.randn(8000)) * 100 + 1).astype(np.float64)
+    b = (np.abs(rng.randn(8000)) * 10 + 1).astype(np.float64)
+    ha = HostQuantileSketch(alpha=0.01)
+    hb = HostQuantileSketch(alpha=0.01)
+    ha.add_many(a)
+    hb.add_many(b)
+    ha.merge(hb)
+    both = np.concatenate([a, b])
+    assert ha.count == len(both)
+    for q in (0.25, 0.5, 0.95):
+        got = ha.quantile(q)
+        want = float(np.quantile(both, q))
+        assert abs(got - want) / want < 0.03, (q, got, want)
+    with pytest.raises(ValueError):
+        ha.merge(HostQuantileSketch(bins=64, alpha=0.01))
+
+
+def test_host_sketch_empty_nan_and_roundtrip():
+    from metrics_tpu.streaming import HostQuantileSketch
+
+    h = HostQuantileSketch()
+    assert np.isnan(h.quantile(0.5))
+    assert h.count == 0
+    h.add(float("nan"))  # dropped, not binned
+    assert h.count == 0
+    h.add_many([3.0, 7.0, 11.0])
+    snap = h.snapshot()
+    assert snap["count"] == 3
+    assert snap["p50"] == pytest.approx(7.0, rel=0.05)
+    dev = h.to_device()
+    assert float(dev.quantile(0.5)) == pytest.approx(7.0, rel=0.05)
+    assert h.nbytes == h.counts.nbytes

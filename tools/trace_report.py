@@ -170,6 +170,56 @@ def summarize(events: List[Dict[str, Any]]) -> str:
         for e in sketches:
             by_owner[e.get("owner", "?")] = by_owner.get(e.get("owner", "?"), 0) + 1
         lines.append("sketch ops: " + "   ".join(f"{o}: {n}" for o, n in sorted(by_owner.items())))
+    # request flight recorder (metrics_tpu.serve): one `request` span per
+    # admitted submit with the end-to-end latency and its stage breakdown
+    requests = [e for e in events if e["name"] == "request"]
+    if requests:
+        by_outcome: Dict[str, int] = {}
+        for e in requests:
+            by_outcome[e.get("kind", "?")] = by_outcome.get(e.get("kind", "?"), 0) + 1
+        replayed_reqs = sum(1 for e in requests if (e.get("attrs") or {}).get("replayed"))
+        lines.append("")
+        lines.append(
+            "requests: "
+            + "   ".join(f"{k}: {n}" for k, n in sorted(by_outcome.items()))
+            + (f"   replayed: {replayed_reqs}" if replayed_reqs else "")
+        )
+        e2e = sorted(e.get("dur_us", 0.0) for e in requests)
+        lines.append(
+            f"  {'e2e':<12}p50 {_percentile(e2e, 50):>10.1f} us"
+            f"   p95 {_percentile(e2e, 95):>10.1f} us"
+            f"   p99 {_percentile(e2e, 99):>10.1f} us"
+        )
+        for stage in ("queue_us", "journal_us", "launch_us", "retire_us"):
+            vals = sorted(
+                float((e.get("attrs") or {}).get(stage, 0.0)) for e in requests
+            )
+            lines.append(
+                f"  {stage[:-3]:<12}p50 {_percentile(vals, 50):>10.1f} us"
+                f"   p95 {_percentile(vals, 95):>10.1f} us"
+                f"   p99 {_percentile(vals, 99):>10.1f} us"
+            )
+
+    # memory gauges (serve flight recorder): the latest per-flush sample of
+    # stacked-state bytes, with the largest leaves — the sharding input
+    mem_gauges = [
+        e for e in events if e["name"] == "gauge" and e.get("kind") == "memory"
+    ]
+    if mem_gauges:
+        latest = max(mem_gauges, key=lambda e: e.get("ts_us", 0.0))
+        attrs = latest.get("attrs") or {}
+        lines.append("")
+        lines.append(
+            f"state memory: {attrs.get('total_bytes', 0)} bytes over "
+            f"{attrs.get('leaf_count', 0)} leaves ({latest.get('owner', '?')})"
+        )
+        for entry in attrs.get("top", []):
+            try:
+                leaf_name, nbytes = entry[0], entry[1]
+            except (TypeError, IndexError, KeyError):
+                continue
+            lines.append(f"  {str(leaf_name):<28}{nbytes:>12} bytes")
+
     degrades = [
         e for e in events
         if e["name"] == "degrade" and e.get("kind") in ("admission", "session")
@@ -233,6 +283,51 @@ def run_instrumented_bench(path: str) -> None:
     print(f"wrote {path} and {chrome_path} (Perfetto-loadable)", file=sys.stderr)
 
 
+def run_slo_demo(path: str) -> None:
+    """A short mixed serving workload (multi-tenant submits + a shed burst)
+    under instrumentation, then the live SLO / health / memory views —
+    what `make slo` prints. The trace lands at ``path`` (+ ``.trace.json``
+    for Perfetto, request spans linked submit→launch→retire by flows)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, telemetry
+    from metrics_tpu.serve import MetricsService, QueueFullError
+
+    rng = np.random.RandomState(11)
+    svc = MetricsService(
+        Accuracy(task="multiclass", num_classes=8),
+        max_queue=64,
+        admission="shed-oldest",
+    )
+    with telemetry.instrument() as session:
+        for step in range(6):
+            for i in range(32):
+                preds = jnp.asarray(rng.randint(0, 8, 32))
+                target = jnp.asarray(rng.randint(0, 8, 32))
+                svc.submit(f"tenant-{i % 8}", preds, target)
+            svc.flush()
+        # overload burst: every submit past the bound sheds the oldest
+        for i in range(96):
+            preds = jnp.asarray(rng.randint(0, 8, 32))
+            target = jnp.asarray(rng.randint(0, 8, 32))
+            try:
+                svc.submit(f"tenant-{i % 8}", preds, target)
+            except QueueFullError:
+                pass
+        svc.drain()
+    session.export_jsonl(path)
+    session.export_chrome_trace(path.rsplit(".", 1)[0] + ".trace.json")
+
+    print("== slo_snapshot() ==")
+    print(json.dumps(svc.slo_snapshot(), indent=2, default=str))
+    print("== health() ==")
+    print(json.dumps(svc.health(), indent=2, default=str))
+    print("== memory ==")
+    print(json.dumps(svc.memory_snapshot(), indent=2, default=str))
+    print(f"wrote {path} (Perfetto: {path.rsplit('.', 1)[0]}.trace.json)", file=sys.stderr)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("trace", help="telemetry JSONL file to summarize (written first with --bench)")
@@ -241,9 +336,17 @@ def main(argv=None) -> int:
         action="store_true",
         help="run a short instrumented fused-collection eval and export it to TRACE first",
     )
+    parser.add_argument(
+        "--slo",
+        action="store_true",
+        help="run a short instrumented serving workload, print slo_snapshot()/"
+        "health()/memory, export the trace to TRACE, then summarize it",
+    )
     args = parser.parse_args(argv)
     if args.bench:
         run_instrumented_bench(args.trace)
+    if args.slo:
+        run_slo_demo(args.trace)
     print(summarize(load_events(args.trace)))
     return 0
 
