@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos crash doctest audit bench bench-forward serve-bench stream-bench trace slo tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos chaos-fabric crash load doctest audit bench bench-forward serve-bench stream-bench trace slo tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -57,6 +57,7 @@ chaos:
 		METRICS_TPU_INJECT_FAULT=$$f python -m pytest tests/bases/test_chaos.py -k ambient -q || exit 1; \
 	done
 	$(MAKE) crash
+	$(MAKE) load
 
 # kill-and-recover loop: for EVERY registered crash point a subprocess is
 # SIGKILLed at that instruction, then a fresh process recover()s
@@ -66,6 +67,23 @@ chaos:
 # representative point).
 crash:
 	python -m pytest tests/bases/test_crash_recovery.py -q -m 'chaos or slow'
+
+# shard-death lane (metrics_tpu.fabric): SIGKILL one fabric shard at every
+# registered crash point, fence the epoch, replay its journal on a peer,
+# and require compute_all() bit-identical to an uncrashed twin — zombie
+# writers at the stale epoch must raise StaleEpochError. Then one loadgen
+# run with a mid-stream kill to exercise failover under live traffic.
+chaos-fabric:
+	python -m pytest tests/bases/test_crash_recovery.py -k shard_death -q
+	python tools/loadgen.py --sessions 48 --events 1200 --shards 2 --seed 11 --kill-shard 0
+
+# open-loop overload harness (tools/loadgen.py): replayable heavy-tailed
+# arrivals with hot-key skew over a sharded fabric, calibrated by warm
+# bursts then driven at 2x sustained capacity. Exits non-zero if any
+# structural pin breaks: per-shard coalesced launches, bounded queues,
+# zero cross-shard collectives on submit, no shedding below 1.5x.
+load:
+	python tools/loadgen.py --sessions 64 --events 2000 --shards 2 --seed 7
 
 # on-device smoke suite: needs a live TPU backend (skips itself otherwise)
 tpu-smoke:

@@ -560,6 +560,131 @@ def _cfg_request_tracing(detail: dict, sessions: int = 64, reps: int = 3, loops:
     )
 
 
+def _cfg_fabric(
+    detail: dict,
+    sessions: int = 128,
+    events: int = 2000,
+    shards: int = 4,
+    overload: float = 2.0,
+) -> None:
+    """Sharded serving fabric (:mod:`metrics_tpu.fabric`) capacity +
+    failover numbers — the bench face of ``tools/loadgen.py``.
+
+    Four claims. (1) **Sustained throughput**: warm updates/sec through
+    an N-shard fleet (last of three max-rate bursts, so coalesce-bucket
+    compiles are amortized out). (2) **Overload posture**: at
+    ``overload``x the calibrated rate with bounded per-shard queues and
+    ``shed-oldest`` admission, the fleet sheds instead of queueing
+    without bound — shed rate and served-request p99 are the keys.
+    (3) **Failover**: SIGKILL-equivalent shard death → fenced replay on
+    a peer, timed from kill to the first recovered result. (4)
+    **Structure** (pinned, not timed): every stacked launch carries
+    exactly one ``@shard<k>`` owner tag and the submit path emits zero
+    collective events."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import telemetry
+    from metrics_tpu.classification import Accuracy
+    from metrics_tpu.fabric import ShardedMetricsService
+    from metrics_tpu.serve import QueueFullError
+
+    rng = np.random.RandomState(17)
+    C, B = 8, 16
+    names = [f"t{i:05d}" for i in range(sessions)]
+    batches = [
+        (jnp.asarray(rng.randint(0, C, B)), jnp.asarray(rng.randint(0, C, B)))
+        for _ in range(32)
+    ]
+    order = rng.randint(0, sessions, events)
+
+    fab = ShardedMetricsService(
+        Accuracy(task="multiclass", num_classes=C),
+        num_shards=shards,
+        max_queue=256,
+        admission="shed-oldest",
+        flush_interval_s=0.02,
+    )
+    collectives_0 = sum(
+        v for k, v in telemetry.snapshot().items() if k.startswith("collective")
+    )
+    with telemetry.instrument() as session:
+        # three max-rate bursts; the last is the warm capacity measurement
+        capacity = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(events):
+                fab.submit(names[int(order[i])], *batches[i % len(batches)])
+            fab.drain()
+            capacity = events / max(time.perf_counter() - t0, 1e-9)
+        detail["fabric_updates_per_sec"] = round(capacity, 1)
+
+        # open-loop overload: paced arrivals at overload x capacity
+        gaps = rng.pareto(2.0, events)
+        arrivals = np.cumsum(gaps / max(gaps.mean(), 1e-12)) / (overload * capacity)
+        pre_shed = sum(
+            int(s["serve"].get("shed_requests", 0))
+            for s in fab.fleet_snapshot()["shards"].values()
+        )
+        with telemetry.instrument() as osession:
+            t_start = time.perf_counter()
+            for i in range(events):
+                target = t_start + float(arrivals[i])
+                while time.perf_counter() < target:
+                    time.sleep(1e-4)
+                try:
+                    fab.submit(names[int(order[i])], *batches[i % len(batches)])
+                except QueueFullError:
+                    pass
+            fab.drain()
+        shed = sum(
+            int(s["serve"].get("shed_requests", 0))
+            for s in fab.fleet_snapshot()["shards"].values()
+        ) - pre_shed
+        detail["fabric_shed_rate_2x_overload"] = round(shed / max(events, 1), 4)
+        durs = sorted(
+            e.dur_us for e in osession.spans(name="request", kind="served") if e.dur_us
+        )
+        p99 = durs[min(len(durs) - 1, int(round(0.99 * (len(durs) - 1))))] if durs else 0.0
+        detail["fabric_p99_ms_2x_overload"] = round(p99 / 1e3, 3)
+
+    # structural pins: shard-tagged launches, collective-free submit path
+    launches = session.spans(name="update", kind="stacked-aot")
+    detail["fabric_launches_total"] = len(launches)
+    detail["fabric_launches_shard_tagged"] = sum(
+        1 for e in launches if "@shard" in e.owner
+    )
+    collectives_1 = sum(
+        v for k, v in telemetry.snapshot().items() if k.startswith("collective")
+    )
+    detail["fabric_submit_collectives"] = collectives_1 - collectives_0
+    fab.shutdown()
+
+    # failover: kill a shard with durable state, fence + replay on a peer,
+    # time kill -> first recovered result
+    with tempfile.TemporaryDirectory() as data_dir:
+        dfab = ShardedMetricsService(
+            Accuracy(task="multiclass", num_classes=C),
+            num_shards=2,
+            data_dir=data_dir,
+        )
+        for i in range(64):
+            dfab.submit(names[i % sessions], *batches[i % len(batches)])
+        dfab.drain()
+        dfab.checkpoint()
+        victim = dfab.shard_for(names[0])
+        t0 = time.perf_counter()
+        dfab.kill_shard(victim)
+        dfab.fail_over(victim)
+        jax.block_until_ready(dfab.compute(names[0]))
+        detail["fabric_failover_first_result_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1
+        )
+        dfab.shutdown()
+
+
 def _cfg_resilience_overhead(detail: dict) -> None:
     """Idle cost of the resilience engine on the fused forward path.
 
@@ -1440,6 +1565,7 @@ def _bench_detail() -> dict:
         ("wal_append_overhead_ratio", _cfg_crash_recovery),
         ("window_advance_us", _cfg_streaming),
         ("request_tracing_idle_overhead_ratio", _cfg_request_tracing),
+        ("fabric_updates_per_sec", _cfg_fabric),
     ]
     detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
     return detail
@@ -1661,6 +1787,7 @@ def _bench_detail_fast() -> dict:
         ("serving", _cfg_serving),
         ("crash_recovery", lambda d: _cfg_crash_recovery(d, sessions=32, steps=2, tail=200)),
         ("request_tracing", lambda d: _cfg_request_tracing(d, sessions=32, reps=2, loops=3)),
+        ("fabric", lambda d: _cfg_fabric(d, sessions=32, events=300, shards=2)),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),

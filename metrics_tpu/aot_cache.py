@@ -333,13 +333,28 @@ def store(
     if path is None or fmt is None:
         return False
     try:
+        payload = None
         if fmt == "executable" and compiled is not None:
             payload_bytes, in_tree, out_tree = _serialize_executable.serialize(compiled)
-            payload = {"format": "executable", "payload": payload_bytes,
-                       "in_tree": in_tree, "out_tree": out_tree}
-        elif export_fn is not None and _jax_export is not None:
+            try:
+                # round-trip check: an executable that jax itself satisfied
+                # from its persistent compilation cache serializes WITHOUT
+                # its jit-compiled CPU symbols — the blob stores fine but
+                # every later load dies with "Symbols not found". A store
+                # that cannot be loaded back is a poison pill, so verify
+                # here (stores are per-program rare) and fall through to
+                # the StableHLO tier instead of writing it.
+                _serialize_executable.deserialize_and_load(
+                    payload_bytes, in_tree, out_tree
+                )
+            except Exception:  # noqa: BLE001 - any load failure disqualifies
+                payload = None
+            else:
+                payload = {"format": "executable", "payload": payload_bytes,
+                           "in_tree": in_tree, "out_tree": out_tree}
+        if payload is None and export_fn is not None and _jax_export is not None:
             payload = {"format": "stablehlo", "payload": export_fn().serialize()}
-        else:
+        if payload is None:
             return False
         body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
         os.makedirs(os.path.dirname(path), exist_ok=True)

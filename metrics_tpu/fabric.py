@@ -1,0 +1,529 @@
+"""Multi-host serving fabric: sharded :class:`MetricsService` with failover.
+
+The serving harness (:mod:`metrics_tpu.serve`) is crash-consistent and
+fully traced, but single-process: one host death is total outage, and one
+process bounds session count. This module is the horizontal layer over it
+— a :class:`ShardedMetricsService` partitions sessions across N
+``MetricsService`` shards and makes shard death a replay, not an outage:
+
+* **Consistent-hash routing.** Session ids map to shards through a
+  :class:`HashRing` (md5 points, ``vnodes`` virtual nodes per shard), so
+  the partition of a session is a pure function of its name — the submit
+  path does ZERO cross-shard work: no locks, no collectives, no queues
+  shared between shards (the structural pin ``tools/loadgen.py``
+  asserts). Each shard owns its stacked state rows, its write-ahead
+  journal directory (``shard-<k>/wal``), and its checkpoints
+  (``shard-<k>/ckpt``); request ids are minted on a per-shard lattice
+  (offset ``k``, stride ``N``) so rids stay globally unique with no
+  coordination.
+* **Shard death → replay on a peer.** A dead shard (SIGKILL of its host
+  process, or the injected ``shard-death`` fault from
+  :mod:`metrics_tpu.faults`) is detected by the liveness probe
+  (:meth:`ShardedMetricsService.probe`, or lazily at the next route to
+  it). Failover (:meth:`ShardedMetricsService.fail_over`) is the
+  sequence the WAL already made safe: **fence, then replay** — the
+  designated peer (next live shard clockwise on the ring) bumps the dead
+  shard's journal epoch (:func:`metrics_tpu.wal.fence_epoch`), builds a
+  fresh ``MetricsService`` over the dead shard's directories at the new
+  epoch, and ``recover()``\\ s it (checkpoint + sequence-fenced journal
+  tail, exactly-once). Any late write from the zombie — a submit or
+  checkpoint from the SIGKILLed-but-somehow-alive old host — raises
+  :class:`~metrics_tpu.wal.StaleEpochError` at the journal, so the two
+  hosts can never interleave frames.
+* **Fleet observability.** Every shard's spans carry its shard tag
+  (owner ``MetricsService[T]@shard<k>``, ``shard=`` attr on request
+  spans); failovers emit a ``failover`` telemetry span with the
+  epoch hand-off and the wall time to a recovered first result;
+  :meth:`fleet_snapshot` aggregates per-shard breaker state through
+  :func:`metrics_tpu.resilience.aggregate_policy_stats`.
+
+The chaos lane (``make chaos-fabric``) SIGKILLs a real subprocess shard
+at every crash point (``tests/bases/fabric_worker.py``) and asserts the
+post-failover ``compute_all()`` digest is bit-identical to an uncrashed
+twin; the open-loop load harness (``tools/loadgen.py``) drives heavy-
+tailed, hot-key-skewed replayable traffic across shards and pins the
+structural invariants under 2x overload. See ``docs/serving.md``,
+"Multi-host fabric".
+"""
+import copy
+import hashlib
+import os
+import threading
+import time
+from bisect import bisect_right
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu import faults, resilience, telemetry, wal
+from metrics_tpu.serve import MetricsService, ValueTicket
+
+__all__ = [
+    "HashRing",
+    "ShardedMetricsService",
+    "ShardDeadError",
+    "StaleEpochError",
+]
+
+# re-export: callers catching zombie writes shouldn't need to know the
+# fence lives in the journal layer
+StaleEpochError = wal.StaleEpochError
+
+
+class ShardDeadError(RuntimeError):
+    """The shard owning this session is dead and automatic failover is
+    disabled (``auto_failover=False``); call :meth:`fail_over` first."""
+
+
+def _point(key: str) -> int:
+    """Stable 64-bit ring coordinate (md5 — deterministic across
+    processes and PYTHONHASHSEED, well-mixed for small vnode counts)."""
+    return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring over shard ids with virtual nodes.
+
+    Routing is a pure function of the session name: hash the name, walk
+    clockwise to the next vnode, return its shard. Removing a shard
+    remaps ONLY that shard's arc (its sessions land on the clockwise
+    survivors) — the property failover relies on. Note the fabric keeps
+    dead partitions addressable by re-hosting them instead of shrinking
+    the ring, so session→shard stays stable across failovers; the ring's
+    clockwise walk also picks the designated recovery peer.
+    """
+
+    def __init__(self, shard_ids: List[int], vnodes: int = 64) -> None:
+        if not shard_ids:
+            raise ValueError("HashRing needs at least one shard")
+        self.vnodes = int(vnodes)
+        self.shard_ids = sorted(int(s) for s in shard_ids)
+        points: List[Tuple[int, int]] = []
+        for sid in self.shard_ids:
+            for v in range(self.vnodes):
+                points.append((_point(f"shard-{sid}:vnode-{v}"), sid))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._owners = [s for _, s in points]
+
+    def owner(self, session: str) -> int:
+        """The shard id owning ``session`` (clockwise successor vnode)."""
+        h = _point(str(session))
+        i = bisect_right(self._hashes, h) % len(self._hashes)
+        return self._owners[i]
+
+    def successor(self, shard_id: int, alive: Optional[List[int]] = None) -> int:
+        """Next shard clockwise from ``shard_id``'s first vnode — the
+        designated recovery peer. With ``alive`` given, dead candidates
+        are skipped (cascading failover)."""
+        candidates = set(self.shard_ids if alive is None else alive)
+        candidates.discard(shard_id)
+        if not candidates:
+            raise ShardDeadError(f"no live peer to recover shard {shard_id}")
+        start = _point(f"shard-{shard_id}:vnode-0")
+        i = bisect_right(self._hashes, start)
+        for step in range(len(self._hashes)):
+            sid = self._owners[(i + step) % len(self._hashes)]
+            if sid in candidates:
+                return sid
+        return sorted(candidates)[0]
+
+    def spread(self, sessions: List[str]) -> Dict[int, int]:
+        """Session count per shard (balance diagnostics / tests)."""
+        counts: Dict[int, int] = {sid: 0 for sid in self.shard_ids}
+        for name in sessions:
+            counts[self.owner(name)] += 1
+        return counts
+
+
+class _Shard:
+    """One partition: durable directories + the service currently hosting
+    it. The partition id is permanent; the hosting service is replaced on
+    failover (a fresh ``MetricsService`` at a higher epoch)."""
+
+    __slots__ = ("shard_id", "journal_dir", "checkpoint_dir", "service",
+                 "alive", "epoch", "host", "failovers")
+
+    def __init__(
+        self,
+        shard_id: int,
+        service: MetricsService,
+        journal_dir: Optional[str],
+        checkpoint_dir: Optional[str],
+        epoch: int,
+    ) -> None:
+        self.shard_id = shard_id
+        self.service = service
+        self.journal_dir = journal_dir
+        self.checkpoint_dir = checkpoint_dir
+        self.alive = True
+        self.epoch = epoch
+        # which partition's host serves this one (itself until failover)
+        self.host = shard_id
+        self.failovers = 0
+
+
+class ShardedMetricsService:
+    """N-shard serving fabric over one template metric.
+
+    Args:
+        template: the metric template (deep-copied per shard — shards
+            share nothing mutable).
+        num_shards: partition count. Session→shard is consistent hashing
+            of the session id (:class:`HashRing`), so the mapping is
+            stable across restarts and processes.
+        data_dir: root for per-shard durable state — shard ``k`` journals
+            under ``<data_dir>/shard-<k>/wal`` and checkpoints under
+            ``<data_dir>/shard-<k>/ckpt``. ``None`` disables durability
+            (pure in-memory shards; failover is impossible).
+        vnodes: virtual nodes per shard on the ring.
+        auto_failover: route-time behavior when the owning shard is dead
+            — ``True`` (default) runs :meth:`fail_over` inline and serves
+            the request on the recovered host; ``False`` raises
+            :class:`ShardDeadError`.
+        checkpoint_every / max_inflight / max_queue / admission /
+            admission_timeout_s / request_deadline_s / flush_interval_s /
+            coalesce:
+            passed through to every shard's :class:`MetricsService`
+            (queues and admission are strictly per-shard — one hot shard
+            sheds without touching its neighbors).
+
+    The ``shard-death`` fault class hooks the routing seam: while
+    ``faults.inject("shard-death", shard=k)`` is active, the next route
+    or probe touching shard ``k`` marks it dead, exactly as a missed
+    heartbeat would.
+    """
+
+    def __init__(
+        self,
+        template: Any,
+        num_shards: int = 4,
+        *,
+        data_dir: Optional[str] = None,
+        vnodes: int = 64,
+        auto_failover: bool = True,
+        coalesce: bool = True,
+        checkpoint_every: int = 0,
+        max_inflight: int = 2,
+        max_queue: Optional[int] = None,
+        admission: str = "block",
+        admission_timeout_s: Optional[float] = None,
+        request_deadline_s: Optional[float] = None,
+        flush_interval_s: Optional[float] = None,
+    ) -> None:
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        self.num_shards = int(num_shards)
+        self.data_dir = data_dir
+        self.auto_failover = bool(auto_failover)
+        self.label = f"ShardedMetricsService[{type(template).__name__}]"
+        self.ring = HashRing(list(range(self.num_shards)), vnodes=vnodes)
+        self._template = template
+        self._service_kwargs: Dict[str, Any] = {
+            "coalesce": coalesce,
+            "checkpoint_every": checkpoint_every,
+            "max_inflight": max_inflight,
+            "max_queue": max_queue,
+            "admission": admission,
+            "admission_timeout_s": admission_timeout_s,
+            "request_deadline_s": request_deadline_s,
+            "flush_interval_s": flush_interval_s,
+        }
+        # authoritative per-tenant overrides: re-applied to the recovery
+        # service after failover (overrides are routing metadata, not
+        # journaled state)
+        self._tenant_cfg: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self.stats: Dict[str, int] = {"failovers": 0, "dead_routes": 0}
+        self.failover_events: List[Dict[str, Any]] = []
+
+        self._shards: List[_Shard] = []
+        for k in range(self.num_shards):
+            journal_dir, checkpoint_dir = self.shard_dirs(k)
+            epoch = (wal.read_epoch(journal_dir) or 0) + 1 if journal_dir else 0
+            service = self._build_service(k, epoch)
+            self._shards.append(_Shard(k, service, journal_dir, checkpoint_dir, epoch))
+
+    # ---------------------------------------------------------------- layout
+    def shard_dirs(self, shard_id: int) -> Tuple[Optional[str], Optional[str]]:
+        """(journal_dir, checkpoint_dir) for one partition — the durable
+        unit a peer replays on failover. ``(None, None)`` without a
+        ``data_dir``."""
+        if self.data_dir is None:
+            return None, None
+        root = os.path.join(self.data_dir, f"shard-{shard_id:02d}")
+        return os.path.join(root, "wal"), os.path.join(root, "ckpt")
+
+    def _build_service(self, shard_id: int, epoch: int) -> MetricsService:
+        journal_dir, checkpoint_dir = self.shard_dirs(shard_id)
+        return MetricsService(
+            copy.deepcopy(self._template),
+            journal_dir=journal_dir,
+            checkpoint_dir=checkpoint_dir,
+            shard_id=shard_id,
+            rid_offset=shard_id,
+            rid_stride=self.num_shards,
+            epoch=epoch,
+            **self._service_kwargs,
+        )
+
+    # --------------------------------------------------------------- routing
+    def shard_for(self, name: str) -> int:
+        """The partition id owning session ``name`` (pure hash; no
+        cross-shard reads)."""
+        return self.ring.owner(name)
+
+    def _probe_death(self, shard: _Shard) -> None:
+        """Routing-seam hook for the ``shard-death`` fault class: an
+        active spec targeting this shard (param ``shard``, default = any)
+        kills it exactly as a missed liveness probe would."""
+        if not shard.alive:
+            return
+        params = faults.fault_params("shard-death")
+        target = params.get("shard")
+        if target is not None and int(target) != shard.shard_id:
+            return
+        if faults.should_fire("shard-death"):
+            self.kill_shard(shard.shard_id)
+
+    def _route(self, name: str) -> _Shard:
+        shard = self._shards[self.shard_for(name)]
+        self._probe_death(shard)
+        if not shard.alive:
+            self.stats["dead_routes"] += 1
+            if not self.auto_failover:
+                raise ShardDeadError(
+                    f"shard {shard.shard_id} (owner of session {name!r}) is "
+                    "dead; call fail_over() to recover it on a peer"
+                )
+            self.fail_over(shard.shard_id)
+        return shard
+
+    # ---------------------------------------------------------------- intake
+    def submit(
+        self, name: str, *args: Any, return_value: bool = False, **kwargs: Any
+    ) -> Optional[ValueTicket]:
+        """Route one update to the owning shard's queue. Strictly
+        shard-local past the hash: the owning service journals, admits,
+        and coalesces independently of every other shard."""
+        return self._route(name).service.submit(
+            name, *args, return_value=return_value, **kwargs
+        )
+
+    def update(self, name: str, *args: Any, **kwargs: Any) -> None:
+        shard = self._route(name)
+        shard.service.submit(name, *args, **kwargs)
+        shard.service.flush()
+
+    def forward(self, name: str, *args: Any, **kwargs: Any) -> Any:
+        return self._route(name).service.forward(name, *args, **kwargs)
+
+    def configure_session(self, name: str, **overrides: Any) -> None:
+        """Per-tenant admission overrides, fabric edition: recorded
+        authoritatively here, applied to the owning shard now, and
+        re-applied to the recovery service after a failover."""
+        self._tenant_cfg.setdefault(name, {}).update(overrides)
+        self._route(name).service.configure_session(name, **overrides)
+
+    def open_session(self, name: str) -> int:
+        return self._route(name).service.open_session(name)
+
+    def close_session(self, name: str) -> None:
+        self._route(name).service.close_session(name)
+
+    def reset_session(self, name: str) -> None:
+        self._route(name).service.reset_session(name)
+
+    # ----------------------------------------------------------------- fleet
+    def _live_shards(self) -> List[_Shard]:
+        return [s for s in self._shards if s.alive]
+
+    def _serving_shards(self) -> List[_Shard]:
+        """Every shard, healed: dead partitions are failed over first so a
+        fleet-wide read never silently drops a partition. With
+        ``auto_failover=False`` a dead shard raises instead — the caller
+        must :meth:`fail_over` (or :meth:`probe`) explicitly."""
+        for shard in self._shards:
+            self._probe_death(shard)
+            if not shard.alive:
+                if not self.auto_failover:
+                    raise ShardDeadError(
+                        f"shard {shard.shard_id} is dead; fail_over() it before "
+                        "fleet-wide reads (its partition would be missing)"
+                    )
+                self.fail_over(shard.shard_id)
+        return self._shards
+
+    def flush(self) -> int:
+        """Flush every live shard; returns total requests served. One
+        coalesced launch wave per shard per signature — shards never
+        share a launch (the per-shard structural pin)."""
+        return sum(s.service.flush() for s in self._live_shards())
+
+    def drain(self) -> None:
+        for s in self._live_shards():
+            s.service.drain()
+
+    def compute(self, name: str) -> Any:
+        return self._route(name).service.compute(name)
+
+    def compute_all(self) -> Dict[str, Any]:
+        """Every open session fleet-wide (partitions are disjoint, so the
+        union is exact). Dead shards are failed over first — a fleet read
+        never silently omits a partition."""
+        out: Dict[str, Any] = {}
+        for s in self._serving_shards():
+            out.update(s.service.compute_all())
+        return out
+
+    def checkpoint(self) -> List[str]:
+        return [s.service.checkpoint() for s in self._serving_shards()]
+
+    def recover(self) -> int:
+        """First-boot / restart recovery: every shard restores its own
+        checkpoint + journal tail (``missing_ok`` — fresh directories are
+        zero-config). Returns how many shards had a checkpoint."""
+        return sum(1 for s in self._live_shards() if s.service.recover())
+
+    def shutdown(self) -> None:
+        for s in self._live_shards():
+            s.service.shutdown()
+
+    # -------------------------------------------------------------- liveness
+    def heartbeat(self) -> Dict[int, bool]:
+        """One liveness sample per shard. A live shard answers its
+        ``health()`` probe; a dead one (killed, or with an active
+        ``shard-death`` fault targeting it) reports ``False``."""
+        beats: Dict[int, bool] = {}
+        for shard in self._shards:
+            self._probe_death(shard)
+            if shard.alive:
+                try:
+                    shard.service.health()
+                except Exception:  # noqa: BLE001 - a dead host answers nothing
+                    shard.alive = False
+            beats[shard.shard_id] = shard.alive
+        return beats
+
+    def probe(self) -> List[int]:
+        """Heartbeat sweep + failover of every dead shard. Returns the
+        shard ids failed over (the caller-driven liveness loop)."""
+        failed = [sid for sid, ok in self.heartbeat().items() if not ok]
+        for sid in failed:
+            self.fail_over(sid)
+        return failed
+
+    def kill_shard(self, shard_id: int) -> MetricsService:
+        """Mark one shard dead (the in-process twin of SIGKILLing its
+        host). The old service object is returned — it plays the zombie
+        in fencing tests: any journaled write through it after the peer
+        fences raises :class:`StaleEpochError`. No flush, no checkpoint,
+        no goodbye — exactly what SIGKILL leaves behind."""
+        shard = self._shards[shard_id]
+        shard.alive = False
+        return shard.service
+
+    def fail_over(self, shard_id: int) -> float:
+        """Recover a dead shard's partition on its designated peer.
+
+        Fence-then-replay: bump the partition's journal epoch
+        (:func:`metrics_tpu.wal.fence_epoch`) so the zombie is locked out
+        BEFORE any state moves, then build a fresh service over the dead
+        shard's directories at the new epoch and ``recover()`` it
+        (checkpoint + exactly-once journal tail). Per-tenant overrides
+        re-apply from the fabric's authoritative copy. Returns the
+        failover wall time in ms (fence + recover + first health probe) —
+        the ``failover`` telemetry span carries it, and the bench's
+        failover-to-first-result key builds on it."""
+        shard = self._shards[shard_id]
+        with self._lock:
+            if shard.alive and shard.failovers and shard.host != shard.shard_id:
+                return 0.0  # another thread already recovered it
+            if shard.journal_dir is None:
+                raise ShardDeadError(
+                    f"shard {shard_id} has no durable state (data_dir=None); "
+                    "its sessions are lost — nothing to replay on a peer"
+                )
+            peer = self.ring.successor(
+                shard_id, alive=[s.shard_id for s in self._live_shards()]
+            )
+            t0 = telemetry.clock()
+            w0 = time.monotonic()
+            new_epoch = max(shard.epoch, wal.read_epoch(shard.journal_dir)) + 1
+            wal.fence_epoch(shard.journal_dir, new_epoch)
+            service = self._build_service(shard_id, new_epoch)
+            service.recover()
+            for name, cfg in self._tenant_cfg.items():
+                if self.shard_for(name) == shard_id:
+                    service.configure_session(name, **cfg)
+            shard.service = service
+            shard.epoch = new_epoch
+            shard.alive = True
+            shard.host = peer
+            shard.failovers += 1
+            self.stats["failovers"] += 1
+            ms = (time.monotonic() - w0) * 1e3
+            event = {
+                "shard": shard_id,
+                "peer": peer,
+                "epoch": new_epoch,
+                "ms": round(ms, 3),
+                "sessions": service.session_count,
+            }
+            self.failover_events.append(event)
+            telemetry.emit(
+                "failover", self.label, "shard-death", t0=t0, stream="serve",
+                **event,
+            )
+            return ms
+
+    # ----------------------------------------------------------------- stats
+    def session_count(self) -> int:
+        return sum(s.service.session_count for s in self._live_shards())
+
+    def health(self) -> Dict[str, Any]:
+        """Fleet gauges: per-shard health plus liveness/epoch/host."""
+        return {
+            "shards": {
+                s.shard_id: {
+                    "alive": s.alive,
+                    "epoch": s.epoch,
+                    "host": s.host,
+                    "failovers": s.failovers,
+                    **(s.service.health() if s.alive else {}),
+                }
+                for s in self._shards
+            },
+            "sessions": self.session_count(),
+            "failovers": self.stats["failovers"],
+        }
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """Per-shard SLO views keyed by shard id (sessions are disjoint,
+        so per-tenant entries never collide across shards)."""
+        return {
+            s.shard_id: s.service.slo_snapshot() for s in self._live_shards()
+        }
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        """The fabric's telemetry roll-up: per-shard service snapshots,
+        aggregated breaker/resilience posture
+        (:func:`metrics_tpu.resilience.aggregate_policy_stats`), failover
+        history, and summed serve counters."""
+        per_shard = {
+            s.shard_id: s.service.telemetry_snapshot()
+            for s in self._live_shards()
+        }
+        totals: Dict[str, int] = {}
+        for snap in per_shard.values():
+            for k, v in snap["serve"].items():
+                totals[k] = totals.get(k, 0) + int(v)
+        return {
+            "owner": self.label,
+            "num_shards": self.num_shards,
+            "shards": per_shard,
+            "serve_totals": totals,
+            "resilience": resilience.aggregate_policy_stats(
+                snap["resilience"] for snap in per_shard.values()
+            ),
+            "failover_events": list(self.failover_events),
+            "health": self.health(),
+        }

@@ -32,6 +32,13 @@ fault name                where it fires
                           the checksum tier must convert it into a miss
                           plus a cause-tagged ``degrade`` span, and the
                           engine must fall through to a fresh compile
+``shard-death``           a serving-fabric shard stops responding to its
+                          liveness probe (:mod:`metrics_tpu.fabric`) —
+                          param ``shard`` targets one shard index
+                          (default: the first probed). The fabric must
+                          fence the dead shard's journal epoch and
+                          replay it on a designated peer; a write from
+                          the zombie raises ``StaleEpochError``
 ========================= ==============================================
 
 Activation is per-test via the context manager::
@@ -77,6 +84,7 @@ __all__ = [
     "inject",
     "check",
     "should_fire",
+    "fault_params",
     "check_oom",
     "maybe_poison",
     "maybe_corrupt_leaves",
@@ -97,6 +105,7 @@ FAULT_NAMES = (
     "state-corruption",
     "oom",
     "cache-corruption",
+    "shard-death",
 )
 
 _ENV_VAR = "METRICS_TPU_INJECT_FAULT"
@@ -210,6 +219,16 @@ def check(name: str, where: str = "") -> None:
     """Raising probe: raise :class:`InjectedFault` if ``name`` fires."""
     if should_fire(name):
         raise InjectedFault(name, where)
+
+
+def fault_params(name: str) -> Dict[str, Any]:
+    """Free-form params of the innermost active spec for ``name`` (empty
+    when inactive). Typed fault points use this to read their knobs
+    without consuming a fire slot — e.g. the fabric reads ``shard`` off
+    an active ``shard-death`` spec to decide which shard the probe
+    targets before calling :func:`should_fire`."""
+    spec = _lookup(name)
+    return dict(spec.params) if spec is not None else {}
 
 
 def fired_count(name: str) -> int:

@@ -117,7 +117,13 @@ from metrics_tpu import aot_cache, faults, resilience, telemetry, wal
 from metrics_tpu._compat import profiler_annotation
 from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
 
-__all__ = ["MetricsService", "MetricSession", "QueueFullError", "CircuitOpenError"]
+__all__ = [
+    "MetricsService",
+    "MetricSession",
+    "ValueTicket",
+    "QueueFullError",
+    "CircuitOpenError",
+]
 
 _MIN_SESSION_BUCKET = 8
 _MIN_CAPACITY = 64
@@ -135,6 +141,52 @@ class CircuitOpenError(RuntimeError):
     repeatedly and is in backoff cooldown (counted in submits)."""
 
 
+# sentinel for configure_session(): "leave this override untouched"
+_UNSET = object()
+
+
+class ValueTicket:
+    """Handle for one ``submit(..., return_value=True)``'s batch value.
+
+    The value is the template metric evaluated over that request's batch
+    alone (forward semantics: update a default state with the batch, then
+    compute) — produced by the SAME coalesced stacked launch that advances
+    the session state, not a per-row eager detour. :meth:`result` blocks
+    until the request's launch generation retires (``flush()`` +
+    ``drain()``, or the background flush worker); a shed / expired /
+    failed request resolves the ticket with the failure instead of
+    hanging its waiter."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def _resolve(self, value: Any) -> None:
+        if not self._event.is_set():
+            self._value = value
+            self._event.set()
+
+    def _reject(self, error: BaseException) -> None:
+        if not self._event.is_set():
+            self._error = error
+            self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """The batch value (blocks until retirement; raises the request's
+        failure for shed/expired/failed outcomes)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("request value is not ready; call flush()/drain()")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
 class _Request:
     """One admitted submit's flight record, threaded from the queue through
     coalescing and the stacked launch to retirement. Monotonic timestamps
@@ -144,7 +196,7 @@ class _Request:
     __slots__ = (
         "name", "args", "kwargs", "seq", "rid", "t_enq", "t0", "submit_tid",
         "journal_us", "queue_us", "launch_us", "launch_ts_us", "launch_tid",
-        "t_launch_done", "replayed", "members",
+        "t_launch_done", "replayed", "members", "deadline_s", "ticket", "value",
     )
 
     def __init__(
@@ -160,6 +212,8 @@ class _Request:
         *,
         journal_us: float = 0.0,
         replayed: bool = False,
+        deadline_s: Optional[float] = None,
+        ticket: Optional[ValueTicket] = None,
     ) -> None:
         self.name = name
         self.args = args
@@ -176,6 +230,13 @@ class _Request:
         self.launch_tid: Optional[int] = None
         self.t_launch_done: Optional[float] = None
         self.replayed = replayed
+        # effective deadline snapshot (per-tenant override or the service
+        # default) taken at admission; None = never expires
+        self.deadline_s = deadline_s
+        # forward-value plumbing: the waiter's handle and the staged
+        # per-request batch value from the stacked launch
+        self.ticket = ticket
+        self.value: Any = None
         # a coalesced merge keeps the original requests here so every one
         # of them retires (and traces) individually
         self.members: Optional[List["_Request"]] = None
@@ -238,6 +299,9 @@ class MetricSession:
     def update(self, *args: Any, **kwargs: Any) -> None:
         self._service.submit(self.name, *args, **kwargs)
 
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        return self._service.forward(self.name, *args, **kwargs)
+
     def compute(self) -> Any:
         return self._service.compute(self.name)
 
@@ -283,6 +347,20 @@ class MetricsService:
             :func:`metrics_tpu.telemetry.set_thread_name`); call
             :meth:`shutdown` to stop it. ``None`` (default) keeps the
             caller-driven flush model.
+        shard_id: fabric shard index this service hosts
+            (:mod:`metrics_tpu.fabric`). Tags the telemetry owner label
+            (``MetricsService[T]@shard<k>``) and every ``request`` span
+            with the shard, so fleet traces attribute work per shard.
+            ``None`` (default) keeps the single-host label.
+        rid_offset / rid_stride: request-id minting lattice. The fabric
+            gives shard ``k`` of ``N`` an offset ``k`` and stride ``N``,
+            so rids stay globally unique across shards with zero
+            cross-shard coordination on the submit path.
+        epoch: ownership epoch for the journal directory and checkpoint
+            ``__meta__`` (see :class:`metrics_tpu.wal.WriteAheadLog`).
+            A peer recovering a dead shard opens at the fenced epoch + 1;
+            the zombie's next journaled write raises
+            :class:`~metrics_tpu.wal.StaleEpochError`.
     """
 
     def __init__(
@@ -299,6 +377,10 @@ class MetricsService:
         admission_timeout_s: Optional[float] = None,
         request_deadline_s: Optional[float] = None,
         flush_interval_s: Optional[float] = None,
+        shard_id: Optional[int] = None,
+        rid_offset: int = 0,
+        rid_stride: int = 1,
+        epoch: int = 0,
     ) -> None:
         from metrics_tpu.collections import MetricCollection
         from metrics_tpu.metric import Metric
@@ -323,7 +405,15 @@ class MetricsService:
                 f"admission must be one of {_ADMISSION_POLICIES}, got {admission!r}"
             )
         self.template = template
-        self.label = f"MetricsService[{type(template).__name__}]"
+        self.shard_id = shard_id
+        self.epoch = int(epoch)
+        # the cache label is shard-agnostic so every shard of a fabric
+        # shares one persistent AOT store family (same programs); the
+        # telemetry label carries the shard tag for fleet attribution
+        self._cache_label = f"MetricsService[{type(template).__name__}]"
+        self.label = self._cache_label + (
+            f"@shard{shard_id}" if shard_id is not None else ""
+        )
         from metrics_tpu.streaming.window import _StreamingWindow
 
         # window wrappers count UPDATES (each submit is one window tick);
@@ -356,7 +446,8 @@ class MetricsService:
         # so rid order matches queue order.
         self._queue: List[_Request] = []
         self._queue_cond = threading.Condition()
-        self._rid = 0
+        self._rid_stride = max(1, int(rid_stride))
+        self._rid = int(rid_offset)
         # per-session SLO accounting (always on; host-side sketches)
         self._slo: Dict[str, _SessionSLO] = {}
         self._slo_lock = threading.Lock()
@@ -368,7 +459,10 @@ class MetricsService:
 
         self._wal: Optional[wal.WriteAheadLog] = None
         if journal_dir is not None and wal.wal_enabled():
-            self._wal = wal.WriteAheadLog(journal_dir, owner=self.label)
+            self._wal = wal.WriteAheadLog(journal_dir, owner=self.label, epoch=self.epoch)
+        # per-session config overrides (configure_session): deadline /
+        # admission policy per tenant, consulted at admission time
+        self._tenant_cfg: Dict[str, Dict[str, Any]] = {}
         # sessions explicitly closed: submit() for one raises KeyError until
         # open_session() reclaims the name (never-seen names still auto-open)
         self._closed: set = set()
@@ -460,6 +554,11 @@ class MetricsService:
         """Release ``name``'s row back to the pool (state reset to default).
         Further :meth:`submit` calls for the name raise ``KeyError`` until
         :meth:`open_session` reclaims it."""
+        if not self._replaying:
+            # ordering barrier: updates journaled before this CLOSE must
+            # apply before it, or replay (which honors sequence order)
+            # reconstructs a different state than the live process held
+            self.flush()
         row = self._rows.pop(name, None)
         if row is None:
             return
@@ -475,6 +574,10 @@ class MetricsService:
         """Reset one session's accumulator to the default state. Also clears
         the session's circuit breaker — a reset is the operator's explicit
         "this tenant is healthy again" signal."""
+        if not self._replaying:
+            # same ordering barrier as close_session: live application
+            # order must match the journal's sequence order
+            self.flush()
         row = self.open_session(name)
         if self._wal is not None and not self._replaying:
             self._wal.append(wal.RESET, name)
@@ -497,16 +600,65 @@ class MetricsService:
         self._compute_stack = None
 
     # --------------------------------------------------------------- intake
-    def submit(self, name: str, *args: Any, **kwargs: Any) -> None:
+    def configure_session(
+        self,
+        name: str,
+        *,
+        request_deadline_s: Any = _UNSET,
+        admission: Any = _UNSET,
+    ) -> None:
+        """Per-tenant overrides of the service-wide admission posture.
+
+        ``request_deadline_s`` replaces the service deadline for this
+        session's future submits (``None`` = this tenant never expires);
+        ``admission`` replaces the overload policy applied when *this
+        tenant's* submit meets a full queue (``None`` = back to the
+        service default). Unset arguments leave the existing override
+        untouched. Overrides are routing metadata, not state — they are
+        NOT journaled, and a fabric router re-applies them after failover
+        (:class:`metrics_tpu.fabric.ShardedMetricsService` keeps the
+        authoritative copy)."""
+        if admission is not _UNSET and admission is not None:
+            if admission not in _ADMISSION_POLICIES:
+                raise ValueError(
+                    f"admission must be one of {_ADMISSION_POLICIES}, got {admission!r}"
+                )
+        cfg = self._tenant_cfg.setdefault(name, {})
+        if request_deadline_s is not _UNSET:
+            cfg["request_deadline_s"] = request_deadline_s
+        if admission is not _UNSET:
+            cfg["admission"] = admission
+
+    def session_config(self, name: str) -> Dict[str, Any]:
+        """Effective admission config for one session (overrides folded
+        over the service defaults)."""
+        cfg = self._tenant_cfg.get(name, {})
+        return {
+            "request_deadline_s": cfg.get(
+                "request_deadline_s", self.request_deadline_s
+            ),
+            "admission": cfg.get("admission") or self.admission,
+        }
+
+    def submit(
+        self, name: str, *args: Any, return_value: bool = False, **kwargs: Any
+    ) -> Optional[ValueTicket]:
         """Enqueue one update for session ``name`` (thread-safe; the device
         work happens at the next :meth:`flush`).
 
         Order of gates: a closed session raises ``KeyError`` immediately
         (never deep inside the coalescer); an open circuit breaker raises
         :class:`CircuitOpenError`; a full bounded queue engages the
-        admission policy. Only an *admitted* request is journaled — by the
-        time this returns, the record is durable and the request is
-        eligible for flush, in that order (the write-ahead contract)."""
+        admission policy — the *submitting session's* policy when
+        :meth:`configure_session` set one. Only an *admitted* request is
+        journaled — by the time this returns, the record is durable and
+        the request is eligible for flush, in that order (the write-ahead
+        contract).
+
+        With ``return_value=True`` the returned :class:`ValueTicket`
+        resolves at retirement to the metric's value over this batch alone
+        (forward semantics), computed by the same coalesced stacked launch
+        that advances the session state."""
         if name in self._closed:
             raise KeyError(
                 f"session {name!r} has been closed; call open_session({name!r}) "
@@ -526,11 +678,16 @@ class MetricsService:
                 f"({breaker.cooldown} more submits) or reset_session()"
             )
         self.open_session(name)
+        cfg = self._tenant_cfg.get(name)
+        deadline_s = self.request_deadline_s
+        if cfg is not None and "request_deadline_s" in cfg:
+            deadline_s = cfg["request_deadline_s"]
+        ticket = ValueTicket() if return_value else None
         t0 = telemetry.clock()  # span anchor; None while telemetry is idle
         with self._queue_cond:
             if self.max_queue is not None and len(self._queue) >= self.max_queue:
                 self._admit_locked(name)
-            self._rid += 1
+            self._rid += self._rid_stride
             rid = self._rid
             seq: Optional[int] = None
             journal_us = 0.0
@@ -544,18 +701,23 @@ class MetricsService:
             self._queue.append(_Request(
                 name, args, kwargs, seq, rid,
                 time.monotonic(), t0, threading.get_ident(),
-                journal_us=journal_us,
+                journal_us=journal_us, deadline_s=deadline_s, ticket=ticket,
             ))
             self.stats["submits"] += 1
+        return ticket
 
     def _admit_locked(self, name: str) -> None:
         """Resolve a full queue under the admission policy (queue condition
         held). Returns with space available, or raises
-        :class:`QueueFullError`. Every victim/rejection is one cause-tagged
-        ``degrade`` span; shed victims also get a journal ``DROP`` record
-        so recovery replays exactly what live served."""
+        :class:`QueueFullError`. The policy applied is the submitting
+        session's (:meth:`configure_session` override, else the service
+        default). Every victim/rejection is one cause-tagged ``degrade``
+        span; shed victims also get a journal ``DROP`` record so recovery
+        replays exactly what live served."""
         assert self.max_queue is not None
-        if self.admission == "shed-oldest":
+        cfg = self._tenant_cfg.get(name)
+        policy = (cfg.get("admission") if cfg else None) or self.admission
+        if policy == "shed-oldest":
             while len(self._queue) >= self.max_queue:
                 victim = self._queue.pop(0)
                 if self._wal is not None and victim.seq is not None:
@@ -570,7 +732,7 @@ class MetricsService:
                 )
                 self._finish_request(victim, "shed")
             return
-        if self.admission == "block":
+        if policy == "block":
             deadline = (
                 None
                 if self.admission_timeout_s is None
@@ -587,17 +749,27 @@ class MetricsService:
         self._slo_record(name, "rejected")
         telemetry.emit(
             "degrade", self.label, kind="admission", cause="queue-full-reject",
-            session=name, policy=self.admission,
+            session=name, policy=policy,
         )
         raise QueueFullError(
             f"submit queue is full ({self.max_queue} requests); admission "
-            f"policy {self.admission!r} rejected session {name!r}"
+            f"policy {policy!r} rejected session {name!r}"
         )
 
     def update(self, name: str, *args: Any, **kwargs: Any) -> None:
         """Synchronous convenience: submit + flush."""
         self.submit(name, *args, **kwargs)
         self.flush()
+
+    def forward(self, name: str, *args: Any, **kwargs: Any) -> Any:
+        """Synchronous forward: advance session ``name`` with the batch AND
+        return the metric's value over this batch alone, served by the
+        coalesced stacked launch (one device program even when other
+        sessions' traffic rides the same flush)."""
+        ticket = self.submit(name, *args, return_value=True, **kwargs)
+        self.drain()
+        assert ticket is not None
+        return ticket.result()
 
     # ---------------------------------------------------------------- flush
     def flush(self) -> int:
@@ -677,17 +849,22 @@ class MetricsService:
 
     def _expire_stale(self, queued: List[_Request]) -> List[_Request]:
         """Deadline gate at the head of flush: queued requests older than
-        ``request_deadline_s`` are expired — one ``deadline-expired``
-        degrade span + journal ``DROP`` each — instead of served. Replayed
+        their effective deadline — the per-tenant
+        :meth:`configure_session` override snapshotted at admission, else
+        ``request_deadline_s`` — are expired (one ``deadline-expired``
+        degrade span + journal ``DROP`` each) instead of served. Replayed
         records are never expired (the live process already made their
         deadline decision)."""
-        deadline = self.request_deadline_s
-        if deadline is None or self._replaying:
+        if self._replaying or all(req.deadline_s is None for req in queued):
             return queued
         now = time.monotonic()
         live: List[_Request] = []
         for req in queued:
-            if not req.replayed and now - req.t_enq > deadline:
+            if (
+                not req.replayed
+                and req.deadline_s is not None
+                and now - req.t_enq > req.deadline_s
+            ):
                 if self._wal is not None and req.seq is not None:
                     self._wal.append(
                         wal.DROP, req.name,
@@ -713,7 +890,9 @@ class MetricsService:
             by_session.setdefault(req.name, []).append(req)
         out: List[_Request] = []
         for name, reqs in by_session.items():
-            if len(reqs) > 1:
+            # a forward-value request's batch is its identity (the value
+            # is computed over THAT batch); merging would change it
+            if len(reqs) > 1 and not any(r.ticket is not None for r in reqs):
                 merged = self._try_concat(name, reqs)
                 if merged is not None:
                     self.stats["coalesced_requests"] += len(reqs) - 1
@@ -784,6 +963,10 @@ class MetricsService:
                     treedef,
                     tuple((x.shape[1:], x.dtype) for x in flat),
                     bucket_pow2(batch, minimum=_MIN_SESSION_BUCKET),
+                    # forward-value requests compile a program that also
+                    # emits per-session batch values; they group together
+                    # and still ride ONE stacked launch
+                    req.ticket is not None,
                 )
                 groups.setdefault(sig, []).append(
                     (req, args, dynamic, static, flat, batch)
@@ -794,7 +977,7 @@ class MetricsService:
             self._launch_group(sig, group)
 
     def _launch_group(self, sig: Tuple, group: List) -> None:
-        static_key, treedef, _, b_bucket = sig
+        static_key, treedef, _, b_bucket, want_value = sig
         static = group[0][3]
         if not (self.template._masked_update_supported() and self._policy.allow()):
             for req, args, dynamic, static_kw, _, _ in group:
@@ -827,6 +1010,7 @@ class MetricsService:
             s_bucket,
             b_bucket,
             self._capacity,
+            want_value,
             tuple((x.shape, str(x.dtype)) for x in stacked_flat),
             tuple((self._stacked[k].shape, str(self._stacked[k].dtype)) for k in self._names),
         )
@@ -835,13 +1019,16 @@ class MetricsService:
             if compiled is not None:
                 self._exec_cache.move_to_end(key)
             else:
-                compiled = self._compile_stacked(key, static, treedef, stacked_flat)
+                compiled = self._compile_stacked(
+                    key, static, treedef, stacked_flat, want_value=want_value
+                )
             faults.check("launch", self.label)
             state_leaves = tuple(self._stacked[k] for k in self._names)
             reqs = [r for entry in group for r in entry[0].all()]
             rids = [r.rid for r in reqs]
             t0 = telemetry.clock()
             l0 = time.monotonic()
+            vals = None
             with profiler_annotation(f"metrics_tpu.{self.label}.update[stacked-aot]"):
                 out = compiled(
                     state_leaves,
@@ -849,6 +1036,8 @@ class MetricsService:
                     jnp.asarray(n_valid),
                     *stacked_flat,
                 )
+                if want_value:
+                    out, vals = out
                 out = tuple(out)
             l1 = time.monotonic()
             telemetry.emit(
@@ -876,6 +1065,15 @@ class MetricsService:
             out = faults.maybe_corrupt_leaves(out)
             for k, leaf in zip(self._names, out):
                 self._stacked[k] = leaf
+            if vals is not None:
+                # stage each request's batch value (lane i of the stacked
+                # value outputs); the ticket resolves at retirement
+                for i, entry in enumerate(group):
+                    g_req = entry[0]
+                    if g_req.ticket is not None:
+                        g_req.value = jax.tree_util.tree_map(
+                            lambda v, _i=i: v[_i], vals
+                        )
             self.stats["launches"] += 1
             self._policy.note_success()
             if self._breakers:
@@ -893,9 +1091,12 @@ class MetricsService:
             for req, args, dynamic, static_kw, _, _ in group:
                 self._eager_entry(req, args, dynamic, static_kw)
 
-    def _compile_stacked(self, key: Tuple, static: Dict, treedef, example_flat) -> Callable:
+    def _compile_stacked(
+        self, key: Tuple, static: Dict, treedef, example_flat, *, want_value: bool = False
+    ) -> Callable:
         faults.check("compile", self.label)
         template, names = self.template, self._names
+        default_rows = self._default_rows
 
         def fn(state_leaves, idx, n_valid, *flat):
             # gather: OOB pad indices clamp (harmless — those lanes are
@@ -909,13 +1110,24 @@ class MetricsService:
                 new = template._masked_pure_update(
                     dict(zip(names, row_leaves)), mask, *args, **dyn, **static
                 )
-                return tuple(new[k] for k in names)
+                if want_value:
+                    # forward semantics: the batch value is the metric over
+                    # THIS batch alone — a default state advanced by the
+                    # masked batch, then computed, inside the same launch
+                    batch_state = template._masked_pure_update(
+                        {k: default_rows[k] for k in names}, mask, *args, **dyn, **static
+                    )
+                    val = template.pure_compute(batch_state)
+                else:
+                    val = ()
+                return tuple(new[k] for k in names), val
 
-            new_rows = jax.vmap(per_session)(rows, n_valid, list(flat))
-            return tuple(
+            new_rows, vals = jax.vmap(per_session)(rows, n_valid, list(flat))
+            scattered = tuple(
                 leaf.at[idx].set(rows_k, mode="drop")
                 for leaf, rows_k in zip(state_leaves, new_rows)
             )
+            return (scattered, vals) if want_value else scattered
 
         example_args = (
             tuple(self._stacked[k] for k in self._names),
@@ -926,7 +1138,7 @@ class MetricsService:
         t0 = time.perf_counter()
         loaded = None
         if aot_cache.cache_enabled():
-            loaded = aot_cache.load(self.label, "serve", key, namespace=self._namespace)
+            loaded = aot_cache.load(self._cache_label, "serve", key, namespace=self._namespace)
         if loaded is not None:
             jax.eval_shape(fn, *example_args)  # replay host trace effects
             self._seen_signatures.add(key)
@@ -941,7 +1153,7 @@ class MetricsService:
         jitted = jax.jit(fn)
         compiled = jitted.lower(*example_args).compile()
         aot_cache.store(
-            self.label, "serve", key, compiled=compiled,
+            self._cache_label, "serve", key, compiled=compiled,
             export_fn=lambda: jax.export.export(jitted)(*example_args),
             namespace=self._namespace,
         )
@@ -980,6 +1192,12 @@ class MetricsService:
             new = self.template.pure_update(state, *args, **dynamic, **static)
             for k in self._names:
                 self._stacked[k] = self._stacked[k].at[row].set(new[k])
+            if req.ticket is not None:
+                req.value = self.template.pure_compute(
+                    self.template.pure_update(
+                        dict(self._default_rows), *args, **dynamic, **static
+                    )
+                )
             self.stats["fallback_requests"] += 1
             breaker = self._breakers.get(name)
             if breaker is not None:
@@ -1023,6 +1241,14 @@ class MetricsService:
         Replayed requests emit spans tagged ``replayed=True`` but never
         touch the SLOs — the live process already recorded them."""
         t_ret = time.monotonic() if t_ret is None else t_ret
+        if req.ticket is not None:
+            if outcome in ("served", "fallback"):
+                req.ticket._resolve(req.value)
+            else:
+                req.ticket._reject(RuntimeError(
+                    f"request rid={req.rid} for session {req.name!r} was "
+                    f"{outcome} before serving; no batch value exists"
+                ))
         e2e_us = max(0.0, (t_ret - req.t_enq) * 1e6)
         retire_us = 0.0
         if req.t_launch_done is not None:
@@ -1036,6 +1262,8 @@ class MetricsService:
             )
         if req.t0 is not None and telemetry.clock() is not None:
             extra: Dict[str, Any] = {"replayed": True} if req.replayed else {}
+            if self.shard_id is not None:
+                extra["shard"] = self.shard_id
             if req.launch_ts_us is not None:
                 extra["launch_ts_us"] = round(req.launch_ts_us, 3)
                 extra["launch_tid"] = req.launch_tid
@@ -1244,6 +1472,10 @@ class MetricsService:
         the queue is empty under the flush lock, so every record at or
         below it is provably applied to the checkpointed state."""
         path = self._checkpoint_path(path)
+        if self._wal is not None:
+            # zombie fence: a shard that lost its partition to a peer must
+            # not clobber the new owner's checkpoint either
+            self._wal.check_epoch()
         with self._flush_lock:
             # drain until the queue stays empty: the fence must cover
             # exactly the records applied to the state being written
@@ -1270,6 +1502,7 @@ class MetricsService:
                     "template": type(self.template).__name__,
                     "template_attrs": template_attrs,
                     "journal_seq": fence,
+                    "epoch": self.epoch,
                     "closed": sorted(self._closed),
                 }
             )
@@ -1316,6 +1549,14 @@ class MetricsService:
         ``journal_seq`` fence apply, in sequence order, with shed/expired
         requests excluded — so restoring twice, or restoring after a crash
         at any instruction, reconstructs the same state."""
+        if missing_ok:
+            # first-boot on a fresh shard host is zero-config: (re)create
+            # the state directory chain instead of raising — the journal /
+            # checkpoint volume may have been mounted empty after __init__
+            if self.journal_dir is not None:
+                os.makedirs(self.journal_dir, exist_ok=True)
+            if self.checkpoint_dir is not None:
+                os.makedirs(self.checkpoint_dir, exist_ok=True)
         if path is None and self.checkpoint_dir is None and missing_ok:
             # journal-only recovery: no checkpoint tier configured at all
             if replay and self._wal is not None:
@@ -1446,6 +1687,8 @@ class MetricsService:
         gauges (:meth:`health`)."""
         return {
             "owner": self.label,
+            "shard": self.shard_id,
+            "epoch": self.epoch,
             "serve": dict(self.stats),
             "sessions": self.session_count,
             "capacity": self._capacity,

@@ -51,6 +51,22 @@ The payload codec is :mod:`pickle` guarded by the frame crc — the journal
 is a private on-disk format written and read by the same service, not an
 interchange format.
 
+Epoch fencing (multi-host failover): a journal directory carries an
+``EPOCH`` file — the highest ownership epoch ever granted for this
+shard's state. A writer opens at an epoch (``WriteAheadLog(...,
+epoch=n)``); opening at a *higher* epoch than the file records claims
+ownership and advances the fence atomically. From then on every
+:meth:`WriteAheadLog.append` / :meth:`WriteAheadLog.truncate` re-checks
+the fence: a writer whose epoch is below the fenced one — a zombie shard
+that lost its partition to a peer after a liveness timeout — raises
+:class:`StaleEpochError` instead of writing, so a late submit from the
+walking dead can never interleave frames with the new owner. The serving
+checkpoint embeds the same epoch in its ``__meta__`` (see
+:meth:`metrics_tpu.serve.MetricsService.checkpoint`), and
+:func:`fence_epoch` lets a peer fence the directory *before* replaying
+it — the takeover order is fence, then recover, so there is no window
+where both hosts may write.
+
 Env knobs (see ``docs/serving.md``):
 
 ================================ =======================================
@@ -79,7 +95,10 @@ from metrics_tpu import faults, telemetry
 __all__ = [
     "WriteAheadLog",
     "WalRecord",
+    "StaleEpochError",
     "wal_enabled",
+    "read_epoch",
+    "fence_epoch",
     "UPDATE",
     "DROP",
     "CLOSE",
@@ -99,6 +118,52 @@ _HEAD = struct.Struct("<QBIII")  # seq, kind, hlen, plen, crc
 _FRAME_OVERHEAD = len(_MAGIC) + _HEAD.size
 
 _DEFAULT_SEGMENT_BYTES = 4 * 1024 * 1024
+
+# per-directory ownership fence (multi-host failover): the highest epoch
+# ever granted write ownership of this journal directory
+_EPOCH_FILE = "EPOCH"
+
+
+class StaleEpochError(RuntimeError):
+    """A write arrived from an epoch below the directory's ownership fence
+    — the writer is a zombie shard whose partition a peer already claimed
+    (:func:`fence_epoch`). The write was refused before touching disk."""
+
+
+def read_epoch(directory: str) -> int:
+    """The directory's fenced ownership epoch (0 when never fenced)."""
+    try:
+        with open(os.path.join(directory, _EPOCH_FILE)) as f:
+            return int(json.load(f)["epoch"])
+    except (FileNotFoundError, NotADirectoryError):
+        return 0
+    except Exception as err:  # noqa: BLE001 - torn write of the tiny file
+        from metrics_tpu.resilience import StateCorruptionError
+
+        raise StateCorruptionError(
+            f"journal epoch fence {os.path.join(directory, _EPOCH_FILE)!r} is "
+            f"unreadable: {err}"
+        ) from err
+
+
+def fence_epoch(directory: str, epoch: int) -> int:
+    """Advance the directory's ownership fence to at least ``epoch``
+    (atomic write + replace; the fence never lowers). Returns the fenced
+    epoch. A peer taking over a dead shard fences FIRST, then replays —
+    after this returns, any append from a writer opened at a lower epoch
+    raises :class:`StaleEpochError`."""
+    os.makedirs(directory, exist_ok=True)
+    current = read_epoch(directory)
+    fenced = max(current, int(epoch))
+    if fenced > current or current == 0:
+        path = os.path.join(directory, _EPOCH_FILE)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": fenced}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    return fenced
 
 
 def wal_enabled() -> bool:
@@ -179,6 +244,10 @@ class WriteAheadLog:
             for speed; process-kill durability is unaffected.
         segment_max_bytes: roll to a new segment past this size (default
             from ``METRICS_TPU_WAL_SEGMENT_BYTES``).
+        epoch: ownership epoch this writer opens at. Opening above the
+            directory's fence claims it (:func:`fence_epoch`); opening
+            *below* it raises :class:`StaleEpochError` immediately — a
+            demoted host must not reattach to a partition it lost.
 
     Thread-safe: one lock serializes appends (the fsync dominates, so
     finer grain buys nothing).
@@ -191,6 +260,7 @@ class WriteAheadLog:
         owner: str = "wal",
         fsync: Optional[bool] = None,
         segment_max_bytes: Optional[int] = None,
+        epoch: int = 0,
     ) -> None:
         self.directory = directory
         self.owner = owner
@@ -199,6 +269,15 @@ class WriteAheadLog:
             _segment_bytes_default() if segment_max_bytes is None else max(4096, int(segment_max_bytes))
         )
         os.makedirs(directory, exist_ok=True)
+        self.epoch = int(epoch)
+        fenced = read_epoch(directory)
+        if self.epoch < fenced:
+            raise StaleEpochError(
+                f"journal {directory!r} is fenced at epoch {fenced}; refusing "
+                f"to open a writer at stale epoch {self.epoch}"
+            )
+        if self.epoch > fenced:
+            fence_epoch(directory, self.epoch)
         self._lock = threading.Lock()
         self._active: Optional[Any] = None  # open file handle of the last segment
         self._active_path: Optional[str] = None
@@ -316,7 +395,21 @@ class WriteAheadLog:
             if floor > self._last_seq:
                 self._last_seq = int(floor)
 
+    def check_epoch(self) -> None:
+        """Raise :class:`StaleEpochError` if a peer fenced the directory
+        above this writer's epoch (i.e. this process is a zombie). Re-read
+        on every durable write: one ~µs file read next to an fsync."""
+        fenced = read_epoch(self.directory)
+        if self.epoch < fenced:
+            raise StaleEpochError(
+                f"journal {self.directory!r} was fenced at epoch {fenced} by a "
+                f"peer; this writer (epoch {self.epoch}) is a zombie — write refused"
+            )
+
     def _open_segment(self, first_seq: int) -> None:
+        # self-heal the directory chain: a fresh shard host may mount its
+        # state volume empty after first boot (zero-config contract)
+        os.makedirs(self.directory, exist_ok=True)
         path = os.path.join(self.directory, f"wal-{first_seq:020d}.seg")
         self._active = open(path, "ab")
         self._active_path = path
@@ -349,7 +442,10 @@ class WriteAheadLog:
         (UPDATE frames) persists the flight-recorder rid so replayed
         requests keep their identity."""
         kwargs = kwargs or {}
+        self.check_epoch()
         header: Dict[str, Any] = {"session": session}
+        if self.epoch:
+            header["epoch"] = self.epoch
         if kind == UPDATE:
             args = _to_numpy(args)
             kwargs = _to_numpy(kwargs)
@@ -465,6 +561,7 @@ class WriteAheadLog:
         ``last_seq``. Idempotent: replay is fenced, so a half-truncated
         journal wastes disk, never correctness."""
         removed = 0
+        self.check_epoch()
         t0 = telemetry.clock()
         with self._lock:
             retire = [s for s in self._segments if s.last_seq <= upto_seq]
@@ -513,6 +610,7 @@ class WriteAheadLog:
             out: Dict[str, Any] = dict(self._stats)
             out["last_seq"] = self._last_seq
             out["segments"] = len(self._segments)
+            out["epoch"] = self.epoch
             lat = sorted(self._fsync_us)
         def pct(q: float) -> float:
             if not lat:

@@ -1,0 +1,147 @@
+"""Subprocess body for the fabric shard-death chaos harness.
+
+One OS process per shard, the real multi-host shape: each worker runs
+the SAME deterministic global op stream but executes only the ops whose
+session the :class:`~metrics_tpu.fabric.HashRing` assigns to its shard
+(the ring is a pure function of the session names, so every process
+agrees on the partition with zero coordination). Ops executed locally
+map 1:1 to this shard's journal sequence numbers, which makes
+``journal.last_seq`` the resume cursor exactly as in ``crash_worker.py``.
+
+Phases:
+
+``run``      execute the shard's slice from op 0 at ownership epoch
+             ``read_epoch() + 1`` (first boot: 1). The parent either
+             lets it finish (the uncrashed twin) or arms
+             ``METRICS_TPU_CRASH`` so a crash point SIGKILLs it
+             mid-stream — a dead shard with a torn journal tail.
+``recover``  the peer's side of failover: fence the dead shard's
+             directory at ``read_epoch() + 1`` (locking the zombie out
+             BEFORE any state moves), ``recover()`` the checkpoint +
+             sequence-fenced journal tail, resume the slice at
+             ``last_seq``, and finish normally.
+
+Both phases print a bit-exact ``compute_all()`` digest of the shard's
+partition as the last stdout line; the parent unions partitions and
+compares against the uncrashed twin fleet.
+
+Usage: ``python fabric_worker.py {run|recover} WORKDIR SHARD NSHARDS``
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+N_OPS = 44
+N_SESSIONS = 6
+BATCH = 16
+
+
+def ops_list():
+    """The fixed global op stream (all shards see the same list)."""
+    ops = []
+    for i in range(N_OPS):
+        if i == 12:
+            ops.append(("close", "s1"))
+        elif i == 20:
+            ops.append(("reset", "s3"))
+        else:
+            ops.append(("update", f"s{i % N_SESSIONS}", i))
+    return ops
+
+
+def batch_for(i):
+    rng = np.random.RandomState(2000 + i)
+    return rng.randint(0, 8, BATCH), rng.randint(0, 8, BATCH)
+
+
+def digest(svc):
+    """Bit-exact leaf digest of every open session in this partition."""
+    import jax
+
+    out = {}
+    for name, val in sorted(svc.compute_all().items()):
+        leaves = jax.tree_util.tree_leaves(val)
+        out[name] = [
+            [str(np.asarray(leaf).dtype), list(np.shape(leaf)), np.asarray(leaf).tobytes().hex()]
+            for leaf in leaves
+        ]
+    return out
+
+
+def main():
+    phase, root, shard, nshards = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
+    )
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, wal
+    from metrics_tpu.fabric import HashRing
+    from metrics_tpu.serve import MetricsService
+
+    ring = HashRing(list(range(nshards)))
+    journal_dir = os.path.join(root, f"shard-{shard:02d}", "wal")
+    # run: claim the first epoch. recover: the peer fences one higher —
+    # constructing the service at read_epoch()+1 IS the fence (the WAL
+    # advances the EPOCH file before any replay), so the takeover order
+    # is fence-then-recover by construction.
+    epoch = wal.read_epoch(journal_dir) + 1
+    svc = MetricsService(
+        Accuracy(task="multiclass", num_classes=8),
+        journal_dir=journal_dir,
+        checkpoint_dir=os.path.join(root, f"shard-{shard:02d}", "ckpt"),
+        checkpoint_every=2,
+        shard_id=shard,
+        rid_offset=shard,
+        rid_stride=nshards,
+        epoch=epoch,
+    )
+    start_seq = 0
+    if phase == "recover":
+        svc.recover()
+        start_seq = svc.journal.last_seq
+
+    closed = set()
+    local_idx = 0  # local ops journal as seq local_idx; the resume cursor
+    for op in ops_list():
+        name = op[1]
+        if ring.owner(name) != shard:
+            continue
+        local_idx += 1
+        if local_idx <= start_seq:
+            # already durable before the crash (applied by replay); keep
+            # the closed-set bookkeeping consistent with the stream
+            if op[0] == "close":
+                closed.add(name)
+            elif op[0] == "update":
+                closed.discard(name)
+            continue
+        if op[0] == "update":
+            if name in closed:
+                svc.open_session(name)
+                closed.discard(name)
+            preds, target = batch_for(op[2])
+            svc.submit(name, jnp.asarray(preds), jnp.asarray(target))
+        elif op[0] == "close":
+            svc.close_session(name)
+            closed.add(name)
+        elif op[0] == "reset":
+            svc.reset_session(name)
+        if local_idx % 4 == 0:
+            svc.flush()
+    svc.drain()
+    print(
+        json.dumps(
+            {
+                "digest": digest(svc),
+                "last_seq": svc.journal.last_seq,
+                "epoch": svc.epoch,
+                "shard": shard,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 26
+    assert len(skipped) == 27
     assert "detail_elapsed_s" in detail
 
 
@@ -244,6 +244,22 @@ def test_request_tracing_config_counts_and_keys(monkeypatch):
     # the config must restore the kill switch it toggles
     assert os.environ.get("METRICS_TPU_TELEMETRY") is None or (
         os.environ["METRICS_TPU_TELEMETRY"] != "0")
+
+
+def test_fabric_config_counts_and_keys():
+    """Pin the fabric bench config at test-budget scale: the capacity and
+    overload keys must exist and be positive, every stacked launch must
+    carry a shard tag, the submit path must be collective-free, and the
+    failover drill must produce a kill-to-first-result time."""
+    detail = {}
+    bench._cfg_fabric(detail, sessions=16, events=120, shards=2)
+    assert detail["fabric_updates_per_sec"] > 0
+    assert 0.0 <= detail["fabric_shed_rate_2x_overload"] <= 1.0
+    assert detail["fabric_p99_ms_2x_overload"] >= 0.0
+    assert detail["fabric_launches_total"] > 0
+    assert detail["fabric_launches_shard_tagged"] == detail["fabric_launches_total"]
+    assert detail["fabric_submit_collectives"] == 0
+    assert detail["fabric_failover_first_result_ms"] > 0
 
 
 def test_resilience_overhead_config_counts_and_keys(monkeypatch):

@@ -122,3 +122,119 @@ def test_crash_points_registry_is_closed():
     """The harness and the registry must not drift: every point the test
     matrix knows is registered, and vice versa."""
     assert set(_CRASH_NTH) == set(faults.CRASH_POINTS)
+
+
+# --------------------------------------------------------- shard-death matrix
+# The fabric twin of the harness above: one OS process per shard
+# (``fabric_worker.py``), SIGKILL shard 0 at every crash point, then run
+# the peer's failover — fence the dead shard's journal epoch one higher
+# and replay it on a fresh process. The union of the recovered partition
+# and the surviving shard's partition must be bit-identical to an
+# uncrashed two-shard twin fleet, and the zombie's epoch must be fenced
+# out (``StaleEpochError``).
+
+_FABRIC_WORKER = os.path.join(os.path.dirname(__file__), "fabric_worker.py")
+_VICTIM, _SURVIVOR, _NSHARDS = 0, 1, 2
+
+# the fabric stream is shorter per shard (the ring splits the sessions
+# 3/3), so each point's nth is tuned to land mid-stream on shard 0
+_FABRIC_CRASH_NTH = {
+    "post-journal": 8,
+    "mid-journal-append": 8,
+    "mid-flush": 2,
+    "mid-checkpoint": 2,
+    "mid-truncate": 2,
+}
+
+
+def _run_fabric_worker(phase, workdir, shard, env, crash=None, timeout=240):
+    if crash is not None:
+        env = dict(env)
+        env["METRICS_TPU_CRASH"] = crash
+    return subprocess.run(
+        [sys.executable, _FABRIC_WORKER, phase, str(workdir), str(shard),
+         str(_NSHARDS)],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=_REPO,
+    )
+
+
+@pytest.fixture(scope="module")
+def fabric_twin(tmp_path_factory):
+    """The uncrashed twin fleet: both shards run their slice clean; the
+    per-shard digests union into the fleet ground truth."""
+    aot = tmp_path_factory.mktemp("fabric-aot-shared")
+    work = tmp_path_factory.mktemp("fabric-twin")
+    shards = {}
+    for k in range(_NSHARDS):
+        proc = _run_fabric_worker("run", work, k, _env(aot))
+        assert proc.returncode == 0, proc.stderr
+        shards[k] = json.loads(proc.stdout.strip().splitlines()[-1])
+    names = [set(s["digest"]) for s in shards.values()]
+    assert not names[0] & names[1], "ring assigned a session to both shards"
+    return {"aot": aot, "shards": shards}
+
+
+def _kill_shard_and_fail_over(point, fabric_twin, tmp_path):
+    nth = _FABRIC_CRASH_NTH[point]
+    work = tmp_path / point
+    work.mkdir()
+    env = _env(fabric_twin["aot"])
+
+    # SIGKILL shard 0 at the armed point; shard 1 never notices
+    crashed = _run_fabric_worker(
+        "run", work, _VICTIM, env, crash=f"{point}:{nth}"
+    )
+    assert crashed.returncode in (-signal.SIGKILL, 128 + signal.SIGKILL), (
+        f"crash point {point} did not kill shard {_VICTIM} "
+        f"(rc={crashed.returncode})\n{crashed.stderr}"
+    )
+    assert not crashed.stdout.strip(), "a killed shard must not print a digest"
+    survivor = _run_fabric_worker("run", work, _SURVIVOR, env)
+    assert survivor.returncode == 0, survivor.stderr
+    live = json.loads(survivor.stdout.strip().splitlines()[-1])
+
+    # the peer's failover: fence one epoch higher, replay, resume
+    recovered = _run_fabric_worker("recover", work, _VICTIM, env)
+    assert recovered.returncode == 0, recovered.stderr
+    out = json.loads(recovered.stdout.strip().splitlines()[-1])
+
+    twin = fabric_twin["shards"]
+    assert out["digest"] == twin[_VICTIM]["digest"], (
+        f"failover after {point} kill is not bit-identical to the "
+        f"uncrashed twin partition"
+    )
+    assert out["last_seq"] == twin[_VICTIM]["last_seq"]
+    assert live["digest"] == twin[_SURVIVOR]["digest"]
+    fleet = dict(out["digest"], **live["digest"])
+    twin_fleet = dict(twin[_VICTIM]["digest"], **twin[_SURVIVOR]["digest"])
+    assert fleet == twin_fleet
+
+    # the zombie is fenced out: reopening the journal at the dead
+    # shard's old epoch must be refused outright
+    from metrics_tpu import wal
+
+    journal_dir = os.path.join(str(work), f"shard-{_VICTIM:02d}", "wal")
+    assert out["epoch"] > 1 and wal.read_epoch(journal_dir) == out["epoch"]
+    with pytest.raises(wal.StaleEpochError):
+        wal.WriteAheadLog(journal_dir, epoch=1)
+
+
+def test_shard_death_and_fail_over_representative(fabric_twin, tmp_path):
+    """Default-tier pin: the post-journal shard kill fails over to a
+    peer bit-identically with the zombie epoch-fenced out."""
+    _kill_shard_and_fail_over("post-journal", fabric_twin, tmp_path)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "point", [p for p in faults.CRASH_POINTS if p != "post-journal"]
+)
+def test_shard_death_matrix_every_point(point, fabric_twin, tmp_path):
+    """The full shard-death matrix (``make chaos-fabric``): SIGKILL the
+    shard at every registered crash point; the peer's fenced replay must
+    reproduce the twin fleet digest bit-for-bit."""
+    _kill_shard_and_fail_over(point, fabric_twin, tmp_path)
+
+
+def test_fabric_crash_matrix_registry_is_closed():
+    assert set(_FABRIC_CRASH_NTH) == set(faults.CRASH_POINTS)

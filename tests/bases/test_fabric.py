@@ -1,0 +1,315 @@
+"""Sharded serving fabric (metrics_tpu/fabric.py).
+
+The fabric is an optimization + availability layer, never a semantics
+change: per-session values through N shards must stay bit-identical to a
+single ``MetricsService`` fed the same stream, and a shard death must be
+invisible after failover (fenced replay on a peer reconstructs the
+partition bit-for-bit while the zombie's writes bounce off the epoch
+fence). Structural invariants are pinned via telemetry: launches carry
+exactly one ``@shard<k>`` owner tag, and the submit path emits zero
+collectives.
+"""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, faults, telemetry, wal
+from metrics_tpu.fabric import (
+    HashRing,
+    ShardDeadError,
+    ShardedMetricsService,
+    StaleEpochError,
+)
+from metrics_tpu.serve import MetricsService, QueueFullError
+
+
+def _tmpl():
+    return Accuracy(task="multiclass", num_classes=8)
+
+
+def _fabric(num_shards=3, **kwargs):
+    return ShardedMetricsService(_tmpl(), num_shards=num_shards, **kwargs)
+
+
+def _batches(n, steps=2, batch=16, C=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        f"t{i}": [
+            (jnp.asarray(rng.randint(0, C, batch)), jnp.asarray(rng.randint(0, C, batch)))
+            for _ in range(steps)
+        ]
+        for i in range(n)
+    }
+
+
+# -------------------------------------------------------------------- ring
+def test_ring_is_deterministic_and_total():
+    a, b = HashRing([0, 1, 2, 3]), HashRing([0, 1, 2, 3])
+    names = [f"session-{i}" for i in range(500)]
+    assert [a.owner(n) for n in names] == [b.owner(n) for n in names]
+    spread = a.spread(names)
+    assert set(spread) == {0, 1, 2, 3}
+    assert all(v > 0 for v in spread.values()), f"starved shard: {spread}"
+
+
+def test_ring_successor_skips_dead_shards():
+    ring = HashRing([0, 1, 2, 3])
+    peer = ring.successor(1)
+    assert peer != 1
+    constrained = ring.successor(1, alive=[2])
+    assert constrained == 2
+    with pytest.raises(ShardDeadError):
+        ring.successor(1, alive=[1])
+
+
+# ------------------------------------------------------------- routing parity
+def test_fabric_parity_with_single_service():
+    """N shards are a partition, not a transformation: every session's
+    value is bit-identical to one unsharded service fed the same stream."""
+    data = _batches(12)
+    fab = _fabric(3)
+    ref = MetricsService(_tmpl())
+    for name, steps in data.items():
+        for p, t in steps:
+            fab.submit(name, p, t)
+            ref.submit(name, p, t)
+    fab.drain()
+    ref.drain()
+    got, want = fab.compute_all(), ref.compute_all()
+    assert set(got) == set(want)
+    for name in want:
+        assert np.asarray(got[name]).tobytes() == np.asarray(want[name]).tobytes()
+    fab.shutdown()
+    ref.shutdown()
+
+
+def test_submit_is_shard_local_and_collective_free():
+    """Structural pin: every launch span belongs to exactly one shard
+    (``@shard<k>`` owner tag) and the whole submit+flush path emits zero
+    collective events."""
+    data = _batches(9)
+    fab = _fabric(3)
+    before = {
+        k: v for k, v in telemetry.snapshot().items() if k.startswith("collective")
+    }
+    with telemetry.instrument() as tel:
+        for name, steps in data.items():
+            for p, t in steps:
+                fab.submit(name, p, t)
+        fab.drain()
+    after = {
+        k: v for k, v in telemetry.snapshot().items() if k.startswith("collective")
+    }
+    assert sum(after.values()) == sum(before.values())
+    launches = tel.spans(name="update", kind="stacked-aot")
+    assert launches, "no stacked launches recorded"
+    owners = {e.owner for e in launches}
+    assert all("@shard" in o for o in owners)
+    touched = {fab.shard_for(n) for n in data}
+    launched = {int(o.rsplit("@shard", 1)[1]) for o in owners}
+    assert launched == touched
+    fab.shutdown()
+
+
+def test_rid_lattice_is_disjoint_across_shards():
+    data = _batches(9, steps=3)
+    fab = _fabric(3)
+    for name, steps in data.items():
+        for p, t in steps:
+            fab.submit(name, p, t)
+    fab.drain()
+    heads = [(s.shard_id, s.service._rid) for s in fab._shards]
+    # shard k mints rids congruent to k mod N: lattices never collide
+    for sid, rid in heads:
+        assert rid % fab.num_shards == sid
+    fab.shutdown()
+
+
+# ---------------------------------------------------------- per-tenant config
+def test_tenant_config_routes_and_survives_failover(tmp_path):
+    data = _batches(8)
+    fab = _fabric(2, data_dir=str(tmp_path))
+    loud = next(iter(data))
+    fab.configure_session(loud, admission="reject")
+    shard = fab.shard_for(loud)
+    assert fab._shards[shard].service.session_config(loud)["admission"] == "reject"
+
+    for name, steps in data.items():
+        for p, t in steps:
+            fab.submit(name, p, t)
+    fab.drain()
+    fab.checkpoint()
+
+    fab.kill_shard(shard)
+    fab.fail_over(shard)
+    # the recovery service re-learns the override from the fabric's copy
+    assert fab._shards[shard].service.session_config(loud)["admission"] == "reject"
+    fab.shutdown()
+
+
+# ------------------------------------------------------------------- failover
+def test_shard_death_failover_is_bit_identical(tmp_path):
+    data = _batches(10, steps=3)
+    fab = _fabric(3, data_dir=str(tmp_path))
+    ref = MetricsService(_tmpl())
+    for name, steps in data.items():
+        for p, t in steps:
+            fab.submit(name, p, t)
+            ref.submit(name, p, t)
+    fab.drain()
+    ref.drain()
+    fab.checkpoint()
+    want = ref.compute_all()
+
+    victim = fab.shard_for(next(iter(data)))
+    zombie = fab.kill_shard(victim)
+    ms = fab.fail_over(victim)
+    assert ms >= 0.0
+    got = fab.compute_all()
+    assert set(got) == set(want)
+    for name in want:
+        assert np.asarray(got[name]).tobytes() == np.asarray(want[name]).tobytes()
+    assert fab.stats["failovers"] == 1
+    assert fab.failover_events[0]["shard"] == victim
+    assert fab._shards[victim].epoch > zombie.epoch
+
+    # the zombie is locked out of every durable mutation
+    name = next(n for n in data if fab.shard_for(n) == victim)
+    with pytest.raises(StaleEpochError):
+        zombie.submit(name, *data[name][0])
+    with pytest.raises(StaleEpochError):
+        zombie.checkpoint()
+    fab.shutdown()
+
+
+def test_auto_failover_serves_through_death(tmp_path):
+    data = _batches(6)
+    fab = _fabric(2, data_dir=str(tmp_path))
+    for name, steps in data.items():
+        for p, t in steps:
+            fab.submit(name, p, t)
+    fab.drain()
+    fab.checkpoint()
+    want = fab.compute_all()
+
+    victim = fab.shard_for(next(iter(data)))
+    fab.kill_shard(victim)
+    # next route to the dead shard recovers it inline — no caller error
+    got = fab.compute_all()
+    for name in want:
+        assert np.asarray(got[name]).tobytes() == np.asarray(want[name]).tobytes()
+    fab.shutdown()
+
+
+def test_auto_failover_off_raises_until_probe(tmp_path):
+    fab = _fabric(2, data_dir=str(tmp_path), auto_failover=False)
+    p, t = _batches(1)["t0"][0]
+    fab.submit("t0", p, t)
+    fab.drain()
+    fab.checkpoint()
+    victim = fab.shard_for("t0")
+    fab.kill_shard(victim)
+    with pytest.raises(ShardDeadError):
+        fab.submit("t0", p, t)
+    assert fab.probe() == [victim]
+    fab.submit("t0", p, t)
+    fab.drain()
+    fab.shutdown()
+
+
+def test_failover_without_durable_state_is_refused():
+    fab = _fabric(2, auto_failover=False)  # data_dir=None: nothing to replay
+    p, t = _batches(1)["t0"][0]
+    fab.submit("t0", p, t)
+    fab.drain()
+    fab.kill_shard(fab.shard_for("t0"))
+    with pytest.raises(ShardDeadError):
+        fab.fail_over(fab.shard_for("t0"))
+    fab.shutdown()
+
+
+def test_shard_death_fault_class_triggers_failover(tmp_path):
+    """``faults.inject('shard-death', shard=k)`` kills shard k at the
+    routing seam, exactly as a missed liveness probe would."""
+    data = _batches(6)
+    fab = _fabric(2, data_dir=str(tmp_path))
+    for name, steps in data.items():
+        for p, t in steps:
+            fab.submit(name, p, t)
+    fab.drain()
+    fab.checkpoint()
+    want = fab.compute_all()
+    victim = fab.shard_for("t0")
+    with faults.inject("shard-death", count=1, shard=victim):
+        got = fab.compute("t0")
+    assert np.asarray(got).tobytes() == np.asarray(want["t0"]).tobytes()
+    assert fab.stats["failovers"] == 1
+    # the untargeted shard was never touched
+    other = 1 - victim
+    assert fab._shards[other].epoch == fab._shards[other].service.epoch
+    fab.shutdown()
+
+
+def test_shard_death_is_a_registered_fault_class():
+    assert "shard-death" in faults.FAULT_NAMES
+
+
+# ----------------------------------------------------------- fleet aggregates
+def test_queue_bounds_are_per_shard(tmp_path):
+    """One hot shard sheds without its neighbors noticing: queue bounds
+    and admission are strictly shard-local."""
+    fab = _fabric(2, max_queue=2, admission="reject")
+    names = [f"t{i}" for i in range(8)]
+    hot = [n for n in names if fab.shard_for(n) == 0][0]
+    p, t = _batches(1)["t0"][0]
+    rejected = 0
+    for _ in range(6):
+        try:
+            fab.submit(hot, p, t)
+        except QueueFullError:
+            rejected += 1
+    assert rejected == 4  # bound 2, six offers, zero served yet
+    cold = next(n for n in names if fab.shard_for(n) != fab.shard_for(hot))
+    fab.submit(cold, p, t)  # the other shard admits freely
+    fab.drain()
+    fab.shutdown()
+
+
+def test_fleet_snapshot_aggregates_shards():
+    data = _batches(6)
+    fab = _fabric(3)
+    for name, steps in data.items():
+        for p, t in steps:
+            fab.submit(name, p, t)
+    fab.drain()
+    snap = fab.fleet_snapshot()
+    assert snap["num_shards"] == 3
+    assert snap["serve_totals"]["submits"] == sum(
+        len(steps) for steps in data.values()
+    )
+    assert snap["resilience"]["shards"] == 3
+    assert snap["health"]["sessions"] == len(data)
+    per_shard = {s["shard"] for s in snap["shards"].values()}
+    assert per_shard == {0, 1, 2}
+    fab.shutdown()
+
+
+def test_forward_rides_the_stacked_launch_through_fabric():
+    """``forward``-style batch values ride the same coalesced stacked
+    launch as state updates — one launch per shard signature, values
+    matching a fresh per-batch metric."""
+    fab = _fabric(2)
+    rng = np.random.RandomState(3)
+    p = jnp.asarray(rng.randint(0, 8, 16))
+    t = jnp.asarray(rng.randint(0, 8, 16))
+    with telemetry.instrument() as tel:
+        val = fab.forward("t0", p, t)
+    fresh = _tmpl()
+    fresh.update(p, t)
+    want = fresh.compute()
+    assert np.asarray(val).tobytes() == np.asarray(want).tobytes()
+    launches = tel.spans(name="update", kind="stacked-aot")
+    assert len(launches) == 1 and "@shard" in launches[0].owner
+    fab.shutdown()

@@ -115,6 +115,45 @@ def test_rid_uniqueness_under_concurrent_submits():
     assert len(rids) == 400
 
 
+def test_rid_uniqueness_across_shards_under_concurrent_submits():
+    """The fabric's rid lattice: 8 threads submitting across a 4-shard
+    fleet mint globally-unique rids with zero cross-shard coordination —
+    shard k of N mints only ids congruent to k mod N, so the 400 request
+    spans carry 400 distinct rids and every rid's residue matches the
+    shard that served it."""
+    from metrics_tpu.fabric import ShardedMetricsService
+
+    rng = np.random.RandomState(7)
+    fab = ShardedMetricsService(
+        Accuracy(task="multiclass", num_classes=8), num_shards=4
+    )
+    batches = [_batch(rng) for _ in range(8)]
+    errs = []
+
+    def worker(i):
+        try:
+            for _ in range(50):
+                fab.submit(f"t{i}", *batches[i])
+        except Exception as err:  # noqa: BLE001 - surfaced below
+            errs.append(err)
+
+    with telemetry.instrument() as session:
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        fab.drain()
+    assert not errs
+    spans = session.spans(name="request")
+    assert len(spans) == 400
+    rids = {e.attrs["rid"] for e in spans}
+    assert len(rids) == 400
+    for e in spans:
+        assert e.attrs["rid"] % 4 == e.attrs["shard"]
+    fab.shutdown()
+
+
 def test_coalescing_preserves_rid_set():
     """Concatenating same-signature requests must not lose identity: the
     stacked launch span carries every member rid, and every member still

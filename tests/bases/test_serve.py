@@ -343,8 +343,9 @@ def test_telemetry_snapshot_shape():
     assert snap["serve"]["submits"] == 1 and snap["serve"]["launches"] == 1
     assert set(snap) == {
         "owner", "serve", "sessions", "capacity", "resilience",
-        "aot_cache", "wal", "memory", "health",
+        "aot_cache", "wal", "memory", "health", "shard", "epoch",
     }
+    assert snap["shard"] is None and snap["epoch"] == 0  # single-host posture
     assert snap["memory"]["total_bytes"] > 0
     assert snap["health"]["sessions"] == 1
     assert snap["wal"] is None  # no journal_dir configured
@@ -368,6 +369,30 @@ def test_restore_missing_checkpoint_raises_unless_first_boot(tmp_path):
     # documented first-boot path: missing_ok tolerates the empty dir
     assert svc.restore(missing_ok=True) is False
     assert svc.recover() is False  # recover() is the missing_ok spelling
+
+
+def test_restore_missing_ok_creates_unborn_directory_chain(tmp_path):
+    """Zero-config first boot: ``restore(missing_ok=True)`` with a
+    journal_dir whose PARENT does not yet exist creates the chain
+    instead of raising, and the service is immediately durable."""
+    root = tmp_path / "never" / "made" / "yet"
+    svc = MetricsService(
+        FloatSum(),
+        journal_dir=str(root / "wal"),
+        checkpoint_dir=str(root / "ckpt"),
+    )
+    assert svc.restore(missing_ok=True) is False
+    assert os.path.isdir(str(root / "wal")) and os.path.isdir(str(root / "ckpt"))
+    svc.update("tenant", jnp.asarray([4.0], dtype=jnp.float32))
+    assert svc.journal.last_seq == 1  # the journal took the write
+    svc.checkpoint()
+    twin = MetricsService(
+        FloatSum(),
+        journal_dir=str(root / "wal"),
+        checkpoint_dir=str(root / "ckpt"),
+    )
+    assert twin.recover() is True
+    assert float(np.asarray(twin.compute("tenant"))) == 4.0
 
 
 def test_restore_truncated_checkpoint_raises_corruption(tmp_path):

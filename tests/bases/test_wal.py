@@ -186,3 +186,61 @@ def test_wal_kill_switch(monkeypatch):
     assert wal.wal_enabled()
     monkeypatch.delenv("METRICS_TPU_WAL")
     assert wal.wal_enabled()
+
+
+# ---------------------------------------------------------------- epoch fence
+def test_epoch_file_roundtrip_and_monotonicity(tmp_path):
+    d = str(tmp_path / "wal")
+    assert wal.read_epoch(d) == 0  # never fenced
+    assert wal.fence_epoch(d, 3) == 3
+    assert wal.read_epoch(d) == 3
+    assert wal.fence_epoch(d, 1) == 3  # a fence never lowers
+    assert wal.fence_epoch(d, 7) == 7
+
+
+def test_open_claims_higher_epoch_and_rejects_lower(tmp_path):
+    log = _log(tmp_path, epoch=2)
+    _append_updates(log, 2)
+    log.close()
+    assert wal.read_epoch(str(tmp_path / "wal")) == 2
+    with pytest.raises(wal.StaleEpochError):
+        _log(tmp_path, epoch=1)  # the zombie is refused at open
+    # equal epoch reopens fine (same owner restarting)
+    assert _log(tmp_path, epoch=2).last_seq == 2
+
+
+def test_fence_locks_out_live_writer(tmp_path):
+    """The failover sequence: a peer fences the directory while the old
+    writer is still up; the zombie's next append/truncate raises."""
+    zombie = _log(tmp_path, epoch=1)
+    _append_updates(zombie, 3)
+    wal.fence_epoch(str(tmp_path / "wal"), 2)  # peer takes over
+    with pytest.raises(wal.StaleEpochError):
+        _append_updates(zombie, 1, start=3)
+    with pytest.raises(wal.StaleEpochError):
+        zombie.truncate(2)
+    # the peer at the fenced epoch sees every pre-fence record
+    peer = _log(tmp_path, epoch=2)
+    assert peer.last_seq == 3
+    _append_updates(peer, 1, start=3)
+    assert peer.last_seq == 4
+
+
+def test_epoch_zero_is_unfenced_legacy_mode(tmp_path):
+    """Single-host journals (epoch 0, the default) never write an EPOCH
+    file and never check one — zero-cost when the fabric is not in play."""
+    log = _log(tmp_path)
+    _append_updates(log, 2)
+    assert not os.path.exists(str(tmp_path / "wal" / "EPOCH"))
+    assert log.stats()["epoch"] == 0
+
+
+def test_journal_dir_recreated_after_disappearing(tmp_path):
+    """First-boot self-heal: appends recreate a journal directory whose
+    chain vanished after construction instead of raising."""
+    import shutil
+
+    log = _log(tmp_path)
+    shutil.rmtree(str(tmp_path / "wal"))
+    _append_updates(log, 1)
+    assert log.last_seq == 1

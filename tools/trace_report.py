@@ -233,6 +233,37 @@ def summarize(events: List[Dict[str, Any]]) -> str:
         for cause in sorted(by_cause):
             lines.append(f"  cause {cause:<22}{by_cause[cause]:>5}")
 
+    # multi-host fabric (metrics_tpu.fabric): shards tag their spans with an
+    # `@shard<k>` owner suffix, so a fleet trace decomposes into per-shard
+    # launch/request tallies; failover spans carry shard/peer/epoch/ms
+    shard_launches: Dict[str, int] = {}
+    shard_requests: Dict[str, int] = {}
+    for e in events:
+        owner = str(e.get("owner", ""))
+        if "@shard" not in owner:
+            continue
+        sid = owner.rsplit("@", 1)[1]
+        if e.get("kind") == "stacked-aot":  # one coalesced device launch
+            shard_launches[sid] = shard_launches.get(sid, 0) + 1
+        elif e["name"] == "request":
+            shard_requests[sid] = shard_requests.get(sid, 0) + 1
+    failovers = [e for e in events if e["name"] == "failover"]
+    if shard_launches or shard_requests or failovers:
+        lines.append("")
+        lines.append(f"fleet: {len(set(shard_launches) | set(shard_requests))} shards seen   failovers: {len(failovers)}")
+        for sid in sorted(set(shard_launches) | set(shard_requests)):
+            lines.append(
+                f"  {sid:<10}launches: {shard_launches.get(sid, 0):>6}"
+                f"   requests: {shard_requests.get(sid, 0):>6}"
+            )
+        for e in failovers:
+            attrs = e.get("attrs") or {}
+            lines.append(
+                f"  failover shard {attrs.get('shard', '?')} -> peer {attrs.get('peer', '?')}"
+                f"   epoch {attrs.get('epoch', '?')}   {float(attrs.get('ms', 0.0)):.1f} ms"
+                f"   sessions {attrs.get('sessions', '?')}"
+            )
+
     # cold start to first result: process start (trace window origin) to the
     # retirement of the first value-producing span — the number the
     # persistent cache exists to shrink
