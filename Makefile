@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos chaos-fabric crash load doctest audit bench bench-forward serve-bench stream-bench trace slo tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load doctest audit bench bench-forward serve-bench stream-bench trace slo tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -58,6 +58,7 @@ chaos:
 	done
 	$(MAKE) crash
 	$(MAKE) load
+	$(MAKE) chaos-elastic
 
 # kill-and-recover loop: for EVERY registered crash point a subprocess is
 # SIGKILLed at that instruction, then a fresh process recover()s
@@ -76,6 +77,18 @@ crash:
 chaos-fabric:
 	python -m pytest tests/bases/test_crash_recovery.py -k shard_death -q
 	python tools/loadgen.py --sessions 48 --events 1200 --shards 2 --seed 11 --kill-shard 0
+
+# elastic-membership lane: the overload stream with mid-run membership and
+# partition drills — add a shard at event 300 (timed drain -> fence ->
+# transfer -> swap hand-off), retire one at 700, partition shard 1 at the
+# halfway mark (epoch fence promotes exactly one side). The run keeps an
+# exactly-once ledger of every admitted request and exits non-zero if the
+# final fleet state differs bit-for-bit from an unsharded control replay
+# (a dropped or double-applied request), alongside the structural pins.
+chaos-elastic:
+	python -m pytest tests/bases/test_fabric_elastic.py -q
+	python tools/loadgen.py --sessions 48 --events 1200 --shards 2 --seed 11 \
+		--add-shard-at 300 --remove-shard-at 700 --partition 1
 
 # open-loop overload harness (tools/loadgen.py): replayable heavy-tailed
 # arrivals with hot-key skew over a sharded fabric, calibrated by warm

@@ -580,7 +580,13 @@ def _cfg_fabric(
     a peer, timed from kill to the first recovered result. (4)
     **Structure** (pinned, not timed): every stacked launch carries
     exactly one ``@shard<k>`` owner tag and the submit path emits zero
-    collective events."""
+    collective events. (5) **Elastic membership**: planned hand-off
+    (drain → fence → transfer → swap) timed to the first result off a
+    moved session, plus the pooled fleet-read latency at N shards.
+    (6) **Replication**: at a long journal, promoting a warm standby
+    (tail-only replay) vs the full-replay failover of an identical
+    un-replicated fleet — ``fabric_replicated_failover_ms`` must sit
+    strictly below ``fabric_full_replay_failover_ms``."""
     import tempfile
 
     import jax
@@ -660,6 +666,12 @@ def _cfg_fabric(
         v for k, v in telemetry.snapshot().items() if k.startswith("collective")
     )
     detail["fabric_submit_collectives"] = collectives_1 - collectives_0
+
+    # pooled fleet read: compute_all fans out over the read pool, so the
+    # fleet-wide latency tracks max(shard) instead of sum(shard)
+    t0 = time.perf_counter()
+    jax.block_until_ready(list(fab.compute_all().values()))
+    detail["fabric_fleet_read_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
     fab.shutdown()
 
     # failover: kill a shard with durable state, fence + replay on a peer,
@@ -683,6 +695,63 @@ def _cfg_fabric(
             (time.perf_counter() - t0) * 1e3, 1
         )
         dfab.shutdown()
+
+    # planned hand-off: scale out one shard, converge the ring, and time
+    # drain -> fence -> transfer -> swap to the first result off a moved
+    # session
+    with tempfile.TemporaryDirectory() as data_dir:
+        efab = ShardedMetricsService(
+            Accuracy(task="multiclass", num_classes=C),
+            num_shards=2,
+            data_dir=data_dir,
+        )
+        for i in range(min(events, 512)):
+            efab.submit(names[i % sessions], *batches[i % len(batches)])
+        efab.drain()
+        t0 = time.perf_counter()
+        efab.add_shard()
+        moved = efab.rebalance()["moved"]
+        if moved:
+            jax.block_until_ready(efab.compute(moved[0]))
+        detail["fabric_handoff_first_result_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1
+        )
+        detail["fabric_handoff_moved_sessions"] = len(moved)
+        efab.shutdown()
+
+    # replicated vs full-replay failover at a long journal (~events*5
+    # records, capped at 10k): the warm standby replays only the unshipped
+    # tail, the un-replicated twin replays the whole journal
+    with tempfile.TemporaryDirectory() as root:
+        tail = max(200, min(10000, events * 5))
+        fo_times = {}
+        for mode in ("standby", "full"):
+            mfab = ShardedMetricsService(
+                Accuracy(task="multiclass", num_classes=C),
+                num_shards=2,
+                data_dir=os.path.join(root, mode),
+                standby=(mode == "standby"),
+            )
+            for i in range(tail):
+                mfab.submit(names[i % sessions], *batches[i % len(batches)])
+                if i % 64 == 0:
+                    mfab.flush()
+            mfab.drain()
+            if mode == "standby":
+                mfab.replicate()  # seed
+                mfab.replicate()  # ship the tail
+            victim = mfab.shard_for(names[0])
+            t0 = time.perf_counter()
+            mfab.kill_shard(victim)
+            mfab.fail_over(victim)
+            jax.block_until_ready(mfab.compute(names[0]))
+            fo_times[mode] = (time.perf_counter() - t0) * 1e3
+            mfab.shutdown()
+        detail["fabric_replicated_failover_ms"] = round(fo_times["standby"], 1)
+        detail["fabric_full_replay_failover_ms"] = round(fo_times["full"], 1)
+        detail["fabric_replication_failover_speedup"] = round(
+            fo_times["full"] / max(fo_times["standby"], 1e-9), 2
+        )
 
 
 def _cfg_resilience_overhead(detail: dict) -> None:

@@ -37,20 +37,55 @@ process bounds session count. This module is the horizontal layer over it
   :meth:`fleet_snapshot` aggregates per-shard breaker state through
   :func:`metrics_tpu.resilience.aggregate_policy_stats`.
 
+Beyond crash failover, membership and degradation are first-class:
+
+* **Elastic membership (planned hand-off).** :meth:`add_shard` /
+  :meth:`remove_shard` change capacity with zero kills:
+  :meth:`rebalance` drains each source shard (flush + an admission
+  fence so no new submits land mid-move), bumps its journal epoch (the
+  same zombie fence failover uses — a superseded writer of the moved
+  range raises :class:`StaleEpochError`), transfers exactly the
+  affected ring arc's session rows to the target, and only then swaps
+  ring ownership. Consistent hashing keeps the move minimal — ~1/N of
+  the sessions, never a reshuffle — and a moved session's digest is
+  bit-identical to an unmoved twin.
+* **Hot-standby replication.** With ``standby=True`` each shard ships
+  its journal tail (:meth:`metrics_tpu.wal.WriteAheadLog.stream_since`)
+  to a :class:`~metrics_tpu.wal.StandbyReplica` designated for its ring
+  successor; :meth:`replicate` advances the warm copies. Failover then
+  promotes the standby and replays only the *unshipped* tail —
+  O(replication lag), not O(journal). :meth:`anti_entropy` checksums
+  every standby against its primary at a common replication floor and
+  re-seeds divergent copies by bulk state transfer.
+* **Gray-failure containment.** The ``shard-slow`` fault class injects
+  per-flush latency into one shard (alive, correct, slow); the
+  suspicion monitor (:meth:`suspicion_sweep`) reads each shard's SLO
+  sketches and quarantines any shard whose served p99 crosses
+  ``suspect_p99_multiple`` x the fleet median — drain, fence, and route
+  its partition to the successor's standby (failover cause
+  ``suspect-slow``). The ``network-partition`` fault class makes a
+  shard unreachable while its host keeps running: the fabric fences and
+  fails over (cause ``partition``), after which every journaled write
+  from the old side raises :class:`StaleEpochError` — exactly one side
+  of the partition wins.
+
 The chaos lane (``make chaos-fabric``) SIGKILLs a real subprocess shard
 at every crash point (``tests/bases/fabric_worker.py``) and asserts the
 post-failover ``compute_all()`` digest is bit-identical to an uncrashed
 twin; the open-loop load harness (``tools/loadgen.py``) drives heavy-
 tailed, hot-key-skewed replayable traffic across shards and pins the
-structural invariants under 2x overload. See ``docs/serving.md``,
-"Multi-host fabric".
+structural invariants under 2x overload, with mid-run membership and
+partition drills (``make chaos-elastic``). See ``docs/serving.md``,
+"Multi-host fabric" and "Elastic membership".
 """
 import copy
 import hashlib
 import os
+import statistics
 import threading
 import time
 from bisect import bisect_right
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from metrics_tpu import faults, resilience, telemetry, wal
@@ -60,6 +95,7 @@ __all__ = [
     "HashRing",
     "ShardedMetricsService",
     "ShardDeadError",
+    "FleetDeadError",
     "StaleEpochError",
 ]
 
@@ -71,6 +107,13 @@ StaleEpochError = wal.StaleEpochError
 class ShardDeadError(RuntimeError):
     """The shard owning this session is dead and automatic failover is
     disabled (``auto_failover=False``); call :meth:`fail_over` first."""
+
+
+class FleetDeadError(ShardDeadError):
+    """Every shard is dead (or retired): there is no live peer left to
+    recover a partition on. Terminal for the fleet — the message names
+    the dead shards so the operator knows what to restart. Subclasses
+    :class:`ShardDeadError` so existing handlers still catch it."""
 
 
 def _point(key: str) -> int:
@@ -117,7 +160,11 @@ class HashRing:
         candidates = set(self.shard_ids if alive is None else alive)
         candidates.discard(shard_id)
         if not candidates:
-            raise ShardDeadError(f"no live peer to recover shard {shard_id}")
+            dead = sorted(set(self.shard_ids) - candidates)
+            raise FleetDeadError(
+                f"fleet dead: no live peer to recover shard {shard_id} "
+                f"(dead shards: {dead})"
+            )
         start = _point(f"shard-{shard_id}:vnode-0")
         i = bisect_right(self._hashes, start)
         for step in range(len(self._hashes)):
@@ -140,7 +187,8 @@ class _Shard:
     failover (a fresh ``MetricsService`` at a higher epoch)."""
 
     __slots__ = ("shard_id", "journal_dir", "checkpoint_dir", "service",
-                 "alive", "epoch", "host", "failovers")
+                 "alive", "epoch", "host", "failovers", "rid_offset",
+                 "rid_stride", "retired", "suspect", "down_cause")
 
     def __init__(
         self,
@@ -159,6 +207,14 @@ class _Shard:
         # which partition's host serves this one (itself until failover)
         self.host = shard_id
         self.failovers = 0
+        # rid lattice currently assigned to this partition (rebased on
+        # membership changes so rids stay globally unique)
+        self.rid_offset = service._rid
+        self.rid_stride = service._rid_stride
+        # membership / degradation flags
+        self.retired = False        # removed via remove_shard(); permanent
+        self.suspect = False        # flagged by the suspicion monitor
+        self.down_cause: Optional[str] = None  # why it last went down
 
 
 class ShardedMetricsService:
@@ -179,6 +235,18 @@ class ShardedMetricsService:
             — ``True`` (default) runs :meth:`fail_over` inline and serves
             the request on the recovered host; ``False`` raises
             :class:`ShardDeadError`.
+        standby: hot-standby replication. ``True`` provisions a warm
+            :class:`~metrics_tpu.wal.StandbyReplica` per shard (hosted at
+            its ring successor) on the first :meth:`replicate` call;
+            failover then promotes the standby and replays only the
+            unshipped journal tail — O(replication lag) instead of
+            O(journal).
+        suspect_p99_multiple / suspect_min_requests: gray-failure
+            suspicion threshold — :meth:`suspicion_sweep` quarantines a
+            shard whose served p99 exceeds ``suspect_p99_multiple`` times
+            the fleet median, once it has served at least
+            ``suspect_min_requests`` requests (below that the sketch is
+            noise).
         checkpoint_every / max_inflight / max_queue / admission /
             admission_timeout_s / request_deadline_s / flush_interval_s /
             coalesce:
@@ -200,6 +268,9 @@ class ShardedMetricsService:
         data_dir: Optional[str] = None,
         vnodes: int = 64,
         auto_failover: bool = True,
+        standby: bool = False,
+        suspect_p99_multiple: float = 4.0,
+        suspect_min_requests: int = 32,
         coalesce: bool = True,
         checkpoint_every: int = 0,
         max_inflight: int = 2,
@@ -232,8 +303,27 @@ class ShardedMetricsService:
         # journaled state)
         self._tenant_cfg: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
-        self.stats: Dict[str, int] = {"failovers": 0, "dead_routes": 0}
+        self.stats: Dict[str, int] = {"failovers": 0, "dead_routes": 0,
+                                      "handoffs": 0, "moved_sessions": 0}
         self.failover_events: List[Dict[str, Any]] = []
+
+        # hot-standby replication (see module docstring)
+        self.standby = bool(standby)
+        self._standbys: Dict[int, wal.StandbyReplica] = {}
+        # gray-failure suspicion thresholds
+        self.suspect_p99_multiple = float(suspect_p99_multiple)
+        self.suspect_min_requests = int(suspect_min_requests)
+        # elastic membership: admission fence (shard ids currently mid
+        # hand-off — routes to them park until the swap completes) and the
+        # ring the next rebalance() converges to
+        self._fenced: set = set()
+        self._fence_cond = threading.Condition()
+        self._target_ring: Optional[HashRing] = None
+        # final SLO snapshots of retired shards (loadgen's exactly-once
+        # ledger still needs their served counts after remove_shard)
+        self._retired_slo: Dict[int, Any] = {}
+        # bounded pool for fleet-wide reads (created lazily)
+        self._pool: Optional[ThreadPoolExecutor] = None
 
         self._shards: List[_Shard] = []
         for k in range(self.num_shards):
@@ -252,17 +342,34 @@ class ShardedMetricsService:
         root = os.path.join(self.data_dir, f"shard-{shard_id:02d}")
         return os.path.join(root, "wal"), os.path.join(root, "ckpt")
 
-    def _build_service(self, shard_id: int, epoch: int) -> MetricsService:
+    def _build_service(
+        self,
+        shard_id: int,
+        epoch: int,
+        *,
+        rid_offset: Optional[int] = None,
+        rid_stride: Optional[int] = None,
+        durable: bool = True,
+    ) -> MetricsService:
         journal_dir, checkpoint_dir = self.shard_dirs(shard_id)
+        kwargs = dict(self._service_kwargs)
+        if not durable:
+            # warm standby replica: no journal/checkpoint of its own (it
+            # attaches the primary's on promotion), no background flusher,
+            # no admission limit — applies arrive pre-admitted via
+            # apply_records()
+            journal_dir = checkpoint_dir = None
+            kwargs.update(flush_interval_s=None, checkpoint_every=0,
+                          max_queue=None)
         return MetricsService(
             copy.deepcopy(self._template),
             journal_dir=journal_dir,
             checkpoint_dir=checkpoint_dir,
             shard_id=shard_id,
-            rid_offset=shard_id,
-            rid_stride=self.num_shards,
+            rid_offset=shard_id if rid_offset is None else int(rid_offset),
+            rid_stride=self.num_shards if rid_stride is None else int(rid_stride),
             epoch=epoch,
-            **self._service_kwargs,
+            **kwargs,
         )
 
     # --------------------------------------------------------------- routing
@@ -271,31 +378,48 @@ class ShardedMetricsService:
         cross-shard reads)."""
         return self.ring.owner(name)
 
+    # fault class -> failover cause recorded when it fires at the routing
+    # seam. A partition is not a crash: the old host keeps running (the
+    # returned zombie service), and only the epoch fence decides which
+    # side's writes survive.
+    _ROUTE_FAULTS = (("shard-death", "killed"), ("network-partition", "partition"))
+
     def _probe_death(self, shard: _Shard) -> None:
-        """Routing-seam hook for the ``shard-death`` fault class: an
-        active spec targeting this shard (param ``shard``, default = any)
-        kills it exactly as a missed liveness probe would."""
-        if not shard.alive:
+        """Routing-seam hook for the ``shard-death`` and
+        ``network-partition`` fault classes: an active spec targeting this
+        shard (param ``shard``, default = any) marks it down exactly as a
+        missed liveness probe would, tagged with the matching cause."""
+        if not shard.alive or shard.retired:
             return
-        params = faults.fault_params("shard-death")
-        target = params.get("shard")
-        if target is not None and int(target) != shard.shard_id:
-            return
-        if faults.should_fire("shard-death"):
-            self.kill_shard(shard.shard_id)
+        for fault, cause in self._ROUTE_FAULTS:
+            params = faults.fault_params(fault)
+            target = params.get("shard")
+            if target is not None and int(target) != shard.shard_id:
+                continue
+            if faults.should_fire(fault):
+                self.kill_shard(shard.shard_id, cause=cause)
+                return
 
     def _route(self, name: str) -> _Shard:
-        shard = self._shards[self.shard_for(name)]
-        self._probe_death(shard)
-        if not shard.alive:
-            self.stats["dead_routes"] += 1
-            if not self.auto_failover:
-                raise ShardDeadError(
-                    f"shard {shard.shard_id} (owner of session {name!r}) is "
-                    "dead; call fail_over() to recover it on a peer"
-                )
-            self.fail_over(shard.shard_id)
-        return shard
+        while True:
+            shard = self._shards[self.shard_for(name)]
+            if shard.shard_id in self._fenced:
+                # mid hand-off: park until the ring swap, then re-route —
+                # ownership of this arc may have moved
+                with self._fence_cond:
+                    while shard.shard_id in self._fenced:
+                        self._fence_cond.wait(timeout=5.0)
+                continue
+            self._probe_death(shard)
+            if not shard.alive:
+                self.stats["dead_routes"] += 1
+                if not self.auto_failover:
+                    raise ShardDeadError(
+                        f"shard {shard.shard_id} (owner of session {name!r}) is "
+                        "dead; call fail_over() to recover it on a peer"
+                    )
+                self.fail_over(shard.shard_id)
+            return shard
 
     # ---------------------------------------------------------------- intake
     def submit(
@@ -334,14 +458,15 @@ class ShardedMetricsService:
 
     # ----------------------------------------------------------------- fleet
     def _live_shards(self) -> List[_Shard]:
-        return [s for s in self._shards if s.alive]
+        return [s for s in self._shards if s.alive and not s.retired]
 
     def _serving_shards(self) -> List[_Shard]:
-        """Every shard, healed: dead partitions are failed over first so a
-        fleet-wide read never silently drops a partition. With
-        ``auto_failover=False`` a dead shard raises instead — the caller
-        must :meth:`fail_over` (or :meth:`probe`) explicitly."""
-        for shard in self._shards:
+        """Every non-retired shard, healed: dead partitions are failed
+        over first so a fleet-wide read never silently drops a partition.
+        With ``auto_failover=False`` a dead shard raises instead — the
+        caller must :meth:`fail_over` (or :meth:`probe`) explicitly."""
+        serving = [s for s in self._shards if not s.retired]
+        for shard in serving:
             self._probe_death(shard)
             if not shard.alive:
                 if not self.auto_failover:
@@ -350,7 +475,26 @@ class ShardedMetricsService:
                         "fleet-wide reads (its partition would be missing)"
                     )
                 self.fail_over(shard.shard_id)
-        return self._shards
+        return serving
+
+    def _fan_out(self, fn, shards: List[_Shard]) -> List[Any]:
+        """Map ``fn`` over shards on a bounded thread pool — fleet-wide
+        reads pay max(shard) latency instead of sum(shard). Shard state is
+        disjoint (per-shard flush locks guard each service), so the only
+        ordering requirement is the healed shard list computed first. One
+        shard degenerates to a plain call; the pool is created lazily and
+        bounded at 8 so a wide fleet cannot fork-bomb the host. (The
+        packed-collective read — one device launch for the whole fleet —
+        stays on the roadmap; this is the cheap, exact half.)"""
+        shards = list(shards)
+        if len(shards) <= 1:
+            return [fn(s) for s in shards]
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=min(8, len(shards)),
+                thread_name_prefix=f"{self.label}-read",
+            )
+        return list(self._pool.map(fn, shards))
 
     def flush(self) -> int:
         """Flush every live shard; returns total requests served. One
@@ -368,10 +512,13 @@ class ShardedMetricsService:
     def compute_all(self) -> Dict[str, Any]:
         """Every open session fleet-wide (partitions are disjoint, so the
         union is exact). Dead shards are failed over first — a fleet read
-        never silently omits a partition."""
+        never silently omits a partition — then shards evaluate
+        concurrently on the read pool."""
         out: Dict[str, Any] = {}
-        for s in self._serving_shards():
-            out.update(s.service.compute_all())
+        for part in self._fan_out(
+            lambda s: s.service.compute_all(), self._serving_shards()
+        ):
+            out.update(part)
         return out
 
     def checkpoint(self) -> List[str]:
@@ -386,6 +533,11 @@ class ShardedMetricsService:
     def shutdown(self) -> None:
         for s in self._live_shards():
             s.service.shutdown()
+        for standby in self._standbys.values():
+            standby.service.shutdown()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
 
     # -------------------------------------------------------------- liveness
     def heartbeat(self) -> Dict[int, bool]:
@@ -394,12 +546,15 @@ class ShardedMetricsService:
         ``shard-death`` fault targeting it) reports ``False``."""
         beats: Dict[int, bool] = {}
         for shard in self._shards:
+            if shard.retired:
+                continue
             self._probe_death(shard)
             if shard.alive:
                 try:
                     shard.service.health()
                 except Exception:  # noqa: BLE001 - a dead host answers nothing
                     shard.alive = False
+                    shard.down_cause = "heartbeat"
             beats[shard.shard_id] = shard.alive
         return beats
 
@@ -411,25 +566,32 @@ class ShardedMetricsService:
             self.fail_over(sid)
         return failed
 
-    def kill_shard(self, shard_id: int) -> MetricsService:
+    def kill_shard(self, shard_id: int, cause: str = "killed") -> MetricsService:
         """Mark one shard dead (the in-process twin of SIGKILLing its
         host). The old service object is returned — it plays the zombie
         in fencing tests: any journaled write through it after the peer
         fences raises :class:`StaleEpochError`. No flush, no checkpoint,
-        no goodbye — exactly what SIGKILL leaves behind."""
+        no goodbye — exactly what SIGKILL leaves behind. ``cause`` is
+        recorded on the eventual failover event (``killed`` by default;
+        ``partition`` when the host is alive but unreachable)."""
         shard = self._shards[shard_id]
         shard.alive = False
+        shard.down_cause = cause
         return shard.service
 
-    def fail_over(self, shard_id: int) -> float:
+    def fail_over(self, shard_id: int, cause: Optional[str] = None) -> float:
         """Recover a dead shard's partition on its designated peer.
 
         Fence-then-replay: bump the partition's journal epoch
         (:func:`metrics_tpu.wal.fence_epoch`) so the zombie is locked out
-        BEFORE any state moves, then build a fresh service over the dead
-        shard's directories at the new epoch and ``recover()`` it
-        (checkpoint + exactly-once journal tail). Per-tenant overrides
-        re-apply from the fabric's authoritative copy. Returns the
+        BEFORE any state moves. With a warm standby for this partition,
+        promotion attaches the durable directories to the replica and
+        replays only the journal tail above its applied cursor —
+        O(replication lag). Without one, a fresh service over the dead
+        shard's directories ``recover()``\\ s the checkpoint + exactly-once
+        journal tail (the full-replay path). Per-tenant overrides re-apply
+        from the fabric's authoritative copy. ``cause`` lands on the
+        failover event (defaults to the recorded down cause). Returns the
         failover wall time in ms (fence + recover + first health probe) —
         the ``failover`` telemetry span carries it, and the bench's
         failover-to-first-result key builds on it."""
@@ -442,6 +604,7 @@ class ShardedMetricsService:
                     f"shard {shard_id} has no durable state (data_dir=None); "
                     "its sessions are lost — nothing to replay on a peer"
                 )
+            cause = cause or shard.down_cause or "killed"
             peer = self.ring.successor(
                 shard_id, alive=[s.shard_id for s in self._live_shards()]
             )
@@ -449,14 +612,31 @@ class ShardedMetricsService:
             w0 = time.monotonic()
             new_epoch = max(shard.epoch, wal.read_epoch(shard.journal_dir)) + 1
             wal.fence_epoch(shard.journal_dir, new_epoch)
-            service = self._build_service(shard_id, new_epoch)
-            service.recover()
+            standby = self._standbys.pop(shard_id, None)
+            replayed: Optional[int] = None
+            if standby is not None:
+                # promote: the replica is already warm up to its applied
+                # cursor — attach the partition's directories at the new
+                # epoch and replay only the unshipped tail
+                service = standby.service
+                service.attach_durability(
+                    shard.journal_dir, shard.checkpoint_dir, new_epoch
+                )
+                replayed = service._replay_journal(standby.applied_seq)
+            else:
+                service = self._build_service(
+                    shard_id, new_epoch,
+                    rid_offset=shard.rid_offset, rid_stride=shard.rid_stride,
+                )
+                service.recover()
             for name, cfg in self._tenant_cfg.items():
                 if self.shard_for(name) == shard_id:
                     service.configure_session(name, **cfg)
             shard.service = service
             shard.epoch = new_epoch
             shard.alive = True
+            shard.suspect = False
+            shard.down_cause = None
             shard.host = peer
             shard.failovers += 1
             self.stats["failovers"] += 1
@@ -467,7 +647,11 @@ class ShardedMetricsService:
                 "epoch": new_epoch,
                 "ms": round(ms, 3),
                 "sessions": service.session_count,
+                "cause": cause,
+                "standby": standby is not None,
             }
+            if replayed is not None:
+                event["replayed"] = replayed
             self.failover_events.append(event)
             telemetry.emit(
                 "failover", self.label, "shard-death", t0=t0, stream="serve",
@@ -475,12 +659,372 @@ class ShardedMetricsService:
             )
             return ms
 
+    # ------------------------------------------------------------ membership
+    def _serving_ids(self) -> List[int]:
+        return [s.shard_id for s in self._shards if not s.retired]
+
+    def _fence(self, shard_ids: List[int]) -> None:
+        """Admission fence: routes to these shards park until unfenced —
+        no submit can land on a partition mid hand-off."""
+        with self._fence_cond:
+            self._fenced.update(shard_ids)
+
+    def _unfence(self, shard_ids: List[int]) -> None:
+        with self._fence_cond:
+            self._fenced.difference_update(shard_ids)
+            self._fence_cond.notify_all()
+
+    def add_shard(self) -> int:
+        """Provision one new, empty shard (scale-out). Returns the new
+        shard id. Routing stays on the OLD ring until :meth:`rebalance`
+        hands the moved arc over — the new shard serves nothing until
+        then, so adding capacity is never observable mid-provision."""
+        with self._lock:
+            sid = len(self._shards)
+            journal_dir, checkpoint_dir = self.shard_dirs(sid)
+            epoch = (wal.read_epoch(journal_dir) or 0) + 1 if journal_dir else 0
+            service = self._build_service(sid, epoch)
+            self._shards.append(
+                _Shard(sid, service, journal_dir, checkpoint_dir, epoch)
+            )
+            self.num_shards = len(self._serving_ids())
+            self._target_ring = HashRing(
+                self._serving_ids(), vnodes=self.ring.vnodes
+            )
+            telemetry.emit(
+                "membership", self.label, "add-shard", t0=telemetry.clock(),
+                stream="serve", shard=sid, num_shards=self.num_shards,
+            )
+            return sid
+
+    def remove_shard(self, shard_id: int) -> List[str]:
+        """Retire one shard (scale-in): hand its entire partition to the
+        ring survivors with a planned drain — zero kills, zero replay on
+        the receivers — then drop it from the ring and shut it down. Its
+        final SLO snapshot is archived so fleet accounting (the
+        exactly-once ledger in loadgen) still sees its served counts.
+        Returns the session names that moved."""
+        shard = self._shards[shard_id]
+        if shard.retired:
+            raise ValueError(f"shard {shard_id} is already retired")
+        survivors = [sid for sid in self._serving_ids() if sid != shard_id]
+        if not survivors:
+            raise FleetDeadError(
+                f"cannot remove shard {shard_id}: it is the last live shard "
+                "(the fleet would be dead)"
+            )
+        if not shard.alive:
+            # recover first — planned removal moves state, never loses it
+            self.fail_over(shard_id)
+        with self._lock:
+            self._target_ring = HashRing(survivors, vnodes=self.ring.vnodes)
+        moved = self.rebalance()["moved"]
+        with self._lock:
+            self._retired_slo[shard_id] = shard.service.slo_snapshot()
+            self._standbys.pop(shard_id, None)
+            shard.service.shutdown()
+            shard.retired = True
+            shard.alive = False
+            shard.down_cause = "planned"
+            self.num_shards = len(survivors)
+            self._rebase_rid_lattice()
+            telemetry.emit(
+                "membership", self.label, "remove-shard", t0=telemetry.clock(),
+                stream="serve", shard=shard_id, num_shards=self.num_shards,
+                moved=len(moved),
+            )
+        return moved
+
+    def rebalance(self) -> Dict[str, Any]:
+        """Converge session placement to the target ring set by
+        :meth:`add_shard` / :meth:`remove_shard` — the planned hand-off.
+
+        Per source shard the sequence is **drain → fence → transfer →
+        swap**: an admission fence parks routes to the source (zero lost
+        submits), ``drain()`` retires every admitted request into the
+        stacked state, the source's journal epoch bumps
+        (:meth:`MetricsService.advance_epoch` — a superseded writer of
+        the moved range now raises :class:`StaleEpochError`), exactly the
+        sessions whose target-ring owner changed transfer as portable
+        state rows (:meth:`MetricsService.export_sessions` /
+        ``import_sessions`` — bit-identical, no re-execution), and only
+        then does the ring swap and the fence lift. Consistent hashing
+        makes the plan minimal: ~1/N of the sessions, never a reshuffle.
+        Both sides checkpoint (the moved rows live in no journal) and
+        their standbys re-seed. Returns the move report
+        (``moved`` names, per-pair events, wall ms)."""
+        with self._lock:
+            target = self._target_ring
+            if target is None:
+                return {"moved": [], "handoffs": 0, "ms": 0.0}
+            # plan: exactly the open sessions whose owner changes
+            moves: Dict[int, Dict[int, List[str]]] = {}
+            for shard in self._shards:
+                if shard.retired or not shard.alive:
+                    continue
+                for name in sorted(shard.service._rows):
+                    dst = target.owner(name)
+                    if dst != shard.shard_id:
+                        moves.setdefault(shard.shard_id, {}).setdefault(
+                            dst, []
+                        ).append(name)
+        t0 = telemetry.clock()
+        w0 = time.monotonic()
+        moved: List[str] = []
+        touched: set = set()
+        srcs = sorted(moves)
+        self._fence(srcs)
+        try:
+            for src_id in srcs:
+                shard = self._shards[src_id]
+                h0 = time.monotonic()
+                shard.service.drain()
+                if shard.journal_dir is not None:
+                    shard.epoch = shard.service.advance_epoch(
+                        max(shard.epoch, wal.read_epoch(shard.journal_dir)) + 1
+                    )
+                for dst_id in sorted(moves[src_id]):
+                    names = moves[src_id][dst_id]
+                    dst = self._shards[dst_id]
+                    dst.service.import_sessions(
+                        shard.service.export_sessions(names)
+                    )
+                    for name in names:
+                        cfg = self._tenant_cfg.get(name)
+                        if cfg:
+                            dst.service.configure_session(name, **cfg)
+                    moved.extend(names)
+                    touched.update((src_id, dst_id))
+                    self.failover_events.append({
+                        "shard": src_id,
+                        "peer": dst_id,
+                        "epoch": shard.epoch,
+                        "ms": round((time.monotonic() - h0) * 1e3, 3),
+                        "sessions": len(names),
+                        "cause": "planned",
+                        "standby": False,
+                    })
+                for dst_id in moves[src_id]:
+                    for name in moves[src_id][dst_id]:
+                        shard.service.close_session(name)
+            with self._lock:
+                self.ring = target
+                self._target_ring = None
+        finally:
+            self._unfence(srcs)
+        # moved rows exist in no journal: both sides checkpoint so a crash
+        # after the swap recovers them, and their standbys re-seed (the
+        # state transfer bypassed the shipped log)
+        for sid in sorted(touched):
+            svc = self._shards[sid].service
+            if self._shards[sid].checkpoint_dir is not None:
+                svc.checkpoint()
+            standby = self._standbys.get(sid)
+            if standby is not None:
+                with svc._flush_lock:
+                    standby.seed_from(svc, svc.replication_floor())
+        with self._lock:
+            self._rebase_rid_lattice()
+            self.stats["handoffs"] += len(srcs)
+            self.stats["moved_sessions"] += len(moved)
+        ms = (time.monotonic() - w0) * 1e3
+        telemetry.emit(
+            "handoff", self.label, "planned", t0=t0, stream="serve",
+            sources=len(srcs), sessions=len(moved), ms=round(ms, 3),
+        )
+        return {"moved": moved, "handoffs": len(srcs), "ms": ms}
+
+    def _rebase_rid_lattice(self) -> None:
+        """Re-base every live shard's request-id lattice to
+        ``fleet_max_rid + position, stride = live shards`` — rids stay
+        globally unique across any sequence of joins and leaves. Caller
+        holds the fabric lock."""
+        live = [s for s in self._shards if not s.retired]
+        if not live:
+            return
+        stride = len(live)
+        base = max(s.service._rid for s in live) + stride
+        for pos, s in enumerate(sorted(live, key=lambda s: s.shard_id)):
+            s.service.rebase_rids(base + pos, stride)
+            s.rid_offset, s.rid_stride = base + pos, stride
+            standby = self._standbys.get(s.shard_id)
+            if standby is not None:
+                standby.service.rebase_rids(base + pos, stride)
+
+    # ----------------------------------------------------------- replication
+    def replicate(self, shard_id: Optional[int] = None) -> Dict[int, int]:
+        """Advance the warm standbys: ship each primary's journal tail
+        (:meth:`~metrics_tpu.wal.WriteAheadLog.stream_since` above the
+        standby's cursor) plus the current replication floor. The first
+        call per shard seeds its standby by bulk state transfer at the
+        floor (O(1) state bytes — jax rows are immutable). Returns
+        applied-record counts per shard. Call it from the same periodic
+        loop as :meth:`probe` — replication lag, and therefore failover
+        cost, is bounded by how often this runs."""
+        shards = (
+            self._serving_shards() if shard_id is None
+            else [self._shards[shard_id]]
+        )
+        out: Dict[int, int] = {}
+        for shard in shards:
+            if shard.retired or not shard.alive:
+                continue
+            if shard.service.journal is None:
+                continue
+            out[shard.shard_id] = self._ship(shard)
+        return out
+
+    def _ship(self, shard: _Shard) -> int:
+        standby = self._standbys.get(shard.shard_id)
+        if standby is None:
+            standby = self._new_standby(shard)
+            if standby is None:
+                return 0
+            self._standbys[shard.shard_id] = standby
+            return 0
+        # floor FIRST, then stream: everything at or below the floor is
+        # durably on disk, so the shipped batch always covers it — the
+        # standby never advances past a record it has not seen
+        floor = shard.service.replication_floor()
+        records = shard.service.journal.stream_since(standby.cursor)
+        applied = standby.apply(records, floor)
+        telemetry.emit(
+            "replicate", self.label, "ship", t0=telemetry.clock(),
+            stream="serve", shard=shard.shard_id, records=len(records),
+            applied=applied, floor=floor,
+        )
+        return applied
+
+    def _new_standby(self, shard: _Shard) -> Optional[wal.StandbyReplica]:
+        live = [s.shard_id for s in self._live_shards()]
+        if len(live) < 2:
+            return None  # no peer to host a standby on
+        host = self.ring.successor(shard.shard_id, alive=live)
+        replica = self._build_service(
+            shard.shard_id, epoch=0,
+            rid_offset=shard.rid_offset, rid_stride=shard.rid_stride,
+            durable=False,
+        )
+        standby = wal.StandbyReplica(replica, source_shard=shard.shard_id)
+        with shard.service._flush_lock:
+            # pin the floor: no flush may advance the state between the
+            # floor read and the mirror, or the cursor would lie
+            floor = shard.service.replication_floor()
+            standby.seed_from(shard.service, floor)
+        standby.host = host
+        return standby
+
+    def anti_entropy(self) -> List[int]:
+        """Checksum every standby against its primary at a common
+        replication floor (:meth:`MetricsService.state_digest` — sha1 of
+        the stacked rows); a divergent standby is re-seeded by bulk state
+        transfer. Returns the shard ids that diverged. Divergence should
+        never happen through the shipping path — this is the backstop
+        that turns a silent replica corruption into a bounded repair."""
+        diverged: List[int] = []
+        for shard in self._live_shards():
+            standby = self._standbys.get(shard.shard_id)
+            if standby is None or shard.service.journal is None:
+                continue
+            svc = shard.service
+            with svc._flush_lock:
+                floor = svc.replication_floor()
+                standby.apply(svc.journal.stream_since(standby.cursor), floor)
+                ok = svc.state_digest() == standby.digest()
+                if not ok:
+                    diverged.append(shard.shard_id)
+                    standby.seed_from(svc, floor)
+            telemetry.emit(
+                "anti-entropy", self.label, "scrub", t0=telemetry.clock(),
+                stream="serve", shard=shard.shard_id, diverged=not ok,
+            )
+        return diverged
+
+    # ------------------------------------------------------------- suspicion
+    def suspicion_sweep(
+        self,
+        multiple: Optional[float] = None,
+        min_requests: Optional[int] = None,
+    ) -> List[int]:
+        """Gray-failure containment: compare each shard's served p99
+        (from its SLO sketches) against the fleet median; any shard above
+        ``multiple`` x the median (default ``suspect_p99_multiple``) is
+        marked *suspect* and quarantined — drained (it is alive and
+        correct, just slow: nothing is lost), final tail shipped to its
+        standby, then fenced and failed over to the designated peer with
+        cause ``suspect-slow``. Returns the quarantined shard ids. Shards
+        under ``min_requests`` served are skipped (sketch noise), and a
+        fleet of fewer than two measurable shards has no median to trust.
+        """
+        multiple = (
+            self.suspect_p99_multiple if multiple is None else float(multiple)
+        )
+        min_requests = (
+            self.suspect_min_requests if min_requests is None
+            else int(min_requests)
+        )
+        p99s: Dict[int, float] = {}
+        for shard in self._live_shards():
+            totals = shard.service.slo_snapshot()["totals"]
+            if int(totals.get("served", 0)) < min_requests:
+                continue
+            p99 = float((totals.get("e2e_us") or {}).get("p99") or 0.0)
+            if p99 > 0.0:
+                p99s[shard.shard_id] = p99
+        if len(p99s) < 2:
+            return []
+        median = statistics.median(p99s.values())
+        if median <= 0.0:
+            return []
+        suspects = [
+            sid for sid, p99 in sorted(p99s.items()) if p99 > multiple * median
+        ]
+        for sid in suspects:
+            self._shards[sid].suspect = True
+            telemetry.emit(
+                "suspect", self.label, "gray-failure", t0=telemetry.clock(),
+                stream="serve", shard=sid, p99_us=round(p99s[sid], 1),
+                fleet_median_us=round(median, 1), multiple=multiple,
+            )
+            self.quarantine(sid)
+        return suspects
+
+    def quarantine(self, shard_id: int) -> float:
+        """Route around a suspect-but-alive shard: drain it (planned —
+        every admitted request retires into state first), ship the final
+        journal tail to its standby, then fence and fail its partition
+        over to the designated peer (cause ``suspect-slow``). The slow
+        host's old service becomes the zombie — any later write through
+        it raises :class:`StaleEpochError`."""
+        shard = self._shards[shard_id]
+        if shard.alive:
+            try:
+                shard.service.drain()
+                if shard.shard_id in self._standbys:
+                    self._ship(shard)
+            except Exception:  # noqa: BLE001 — a truly sick shard may not drain
+                pass
+            shard.alive = False
+        shard.down_cause = "suspect-slow"
+        return self.fail_over(shard_id, cause="suspect-slow")
+
     # ----------------------------------------------------------------- stats
     def session_count(self) -> int:
         return sum(s.service.session_count for s in self._live_shards())
 
+    def failover_causes(self) -> Dict[str, int]:
+        """Event count per failover cause (``killed`` / ``heartbeat`` /
+        ``suspect-slow`` / ``partition`` / ``planned``) — the fleet's
+        incident mix at a glance."""
+        causes: Dict[str, int] = {}
+        for event in self.failover_events:
+            cause = event.get("cause", "killed")
+            causes[cause] = causes.get(cause, 0) + 1
+        return causes
+
     def health(self) -> Dict[str, Any]:
-        """Fleet gauges: per-shard health plus liveness/epoch/host."""
+        """Fleet gauges: per-shard health plus liveness/epoch/host,
+        membership and suspicion flags, and the failover cause mix."""
         return {
             "shards": {
                 s.shard_id: {
@@ -488,30 +1032,46 @@ class ShardedMetricsService:
                     "epoch": s.epoch,
                     "host": s.host,
                     "failovers": s.failovers,
-                    **(s.service.health() if s.alive else {}),
+                    "retired": s.retired,
+                    "suspect": s.suspect,
+                    "down_cause": s.down_cause,
+                    "standby": s.shard_id in self._standbys,
+                    **(s.service.health()
+                       if s.alive and not s.retired else {}),
                 }
                 for s in self._shards
             },
             "sessions": self.session_count(),
             "failovers": self.stats["failovers"],
+            "handoffs": self.stats["handoffs"],
+            "moved_sessions": self.stats["moved_sessions"],
+            "failover_causes": self.failover_causes(),
         }
 
     def slo_snapshot(self) -> Dict[str, Any]:
         """Per-shard SLO views keyed by shard id (sessions are disjoint,
-        so per-tenant entries never collide across shards)."""
-        return {
-            s.shard_id: s.service.slo_snapshot() for s in self._live_shards()
-        }
+        so per-tenant entries never collide across shards), read
+        concurrently on the fleet pool. Retired shards report their
+        archived final snapshot — served counts survive scale-in."""
+        live = self._live_shards()
+        out = dict(zip(
+            [s.shard_id for s in live],
+            self._fan_out(lambda s: s.service.slo_snapshot(), live),
+        ))
+        out.update(self._retired_slo)
+        return out
 
     def fleet_snapshot(self) -> Dict[str, Any]:
-        """The fabric's telemetry roll-up: per-shard service snapshots,
-        aggregated breaker/resilience posture
+        """The fabric's telemetry roll-up: per-shard service snapshots
+        (read concurrently on the fleet pool), aggregated
+        breaker/resilience posture
         (:func:`metrics_tpu.resilience.aggregate_policy_stats`), failover
-        history, and summed serve counters."""
-        per_shard = {
-            s.shard_id: s.service.telemetry_snapshot()
-            for s in self._live_shards()
-        }
+        history with causes, and replication standby cursors."""
+        live = self._live_shards()
+        per_shard = dict(zip(
+            [s.shard_id for s in live],
+            self._fan_out(lambda s: s.service.telemetry_snapshot(), live),
+        ))
         totals: Dict[str, int] = {}
         for snap in per_shard.values():
             for k, v in snap["serve"].items():
@@ -525,5 +1085,11 @@ class ShardedMetricsService:
                 snap["resilience"] for snap in per_shard.values()
             ),
             "failover_events": list(self.failover_events),
+            "failover_causes": self.failover_causes(),
+            "replication": {
+                sid: {"host": getattr(standby, "host", None),
+                      **standby.snapshot()}
+                for sid, standby in sorted(self._standbys.items())
+            },
             "health": self.health(),
         }

@@ -39,6 +39,21 @@ fault name                where it fires
                           fence the dead shard's journal epoch and
                           replay it on a designated peer; a write from
                           the zombie raises ``StaleEpochError``
+``shard-slow``            gray failure: the targeted shard's flush path
+                          sleeps ``ms`` (default 25) per call — the
+                          shard is alive and correct but slow. Params
+                          ``shard`` / ``ms``. Nothing raises anywhere;
+                          the suspicion monitor must notice the p99
+                          divergence in the shard's SLO sketches and
+                          quarantine it (``suspect-slow`` failover)
+``network-partition``     gray failure: the targeted shard (param
+                          ``shard``) becomes unreachable from the
+                          router while its host keeps running — both
+                          sides believe they own the range. The fabric
+                          fails the partition over (epoch fence first),
+                          after which every journaled write from the
+                          old owner raises ``StaleEpochError``: exactly
+                          one side of the partition wins
 ========================= ==============================================
 
 Activation is per-test via the context manager::
@@ -106,6 +121,8 @@ FAULT_NAMES = (
     "oom",
     "cache-corruption",
     "shard-death",
+    "shard-slow",
+    "network-partition",
 )
 
 _ENV_VAR = "METRICS_TPU_INJECT_FAULT"

@@ -102,6 +102,7 @@ Session handles::
 
 See ``docs/serving.md`` for the full session model and ops guidance.
 """
+import hashlib
 import json
 import os
 import threading
@@ -782,6 +783,7 @@ class MetricsService:
                 self._queue_cond.notify_all()
             if not queued:
                 return 0
+            self._maybe_slow()
             now = time.monotonic()
             for req in queued:
                 req.queue_us = max(0.0, (now - req.t_enq) * 1e6)
@@ -820,6 +822,26 @@ class MetricsService:
                     # never take serving down; the span records the cause
                     resilience.record_degrade(self.label, "checkpoint", err)
             return served
+
+    def _maybe_slow(self) -> None:
+        """``shard-slow`` gray-failure seam: while an active spec targets
+        this shard (param ``shard``, default any), every flush that found
+        work sleeps ``ms`` (default 25.0) first — the service stays alive
+        and bit-correct, only slow, so nothing here raises. The fabric's
+        suspicion monitor must catch the p99 divergence this produces in
+        the shard's SLO sketches and quarantine the shard."""
+        if not faults.any_active():
+            return
+        params = faults.fault_params("shard-slow")
+        target = params.get("shard")
+        if (
+            target is not None
+            and self.shard_id is not None
+            and int(target) != self.shard_id
+        ):
+            return
+        if faults.should_fire("shard-slow"):
+            time.sleep(float(params.get("ms", 25.0)) * 1e-3)
 
     def drain(self) -> None:
         """Barrier: flush the queue and block until every launch retired."""
@@ -1625,16 +1647,30 @@ class MetricsService:
 
     def _replay_journal(self, fence: int) -> int:
         """Apply the journal tail above ``fence`` in sequence order through
-        the normal flush machinery. Updates queue and flush in batches;
-        close/reset records are ordering barriers (flush, then apply).
-        Replayed work is never re-journaled, never deadline-expired, and
-        never triggers a periodic checkpoint (a mid-replay fence would
-        orphan the unapplied suffix)."""
+        the normal flush machinery (:meth:`apply_records`)."""
         assert self._wal is not None
         records = self._wal.read_tail(fence)
         if not records:
             return 0
         t0 = telemetry.clock()
+        self.apply_records(records)
+        self.stats["replayed_records"] += len(records)
+        telemetry.emit(
+            "journal", self.label, "replay", t0=t0, stream="serve",
+            records=len(records), fence=fence,
+        )
+        return len(records)
+
+    def apply_records(self, records: List[wal.WalRecord]) -> int:
+        """Apply resolved journal records in sequence order through the
+        normal flush machinery — the shared body of journal replay and of
+        standby log shipping (:class:`metrics_tpu.wal.StandbyReplica`).
+        Updates queue and flush in batches; close/reset records are
+        ordering barriers (flush, then apply). Applied work is never
+        re-journaled, never deadline-expired, and never triggers a
+        periodic checkpoint (a mid-replay fence would orphan the
+        unapplied suffix). The caller must pass only resolved records
+        (DROP frames already excluded)."""
         self._replaying = True
         try:
             for rec in records:
@@ -1661,12 +1697,157 @@ class MetricsService:
             self.drain()
         finally:
             self._replaying = False
-        self.stats["replayed_records"] += len(records)
-        telemetry.emit(
-            "journal", self.label, "replay", t0=t0, stream="serve",
-            records=len(records), fence=fence,
-        )
         return len(records)
+
+    # --------------------------------- elastic membership / replication
+    def replication_floor(self) -> int:
+        """Highest journal seq at or below which every record is resolved
+        — applied to the stacked state, or durably dropped. A ``DROP``
+        frame can only target a still-queued request, so nothing at or
+        below the floor can be cancelled later: this is the prefix a
+        standby may apply eagerly, and the exact seq the stacked state
+        reflects. Takes the flush lock so no request is invisibly
+        mid-flush (popped from the queue but not yet launched)."""
+        if self._wal is None:
+            return 0
+        with self._flush_lock:
+            with self._queue_cond:
+                pending = [r.seq for r in self._queue if r.seq is not None]
+                last = self._wal.last_seq
+        return (min(pending) - 1) if pending else last
+
+    def advance_epoch(self, epoch: int) -> int:
+        """Re-claim this service's journal at a higher ownership epoch —
+        the planned-hand-off fence: a membership change bumps the epoch
+        while the SAME process keeps serving, so any write still in
+        flight from a partitioned or superseded twin of this shard is
+        stale from here on. No-op at or below the current epoch."""
+        epoch = int(epoch)
+        if epoch <= self.epoch:
+            return self.epoch
+        self.epoch = epoch
+        if self._wal is not None:
+            wal.fence_epoch(self._wal.directory, epoch)
+            self._wal.epoch = epoch
+        return epoch
+
+    def attach_durability(
+        self,
+        journal_dir: Optional[str],
+        checkpoint_dir: Optional[str],
+        epoch: int,
+    ) -> None:
+        """Attach a shard's durable directories to a warm (journal-less)
+        standby at promotion time. The journal opens at ``epoch`` — the
+        peer fenced the directory first, so a zombie writer is already
+        locked out; the caller then replays only the unshipped tail
+        (``read_tail(applied_seq)``) instead of the whole journal."""
+        if self._wal is not None:
+            self._wal.close()
+        self._wal = None
+        self.journal_dir = journal_dir
+        self.checkpoint_dir = checkpoint_dir
+        self.epoch = int(epoch)
+        if journal_dir is not None and wal.wal_enabled():
+            self._wal = wal.WriteAheadLog(
+                journal_dir, owner=self.label, epoch=self.epoch
+            )
+
+    def rebase_rids(self, offset: int, stride: int) -> None:
+        """Move this service's request-id lattice (membership changes:
+        the fabric re-bases every live shard onto a fresh
+        ``fleet_max_rid + position, stride = live_shards`` lattice so
+        rids stay globally unique after shards join or leave)."""
+        with self._queue_cond:
+            self._rid = int(offset)
+            self._rid_stride = max(1, int(stride))
+
+    def _portable_template_attrs(self) -> Dict[str, Any]:
+        # scalar template attrs (some metrics determine config lazily from
+        # their first inputs) — same filter the checkpoint meta persists
+        return {
+            k: v
+            for k, v in vars(self.template).items()
+            if not k.startswith("_")
+            and k not in self._names
+            and isinstance(v, (bool, int, float, str, type(None)))
+        }
+
+    def _install_template_attrs(self, attrs: Dict[str, Any]) -> None:
+        for k, v in attrs.items():
+            try:
+                setattr(self.template, k, v)
+            except Exception:  # noqa: BLE001 - read-only/derived attrs
+                pass
+
+    def export_sessions(self, names: List[str]) -> Dict[str, Any]:
+        """Portable state rows for a planned hand-off: host-side copies of
+        the named sessions' stacked rows plus the template's scalar
+        attrs. The caller must have fenced admission and drained first —
+        exported rows must reflect every admitted update."""
+        rows: Dict[str, Dict[str, np.ndarray]] = {}
+        for name in names:
+            row = self._rows.get(name)
+            if row is None:
+                raise KeyError(f"unknown session {name!r}; nothing to export")
+            rows[name] = {
+                k: np.asarray(self._stacked[k][row]) for k in self._names
+            }
+        return {"rows": rows, "template_attrs": self._portable_template_attrs()}
+
+    def import_sessions(self, payload: Dict[str, Any]) -> int:
+        """Install exported session rows (the receiving side of a planned
+        hand-off). Idempotent per session — re-importing overwrites the
+        row with the same bits. Returns how many sessions landed. Takes
+        the flush lock: a concurrent background flush writing ``_stacked``
+        back after a launch must not clobber the imported rows."""
+        with self._flush_lock:
+            self._install_template_attrs(payload.get("template_attrs", {}))
+            for name, leaves in payload["rows"].items():
+                row = self.open_session(name)
+                for k in self._names:
+                    self._stacked[k] = (
+                        self._stacked[k].at[row].set(jnp.asarray(leaves[k]))
+                    )
+            return len(payload["rows"])
+
+    def mirror_state(self, src: "MetricsService") -> None:
+        """Install a bit-identical copy of another service's stacked state
+        (standby seeding and the anti-entropy re-ship). jax arrays are
+        immutable, so the leaves are shared, not copied — O(sessions)
+        bookkeeping, O(1) state bytes. Takes this service's flush lock
+        (the caller pins the SOURCE's floor under the source's lock)."""
+        with self._flush_lock:
+            self._capacity = src._capacity
+            self._stacked = dict(src._stacked)
+            self._rows = dict(src._rows)
+            used = set(self._rows.values())
+            self._free = [
+                r for r in range(self._capacity - 1, -1, -1) if r not in used
+            ]
+            self._closed = set(src._closed)
+            with self._queue_cond:
+                self._rid = src._rid
+                self._rid_stride = src._rid_stride
+            self._install_template_attrs(src._portable_template_attrs())
+            self._exec_cache.clear()
+            self._compute_stack = None
+            self._compute_one = None
+
+    def state_digest(self, names: Optional[List[str]] = None) -> str:
+        """sha1 over the stacked rows of the named (default: every open)
+        sessions, in name order — the anti-entropy comparand. Pure host
+        readback of applied state; does NOT flush (the caller pins a
+        common replication floor first)."""
+        h = hashlib.sha1()
+        for name in sorted(self._rows if names is None else names):
+            row = self._rows.get(name)
+            if row is None:
+                continue
+            h.update(name.encode())
+            for k in self._names:
+                h.update(np.asarray(self._stacked[k][row]).tobytes())
+        return h.hexdigest()
 
     # ---------------------------------------------------------------- stats
     @property

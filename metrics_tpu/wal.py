@@ -95,6 +95,7 @@ from metrics_tpu import faults, telemetry
 __all__ = [
     "WriteAheadLog",
     "WalRecord",
+    "StandbyReplica",
     "StaleEpochError",
     "wal_enabled",
     "read_epoch",
@@ -287,6 +288,7 @@ class WriteAheadLog:
             "bytes": 0,
             "fsyncs": 0,
             "replayed": 0,
+            "shipped": 0,
             "truncated_segments": 0,
             "discarded_frames": 0,
             "drops": 0,
@@ -551,6 +553,50 @@ class WriteAheadLog:
             self._stats["replayed"] += len(records)
         return records
 
+    # ---------------------------------------------------------- replication
+    def stream_since(self, after_seq: int = 0) -> List[WalRecord]:
+        """Replication stream: every record with ``seq > after_seq``, in
+        order, INCLUDING unresolved ``DROP`` frames — a ``DROP`` record
+        carries the cancelled seq as ``args[0]`` and its cause under
+        ``kwargs["cause"]``. Unlike :meth:`read_tail`, drops are NOT
+        resolved here: a drop may ship in a *later* batch than the update
+        it cancels, so resolution belongs to the receiver
+        (:class:`StandbyReplica` holds unresolved updates back until the
+        primary's replication floor passes them). Reads the sealed
+        segments plus the active tail; an incomplete in-flight frame at
+        the very end is skipped (it ships with the next batch)."""
+        out: List[WalRecord] = []
+        with self._lock:
+            segments = list(self._segments)
+        for seg in segments:
+            if seg.last_seq <= after_seq:
+                continue
+            with open(seg.path, "rb") as f:
+                data = f.read()
+            offset = 0
+            while offset < len(data):
+                frame = self._parse_frame(data, offset, seg.path)
+                if frame is None:
+                    break  # live-writer tail; ships next batch
+                seq, kind, header, payload, frame_len = frame
+                offset += frame_len
+                if seq <= after_seq:
+                    continue
+                if kind == UPDATE:
+                    args, kwargs = pickle.loads(payload)
+                elif kind == DROP:
+                    args = (int(header.get("drop", 0)),)
+                    kwargs = {"cause": header.get("cause", "")}
+                else:
+                    args, kwargs = (), {}
+                out.append(WalRecord(
+                    seq, kind, str(header.get("session", "")), args, kwargs,
+                    rid=int(header.get("rid", 0)),
+                ))
+        with self._lock:
+            self._stats["shipped"] += len(out)
+        return out
+
     # ------------------------------------------------------------- truncate
     def truncate(self, upto_seq: int) -> int:
         """Delete segments wholly retired by a checkpoint fence at
@@ -620,3 +666,105 @@ class WriteAheadLog:
         out["fsync_us_p50"] = pct(50)
         out["fsync_us_p95"] = pct(95)
         return out
+
+
+class StandbyReplica:
+    """Hot-standby applier: a warm, bit-identical copy of one shard's
+    stacked state, maintained by log shipping instead of full replay.
+
+    The primary periodically ships ``stream_since(cursor)`` batches plus
+    its **replication floor**
+    (:meth:`metrics_tpu.serve.MetricsService.replication_floor` — the seq
+    below which every record is resolved: applied to the primary's state
+    or durably dropped). Records at or below the floor apply immediately
+    through the replica service's replay path; records *above* it are
+    held back, because a later ``DROP`` frame (admission shed, deadline
+    expiry) may still cancel them — applying eagerly would diverge from
+    the primary. Held records apply once a later ship moves the floor
+    past them, so ``service`` state always equals
+    ``apply(records <= applied_seq)`` — exactly what a fresh
+    ``recover()`` would reconstruct at that seq.
+
+    On promotion (the fabric's replicated failover) the peer fences the
+    journal epoch, attaches the dead shard's durable directories to the
+    warm service, and replays only ``read_tail(applied_seq)`` — the
+    unshipped tail — turning failover cost from O(journal) into
+    O(replication lag). The anti-entropy pass compares
+    :meth:`digest` against the primary's at a common floor and re-seeds
+    (:meth:`seed_from`) on divergence.
+
+    ``service`` is a journal-less :class:`~metrics_tpu.serve.MetricsService`
+    twin (same template, same shard/rid lattice) built by the fabric; the
+    replica never writes the primary's journal or checkpoints.
+    """
+
+    def __init__(self, service: Any, *, source_shard: Optional[int] = None) -> None:
+        self.service = service
+        self.source_shard = source_shard
+        # highest seq ever shipped to this replica (the ship cursor)
+        self.cursor = 0
+        # highest resolved seq applied to the warm state
+        self.applied_seq = 0
+        self._pending: Dict[int, WalRecord] = {}
+        self._dropped: set = set()
+        self.stats: Dict[str, int] = {
+            "ships": 0, "shipped_records": 0, "applied_records": 0,
+            "held_records": 0, "reseeds": 0,
+        }
+
+    def apply(self, records: List[WalRecord], floor: int) -> int:
+        """Ingest one shipped batch and advance the warm state to
+        ``floor``. Returns how many records were applied (the rest are
+        held back or cancelled by DROP frames)."""
+        for rec in records:
+            if rec.seq > self.cursor:
+                self.cursor = rec.seq
+            if rec.kind == DROP:
+                target = int(rec.args[0]) if rec.args else 0
+                self._dropped.add(target)
+                self._pending.pop(target, None)
+            elif rec.seq > self.applied_seq and rec.seq not in self._dropped:
+                self._pending[rec.seq] = rec
+        ready = [
+            self._pending.pop(s)
+            for s in sorted(self._pending)
+            if s <= floor and s not in self._dropped
+        ]
+        if ready:
+            self.service.apply_records(ready)
+        # resolved drop targets never resurface below the floor
+        self._dropped = {s for s in self._dropped if s > floor}
+        if floor > self.applied_seq:
+            self.applied_seq = floor
+        self.stats["ships"] += 1
+        self.stats["shipped_records"] += len(records)
+        self.stats["applied_records"] += len(ready)
+        self.stats["held_records"] = len(self._pending)
+        return len(ready)
+
+    def seed_from(self, primary: Any, floor: int) -> None:
+        """Bulk state transfer: install a bit-identical copy of the
+        primary's stacked state at its replication floor (standby
+        creation, and the anti-entropy re-ship after divergence). The
+        ship cursor rewinds to the floor so the next batch re-reads the
+        unresolved tail."""
+        self.service.mirror_state(primary)
+        self.applied_seq = int(floor)
+        self.cursor = int(floor)
+        self._pending.clear()
+        self._dropped.clear()
+        self.stats["reseeds"] += 1
+
+    def digest(self) -> str:
+        """State digest of the warm copy (anti-entropy comparand)."""
+        return self.service.state_digest()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Replication gauges for fleet telemetry."""
+        return {
+            "source_shard": self.source_shard,
+            "cursor": self.cursor,
+            "applied_seq": self.applied_seq,
+            "held": len(self._pending),
+            **dict(self.stats),
+        }

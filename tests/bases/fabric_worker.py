@@ -25,6 +25,15 @@ Both phases print a bit-exact ``compute_all()`` digest of the shard's
 partition as the last stdout line; the parent unions partitions and
 compares against the uncrashed twin fleet.
 
+With ``METRICS_TPU_REPLICATE=1`` the run phase also maintains an
+in-process hot standby (:class:`metrics_tpu.wal.StandbyReplica`),
+log-shipping ``stream_since`` after every local op — interleaved with
+submits, flushes, auto-checkpoints, and journal truncations, and armed
+at every crash point. Shipping is a pure journal read, so the crash
+matrix must stay digest-bit-identical with replication on; an uncrashed
+run additionally asserts the standby's state digest matches the
+primary's at the end of the stream.
+
 Usage: ``python fabric_worker.py {run|recover} WORKDIR SHARD NSHARDS``
 """
 import json
@@ -102,6 +111,24 @@ def main():
         svc.recover()
         start_seq = svc.journal.last_seq
 
+    standby = None
+    if phase == "run" and os.environ.get("METRICS_TPU_REPLICATE") == "1":
+        replica = MetricsService(
+            Accuracy(task="multiclass", num_classes=8),
+            shard_id=shard,
+            rid_offset=shard,
+            rid_stride=nshards,
+        )
+        standby = wal.StandbyReplica(replica, source_shard=shard)
+
+    def ship():
+        # ship the tail eagerly (every op): the cursor stays ahead of the
+        # auto-checkpoint's journal truncation, exactly like a live
+        # replication loop outpacing the primary's compaction
+        if standby is not None:
+            floor = svc.replication_floor()
+            standby.apply(svc.journal.stream_since(standby.cursor), floor)
+
     closed = set()
     local_idx = 0  # local ops journal as seq local_idx; the resume cursor
     for op in ops_list():
@@ -128,9 +155,16 @@ def main():
             closed.add(name)
         elif op[0] == "reset":
             svc.reset_session(name)
+        ship()
         if local_idx % 4 == 0:
             svc.flush()
+            ship()
     svc.drain()
+    ship()
+    if standby is not None:
+        assert standby.digest() == svc.state_digest(), (
+            f"standby diverged from primary on shard {shard}"
+        )
     print(
         json.dumps(
             {

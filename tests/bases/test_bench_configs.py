@@ -249,8 +249,10 @@ def test_request_tracing_config_counts_and_keys(monkeypatch):
 def test_fabric_config_counts_and_keys():
     """Pin the fabric bench config at test-budget scale: the capacity and
     overload keys must exist and be positive, every stacked launch must
-    carry a shard tag, the submit path must be collective-free, and the
-    failover drill must produce a kill-to-first-result time."""
+    carry a shard tag, the submit path must be collective-free, the
+    failover and planned-hand-off drills must produce kill/hand-off
+    to-first-result times, and the replicated failover must beat the
+    full-replay twin at the same journal length."""
     detail = {}
     bench._cfg_fabric(detail, sessions=16, events=120, shards=2)
     assert detail["fabric_updates_per_sec"] > 0
@@ -260,6 +262,16 @@ def test_fabric_config_counts_and_keys():
     assert detail["fabric_launches_shard_tagged"] == detail["fabric_launches_total"]
     assert detail["fabric_submit_collectives"] == 0
     assert detail["fabric_failover_first_result_ms"] > 0
+    assert detail["fabric_fleet_read_ms"] > 0
+    assert detail["fabric_handoff_first_result_ms"] > 0
+    assert detail["fabric_handoff_moved_sessions"] > 0
+    # the warm standby replays only the unshipped tail; the full-replay
+    # twin re-applies the whole journal — strictly slower
+    assert (
+        detail["fabric_replicated_failover_ms"]
+        < detail["fabric_full_replay_failover_ms"]
+    )
+    assert detail["fabric_replication_failover_speedup"] > 1.0
 
 
 def test_resilience_overhead_config_counts_and_keys(monkeypatch):

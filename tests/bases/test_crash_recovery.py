@@ -148,8 +148,13 @@ _FABRIC_CRASH_NTH = {
 
 
 def _run_fabric_worker(phase, workdir, shard, env, crash=None, timeout=240):
+    env = dict(env)
+    # hot-standby replication on: the run phase log-ships after every op,
+    # interleaved with every armed crash point — the matrix must stay
+    # digest-bit-identical with shipping active (stream_since is a pure
+    # journal read)
+    env["METRICS_TPU_REPLICATE"] = "1"
     if crash is not None:
-        env = dict(env)
         env["METRICS_TPU_CRASH"] = crash
     return subprocess.run(
         [sys.executable, _FABRIC_WORKER, phase, str(workdir), str(shard),

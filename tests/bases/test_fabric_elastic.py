@@ -1,0 +1,392 @@
+"""Elastic fabric: planned hand-off, hot-standby replication, gray failure.
+
+Membership changes are an optimization + availability layer, never a
+semantics change: after any sequence of add_shard / remove_shard /
+rebalance / standby promotion, every session's value must stay
+bit-identical to one unsharded ``MetricsService`` fed the same stream.
+The drills pinned here: ring minimality (a hand-off moves ~1/N sessions,
+never a reshuffle), replicated failover replays only the unshipped tail,
+anti-entropy detects and repairs a divergent standby, the suspicion
+monitor quarantines a slow-but-alive shard, and exactly one side of a
+network partition wins (the loser's writes raise ``StaleEpochError``).
+"""
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, faults, telemetry, wal
+from metrics_tpu.fabric import (
+    FleetDeadError,
+    HashRing,
+    ShardDeadError,
+    ShardedMetricsService,
+    StaleEpochError,
+)
+from metrics_tpu.serve import MetricsService
+
+
+def _tmpl():
+    return Accuracy(task="multiclass", num_classes=8)
+
+
+def _fabric(num_shards=3, **kwargs):
+    return ShardedMetricsService(_tmpl(), num_shards=num_shards, **kwargs)
+
+
+def _stream(n_sessions=18, ops=3, batch=16, C=8, seed=0):
+    """Deterministic (name, preds, target) op stream, round-robin over
+    sessions — the same stream feeds the fabric and the control twin."""
+    rng = np.random.RandomState(seed)
+    names = [f"t{i}" for i in range(n_sessions)]
+    out = []
+    for _ in range(ops):
+        for name in names:
+            out.append((
+                name,
+                jnp.asarray(rng.randint(0, C, batch)),
+                jnp.asarray(rng.randint(0, C, batch)),
+            ))
+    return names, out
+
+
+def _feed(svc, ops):
+    for name, p, t in ops:
+        svc.submit(name, p, t)
+    svc.drain()
+
+
+def _digests(values):
+    return {k: np.asarray(v).tobytes() for k, v in values.items()}
+
+
+def _control(ops):
+    ref = MetricsService(_tmpl())
+    _feed(ref, ops)
+    out = _digests(ref.compute_all())
+    ref.shutdown()
+    return out
+
+
+# --------------------------------------------------------------- fleet death
+def test_fleet_dead_error_names_dead_shards():
+    """Regression: zero live candidates is a clean, typed terminal state
+    — not a loop or a KeyError — and the error names the dead shards."""
+    ring = HashRing([0, 1, 2])
+    with pytest.raises(FleetDeadError) as exc:
+        ring.successor(1, alive=[])
+    assert "0" in str(exc.value) and "2" in str(exc.value)
+    with pytest.raises(FleetDeadError):
+        ring.successor(1, alive=[1])  # only itself alive: no peer
+    # subclasses ShardDeadError so existing handlers still catch it
+    assert issubclass(FleetDeadError, ShardDeadError)
+
+
+def test_remove_last_shard_raises_fleet_dead(tmp_path):
+    fab = _fabric(1, data_dir=str(tmp_path))
+    with pytest.raises(FleetDeadError):
+        fab.remove_shard(0)
+    fab.shutdown()
+
+
+# ------------------------------------------------------------ planned hand-off
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_rebalance_minimality_and_digest_parity(tmp_path, seed):
+    """Property: scale-out moves at most ceil(sessions/N_new) + slack
+    sessions (ring minimality — only the new shard's arc remaps), and
+    every moved session's digest stays bit-identical to an unmoved
+    control twin."""
+    names, ops = _stream(n_sessions=24, seed=seed)
+    fab = _fabric(3, data_dir=str(tmp_path))
+    _feed(fab, ops)
+    want = _control(ops)
+
+    sid = fab.add_shard()
+    report = fab.rebalance()
+    moved = report["moved"]
+    n_new = 4
+    slack = 2  # vnode granularity: the arc is minimal in expectation
+    assert len(moved) <= math.ceil(len(names) / n_new) + slack, (
+        f"rebalance moved {len(moved)}/{len(names)} sessions — not minimal"
+    )
+    assert moved, "adding a shard should claim a non-empty arc"
+    # every moved session now routes to the new shard, and no digest moved
+    for name in moved:
+        assert fab.shard_for(name) == sid
+    got = _digests(fab.compute_all())
+    assert got == want
+    # hand-off events carry cause="planned"
+    planned = [e for e in fab.failover_events if e["cause"] == "planned"]
+    assert planned and all(e["peer"] == sid for e in planned)
+    fab.shutdown()
+
+
+def test_handoff_under_live_traffic_exactly_once(tmp_path):
+    """Membership changes mid-stream: ops land before, between, and after
+    add_shard/rebalance/remove_shard, and the final values are still
+    bit-identical to one unsharded service fed the whole stream —
+    nothing lost, nothing double-applied."""
+    names, ops = _stream(n_sessions=18, ops=4)
+    third = len(ops) // 3
+    fab = _fabric(2, data_dir=str(tmp_path))
+
+    for name, p, t in ops[:third]:
+        fab.submit(name, p, t)
+    fab.add_shard()
+    fab.rebalance()
+    for name, p, t in ops[third:2 * third]:
+        fab.submit(name, p, t)
+    fab.remove_shard(0)
+    for name, p, t in ops[2 * third:]:
+        fab.submit(name, p, t)
+    fab.drain()
+
+    assert _digests(fab.compute_all()) == _control(ops)
+    health = fab.health()
+    assert health["shards"][0]["retired"] is True
+    assert health["handoffs"] >= 2
+    fab.shutdown()
+
+
+def test_remove_shard_archives_slo_counts(tmp_path):
+    """Scale-in keeps the books: the retired shard's served counts stay
+    visible through the archived SLO snapshot (the exactly-once ledger in
+    loadgen sums over them)."""
+    names, ops = _stream(n_sessions=12)
+    fab = _fabric(3, data_dir=str(tmp_path))
+    _feed(fab, ops)
+    served_before = sum(
+        int(s["totals"].get("served", 0)) for s in fab.slo_snapshot().values()
+    )
+    fab.remove_shard(1)
+    snap = fab.slo_snapshot()
+    assert 1 in snap  # archived entry for the retired shard
+    served_after = sum(
+        int(s["totals"].get("served", 0)) for s in snap.values()
+    )
+    assert served_after == served_before
+    fab.shutdown()
+
+
+def test_rid_lattice_stays_disjoint_after_membership_changes(tmp_path):
+    """Joins and leaves re-base the request-id lattice: offsets are
+    distinct residues modulo a shared stride, so rids minted by any two
+    live shards can never collide."""
+    fab = _fabric(3, data_dir=str(tmp_path))
+    names, ops = _stream(n_sessions=12)
+    _feed(fab, ops)
+    fab.add_shard()
+    fab.rebalance()
+    fab.remove_shard(0)
+    live = [s for s in fab._shards if not s.retired]
+    strides = {s.rid_stride for s in live}
+    assert strides == {len(live)}
+    residues = [s.rid_offset % s.rid_stride for s in live]
+    assert len(set(residues)) == len(live), residues
+    fab.shutdown()
+
+
+# ------------------------------------------------------- standby replication
+def test_standby_failover_replays_only_unshipped_tail(tmp_path):
+    """Replicated failover is O(replication lag): the promoted standby
+    replays exactly the records appended after the last ship, not the
+    whole journal — and the recovered values are bit-identical to the
+    control twin."""
+    names, ops = _stream(n_sessions=18, ops=4)
+    half = len(ops) // 2
+    fab = _fabric(3, data_dir=str(tmp_path), standby=True)
+
+    for name, p, t in ops[:half]:
+        fab.submit(name, p, t)
+    fab.drain()
+    fab.replicate()  # seed
+    fab.replicate()  # ship everything so far
+    for name, p, t in ops[half:]:
+        fab.submit(name, p, t)
+    fab.drain()  # appended but NOT shipped: this is the failover tail
+
+    victim = 0
+    total = fab._shards[victim].service.journal.last_seq
+    shipped = fab._standbys[victim].applied_seq
+    assert 0 < shipped < total
+
+    fab.kill_shard(victim)
+    fab.fail_over(victim)
+    event = fab.failover_events[-1]
+    assert event["standby"] is True and event["cause"] == "killed"
+    assert 0 < event["replayed"] <= total - shipped
+
+    assert _digests(fab.compute_all()) == _control(ops)
+    fab.shutdown()
+
+
+def test_anti_entropy_detects_and_repairs_divergence(tmp_path):
+    """A corrupted standby is a bounded repair, not a silent wrong
+    answer: anti_entropy flags the digest mismatch, re-seeds from the
+    primary, and the next scrub is clean."""
+    names, ops = _stream(n_sessions=12)
+    fab = _fabric(3, data_dir=str(tmp_path), standby=True)
+    _feed(fab, ops)
+    fab.replicate()
+    fab.replicate()
+    assert fab.anti_entropy() == []
+
+    victim = next(iter(fab._standbys))
+    replica = fab._standbys[victim].service
+    # corrupt one replicated row out-of-band
+    name = sorted(replica._rows)[0]
+    replica.import_sessions({
+        "rows": {name: {
+            leaf: np.zeros_like(arr)
+            for leaf, arr in replica.export_sessions([name])["rows"][name].items()
+        }},
+    })
+    assert fab.anti_entropy() == [victim]
+    assert fab.anti_entropy() == []
+    assert fab._standbys[victim].stats["reseeds"] >= 2  # seed + repair
+    fab.shutdown()
+
+
+@pytest.mark.slow
+def test_replicated_failover_beats_full_replay(tmp_path):
+    """The point of shipping the log: at a long journal, promoting a warm
+    standby (tail-only replay) is strictly faster than the full-replay
+    failover of an identical un-replicated fleet."""
+    names, ops = _stream(n_sessions=8, ops=60, batch=8)  # long journal
+    times = {}
+    for mode in ("standby", "full"):
+        root = tmp_path / mode
+        fab = _fabric(2, data_dir=str(root), standby=(mode == "standby"))
+        for i, (name, p, t) in enumerate(ops):
+            fab.submit(name, p, t)
+            if i % 64 == 0:
+                fab.flush()
+        fab.drain()
+        if mode == "standby":
+            fab.replicate()
+            fab.replicate()
+        fab.kill_shard(0)
+        times[mode] = fab.fail_over(0)
+        event = fab.failover_events[-1]
+        assert event["standby"] is (mode == "standby")
+        fab.shutdown()
+    assert times["standby"] < times["full"], times
+
+
+# ----------------------------------------------------------- gray failures
+def test_split_brain_exactly_one_side_wins(tmp_path):
+    """Network partition: both sides think they own the range, but the
+    epoch fence decides — every append and truncate from the old owner
+    raises StaleEpochError, and the surviving side's values match the
+    uncrashed control twin bit-for-bit."""
+    names, ops = _stream(n_sessions=18)
+    half = len(ops) // 2
+    fab = _fabric(3, data_dir=str(tmp_path), standby=True)
+    for name, p, t in ops[:half]:
+        fab.submit(name, p, t)
+    fab.drain()
+    fab.replicate()
+    fab.replicate()
+
+    victim = 2
+    zombie = fab._shards[victim].service
+    with faults.inject("network-partition", prob=1.0, count=1, shard=victim):
+        # next route to the victim detects the partition and fails over
+        for name, p, t in ops[half:]:
+            fab.submit(name, p, t)
+    fab.drain()
+    event = next(e for e in fab.failover_events if e["cause"] == "partition")
+    assert event["shard"] == victim
+
+    # the old owner keeps running but every durable write bounces
+    zname = next(n for n in names if fab.shard_for(n) == victim)
+    with pytest.raises(StaleEpochError):
+        zombie.submit(zname, *ops[0][1:])
+        zombie.flush()
+    with pytest.raises(StaleEpochError):
+        zombie.journal.truncate(0)
+    with pytest.raises(StaleEpochError):
+        zombie.checkpoint()
+
+    # exactly one side's writes survived — and they are the right ones
+    assert _digests(fab.compute_all()) == _control(ops)
+    fab.shutdown()
+
+
+def test_suspicion_sweep_quarantines_slow_shard(tmp_path):
+    """Gray failure: a shard that is alive and correct but slow gets
+    routed around — the sweep compares per-shard served p99 against the
+    fleet median and fails the outlier over with cause suspect-slow.
+    Values survive the quarantine bit-for-bit."""
+    fab = _fabric(3, data_dir=str(tmp_path), standby=True)
+    rng = np.random.RandomState(0)
+    names = [f"t{i}" for i in range(24)]
+    for n in names:
+        fab.open_session(n)
+    x = jnp.asarray(rng.randint(0, 8, 16))
+    y = jnp.asarray(rng.randint(0, 8, 16))
+
+    def closed_loop(n_ops):
+        # per-shard closed loop: latency attribution stays shard-local
+        for i in range(n_ops):
+            name = names[i % len(names)]
+            svc = fab._route(name).service
+            svc.submit(name, x, y)
+            svc.flush()
+            svc.drain()
+
+    closed_loop(300)  # warm: compile tail falls out of p99
+    fab.replicate()
+    slow = 0
+    with faults.inject("shard-slow", prob=1.0, count=500, shard=slow, ms=40):
+        closed_loop(150)
+        suspects = fab.suspicion_sweep(min_requests=32)
+    assert suspects == [slow]
+    event = fab.failover_events[-1]
+    assert event["cause"] == "suspect-slow" and event["shard"] == slow
+    assert fab.health()["failover_causes"]["suspect-slow"] == 1
+    # quarantine is a recovery, not an outage: the partition serves again
+    assert fab._shards[slow].alive and not fab._shards[slow].suspect
+    fab.update(next(n for n in names if fab.shard_for(n) == slow), x, y)
+    fab.shutdown()
+
+
+def test_failover_cause_field(tmp_path):
+    """Every way a shard goes down lands a distinct cause on the event
+    and in health(): killed (SIGKILL twin) vs planned (hand-off)."""
+    names, ops = _stream(n_sessions=12)
+    fab = _fabric(3, data_dir=str(tmp_path))
+    _feed(fab, ops)
+    fab.kill_shard(1)
+    fab.fail_over(1)
+    assert fab.failover_events[-1]["cause"] == "killed"
+    fab.add_shard()
+    fab.rebalance()
+    causes = fab.health()["failover_causes"]
+    assert causes.get("killed") == 1 and causes.get("planned", 0) >= 1
+    fab.shutdown()
+
+
+# ------------------------------------------------------------- pooled reads
+def test_pooled_fleet_reads_match_sequential(tmp_path):
+    """compute_all / slo_snapshot / fleet_snapshot fan out on the read
+    pool; pooling is a latency optimization, never a result change."""
+    names, ops = _stream(n_sessions=16)
+    fab = _fabric(4, data_dir=str(tmp_path))
+    _feed(fab, ops)
+
+    pooled = fab.compute_all()
+    sequential = {}
+    for s in fab._serving_shards():
+        sequential.update(s.service.compute_all())
+    assert _digests(pooled) == _digests(sequential)
+    assert fab._pool is not None  # >1 shard: the pool actually ran
+
+    slo = fab.slo_snapshot()
+    assert set(slo) == {0, 1, 2, 3}
+    snap = fab.fleet_snapshot()
+    assert set(snap["shards"]) == {0, 1, 2, 3}
+    assert "failover_causes" in snap and "replication" in snap
+    fab.shutdown()

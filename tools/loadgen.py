@@ -14,6 +14,13 @@ Usage::
         # journal on a peer and report failover-to-first-result ms
     python tools/loadgen.py --worker K ...        # internal: subprocess
         # shard entry point (spawned by --subprocess, not by hand)
+    python tools/loadgen.py --add-shard-at 400 --remove-shard-at 800 \
+        --partition 1                             # elastic drill: scale
+        # out, scale in, and partition one shard mid-stream (what
+        # `make chaos-elastic` runs); every admitted request must land
+        # exactly once — the run replays the admitted stream into an
+        # unsharded control twin and exits non-zero on any digest
+        # mismatch (lost or double-applied updates)
 
 The traffic model is **open-loop**: arrival times are drawn up front
 from the seeded trace (Pareto inter-arrivals — heavy-tailed bursts —
@@ -96,6 +103,8 @@ def _percentile_ms(slo_totals: Dict[str, Any], q: str) -> float:
 
 # ----------------------------------------------------------- in-process mode
 def run_inproc(args: argparse.Namespace) -> Dict[str, Any]:
+    from contextlib import ExitStack
+
     from metrics_tpu import faults, telemetry
     from metrics_tpu.classification import Accuracy
     from metrics_tpu.fabric import ShardedMetricsService
@@ -105,21 +114,32 @@ def run_inproc(args: argparse.Namespace) -> Dict[str, Any]:
     batches = make_batches(args.seed, args.batch_pool, args.batch, args.num_classes)
     names = [f"s{i:06d}" for i in range(args.sessions)]
 
+    elastic = (
+        args.add_shard_at is not None
+        or args.remove_shard_at is not None
+        or args.partition is not None
+    )
     tmp_fleet = None
-    if args.kill_shard is not None and not args.data_dir:
-        # failover replays the victim's journal on a peer, so a kill drill
-        # needs durable per-shard state even in-process
+    if (args.kill_shard is not None or elastic) and not args.data_dir:
+        # failover / hand-off replays and fences per-shard journals, so
+        # the drills need durable per-shard state even in-process
         tmp_fleet = tempfile.TemporaryDirectory(prefix="loadgen-fleet-")
         args.data_dir = tmp_fleet.name
 
+    # the elastic drill's contract is exactly-once over ADMITTED requests,
+    # so it admits everything (blocking admission, no queue bound) and the
+    # ledger replays the full submitted stream into a control twin; the
+    # plain overload lane keeps shed-oldest + the bounded-queue pin
     fab = ShardedMetricsService(
         Accuracy(task="multiclass", num_classes=args.num_classes),
         num_shards=args.shards,
         data_dir=args.data_dir,
-        max_queue=args.max_queue,
-        admission="shed-oldest",
+        standby=elastic,
+        max_queue=None if elastic else args.max_queue,
+        admission="block" if elastic else "shed-oldest",
         flush_interval_s=args.flush_interval_s,
     )
+    ledger: List[Tuple[str, int]] = []  # (session, batch idx) per admitted submit
 
     report: Dict[str, Any] = {
         "mode": "inproc",
@@ -138,6 +158,8 @@ def run_inproc(args: argparse.Namespace) -> Dict[str, Any]:
         for k in range(args.shards):
             probe = next(n for n in names if fab.shard_for(n) == k)
             fab.submit(probe, *batches[0])
+            if elastic:
+                ledger.append((probe, 0))
         fab.drain()
 
         # -- calibrate: repeated max-rate bursts; the last one runs with
@@ -153,6 +175,8 @@ def run_inproc(args: argparse.Namespace) -> Dict[str, Any]:
                 p, t = batches[i % len(batches)]
                 try:
                     fab.submit(names[sid], p, t)
+                    if elastic:
+                        ledger.append((names[sid], i % len(batches)))
                 except QueueFullError:
                     pass
             fab.drain()
@@ -165,8 +189,9 @@ def run_inproc(args: argparse.Namespace) -> Dict[str, Any]:
         max_depth = 0
         rejected = 0
         kill_at = args.events // 2 if args.kill_shard is not None else None
+        partition_at = args.events // 2 if args.partition is not None else None
         pre_totals = dict(fab.fleet_snapshot()["serve_totals"])
-        with telemetry.instrument() as otel:  # overload-phase spans only
+        with telemetry.instrument() as otel, ExitStack() as drills:
             t_start = time.perf_counter()
             for i in range(args.events):
                 target = t_start + float(arrivals[i])
@@ -177,10 +202,40 @@ def run_inproc(args: argparse.Namespace) -> Dict[str, Any]:
                     time.sleep(min(1e-3, target - now))
                 if kill_at is not None and i == kill_at:
                     fab.kill_shard(args.kill_shard)
+                if args.add_shard_at is not None and i == args.add_shard_at:
+                    # scale-out mid-stream: drain -> fence -> transfer ->
+                    # swap; time to the first result off a moved session
+                    t_h = time.perf_counter()
+                    new_sid = fab.add_shard()
+                    moved = fab.rebalance()["moved"]
+                    if moved:
+                        fab.compute(moved[0])
+                    report["handoff_first_result_ms"] = round(
+                        (time.perf_counter() - t_h) * 1e3, 3
+                    )
+                    report["added_shard"] = new_sid
+                    report["handoff_moved_sessions"] = len(moved)
+                if args.remove_shard_at is not None and i == args.remove_shard_at:
+                    victim = args.shards - 1  # retire the last seed shard
+                    moved = fab.remove_shard(victim)
+                    report["removed_shard"] = victim
+                    report["remove_moved_sessions"] = len(moved)
+                if partition_at is not None and i == partition_at:
+                    # both sides think they own the range from here: the
+                    # next route to the victim fences + fails over, and
+                    # the old owner's writes raise StaleEpochError
+                    drills.enter_context(faults.inject(
+                        "network-partition", prob=1.0, count=1,
+                        shard=args.partition,
+                    ))
+                if elastic and i % 251 == 0:
+                    fab.replicate()  # keep the standbys warm mid-stream
                 sid = int(trace["session"][i])
                 p, t = batches[i % len(batches)]
                 try:
                     fab.submit(names[sid], p, t)
+                    if elastic:
+                        ledger.append((names[sid], i % len(batches)))
                 except QueueFullError:
                     rejected += 1
                 if i % 97 == 0:  # bounded-queue pin: sample depths under load
@@ -209,10 +264,14 @@ def run_inproc(args: argparse.Namespace) -> Dict[str, Any]:
     p99 = durs[min(len(durs) - 1, int(round(0.99 * (len(durs) - 1))))] if durs else 0.0
     report["p99_ms_2x_overload"] = round(p99 / 1e3, 3)
     report["max_queue_depth_sampled"] = max_depth
-    report["queue_bound"] = args.max_queue
+    report["queue_bound"] = None if elastic else args.max_queue
     report["failover_events"] = snap["failover_events"]
-    if snap["failover_events"]:
-        report["failover_to_first_result_ms"] = snap["failover_events"][0]["ms"]
+    report["failover_causes"] = snap["failover_causes"]
+    unplanned = [e for e in snap["failover_events"] if e["cause"] != "planned"]
+    if unplanned:
+        report["failover_to_first_result_ms"] = unplanned[0]["ms"]
+        if unplanned[0].get("standby"):
+            report["replicated_failover_ms"] = unplanned[0]["ms"]
 
     launches: Dict[str, int] = {}
     for e in tel.spans(name="update", kind="stacked-aot"):
@@ -229,21 +288,25 @@ def run_inproc(args: argparse.Namespace) -> Dict[str, Any]:
     # -- structural pins ---------------------------------------------------
     violations: List[str] = []
     if args.check:
-        traffic_shards = {fab.shard_for(names[int(s)]) for s in trace["session"]}
-        if args.kill_shard is not None:
-            pass  # the killed shard's counters reset on failover; skip its floor
         for owner in launches:
             if "@shard" not in owner:
                 violations.append(f"launch span without shard tag: {owner}")
         launched_shards = {
             int(owner.rsplit("@shard", 1)[1]) for owner in launches if "@shard" in owner
         }
-        missing = traffic_shards - launched_shards - (
-            {args.kill_shard} if args.kill_shard is not None else set()
-        )
-        if missing:
-            violations.append(f"shards with traffic but zero launches: {sorted(missing)}")
-        if args.max_queue and max_depth > args.max_queue:
+        if not elastic:
+            # (skipped under the elastic drill: membership changed
+            # mid-run, so "which shard got traffic" has no single answer
+            # — the exactly-once ledger below is the real check there)
+            traffic_shards = {fab.shard_for(names[int(s)]) for s in trace["session"]}
+            missing = traffic_shards - launched_shards - (
+                {args.kill_shard} if args.kill_shard is not None else set()
+            )
+            if missing:
+                violations.append(
+                    f"shards with traffic but zero launches: {sorted(missing)}"
+                )
+        if not elastic and args.max_queue and max_depth > args.max_queue:
             violations.append(
                 f"queue bound violated: sampled depth {max_depth} > {args.max_queue}"
             )
@@ -251,10 +314,46 @@ def run_inproc(args: argparse.Namespace) -> Dict[str, Any]:
             violations.append(
                 f"cross-shard collectives on submit path: {report['submit_collectives']}"
             )
-        if shed + rejected == 0 and args.overload >= 1.5 and args.kill_shard is None:
+        if (shed + rejected == 0 and args.overload >= 1.5
+                and args.kill_shard is None and not elastic):
             # (skipped under --kill-shard: failover replaces the victim's
-            # service, so the overload-phase counter deltas go dark)
+            # service, so the overload-phase counter deltas go dark; the
+            # elastic drill admits everything by design)
             violations.append("no shedding at >=1.5x overload: queue bound inert?")
+
+    # -- exactly-once ledger (elastic drill) -------------------------------
+    if elastic and args.check:
+        # replay every admitted submit into one unsharded control twin:
+        # after any mix of hand-offs, retirements, and partition failovers,
+        # every session's value must match bit-for-bit — a lost update or
+        # a double-apply shows up as a digest mismatch
+        from metrics_tpu.serve import MetricsService
+
+        ref = MetricsService(
+            Accuracy(task="multiclass", num_classes=args.num_classes)
+        )
+        for name, bi in ledger:
+            ref.submit(name, *batches[bi])
+        ref.drain()
+        want = {k: np.asarray(v).tobytes() for k, v in ref.compute_all().items()}
+        got = {k: np.asarray(v).tobytes() for k, v in fab.compute_all().items()}
+        ref.shutdown()
+        report["ledger_submits"] = len(ledger)
+        report["ledger_sessions"] = len(want)
+        for name in sorted(set(want) - set(got)):
+            violations.append(f"ledger: session {name} lost in hand-off")
+        for name in sorted(set(got) - set(want)):
+            violations.append(f"ledger: phantom session {name} after hand-off")
+        mismatched = sorted(
+            n for n in set(want) & set(got) if want[n] != got[n]
+        )
+        for name in mismatched[:8]:
+            violations.append(
+                f"ledger: session {name} digest mismatch "
+                "(lost or double-applied admitted request)"
+            )
+        if len(mismatched) > 8:
+            violations.append(f"ledger: ... and {len(mismatched) - 8} more")
     report["violations"] = violations
     _ = faults  # keep the fault registry imported for env-armed runs
     fab.shutdown()
@@ -446,6 +545,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--worker", type=int, default=None, help=argparse.SUPPRESS)
     ap.add_argument("--kill-shard", type=int, default=None,
                     help="SIGKILL this shard mid-stream, then fail over")
+    ap.add_argument("--add-shard-at", type=int, default=None,
+                    help="elastic drill: add_shard() + rebalance() at this "
+                         "event index (in-process mode)")
+    ap.add_argument("--remove-shard-at", type=int, default=None,
+                    help="elastic drill: remove_shard(shards-1) at this "
+                         "event index (in-process mode)")
+    ap.add_argument("--partition", type=int, default=None,
+                    help="elastic drill: network-partition this shard at "
+                         "events/2; the fabric must fence + fail over and "
+                         "the old side's writes must bounce")
     ap.add_argument("--kill-delay-s", type=float, default=2.0)
     ap.add_argument("--worker-timeout-s", type=float, default=600.0)
     ap.add_argument("--check", dest="check", action="store_true", default=True,

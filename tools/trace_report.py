@@ -235,7 +235,8 @@ def summarize(events: List[Dict[str, Any]]) -> str:
 
     # multi-host fabric (metrics_tpu.fabric): shards tag their spans with an
     # `@shard<k>` owner suffix, so a fleet trace decomposes into per-shard
-    # launch/request tallies; failover spans carry shard/peer/epoch/ms
+    # launch/request tallies; failover spans carry shard/peer/epoch/ms and a
+    # cause (killed / heartbeat / suspect-slow / partition / planned)
     shard_launches: Dict[str, int] = {}
     shard_requests: Dict[str, int] = {}
     for e in events:
@@ -262,6 +263,8 @@ def summarize(events: List[Dict[str, Any]]) -> str:
                 f"  failover shard {attrs.get('shard', '?')} -> peer {attrs.get('peer', '?')}"
                 f"   epoch {attrs.get('epoch', '?')}   {float(attrs.get('ms', 0.0)):.1f} ms"
                 f"   sessions {attrs.get('sessions', '?')}"
+                f"   cause {attrs.get('cause', 'killed')}"
+                + ("   standby" if attrs.get("standby") else "")
             )
 
     # cold start to first result: process start (trace window origin) to the
