@@ -61,7 +61,7 @@ Beyond crash failover, membership and degradation are first-class:
   per-flush latency into one shard (alive, correct, slow); the
   suspicion monitor (:meth:`suspicion_sweep`) reads each shard's SLO
   sketches and quarantines any shard whose served p99 crosses
-  ``suspect_p99_multiple`` x the fleet median — drain, fence, and route
+  ``suspect_p99_multiple`` x its peers' median — drain, fence, and route
   its partition to the successor's standby (failover cause
   ``suspect-slow``). The ``network-partition`` fault class makes a
   shard unreachable while its host keeps running: the fabric fences and
@@ -180,6 +180,22 @@ class HashRing:
             counts[self.owner(name)] += 1
         return counts
 
+    def arc_losers(self, target: "HashRing") -> set:
+        """Shard ids owning at least one arc of THIS ring whose owner
+        differs under ``target`` — the set a planned hand-off must fence:
+        any session (open now or submitted mid-move) hashing into a moved
+        arc currently routes to one of these shards. Ownership is
+        piecewise-constant between ring points, so probing just past
+        every boundary of either ring covers each (old, new) ownership
+        interval exactly once."""
+        losers: set = set()
+        for h in set(self._hashes) | set(target._hashes):
+            i = bisect_right(self._hashes, h) % len(self._hashes)
+            j = bisect_right(target._hashes, h) % len(target._hashes)
+            if self._owners[i] != target._owners[j]:
+                losers.add(self._owners[i])
+        return losers
+
 
 class _Shard:
     """One partition: durable directories + the service currently hosting
@@ -244,7 +260,7 @@ class ShardedMetricsService:
         suspect_p99_multiple / suspect_min_requests: gray-failure
             suspicion threshold — :meth:`suspicion_sweep` quarantines a
             shard whose served p99 exceeds ``suspect_p99_multiple`` times
-            the fleet median, once it has served at least
+            its peers' median, once it has served at least
             ``suspect_min_requests`` requests (below that the sketch is
             noise).
         checkpoint_every / max_inflight / max_queue / admission /
@@ -403,13 +419,16 @@ class ShardedMetricsService:
     def _route(self, name: str) -> _Shard:
         while True:
             shard = self._shards[self.shard_for(name)]
-            if shard.shard_id in self._fenced:
-                # mid hand-off: park until the ring swap, then re-route —
-                # ownership of this arc may have moved
-                with self._fence_cond:
+            # membership of the fence set is only meaningful under the
+            # fence condition's lock — an unlocked peek could slip past a
+            # fence mid-install and land a submit on a draining source
+            with self._fence_cond:
+                if shard.shard_id in self._fenced:
+                    # mid hand-off: park until the ring swap, then
+                    # re-route — ownership of this arc may have moved
                     while shard.shard_id in self._fenced:
                         self._fence_cond.wait(timeout=5.0)
-                continue
+                    continue
             self._probe_death(shard)
             if not shard.alive:
                 self.stats["dead_routes"] += 1
@@ -691,6 +710,13 @@ class ShardedMetricsService:
             self._target_ring = HashRing(
                 self._serving_ids(), vnodes=self.ring.vnodes
             )
+            # the fresh service was built on the default (shard-id,
+            # old-stride) lattice, which can collide with an existing
+            # shard's residue (e.g. 2 shards at stride 2, new shard 2 →
+            # same lattice as shard 0). Rebase the fleet NOW — a submit
+            # routed anywhere before rebalance() completes must never
+            # mint a duplicate rid.
+            self._rebase_rid_lattice()
             telemetry.emit(
                 "membership", self.label, "add-shard", t0=telemetry.clock(),
                 stream="serve", shard=sid, num_shards=self.num_shards,
@@ -739,40 +765,44 @@ class ShardedMetricsService:
         """Converge session placement to the target ring set by
         :meth:`add_shard` / :meth:`remove_shard` — the planned hand-off.
 
-        Per source shard the sequence is **drain → fence → transfer →
-        swap**: an admission fence parks routes to the source (zero lost
-        submits), ``drain()`` retires every admitted request into the
-        stacked state, the source's journal epoch bumps
+        The sequence is **fence → drain → plan → transfer → swap**. The
+        fence set comes from the RING DIFF (:meth:`HashRing.arc_losers`),
+        not from open sessions: every shard losing any arc parks
+        admissions — including one with no open session in the moved
+        range — so a submit racing the swap can never open a fresh row on
+        the old owner and strand it behind the new ring (zero lost
+        submits). Per fenced source, ``drain()`` retires every admitted
+        request into the stacked state, the source's journal epoch bumps
         (:meth:`MetricsService.advance_epoch` — a superseded writer of
-        the moved range now raises :class:`StaleEpochError`), exactly the
+        the moved range now raises :class:`StaleEpochError`), and only
+        THEN is the move plan drawn — sessions opened between the target
+        ring being set and the fence landing are included. Exactly the
         sessions whose target-ring owner changed transfer as portable
         state rows (:meth:`MetricsService.export_sessions` /
-        ``import_sessions`` — bit-identical, no re-execution), and only
-        then does the ring swap and the fence lift. Consistent hashing
-        makes the plan minimal: ~1/N of the sessions, never a reshuffle.
-        Both sides checkpoint (the moved rows live in no journal) and
-        their standbys re-seed. Returns the move report
+        ``import_sessions`` — bit-identical, no re-execution); the rid
+        lattice rebases and the ring swaps before the fence lifts.
+        Consistent hashing makes the plan minimal: ~1/N of the sessions,
+        never a reshuffle. Both sides checkpoint (the moved rows live in
+        no journal) and their standbys re-seed. Returns the move report
         (``moved`` names, per-pair events, wall ms)."""
         with self._lock:
             target = self._target_ring
             if target is None:
                 return {"moved": [], "handoffs": 0, "ms": 0.0}
-            # plan: exactly the open sessions whose owner changes
-            moves: Dict[int, Dict[int, List[str]]] = {}
-            for shard in self._shards:
-                if shard.retired or not shard.alive:
-                    continue
-                for name in sorted(shard.service._rows):
-                    dst = target.owner(name)
-                    if dst != shard.shard_id:
-                        moves.setdefault(shard.shard_id, {}).setdefault(
-                            dst, []
-                        ).append(name)
+            srcs = sorted(
+                sid for sid in self.ring.arc_losers(target)
+                if not self._shards[sid].retired
+            )
+        # a dead source still owns durable rows: recover it first so the
+        # hand-off transfers its state instead of abandoning it
+        for sid in srcs:
+            if not self._shards[sid].alive:
+                self.fail_over(sid)
         t0 = telemetry.clock()
         w0 = time.monotonic()
         moved: List[str] = []
         touched: set = set()
-        srcs = sorted(moves)
+        handoffs = 0
         self._fence(srcs)
         try:
             for src_id in srcs:
@@ -783,8 +813,17 @@ class ShardedMetricsService:
                     shard.epoch = shard.service.advance_epoch(
                         max(shard.epoch, wal.read_epoch(shard.journal_dir)) + 1
                     )
-                for dst_id in sorted(moves[src_id]):
-                    names = moves[src_id][dst_id]
+                # plan under the fence: exactly the open sessions whose
+                # owner changes, with every pre-fence admission drained
+                dests: Dict[int, List[str]] = {}
+                for name in sorted(shard.service._rows):
+                    dst = target.owner(name)
+                    if dst != src_id:
+                        dests.setdefault(dst, []).append(name)
+                if dests:
+                    handoffs += 1
+                for dst_id in sorted(dests):
+                    names = dests[dst_id]
                     dst = self._shards[dst_id]
                     dst.service.import_sessions(
                         shard.service.export_sessions(names)
@@ -804,12 +843,16 @@ class ShardedMetricsService:
                         "cause": "planned",
                         "standby": False,
                     })
-                for dst_id in moves[src_id]:
-                    for name in moves[src_id][dst_id]:
+                for dst_id in dests:
+                    for name in dests[dst_id]:
                         shard.service.close_session(name)
             with self._lock:
                 self.ring = target
                 self._target_ring = None
+                # rebase before the fence lifts: a submit routed the
+                # instant admissions resume must already see a
+                # collision-free lattice
+                self._rebase_rid_lattice()
         finally:
             self._unfence(srcs)
         # moved rows exist in no journal: both sides checkpoint so a crash
@@ -823,16 +866,18 @@ class ShardedMetricsService:
             if standby is not None:
                 with svc._flush_lock:
                     standby.seed_from(svc, svc.replication_floor())
+                if svc.journal is not None:
+                    svc.journal.retain_seq = standby.cursor
         with self._lock:
-            self._rebase_rid_lattice()
-            self.stats["handoffs"] += len(srcs)
+            self.stats["handoffs"] += handoffs
             self.stats["moved_sessions"] += len(moved)
         ms = (time.monotonic() - w0) * 1e3
         telemetry.emit(
             "handoff", self.label, "planned", t0=t0, stream="serve",
-            sources=len(srcs), sessions=len(moved), ms=round(ms, 3),
+            sources=handoffs, fenced=len(srcs), sessions=len(moved),
+            ms=round(ms, 3),
         )
-        return {"moved": moved, "handoffs": len(srcs), "ms": ms}
+        return {"moved": moved, "handoffs": handoffs, "ms": ms}
 
     def _rebase_rid_lattice(self) -> None:
         """Re-base every live shard's request-id lattice to
@@ -875,25 +920,59 @@ class ShardedMetricsService:
         return out
 
     def _ship(self, shard: _Shard) -> int:
+        journal = shard.service.journal
         standby = self._standbys.get(shard.shard_id)
         if standby is None:
             standby = self._new_standby(shard)
             if standby is None:
                 return 0
             self._standbys[shard.shard_id] = standby
+            journal.retain_seq = standby.cursor
             return 0
+        if journal.first_seq() > standby.cursor + 1:
+            # a checkpoint truncated records the standby never streamed
+            # (the retain floor was cleared or not yet pinned): streaming
+            # would leap the gap and silently lose those records on
+            # promotion — re-seed by bulk state transfer instead
+            return self._reseed(shard, standby)
         # floor FIRST, then stream: everything at or below the floor is
         # durably on disk, so the shipped batch always covers it — the
         # standby never advances past a record it has not seen
         floor = shard.service.replication_floor()
-        records = shard.service.journal.stream_since(standby.cursor)
+        records = journal.stream_since(standby.cursor)
+        if records and records[0].seq > standby.cursor + 1:
+            # truncation raced the stream read past the gap check
+            return self._reseed(shard, standby)
+        # a mid-stream truncation can cut the batch short: never advance
+        # the applied floor past what actually shipped (the next ship
+        # detects the gap, if any, and re-seeds)
+        floor = min(floor, records[-1].seq if records else standby.cursor)
         applied = standby.apply(records, floor)
+        # hold truncation back to the ship cursor: the next checkpoint
+        # fence must not delete records the standby has not streamed
+        journal.retain_seq = standby.cursor
         telemetry.emit(
             "replicate", self.label, "ship", t0=telemetry.clock(),
             stream="serve", shard=shard.shard_id, records=len(records),
             applied=applied, floor=floor,
         )
         return applied
+
+    def _reseed(self, shard: _Shard, standby: wal.StandbyReplica) -> int:
+        """Bulk repair after a replication gap (journal truncated past
+        the ship cursor): pin the primary's floor under its flush lock,
+        mirror its state, and rewind the cursor — the warm copy is
+        bit-identical again and the next ship streams from the floor."""
+        svc = shard.service
+        with svc._flush_lock:
+            floor = svc.replication_floor()
+            standby.seed_from(svc, floor)
+        svc.journal.retain_seq = standby.cursor
+        telemetry.emit(
+            "replicate", self.label, "reseed-gap", t0=telemetry.clock(),
+            stream="serve", shard=shard.shard_id, floor=floor,
+        )
+        return 0
 
     def _new_standby(self, shard: _Shard) -> Optional[wal.StandbyReplica]:
         live = [s.shard_id for s in self._live_shards()]
@@ -929,11 +1008,21 @@ class ShardedMetricsService:
             svc = shard.service
             with svc._flush_lock:
                 floor = svc.replication_floor()
-                standby.apply(svc.journal.stream_since(standby.cursor), floor)
-                ok = svc.state_digest() == standby.digest()
+                if svc.journal.first_seq() > standby.cursor + 1:
+                    # replication gap (truncated past the ship cursor):
+                    # the warm copy cannot be caught up by streaming
+                    ok = False
+                else:
+                    records = svc.journal.stream_since(standby.cursor)
+                    standby.apply(
+                        records,
+                        min(floor, records[-1].seq if records else standby.cursor),
+                    )
+                    ok = svc.state_digest() == standby.digest()
                 if not ok:
                     diverged.append(shard.shard_id)
                     standby.seed_from(svc, floor)
+                svc.journal.retain_seq = standby.cursor
             telemetry.emit(
                 "anti-entropy", self.label, "scrub", t0=telemetry.clock(),
                 stream="serve", shard=shard.shard_id, diverged=not ok,
@@ -947,15 +1036,19 @@ class ShardedMetricsService:
         min_requests: Optional[int] = None,
     ) -> List[int]:
         """Gray-failure containment: compare each shard's served p99
-        (from its SLO sketches) against the fleet median; any shard above
-        ``multiple`` x the median (default ``suspect_p99_multiple``) is
-        marked *suspect* and quarantined — drained (it is alive and
+        (from its SLO sketches) against the median of its PEERS — the
+        other measurable shards, its own sample excluded; any shard above
+        ``multiple`` x that baseline (default ``suspect_p99_multiple``)
+        is marked *suspect* and quarantined — drained (it is alive and
         correct, just slow: nothing is lost), final tail shipped to its
         standby, then fenced and failed over to the designated peer with
         cause ``suspect-slow``. Returns the quarantined shard ids. Shards
         under ``min_requests`` served are skipped (sketch noise), and a
-        fleet of fewer than two measurable shards has no median to trust.
-        """
+        fleet of fewer than two measurable shards has no baseline to
+        trust. Excluding the candidate's own sample keeps the threshold
+        meaningful down to a 2-shard fleet: a self-inclusive median made
+        ``slow > multiple * median`` unsatisfiable at n=2 for any
+        ``multiple >= 2``."""
         multiple = (
             self.suspect_p99_multiple if multiple is None else float(multiple)
         )
@@ -973,18 +1066,20 @@ class ShardedMetricsService:
                 p99s[shard.shard_id] = p99
         if len(p99s) < 2:
             return []
-        median = statistics.median(p99s.values())
-        if median <= 0.0:
-            return []
-        suspects = [
-            sid for sid, p99 in sorted(p99s.items()) if p99 > multiple * median
-        ]
+        suspects: List[int] = []
+        baselines: Dict[int, float] = {}
+        for sid in sorted(p99s):
+            peers = [v for k, v in p99s.items() if k != sid]
+            baseline = statistics.median(peers)
+            if baseline > 0.0 and p99s[sid] > multiple * baseline:
+                suspects.append(sid)
+                baselines[sid] = baseline
         for sid in suspects:
             self._shards[sid].suspect = True
             telemetry.emit(
                 "suspect", self.label, "gray-failure", t0=telemetry.clock(),
                 stream="serve", shard=sid, p99_us=round(p99s[sid], 1),
-                fleet_median_us=round(median, 1), multiple=multiple,
+                peer_median_us=round(baselines[sid], 1), multiple=multiple,
             )
             self.quarantine(sid)
         return suspects

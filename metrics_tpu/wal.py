@@ -280,6 +280,11 @@ class WriteAheadLog:
         if self.epoch > fenced:
             fence_epoch(directory, self.epoch)
         self._lock = threading.Lock()
+        # replication hold-back: with a standby streaming this journal, the
+        # fabric pins this to the standby's ship cursor so a checkpoint
+        # fence can never truncate records the standby has not seen yet
+        # (None = no consumer; truncate freely)
+        self.retain_seq: Optional[int] = None
         self._active: Optional[Any] = None  # open file handle of the last segment
         self._active_path: Optional[str] = None
         self._fsync_us: deque = deque(maxlen=512)
@@ -388,6 +393,17 @@ class WriteAheadLog:
     def last_seq(self) -> int:
         """High-water sequence number (0 before the first append)."""
         return self._last_seq
+
+    def first_seq(self) -> int:
+        """Lowest sequence number still readable from disk
+        (``last_seq + 1`` once truncation has retired every frame). A
+        replication consumer whose cursor sits below ``first_seq() - 1``
+        has a gap — records it never streamed were truncated — and must
+        re-seed by bulk state transfer instead of streaming."""
+        with self._lock:
+            if self._segments:
+                return self._segments[0].first_seq
+            return self._last_seq + 1
 
     def ensure_seq(self, floor: int) -> None:
         """Raise the sequence floor to at least ``floor`` (restore() calls
@@ -564,15 +580,33 @@ class WriteAheadLog:
         (:class:`StandbyReplica` holds unresolved updates back until the
         primary's replication floor passes them). Reads the sealed
         segments plus the active tail; an incomplete in-flight frame at
-        the very end is skipped (it ships with the next batch)."""
+        the very end is skipped (it ships with the next batch).
+
+        Safe against a concurrent :meth:`truncate` (the flush worker's
+        auto-checkpoint races replication reads): a snapshotted segment
+        removed before it could be opened is skipped, and the stream
+        stops at the first sequence discontinuity so the returned batch
+        is always contiguous — the caller detects the resulting gap
+        (``records[0].seq`` vs its cursor, or :meth:`first_seq`) and
+        re-seeds the consumer instead of leaping truncated records."""
         out: List[WalRecord] = []
         with self._lock:
             segments = list(self._segments)
+        prev: Optional[int] = None
         for seg in segments:
             if seg.last_seq <= after_seq:
                 continue
-            with open(seg.path, "rb") as f:
-                data = f.read()
+            try:
+                with open(seg.path, "rb") as f:
+                    data = f.read()
+            except FileNotFoundError:
+                # truncated between the snapshot and the open. Anything it
+                # held is a gap: if earlier records were already collected,
+                # later segments would leap it — stop and ship the prefix.
+                if prev is not None:
+                    break
+                continue
+            gap = False
             offset = 0
             while offset < len(data):
                 frame = self._parse_frame(data, offset, seg.path)
@@ -582,6 +616,10 @@ class WriteAheadLog:
                 offset += frame_len
                 if seq <= after_seq:
                     continue
+                if prev is not None and seq != prev + 1:
+                    gap = True
+                    break
+                prev = seq
                 if kind == UPDATE:
                     args, kwargs = pickle.loads(payload)
                 elif kind == DROP:
@@ -593,6 +631,8 @@ class WriteAheadLog:
                     seq, kind, str(header.get("session", "")), args, kwargs,
                     rid=int(header.get("rid", 0)),
                 ))
+            if gap:
+                break
         with self._lock:
             self._stats["shipped"] += len(out)
         return out
@@ -605,8 +645,17 @@ class WriteAheadLog:
         created *first* — its name pins the sequence floor — so a crash at
         any point leaves a journal that still opens with the right
         ``last_seq``. Idempotent: replay is fenced, so a half-truncated
-        journal wastes disk, never correctness."""
+        journal wastes disk, never correctness.
+
+        With :attr:`retain_seq` set (a standby is streaming this journal;
+        the fabric pins it to the ship cursor after every ship), the
+        effective fence is ``min(upto_seq, retain_seq)`` — a checkpoint
+        can never delete records the standby has not streamed, so the
+        replication cursor never silently leaps truncated records."""
         removed = 0
+        retain = self.retain_seq
+        if retain is not None:
+            upto_seq = min(int(upto_seq), int(retain))
         self.check_epoch()
         t0 = telemetry.clock()
         with self._lock:

@@ -170,6 +170,94 @@ def test_remove_shard_archives_slo_counts(tmp_path):
     fab.shutdown()
 
 
+def test_rebalance_fences_every_arc_loser(tmp_path):
+    """Regression: the fence set comes from the RING DIFF, not from the
+    open-session set — a shard losing an arc that currently holds no
+    session must still park admissions, or a submit racing the swap
+    could open a fresh row on the old owner and strand it (the session
+    would exist on two shards after the swap)."""
+    fab = _fabric(3, data_dir=str(tmp_path))
+    fab.add_shard()  # no sessions open anywhere: the old plan was empty
+    losers = fab.ring.arc_losers(fab._target_ring)
+    assert losers, "a new shard must claim arcs from at least one old shard"
+    fenced: list = []
+    orig_fence = fab._fence
+
+    def recording_fence(shard_ids):
+        fenced.extend(shard_ids)
+        return orig_fence(shard_ids)
+
+    fab._fence = recording_fence
+    fab.rebalance()
+    assert set(fenced) == set(losers)
+    fab.shutdown()
+
+
+def test_submit_racing_the_fence_is_not_stranded(tmp_path):
+    """Regression: a session opened on a source shard after the move
+    plan would classically have been drawn (the in-flight twin of a
+    submit that passed the fence check just before the fence landed)
+    must still transfer — the plan is computed under the fence, after
+    the drain. A stranded row would make the session exist on two
+    shards and silently drop its pre-swap updates."""
+    names, ops = _stream(n_sessions=8)
+    fab = _fabric(2, data_dir=str(tmp_path))
+    _feed(fab, ops)
+    fab.add_shard()
+    target = fab._target_ring
+    losers = fab.ring.arc_losers(target)
+    # a fresh session on a moved arc of some fenced source
+    victim = next(
+        f"race{i}" for i in range(10_000)
+        if fab.ring.owner(f"race{i}") in losers
+        and fab.ring.owner(f"race{i}") != target.owner(f"race{i}")
+    )
+    src = fab._shards[fab.ring.owner(victim)]
+    x = jnp.asarray(np.arange(16) % 8)
+    real_drain = src.service.drain
+    fired = []
+
+    def racing_drain():
+        real_drain()
+        if not fired:
+            # lands mid-hand-off, after the fence, before the plan
+            # (one-shot: checkpoint() drains again after the move)
+            fired.append(True)
+            src.service.submit(victim, x, x)
+            src.service.flush()
+            real_drain()
+
+    src.service.drain = racing_drain
+    try:
+        report = fab.rebalance()
+    finally:
+        src.service.drain = real_drain
+    assert victim in report["moved"]
+    holders = [
+        s.shard_id for s in fab._shards
+        if not s.retired and victim in s.service._rows
+    ]
+    assert holders == [fab.shard_for(victim)] == [target.owner(victim)]
+    # the racing update survived the move bit-exactly: acc(x, x) == 1
+    assert float(np.asarray(fab.compute(victim))) == 1.0
+    fab.shutdown()
+
+
+def test_add_shard_rebases_rid_lattice_immediately(tmp_path):
+    """Regression: the freshly provisioned shard must never share a rid
+    residue with an existing shard, even before rebalance() completes
+    (the default offset=sid, stride=old_N lattice collided: 2 shards at
+    stride 2 plus new shard 2 → the same residue as shard 0)."""
+    fab = _fabric(2, data_dir=str(tmp_path))
+    fab.add_shard()
+    live = [s for s in fab._shards if not s.retired]
+    strides = {s.service._rid_stride for s in live}
+    assert strides == {len(live)}
+    residues = [s.service._rid % s.service._rid_stride for s in live]
+    assert len(set(residues)) == len(live), residues
+    fab.shutdown()
+
+
 def test_rid_lattice_stays_disjoint_after_membership_changes(tmp_path):
     """Joins and leaves re-base the request-id lattice: offsets are
     distinct residues modulo a shared stride, so rids minted by any two
@@ -218,6 +306,62 @@ def test_standby_failover_replays_only_unshipped_tail(tmp_path):
     assert event["standby"] is True and event["cause"] == "killed"
     assert 0 < event["replayed"] <= total - shipped
 
+    assert _digests(fab.compute_all()) == _control(ops)
+    fab.shutdown()
+
+
+def test_checkpoint_truncation_cannot_silently_drop_replicated_records(tmp_path):
+    """Regression: a checkpoint fence truncating journal segments the
+    standby has not streamed yet must not turn into silent standby data
+    loss. Two layers: the retain floor holds truncation back to the ship
+    cursor while replication is active, and a forced gap (retain floor
+    cleared, truncate past the cursor) is detected by the next ship and
+    repaired by a bulk re-seed — promotion after either path stays
+    bit-identical to the control twin."""
+    names, ops = _stream(n_sessions=12, ops=4)
+    q = len(ops) // 4
+    fab = _fabric(2, data_dir=str(tmp_path), standby=True)
+    for name, p, t in ops[:q]:
+        fab.submit(name, p, t)
+    fab.drain()
+    fab.replicate()  # seed
+    fab.replicate()  # ship everything so far
+
+    victim = 0
+    svc = fab._shards[victim].service
+    standby = fab._standbys[victim]
+    cursor = standby.cursor
+    for name, p, t in ops[q:2 * q]:
+        fab.submit(name, p, t)
+    fab.drain()
+    # layer 1 — retain floor: the checkpoint fence covers the whole
+    # journal, but truncation holds back to the ship cursor, so the
+    # unshipped tail is still streamable afterwards
+    svc.checkpoint()
+    assert svc.journal.first_seq() <= cursor + 1
+    fab.replicate()  # ships the held-back tail; no gap, no repair needed
+    assert standby.stats["reseeds"] == 1  # the initial seed only
+
+    # layer 2 — gap detection: clear the floor and truncate past the
+    # cursor (the pre-fix behavior); the next ship must re-seed instead
+    # of advancing the cursor past records it never saw
+    for name, p, t in ops[2 * q:3 * q]:
+        fab.submit(name, p, t)
+    fab.drain()
+    svc.journal.retain_seq = None
+    svc.checkpoint()
+    assert svc.journal.first_seq() > standby.cursor + 1  # a real gap
+    fab.replicate()
+    assert standby.stats["reseeds"] == 2  # gap detected → bulk repair
+    assert fab.anti_entropy() == []  # the repaired copy is bit-identical
+
+    # promotion after the repair is still exactly-once
+    for name, p, t in ops[3 * q:]:
+        fab.submit(name, p, t)
+    fab.drain()
+    fab.kill_shard(victim)
+    fab.fail_over(victim)
+    assert fab.failover_events[-1]["standby"] is True
     assert _digests(fab.compute_all()) == _control(ops)
     fab.shutdown()
 
@@ -350,6 +494,43 @@ def test_suspicion_sweep_quarantines_slow_shard(tmp_path):
     # quarantine is a recovery, not an outage: the partition serves again
     assert fab._shards[slow].alive and not fab._shards[slow].suspect
     fab.update(next(n for n in names if fab.shard_for(n) == slow), x, y)
+    fab.shutdown()
+
+
+def test_suspicion_sweep_works_in_two_shard_fleet(tmp_path):
+    """Regression: with a self-inclusive fleet median the 2-shard case
+    was mathematically inert — slow > multiple * median(fast, slow) is
+    unsatisfiable for any multiple >= 2, so a gray-failing shard in the
+    smallest real fleet was never quarantined. The baseline is now the
+    median of the OTHER shards, so two shards compare against each
+    other directly."""
+    fab = _fabric(2, data_dir=str(tmp_path), standby=True)
+    rng = np.random.RandomState(0)
+    names = [f"t{i}" for i in range(16)]
+    for n in names:
+        fab.open_session(n)
+    x = jnp.asarray(rng.randint(0, 8, 16))
+    y = jnp.asarray(rng.randint(0, 8, 16))
+
+    def closed_loop(n_ops):
+        for i in range(n_ops):
+            name = names[i % len(names)]
+            svc = fab._route(name).service
+            svc.submit(name, x, y)
+            svc.flush()
+            svc.drain()
+
+    closed_loop(200)  # warm: compile tail falls out of p99
+    fab.replicate()
+    slow = 0
+    with faults.inject("shard-slow", prob=1.0, count=500, shard=slow, ms=40):
+        closed_loop(100)
+        suspects = fab.suspicion_sweep(min_requests=32)
+    assert suspects == [slow]
+    event = fab.failover_events[-1]
+    assert event["cause"] == "suspect-slow" and event["shard"] == slow
+    # quarantine is a recovery, not an outage
+    assert fab._shards[slow].alive and not fab._shards[slow].suspect
     fab.shutdown()
 
 

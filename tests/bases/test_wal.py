@@ -146,6 +146,65 @@ def test_truncate_is_fenced_and_idempotent(tmp_path):
     assert [r.seq for r in log.read_tail(fence)] == [3, 4, 5]
 
 
+def test_truncate_holds_back_to_retain_floor(tmp_path):
+    """Regression: with a standby streaming this journal (retain_seq
+    pinned to its ship cursor), a checkpoint fence must not delete
+    records the standby has not streamed — the effective truncation
+    fence is min(checkpoint fence, retain floor)."""
+    log = _log(tmp_path, segment_max_bytes=4096)
+    big = np.zeros(800, np.float32)
+    for i in range(5):
+        log.append(wal.UPDATE, "s", (big + i,))
+    log.retain_seq = 2  # standby has streamed through seq 2
+    log.truncate(log.last_seq)  # checkpoint fence covers everything
+    # records above the retain floor survive, so the standby can still
+    # stream them — no replication gap
+    assert [r.seq for r in log.stream_since(2)] == [3, 4, 5]
+    assert log.first_seq() <= 3
+    # releasing the floor lets the next truncation finish the job
+    log.retain_seq = None
+    log.truncate(5)
+    assert log.first_seq() == 6 and log.last_seq == 5
+
+
+def test_stream_since_tolerates_concurrently_truncated_segment(tmp_path):
+    """Regression: a segment os.remove'd between the snapshot of the
+    segment list and the open (a racing auto-checkpoint truncate) must
+    not crash the replication read — and the returned batch stays
+    contiguous so the consumer can detect the gap instead of silently
+    leaping it."""
+    log = _log(tmp_path, segment_max_bytes=4096)
+    big = np.zeros(1200, np.float32)  # ~4.8KB payload: one frame per segment
+    for i in range(4):
+        log.append(wal.UPDATE, "s", (big + i,))
+    # simulate the race: the first snapshotted segment vanishes from disk
+    # behind the reader's back (the in-memory segment list still has it)
+    os.remove(log._segments[0].path)
+    records = log.stream_since(0)
+    assert [r.seq for r in records] == [2, 3, 4]  # no crash, prefix gone
+    # the gap is visible to the consumer: first record leaps the cursor
+    assert records[0].seq > 0 + 1
+
+    # a MIDDLE segment vanishing truncates the stream at the gap instead
+    # of shipping records that leap it
+    os.remove(log._segments[2].path)
+    records = log.stream_since(1)
+    assert [r.seq for r in records] == [2]  # stops before the hole
+
+
+def test_first_seq_tracks_truncation(tmp_path):
+    log = _log(tmp_path, segment_max_bytes=4096)
+    assert log.first_seq() == 1  # empty journal: next appendable seq
+    big = np.zeros(800, np.float32)
+    for i in range(5):
+        log.append(wal.UPDATE, "s", (big + i,))
+    assert log.first_seq() == 1
+    log.truncate(2)
+    assert log.first_seq() > 1  # the retired prefix is gone
+    log.truncate(5)
+    assert log.first_seq() == 6  # everything retired: last_seq + 1
+
+
 def test_ensure_seq_raises_floor_only(tmp_path):
     log = _log(tmp_path)
     log.ensure_seq(40)
