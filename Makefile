@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load doctest audit bench bench-forward serve-bench stream-bench trace slo tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load doctest audit bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -133,6 +133,12 @@ serve-bench:
 # sync is exactly one packed collective)
 stream-bench:
 	python -c "import json, bench; d = {}; bench._cfg_streaming(d); print(json.dumps(d, indent=2))"
+
+# O(1)-read-path numbers only: window read-µs flat-line across window
+# sizes, zero-launch second read of an un-ticked session, mixed
+# submit/read memo hit rate, and the one-packed-collective fleet read
+read-bench:
+	python -c "import json, bench; d = {}; bench._cfg_read_path(d); print(json.dumps(d, indent=2))"
 
 # short instrumented eval with telemetry export, then the human-readable
 # replay: launches, retraces by cause, collectives/bytes, p50/p95 span µs.

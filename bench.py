@@ -1049,6 +1049,101 @@ def _cfg_streaming(detail: dict, steps: int = 1000) -> None:
     detail["sketch_sync_bytes_2replica"] = ts.bytes_on_wire
 
 
+def _cfg_read_path(detail: dict, sessions: int = 64, reps: int = 20) -> None:
+    """The O(1) read path (ROADMAP items 4+5): four claims.
+
+    (1) **Window reads flat-line**: a ``SlidingWindow`` read is ONE
+    guarded ``pure_merge`` against the cached prefix fold, so read-µs
+    (and the structural ``read:window-cached`` counter) stay flat from
+    window=8 to window=1024 — the refold rides the advance tick. (2)
+    **Second read of an un-ticked session is free**: zero launches, zero
+    compiles (the version-tagged serve memo short-circuits the engine
+    entirely). (3) **Mixed submit/read serving**: ``compute_all`` over
+    ``sessions`` rows where only a few ticked launches the vmapped
+    program for the DIRTY rows only — read cost scales with churn, not
+    state. (4) **Fleet reads are one packed collective**: a sharded
+    ``compute_all`` adds exactly one ``fleet_read_collectives`` no matter
+    how many shards participate."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import SlidingWindow, profiling, telemetry
+    from metrics_tpu.aggregation import SumMetric
+    from metrics_tpu.classification import Accuracy
+    from metrics_tpu.fabric import ShardedMetricsService
+    from metrics_tpu.serve import MetricsService
+
+    rng = np.random.RandomState(23)
+
+    # (1) window read cost vs window size: O(1) merges, must flat-line
+    for wsize in (8, 64, 1024):
+        w = SlidingWindow(SumMetric(), window=wsize)
+        for _ in range(8):
+            w.update(jnp.asarray([1.0, 2.0]))
+        jax.block_until_ready(w.compute())  # warm: heal the prefix once
+        c0 = telemetry.snapshot().get("read:window-cached", 0)
+        total = 0.0
+        for _ in range(reps):
+            w.update(jnp.asarray([0.5, 0.5]))  # tick: maintenance rides here
+            t0 = time.perf_counter()
+            jax.block_until_ready(w.compute())
+            total += time.perf_counter() - t0
+        detail[f"read_window_us_w{wsize}"] = round(total / reps * 1e6, 1)
+        detail[f"read_window_cached_reads_w{wsize}"] = (
+            telemetry.snapshot().get("read:window-cached", 0) - c0
+        )
+
+    # (2) + (3) serve memo: un-ticked reads are free, mixed reads batch
+    # only the dirty rows
+    C, B = 8, 16
+    svc = MetricsService(Accuracy(task="multiclass", num_classes=C))
+    names = [f"t{i:04d}" for i in range(sessions)]
+    batch = (jnp.asarray(rng.randint(0, C, B)), jnp.asarray(rng.randint(0, C, B)))
+    for n in names:
+        svc.submit(n, *batch)
+    jax.block_until_ready(list(svc.compute_all().values()))  # warm + memoize
+    with profiling.track_dispatches() as t:
+        jax.block_until_ready(list(svc.compute_all().values()))
+    detail["read_second_unticked_launches"] = t.dispatch_count()
+    detail["read_second_unticked_retraces"] = t.retrace_count()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        svc.compute_all()
+    detail["read_all_memoized_us"] = round((time.perf_counter() - t0) / reps * 1e6, 1)
+    dirty = max(1, sessions // 8)
+    total = 0.0
+    h0 = svc.stats["read_memo_hits"]
+    m0 = svc.stats["read_memo_misses"]
+    for _ in range(reps):
+        for n in names[:dirty]:
+            svc.submit(n, *batch)
+        t0 = time.perf_counter()
+        jax.block_until_ready(list(svc.compute_all().values()))
+        total += time.perf_counter() - t0
+    detail[f"read_all_us_{sessions}_sessions_{dirty}_dirty"] = round(
+        total / reps * 1e6, 1
+    )
+    hits = svc.stats["read_memo_hits"] - h0
+    misses = svc.stats["read_memo_misses"] - m0
+    detail["read_memo_hit_rate_mixed"] = round(hits / max(hits + misses, 1), 4)
+
+    # (4) packed fleet read: one collective per fleet-wide compute_all
+    fab = ShardedMetricsService(
+        Accuracy(task="multiclass", num_classes=C), num_shards=2
+    )
+    for n in names:
+        fab.update(n, *batch)
+    jax.block_until_ready(list(fab.compute_all().values()))  # warm the program
+    for n in names:
+        fab.update(n, *batch)  # dirty every row again
+    c0 = fab.stats["fleet_read_collectives"]
+    t0 = time.perf_counter()
+    jax.block_until_ready(list(fab.compute_all().values()))
+    detail["read_fleet_us_2shards"] = round((time.perf_counter() - t0) * 1e6, 1)
+    detail["fleet_read_collectives"] = fab.stats["fleet_read_collectives"] - c0
+    fab.shutdown()
+
+
 def _cfg_compute_group_detection(detail: dict, reps: int = 5) -> None:
     """First-update cost of auto compute-group detection (VERDICT r3 #7).
 
@@ -1635,6 +1730,7 @@ def _bench_detail() -> dict:
         ("window_advance_us", _cfg_streaming),
         ("request_tracing_idle_overhead_ratio", _cfg_request_tracing),
         ("fabric_updates_per_sec", _cfg_fabric),
+        ("read_path_second_read_launches", _cfg_read_path),
     ]
     detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
     return detail
@@ -1857,6 +1953,7 @@ def _bench_detail_fast() -> dict:
         ("crash_recovery", lambda d: _cfg_crash_recovery(d, sessions=32, steps=2, tail=200)),
         ("request_tracing", lambda d: _cfg_request_tracing(d, sessions=32, reps=2, loops=3)),
         ("fabric", lambda d: _cfg_fabric(d, sessions=32, events=300, shards=2)),
+        ("read_path", lambda d: _cfg_read_path(d, sessions=16, reps=5)),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),

@@ -88,8 +88,13 @@ from bisect import bisect_right
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
-from metrics_tpu import faults, resilience, telemetry, wal
-from metrics_tpu.serve import MetricsService, ValueTicket
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import faults, resilience, sync_engine, telemetry, wal
+from metrics_tpu.serve import _MIN_SESSION_BUCKET, MetricsService, ValueTicket
+from metrics_tpu.utilities.data import bucket_pow2
 
 __all__ = [
     "HashRing",
@@ -320,7 +325,9 @@ class ShardedMetricsService:
         self._tenant_cfg: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         self.stats: Dict[str, int] = {"failovers": 0, "dead_routes": 0,
-                                      "handoffs": 0, "moved_sessions": 0}
+                                      "handoffs": 0, "moved_sessions": 0,
+                                      "fleet_reads": 0,
+                                      "fleet_read_collectives": 0}
         self.failover_events: List[Dict[str, Any]] = []
 
         # hot-standby replication (see module docstring)
@@ -340,6 +347,9 @@ class ShardedMetricsService:
         self._retired_slo: Dict[int, Any] = {}
         # bounded pool for fleet-wide reads (created lazily)
         self._pool: Optional[ThreadPoolExecutor] = None
+        # packed fleet-read programs, keyed (kind, n shards, session bucket)
+        # — jit under the key handles per-shard capacity shape changes
+        self._fleet_programs: Dict[Tuple, Any] = {}
 
         self._shards: List[_Shard] = []
         for k in range(self.num_shards):
@@ -502,9 +512,11 @@ class ShardedMetricsService:
         disjoint (per-shard flush locks guard each service), so the only
         ordering requirement is the healed shard list computed first. One
         shard degenerates to a plain call; the pool is created lazily and
-        bounded at 8 so a wide fleet cannot fork-bomb the host. (The
-        packed-collective read — one device launch for the whole fleet —
-        stays on the roadmap; this is the cheap, exact half.)"""
+        bounded at 8 so a wide fleet cannot fork-bomb the host. Host-side
+        snapshot aggregation (and the packed read's degrade path) ride
+        this pool; value reads themselves go through the packed-collective
+        program (:meth:`compute_all` / :meth:`rollup`) — one device launch
+        for the whole fleet."""
         shards = list(shards)
         if len(shards) <= 1:
             return [fn(s) for s in shards]
@@ -528,17 +540,154 @@ class ShardedMetricsService:
     def compute(self, name: str) -> Any:
         return self._route(name).service.compute(name)
 
+    def _fleet_program(self, kind: str, n: int, m: int, builder) -> Any:
+        key = (kind, n, m)
+        program = self._fleet_programs.get(key)
+        if program is None:
+            program = jax.jit(builder())
+            self._fleet_programs[key] = program
+        return program
+
     def compute_all(self) -> Dict[str, Any]:
         """Every open session fleet-wide (partitions are disjoint, so the
         union is exact). Dead shards are failed over first — a fleet read
-        never silently omits a partition — then shards evaluate
-        concurrently on the read pool."""
+        never silently omits a partition. Memo-clean sessions are served
+        host-side from each shard's read memo; the DIRTY rows of every
+        shard ride ONE packed-gather program (`sync_engine.build_fleet_read`)
+        — one device launch and exactly one packed gather per fleet read,
+        instead of N per-shard reads. Falls back to the bounded-pool
+        per-shard fan-out if the template's compute does not vmap."""
+        shards = self._serving_shards()
+        self._fan_out(lambda s: s.service.flush(), shards)
+        self.stats["fleet_reads"] += 1
+        t0 = telemetry.clock()
+        plans = []  # (shard, names_sorted, memoized, dirty)
+        for s in shards:
+            names_sorted, memoized, dirty = s.service._read_plan()
+            if memoized:
+                s.service._check_read_epoch()
+            s.service.stats["read_memo_hits"] += len(memoized)
+            s.service.stats["read_memo_misses"] += len(dirty)
+            plans.append((s, names_sorted, memoized, dirty))
         out: Dict[str, Any] = {}
-        for part in self._fan_out(
-            lambda s: s.service.compute_all(), self._serving_shards()
-        ):
-            out.update(part)
-        return out
+        for _s, _names, memoized, _dirty in plans:
+            out.update(memoized)
+        dirty_plans = [(s, dirty) for s, _n, _m, dirty in plans if dirty]
+        n_memo = len(out)
+        if not dirty_plans:
+            telemetry.emit(
+                "read", self.label, "fleet", t0=t0, stream="serve",
+                shards=len(shards), dirty=0, memoized=n_memo, collectives=0,
+            )
+            return out
+        try:
+            n = len(dirty_plans)
+            m = bucket_pow2(
+                max(len(dirty) for _s, dirty in dirty_plans),
+                minimum=_MIN_SESSION_BUCKET,
+            )
+            template = dirty_plans[0][0].service.template
+            leaf_names = dirty_plans[0][0].service._names
+            program = self._fleet_program(
+                "read", n, m,
+                lambda: sync_engine.build_fleet_read(template, leaf_names, n, m),
+            )
+            shard_leaves = []
+            shard_idx = []
+            for s, dirty in dirty_plans:
+                svc = s.service
+                idx = np.full((m,), svc._capacity, dtype=np.int32)  # OOB pad: clamps
+                for i, (_name, row, _ver) in enumerate(dirty):
+                    idx[i] = row
+                shard_leaves.append(tuple(svc._stacked[k] for k in svc._names))
+                shard_idx.append(jnp.asarray(idx))
+            c0 = telemetry.clock()
+            vals = program(tuple(shard_leaves), tuple(shard_idx))
+            self.stats["fleet_read_collectives"] += 1
+            nbytes = sum(
+                spec[3] * n * m
+                for spec in sync_engine._leaf_wire_specs(template, leaf_names)
+            )
+            telemetry.emit(
+                "collective", self.label, "packed-read", t0=c0,
+                nbytes=nbytes, nleaves=len(leaf_names), shards=n,
+            )
+            n_dirty = 0
+            for si, (s, dirty) in enumerate(dirty_plans):
+                svc = s.service
+                chaos = faults.any_active()
+                for i, (name, _row, ver) in enumerate(dirty):
+                    val = jax.tree_util.tree_map(
+                        lambda v, _r=si * m + i: v[_r], vals
+                    )
+                    out[name] = val
+                    if not chaos:
+                        svc._memo[name] = (ver, svc.epoch, val)
+                n_dirty += len(dirty)
+            telemetry.emit(
+                "read", self.label, "fleet", t0=t0, stream="serve",
+                shards=len(shards), dirty=n_dirty, memoized=n_memo,
+                collectives=1,
+            )
+            return out
+        except Exception as err:  # noqa: BLE001 - e.g. value-dependent compute
+            resilience.record_degrade(self.label, "fleet-read", err)
+            out = {}
+            for part in self._fan_out(
+                lambda s: s.service.compute_all(), shards
+            ):
+                out.update(part)
+            return out
+
+    def rollup(self, names: Optional[List[str]] = None) -> Any:
+        """The fleet-wide merged value — every (or just the named) open
+        session's state merged via the template's ``pure_merge`` algebra,
+        then computed ONCE: cross-shard aggregation (fleet-wide macro
+        averages, tenant rollups spanning shards) as a single launch with
+        exactly one packed gather (`sync_engine.build_fleet_rollup`).
+        Padded/absent lanes contribute exactly nothing (same masked-fold
+        step the window read cache uses), so the result is bit-identical
+        to a host-side left fold over the same rows in packed order."""
+        shards = self._serving_shards()
+        self._fan_out(lambda s: s.service.flush(), shards)
+        self.stats["fleet_reads"] += 1
+        t0 = telemetry.clock()
+        want = None if names is None else set(names)
+        per_shard_rows: List[List[int]] = []
+        for s in shards:
+            svc = s.service
+            per_shard_rows.append([
+                svc._rows[n] for n in sorted(svc._rows)
+                if want is None or n in want
+            ])
+        n = len(shards)
+        m = bucket_pow2(
+            max((len(r) for r in per_shard_rows), default=1),
+            minimum=_MIN_SESSION_BUCKET,
+        )
+        template = shards[0].service.template
+        leaf_names = shards[0].service._names
+        program = self._fleet_program(
+            "rollup", n, m,
+            lambda: sync_engine.build_fleet_rollup(template, leaf_names, n, m),
+        )
+        shard_leaves = []
+        shard_idx = []
+        valid = np.zeros((n * m,), dtype=bool)
+        for si, (s, rows) in enumerate(zip(shards, per_shard_rows)):
+            svc = s.service
+            idx = np.full((m,), svc._capacity, dtype=np.int32)
+            idx[: len(rows)] = rows
+            valid[si * m : si * m + len(rows)] = True
+            shard_leaves.append(tuple(svc._stacked[k] for k in svc._names))
+            shard_idx.append(jnp.asarray(idx))
+        val = program(tuple(shard_leaves), tuple(shard_idx), jnp.asarray(valid))
+        self.stats["fleet_read_collectives"] += 1
+        telemetry.emit(
+            "read", self.label, "rollup", t0=t0, stream="serve",
+            shards=n, sessions=int(valid.sum()), collectives=1,
+        )
+        return val
 
     def checkpoint(self) -> List[str]:
         return [s.service.checkpoint() for s in self._serving_shards()]
@@ -1176,6 +1325,12 @@ class ShardedMetricsService:
             "num_shards": self.num_shards,
             "shards": per_shard,
             "serve_totals": totals,
+            "reads": {
+                "fleet_reads": self.stats["fleet_reads"],
+                "fleet_read_collectives": self.stats["fleet_read_collectives"],
+                "memo_hits": totals.get("read_memo_hits", 0),
+                "memo_misses": totals.get("read_memo_misses", 0),
+            },
             "resilience": resilience.aggregate_policy_stats(
                 snap["resilience"] for snap in per_shard.values()
             ),

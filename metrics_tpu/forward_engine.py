@@ -200,4 +200,5 @@ def metric_forward(metric: Any, args: Tuple, kwargs: Dict) -> Any:
 
     metric._update_count += 1
     metric._computed = None
+    metric._bump_version()
     return batch_val

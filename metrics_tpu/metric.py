@@ -276,6 +276,11 @@ class Metric(ABC):
         self._computed: Any = None
         self._forward_cache: Any = None
         self._update_count = 0
+        # monotonic state version: bumped on every mutation edge (update,
+        # forward's state merge, sync, reset, dtype cast, checkpoint load) so
+        # read-side memo layers (serve rows, window caches) can tell "nothing
+        # changed since I last computed" without inspecting the state leaves
+        self._version = 0
         self._to_sync = True
         self._should_unsync = True
 
@@ -349,6 +354,21 @@ class Metric(ABC):
     def _load_state(self, state: Dict[str, StateType]) -> None:
         for k, v in state.items():
             object.__setattr__(self, k, list(v) if isinstance(v, (list, tuple)) else v)
+
+    @property
+    def state_version(self) -> int:
+        """Monotonic counter of state mutations. Two reads of an equal
+        ``state_version`` are guaranteed to see identical state, so a
+        memoized compute result tagged with the version it was computed at
+        can be served without touching the engine. The converse is NOT
+        guaranteed (a bump does not imply the leaves actually differ) —
+        memo layers may only over-invalidate, never under-invalidate."""
+        return self._version
+
+    def _bump_version(self) -> None:
+        """Record a state mutation (every edge that can change what
+        ``compute()`` would return must pass through here)."""
+        self._version += 1
 
     def _copy_state(self) -> Dict[str, StateType]:
         return {k: list(v) if isinstance(v, list) else v for k, v in ((k, getattr(self, k)) for k in self._defaults)}
@@ -545,6 +565,7 @@ class Metric(ABC):
         self._should_unsync = True
         self._to_sync = True
         self._computed = None
+        self._bump_version()
         return batch_val
 
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
@@ -565,6 +586,7 @@ class Metric(ABC):
         self._should_unsync = True
         self._to_sync = True
         self._computed = None
+        self._bump_version()
         return batch_val
 
     def _reduce_states(self, incoming_state: Dict[str, StateType]) -> None:
@@ -623,6 +645,7 @@ class Metric(ABC):
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
+            self._bump_version()
             # named scope surfaces per-metric regions in jax profiler traces
             # (the SURVEY §5.1 observability analogue of the reference's
             # one-line construction telemetry, metric.py:85)
@@ -1229,6 +1252,7 @@ class Metric(ABC):
         with telemetry.span("sync", type(self).__name__, "metric"):
             self._sync_dist(dist_sync_fn, env=env)
         self._is_synced = True
+        self._bump_version()
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore the pre-sync local state (ref metric.py:325-345)."""
@@ -1241,6 +1265,7 @@ class Metric(ABC):
         self._load_state(self._cache)
         self._is_synced = False
         self._cache = None
+        self._bump_version()
 
     @contextmanager
     def sync_context(
@@ -1305,6 +1330,7 @@ class Metric(ABC):
         self._update_count = 0
         self._forward_cache = None
         self._computed = None
+        self._bump_version()
         for attr, default in self.default_state().items():
             object.__setattr__(self, attr, default)
         # reset internal sync state
@@ -1362,6 +1388,8 @@ class Metric(ABC):
             self.__dict__.get("_forward_stats") or {"launches": 0, "retraces": 0, "engine_us": 0.0}
         )
         self._forward_resilience = self.__dict__.get("_forward_resilience") or resilience.ResiliencePolicy()
+        if "_version" not in self.__dict__:
+            self._version = 0
 
     def __setattr__(self, name: str, value: Any) -> None:
         if name in ("higher_is_better", "is_differentiable", "full_state_update"):
@@ -1448,6 +1476,8 @@ class Metric(ABC):
                 self._defaults[attr] = _cast(default)
         for _, child in self._children():
             child.set_dtype(dst_type)
+        self._computed = None
+        self._bump_version()
         return self
 
     # ------------------------------------------------------------- children
@@ -1527,6 +1557,8 @@ class Metric(ABC):
             key = f"{prefix}aux:{name}"
             if key in state_dict:
                 setattr(self, name, state_dict[key])
+        self._computed = None
+        self._bump_version()
         for name, child in self._children():
             child.load_state_dict(state_dict, prefix=f"{prefix}{name}.", strict=strict)
 

@@ -440,6 +440,15 @@ class MetricsService:
         }
         self._rows: Dict[str, int] = {}
         self._free: List[int] = list(range(self._capacity - 1, -1, -1))
+        # read-path memoization: a monotonic version per stacked row (bumped
+        # by every write-back — stacked launch, eager fallback, close/reset,
+        # import, replayed records riding the normal flush) and a per-session
+        # memo of the last computed value, keyed (row_version, epoch). An
+        # un-ticked session serves the memo with zero engine launches; an
+        # epoch bump (fail-over / hand-off fence) invalidates every entry by
+        # key mismatch, so a superseded owner can never serve a stale value.
+        self._row_version: List[int] = [0] * self._capacity
+        self._memo: Dict[str, Tuple[int, int, Any]] = {}
 
         # the submit queue holds _Request flight records. The condition
         # doubles as the queue lock; flush() notifies blocked submitters
@@ -495,6 +504,8 @@ class MetricsService:
             "breaker_rejected": 0,
             "failed_requests": 0,
             "replayed_records": 0,
+            "read_memo_hits": 0,
+            "read_memo_misses": 0,
         }
 
         self.flush_interval_s = flush_interval_s
@@ -569,6 +580,8 @@ class MetricsService:
             self._wal.append(wal.CLOSE, name)
         for k in self._names:
             self._stacked[k] = self._stacked[k].at[row].set(self._default_rows[k])
+        self._row_version[row] += 1
+        self._memo.pop(name, None)
         self._free.append(row)
 
     def reset_session(self, name: str) -> None:
@@ -585,6 +598,8 @@ class MetricsService:
         self._breakers.pop(name, None)
         for k in self._names:
             self._stacked[k] = self._stacked[k].at[row].set(self._default_rows[k])
+        self._row_version[row] += 1
+        self._memo.pop(name, None)
 
     def _grow(self) -> None:
         old = self._capacity
@@ -595,6 +610,7 @@ class MetricsService:
             )
             self._stacked[k] = jnp.concatenate([self._stacked[k], pad], axis=0)
         self._free.extend(range(self._capacity - 1, old - 1, -1))
+        self._row_version.extend([0] * old)
         # capacity is part of every executable signature; a growth step
         # retires the old programs
         self._exec_cache.clear()
@@ -1087,6 +1103,14 @@ class MetricsService:
             out = faults.maybe_corrupt_leaves(out)
             for k, leaf in zip(self._names, out):
                 self._stacked[k] = leaf
+            if faults.any_active():
+                # a corruption fault may have rewritten ANY row — every memo
+                # tag is suspect, so invalidate the whole table
+                for r in range(self._capacity):
+                    self._row_version[r] += 1
+            else:
+                for r in idx[:s_real]:
+                    self._row_version[int(r)] += 1
             if vals is not None:
                 # stage each request's batch value (lane i of the stacked
                 # value outputs); the ticket resolves at retirement
@@ -1214,6 +1238,7 @@ class MetricsService:
             new = self.template.pure_update(state, *args, **dynamic, **static)
             for k in self._names:
                 self._stacked[k] = self._stacked[k].at[row].set(new[k])
+            self._row_version[row] += 1
             if req.ticket is not None:
                 req.value = self.template.pure_compute(
                     self.template.pure_update(
@@ -1390,12 +1415,47 @@ class MetricsService:
         }
 
     # -------------------------------------------------------------- results
-    def compute(self, name: str) -> Any:
-        """Flush pending work, then evaluate one session's metric value."""
-        self.flush()
+    def _check_read_epoch(self) -> None:
+        """Zombie fence for memoized reads — parity with the write path: a
+        shard that lost its partition must not serve cached values for
+        sessions a peer now owns. Raises :class:`~metrics_tpu.wal.StaleEpochError`
+        when the journal directory has been fenced at a higher epoch."""
+        if self._wal is not None:
+            self._wal.check_epoch()
+
+    def _memo_get(self, name: str, row: int) -> Tuple[int, Optional[Any]]:
+        """(current row version, memoized value or None). The memo only
+        serves when its (version, epoch) tag matches exactly and no fault
+        class is armed — chaos drills must always exercise the real path."""
+        ver = self._row_version[row]
+        memo = self._memo.get(name)
+        if (
+            memo is not None
+            and memo[0] == ver
+            and memo[1] == self.epoch
+            and not faults.any_active()
+        ):
+            return ver, memo[2]
+        return ver, None
+
+    def compute(self, name: str, *, _flushed: bool = False) -> Any:
+        """Flush pending work, then evaluate one session's metric value.
+
+        An un-ticked session (row version unchanged since the last read at
+        this epoch) serves the memoized value with zero engine launches.
+        ``_flushed=True`` is the internal fast path for callers that have
+        already drained the queue (the ``compute_all`` degrade loop)."""
+        if not _flushed:
+            self.flush()
         row = self._rows.get(name)
         if row is None:
             raise KeyError(f"unknown session {name!r}")
+        ver, hit = self._memo_get(name, row)
+        if hit is not None:
+            self._check_read_epoch()
+            self.stats["read_memo_hits"] += 1
+            telemetry.emit("read", self.label, "memo-hit", stream="serve", sessions=1)
+            return hit
         if self._compute_one is None:
             template, names = self.template, self._names
 
@@ -1403,18 +1463,56 @@ class MetricsService:
                 return template.pure_compute({k: leaf[idx] for k, leaf in zip(names, leaves)})
 
             self._compute_one = jax.jit(compute_one)
-        return self._compute_one(
+        value = self._compute_one(
             tuple(self._stacked[k] for k in self._names), jnp.asarray(row, jnp.int32)
         )
+        self.stats["read_memo_misses"] += 1
+        telemetry.emit("read", self.label, "memo-miss", stream="serve", sessions=1)
+        if not faults.any_active():
+            self._memo[name] = (ver, self.epoch, value)
+        return value
+
+    def _read_plan(self) -> Tuple[List[str], Dict[str, Any], List[Tuple[str, int, int]]]:
+        """Partition the open sessions into memo-served and dirty.
+
+        Returns ``(names_sorted, memoized, dirty)`` where ``dirty`` rows
+        carry their plan-time version — the tag a freshly computed value is
+        memoized under, so a write landing mid-read can only cause a miss
+        on the next read, never a stale hit."""
+        names_sorted = sorted(self._rows)
+        memoized: Dict[str, Any] = {}
+        dirty: List[Tuple[str, int, int]] = []
+        for n in names_sorted:
+            row = self._rows[n]
+            ver, hit = self._memo_get(n, row)
+            if hit is not None:
+                memoized[n] = hit
+            else:
+                dirty.append((n, row, ver))
+        return names_sorted, memoized, dirty
 
     def compute_all(self) -> Dict[str, Any]:
-        """Flush, then evaluate EVERY open session in one vmapped program
-        (per-session fallback if the compute does not vmap)."""
+        """Flush, then evaluate every open session: memo-clean sessions are
+        served host-side, only the DIRTY rows ride the vmapped program (one
+        launch, index vector padded to a pow2 bucket so the dirty count
+        never retraces). Per-session fallback if the compute does not vmap
+        — flushed ONCE up front, not once per session."""
         self.flush()
         if not self._rows:
             return {}
-        names_sorted = sorted(self._rows)
-        idx = jnp.asarray([self._rows[n] for n in names_sorted], jnp.int32)
+        t0 = telemetry.clock()
+        names_sorted, memoized, dirty = self._read_plan()
+        if memoized:
+            self._check_read_epoch()
+        self.stats["read_memo_hits"] += len(memoized)
+        self.stats["read_memo_misses"] += len(dirty)
+        if not dirty:
+            telemetry.emit(
+                "read", self.label, "memo-hit", t0=t0, stream="serve",
+                sessions=len(names_sorted), dirty=0, memoized=len(memoized),
+            )
+            return {n: memoized[n] for n in names_sorted}
+        chaos = faults.any_active()
         try:
             if self._compute_stack is None:
                 template, names = self.template, self._names
@@ -1427,16 +1525,36 @@ class MetricsService:
                     )(idx)
 
                 self._compute_stack = jax.jit(compute_rows)
+            # pad to a pow2 bucket with an OOB index (gather clamps; the
+            # padded lanes are dropped host-side) so the executable is
+            # shared across dirty counts instead of retracing per read
+            m = bucket_pow2(len(dirty), minimum=_MIN_SESSION_BUCKET)
+            idx = np.full((m,), self._capacity, dtype=np.int32)
+            for i, (_, row, _) in enumerate(dirty):
+                idx[i] = row
             stacked_vals = self._compute_stack(
-                tuple(self._stacked[k] for k in self._names), idx
+                tuple(self._stacked[k] for k in self._names), jnp.asarray(idx)
             )
-            return {
-                n: jax.tree_util.tree_map(lambda v: v[i], stacked_vals)
-                for i, n in enumerate(names_sorted)
-            }
+            out = dict(memoized)
+            for i, (n, _row, ver) in enumerate(dirty):
+                val = jax.tree_util.tree_map(lambda v, _i=i: v[_i], stacked_vals)
+                out[n] = val
+                if not chaos:
+                    self._memo[n] = (ver, self.epoch, val)
+            telemetry.emit(
+                "read", self.label, "batch", t0=t0, stream="serve",
+                sessions=len(names_sorted), dirty=len(dirty),
+                memoized=len(memoized),
+            )
+            return {n: out[n] for n in names_sorted}
         except Exception as err:  # noqa: BLE001 - e.g. value-dependent compute
             resilience.record_degrade(self.label, "compute", err)
-            return {n: self.compute(n) for n in names_sorted}
+            # the queue was drained above — the per-session loop must not
+            # pay a redundant flush cycle per session
+            out = dict(memoized)
+            for n, _row, _ver in dirty:
+                out[n] = self.compute(n, _flushed=True)
+            return {n: out[n] for n in names_sorted}
 
     def compute_window(self, name: Optional[str] = None) -> Any:
         """Windowed read of a streaming-wrapper service.
@@ -1630,6 +1748,9 @@ class MetricsService:
         self._exec_cache.clear()
         self._compute_stack = None
         self._compute_one = None
+        # installed state is brand new — every memo tag predates it
+        self._row_version = [0] * self._capacity
+        self._memo.clear()
         fence = int(meta.get("journal_seq", 0))
         if self._wal is not None:
             # a journal whose segments were all truncated must never
@@ -1809,6 +1930,8 @@ class MetricsService:
                     self._stacked[k] = (
                         self._stacked[k].at[row].set(jnp.asarray(leaves[k]))
                     )
+                self._row_version[row] += 1
+                self._memo.pop(name, None)
             return len(payload["rows"])
 
     def mirror_state(self, src: "MetricsService") -> None:
@@ -1833,6 +1956,8 @@ class MetricsService:
             self._exec_cache.clear()
             self._compute_stack = None
             self._compute_one = None
+            self._row_version = [0] * self._capacity
+            self._memo.clear()
 
     def state_digest(self, names: Optional[List[str]] = None) -> str:
         """sha1 over the stacked rows of the named (default: every open)

@@ -13,6 +13,10 @@ serving) and never retrace as the window slides:
   ``compute()`` merges the buckets oldest-first through the inner
   metric's :meth:`~metrics_tpu.metric.Metric.pure_merge`, so the value
   covers the most recent ``window`` updates (to ``slide`` granularity).
+  Reads are **O(1)**: a cached prefix fold over the frozen buckets
+  (``pfx_*`` leaves, rebuilt when the cursor advances — the maintenance
+  rides the tick) means ``compute()`` is one guarded ``pure_merge`` of
+  the prefix with the live bucket, bit-identical to the full left fold.
 * :class:`TumblingWindow` — non-overlapping windows of exactly
   ``window`` updates: a *current* accumulator and a *done* snapshot,
   swapped by a traced predicate when the window fills.
@@ -98,6 +102,14 @@ def _check_inner(metric: Any, wrapper: str, allow_max_min: bool = True) -> None:
                 )
 
 
+def _poison_token(stacked: Any) -> Any:
+    """Reduction for ``pfx_token``: any cross-replica/state merge poisons
+    the token to ``-1`` (merged prefixes are meaningless), failing the
+    validity handshake so the next read rebuilds the prefix cache.
+    Module-level (not a lambda) so the wrapper stays picklable."""
+    return stacked[0] * 0 - 1
+
+
 def _emit_concrete(probe: Any, name: str, owner: str, kind: str, **attrs: Any) -> None:
     """Emit only on the eager path: under jit/vmap the Python body runs
     once at trace time, where ``probe`` is a Tracer — a span there would
@@ -155,6 +167,26 @@ class SlidingWindow(_StreamingWindow):
     ``slide``-granular: between advances the value covers between
     ``window - slide + 1`` and ``window`` updates.
 
+    **The read path is O(1).** fp addition is not associative, so the
+    classic two-stacks/SWAG re-association would break the bit-identical
+    contract; instead the wrapper caches the *left fold itself*: the
+    ``pfx_*`` leaves hold the oracle fold over the ``n - 1`` frozen
+    buckets (oldest-first), ``pfx_seen`` the live-bucket count it
+    absorbed, and ``pfx_token``/``advances`` form the validity handshake.
+    Between advances the frozen set never changes, so every read is ONE
+    guarded ``pure_merge`` of the prefix with the current bucket — the
+    exact last step of the oracle fold, hence bit-identical. An advance
+    refolds the prefix (O(n), amortized over the ``slide`` ticks that
+    share it) *inside the tick*; reads never pay it. The cache is plain
+    fixed-shape state, so it rides checkpoints, hand-offs and the stacked
+    serving rows unchanged, and a cross-replica merge invalidates it
+    through ``pfx_token``'s reduction (any merge poisons the token to
+    ``-1``; the next read or advance rebuilds). On traced reads the two
+    branches sit under ``lax.cond`` — O(1) at runtime under plain jit;
+    under ``vmap`` (stacked serving) cond lowers to select and both
+    branches execute, which is why the serving layer memoizes whole rows
+    above this (see docs/serving.md).
+
     Args:
         metric: inner metric; fixed-shape array states only.
         window: horizon in updates. Must be a positive multiple of ``slide``.
@@ -199,6 +231,25 @@ class SlidingWindow(_StreamingWindow):
         self.add_state(
             "counts", jnp.zeros((self.num_buckets,), jnp.int32), dist_reduce_fx="sum"
         )
+        # monoid read cache: the oracle left fold over the n-1 FROZEN
+        # buckets (everything but the current cursor bucket), so a read is
+        # one pure_merge instead of an O(n) refold. A fresh/reset state is
+        # born valid: zero frozen buckets fold to the default seed.
+        for k, d in self._inner_defaults.items():
+            self.add_state(
+                f"pfx_{k}", jnp.zeros_like(d) + d, dist_reduce_fx=metric._reductions[k]
+            )
+        self.add_state("pfx_seen", jnp.asarray(0, jnp.int32), dist_reduce_fx="max")
+        self.add_state("advances", jnp.asarray(0, jnp.int32), dist_reduce_fx="max")
+        # validity handshake: token == advances means the pfx_* leaves are
+        # the fold of the current frozen set. The custom reduction poisons
+        # the token on ANY cross-replica/state merge (merged prefixes are
+        # meaningless), forcing the next read or advance to rebuild.
+        self.add_state(
+            "pfx_token",
+            jnp.asarray(0, jnp.int32),
+            dist_reduce_fx=_poison_token,
+        )
 
     # ------------------------------------------------------------- advance
     def _advance(self, gate: Array) -> Tuple[Array, Array]:
@@ -215,7 +266,77 @@ class SlidingWindow(_StreamingWindow):
         self.counts = counts
         self.cursor = cursor
         self.in_bucket = jnp.where(adv, 0, self.in_bucket)
+        self._maintain_prefix(adv)
         return adv, cursor
+
+    # ------------------------------------------------------------ read cache
+    def _fold_step(self, carry: Tuple, xs: Tuple) -> Tuple[Tuple, None]:
+        """One oracle fold step: merge a bucket iff it holds updates, with
+        ``count`` = #nonempty buckets folded so far (the running-mean merge
+        law then weighs each bucket equally, and count=1 on the first live
+        bucket drops the fold's default-state seed exactly)."""
+        acc, seen = carry
+        bucket, c = xs
+        nonempty = c > 0
+        seen_new = seen + nonempty.astype(jnp.int32)
+        merged = self._inner.pure_merge(
+            acc, bucket, count=jnp.maximum(seen_new, 1).astype(jnp.float32)
+        )
+        acc = {k: jnp.where(nonempty, merged[k], acc[k]) for k in acc}
+        return (acc, seen_new), None
+
+    def _fold_positions(self, order: Array) -> Tuple[Dict[str, Array], Array]:
+        """Oracle left fold over the given ring positions, oldest-first."""
+        buckets = {k: getattr(self, f"ring_{k}")[order] for k in self._inner_names}
+        counts = self.counts[order]
+        acc0 = {k: jnp.zeros_like(d) + d for k, d in self._inner_defaults.items()}
+        (acc, seen), _ = jax.lax.scan(
+            self._fold_step, (acc0, jnp.asarray(0, jnp.int32)), (buckets, counts)
+        )
+        return acc, seen
+
+    def _prefix_fold(self) -> Tuple[Dict[str, Array], Array]:
+        """Fold of the n-1 frozen buckets — the oracle fold minus its last
+        step (the current cursor bucket)."""
+        n = self.num_buckets
+        order = (self.cursor + 1 + jnp.arange(n - 1, dtype=jnp.int32)) % n
+        return self._fold_positions(order)
+
+    def _install_prefix(self, acc: Dict[str, Array], seen: Array) -> None:
+        for k in self._inner_names:
+            object.__setattr__(self, f"pfx_{k}", acc[k])
+        self.pfx_seen = seen
+        self.pfx_token = self.advances
+
+    def _maintain_prefix(self, adv: Array) -> None:
+        """Keep the prefix cache coherent across an advance. The O(n)
+        refold rides the tick (amortized over the ``slide`` updates that
+        share the frozen set); reads stay O(1). Eager ticks skip the fold
+        entirely when the cursor did not move; traced ticks gate it under
+        ``lax.cond`` (select under vmap — both branches run there, which
+        the serving layer hides behind its row memo)."""
+        advances = self.advances + adv.astype(jnp.int32)
+        self.advances = advances
+        if not isinstance(adv, jax.core.Tracer):
+            if bool(adv):
+                acc, seen = self._prefix_fold()
+                self._install_prefix(acc, seen)
+            return
+        names = self._inner_names
+
+        def rebuilt(_):
+            acc, seen = self._prefix_fold()
+            return tuple(acc[k] for k in names), seen
+
+        def kept(_):
+            return tuple(getattr(self, f"pfx_{k}") for k in names), self.pfx_seen
+
+        pfx, seen = jax.lax.cond(adv, rebuilt, kept, None)
+        for k, leaf in zip(names, pfx):
+            object.__setattr__(self, f"pfx_{k}", leaf)
+        self.pfx_seen = seen
+        # a poisoned (-1) token stays poisoned until a refold repairs it
+        self.pfx_token = jnp.where(adv, advances, self.pfx_token)
 
     def _apply_bucket(self, cursor: Array, new_bucket: Dict[str, Array], gate: Array) -> None:
         for k in self._inner_names:
@@ -246,32 +367,54 @@ class SlidingWindow(_StreamingWindow):
         self._apply_bucket(cursor, new_bucket, gate)
 
     # -------------------------------------------------------------- compute
+    def _cached_fold(self) -> Tuple[Array, ...]:
+        """The oracle fold's LAST step, served from the prefix cache: one
+        ``pure_merge`` of the frozen-bucket prefix with the live bucket —
+        bit-identical to the full fold because it IS the full fold's final
+        step applied to the fold's own n-1-step accumulator."""
+        names = self._inner_names
+        c = self.counts[self.cursor]
+        nonempty = c > 0
+        seen_new = self.pfx_seen + nonempty.astype(jnp.int32)
+        pfx = {k: getattr(self, f"pfx_{k}") for k in names}
+        bucket = {k: getattr(self, f"ring_{k}")[self.cursor] for k in names}
+        merged = self._inner.pure_merge(
+            pfx, bucket, count=jnp.maximum(seen_new, 1).astype(jnp.float32)
+        )
+        return tuple(jnp.where(nonempty, merged[k], pfx[k]) for k in names)
+
     def compute(self) -> Any:
         n = self.num_buckets
-        order = (self.cursor + 1 + jnp.arange(n, dtype=jnp.int32)) % n
-        buckets = {k: getattr(self, f"ring_{k}")[order] for k in self._inner_names}
-        counts = self.counts[order]
-        acc0 = {k: jnp.zeros_like(d) + d for k, d in self._inner_defaults.items()}
-
-        def step(carry, xs):
-            acc, seen = carry
-            bucket, c = xs
-            nonempty = c > 0
-            seen_new = seen + nonempty.astype(jnp.int32)
-            # count = #nonempty buckets so far: the running-mean merge law
-            # then weighs each bucket equally (and count=1 on the first
-            # live bucket drops the fold's default-state seed exactly)
-            merged = self._inner.pure_merge(
-                acc, bucket, count=jnp.maximum(seen_new, 1).astype(jnp.float32)
+        valid = jnp.logical_and(self.pfx_token >= 0, self.pfx_token == self.advances)
+        if not isinstance(valid, jax.core.Tracer):
+            # eager read: O(1) merges. An invalid cache (a merge poisoned
+            # the token, or external state surgery) self-heals in place —
+            # one O(n) refold, then this and every later read is cached.
+            rebuilt = not bool(valid)
+            if rebuilt:
+                acc, seen = self._prefix_fold()
+                self._install_prefix(acc, seen)
+            leaves = self._cached_fold()
+            telemetry.emit(
+                "window", type(self).__name__, "compute",
+                buckets=n, live=int(jnp.sum(self.counts)),
             )
-            acc = {k: jnp.where(nonempty, merged[k], acc[k]) for k in acc}
-            return (acc, seen_new), None
+            telemetry.emit(
+                "read", type(self).__name__,
+                "window-rebuild" if rebuilt else "window-cached",
+                buckets=n, merges=n if rebuilt else 1,
+            )
+        else:
+            # traced read: both branches live under cond. Plain jit runs
+            # only the taken branch (O(1) when valid); vmapped stacked
+            # serving lowers to select — the serve-row memo absorbs that.
+            def full(_):
+                order = (self.cursor + 1 + jnp.arange(n, dtype=jnp.int32)) % n
+                acc, _seen = self._fold_positions(order)
+                return tuple(acc[k] for k in self._inner_names)
 
-        (acc, _), _ = jax.lax.scan(step, (acc0, jnp.asarray(0, jnp.int32)), (buckets, counts))
-        if not isinstance(counts, jax.core.Tracer):
-            telemetry.emit("window", type(self).__name__, "compute",
-                           buckets=n, live=int(jnp.sum(counts)))
-        return self._inner.pure_compute(acc)
+            leaves = jax.lax.cond(valid, lambda _: self._cached_fold(), full, None)
+        return self._inner.pure_compute(dict(zip(self._inner_names, leaves)))
 
 
 class TumblingWindow(_StreamingWindow):

@@ -233,3 +233,130 @@ def execute_buckets(
             stats["buckets"] = stats.get("buckets", 0) + 1
             stats["bytes_on_wire"] = stats.get("bytes_on_wire", 0) + nbytes
     return out
+
+
+# --------------------------------------------------------- fleet reads
+# The read-side twin of the bucketed sync path: a fleet read gathers the
+# requested session rows of EVERY shard into ONE byte-packed buffer (one
+# packed gather in the jaxpr — the collective on a real multi-host env,
+# one ``concatenate`` in the CPU emulation), then unpacks per leaf and
+# evaluates all rows under one vmap. Leaves of mixed dtypes share the
+# buffer by crossing it as raw bytes (exact — a bitcast round-trip, never
+# a value cast), the same trick DDP flat-buffer allreduce uses for mixed
+# parameter dtypes.
+
+
+def _to_wire_bytes(x: Array) -> Array:
+    """Reinterpret ``x`` as uint8 bytes (exact; adds a trailing itemsize
+    axis for multi-byte dtypes). bool crosses as one byte per element."""
+    if jnp.dtype(x.dtype) == jnp.bool_:
+        return x.astype(jnp.uint8)
+    return jax.lax.bitcast_convert_type(x, jnp.uint8)
+
+
+def _from_wire_bytes(flat: Array, shape: Tuple[int, ...], dtype: Any) -> Array:
+    """Inverse of :func:`_to_wire_bytes` from a flat uint8 segment."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.bool_:
+        return flat.reshape(shape).astype(jnp.bool_)
+    if dt.itemsize == 1:
+        return jax.lax.bitcast_convert_type(flat.reshape(shape), dt)
+    return jax.lax.bitcast_convert_type(flat.reshape(shape + (dt.itemsize,)), dt)
+
+
+def _leaf_wire_specs(template: Any, names: List[str]) -> List[Tuple[str, Tuple[int, ...], Any, int]]:
+    """(name, row shape, dtype, wire bytes per row) for every state leaf."""
+    defaults = template.default_state()
+    specs = []
+    for k in names:
+        d = jnp.asarray(defaults[k])
+        dt = jnp.dtype(d.dtype)
+        itemsize = 1 if dt == jnp.bool_ else dt.itemsize
+        specs.append((k, tuple(d.shape), dt, int(np.prod(d.shape, dtype=np.int64)) * itemsize))
+    return specs
+
+
+def build_fleet_read(template: Any, names: List[str], n_shards: int, m: int) -> Any:
+    """A jittable fleet read: gather ``m`` session rows from each of
+    ``n_shards`` stacked services, cross them as ONE packed byte buffer,
+    and evaluate every row under one vmap.
+
+    ``fleet_read(shard_leaves, shard_idx)`` takes a tuple (per shard) of
+    leaf tuples (the shards' stacked state, leaf order = ``names``) and a
+    tuple of per-shard ``(m,)`` int32 index vectors (OOB pad indices clamp
+    on gather; the caller drops padded lanes host-side). Returns the
+    vmapped ``pure_compute`` values over the ``n_shards * m`` rows, row
+    index ``shard * m + lane``. Segments are packed leaf-major then shard
+    so each leaf's region is contiguous — exactly one ``concatenate``
+    (the packed gather) appears in the jaxpr, which the bench pins."""
+    specs = _leaf_wire_specs(template, names)
+
+    def fleet_read(shard_leaves, shard_idx):
+        segs = []
+        for ki in range(len(specs)):
+            for s in range(n_shards):
+                rows = shard_leaves[s][ki][shard_idx[s]]
+                segs.append(jnp.ravel(_to_wire_bytes(rows)))
+        packed = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+        leaves_rows = []
+        off = 0
+        for _k, shape, dt, row_bytes in specs:
+            size = n_shards * m * row_bytes
+            leaves_rows.append(
+                _from_wire_bytes(packed[off : off + size], (n_shards * m,) + shape, dt)
+            )
+            off += size
+        return jax.vmap(
+            lambda *row: template.pure_compute(dict(zip(names, row)))
+        )(*leaves_rows)
+
+    return fleet_read
+
+
+def build_fleet_rollup(template: Any, names: List[str], n_shards: int, m: int) -> Any:
+    """A jittable fleet-wide rollup: same packed gather as
+    :func:`build_fleet_read`, then one masked ``pure_merge`` left fold over
+    the ``n_shards * m`` rows (identical step to the window read cache:
+    rows where ``valid`` is False contribute exactly nothing, ``count``
+    tracks nonempty rows so running-mean merges stay exact) and ONE
+    ``pure_compute`` of the merged state — the fleet-wide value in a
+    single launch. ``valid`` is a ``(n_shards * m,)`` mask in the packed
+    row order."""
+    specs = _leaf_wire_specs(template, names)
+    defaults = template.default_state()
+    acc0 = {k: jnp.zeros_like(jnp.asarray(defaults[k])) + jnp.asarray(defaults[k]) for k in names}
+
+    def fleet_rollup(shard_leaves, shard_idx, valid):
+        segs = []
+        for ki in range(len(specs)):
+            for s in range(n_shards):
+                rows = shard_leaves[s][ki][shard_idx[s]]
+                segs.append(jnp.ravel(_to_wire_bytes(rows)))
+        packed = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+        rows_by_leaf = {}
+        off = 0
+        for k, shape, dt, row_bytes in specs:
+            size = n_shards * m * row_bytes
+            rows_by_leaf[k] = _from_wire_bytes(
+                packed[off : off + size], (n_shards * m,) + shape, dt
+            )
+            off += size
+
+        def step(carry, xs):
+            acc, seen = carry
+            row, v = xs
+            seen_new = seen + v.astype(jnp.int32)
+            merged = template.pure_merge(
+                acc, row, count=jnp.maximum(seen_new, 1).astype(jnp.float32)
+            )
+            acc = {k: jnp.where(v, merged[k], acc[k]) for k in acc}
+            return (acc, seen_new), None
+
+        (acc, _seen), _ = jax.lax.scan(
+            step,
+            (acc0, jnp.asarray(0, jnp.int32)),
+            (rows_by_leaf, valid.astype(jnp.bool_)),
+        )
+        return template.pure_compute(acc)
+
+    return fleet_rollup

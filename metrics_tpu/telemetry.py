@@ -77,6 +77,17 @@ gauge      one sampled health/memory reading (:mod:`metrics_tpu.serve`):
            emitted once per flush while a subscriber is attached
 retire     one inflight-generation retirement on the serving path —
            the host-side wait for a launch wave's device results
+read       one read-path decision (the O(1) read machinery): kinds
+           ``memo-hit`` (a session/batch served entirely from the
+           version-tagged memo — zero launches, with ``sessions`` /
+           ``memoized`` attrs), ``memo-miss`` (one session recomputed),
+           ``batch`` (a ``compute_all`` that launched the vmapped
+           program for its ``dirty`` rows and memo-served the rest),
+           ``window-cached`` / ``window-rebuild`` (a
+           :class:`SlidingWindow` read served from / refolding the
+           prefix cache, with the ``merges`` paid), ``fleet`` /
+           ``rollup`` (one fabric-wide packed read, with ``shards``,
+           ``dirty``, ``memoized`` and packed ``collectives``)
 ========== ============================================================
 
 The serving admission layer reuses the ``degrade`` name for shed work:

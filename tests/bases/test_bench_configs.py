@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 27
+    assert len(skipped) == 28
     assert "detail_elapsed_s" in detail
 
 
@@ -338,6 +338,27 @@ def test_streaming_config_counts_and_keys():
     assert detail["window_advance_us"] > 0
     assert detail["sketch_sync_collectives_2replica"] == 1
     assert detail["sketch_sync_bytes_2replica"] > 0
+
+
+def test_read_path_config_counts_and_keys():
+    """Pin the O(1)-read-path bench config at test-budget scale: the
+    structural claims are 'the second read of an un-ticked session is
+    ZERO launches and ZERO retraces' (the version-tagged serve memo
+    short-circuits the engine), 'every steady-state window read takes the
+    cached-prefix path regardless of window size' (the read-µs flat-line
+    itself is recorded in BASELINE.md — timing bounds don't belong in
+    CI), and 'a sharded fleet read is exactly ONE packed collective'."""
+    detail = {}
+    bench._cfg_read_path(detail, sessions=16, reps=3)
+    assert detail["read_second_unticked_launches"] == 0
+    assert detail["read_second_unticked_retraces"] == 0
+    for wsize in (8, 64, 1024):
+        assert detail[f"read_window_cached_reads_w{wsize}"] == 3
+        assert detail[f"read_window_us_w{wsize}"] > 0
+    assert detail["read_all_memoized_us"] > 0
+    assert 0.0 < detail["read_memo_hit_rate_mixed"] < 1.0
+    assert detail["fleet_read_collectives"] == 1
+    assert detail["read_fleet_us_2shards"] > 0
 
 
 def test_cg_configs_record_host_pinning():

@@ -128,6 +128,66 @@ def test_merge_fold_equals_streamed_updates():
     )
 
 
+# ------------------------------------------- tick/read interleavings
+def _window_fold_oracle(build, tail):
+    """The left-fold truth: a fresh metric fed exactly the window tail in
+    stream order (the oracle test_sliding_sum_matches_oracle_slide1 pins
+    against the rebuild path; here it pins the CACHED prefix path)."""
+    oracle = build()
+    for u in tail:
+        oracle.update(*u)
+    return np.asarray(oracle.compute())
+
+
+def _assert_interleaving_matches_oracle(build, make_batches, seed, window=4, n_ops=14):
+    """Arbitrary tick/read interleaving: every read of a SlidingWindow —
+    whatever mix of cached-prefix reads, immediate re-reads, and
+    post-advance reads the schedule produces — must equal the left-fold
+    oracle BIT FOR BIT. Reads must also be pure: interleaving them can
+    never perturb a later read."""
+    from metrics_tpu import SlidingWindow
+
+    rng = np.random.RandomState(1000 + seed)
+    batches = make_batches(seed, n=n_ops)
+    w = SlidingWindow(build(), window=window, jit_update=False)
+    seen = []
+    for u in batches:
+        w.update(*u)
+        seen.append(u)
+        r = rng.rand()
+        if r < 0.5:
+            got = np.asarray(w.compute())
+            np.testing.assert_array_equal(got, _window_fold_oracle(build, seen[-window:]))
+            if r < 0.2:  # immediate re-read: the cached value is bit-stable
+                np.testing.assert_array_equal(np.asarray(w.compute()), got)
+    np.testing.assert_array_equal(
+        np.asarray(w.compute()), _window_fold_oracle(build, seen[-window:])
+    )
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_window_cached_read_matches_left_fold_oracle(seed):
+    """Tier-1 representative of the slow full matrix below: Accuracy
+    (integer-count states, the serving workhorse) under three random
+    tick/read schedules."""
+    _assert_interleaving_matches_oracle(
+        lambda: Accuracy(num_classes=_C, average="macro"), _batches, seed
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "build,make_batches", [c[1:] for c in _CASES], ids=[c[0] for c in _CASES]
+)
+@pytest.mark.parametrize("seed", range(5))
+def test_window_cached_read_matches_left_fold_oracle_full_matrix(
+    build, make_batches, seed
+):
+    """The full seed sweep across all five merge families — sum/max/min
+    aggregations plus the two confusion-count classification metrics."""
+    _assert_interleaving_matches_oracle(build, make_batches, seed)
+
+
 def test_merge_mean_running_formula_pinned():
     """The mean reduction is the RUNNING formula, not a symmetric average:
     ((count-1)*a + b)/count. MeanSquaredError is mean-reduced via its

@@ -1,0 +1,396 @@
+"""The O(1) read path (metric versions -> serve memo -> packed fleet read).
+
+The contract under test: memoized and cached reads are BIT-IDENTICAL to a
+fresh recompute, at every mutation edge. ``Metric.state_version`` is the
+root signal — equal versions guarantee identical state, so the serve memo
+may return a cached value; every edge that can change what ``compute()``
+returns must bump it (over-invalidation is allowed, under-invalidation is
+the bug class this file exists to catch). On top sit the structural pins:
+a second read of an un-ticked session is ZERO launches and ZERO retraces,
+``compute_all`` batches only the dirty rows, and a sharded fleet read is
+exactly ONE packed collective whose jaxpr carries exactly one
+``concatenate`` (the packed gather).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, SlidingWindow, faults, profiling, sync_engine, telemetry
+from metrics_tpu.aggregation import MeanMetric, SumMetric
+from metrics_tpu.fabric import ShardedMetricsService, StaleEpochError
+from metrics_tpu.serve import MetricsService
+from tests.bases.test_chaos import FloatSum
+
+
+def _acc():
+    return Accuracy(task="multiclass", num_classes=8)
+
+
+def _batch(seed=0, b=16, C=8):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randint(0, C, b)), jnp.asarray(rng.randint(0, C, b))
+
+
+def _bits(x):
+    return np.asarray(x).tobytes()
+
+
+# ------------------------------------------------------- metric versions
+def test_state_version_bumps_on_every_mutation_edge():
+    """Every edge that can change compute()'s answer must bump the
+    version; pure reads must not (a read-triggered bump would defeat the
+    memo entirely)."""
+    m = FloatSum()
+    v = m.state_version
+    m.update(jnp.asarray([1.0, 2.0]))
+    assert m.state_version > v
+    v = m.state_version
+    m.compute()
+    m.compute()
+    assert m.state_version == v  # reads never bump
+
+    m.forward(jnp.asarray([3.0]))
+    assert m.state_version > v
+    v = m.state_version
+    m.reset()
+    assert m.state_version > v
+    v = m.state_version
+
+    donor = FloatSum()
+    donor.update(jnp.asarray([7.0]))
+    m.load_state_dict(donor.state_dict())
+    # the load may or may not carry state (persistence flags), but the
+    # memo signal must over-invalidate: the version bumps regardless
+    assert m.state_version > v
+
+
+def test_equal_version_means_equal_value():
+    """The memo's soundness direction: between two reads at the SAME
+    version, compute() is bit-stable."""
+    m = _acc()
+    m.update(*_batch(0))
+    v0, bits0 = m.state_version, _bits(m.compute())
+    assert m.state_version == v0
+    assert _bits(m.compute()) == bits0
+
+
+# ----------------------------------------------------------- serve memo
+def test_memo_hit_is_bit_identical_and_tick_invalidates():
+    svc = MetricsService(_acc())
+    refs = {}
+    for i in range(4):
+        name = f"s{i}"
+        refs[name] = _acc()
+        svc.submit(name, *_batch(i))
+        refs[name].update(*_batch(i))
+    svc.drain()
+    first = {n: _bits(svc.compute(n)) for n in refs}
+    h0 = svc.stats["read_memo_hits"]
+    second = {n: _bits(svc.compute(n)) for n in refs}
+    assert second == first
+    assert svc.stats["read_memo_hits"] == h0 + 4
+    for n, ref in refs.items():
+        assert first[n] == _bits(ref.compute())
+
+    # a tick on ONE session invalidates exactly that memo entry
+    svc.submit("s0", *_batch(9))
+    refs["s0"].update(*_batch(9))
+    svc.drain()
+    m0 = svc.stats["read_memo_misses"]
+    assert _bits(svc.compute("s0")) == _bits(refs["s0"].compute())
+    assert svc.stats["read_memo_misses"] == m0 + 1
+    h1 = svc.stats["read_memo_hits"]
+    assert _bits(svc.compute("s1")) == first["s1"]
+    assert svc.stats["read_memo_hits"] == h1 + 1
+
+
+def test_second_read_of_unticked_sessions_is_zero_launches():
+    """THE tentpole pin: the memoized read path never touches the engine —
+    no dispatches, no retraces, no compiles."""
+    svc = MetricsService(_acc())
+    for i in range(8):
+        svc.submit(f"s{i}", *_batch(i))
+    svc.drain()
+    warm = svc.compute_all()
+    with profiling.track_dispatches() as t:
+        again = svc.compute_all()
+    assert t.dispatch_count() == 0
+    assert t.retrace_count() == 0
+    assert {n: _bits(v) for n, v in again.items()} == {
+        n: _bits(v) for n, v in warm.items()
+    }
+
+
+def test_compute_all_batches_only_dirty_rows():
+    svc = MetricsService(_acc())
+    refs = {}
+    for i in range(8):
+        name = f"s{i}"
+        refs[name] = _acc()
+        svc.submit(name, *_batch(i))
+        refs[name].update(*_batch(i))
+    svc.drain()
+    svc.compute_all()  # memoize everything
+    for name in ("s2", "s5"):
+        svc.submit(name, *_batch(40))
+        refs[name].update(*_batch(40))
+    svc.drain()
+    with telemetry.instrument() as t:
+        got = svc.compute_all()
+    spans = t.spans(name="read", kind="batch")
+    assert len(spans) == 1
+    assert spans[0].attrs["dirty"] == 2
+    assert spans[0].attrs["memoized"] == 6
+    for name, ref in refs.items():
+        assert _bits(got[name]) == _bits(ref.compute())
+
+
+def test_reset_session_invalidates_memo():
+    svc = MetricsService(FloatSum())
+    svc.update("t", jnp.asarray([5.0]))
+    svc.drain()
+    assert float(svc.compute("t")) == 5.0
+    svc.compute("t")  # memoize
+    svc.reset_session("t")
+    np.testing.assert_array_equal(
+        np.asarray(svc.compute("t")), np.asarray(0.0, np.float32)
+    )
+
+
+def test_close_then_reopen_never_serves_the_old_tenant():
+    svc = MetricsService(FloatSum())
+    svc.update("t", jnp.asarray([5.0]))
+    svc.drain()
+    svc.compute("t")  # memoize
+    svc.close_session("t")
+    svc.open_session("t")
+    np.testing.assert_array_equal(
+        np.asarray(svc.compute("t")), np.asarray(0.0, np.float32)
+    )
+
+
+def test_restore_invalidates_memo(tmp_path):
+    """Rolling back to a checkpoint must drop every memoized value — the
+    next read serves the checkpointed bits, not the pre-restore life."""
+    svc = MetricsService(FloatSum())
+    svc.update("t", jnp.asarray([1.0]))
+    svc.drain()
+    path = svc.checkpoint(str(tmp_path / "svc.npz"))
+    svc.update("t", jnp.asarray([2.0]))
+    svc.drain()
+    assert float(svc.compute("t")) == 3.0  # memoized at version v
+    svc.restore(path)
+    np.testing.assert_array_equal(
+        np.asarray(svc.compute("t")), np.asarray(1.0, np.float32)
+    )
+
+
+def test_wal_replay_reaches_reads_and_memo_is_sound(tmp_path):
+    """Crash recovery: the survivor's first read reflects checkpoint +
+    replayed journal tail, and its memo starts sound (second read is a
+    bit-identical zero-launch hit)."""
+    dirs = dict(
+        journal_dir=str(tmp_path / "wal"), checkpoint_dir=str(tmp_path / "ckpt")
+    )
+    svc = MetricsService(FloatSum(), **dirs)
+    svc.update("t", jnp.asarray([1.0]))
+    svc.drain()
+    svc.checkpoint()
+    svc.update("t", jnp.asarray([2.0]))  # journal-only tail
+    svc.drain()
+    assert float(svc.compute("t")) == 3.0
+
+    fresh = MetricsService(FloatSum(), **dirs)
+    assert fresh.recover() is True  # checkpoint + replayed tail
+    first = fresh.compute("t")
+    np.testing.assert_array_equal(np.asarray(first), np.asarray(3.0, np.float32))
+    with profiling.track_dispatches() as t:
+        again = fresh.compute("t")
+    assert t.dispatch_count() == 0 and t.retrace_count() == 0
+    assert _bits(again) == _bits(first)
+
+
+def test_import_sessions_overwrite_invalidates_memo():
+    """The hand-off edge: importing a row OVER an existing memoized
+    session must serve the imported bits on the next read."""
+    src = MetricsService(FloatSum())
+    src.update("t", jnp.asarray([10.0]))
+    src.update("t", jnp.asarray([7.0]))
+    src.drain()
+    dst = MetricsService(FloatSum())
+    dst.update("t", jnp.asarray([1.0]))
+    dst.drain()
+    dst.compute("t")  # memoize the pre-hand-off value
+    assert dst.import_sessions(src.export_sessions(["t"])) == 1
+    np.testing.assert_array_equal(
+        np.asarray(dst.compute("t")), np.asarray(17.0, np.float32)
+    )
+    assert _bits(dst.compute("t")) == _bits(src.compute("t"))
+
+
+def test_state_corruption_fault_bypasses_and_invalidates_memo():
+    """Chaos must exercise the REAL read path (a memo hit would hide the
+    corruption the drill injects), and post-chaos reads must recompute —
+    the whole memo table is suspect once a corruption fault was live."""
+    svc = MetricsService(FloatSum())
+    svc.update("t", jnp.asarray([5.0]))
+    svc.drain()
+    clean = _bits(svc.compute("t"))
+    h0 = svc.stats["read_memo_hits"]
+    with faults.inject("state-corruption"):
+        svc.update("t", jnp.asarray([1.0]))
+        svc.drain()
+        inside = _bits(svc.compute("t"))
+        inside2 = _bits(svc.compute("t"))
+    assert svc.stats["read_memo_hits"] == h0  # no hits served under chaos
+    assert inside == inside2  # bypass is still deterministic
+    # post-chaos: a fresh recompute, never the pre-chaos memo
+    after = _bits(svc.compute("t"))
+    svc._memo.clear()  # force the oracle recompute
+    assert after == _bits(svc.compute("t"))
+    assert after != clean
+
+
+# -------------------------------------------------------- window reads
+def test_window_steady_state_reads_are_cached():
+    """After the warm-up heal, every read of a ticking window takes the
+    cached-prefix path (one guarded pure_merge), never a rebuild."""
+    w = SlidingWindow(SumMetric(), window=16)
+    for i in range(8):
+        w.update(jnp.asarray([float(i)]))
+    w.compute()  # warm: heal the prefix once
+    with telemetry.instrument() as t:
+        for i in range(5):
+            w.update(jnp.asarray([1.0]))
+            w.compute()
+    assert len(t.spans(name="read", kind="window-cached")) == 5
+    assert not t.spans(name="read", kind="window-rebuild")
+
+
+def test_window_second_read_is_zero_dispatches():
+    w = SlidingWindow(SumMetric(), window=16)
+    for i in range(6):
+        w.update(jnp.asarray([2.0]))
+    first = _bits(w.compute())
+    with profiling.track_dispatches() as t:
+        again = _bits(w.compute())
+    assert t.dispatch_count() == 0 and t.retrace_count() == 0
+    assert again == first
+
+
+def test_serve_compute_window_second_read_is_zero_launches():
+    svc = MetricsService(SlidingWindow(SumMetric(), window=8))
+    for i in range(4):
+        svc.update("t", jnp.asarray([float(i)]))
+    svc.drain()
+    warm = svc.compute_window("t")
+    with profiling.track_dispatches() as t:
+        again = svc.compute_window("t")
+    assert t.dispatch_count() == 0 and t.retrace_count() == 0
+    assert _bits(again) == _bits(warm)
+
+
+# --------------------------------------------------------- fleet reads
+def test_fleet_packed_read_parity_and_one_collective():
+    fab = ShardedMetricsService(_acc(), num_shards=3)
+    refs = {}
+    for i in range(12):
+        name = f"t{i}"
+        refs[name] = _acc()
+        fab.submit(name, *_batch(i))
+        refs[name].update(*_batch(i))
+    fab.drain()
+    c0 = fab.stats["fleet_read_collectives"]
+    got = fab.compute_all()
+    assert fab.stats["fleet_read_collectives"] == c0 + 1  # ONE packed launch
+    for name, ref in refs.items():
+        assert _bits(got[name]) == _bits(ref.compute())
+    # second fleet read: fully memoized — zero collectives, zero launches
+    with profiling.track_dispatches() as t:
+        again = fab.compute_all()
+    assert fab.stats["fleet_read_collectives"] == c0 + 1
+    assert t.dispatch_count() == 0 and t.retrace_count() == 0
+    assert {n: _bits(v) for n, v in again.items()} == {
+        n: _bits(v) for n, v in got.items()
+    }
+    fab.shutdown()
+
+
+def test_fleet_read_jaxpr_has_exactly_one_packed_gather():
+    """The structural pin behind ``fleet_read_collectives == 1``: the
+    whole cross-shard gather is ONE concatenate in the jaxpr, even with
+    heterogeneous shard capacities."""
+    tmpl = SumMetric()
+    names = sorted(tmpl.default_state())
+    n_shards, m = 3, 8
+    fleet_read = sync_engine.build_fleet_read(tmpl, names, n_shards, m)
+    defaults = tmpl.default_state()
+    shard_leaves = tuple(
+        tuple(
+            jnp.zeros((cap,) + jnp.asarray(defaults[k]).shape, jnp.asarray(defaults[k]).dtype)
+            for k in names
+        )
+        for cap in (16, 16, 32)
+    )
+    shard_idx = tuple(jnp.zeros((m,), jnp.int32) for _ in range(n_shards))
+    jaxpr = str(jax.make_jaxpr(fleet_read)(shard_leaves, shard_idx))
+    assert jaxpr.count("concatenate") == 1
+
+
+def test_fleet_rollup_matches_host_fold():
+    """Fleet-wide rollup parity: the masked on-device pure_merge fold must
+    equal the host-side oracle — total sum for SumMetric, the global mean
+    for MeanMetric (running-mean merge over equal-weight rows)."""
+    rng = np.random.RandomState(3)
+    vals = {f"t{i}": rng.rand(6).astype(np.float32) for i in range(10)}
+
+    fab = ShardedMetricsService(SumMetric(), num_shards=3)
+    for name, v in vals.items():
+        fab.submit(name, jnp.asarray(v))
+    fab.drain()
+    np.testing.assert_allclose(
+        np.asarray(fab.rollup()),
+        np.sum([v.sum() for v in vals.values()], dtype=np.float32),
+        rtol=1e-6,
+    )
+    fab.shutdown()
+
+    fab = ShardedMetricsService(MeanMetric(), num_shards=3)
+    per_session = []
+    for name, v in vals.items():
+        fab.submit(name, jnp.asarray(v))
+        ref = MeanMetric()
+        ref.update(jnp.asarray(v))
+        per_session.append((name, np.asarray(ref.compute())))
+    fab.drain()
+    # running-mean merge over equal-weight rows == plain average of the
+    # per-session means (each session saw the same number of elements)
+    want = np.mean([m for _, m in sorted(per_session)], dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(fab.rollup()), want, rtol=1e-5)
+    fab.shutdown()
+
+
+def test_mid_read_failover_never_serves_stale_epoch(tmp_path):
+    """The chaos drill: after a shard fail-over, the ZOMBIE's memoized
+    values sit at a superseded epoch — serving one must raise
+    ``StaleEpochError`` (read-path parity with the write-path fence), and
+    the survivor's reads must be bit-identical to the pre-kill truth."""
+    fab = ShardedMetricsService(_acc(), num_shards=2, data_dir=str(tmp_path))
+    for i in range(6):
+        fab.submit(f"t{i}", *_batch(i))
+    fab.drain()
+    fab.checkpoint()
+    want = {n: _bits(v) for n, v in fab.compute_all().items()}  # memoized
+
+    victim = fab.shard_for("t0")
+    name = next(n for n in (f"t{i}" for i in range(6)) if fab.shard_for(n) == victim)
+    zombie = fab.kill_shard(victim)
+    assert fab.fail_over(victim) >= 0.0
+    # the zombie still holds a memo for `name` keyed by the OLD epoch
+    with pytest.raises(StaleEpochError):
+        zombie.compute(name)
+    # the fleet serves on: recomputed (new epoch != memo epoch), bit-equal
+    got = {n: _bits(v) for n, v in fab.compute_all().items()}
+    assert got == want
+    fab.shutdown()

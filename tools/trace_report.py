@@ -164,6 +164,48 @@ def summarize(events: List[Dict[str, Any]]) -> str:
                 for k in ("advance", "update", "compute", "serve-compute")
             )
         )
+    # the O(1) read path (serve memo + window prefix cache + packed fleet
+    # reads): hit rate answers "are dashboards actually free?", the
+    # dirty-row histogram shows how much of each compute_all launched, and
+    # fleet-read percentiles pin the one-collective fan-in latency
+    reads = [e for e in events if e["name"] == "read"]
+    if reads:
+        by_rkind: Dict[str, int] = {}
+        for e in reads:
+            by_rkind[e.get("kind", "?")] = by_rkind.get(e.get("kind", "?"), 0) + 1
+        hits = by_rkind.get("memo-hit", 0) + by_rkind.get("window-cached", 0)
+        misses = (
+            by_rkind.get("memo-miss", 0)
+            + by_rkind.get("batch", 0)
+            + by_rkind.get("window-rebuild", 0)
+        )
+        total = hits + misses
+        lines.append("")
+        lines.append(
+            "read path: "
+            + "   ".join(f"{k}: {n}" for k, n in sorted(by_rkind.items()))
+        )
+        if total:
+            lines.append(f"  memo hit rate: {hits}/{total} ({100.0 * hits / total:.1f}%)")
+        batches = [e for e in reads if e.get("kind") == "batch"]
+        if batches:
+            hist: Dict[int, int] = {}
+            for e in batches:
+                d = int((e.get("attrs") or {}).get("dirty", 0))
+                hist[d] = hist.get(d, 0) + 1
+            lines.append(
+                "  dirty rows per batched read: "
+                + "   ".join(f"{d}: {n}" for d, n in sorted(hist.items()))
+            )
+        fleet = sorted(
+            e.get("dur_us", 0.0) for e in reads if e.get("kind") in ("fleet", "rollup")
+        )
+        if fleet:
+            lines.append(
+                f"  fleet read   p50 {_percentile(fleet, 50):>10.1f} us"
+                f"   p95 {_percentile(fleet, 95):>10.1f} us"
+                f"   ({len(fleet)} reads)"
+            )
     sketches = [e for e in events if e["name"] == "sketch"]
     if sketches:
         by_owner: Dict[str, int] = {}
