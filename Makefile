@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load doctest audit bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load doctest audit sentinel bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -11,6 +11,17 @@ test:
 #   python tools/static_audit.py --write-baseline
 audit:
 	python tools/static_audit.py --diff
+
+# roofline-attributed perf ratchet: re-runs the bench-config schedule at
+# test-budget scale and checks structural counters (launches / retraces /
+# collectives / wire bytes), XLA cost_analysis model flops+bytes per
+# executable family, and wall-clock envelopes against the checked-in
+# PERF_BASELINE.json. STATIC_AUDIT semantics: new regressions fail, stale
+# accepted entries fail, every accepted regression carries a `why`.
+# CPU-only, ~10s. Re-accept an intentional change with:
+#   python tools/perf_sentinel.py --write-baseline
+sentinel:
+	python tools/perf_sentinel.py --diff
 
 # fast iteration lane (VERDICT r3 item 5): one representative file per
 # subsystem — base-class contract incl. real sync machinery + the
@@ -59,6 +70,7 @@ chaos:
 	$(MAKE) crash
 	$(MAKE) load
 	$(MAKE) chaos-elastic
+	$(MAKE) sentinel
 
 # kill-and-recover loop: for EVERY registered crash point a subprocess is
 # SIGKILLed at that instruction, then a fresh process recover()s

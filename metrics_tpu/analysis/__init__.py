@@ -19,13 +19,20 @@ tiny read-side the dispatcher uses to tag compile spans with
 predicted-vs-observed retrace hazards. CLI: ``tools/static_audit.py``
 (``make audit``). Docs: ``docs/static_analysis.md``.
 
+:mod:`~metrics_tpu.analysis.cost_model` is the runtime-facing sibling:
+a per-executable registry of XLA's ``cost_analysis`` /
+``memory_analysis`` numbers fed at every AOT compile seam, from which
+launch spans derive achieved GFLOP/s / GB/s and a roofline regime
+(``tools/perf_sentinel.py``, ``make sentinel``, rides it the way
+``static_audit`` rides the jaxpr front).
+
 This ``__init__`` stays import-light (lazy submodules): the hot path
 imports ``analysis.hazards`` at module load, and the heavy fronts import
 ``metrics_tpu`` itself.
 """
 import importlib
 
-_SUBMODULES = ("ast_lint", "hazards", "jaxpr_audit", "registry", "report")
+_SUBMODULES = ("ast_lint", "cost_model", "hazards", "jaxpr_audit", "registry", "report")
 
 __all__ = list(_SUBMODULES)
 

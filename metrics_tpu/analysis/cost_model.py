@@ -1,0 +1,248 @@
+"""Roofline-attributed cost model: FLOP/byte accounting per executable.
+
+Every AOT compile seam in the repo (fast dispatch update/forward
+programs, the fused-sync packed programs, the serving stack's stacked
+launches, the fabric's packed fleet reads) hands its freshly compiled
+executable to :func:`record`, which captures XLA's own accounting —
+``compiled.cost_analysis()`` (model flops, bytes accessed) and
+``compiled.memory_analysis()`` (peak temp bytes, argument/output sizes)
+— into a process-level registry keyed by a stable 12-hex ``cost_key``.
+
+Two consumers:
+
+* **Compile spans** carry the static model numbers
+  (``cost_flops`` / ``cost_bytes`` / ``cost_peak_temp_bytes`` /
+  ``cost_key``), so a trace shows what each executable *costs* the
+  moment it exists.
+* **Launch spans** call :func:`launch_attrs` with the entry and the
+  measured wall µs, and get back the derived utilization view:
+  achieved GFLOP/s, achieved GB/s, the arithmetic intensity
+  (flops/byte), and a roofline ``regime`` classification
+  (``bandwidth-bound`` / ``compute-bound``). On a device present in
+  the peak table (TPUs) the classification is **absolute** — the ridge
+  point is ``peak_gflops / peak_gbps`` for the attached device kind and
+  the attrs additionally carry ``roofline_frac`` (achieved / roofline
+  ceiling at that intensity). On CPU there is no trustworthy peak, so
+  the basis is **relative**: intensity and regime come purely from the
+  HLO numbers against a fixed nominal ridge, which keeps every
+  structural pin (model flops / bytes / intensity / regime)
+  deterministic across hosts while the timing-derived rates stay
+  advisory.
+
+The registry is what ``tools/trace_report.py``'s roofline section and
+``tools/perf_sentinel.py``'s model-cost schedule read; :func:`entries`
+returns a snapshot, :func:`reset` clears it (tests).
+
+``cost_analysis`` availability is treated as best-effort everywhere: a
+persistent-AOT-cache hit installs a plain ``jax.jit`` wrapper (no
+compiled object), older jaxlibs may lack ``memory_analysis``, and
+executables inside tracing contexts must never be poked — :func:`record`
+returns ``None`` rather than raising in every such case.
+"""
+import hashlib
+import threading
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+__all__ = [
+    "CostEntry",
+    "record",
+    "lookup",
+    "entries",
+    "reset",
+    "launch_attrs",
+    "device_peaks",
+    "classify",
+    "NOMINAL_RIDGE",
+]
+
+# Arithmetic-intensity ridge (flops/byte) used when no absolute device
+# peak is known (CPU runs): chosen at the TPU-generation ballpark
+# (~100-140 flops/byte for v4/v5) so the relative classification of the
+# bench configs matches what the same HLO would be on the hardware the
+# ROADMAP targets. Purely structural — the same HLO always classifies
+# the same way on every host.
+NOMINAL_RIDGE = 100.0
+
+# device_kind substring -> (peak GFLOP/s, peak GB/s). Nominal
+# single-chip dense f32-equivalent numbers from published specs; the
+# point is a stable denominator for roofline_frac, not benchmarketing
+# precision. Matched longest-substring-first against
+# ``jax.devices()[0].device_kind``.
+DEVICE_PEAKS: Dict[str, Tuple[float, float]] = {
+    "TPU v2": (22500.0, 700.0),
+    "TPU v3": (61000.0, 900.0),
+    "TPU v4": (137500.0, 1200.0),
+    "TPU v5 lite": (98000.0, 819.0),
+    "TPU v5e": (98000.0, 819.0),
+    "TPU v5p": (229000.0, 2765.0),
+    "TPU v6e": (459000.0, 1640.0),
+}
+
+
+class CostEntry(NamedTuple):
+    """XLA's static accounting for one compiled executable."""
+
+    owner: str
+    family: str          # update / forward / sync / serve / fleet-read / fleet-rollup
+    key_id: str          # stable 12-hex digest of (owner, family, cache key)
+    flops: float         # model flops per launch (cost_analysis)
+    bytes_accessed: float  # HBM bytes touched per launch (cost_analysis)
+    peak_temp_bytes: float  # scratch high-water mark (memory_analysis)
+    arg_bytes: float
+    out_bytes: float
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity in flops/byte (0 when bytes unknown)."""
+        return self.flops / self.bytes_accessed if self.bytes_accessed > 0 else 0.0
+
+
+_lock = threading.Lock()
+_registry: Dict[str, CostEntry] = {}
+
+
+def _key_id(owner: str, family: str, key: Any) -> str:
+    digest = hashlib.md5(repr((owner, family, key)).encode("utf-8", "replace"))
+    return digest.hexdigest()[:12]
+
+
+def record(owner: str, family: str, key: Any, compiled: Any) -> Optional[CostEntry]:
+    """Capture ``compiled``'s cost/memory analysis into the registry.
+
+    ``key`` is the engine's own cache key for the executable (any
+    repr-able value); the returned entry's ``key_id`` is what rides the
+    compile span as ``cost_key`` and joins launches back to their cost.
+    Returns ``None`` (and records nothing) when the object offers no
+    usable analysis — jit wrappers from persistent-cache hits, tracer
+    contexts, very old runtimes.
+    """
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+    peak_temp = arg_bytes = out_bytes = 0.0
+    try:
+        ma = compiled.memory_analysis()
+        peak_temp = float(getattr(ma, "temp_size_in_bytes", 0) or 0)
+        arg_bytes = float(getattr(ma, "argument_size_in_bytes", 0) or 0)
+        out_bytes = float(getattr(ma, "output_size_in_bytes", 0) or 0)
+    except Exception:
+        pass
+    entry = CostEntry(
+        owner=str(owner),
+        family=str(family),
+        key_id=_key_id(owner, family, key),
+        flops=flops,
+        bytes_accessed=nbytes,
+        peak_temp_bytes=peak_temp,
+        arg_bytes=arg_bytes,
+        out_bytes=out_bytes,
+    )
+    with _lock:
+        _registry[entry.key_id] = entry
+    return entry
+
+
+def lookup(key_id: str) -> Optional[CostEntry]:
+    with _lock:
+        return _registry.get(key_id)
+
+
+def entries() -> Dict[str, CostEntry]:
+    """Snapshot of the registry (``key_id -> CostEntry``)."""
+    with _lock:
+        return dict(_registry)
+
+
+def reset() -> None:
+    with _lock:
+        _registry.clear()
+
+
+# --------------------------------------------------------------- roofline
+_peaks_cache: Optional[Tuple[bool, Optional[Tuple[float, float]]]] = None
+
+
+def device_peaks(refresh: bool = False) -> Optional[Tuple[float, float]]:
+    """(peak GFLOP/s, peak GB/s) for the attached default device, or
+    ``None`` when the device kind is not in the table (CPU — the
+    relative basis). Cached after the first probe."""
+    global _peaks_cache
+    if _peaks_cache is not None and not refresh:
+        return _peaks_cache[1]
+    peaks: Optional[Tuple[float, float]] = None
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind
+        best = ""
+        for sub, p in DEVICE_PEAKS.items():
+            if sub.lower() in str(kind).lower() and len(sub) > len(best):
+                best, peaks = sub, p
+    except Exception:
+        peaks = None
+    _peaks_cache = (True, peaks)
+    return peaks
+
+
+def classify(intensity: float, ridge: Optional[float] = None) -> str:
+    """Roofline regime for an arithmetic intensity (flops/byte)."""
+    if ridge is None:
+        peaks = device_peaks()
+        ridge = (peaks[0] / peaks[1]) if peaks else NOMINAL_RIDGE
+    return "bandwidth-bound" if intensity < ridge else "compute-bound"
+
+
+def compile_attrs(entry: Optional[CostEntry]) -> Dict[str, Any]:
+    """Static cost attrs for the compile span that minted ``entry``."""
+    if entry is None:
+        return {}
+    return {
+        "cost_key": entry.key_id,
+        "cost_flops": entry.flops,
+        "cost_bytes": entry.bytes_accessed,
+        "cost_peak_temp_bytes": entry.peak_temp_bytes,
+    }
+
+
+def launch_attrs(entry: Optional[CostEntry], dur_us: Optional[float]) -> Dict[str, Any]:
+    """Utilization attrs for one launch of ``entry``'s executable.
+
+    Always carries the structural numbers (``model_flops`` /
+    ``model_bytes`` / ``intensity`` / ``regime`` / ``roofline_basis``);
+    with a measured ``dur_us`` adds ``achieved_gflops`` /
+    ``achieved_gbps`` and — on a device with absolute peaks —
+    ``roofline_frac`` (achieved over the roofline ceiling at this
+    intensity, whichever of the two walls binds)."""
+    if entry is None:
+        return {}
+    peaks = device_peaks()
+    intensity = entry.intensity
+    attrs: Dict[str, Any] = {
+        "cost_key": entry.key_id,
+        "model_flops": entry.flops,
+        "model_bytes": entry.bytes_accessed,
+        "intensity": round(intensity, 4),
+        "regime": classify(intensity),
+        "roofline_basis": "absolute" if peaks else "relative",
+    }
+    if dur_us is not None and dur_us > 0:
+        # flops / µs * 1e-3 == GFLOP/s; bytes / µs * 1e-3 == GB/s
+        gflops = entry.flops / dur_us * 1e-3
+        gbps = entry.bytes_accessed / dur_us * 1e-3
+        attrs["achieved_gflops"] = round(gflops, 4)
+        attrs["achieved_gbps"] = round(gbps, 4)
+        if peaks:
+            peak_gflops, peak_gbps = peaks
+            # the attainable ceiling at this intensity: min(peak compute,
+            # intensity * peak bandwidth) — classic roofline
+            ceiling = min(peak_gflops, intensity * peak_gbps) if intensity > 0 else 0.0
+            if ceiling > 0:
+                attrs["roofline_frac"] = round(gflops / ceiling, 6)
+    return attrs

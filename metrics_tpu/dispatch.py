@@ -73,7 +73,7 @@ import numpy as np
 
 from metrics_tpu import aot_cache, faults, telemetry
 from metrics_tpu._compat import profiler_annotation
-from metrics_tpu.analysis import hazards
+from metrics_tpu.analysis import cost_model, hazards
 from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
 
 Array = jax.Array
@@ -185,6 +185,9 @@ class FastDispatcher:
         self._cache_namespace = cache_namespace
         # LRU over compiled executables (both families); see cache_max()
         self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        # cache key -> CostEntry (XLA flops/bytes accounting) for the
+        # roofline attrs every launch span carries; evicted with _cache
+        self._cost: Dict[Tuple, Any] = {}
         # id()s of the leaves the engine itself produced last; anything else
         # is a foreign buffer that must be copied before donation
         self._owned: Tuple[int, ...] = ()
@@ -266,15 +269,23 @@ class FastDispatcher:
                 out = compiled(leaves, *call_inputs)
             out = tuple(out)
 
+        dur = None if t0 is None else (time.perf_counter() - t0) * 1e6
+        cost = (
+            cost_model.launch_attrs(self._cost.get(key), dur)
+            if telemetry.subscribed()
+            else {}
+        )
         telemetry.emit(
             "update",
             self.label,
             self._kind,
             t0=t0,
+            dur_us=dur,
             stream="dispatch",
             masked=masked,
             bucket=bucket_pow2(batch, minimum=MIN_BUCKET) if masked else None,
             static_key=static_key or None,
+            **cost,
         )
         self.stats["dispatches"] += 1
 
@@ -321,6 +332,11 @@ class FastDispatcher:
             out_leaves = tuple(out_leaves)
         elapsed_us = (time.perf_counter() - t0) * 1e6
 
+        cost = (
+            cost_model.launch_attrs(self._cost.get(key), elapsed_us)
+            if telemetry.subscribed()
+            else {}
+        )
         telemetry.emit(
             "forward",
             self.label,
@@ -331,6 +347,7 @@ class FastDispatcher:
             masked=masked,
             bucket=bucket_pow2(batch, minimum=MIN_BUCKET) if masked else None,
             static_key=static_key or None,
+            **cost,
         )
         self.forward_stats["launches"] += 1
         self.forward_stats["engine_us"] += elapsed_us
@@ -368,7 +385,8 @@ class FastDispatcher:
         self._cache.move_to_end(key)
         limit = cache_max()
         while limit > 0 and len(self._cache) > limit:
-            self._cache.popitem(last=False)
+            evicted_key, _ = self._cache.popitem(last=False)
+            self._cost.pop(evicted_key, None)
             self.stats["evictions"] = self.stats.get("evictions", 0) + 1
             telemetry.emit("evict", self.label, self._kind, stream="dispatch")
 
@@ -436,6 +454,11 @@ class FastDispatcher:
         jax.eval_shape(trace_fn, *trace_args)
         # feed the seen-sets anyway so LATER real misses attribute correctly
         self._retrace_cause(seen_family, static_key, example_inputs)
+        # best-effort cost capture: a deserialized store hit is usually a
+        # plain jit wrapper with no cost_analysis — record() returns None
+        self._cost[key] = cost_model.record(
+            self.label, "update" if family == "update" else "forward", key, loaded
+        )
         telemetry.emit(
             "compile",
             self.label,
@@ -494,6 +517,7 @@ class FastDispatcher:
         t0 = time.perf_counter()
         compiled = jitted.lower(*export_args).compile()
         self._persist("update", key, compiled, jitted, export_args)
+        self._cost[key] = cost_model.record(self.label, "update", key, compiled)
 
         telemetry.emit(
             "compile",
@@ -504,6 +528,7 @@ class FastDispatcher:
             cause=cause,
             masked=masked,
             static_key=static_key or None,
+            **cost_model.compile_attrs(self._cost[key]),
             **self._predicted_attr(cause),
         )
         self.stats["retraces"] += 1
@@ -546,6 +571,7 @@ class FastDispatcher:
         t0 = time.perf_counter()
         compiled = jitted.lower(*export_args).compile()
         self._persist("fwd", key, compiled, jitted, export_args)
+        self._cost[key] = cost_model.record(self.label, "forward", key, compiled)
 
         telemetry.emit(
             "compile",
@@ -556,6 +582,7 @@ class FastDispatcher:
             cause=cause,
             masked=masked,
             static_key=static_key or None,
+            **cost_model.compile_attrs(self._cost[key]),
             **self._predicted_attr(cause),
         )
         self.forward_stats["retraces"] += 1

@@ -93,6 +93,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu import faults, resilience, sync_engine, telemetry, wal
+from metrics_tpu.analysis import cost_model
 from metrics_tpu.serve import _MIN_SESSION_BUCKET, MetricsService, ValueTicket
 from metrics_tpu.utilities.data import bucket_pow2
 
@@ -347,9 +348,13 @@ class ShardedMetricsService:
         self._retired_slo: Dict[int, Any] = {}
         # bounded pool for fleet-wide reads (created lazily)
         self._pool: Optional[ThreadPoolExecutor] = None
-        # packed fleet-read programs, keyed (kind, n shards, session bucket)
-        # — jit under the key handles per-shard capacity shape changes
+        # AOT-compiled packed fleet-read programs, keyed (kind, n shards,
+        # session bucket, input aval signature) — the aval component keys
+        # per-shard capacity shape changes that the old jit cache absorbed
+        # implicitly. Values are (compiled, CostEntry|None).
         self._fleet_programs: Dict[Tuple, Any] = {}
+        # per-kind compile attribution for fleet compile spans
+        self._fleet_seen: Dict[str, int] = {}
 
         self._shards: List[_Shard] = []
         for k in range(self.num_shards):
@@ -540,13 +545,33 @@ class ShardedMetricsService:
     def compute(self, name: str) -> Any:
         return self._route(name).service.compute(name)
 
-    def _fleet_program(self, kind: str, n: int, m: int, builder) -> Any:
-        key = (kind, n, m)
-        program = self._fleet_programs.get(key)
-        if program is None:
-            program = jax.jit(builder())
-            self._fleet_programs[key] = program
-        return program
+    def _fleet_program(self, kind: str, n: int, m: int, builder, example_args: Tuple) -> Tuple[Any, Any]:
+        """The AOT-compiled packed program for one fleet-read signature,
+        plus its :class:`~metrics_tpu.analysis.cost_model.CostEntry`.
+        Compiled ONCE per (kind, shard count, session bucket, input aval
+        signature) via ``jit(...).lower(...).compile()`` — the compile is
+        announced as a ``compile`` span (kind ``fleet-<kind>``) carrying
+        the executable's cost attrs, like every other AOT seam."""
+        flat, _ = jax.tree_util.tree_flatten(example_args)
+        key = (
+            kind, n, m,
+            tuple((tuple(x.shape), str(jnp.dtype(x.dtype))) for x in flat),
+        )
+        cached = self._fleet_programs.get(key)
+        if cached is not None:
+            return cached
+        t0 = time.perf_counter()
+        compiled = jax.jit(builder()).lower(*example_args).compile()
+        entry = cost_model.record(self.label, f"fleet-{kind}", key, compiled)
+        cause = "first-compile" if not self._fleet_seen.get(kind) else "new-signature"
+        self._fleet_seen[kind] = self._fleet_seen.get(kind, 0) + 1
+        telemetry.emit(
+            "compile", self.label, f"fleet-{kind}", t0=t0, stream="serve",
+            cause=cause, shards=n, session_bucket=m,
+            **cost_model.compile_attrs(entry),
+        )
+        self._fleet_programs[key] = (compiled, entry)
+        return compiled, entry
 
     def compute_all(self) -> Dict[str, Any]:
         """Every open session fleet-wide (partitions are disjoint, so the
@@ -588,10 +613,6 @@ class ShardedMetricsService:
             )
             template = dirty_plans[0][0].service.template
             leaf_names = dirty_plans[0][0].service._names
-            program = self._fleet_program(
-                "read", n, m,
-                lambda: sync_engine.build_fleet_read(template, leaf_names, n, m),
-            )
             shard_leaves = []
             shard_idx = []
             for s, dirty in dirty_plans:
@@ -601,16 +622,25 @@ class ShardedMetricsService:
                     idx[i] = row
                 shard_leaves.append(tuple(svc._stacked[k] for k in svc._names))
                 shard_idx.append(jnp.asarray(idx))
+            program_args = (tuple(shard_leaves), tuple(shard_idx))
+            program, cost_entry = self._fleet_program(
+                "read", n, m,
+                lambda: sync_engine.build_fleet_read(template, leaf_names, n, m),
+                program_args,
+            )
             c0 = telemetry.clock()
-            vals = program(tuple(shard_leaves), tuple(shard_idx))
+            vals = program(*program_args)
+            c_dur = None if c0 is None else (time.perf_counter() - c0) * 1e6
             self.stats["fleet_read_collectives"] += 1
             nbytes = sum(
                 spec[3] * n * m
                 for spec in sync_engine._leaf_wire_specs(template, leaf_names)
             )
             telemetry.emit(
-                "collective", self.label, "packed-read", t0=c0,
+                "collective", self.label, "packed-read", t0=c0, dur_us=c_dur,
                 nbytes=nbytes, nleaves=len(leaf_names), shards=n,
+                **(cost_model.launch_attrs(cost_entry, c_dur)
+                   if telemetry.subscribed() else {}),
             )
             n_dirty = 0
             for si, (s, dirty) in enumerate(dirty_plans):
@@ -667,10 +697,6 @@ class ShardedMetricsService:
         )
         template = shards[0].service.template
         leaf_names = shards[0].service._names
-        program = self._fleet_program(
-            "rollup", n, m,
-            lambda: sync_engine.build_fleet_rollup(template, leaf_names, n, m),
-        )
         shard_leaves = []
         shard_idx = []
         valid = np.zeros((n * m,), dtype=bool)
@@ -681,11 +707,21 @@ class ShardedMetricsService:
             valid[si * m : si * m + len(rows)] = True
             shard_leaves.append(tuple(svc._stacked[k] for k in svc._names))
             shard_idx.append(jnp.asarray(idx))
-        val = program(tuple(shard_leaves), tuple(shard_idx), jnp.asarray(valid))
+        program_args = (tuple(shard_leaves), tuple(shard_idx), jnp.asarray(valid))
+        program, cost_entry = self._fleet_program(
+            "rollup", n, m,
+            lambda: sync_engine.build_fleet_rollup(template, leaf_names, n, m),
+            program_args,
+        )
+        r0 = telemetry.clock()
+        val = program(*program_args)
+        r_dur = None if r0 is None else (time.perf_counter() - r0) * 1e6
         self.stats["fleet_read_collectives"] += 1
         telemetry.emit(
             "read", self.label, "rollup", t0=t0, stream="serve",
             shards=n, sessions=int(valid.sum()), collectives=1,
+            **(cost_model.launch_attrs(cost_entry, r_dur)
+               if telemetry.subscribed() else {}),
         )
         return val
 
@@ -1340,6 +1376,13 @@ class ShardedMetricsService:
                 sid: {"host": getattr(standby, "host", None),
                       **standby.snapshot()}
                 for sid, standby in sorted(self._standbys.items())
+            },
+            # per-shard always-on latency/throughput aggregates: shard
+            # services label their spans "...@shard<id>", so each shard's
+            # view is an owner-filtered slice of telemetry.timeline()
+            "timeline": {
+                s.shard_id: telemetry.timeline(owner=f"@shard{s.shard_id}")
+                for s in live
             },
             "health": self.health(),
         }

@@ -116,6 +116,7 @@ import numpy as np
 
 from metrics_tpu import aot_cache, faults, resilience, telemetry, wal
 from metrics_tpu._compat import profiler_annotation
+from metrics_tpu.analysis import cost_model
 from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
 
 __all__ = [
@@ -483,6 +484,8 @@ class MetricsService:
         self._replaying = False
 
         self._exec_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        # cache key -> CostEntry for the stacked launches' roofline attrs
+        self._cost: Dict[Tuple, Any] = {}
         self._compute_one = None
         self._compute_stack = None
         self._seen_signatures: set = set()
@@ -1078,6 +1081,12 @@ class MetricsService:
                     out, vals = out
                 out = tuple(out)
             l1 = time.monotonic()
+            launch_us = (l1 - l0) * 1e6
+            cost = (
+                cost_model.launch_attrs(self._cost.get(key), launch_us)
+                if telemetry.subscribed()
+                else {}
+            )
             telemetry.emit(
                 "update",
                 self.label,
@@ -1090,8 +1099,8 @@ class MetricsService:
                 static_key=static_key or None,
                 rid_count=len(rids),
                 rids=rids[:128],
+                **cost,
             )
-            launch_us = (l1 - l0) * 1e6
             launch_tid = threading.get_ident()
             for r in reqs:
                 r.launch_us = launch_us
@@ -1188,6 +1197,7 @@ class MetricsService:
         if loaded is not None:
             jax.eval_shape(fn, *example_args)  # replay host trace effects
             self._seen_signatures.add(key)
+            self._cost[key] = cost_model.record(self.label, "serve", key, loaded)
             telemetry.emit(
                 "compile", self.label, "stacked-aot", t0=t0, stream="serve",
                 cause="persistent-cache-hit",
@@ -1203,8 +1213,10 @@ class MetricsService:
             export_fn=lambda: jax.export.export(jitted)(*example_args),
             namespace=self._namespace,
         )
+        self._cost[key] = cost_model.record(self.label, "serve", key, compiled)
         telemetry.emit(
             "compile", self.label, "stacked-aot", t0=t0, stream="serve", cause=cause,
+            **cost_model.compile_attrs(self._cost[key]),
         )
         self.stats["retraces"] += 1
         self._cache_put(key, compiled)
@@ -1217,7 +1229,8 @@ class MetricsService:
         self._exec_cache.move_to_end(key)
         limit = cache_max()
         while limit > 0 and len(self._exec_cache) > limit:
-            self._exec_cache.popitem(last=False)
+            evicted_key, _ = self._exec_cache.popitem(last=False)
+            self._cost.pop(evicted_key, None)
             self.stats["evictions"] += 1
             telemetry.emit("evict", self.label, "stacked-aot", stream="serve")
 

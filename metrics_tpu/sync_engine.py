@@ -42,6 +42,7 @@ bucket collective is emitted on the :mod:`metrics_tpu.telemetry` stream
 owner's ``sync_stats``.
 """
 import os
+import time
 from typing import Any, Dict, Hashable, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -49,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu import telemetry
+from metrics_tpu.analysis import cost_model
 from metrics_tpu.utilities.data import dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
 
 Array = jax.Array
@@ -161,6 +163,42 @@ def bucket_plan(specs: List[LeafSpec]) -> Dict[Tuple[str, str], List[LeafSpec]]:
     return buckets
 
 
+# (owner, wire dtype, op, leaf signature) -> CostEntry | None. The fused
+# bucket pass is not itself AOT-compiled (it runs inside the caller's
+# trace or eagerly), so its cost entry comes from lowering an equivalent
+# pack+unpack probe program ONCE per bucket signature — compiled for
+# analysis only, never executed, and only when a telemetry session is
+# subscribed (so unsubscribed sync paths never pay a probe compile).
+_bucket_cost_cache: Dict[Tuple, Any] = {}
+
+
+def _bucket_cost(owner: str, leaves: List[LeafSpec], wire_name: str, op: str) -> Any:
+    key = (owner, wire_name, op, tuple((s.shape, str(s.dtype)) for s in leaves))
+    if key in _bucket_cost_cache:
+        return _bucket_cost_cache[key]
+    wire = jnp.dtype(wire_name)
+    sizes = [int(np.prod(s.shape)) for s in leaves]
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+
+    def probe(*vals):
+        flat = [jnp.ravel(v).astype(wire) for v in vals]
+        buf = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+        outs = []
+        for s, o, n in zip(leaves, offsets, sizes):
+            outs.append(buf[o : o + n].astype(s.dtype).reshape(s.shape))
+        return tuple(outs)
+
+    entry = None
+    try:
+        avals = [jax.ShapeDtypeStruct(tuple(s.value.shape), s.dtype) for s in leaves]
+        compiled = jax.jit(probe).lower(*avals).compile()
+        entry = cost_model.record(owner, "sync", key, compiled)
+    except Exception:
+        entry = None
+    _bucket_cost_cache[key] = entry
+    return entry
+
+
 def execute_buckets(
     env: Any,
     specs: List[LeafSpec],
@@ -218,6 +256,11 @@ def execute_buckets(
                     seg = seg.astype(s.dtype)  # bool leaves rode the wire as int32
                 out[s.key] = seg.reshape(s.shape)
 
+        cost = {}
+        if telemetry.subscribed() and not isinstance(buf, jax.core.Tracer):
+            entry = _bucket_cost(owner, leaves, wire_name, op)
+            dur = None if t0 is None else (time.perf_counter() - t0) * 1e6
+            cost = cost_model.launch_attrs(entry, dur)
         telemetry.emit(
             "collective",
             owner,
@@ -227,6 +270,7 @@ def execute_buckets(
             op=op,
             wire_dtype=wire_name,
             nleaves=len(leaves),
+            **cost,
         )
         if stats is not None:
             stats["collectives"] = stats.get("collectives", 0) + 1

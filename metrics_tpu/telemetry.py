@@ -103,10 +103,18 @@ retrace cause). Two consumption tiers:
 * **Always-on counters.** Every emit bumps a process-level counter keyed
   ``"<name>:<kind>"`` (plus ``"collective:bytes"`` and
   ``"compile:cause:<cause>"``) — read with :func:`snapshot`, clear with
-  :func:`reset_counters`. When no subscriber is attached this is the
-  whole cost of an event: a couple of dict increments, no clock reads
-  for the launch-path spans (:func:`clock` returns ``None`` idle, so
-  callers skip ``perf_counter`` entirely).
+  :func:`reset_counters`.
+* **Always-on timeline.** Every *timed* span additionally feeds a
+  per-``(family, owner)`` sliding latency/throughput aggregate — a
+  :class:`~metrics_tpu.streaming.sketch.HostQuantileSketch` of span µs
+  (the telemetry engine dogfoods its own histogram machinery) plus a
+  ring of one-second throughput buckets. Read with :func:`timeline`
+  (merged per family, or filtered by owner substring for per-shard
+  fleet views); disable with ``METRICS_TPU_TIMELINE=0``, at which point
+  :func:`clock` goes back to returning ``None`` idle and the hot paths
+  skip ``perf_counter`` entirely. The per-span cost while idle is two
+  clock reads and one host-sketch bin increment — pinned inside the
+  ``telemetry_idle_overhead_ratio`` bench envelope.
 * **Subscribed sessions.** ``with telemetry.instrument() as session:``
   captures every event into ``session.events`` with real timestamps and
   durations; export with :meth:`TelemetrySession.export_chrome_trace`
@@ -137,6 +145,10 @@ __all__ = [
     "TelemetryEvent",
     "TelemetrySession",
     "telemetry_enabled",
+    "subscribed",
+    "timeline_enabled",
+    "timeline",
+    "reset_timeline",
     "instrument",
     "emit",
     "span",
@@ -173,6 +185,12 @@ def telemetry_enabled() -> bool:
     return os.environ.get("METRICS_TPU_TELEMETRY", "1").strip().lower() not in ("0", "false", "off")
 
 
+def timeline_enabled() -> bool:
+    """Always-on timeline switch (env ``METRICS_TPU_TIMELINE``, default
+    on; the engine kill switch silences it too)."""
+    return os.environ.get("METRICS_TPU_TIMELINE", "1").strip().lower() not in ("0", "false", "off")
+
+
 class TelemetryEvent(NamedTuple):
     """One timestamped span (or instant, when ``dur_us == 0``) on the stream.
 
@@ -199,6 +217,124 @@ class TelemetryEvent(NamedTuple):
     attrs: Dict[str, Any]
 
 
+# ----------------------------------------------------------------- timeline
+# seconds of sliding throughput window kept per (family, owner)
+_TIMELINE_RING = 32
+# lazy class ref: streaming.sketch imports this module at its top, so
+# the dogfooded HostQuantileSketch must be imported at first use
+_HostSketch: Any = None
+
+
+class _FamilyTimeline:
+    """One family+owner's always-on aggregate: a host DDSketch of span
+    µs (bins=512, alpha=0.05 — ~5 % relative error over sub-µs..hours)
+    plus a ring of one-second throughput buckets. Mutated only under the
+    module ``_lock``."""
+
+    __slots__ = ("sketch", "count", "total_us", "max_us", "ring_n", "ring_sec")
+
+    def __init__(self) -> None:
+        self.sketch = _HostSketch(bins=512, alpha=0.05)
+        self.count = 0
+        self.total_us = 0.0
+        self.max_us = 0.0
+        self.ring_n = [0] * _TIMELINE_RING
+        self.ring_sec = [-1] * _TIMELINE_RING
+
+    def add(self, dur_us: float, now: float) -> None:
+        self.count += 1
+        self.total_us += dur_us
+        if dur_us > self.max_us:
+            self.max_us = dur_us
+        if dur_us > 0:
+            self.sketch.add(dur_us)
+        sec = int(now)
+        idx = sec % _TIMELINE_RING
+        if self.ring_sec[idx] != sec:
+            self.ring_sec[idx] = sec
+            self.ring_n[idx] = 0
+        self.ring_n[idx] += 1
+
+
+_timelines: Dict[Tuple[str, str], "_FamilyTimeline"] = {}
+
+
+def _timeline_add(name: str, owner: str, dur_us: float, now: float) -> None:
+    global _HostSketch
+    if _HostSketch is None:
+        from metrics_tpu.streaming.sketch import HostQuantileSketch
+
+        _HostSketch = HostQuantileSketch
+    key = (name, owner)
+    with _lock:
+        tl = _timelines.get(key)
+        if tl is None:
+            tl = _timelines[key] = _FamilyTimeline()
+        tl.add(dur_us, now)
+
+
+def timeline(owner: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
+    """The always-on per-family latency/throughput view.
+
+    Returns ``{family: {count, total_us, mean_us, max_us, p50_us,
+    p95_us, p99_us, rate_per_s}}`` aggregated over every owner (the
+    per-owner sketches merge losslessly — same DDSketch geometry), or
+    over owners containing the ``owner`` substring when given (a fabric
+    passes ``"@shard3"`` to get one shard's view). ``rate_per_s`` is
+    events/second over the sliding :data:`_TIMELINE_RING`-second window;
+    the quantiles are lifetime (sliding-window quantiles would need a
+    decaying sketch — the ratchet pins structure, not decay policy).
+    """
+    now = time.perf_counter()
+    sec = int(now)
+    with _lock:
+        groups: Dict[str, List[_FamilyTimeline]] = {}
+        for (family, own), tl in _timelines.items():
+            if owner is not None and owner not in own:
+                continue
+            groups.setdefault(family, []).append(tl)
+        out: Dict[str, Dict[str, Any]] = {}
+        for family, tls in sorted(groups.items()):
+            count = sum(t.count for t in tls)
+            total = sum(t.total_us for t in tls)
+            merged = tls[0].sketch
+            if len(tls) > 1:
+                merged = _HostSketch(bins=512, alpha=0.05)
+                for t in tls:
+                    merged.merge(t.sketch)
+            recent = 0
+            oldest = sec
+            for t in tls:
+                for s, n in zip(t.ring_sec, t.ring_n):
+                    if 0 <= sec - s < _TIMELINE_RING:
+                        recent += n
+                        if s < oldest:
+                            oldest = s
+            span_s = max(1, min(_TIMELINE_RING, sec - oldest + 1))
+
+            def _q(q: float) -> float:
+                v = merged.quantile(q)
+                return round(v, 3) if v == v else 0.0
+
+            out[family] = {
+                "count": count,
+                "total_us": round(total, 3),
+                "mean_us": round(total / count, 3) if count else 0.0,
+                "max_us": round(max(t.max_us for t in tls), 3),
+                "p50_us": _q(0.50),
+                "p95_us": _q(0.95),
+                "p99_us": _q(0.99),
+                "rate_per_s": round(recent / span_s, 3),
+            }
+        return out
+
+
+def reset_timeline() -> None:
+    """Drop every timeline aggregate (tests / bench isolation)."""
+    with _lock:
+        _timelines.clear()
+
+
 # ----------------------------------------------------------------- emission
 def _subscribe(callback: Callable[[TelemetryEvent], None]) -> None:
     global _subscribers
@@ -217,11 +353,23 @@ def _unsubscribe(callback: Callable[[TelemetryEvent], None]) -> None:
 
 def clock() -> Optional[float]:
     """Span start marker: ``perf_counter()`` when someone will receive the
-    span, else ``None`` — so idle hot paths never pay the clock read. Pass
+    span — a subscriber, or the always-on timeline — else ``None`` so
+    idle hot paths never pay the clock read. With the timeline at its
+    default-on setting this returns a real timestamp even unsubscribed
+    (the idle cost is the clock read plus one sketch bin increment at
+    emit; ``METRICS_TPU_TIMELINE=0`` restores the old idle no-op). Pass
     the result to :func:`emit` as ``t0``."""
-    if _subscribers and telemetry_enabled():
+    if telemetry_enabled() and (_subscribers or timeline_enabled()):
         return time.perf_counter()
     return None
+
+
+def subscribed() -> bool:
+    """True when at least one :func:`instrument` session (or legacy
+    tracker shim) will receive full events. Hot paths use this to skip
+    building optional attr payloads (e.g. the roofline cost attrs) that
+    only subscribed sessions ever read."""
+    return bool(_subscribers) and telemetry_enabled()
 
 
 def stream_us(t: float) -> float:
@@ -283,11 +431,16 @@ def emit(
             _counters[f"degrade:cause:{cause}"] = _counters.get(f"degrade:cause:{cause}", 0) + 1
         elif name == "journal" and kind == "append":
             _counters["journal:bytes"] = _counters.get("journal:bytes", 0) + attrs.get("nbytes", 0)
-    if not subs:
+    timed = t0 is not None or dur_us is not None
+    if not subs and not timed:
         return
     now = time.perf_counter()
     if dur_us is None:
         dur_us = 0.0 if t0 is None else (now - t0) * 1e6
+    if timed and timeline_enabled():
+        _timeline_add(name, owner, dur_us, now)
+    if not subs:
+        return
     if t0 is not None:
         ts_us = (t0 - _EPOCH) * 1e6
     else:

@@ -369,3 +369,60 @@ def test_cg_configs_record_host_pinning():
     bench._cfg_compute_group_detection(detail, reps=1)
     assert "host cpu" in detail["cg_machinery_device"]
     assert detail["cg_first_update_auto_detect_us"] > 0
+
+
+def test_perf_sentinel_capstone_matches_live_bench_counters():
+    """The dynamic capstone for ``tools/perf_sentinel.py`` (``make
+    sentinel``), mirroring how the static audit's capstone collective
+    counts are pinned equal to ``_cfg_sync_engine`` above: the sentinel's
+    ``collect()`` runs THE SAME ``bench._cfg_*`` schedule these tests pin,
+    so its structural counters must equal the live pins verbatim AND equal
+    the checked-in PERF_BASELINE.json. If the sentinel's schedule drifts
+    from the bench (different scales, renamed keys, a dropped config),
+    this fails in tier-1 — not silently in the chaos lane."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_sentinel",
+        os.path.join(os.path.dirname(__file__), "..", "..", "tools", "perf_sentinel.py"),
+    )
+    ps = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ps)
+
+    # the cheap structural configs, at the exact scales pinned above
+    report = ps.collect(only=("sync_engine", "streaming"))
+    s = report["structural"]
+    assert s["sync_collectives_fused_collection"] == 1
+    assert s["sync_bucket_count_fused_collection"] == 1
+    assert s["sync_collectives_perleaf_collection"] == 17
+    assert s["sync_bytes_fused_collection"] == s["sync_bytes_perleaf_collection"]
+    assert s["window_retraces_1k_steps"] == 0
+    assert s["window_dispatches_1k_steps"] == 40
+    assert s["sketch_sync_collectives_2replica"] == 1
+
+    # every structural counter the sentinel measured equals the checked-in
+    # baseline — the live run IS the baseline, or `make sentinel` lies
+    base = ps.load_baseline()
+    assert base is not None, "PERF_BASELINE.json must be checked in"
+    for key, value in s.items():
+        assert base["structural"][key] == value, key
+
+    # schedule-coverage pin: the sentinel watches every structural family
+    # this file pins live (dispatch/sync/forward/streaming/read-path)
+    scheduled = {k for _, _, _, skeys, _ in ps.SCHEDULE for k in skeys}
+    assert {
+        "dispatch_count_single_metric_4_updates",
+        "sync_collectives_fused_collection",
+        "forward_launches_single_metric_10_steps",
+        "window_retraces_1k_steps",
+        "read_second_unticked_launches",
+        "fleet_read_collectives",
+    } <= scheduled
+    # and the latency front keeps the idle-overhead ratio under the same
+    # pin _cfg_telemetry_overhead enforces (band IS the 2.0 bound)
+    sched = {name: (kwargs, lkeys) for name, _, kwargs, _, lkeys in ps.SCHEDULE}
+    assert "telemetry_idle_overhead_ratio" in sched["telemetry_overhead"][1]
+    assert ps.BAND_OVERRIDES["telemetry_idle_overhead_ratio"] == 2.0
+    # the scales must match the pins above, or "equal counters" is vacuous
+    assert sched["streaming"][0] == {"steps": 40}
+    assert sched["read_path"][0] == {"sessions": 16, "reps": 3}

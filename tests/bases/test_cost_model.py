@@ -1,0 +1,325 @@
+"""Tests for the roofline-attributed cost model + perf sentinel.
+
+Pins the observability contracts this PR ships: every AOT compile seam
+feeds XLA's ``cost_analysis`` / ``memory_analysis`` into the
+per-executable registry (:mod:`metrics_tpu.analysis.cost_model`), compile
+spans carry the model numbers, launch spans carry model flops/bytes plus
+achieved GFLOP/s / GB/s and a roofline regime (relative basis on CPU —
+the structural pins stay backend-independent), the always-on telemetry
+timeline aggregates per-family latency/throughput with its
+``METRICS_TPU_TIMELINE=0`` kill switch, per-shard timelines ride
+``fleet_snapshot()``, and ``tools/perf_sentinel.py``'s ratchet fails on
+new regressions, stale accepted entries, and accepted entries without a
+``why`` (STATIC_AUDIT semantics).
+"""
+import copy
+import importlib.util
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MetricCollection, Precision, telemetry
+from metrics_tpu.analysis import cost_model
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+C = 4
+
+
+def _batch(rng, b, c=C):
+    logits = rng.rand(b, c).astype(np.float32)
+    return jnp.asarray(logits), jnp.asarray(rng.randint(0, c, b))
+
+
+def _load_sentinel():
+    spec = importlib.util.spec_from_file_location(
+        "perf_sentinel",
+        os.path.join(os.path.dirname(__file__), "..", "..", "tools", "perf_sentinel.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------------- cost registry
+def test_dispatch_compile_records_cost_entry_and_span_attrs():
+    """A cold fused-dispatch compile lands one registry entry whose model
+    numbers ride the compile span, and every subsequent launch span
+    carries model flops/bytes + achieved rates + a roofline regime."""
+    rng = np.random.RandomState(0)
+    m = Accuracy(num_classes=C, jit_update=True)
+    with telemetry.instrument() as session:
+        for _ in range(3):
+            m.update(*_batch(rng, 32))
+        jax.block_until_ready(m.tp)
+
+    compiles = [e for e in session.spans(name="compile") if "cost_key" in e.attrs]
+    assert compiles, "the cold compile must carry cost attrs"
+    ca = compiles[0].attrs
+    assert ca["cost_bytes"] > 0  # counting 32 predictions moves real bytes
+    assert ca["cost_peak_temp_bytes"] >= 0
+
+    entry = cost_model.lookup(ca["cost_key"])
+    assert entry is not None
+    assert entry.owner == "Accuracy"
+    assert entry.family == "update"
+    assert float(entry.bytes_accessed) == float(ca["cost_bytes"])
+
+    updates = [e for e in session.spans(name="update") if "model_flops" in e.attrs]
+    assert len(updates) == 3
+    for e in updates:
+        a = e.attrs
+        assert a["cost_key"] == ca["cost_key"]
+        assert a["model_bytes"] == ca["cost_bytes"]
+        assert a["regime"] in ("bandwidth-bound", "compute-bound")
+        assert a["intensity"] == pytest.approx(
+            float(entry.flops) / float(entry.bytes_accessed), rel=1e-3
+        )
+        # wall-clock measured -> achieved rates derived from THIS launch
+        assert a["achieved_gbps"] > 0
+        assert a["roofline_basis"] in ("absolute", "relative")
+
+    # CPU boxes have no peak table entry: pins must stay structural
+    if cost_model.device_peaks() is None:
+        assert all(e.attrs["roofline_basis"] == "relative" for e in updates)
+
+
+def test_forward_and_collection_seams_record_entries():
+    rng = np.random.RandomState(1)
+    m = Accuracy(num_classes=C, jit_update=True)
+    col = MetricCollection(
+        {"acc": Accuracy(num_classes=C), "prec": Precision(num_classes=C)},
+        fused_update=True,
+    )
+    with telemetry.instrument() as session:
+        m.forward(*_batch(rng, 16))
+        col.update(*_batch(rng, 16))
+    families = {
+        (cost_model.lookup(e.attrs["cost_key"]).owner,
+         cost_model.lookup(e.attrs["cost_key"]).family)
+        for e in session.spans(name="compile")
+        if "cost_key" in e.attrs
+    }
+    assert ("Accuracy", "forward") in families
+    assert ("MetricCollection", "update") in families
+
+
+def test_unsubscribed_launches_skip_cost_attr_building():
+    """With no subscriber the launch path must not pay for attr dicts —
+    ``telemetry.subscribed()`` is the documented gate."""
+    assert not telemetry.subscribed()
+    with telemetry.instrument():
+        assert telemetry.subscribed()
+    assert not telemetry.subscribed()
+
+
+# ------------------------------------------------------------ roofline math
+def test_classify_and_launch_attrs_math():
+    assert cost_model.classify(0.5, ridge=1.0) == "bandwidth-bound"
+    assert cost_model.classify(2.0, ridge=1.0) == "compute-bound"
+
+    entry = cost_model.CostEntry(
+        owner="X", family="update", key_id="abc", flops=1e6,
+        bytes_accessed=1e6, peak_temp_bytes=0, arg_bytes=0, out_bytes=0,
+    )
+    assert entry.intensity == 1.0
+    a = cost_model.launch_attrs(entry, 1000.0)  # 1ms
+    # 1e6 flops / 1e-3 s = 1e9 flop/s = 1 GFLOP/s; same for bytes
+    assert a["achieved_gflops"] == pytest.approx(1.0)
+    assert a["achieved_gbps"] == pytest.approx(1.0)
+    assert a["model_flops"] == 1e6
+    assert cost_model.launch_attrs(None, 1000.0) == {}
+    assert "achieved_gflops" not in cost_model.launch_attrs(entry, None)
+
+
+def test_device_peaks_table_sane():
+    for kind, (gflops, gbps) in cost_model.DEVICE_PEAKS.items():
+        assert gflops > 0 and gbps > 0, kind
+        # every known accelerator's ridge point is >10 flops/byte — the
+        # NOMINAL_RIDGE used for the relative basis sits in that range too
+        assert 10.0 < gflops / gbps < 1000.0, kind
+    assert 10.0 < cost_model.NOMINAL_RIDGE < 1000.0
+
+
+# ------------------------------------------------------------ timeline
+def test_timeline_always_on_without_subscriber():
+    telemetry.reset_timeline()
+    rng = np.random.RandomState(2)
+    m = Accuracy(num_classes=C, jit_update=True)
+    for _ in range(4):
+        m.update(*_batch(rng, 32))  # NO subscriber attached
+    jax.block_until_ready(m.tp)
+    tl = telemetry.timeline()
+    assert tl["update"]["count"] >= 4
+    assert tl["update"]["mean_us"] > 0
+    assert tl["update"]["p50_us"] > 0
+    assert tl["update"]["max_us"] >= tl["update"]["p50_us"]
+    assert tl["update"]["rate_per_s"] > 0
+    # compile rode the cold start
+    assert tl["compile"]["count"] >= 1
+
+    # owner filter: Accuracy activity doesn't show under a bogus owner
+    assert telemetry.timeline(owner="@shard99") == {}
+
+    telemetry.reset_timeline()
+    assert telemetry.timeline() == {}
+
+
+def test_timeline_kill_switch(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_TIMELINE", "0")
+    telemetry.reset_timeline()
+    rng = np.random.RandomState(3)
+    m = Accuracy(num_classes=C, jit_update=True)
+    m.update(*_batch(rng, 32))
+    jax.block_until_ready(m.tp)
+    assert telemetry.timeline() == {}
+    # and the hot path reverts to the no-clock idle state
+    assert not telemetry.timeline_enabled()
+    assert telemetry.clock() is None
+
+
+def test_fleet_snapshot_carries_per_shard_timelines():
+    from metrics_tpu.fabric import ShardedMetricsService
+
+    telemetry.reset_timeline()
+    rng = np.random.RandomState(4)
+    fab = ShardedMetricsService(
+        Accuracy(task="multiclass", num_classes=C), num_shards=2
+    )
+    try:
+        batch = (jnp.asarray(rng.randint(0, C, 8)), jnp.asarray(rng.randint(0, C, 8)))
+        for i in range(8):
+            fab.update(f"s{i}", *batch)
+        jax.block_until_ready(list(fab.compute_all().values()))
+        snap = fab.fleet_snapshot()
+        assert set(snap["timeline"]) == {0, 1}
+        merged = {}
+        for shard_tl in snap["timeline"].values():
+            for fam, agg in shard_tl.items():
+                merged[fam] = merged.get(fam, 0) + agg["count"]
+        assert merged.get("update", 0) >= 8  # every session update landed
+    finally:
+        fab.shutdown()
+
+
+# ------------------------------------------------------- sentinel ratchet
+def _synthetic_report():
+    return {
+        "schema": 1,
+        "configs": ["sync_engine"],
+        "structural": {"sync_collectives_fused_collection": 1},
+        "model": {
+            "MetricCollection:sync": {
+                "execs": 1, "flops": 0.0, "bytes": 1024.0,
+                "intensity": 0.0, "regime": "bandwidth-bound",
+            }
+        },
+        "latency": {"sync_us_fused_collection": {"value": 100.0, "band": 5.0}},
+        "elapsed_s": 0.0,
+    }
+
+
+def _synthetic_baseline():
+    base = _synthetic_report()
+    base.pop("elapsed_s")
+    base["accepted"] = {}
+    return base
+
+
+def test_sentinel_diff_clean_pass():
+    ps = _load_sentinel()
+    d = ps.diff(_synthetic_report(), _synthetic_baseline())
+    assert d["ok"], d
+
+
+def test_sentinel_diff_fails_on_structural_regression():
+    ps = _load_sentinel()
+    rep = _synthetic_report()
+    rep["structural"]["sync_collectives_fused_collection"] = 2
+    d = ps.diff(rep, _synthetic_baseline())
+    assert not d["ok"]
+    assert [r["key"] for r in d["regressions"]] == [
+        "structural:sync_collectives_fused_collection"
+    ]
+    assert "FAIL" in ps.summarize_diff(d)
+
+
+def test_sentinel_diff_fails_on_model_regression():
+    """The model front catches silent flops/bytes bloat even inside the
+    latency noise band."""
+    ps = _load_sentinel()
+    rep = _synthetic_report()
+    rep["model"]["MetricCollection:sync"]["bytes"] = 2048.0
+    d = ps.diff(rep, _synthetic_baseline())
+    assert not d["ok"]
+    assert any(r["key"].startswith("model:") for r in d["regressions"])
+
+
+def test_sentinel_accepted_regression_needs_why():
+    ps = _load_sentinel()
+    rep = _synthetic_report()
+    rep["structural"]["sync_collectives_fused_collection"] = 2
+
+    base = _synthetic_baseline()
+    base["accepted"]["structural:sync_collectives_fused_collection"] = {
+        "value": 2, "why": "bucketizer intentionally split the pack"
+    }
+    assert ps.diff(rep, base)["ok"]
+
+    base["accepted"]["structural:sync_collectives_fused_collection"] = {"value": 2}
+    d = ps.diff(rep, base)
+    assert not d["ok"]
+    assert d["unexplained_accepted"]
+
+
+def test_sentinel_stale_accepted_fails():
+    """An accepted regression that no longer regresses must be removed —
+    the ratchet tightens."""
+    ps = _load_sentinel()
+    base = _synthetic_baseline()
+    base["accepted"]["structural:sync_collectives_fused_collection"] = {
+        "value": 2, "why": "was split; fixed since"
+    }
+    d = ps.diff(_synthetic_report(), base)
+    assert not d["ok"]
+    assert [s["key"] for s in d["stale_accepted"]] == [
+        "structural:sync_collectives_fused_collection"
+    ]
+
+
+def test_sentinel_latency_band_and_schedule_drift():
+    ps = _load_sentinel()
+    rep = _synthetic_report()
+    rep["latency"]["sync_us_fused_collection"]["value"] = 501.0  # > 100 * 5.0
+    d = ps.diff(rep, _synthetic_baseline())
+    assert not d["ok"]
+    assert [r["key"] for r in d["regressions"]] == ["latency:sync_us_fused_collection"]
+
+    rep2 = _synthetic_report()
+    rep2["structural"]["brand_new_counter"] = 7
+    d2 = ps.diff(rep2, _synthetic_baseline())
+    assert not d2["ok"]
+    assert any(r["kind"] == "new-key" for r in d2["schedule_drift"])
+
+    d3 = ps.diff(_synthetic_report(), None)
+    assert not d3["ok"] and "PERF_BASELINE.json" in d3["error"]
+
+
+def test_checked_in_baseline_is_well_formed():
+    ps = _load_sentinel()
+    base = ps.load_baseline()
+    assert base is not None
+    assert base["schema"] == 1
+    assert base["structural"] and base["model"] and base["latency"]
+    for key, env in base["latency"].items():
+        assert env["value"] > 0 and env["band"] > 1.0, key
+    for name, agg in base["model"].items():
+        assert agg["execs"] >= 1 and agg["bytes"] > 0, name
+        assert agg["regime"] in ("bandwidth-bound", "compute-bound")
+    # accepted entries (if any ever land) must all carry a why
+    for key, acc in base.get("accepted", {}).items():
+        assert str(acc.get("why", "")).strip(), key

@@ -112,6 +112,16 @@ def test_instrumented_fused_collection_eval(tmp_path):
     assert any(entry["ph"] == "X" for entry in slices)
 
 
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report",
+        os.path.join(os.path.dirname(__file__), "..", "..", "tools", "trace_report.py"),
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+    return trace_report
+
+
 def test_jsonl_roundtrip_through_trace_report(tmp_path):
     """The JSONL export replays through tools/trace_report.py into a
     summary that names launches, causes, and percentiles."""
@@ -124,19 +134,90 @@ def test_jsonl_roundtrip_through_trace_report(tmp_path):
     path = tmp_path / "t.jsonl"
     session.export_jsonl(str(path))
 
-    spec = importlib.util.spec_from_file_location(
-        "trace_report",
-        os.path.join(os.path.dirname(__file__), "..", "..", "tools", "trace_report.py"),
-    )
-    trace_report = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(trace_report)
-
+    trace_report = _load_trace_report()
     events = trace_report.load_events(str(path))
     assert len(events) == len(session.events)
     report = trace_report.summarize(events)
     assert "update:aot" in report
     assert "cause first-compile" in report
     assert "p50 us" in report
+
+
+def test_trace_report_roofline_section_roundtrip(tmp_path):
+    """Launch spans carrying cost-model attrs replay into the roofline
+    section: every instrumented config ranks with its regime, model
+    intensity, and achieved rates — relative basis on CPU."""
+    from metrics_tpu.analysis import cost_model
+
+    rng = np.random.RandomState(21)
+    m = Accuracy(num_classes=C, jit_update=True)
+    col = MetricCollection(
+        {"acc": Accuracy(num_classes=C), "prec": Precision(num_classes=C)},
+        fused_update=True,
+    )
+    with telemetry.instrument() as session:
+        for _ in range(3):
+            m.update(*_batch(rng, 64))
+            col.update(*_batch(rng, 64))
+        jax.block_until_ready(m.tp)
+
+    path = tmp_path / "roofline.jsonl"
+    session.export_jsonl(str(path))
+    trace_report = _load_trace_report()
+    report = trace_report.summarize(trace_report.load_events(str(path)))
+
+    basis = "absolute" if cost_model.device_peaks() else "relative"
+    assert f"roofline ({basis} basis)" in report
+    assert "Accuracy:aot" in report
+    assert "MetricCollection:fused-aot" in report
+    assert "bandwidth-bound" in report or "compute-bound" in report
+
+
+def test_trace_report_handles_empty_and_blank_jsonl(tmp_path):
+    trace_report = _load_trace_report()
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert trace_report.load_events(str(empty)) == []
+    assert "empty trace" in trace_report.summarize([])
+
+    blank = tmp_path / "blank.jsonl"
+    blank.write_text("\n\n   \n")
+    assert trace_report.load_events(str(blank)) == []
+
+
+def test_trace_report_rejects_malformed_jsonl_cleanly(tmp_path):
+    """A malformed, truncated, or non-telemetry line is a clear one-line
+    error naming the file and line — never a traceback."""
+    trace_report = _load_trace_report()
+    cases = {
+        "malformed.jsonl": 'not json at all\n',
+        # a write cut mid-record (crash/disk-full) leaves a truncated tail
+        "truncated.jsonl": '{"name": "update", "kind": "aot"}\n{"name": "upd',
+        # parses as JSON but is not a telemetry record
+        "notdict.jsonl": '42\n',
+        "noname.jsonl": '{"kind": "aot"}\n',
+    }
+    for fname, content in cases.items():
+        path = tmp_path / fname
+        path.write_text(content)
+        with pytest.raises(SystemExit) as exc:
+            trace_report.load_events(str(path))
+        msg = str(exc.value)
+        assert fname in msg and "not a telemetry JSONL line" in msg, fname
+
+
+def test_trace_report_tolerates_sparse_events():
+    """Well-formed records missing optional fields (kind, attrs, dur) must
+    summarize without raising — forward-compat with older traces."""
+    trace_report = _load_trace_report()
+    report = trace_report.summarize(
+        [
+            {"name": "update"},
+            {"name": "compile", "attrs": None},
+            {"name": "collective", "attrs": {"nbytes": 64}},
+        ]
+    )
+    assert "update" in report
 
 
 # -------------------------------------------------------------- cause tagging

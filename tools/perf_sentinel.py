@@ -1,0 +1,442 @@
+#!/usr/bin/env python
+"""Roofline-attributed perf-regression sentinel.
+
+Usage::
+
+    python tools/perf_sentinel.py                   # human summary of this run
+    python tools/perf_sentinel.py --diff            # ratchet vs the checked-in
+        # PERF_BASELINE.json: exit 1 on NEW structural/model regressions, on
+        # latency outside its noise band, on stale accepted entries, or on
+        # accepted entries without a `why` — `make sentinel`
+    python tools/perf_sentinel.py --json            # full report as JSON
+    python tools/perf_sentinel.py --write-baseline  # accept this run as the
+        # new baseline (drops accepted regressions: they become the baseline)
+
+The sentinel runs the SAME ``bench._cfg_*`` schedule the bench-config pin
+tests run (``tests/bases/test_bench_configs.py`` pins the two equal — the
+dynamic capstone, mirroring how ``tools/static_audit.py`` pins its
+statically-derived collective counts) and splits every measured key into
+three fronts:
+
+* **structural** — launch / retrace / collective / bucket / wire-byte
+  counters. Deterministic on any backend; ANY drift from the baseline
+  fails, in either direction (an improvement must be re-baselined so the
+  ratchet tightens — STATIC_AUDIT semantics).
+* **model** — XLA ``cost_analysis`` flops / bytes per (owner, family)
+  aggregated from :mod:`metrics_tpu.analysis.cost_model` over the same
+  run, plus executable counts and the roofline regime of the aggregate
+  arithmetic intensity. Structural on CPU: the numbers come from the
+  compiled HLO, not the clock, so a silent "metric now moves 2x the
+  bytes" regression fails here even when the latency noise band hides it.
+* **latency** — wall-clock envelopes ``{value, band}``; the current value
+  must stay ``<= value * band``. One-sided: getting faster never fails.
+
+A regression can be *accepted* by adding it to the baseline's
+``accepted`` section with a ``why``; an accepted entry whose key no
+longer regresses is STALE and fails until removed (the ratchet must
+tighten), and an accepted entry without a ``why`` always fails.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # structural fronts never need a device
+
+_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "PERF_BASELINE.json"
+)
+
+# The measurement schedule: (config, bench fn name, kwargs at test-budget
+# scale, structural keys, latency keys). Scales and key lists mirror
+# tests/bases/test_bench_configs.py — the capstone test over there pins
+# collect()'s structural values equal to the live ``_cfg_*`` pins, so any
+# edit here that drifts from the bench schedule fails tier-1, not just
+# ``make sentinel``.
+SCHEDULE: Tuple[Tuple[str, str, Dict[str, Any], Tuple[str, ...], Tuple[str, ...]], ...] = (
+    (
+        "dispatch_engine",
+        "_cfg_dispatch_engine",
+        {},
+        (
+            "dispatch_count_single_metric_4_updates",
+            "retrace_count_intra_bucket_4_sizes",
+            "dispatch_count_fused_collection_10_updates",
+            "retrace_count_fused_collection_steady",
+            "retrace_count_bucketed_latency_pair",
+        ),
+        ("engine_update_us_b1024", "engine_update_us_b700_same_bucket"),
+    ),
+    (
+        "sync_engine",
+        "_cfg_sync_engine",
+        {},
+        (
+            "sync_collectives_fused_collection",
+            "sync_bucket_count_fused_collection",
+            "sync_bytes_fused_collection",
+            "sync_collectives_perleaf_collection",
+            "sync_bytes_perleaf_collection",
+        ),
+        ("sync_us_fused_collection", "sync_us_perleaf_collection"),
+    ),
+    (
+        "forward_engine",
+        "_cfg_forward_engine",
+        {},
+        (
+            "forward_launches_single_metric_10_steps",
+            "forward_retraces_single_metric_steady",
+            "forward_launches_fused_collection_10_steps",
+        ),
+        (
+            "forward_us_single_metric",
+            "forward_us_single_metric_eager",
+            "forward_us_fused_collection",
+        ),
+    ),
+    (
+        "telemetry_overhead",
+        "_cfg_telemetry_overhead",
+        {},
+        (),
+        ("telemetry_idle_overhead_ratio",),
+    ),
+    (
+        "streaming",
+        "_cfg_streaming",
+        {"steps": 40},
+        (
+            "window_retraces_1k_steps",
+            "window_dispatches_1k_steps",
+            "sketch_sync_collectives_2replica",
+            "sketch_sync_bytes_2replica",
+        ),
+        ("window_advance_us",),
+    ),
+    (
+        "read_path",
+        "_cfg_read_path",
+        {"sessions": 16, "reps": 3},
+        (
+            "read_second_unticked_launches",
+            "read_second_unticked_retraces",
+            "fleet_read_collectives",
+        ),
+        ("read_all_memoized_us", "read_fleet_us_2shards"),
+    ),
+)
+
+# Per-key noise-band overrides. The default wall-clock band is generous
+# (shared CI boxes): a real regression shows up in the structural/model
+# fronts long before a 5x latency blowout. The idle-overhead ratio is
+# already a ratio of two same-box measurements, so its band IS the pin
+# the bench-config test enforces (0 < ratio < 2.0).
+DEFAULT_BAND = 5.0
+BAND_OVERRIDES: Dict[str, float] = {"telemetry_idle_overhead_ratio": 2.0}
+
+
+def collect(only: Optional[Iterable[str]] = None) -> Dict[str, Any]:
+    """Run the (optionally restricted) schedule and return the report.
+
+    ``only`` restricts to a subset of config names — used by the capstone
+    test to pin the cheap structural configs without paying for the
+    latency-heavy ones. The model front is only meaningful for a full
+    run (the cost registry reflects whatever compiled), so restricted
+    runs still report it but diffs should use full runs.
+    """
+    import bench
+    from metrics_tpu.analysis import cost_model
+
+    wanted = None if only is None else set(only)
+    prev_aot = os.environ.pop("METRICS_TPU_AOT_CACHE", None)
+    cost_model.reset()
+    t0 = time.monotonic()
+    structural: Dict[str, Any] = {}
+    latency: Dict[str, Any] = {}
+    configs = []
+    try:
+        for name, fn_name, kwargs, skeys, lkeys in SCHEDULE:
+            if wanted is not None and name not in wanted:
+                continue
+            detail: Dict[str, Any] = {}
+            getattr(bench, fn_name)(detail, **kwargs)
+            configs.append(name)
+            for k in skeys:
+                structural[k] = detail[k]
+            for k in lkeys:
+                latency[k] = {
+                    "value": detail[k],
+                    "band": BAND_OVERRIDES.get(k, DEFAULT_BAND),
+                }
+    finally:
+        if prev_aot is not None:
+            os.environ["METRICS_TPU_AOT_CACHE"] = prev_aot
+
+    model: Dict[str, Any] = {}
+    for e in cost_model.entries().values():
+        agg = model.setdefault(
+            f"{e.owner}:{e.family}", {"execs": 0, "flops": 0.0, "bytes": 0.0}
+        )
+        agg["execs"] += 1
+        agg["flops"] += float(e.flops)
+        agg["bytes"] += float(e.bytes_accessed)
+    for agg in model.values():
+        intensity = agg["flops"] / agg["bytes"] if agg["bytes"] > 0 else 0.0
+        agg["intensity"] = round(intensity, 4)
+        agg["regime"] = cost_model.classify(intensity)
+
+    return {
+        "schema": 1,
+        "configs": configs,
+        "structural": structural,
+        "model": model,
+        "latency": latency,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+    }
+
+
+def load_baseline(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    path = path or _BASELINE
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_baseline(report: Dict[str, Any], path: Optional[str] = None) -> str:
+    path = path or _BASELINE
+    doc = {
+        "schema": report["schema"],
+        "configs": report["configs"],
+        "structural": report["structural"],
+        "model": report["model"],
+        "latency": report["latency"],
+        "accepted": {},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return os.path.abspath(path)
+
+
+def _flat_model(model: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten the model front to exact-match scalar keys."""
+    out: Dict[str, Any] = {}
+    for name, agg in model.items():
+        for field in ("execs", "flops", "bytes", "regime"):
+            out[f"{name}:{field}"] = agg.get(field)
+    return out
+
+
+def diff(report: Dict[str, Any], baseline: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """STATIC_AUDIT-style ratchet. Returns a dict with ``ok`` plus lists
+    of failures: ``regressions`` (new drift not in accepted),
+    ``stale_accepted`` (accepted entries that no longer regress),
+    ``unexplained_accepted`` (accepted without a ``why``), and
+    ``schedule_drift`` (keys added/removed vs the baseline)."""
+    if baseline is None:
+        return {
+            "ok": False,
+            "error": "no PERF_BASELINE.json — run `python tools/perf_sentinel.py --write-baseline`",
+            "regressions": [],
+            "stale_accepted": [],
+            "unexplained_accepted": [],
+            "schedule_drift": [],
+        }
+
+    accepted = baseline.get("accepted", {})
+    regressions = []
+    stale = []
+    unexplained = []
+    drift = []
+    used_accepted = set()
+
+    for key, acc in accepted.items():
+        if not isinstance(acc, dict) or not str(acc.get("why", "")).strip():
+            unexplained.append({"key": key, "entry": acc})
+
+    def check_exact(front: str, cur: Dict[str, Any], base: Dict[str, Any]) -> None:
+        for key in sorted(set(cur) | set(base)):
+            fq = f"{front}:{key}"
+            if key not in base:
+                drift.append({"key": fq, "kind": "new-key", "current": cur[key]})
+                continue
+            if key not in cur:
+                drift.append({"key": fq, "kind": "missing-key", "baseline": base[key]})
+                continue
+            if cur[key] == base[key]:
+                if fq in accepted:
+                    stale.append({"key": fq, "baseline": base[key], "current": cur[key]})
+                    used_accepted.add(fq)
+                continue
+            acc = accepted.get(fq)
+            if isinstance(acc, dict) and acc.get("value") == cur[key]:
+                used_accepted.add(fq)
+                continue
+            regressions.append(
+                {"key": fq, "baseline": base[key], "current": cur[key]}
+            )
+
+    check_exact("structural", report["structural"], baseline.get("structural", {}))
+    check_exact("model", _flat_model(report["model"]), _flat_model(baseline.get("model", {})))
+
+    base_lat = baseline.get("latency", {})
+    for key in sorted(set(report["latency"]) | set(base_lat)):
+        fq = f"latency:{key}"
+        if key not in base_lat:
+            drift.append({"key": fq, "kind": "new-key", "current": report["latency"][key]["value"]})
+            continue
+        if key not in report["latency"]:
+            drift.append({"key": fq, "kind": "missing-key", "baseline": base_lat[key]})
+            continue
+        cur = report["latency"][key]["value"]
+        env = base_lat[key]
+        limit = env["value"] * env.get("band", DEFAULT_BAND)
+        within = cur <= limit
+        acc = accepted.get(fq)
+        if within:
+            if fq in accepted:
+                stale.append({"key": fq, "limit": limit, "current": cur})
+                used_accepted.add(fq)
+            continue
+        if isinstance(acc, dict) and "value" in acc and cur <= float(acc["value"]) * env.get("band", DEFAULT_BAND):
+            used_accepted.add(fq)
+            continue
+        regressions.append({"key": fq, "limit": round(limit, 1), "current": cur})
+
+    for key in accepted:
+        if key not in used_accepted and not any(u["key"] == key for u in unexplained):
+            stale.append({"key": key, "kind": "unknown-key"})
+
+    ok = not (regressions or stale or unexplained or drift)
+    return {
+        "ok": ok,
+        "regressions": regressions,
+        "stale_accepted": stale,
+        "unexplained_accepted": unexplained,
+        "schedule_drift": drift,
+    }
+
+
+def summarize(report: Dict[str, Any]) -> str:
+    lines = ["== perf sentinel =="]
+    lines.append(
+        f"  {len(report['configs'])} configs in {report['elapsed_s']}s"
+        f" — {len(report['structural'])} structural,"
+        f" {len(report['model'])} model aggregates,"
+        f" {len(report['latency'])} latency envelopes"
+    )
+    lines.append("")
+    lines.append("== structural ==")
+    for k in sorted(report["structural"]):
+        lines.append(f"  {k} = {report['structural'][k]}")
+    lines.append("")
+    lines.append("== model (XLA cost_analysis, per owner:family) ==")
+    for name in sorted(report["model"]):
+        agg = report["model"][name]
+        lines.append(
+            f"  {name}: {agg['execs']} exec(s), {agg['flops']:.0f} flops,"
+            f" {agg['bytes']:.0f} bytes, intensity {agg['intensity']}"
+            f" ({agg['regime']})"
+        )
+    lines.append("")
+    lines.append("== latency envelopes ==")
+    for k in sorted(report["latency"]):
+        env = report["latency"][k]
+        lines.append(f"  {k} = {env['value']} (band x{env['band']})")
+    return "\n".join(lines)
+
+
+def summarize_diff(d: Dict[str, Any]) -> str:
+    if d.get("error"):
+        return f"FAIL: {d['error']}"
+    lines = []
+    if d["regressions"]:
+        lines.append(
+            f"FAIL: {len(d['regressions'])} perf regression(s) vs baseline"
+            " (fix, or accept in PERF_BASELINE.json `accepted` with a `why`):"
+        )
+        for r in d["regressions"]:
+            if "limit" in r:
+                lines.append(f"  + {r['key']}: {r['current']} > band limit {r['limit']}")
+            else:
+                lines.append(f"  + {r['key']}: {r['baseline']} -> {r['current']}")
+    if d["stale_accepted"]:
+        lines.append(
+            f"FAIL: {len(d['stale_accepted'])} STALE accepted entr(ies) — no longer"
+            " regressing; remove from `accepted` (tighten the ratchet):"
+        )
+        for r in d["stale_accepted"]:
+            lines.append(f"  - {r['key']}")
+    if d["unexplained_accepted"]:
+        lines.append(
+            f"FAIL: {len(d['unexplained_accepted'])} accepted entr(ies) without a `why`:"
+        )
+        for r in d["unexplained_accepted"]:
+            lines.append(f"  ? {r['key']}")
+    if d["schedule_drift"]:
+        lines.append(
+            f"FAIL: {len(d['schedule_drift'])} schedule-drift key(s)"
+            " (measurement set changed — re-baseline with --write-baseline):"
+        )
+        for r in d["schedule_drift"]:
+            lines.append(f"  ~ {r['key']} [{r['kind']}]")
+    if d["ok"]:
+        lines.append(
+            "OK: perf matches baseline (no regressions, no stale accepted"
+            " entries, all accepted regressions explained)"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("--json", action="store_true", help="emit the full report as JSON")
+    parser.add_argument(
+        "--diff", action="store_true",
+        help="ratchet against the checked-in baseline; exit 1 on drift",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept this run as the new PERF_BASELINE.json",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline path override (default: repo PERF_BASELINE.json)",
+    )
+    parser.add_argument(
+        "--only", default=None,
+        help="comma-separated config subset (debugging; diffs want full runs)",
+    )
+    args = parser.parse_args(argv)
+
+    only = args.only.split(",") if args.only else None
+    report = collect(only=only)
+
+    if args.write_baseline:
+        path = write_baseline(report, args.baseline)
+        print(f"wrote {path} ({len(report['structural'])} structural keys,"
+              f" {len(report['model'])} model aggregates,"
+              f" {len(report['latency'])} latency envelopes)")
+        return 0
+    if args.diff:
+        d = diff(report, load_baseline(args.baseline))
+        print(summarize_diff(d))
+        return 0 if d["ok"] else 1
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        print()
+        return 0
+    print(summarize(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,9 +41,16 @@ def load_events(path: str) -> List[Dict[str, Any]]:
             if not line:
                 continue
             try:
-                events.append(json.loads(line))
+                event = json.loads(line)
             except json.JSONDecodeError as err:
                 raise SystemExit(f"{path}:{line_no}: not a telemetry JSONL line ({err})")
+            # a truncated tail can still parse (e.g. a bare number) — every
+            # telemetry record is an object with at least a span name
+            if not isinstance(event, dict) or "name" not in event:
+                raise SystemExit(
+                    f"{path}:{line_no}: not a telemetry JSONL line (no span name)"
+                )
+            events.append(event)
     return events
 
 
@@ -113,6 +120,64 @@ def summarize(events: List[Dict[str, Any]]) -> str:
         by_kind.setdefault(e.get("kind", "?"), []).append(int((e.get("attrs") or {}).get("nbytes", 0)))
     for kind in sorted(by_kind):
         lines.append(f"  {kind:<8}{len(by_kind[kind]):>5} launches, {sum(by_kind[kind]):>10} bytes")
+
+    # roofline attribution (metrics_tpu.analysis.cost_model): every launch
+    # span that rode a cost-registry entry carries model flops/bytes and
+    # achieved rates. Configs rank by DISTANCE to the roofline — the
+    # farthest-from-ceiling bandwidth-bound configs are the Pallas-kernel
+    # targets ROADMAP item 3 names. On TPU the fraction is absolute
+    # (device peak table); on CPU it is relative to the best achieved
+    # rate in this trace (structural ordering, advisory magnitudes).
+    costed = [
+        e for e in events
+        if (e.get("attrs") or {}).get("model_flops") is not None
+    ]
+    if costed:
+        by_cfg: Dict[str, List[Dict[str, Any]]] = {}
+        for e in costed:
+            cfg = f"{e.get('owner', '?')}:{e.get('kind', '?')}"
+            by_cfg.setdefault(cfg, []).append(e)
+        rows = []
+        for cfg, evs in by_cfg.items():
+            a0 = evs[0].get("attrs") or {}
+            best_gflops = max(float((e.get("attrs") or {}).get("achieved_gflops", 0.0)) for e in evs)
+            best_gbps = max(float((e.get("attrs") or {}).get("achieved_gbps", 0.0)) for e in evs)
+            frac = max(float((e.get("attrs") or {}).get("roofline_frac", 0.0)) for e in evs)
+            rows.append({
+                "cfg": cfg,
+                "n": len(evs),
+                "flops": float(a0.get("model_flops", 0.0)),
+                "bytes": float(a0.get("model_bytes", 0.0)),
+                "intensity": float(a0.get("intensity", 0.0)),
+                "regime": str(a0.get("regime", "?")),
+                "basis": str(a0.get("roofline_basis", "relative")),
+                "gflops": best_gflops,
+                "gbps": best_gbps,
+                "frac": frac,
+            })
+        # relative basis: normalize each regime's wall against the best
+        # achieved rate for that wall anywhere in this trace
+        top_gbps = max((r["gbps"] for r in rows), default=0.0)
+        top_gflops = max((r["gflops"] for r in rows), default=0.0)
+        for r in rows:
+            if r["basis"] != "absolute" or r["frac"] <= 0.0:
+                if r["regime"] == "compute-bound" and top_gflops > 0:
+                    r["frac"] = r["gflops"] / top_gflops
+                elif top_gbps > 0:
+                    r["frac"] = r["gbps"] / top_gbps
+        rows.sort(key=lambda r: (1.0 - r["frac"], -r["bytes"]), reverse=True)
+        basis = rows[0]["basis"] if rows else "relative"
+        lines.append("")
+        lines.append(f"roofline ({basis} basis), ranked by distance to roofline:")
+        lines.append(
+            f"  {'config':<36}{'launches':>9}{'intensity':>11}  {'regime':<16}"
+            f"{'GB/s':>9}{'GFLOP/s':>10}{'of roof':>9}"
+        )
+        for r in rows:
+            lines.append(
+                f"  {r['cfg']:<36}{r['n']:>9}{r['intensity']:>11.3f}  {r['regime']:<16}"
+                f"{r['gbps']:>9.2f}{r['gflops']:>10.2f}{100.0 * r['frac']:>8.1f}%"
+            )
 
     # persistent AOT cache + in-process LRU churn (metrics_tpu.aot_cache):
     # hits are warm starts (compile cause persistent-cache-hit above),
