@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load doctest audit sentinel bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load kernels doctest audit sentinel bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -70,7 +70,16 @@ chaos:
 	$(MAKE) crash
 	$(MAKE) load
 	$(MAKE) chaos-elastic
+	$(MAKE) kernels
 	$(MAKE) sentinel
+
+# kernel-registry lane (docs/kernels.md): interpret-mode bitwise parity of
+# every Pallas kernel vs its lax fallback + jaxpr launch-count pins +
+# kill-switch / fault-demotion matrix, then the kernel-vs-lax bench config
+# at sentinel scale (includes the window_tick_launches == 1 pin)
+kernels:
+	python -m pytest tests/ops/ -q
+	python -c "import json, bench; d = {}; bench._cfg_kernels(d, reps=3); print(json.dumps(d, indent=2))"
 
 # kill-and-recover loop: for EVERY registered crash point a subprocess is
 # SIGKILLed at that instruction, then a fresh process recover()s

@@ -1049,6 +1049,97 @@ def _cfg_streaming(detail: dict, steps: int = 1000) -> None:
     detail["sketch_sync_bytes_2replica"] = ts.bytes_on_wire
 
 
+def _cfg_kernels(detail: dict, reps: int = 20) -> None:
+    """The ops/ kernel registry (docs/kernels.md): kernel-vs-lax latency
+    pairs per registered op, plus the structural pins behind the
+    registry's contract.
+
+    Each Pallas op is measured BOTH ways at one fixed shape — the hand
+    kernel (``force_pallas=True``; interpret mode off-TPU, so CPU numbers
+    are structural comparisons only — the compiled Mosaic pair is the
+    BASELINE.md capture) and the production lax formulation. Structural
+    pins: a fused ``SlidingWindow`` tick is ONE dispatch per tick
+    (``window_tick_launches``), every registered kernel engages under
+    force (``kernels_engaged_forced``), and the registry census
+    (``kernels_registered``) catches a kernel dropping out of
+    registration. The per-kernel analytic flops/bytes land in the cost
+    registry during this config, which is what the sentinel's model front
+    ratchets as ``ops.<name>:kernel`` entries."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, SlidingWindow, ops, profiling
+
+    rng = np.random.RandomState(23)
+    n, c = 512, 16
+    target = jnp.asarray(rng.randint(0, c, n))
+    pred = jnp.asarray(rng.randint(0, c, n))
+    correct = (pred == target).astype(jnp.float32)
+    w = jnp.ones(n, jnp.float32)
+    preds1d = jnp.asarray(rng.rand(n).astype(np.float32))
+    bits = jnp.asarray(rng.randint(0, 2**31, n).astype(np.uint32))
+    seeds = jnp.arange(4, dtype=jnp.uint32) * jnp.uint32(0x9E3779B9) + jnp.uint32(1)
+    value = jnp.zeros((4, 1024), jnp.float32)
+    probs = jnp.asarray(rng.rand(256, 4).astype(np.float32))
+    ml = jnp.asarray(rng.randint(0, 2, (256, 4)))
+    thr = jnp.linspace(0, 1, 16)
+
+    cases = {
+        "stat_scores": lambda f: ops.stat_scores_counts(target, pred, correct, w, c, force_pallas=f),
+        "confusion_matrix": lambda f: ops.confusion_matrix_counts(target, pred, c, force_pallas=f),
+        "retrieval_sort": lambda f: ops.sorted_by_preds(preds1d, target, force_pallas=f),
+        "countmin_scatter": lambda f: ops.countmin_update(value, bits, w, seeds, force_pallas=f),
+        "binned_stats": lambda f: ops.binned_stat_scores(probs, ml, thr, force_pallas=f),
+    }
+
+    def _best_us(fn):
+        jax.block_until_ready(fn())  # warmup compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn()
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / reps * 1e6)
+        return round(best, 1)
+
+    ops.reset_stats()
+    for name, call in cases.items():
+        detail[f"{name}_kernel_us"] = _best_us(lambda: call(True))
+        detail[f"{name}_lax_us"] = _best_us(lambda: call(False))
+
+    # fused window tick: whole gather+update+scatter+advance sequence as
+    # ONE dispatch per tick, vs the eager multi-launch tick
+    ticks = 8
+    probs_w = jnp.asarray(rng.rand(64, 8).astype(np.float32))
+    labels_w = jnp.asarray(rng.randint(0, 8, 64))
+    fused = SlidingWindow(Accuracy(num_classes=8, average="macro"), window=8, slide=2, jit_update=False)
+    ops.fused_window_tick(fused, (probs_w, labels_w), {})  # warmup compile
+    jax.block_until_ready(fused.cursor)
+    with profiling.track_dispatches() as t:
+        for _ in range(ticks):
+            ops.fused_window_tick(fused, (probs_w, labels_w), {})
+        jax.block_until_ready(fused.cursor)
+    detail["window_tick_launches"] = t.dispatch_count() // ticks
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        ops.fused_window_tick(fused, (probs_w, labels_w), {})
+    jax.block_until_ready(fused.cursor)
+    detail["window_tick_fused_us"] = round((time.perf_counter() - t0) / ticks * 1e6, 1)
+
+    eager = SlidingWindow(Accuracy(num_classes=8, average="macro"), window=8, slide=2, jit_update=False)
+    eager.update(probs_w, labels_w)  # warmup
+    jax.block_until_ready(eager.cursor)
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        eager.update(probs_w, labels_w)
+    jax.block_until_ready(eager.cursor)
+    detail["window_tick_eager_us"] = round((time.perf_counter() - t0) / ticks * 1e6, 1)
+
+    detail["kernels_registered"] = len(ops.names())
+    detail["kernels_engaged_forced"] = sum(len(v) for v in ops.engaged().values())
+
+
 def _cfg_read_path(detail: dict, sessions: int = 64, reps: int = 20) -> None:
     """The O(1) read path (ROADMAP items 4+5): four claims.
 
@@ -1728,6 +1819,7 @@ def _bench_detail() -> dict:
         ("serve_updates_per_sec_1k_sessions", _cfg_serving),
         ("wal_append_overhead_ratio", _cfg_crash_recovery),
         ("window_advance_us", _cfg_streaming),
+        ("kernel_vs_lax_us", _cfg_kernels),
         ("request_tracing_idle_overhead_ratio", _cfg_request_tracing),
         ("fabric_updates_per_sec", _cfg_fabric),
         ("read_path_second_read_launches", _cfg_read_path),
@@ -1958,6 +2050,7 @@ def _bench_detail_fast() -> dict:
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),
         ("retrieval", _cfg_retrieval),
+        ("kernels", lambda d: _cfg_kernels(d, reps=3)),
         ("coco_map", _cfg_coco),
         ("fid_stream", _cfg_fid_stream),
         ("kid_compute", _cfg_kid_compute),

@@ -149,6 +149,38 @@ def record(owner: str, family: str, key: Any, compiled: Any) -> Optional[CostEnt
     return entry
 
 
+def record_static(
+    owner: str,
+    family: str,
+    key: Any,
+    *,
+    flops: float,
+    bytes_accessed: float,
+    arg_bytes: float = 0.0,
+    out_bytes: float = 0.0,
+) -> Optional[CostEntry]:
+    """Register an analytically-derived entry (no compiled object).
+
+    Pallas kernels — interpret-mode runs especially — expose no usable
+    ``cost_analysis()``, so :mod:`metrics_tpu.ops` derives the model terms
+    from shapes in closed form. Deterministic across backends, which is
+    what lets the perf sentinel ratchet per-kernel flops/bytes exactly.
+    """
+    entry = CostEntry(
+        owner=str(owner),
+        family=str(family),
+        key_id=_key_id(owner, family, key),
+        flops=float(flops),
+        bytes_accessed=float(bytes_accessed),
+        peak_temp_bytes=0.0,
+        arg_bytes=float(arg_bytes),
+        out_bytes=float(out_bytes),
+    )
+    with _lock:
+        _registry[entry.key_id] = entry
+    return entry
+
+
 def lookup(key_id: str) -> Optional[CostEntry]:
     with _lock:
         return _registry.get(key_id)

@@ -103,7 +103,7 @@ def _program_facts(fn: Callable, *trace_args: Any) -> Dict[str, Any]:
             "error": {"rule": code, "type": type(err).__name__, "why": why},
             "collectives": None, "callbacks": None, "eqns": None, "out": None,
         }
-    collectives = callbacks = total = 0
+    collectives = callbacks = pallas = total = 0
     for eqn in iter_eqns(closed.jaxpr):
         total += 1
         prim = eqn.primitive.name
@@ -111,10 +111,13 @@ def _program_facts(fn: Callable, *trace_args: Any) -> Dict[str, Any]:
             collectives += 1
         elif prim in CALLBACK_PRIMS or "callback" in prim or prim == "debug_print":
             callbacks += 1
+        elif prim == "pallas_call":
+            pallas += 1
     return {
         "error": None,
         "collectives": collectives,
         "callbacks": callbacks,
+        "pallas": pallas,
         "eqns": total,
         "out": out_shape,
     }
@@ -314,6 +317,38 @@ def audit_metric(case: registry.AuditCase, pools: Dict[str, Any]) -> Tuple[Dict[
     return facts, findings
 
 
+def audit_kernel(case: registry.AuditCase, pools: Dict[str, Any]) -> Tuple[Dict[str, Any], List[Finding]]:
+    """Facts + findings for one :mod:`metrics_tpu.ops` kernel case.
+
+    Both formulations of the op must abstract-trace — the Pallas body
+    (``force_pallas=True``; interpret-mode lowering, so this works on the
+    CPU audit box) and the production lax path. Trace failures surface
+    with the same rule codes as metric programs, at P0: an op that cannot
+    trace would break every engine program that embeds it. The kernel
+    trace also records its ``pallas_call`` count — the structural fact
+    ``tests/ops/`` pins to exactly 1 (forced) / 0 (fallback).
+    """
+    fn = case.build()
+    args = case.args(pools)
+    findings: List[Finding] = []
+    programs: Dict[str, Any] = {}
+    for formulation, force in (("kernel", True), ("lax", False)):
+        pf = _program_facts(lambda *a, _f=force: fn(*a, force_pallas=_f), *args)
+        pf.pop("out", None)
+        if pf["error"] is not None:
+            findings.append(Finding(
+                pf["error"]["rule"], "P0", case.name, formulation,
+                f"{formulation} formulation: {pf['error']['why']}",
+            ))
+        programs[formulation] = pf
+    return {
+        "scope": "kernel",
+        "states": {},
+        "programs": programs,
+        "hazards": {"static-key": False, "signature": False},
+    }, findings
+
+
 def audit_structural(case: registry.AuditCase) -> Dict[str, Any]:
     """Facts for non-device scopes: states (when constructible), no traces."""
     facts: Dict[str, Any] = {"scope": case.scope, "states": {}, "programs": {}, "hazards": {"static-key": False, "signature": False}}
@@ -330,18 +365,20 @@ def audit_structural(case: registry.AuditCase) -> Dict[str, Any]:
 
 
 def run_audit(cases: Optional[List[registry.AuditCase]] = None) -> Tuple[Dict[str, Any], List[Finding]]:
-    """Sweep the registry: ``{metric: facts}`` + the full finding list."""
+    """Sweep the registry (metrics AND ops/ kernels): ``{name: facts}`` +
+    the full finding list."""
     if cases is None:
-        cases = registry.audit_cases()
+        cases = registry.audit_cases() + registry.kernel_cases()
     pools = registry.example_inputs()
     all_facts: Dict[str, Any] = {}
     findings: List[Finding] = []
     for case in cases:
-        if case.scope == "device":
+        if case.scope in ("device", "kernel"):
+            audit_one = audit_metric if case.scope == "device" else audit_kernel
             try:
-                facts, fs = audit_metric(case, pools)
+                facts, fs = audit_one(case, pools)
             except Exception as err:  # noqa: BLE001 — a broken case must not hide the rest
-                facts = {"scope": "device", "states": {}, "programs": {},
+                facts = {"scope": case.scope, "states": {}, "programs": {},
                          "hazards": {"static-key": False, "signature": False}}
                 fs = [Finding("JX000", "P0", case.name, "registry",
                               f"audit case failed outside tracing: {type(err).__name__}: {err}")]

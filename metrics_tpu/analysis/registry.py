@@ -224,6 +224,97 @@ def _wrapper_builds():
     }
 
 
+def _kernel_table():
+    """(op-callable builder, example-args) per registered ops/ kernel.
+
+    Each build returns a callable ``fn(*arrays, force_pallas=...)`` closing
+    over the op's static parameters, so the kernel sweep can abstract-trace
+    BOTH formulations of the same op — ``force_pallas=True`` (the Pallas
+    body) and ``force_pallas=False`` (the production lax path) — from one
+    entry. ``window_tick`` has no Pallas body (it is a fused-jit program);
+    its callable ignores the flag and traces the one-launch tick program.
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import ops
+
+    def binned_build():
+        thresholds = jnp.linspace(0.0, 1.0, 8, dtype=jnp.float32)
+        return lambda preds, target, force_pallas=None: ops.binned_stat_scores(
+            preds, target, thresholds, force_pallas=force_pallas
+        )
+
+    def stat_build():
+        return lambda t, p, c, w, force_pallas=None: ops.stat_scores_counts(
+            t, p, c, w, _C, force_pallas=force_pallas
+        )
+
+    def stat_args(pools):
+        target = pools["labels"].astype(jnp.int32)
+        pred = jnp.roll(target, 1)
+        correct = (pred == target).astype(jnp.float32)
+        return target, pred, correct, jnp.ones(_B, jnp.float32)
+
+    def confmat_build():
+        return lambda t, p, force_pallas=None: ops.confusion_matrix_counts(
+            t, p, _C, force_pallas=force_pallas
+        )
+
+    def countmin_build():
+        seeds = jnp.arange(2, dtype=jnp.uint32) * jnp.uint32(0x9E3779B9) + jnp.uint32(1)
+        value = jnp.zeros((2, 128), jnp.float32)
+        return lambda bits, w, force_pallas=None: ops.countmin_update(
+            value, bits, w, seeds, force_pallas=force_pallas
+        )
+
+    def countmin_args(pools):
+        bits = pools["labels"].astype(jnp.uint32)
+        return bits, jnp.ones(_B, jnp.float32)
+
+    def tick_build():
+        import metrics_tpu as M
+
+        window = M.SlidingWindow(M.Accuracy(num_classes=_C, average="macro"), window=4, slide=2)
+        state = window.default_state()
+        return lambda probs, labels, force_pallas=None: window.pure_update(state, probs, labels)
+
+    return {
+        "binned_stats": (binned_build, lambda pools: (pools["probs"], pools["ml_labels"])),
+        "stat_scores": (stat_build, stat_args),
+        "confusion_matrix": (
+            confmat_build,
+            lambda pools: (pools["labels"].astype("int32"), pools["labels"].astype("int32")),
+        ),
+        "retrieval_sort": (
+            lambda: (lambda p, t, force_pallas=None: ops.sorted_by_preds(p, t, force_pallas=force_pallas)),
+            lambda pools: (pools["bin_scores"], pools["bin_labels"]),
+        ),
+        "countmin_scatter": (countmin_build, countmin_args),
+        "window_tick": (tick_build, lambda pools: (pools["probs"], pools["labels"])),
+    }
+
+
+def kernel_cases() -> List[AuditCase]:
+    """Every :mod:`metrics_tpu.ops` registry entry, as an audit case.
+
+    Mirrors the exhaustiveness contract of :func:`audit_cases`: a kernel
+    registered in ``ops.registry`` without an entry here surfaces as an
+    ``unclassified`` case — a P0 (JX000) registry gap in the report — so a
+    new kernel cannot escape the static sweep.
+    """
+    from metrics_tpu.ops import registry as ops_registry
+
+    table = _kernel_table()
+    cases: List[AuditCase] = []
+    for name in ops_registry.names():
+        if name in table:
+            build, args = table[name]
+            cases.append(AuditCase(f"ops.{name}", "kernel", build, args, ops_registry.get(name).doc))
+        else:
+            cases.append(AuditCase(f"ops.{name}", "unclassified", None, None, "no kernel audit entry"))
+    return cases
+
+
 def example_inputs():
     """One pool of example input arrays shared by a whole audit sweep."""
     return _inputs()

@@ -74,6 +74,7 @@ def build_report() -> Dict[str, Any]:
         "summary": {
             "metrics_swept": len(facts),
             "device_traced": sum(1 for v in facts.values() if v.get("scope") == "device"),
+            "kernels_swept": sum(1 for v in facts.values() if v.get("scope") == "kernel"),
             "findings": counts,
         },
         "capstone": jaxpr_audit.classification_suite_sync_plan(),

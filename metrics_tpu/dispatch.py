@@ -74,6 +74,7 @@ import numpy as np
 from metrics_tpu import aot_cache, faults, telemetry
 from metrics_tpu._compat import profiler_annotation
 from metrics_tpu.analysis import cost_model, hazards
+from metrics_tpu.ops import registry as ops_registry
 from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
 
 Array = jax.Array
@@ -515,7 +516,8 @@ class FastDispatcher:
             return loaded
         cause = self._retrace_cause("update", static_key, example_inputs)
         t0 = time.perf_counter()
-        compiled = jitted.lower(*export_args).compile()
+        with ops_registry.lowering(self.label):
+            compiled = jitted.lower(*export_args).compile()
         self._persist("update", key, compiled, jitted, export_args)
         self._cost[key] = cost_model.record(self.label, "update", key, compiled)
 
@@ -569,7 +571,8 @@ class FastDispatcher:
             return loaded
         cause = self._retrace_cause("forward", static_key, example_inputs)
         t0 = time.perf_counter()
-        compiled = jitted.lower(*export_args).compile()
+        with ops_registry.lowering(self.label):
+            compiled = jitted.lower(*export_args).compile()
         self._persist("fwd", key, compiled, jitted, export_args)
         self._cost[key] = cost_model.record(self.label, "forward", key, compiled)
 

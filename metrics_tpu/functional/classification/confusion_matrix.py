@@ -65,12 +65,16 @@ def _confusion_matrix_update_matmul(
     its (C/cp, C) output block, never the full matrix (the bincount
     scatter has no such partitioning). float32 accumulation is exact for
     per-batch counts below 2^24. Layout contract: docs/distributed.md.
+
+    The matmul itself lives in ops/ as the lax half of the
+    ``confusion_matrix`` kernel, which fuses the one-hot expansion into
+    the contraction so the ``(B, C)`` operands never touch HBM (kernel
+    opt-in: docs/kernels.md).
     """
+    from metrics_tpu.ops import confusion_matrix_counts
+
     preds, target = _canonicalize_confmat_labels(preds, target, num_classes, threshold)
-    classes = jnp.arange(num_classes)
-    oh_t = (target.reshape(-1)[:, None] == classes[None, :]).astype(jnp.float32)
-    oh_p = (preds.reshape(-1)[:, None] == classes[None, :]).astype(jnp.float32)
-    return (oh_t.T @ oh_p).astype(jnp.int32)
+    return confusion_matrix_counts(target, preds, num_classes)
 
 
 def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:

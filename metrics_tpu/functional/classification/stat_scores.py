@@ -203,14 +203,12 @@ def _fast_multiclass_stat_scores(
     # vector [target, pred+C, target+2C] with weights [valid, valid,
     # correct]. One pass over 3B elements beats three B×C one-hot
     # reductions on XLA CPU by ~1.5×; masked (padded) rows carry weight 0
-    # so they contribute to nothing.
+    # so they contribute to nothing. The scatter lives in ops/ as the lax
+    # half of the stat_scores kernel (kernel opt-in: docs/kernels.md).
+    from metrics_tpu.ops import stat_scores_counts
+
     w = valid.astype(dtype) if valid is not None else jnp.ones(num_rows, dtype)
-    idx = jnp.concatenate([target_cls, pred_cls + num_classes, target_cls + 2 * num_classes])
-    wts = jnp.concatenate([w, w, correct.astype(dtype)])
-    counts = jnp.zeros(3 * num_classes, dtype).at[idx].add(wts)
-    targ_count = counts[:num_classes]
-    pred_count = counts[num_classes : 2 * num_classes]
-    tp = counts[2 * num_classes :]
+    targ_count, pred_count, tp = stat_scores_counts(target_cls, pred_cls, correct, w, num_classes)
     fp = pred_count - tp
     fn = targ_count - tp
     tn = (jnp.asarray(n_valid, dtype) - tp - fp - fn).astype(dtype)

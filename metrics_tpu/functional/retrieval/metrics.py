@@ -6,12 +6,18 @@ hit_rate.py, fall_out.py, ndcg.py, r_precision.py; 486 LoC). These are the
 single-query building blocks; the module metrics' batched compute path
 (:mod:`metrics_tpu.retrieval.base`) evaluates all queries at once on padded
 (Q, L) tensors instead of looping.
+
+Every metric here shares one grouping step — relevance labels reordered by
+descending score (:func:`metrics_tpu.ops.sorted_by_preds`), which carries
+both the production stable-argsort gather and an opt-in Pallas ranking
+kernel (docs/kernels.md).
 """
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops import sorted_by_preds
 from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
 
 Array = jax.Array
@@ -29,7 +35,7 @@ def retrieval_average_precision(preds: Array, target: Array) -> Array:
         0.8333
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
-    sorted_target = target[jnp.argsort(-preds, stable=True)]
+    sorted_target = sorted_by_preds(preds, target)
     rel = sorted_target > 0
     positions = jnp.arange(1, target.shape[0] + 1, dtype=jnp.float32)
     prec_at_rel = jnp.cumsum(rel, axis=0) / positions
@@ -49,7 +55,7 @@ def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
         0.5
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
-    sorted_target = target[jnp.argsort(-preds, stable=True)] > 0
+    sorted_target = sorted_by_preds(preds, target) > 0
     position = jnp.argmax(sorted_target)  # first True (0 if none, guarded below)
     return jnp.where(sorted_target.any(), 1.0 / (position + 1.0), 0.0)
 
@@ -72,7 +78,7 @@ def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, ad
         k = preds.shape[-1]
     if not (isinstance(k, int) and k > 0):
         raise ValueError("`k` has to be a positive integer or None")
-    sorted_target = target[jnp.argsort(-preds, stable=True)][:k]
+    sorted_target = sorted_by_preds(preds, target)[:k]
     relevant = (sorted_target > 0).sum().astype(jnp.float32)
     return jnp.where(target.sum() > 0, relevant / k, 0.0)
 
@@ -93,7 +99,7 @@ def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Ar
         k = preds.shape[-1]
     if not (isinstance(k, int) and k > 0):
         raise ValueError("`k` has to be a positive integer or None")
-    sorted_target = target[jnp.argsort(-preds, stable=True)][:k]
+    sorted_target = sorted_by_preds(preds, target)[:k]
     relevant = (sorted_target > 0).sum().astype(jnp.float32)
     n_rel = target.sum()
     return jnp.where(n_rel > 0, relevant / jnp.maximum(n_rel, 1), 0.0)
@@ -115,7 +121,7 @@ def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> 
         k = preds.shape[-1]
     if not (isinstance(k, int) and k > 0):
         raise ValueError("`k` has to be a positive integer or None")
-    relevant = (target[jnp.argsort(-preds, stable=True)][:k] > 0).sum()
+    relevant = (sorted_by_preds(preds, target)[:k] > 0).sum()
     return (relevant > 0).astype(jnp.float32)
 
 
@@ -135,7 +141,7 @@ def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> 
     if not (isinstance(k, int) and k > 0):
         raise ValueError("`k` has to be a positive integer or None")
     target = 1 - (target > 0)  # fraction of non-relevant retrieved among non-relevant
-    relevant = target[jnp.argsort(-preds, stable=True)][:k].sum().astype(jnp.float32)
+    relevant = sorted_by_preds(preds, target)[:k].sum().astype(jnp.float32)
     n_nonrel = target.sum()
     return jnp.where(n_nonrel > 0, relevant / jnp.maximum(n_nonrel, 1), 0.0)
 
@@ -161,7 +167,7 @@ def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = Non
     k = preds.shape[-1] if k is None else k
     if not (isinstance(k, int) and k > 0):
         raise ValueError("`k` has to be a positive integer or None")
-    sorted_target = target[jnp.argsort(-preds, stable=True)][:k]
+    sorted_target = sorted_by_preds(preds, target)[:k]
     ideal_target = jnp.sort(target)[::-1][:k]
     ideal_dcg = _dcg(ideal_target.astype(jnp.float32))
     target_dcg = _dcg(sorted_target.astype(jnp.float32))
@@ -185,5 +191,5 @@ def retrieval_r_precision(preds: Array, target: Array) -> Array:
         raise ValueError("retrieval_r_precision requires concrete targets (top-r slicing is data dependent)")
     if not relevant_number:
         return jnp.asarray(0.0)
-    relevant = (target[jnp.argsort(-preds, stable=True)][:relevant_number] > 0).sum().astype(jnp.float32)
+    relevant = (sorted_by_preds(preds, target)[:relevant_number] > 0).sum().astype(jnp.float32)
     return relevant / relevant_number

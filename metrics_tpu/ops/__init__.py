@@ -1,11 +1,41 @@
-"""TPU-native fused kernels (Pallas) for hot metric ops.
+"""Hand-scheduled TPU kernels with bit-exact lax fallbacks.
 
-Every kernel here is bit-exact with the plain XLA formulation that the
-metrics dispatch by default (measured faster — see binned_stats.py module
-docstring for numbers). Set ``METRICS_TPU_FORCE_PALLAS=1`` to opt in to the
-Pallas path on TPU backends; off-TPU the kernels run in interpret mode for
-parity testing.
+Each op here carries two formulations selected by
+:mod:`metrics_tpu.ops.registry` — a Pallas TPU kernel (opt-in via
+``METRICS_TPU_FORCE_PALLAS=1`` or ``force_pallas=True``; interpret mode
+off-TPU so parity pins run on the CI backend) and the production lax
+path. See docs/kernels.md for the registry, the opt-in knobs, and the
+parity-pin contract.
 """
-from metrics_tpu.ops.binned_stats import binned_stat_scores, pallas_enabled
+from metrics_tpu.ops.registry import (
+    engaged,
+    kernel_status,
+    names,
+    pallas_enabled,
+    refresh,
+    reset_stats,
+    specs,
+)
+from metrics_tpu.ops.binned_stats import binned_stat_scores
+from metrics_tpu.ops.confusion import confusion_matrix_counts
+from metrics_tpu.ops.retrieval import sorted_by_preds
+from metrics_tpu.ops.sketch_ops import countmin_update, hash_u32
+from metrics_tpu.ops.stat_scores import stat_scores_counts
+from metrics_tpu.ops.window_tick import fused_window_tick
 
-__all__ = ["binned_stat_scores", "pallas_enabled"]
+__all__ = [
+    "binned_stat_scores",
+    "confusion_matrix_counts",
+    "countmin_update",
+    "engaged",
+    "fused_window_tick",
+    "hash_u32",
+    "kernel_status",
+    "names",
+    "pallas_enabled",
+    "refresh",
+    "reset_stats",
+    "sorted_by_preds",
+    "specs",
+    "stat_scores_counts",
+]

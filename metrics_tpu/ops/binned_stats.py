@@ -21,30 +21,23 @@ TPU-first answer here is to let the compiler fuse. The kernel stays available
 via ``METRICS_TPU_FORCE_PALLAS=1`` (or ``force_pallas=True``) and is kept
 bit-exact with the XLA path by tests/classification/test_pallas_binned.py.
 """
-import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # pltpu only imports on builds with mosaic support
-    from jax.experimental.pallas import tpu as pltpu
-except (ImportError, ModuleNotFoundError):  # pragma: no cover
-    pltpu = None
+from metrics_tpu.ops import registry
+from metrics_tpu.ops.registry import pallas_enabled  # noqa: F401 — back-compat export
 
 _BN = 128  # batch tile (sublane-friendly)
 
-
-def pallas_enabled() -> bool:
-    """Whether the Pallas path is dispatched by default.
-
-    Off by default: the measured XLA fusion is faster for this op (see module
-    docstring). Set ``METRICS_TPU_FORCE_PALLAS=1`` to opt in on TPU backends.
-    """
-    if pltpu is None:
-        return False
-    return os.environ.get("METRICS_TPU_FORCE_PALLAS", "0") == "1"
+registry.register(
+    "binned_stats",
+    "pallas",
+    ("Binned",),
+    "binned TP/FP/FN threshold sweep with grid-revisited accumulators",
+)
 
 
 def _binned_kernel(preds_ref, target_ref, thr_ref, tp_ref, p_ref, pos_ref):
@@ -127,15 +120,21 @@ def binned_stat_scores(preds, target, thresholds, force_pallas=None):
     XLA path. Shapes whose compare tile would exceed VMEM always take XLA.
     """
     target = target == 1  # one canonicalization shared by both backends
-    use_pallas = pallas_enabled() if force_pallas is None else force_pallas
+    n, c = preds.shape
+    t = thresholds.shape[0]
     # compare tile (BN, C, T) f32 + two (C, T) accumulators must fit VMEM;
     # an empty batch would give Mosaic a zero-size grid — XLA returns zeros
-    if use_pallas and (
-        preds.shape[0] == 0
-        or (_BN + 2) * preds.shape[1] * thresholds.shape[0] * 4 > 12 * 2**20
-    ):
-        use_pallas = False
-    if not use_pallas:
+    eligible = n > 0 and (_BN + 2) * c * t * 4 <= 12 * 2**20
+    if not registry.resolve("binned_stats", force_pallas, eligible):
         return _binned_stat_scores_xla(preds, target, thresholds)
     interpret = jax.default_backend() != "tpu"
-    return _binned_stat_scores_pallas(preds, target, thresholds, interpret=interpret)
+    return registry.launch(
+        "binned_stats",
+        lambda: _binned_stat_scores_pallas(preds, target, thresholds, interpret=interpret),
+        lambda: _binned_stat_scores_xla(preds, target, thresholds),
+        cost_key=(n, c, t),
+        # the (N, C, T) broadcast compare + three weighted reductions
+        flops=4.0 * n * c * t,
+        # scores + targets read once, three (C, T) f32 outputs written
+        bytes_accessed=8.0 * n * c + 12.0 * c * t,
+    )

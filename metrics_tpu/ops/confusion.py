@@ -1,0 +1,122 @@
+"""One-pass fused confusion-matrix kernel.
+
+The class-parallel confmat formulation
+(``functional/classification/confusion_matrix.py``) materializes two
+``(B, C)`` one-hot operands in HBM and contracts them on the MXU::
+
+    confmat = onehot(target).T @ onehot(preds)
+
+This kernel fuses the expansion into the contraction: each batch tile
+builds its one-hot slices in VMEM only and folds ``oh_t.T @ oh_p`` into a
+grid-revisited ``(C, C)`` accumulator — the full one-hots never touch HBM.
+f32 accumulation of 0/1 products is exact below 2^24 per cell, so the
+int32 cast is bit-identical to the lax path.
+
+The lax fallback IS the production matmul formulation (post label
+canonicalization), moved here verbatim under the registry's parity
+contract (tests/ops/test_kernel_parity.py).
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from metrics_tpu.ops import registry
+
+_BN = 128  # batch tile (MXU-friendly contraction depth)
+
+registry.register(
+    "confusion_matrix",
+    "pallas",
+    ("ConfusionMatrix", "CohenKappa", "MatthewsCorrCoef"),
+    "confusion-matrix one-hot matmul fused into one tiled kernel",
+)
+
+
+def _confmat_kernel(target_ref, pred_ref, out_ref):
+    """One batch tile: expand one-hots in VMEM, contract, accumulate."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tgt = target_ref[:]  # (BN, 1) i32 (padding rows: -1 → all-zero rows)
+    prd = pred_ref[:]    # (BN, 1) i32
+    c = out_ref.shape[0]
+    class_idx = jax.lax.broadcasted_iota(jnp.int32, (1, c), 1)
+    oh_t = (tgt == class_idx).astype(jnp.float32)  # (BN, C)
+    oh_p = (prd == class_idx).astype(jnp.float32)
+    out_ref[:] += jax.lax.dot_general(
+        oh_t,
+        oh_p,
+        dimension_numbers=(((0,), (0,)), ((), ())),  # contract the batch dim
+        preferred_element_type=jnp.float32,
+    )
+
+
+@partial(jax.jit, static_argnames=("num_classes", "interpret"))
+def _confmat_pallas(target_cls, pred_cls, num_classes, interpret=False):
+    n = target_cls.shape[0]
+    n_pad = (-n) % _BN
+    # padding label -1 matches no class: an all-zero one-hot row
+    tgt = jnp.pad(target_cls.astype(jnp.int32), (0, n_pad), constant_values=-1).reshape(-1, 1)
+    prd = jnp.pad(pred_cls.astype(jnp.int32), (0, n_pad), constant_values=-1).reshape(-1, 1)
+    grid = (tgt.shape[0] // _BN,)
+
+    return pl.pallas_call(
+        _confmat_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BN, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BN, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((num_classes, num_classes), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_classes, num_classes), jnp.float32),
+        interpret=interpret,
+    )(tgt, prd)
+
+
+def _confmat_lax(target_cls, pred_cls, num_classes):
+    """Production formulation: materialized one-hot matmul."""
+    classes = jnp.arange(num_classes)
+    oh_t = (target_cls.reshape(-1)[:, None] == classes[None, :]).astype(jnp.float32)
+    oh_p = (pred_cls.reshape(-1)[:, None] == classes[None, :]).astype(jnp.float32)
+    return (oh_t.T @ oh_p).astype(jnp.int32)
+
+
+def confusion_matrix_counts(target_cls, pred_cls, num_classes, force_pallas=None):
+    """Unnormalized ``(C, C)`` int32 confusion matrix from class indices.
+
+    Bit-identical between both paths (exact 0/1 f32 accumulation).
+
+    ``force_pallas``: None → env-gated (``METRICS_TPU_FORCE_PALLAS=1``);
+    True → Pallas (interpret-mode off-TPU); False → the lax matmul.
+    """
+    n = target_cls.reshape(-1).shape[0]
+    # two (BN, C) one-hot tiles + the (C, C) accumulator must fit VMEM
+    eligible = (
+        0 < n < 2**24
+        and (2 * _BN * num_classes + num_classes * num_classes) * 4 <= 12 * 2**20
+    )
+    if not registry.resolve("confusion_matrix", force_pallas, eligible):
+        return _confmat_lax(target_cls, pred_cls, num_classes)
+    interpret = jax.default_backend() != "tpu"
+
+    def kernel_thunk():
+        counts = _confmat_pallas(
+            target_cls.reshape(-1), pred_cls.reshape(-1), num_classes, interpret=interpret
+        )
+        return counts.astype(jnp.int32)
+
+    return registry.launch(
+        "confusion_matrix",
+        kernel_thunk,
+        lambda: _confmat_lax(target_cls, pred_cls, num_classes),
+        cost_key=(n, num_classes),
+        # the (C, B) x (B, C) contraction
+        flops=2.0 * n * num_classes * num_classes,
+        # labels read once (2 x 4B), (C, C) f32 accumulator written
+        bytes_accessed=8.0 * n + 4.0 * num_classes * num_classes,
+    )

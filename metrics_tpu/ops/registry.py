@@ -1,0 +1,289 @@
+"""Kernel registry: the dispatch layer between hand-written Pallas kernels
+and their bit-exact lax fallbacks.
+
+Every op in :mod:`metrics_tpu.ops` carries two formulations of the same
+computation — a compiler-scheduled lax path (the production default) and a
+hand-tiled Pallas TPU kernel (opt-in). This module is the single place that
+decides, per call, which one runs:
+
+* **Opt-in knob** — ``force_pallas=`` tri-state on every op entry point.
+  ``None`` defers to the process-wide ``METRICS_TPU_FORCE_PALLAS`` switch
+  (sampled ONCE and cached — call :func:`refresh` after mutating the env in
+  tests); ``True``/``False`` override it per call.
+* **Eligibility** — each :class:`KernelSpec` names a shape/dtype guard
+  (VMEM budget, empty batches, unsupported backends). Ineligible calls take
+  the lax path silently; :func:`kernel_status` reports ``eligible`` for
+  owners a registered kernel *could* serve.
+* **Interpret mode off-TPU** — kernels always run (``interpret=True``) on
+  CPU/GPU backends, so every parity pin in ``tests/ops/`` executes the real
+  kernel body on the CI backend.
+* **Resilience demotion** — a kernel launch that raises (including an
+  injected ``launch`` fault) demotes that one kernel to its lax fallback
+  through a per-kernel :class:`~metrics_tpu.resilience.ResiliencePolicy`:
+  cause-tagged ``degrade`` span, exponential-backoff cooldown, automatic
+  re-promotion. Never permanent — the lax path is always a correct answer.
+* **Cost entries** — each successful kernel launch registers an
+  analytically-derived :mod:`~metrics_tpu.analysis.cost_model` entry
+  (family ``"kernel"``) and emits a roofline-attributed telemetry event, so
+  ``tools/trace_report.py`` and ``tools/perf_sentinel.py`` see kernels as
+  first-class executables next to the engine programs.
+
+The execution engines consult the registry **at lowering time**: both
+``FastDispatcher._compile`` paths open :func:`lowering` around their
+trace+compile step, which (a) lets a cooling-down kernel veto itself inside
+engine programs and (b) records which owners lowered with kernels engaged —
+that is what ``trace_report``'s ``kernel=yes`` column reads.
+"""
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from metrics_tpu import faults, telemetry
+from metrics_tpu.analysis import cost_model
+from metrics_tpu.resilience import (
+    ResiliencePolicy,
+    classify,
+    record_degrade,
+    resilience_enabled,
+)
+
+try:  # pltpu only imports on builds with mosaic support
+    from jax.experimental.pallas import tpu as pltpu
+except (ImportError, ModuleNotFoundError):  # pragma: no cover
+    pltpu = None
+
+__all__ = [
+    "KernelSpec",
+    "register",
+    "get",
+    "specs",
+    "names",
+    "pallas_enabled",
+    "refresh",
+    "resolve",
+    "launch",
+    "lowering",
+    "kernel_status",
+    "engaged",
+    "reset_stats",
+]
+
+_ENV = "METRICS_TPU_FORCE_PALLAS"
+
+_lock = threading.Lock()
+_REGISTRY: Dict[str, "KernelSpec"] = {}
+
+# env switch sampled once (satellite fix: the old per-call os.environ read
+# sat inside the update hot path); tests mutate the env then call refresh()
+_enabled_cache: Optional[bool] = None
+
+# owners whose engine lowering engaged >= 1 kernel (trace_report "yes")
+_engaged_by_owner: Dict[str, set] = {}
+# cost keys already recorded (one analytic entry per op x shape bucket)
+_costed: set = set()
+
+_lowering_owner = threading.local()
+
+
+class KernelSpec:
+    """One registered kernel: identity, coverage, analytic cost model.
+
+    Attributes:
+        name: registry key, e.g. ``"stat_scores"``.
+        kind: ``"pallas"`` for Mosaic kernels, ``"fused-jit"`` for
+            single-launch fused programs without a hand-written body.
+        covers: owner-name substrings this kernel can serve — the basis of
+            :func:`kernel_status`'s ``eligible`` verdict.
+        doc: one-line description for docs/tooling.
+        policy: per-kernel resilience policy (demotion/backoff state).
+    """
+
+    __slots__ = ("name", "kind", "covers", "doc", "policy")
+
+    def __init__(self, name: str, kind: str, covers: Tuple[str, ...], doc: str) -> None:
+        self.name = name
+        self.kind = kind
+        self.covers = tuple(covers)
+        self.doc = doc
+        self.policy = ResiliencePolicy()
+
+
+def register(name: str, kind: str, covers: Tuple[str, ...], doc: str) -> KernelSpec:
+    """Register (or re-register, idempotently) one kernel spec."""
+    with _lock:
+        spec = _REGISTRY.get(name)
+        if spec is None:
+            spec = KernelSpec(name, kind, covers, doc)
+            _REGISTRY[name] = spec
+    return spec
+
+
+def get(name: str) -> KernelSpec:
+    return _REGISTRY[name]
+
+
+def specs() -> List[KernelSpec]:
+    return list(_REGISTRY.values())
+
+
+def names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def pallas_enabled() -> bool:
+    """Process-wide kernel opt-in (env ``METRICS_TPU_FORCE_PALLAS``).
+
+    Off by default: the lax formulations are the measured production
+    defaults (see docs/kernels.md). The env var is sampled once and
+    cached — this sits inside the update hot path, one call per op per
+    launch — so tests that mutate the env must call :func:`refresh`.
+    """
+    global _enabled_cache
+    if _enabled_cache is None:
+        _enabled_cache = pltpu is not None and os.environ.get(_ENV, "0") == "1"
+    return _enabled_cache
+
+
+def refresh() -> None:
+    """Drop the cached ``METRICS_TPU_FORCE_PALLAS`` sample (tests)."""
+    global _enabled_cache
+    _enabled_cache = None
+
+
+def resolve(name: str, force: Optional[bool], eligible: bool = True) -> bool:
+    """Per-call kernel/lax decision for op ``name``.
+
+    ``force`` is the op's tri-state argument; ``eligible`` is the op's own
+    shape/dtype/VMEM guard verdict. A kernel in resilience cooldown demotes
+    here (one :meth:`~metrics_tpu.resilience.ResiliencePolicy.allow` tick),
+    so engine lowerings pick the fallback formulation while the kernel is
+    suspect.
+    """
+    use = pallas_enabled() if force is None else bool(force)
+    if not use or not eligible:
+        return False
+    spec = _REGISTRY.get(name)
+    if spec is not None and resilience_enabled() and not spec.policy.allow():
+        return False
+    return True
+
+
+def launch(
+    name: str,
+    kernel_thunk: Callable[[], Any],
+    fallback_thunk: Callable[[], Any],
+    cost_key: Any = None,
+    flops: float = 0.0,
+    bytes_accessed: float = 0.0,
+) -> Any:
+    """Run one guarded kernel launch; demote to the fallback on any failure.
+
+    The ``launch`` fault-injection probe fires here (``ops.<name>``), so
+    chaos tests exercise the same demotion path a genuine Mosaic failure
+    takes: ``note_failure`` (non-permanent, exponential backoff) + a
+    cause-tagged ``degrade`` span, then the bit-exact lax answer.
+    """
+    spec = _REGISTRY.get(name) or register(name, "pallas", (), "")
+    try:
+        faults.check("launch", f"ops.{name}")
+        out = kernel_thunk()
+    except Exception as err:  # noqa: BLE001 — the fallback is always correct
+        cause = classify(err)
+        spec.policy.note_failure(cause, permanent=False)
+        if spec.policy.permanent and not resilience_enabled():
+            # the registry never demotes permanently: the lax path being
+            # exact means re-promotion after backoff is always safe
+            spec.policy.permanent = False
+        record_degrade(f"ops.{name}", "kernel", err, spec.policy)
+        return fallback_thunk()
+    if spec.policy.failures:
+        spec.policy.note_success()
+    _note_engaged(name)
+    _record_cost(name, cost_key, flops, bytes_accessed)
+    return out
+
+
+def _note_engaged(name: str) -> None:
+    owner = getattr(_lowering_owner, "value", None)
+    with _lock:
+        _engaged_by_owner.setdefault(owner or f"ops.{name}", set()).add(name)
+
+
+def _record_cost(name: str, cost_key: Any, flops: float, bytes_accessed: float) -> None:
+    """One analytic cost entry + roofline-attributed event per launch.
+
+    Pallas executables (and interpret-mode runs especially) expose no
+    usable ``cost_analysis()``, so the model terms are derived from shapes
+    by each op — deterministic across backends, which is what lets the
+    perf sentinel ratchet them.
+    """
+    if cost_key is None:
+        return
+    entry = cost_model.record_static(
+        f"ops.{name}", "kernel", cost_key, flops=flops, bytes_accessed=bytes_accessed
+    )
+    key = (name, repr(cost_key))
+    first = key not in _costed
+    if first:
+        with _lock:
+            _costed.add(key)
+    if entry is not None and telemetry.telemetry_enabled():
+        telemetry.emit(
+            "kernel",
+            f"ops.{name}",
+            "kernel",
+            first=first,
+            **cost_model.launch_attrs(entry, None),
+        )
+
+
+@contextmanager
+def lowering(owner: str):
+    """Engine consult point: opened by ``FastDispatcher`` around its
+    trace+compile step so kernels engaged inside the lowered program are
+    attributed to ``owner`` (trace_report's ``kernel=yes`` column) and a
+    cooling-down kernel can veto itself for this lowering."""
+    prev = getattr(_lowering_owner, "value", None)
+    _lowering_owner.value = owner
+    try:
+        yield
+    finally:
+        _lowering_owner.value = prev
+
+
+def engaged(owner: Optional[str] = None) -> Dict[str, set]:
+    """Which kernels engaged, keyed by owner (or one owner's set)."""
+    with _lock:
+        if owner is not None:
+            return {owner: set(_engaged_by_owner.get(owner, set()))}
+        return {k: set(v) for k, v in _engaged_by_owner.items()}
+
+
+def kernel_status(owner: str, kind: str = "") -> str:
+    """``yes`` / ``eligible`` / ``no`` verdict for one roofline row.
+
+    ``yes``: this owner's programs actually engaged a registered kernel
+    (or the row IS an ``ops.*`` kernel launch). ``eligible``: a registered
+    kernel covers this owner family but was not engaged — the row is a
+    kernelization target. ``no``: nothing registered covers it.
+    """
+    if owner.startswith("ops.") or kind == "kernel":
+        return "yes"
+    with _lock:
+        if _engaged_by_owner.get(owner):
+            return "yes"
+    for spec in _REGISTRY.values():
+        if any(c and c in owner for c in spec.covers):
+            return "eligible"
+    return "no"
+
+
+def reset_stats() -> None:
+    """Clear engagement/cost bookkeeping and policy state (tests, bench)."""
+    with _lock:
+        _engaged_by_owner.clear()
+        _costed.clear()
+    for spec in _REGISTRY.values():
+        spec.policy = ResiliencePolicy()

@@ -1,0 +1,118 @@
+"""Retrieval grouping kernel: relevance labels reordered by score rank.
+
+Every per-query retrieval metric (``functional/retrieval/metrics.py``)
+starts from the same grouping step::
+
+    target[jnp.argsort(-preds, stable=True)]        # then usually [:k]
+
+XLA lowers that to a general sort + gather. For the short per-query lists
+retrieval serves (N up to ~1k), this kernel computes the stable descending
+rank directly from an all-pairs compare held entirely in VMEM::
+
+    rank[i] = #{j : preds[j] > preds[i]} + #{j < i : preds[j] == preds[i]}
+
+and scatters through a rank one-hot contraction — exactly one nonzero term
+per output slot, so the reorder is bit-identical to the argsort gather for
+every finite score (ties included; the ``j < i`` term is argsort's stable
+tie-break). NaN scores are outside the kernel contract — argsort sorts
+them last, all-pairs compares cannot see them — so callers with possibly-
+NaN scores must keep ``force_pallas=False`` (the default path).
+
+The lax fallback IS the production formulation, shared by every retrieval
+metric under the registry's parity contract (tests/ops/test_kernel_parity.py).
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from metrics_tpu.ops import registry
+
+_LANE = 128   # pad N to the lane width
+_MAX_N = 1024  # all-pairs (N, N) f32 tiles must fit VMEM
+
+registry.register(
+    "retrieval_sort",
+    "pallas",
+    ("Retrieval",),
+    "stable descending score ranking via all-pairs compare in VMEM",
+)
+
+
+def _rank_sort_kernel(preds_ref, target_ref, out_ref):
+    """Whole (padded) query in one block: rank, then rank-one-hot gather."""
+    p = preds_ref[:]  # (1, N) f32, padding slots -inf (rank after real rows)
+    t = target_ref[:]  # (1, N) f32
+    n = p.shape[1]
+    pi = p.reshape(n, 1)  # scores as "self" column
+    pj = p.reshape(1, n)  # scores as "other" row
+    idx_i = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    idx_j = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    beats = (pj > pi).astype(jnp.float32)
+    tie_before = jnp.logical_and(pj == pi, idx_j < idx_i).astype(jnp.float32)
+    rank = jnp.sum(beats + tie_before, axis=1, keepdims=True)  # (N, 1) exact ints
+    # out[k] = target[i where rank[i] == k] — one nonzero per column
+    onehot = (rank == jax.lax.broadcasted_iota(jnp.float32, (n, n), 1)).astype(jnp.float32)
+    out_ref[:] = jnp.sum(onehot * t.reshape(n, 1), axis=0, keepdims=True)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _sorted_by_preds_pallas(preds, target, interpret=False):
+    n = preds.shape[0]
+    n_pad = (-n) % _LANE
+    # -inf pads rank after every finite score; padded targets are 0
+    p = jnp.pad(preds.astype(jnp.float32), (0, n_pad), constant_values=-jnp.inf).reshape(1, -1)
+    t = jnp.pad(target.astype(jnp.float32), (0, n_pad)).reshape(1, -1)
+    padded = p.shape[1]
+
+    out = pl.pallas_call(
+        _rank_sort_kernel,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((1, padded), lambda i: (0, 0)),
+            pl.BlockSpec((1, padded), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, padded), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, padded), jnp.float32),
+        interpret=interpret,
+    )(p, t)
+    return out[0, :n]
+
+
+def _sorted_by_preds_lax(preds, target):
+    """Production formulation: stable argsort gather."""
+    return target[jnp.argsort(-preds, stable=True)]
+
+
+def sorted_by_preds(preds, target, force_pallas=None):
+    """``target`` reordered by descending ``preds``, stable — the grouping
+    step of every retrieval metric (slice ``[:k]`` for top-k).
+
+    Bit-identical between both paths for finite scores; ``-inf`` padding
+    means real ``-inf`` scores keep their stable positions ahead of the
+    pad. Output dtype follows ``target`` (labels round-trip f32 exactly:
+    bool/int relevance below 2^24).
+
+    ``force_pallas``: None → env-gated (``METRICS_TPU_FORCE_PALLAS=1``);
+    True → Pallas (interpret-mode off-TPU); False → the lax argsort.
+    """
+    n = preds.shape[0]
+    eligible = 0 < n <= _MAX_N and preds.ndim == 1
+    if not registry.resolve("retrieval_sort", force_pallas, eligible):
+        return _sorted_by_preds_lax(preds, target)
+    interpret = jax.default_backend() != "tpu"
+
+    def kernel_thunk():
+        return _sorted_by_preds_pallas(preds, target, interpret=interpret).astype(target.dtype)
+
+    return registry.launch(
+        "retrieval_sort",
+        kernel_thunk,
+        lambda: _sorted_by_preds_lax(preds, target),
+        cost_key=(n, str(target.dtype)),
+        # two all-pairs compare planes + the rank one-hot contraction
+        flops=3.0 * n * n,
+        # scores + labels read, reordered labels written (f32)
+        bytes_accessed=12.0 * n,
+    )

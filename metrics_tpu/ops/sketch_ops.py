@@ -1,0 +1,131 @@
+"""Streaming-sketch inner loop: splitmix-style hash + count-min scatter.
+
+``streaming/sketch.py``'s count-min update is the hottest pure loop of the
+streaming layer: hash every key once per table row, then scatter-add the
+weights at ``(row, hash % width)``. TPU scatter serializes, so this kernel
+re-expresses the scatter as a tiled one-hot reduce: each batch tile hashes
+its keys for all rows at once, expands a ``(BN, width)`` column one-hot in
+VMEM per row, and folds weighted sums into the grid-revisited table.
+
+The hash (:func:`hash_u32` — the finalizer also used by the HLL and
+quantile sketches) runs inside the kernel with identical u32 arithmetic,
+so indices match the lax path exactly. Accumulation is f32 in both paths:
+integral weights stay exact below 2^24 per counter, which is the
+bit-exactness contract the parity suite pins (unit-weight updates — the
+overwhelmingly common count use).
+
+The lax fallback IS the production scatter formulation from
+``CountMinHeavyHitters._add``, moved here verbatim under the registry's
+parity contract (tests/ops/test_kernel_parity.py).
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from metrics_tpu.ops import registry
+
+_BN = 128  # batch tile
+
+registry.register(
+    "countmin_scatter",
+    "pallas",
+    ("CountMin",),
+    "count-min hash + scatter-add as tiled hash + one-hot reduce",
+)
+
+
+def hash_u32(x):
+    """The 32-bit avalanche finalizer shared by every sketch (splitmix-style
+    xor-shift-multiply): uniform low bits from float key bit patterns."""
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x45D9F3B)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x45D9F3B)
+    return x ^ (x >> 16)
+
+
+def _countmin_kernel(bits_ref, w_ref, seeds_ref, value_ref, out_ref):
+    """One batch tile: hash keys for every row, one-hot reduce into table."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[:] = value_ref[:]
+
+    bits = bits_ref[:]    # (BN, 1) u32 (padding rows weighted 0)
+    w = w_ref[:]          # (BN, 1) f32
+    seeds = seeds_ref[:]  # (1, depth) u32
+    depth, width = out_ref.shape
+    h = hash_u32(bits ^ seeds)                # (BN, depth)
+    idx = (h % jnp.uint32(width)).astype(jnp.int32)
+    col = jax.lax.broadcasted_iota(jnp.int32, (bits.shape[0], width), 1)
+    for d in range(depth):  # depth is tiny (default 4) and static
+        oh = (idx[:, d : d + 1] == col).astype(jnp.float32)
+        out_ref[d : d + 1, :] += jnp.sum(oh * w, axis=0, keepdims=True)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _countmin_pallas(value, bits, w, seeds, interpret=False):
+    depth, width = value.shape
+    n = bits.shape[0]
+    n_pad = (-n) % _BN
+    bits2 = jnp.pad(bits.astype(jnp.uint32), (0, n_pad)).reshape(-1, 1)
+    w2 = jnp.pad(w.astype(jnp.float32), (0, n_pad)).reshape(-1, 1)
+    grid = (bits2.shape[0] // _BN,)
+
+    return pl.pallas_call(
+        _countmin_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BN, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BN, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, depth), lambda i: (0, 0)),
+            pl.BlockSpec((depth, width), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((depth, width), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((depth, width), jnp.float32),
+        interpret=interpret,
+    )(bits2, w2, seeds.reshape(1, -1), value)
+
+
+def _countmin_lax(value, bits, w, seeds):
+    """Production formulation: one batched scatter-add into the table."""
+    depth, width = value.shape
+    h = hash_u32(bits[None, :] ^ seeds[:, None])
+    idx = (h % jnp.uint32(width)).astype(jnp.int32)
+    rows = jnp.arange(depth, dtype=jnp.int32)[:, None]
+    return value.at[rows, idx].add(jnp.broadcast_to(w[None, :], idx.shape))
+
+
+def countmin_update(value, bits, w, seeds, force_pallas=None):
+    """New ``(depth, width)`` count-min table after absorbing one batch.
+
+    ``bits`` are the pre-hashed key bit patterns (``(B,)`` uint32), ``w``
+    the per-key f32 weights (0 for masked keys), ``seeds`` one uint32 per
+    table row. Bit-identical between both paths for integral weights.
+
+    ``force_pallas``: None → env-gated (``METRICS_TPU_FORCE_PALLAS=1``);
+    True → Pallas (interpret-mode off-TPU); False → the lax scatter.
+    """
+    depth, width = value.shape
+    n = bits.shape[0]
+    # the (BN, width) one-hot tile + two table blocks must fit VMEM
+    eligible = (
+        0 < n < 2**24
+        and (_BN * width + _BN * depth + 2 * depth * width) * 4 <= 12 * 2**20
+    )
+    if not registry.resolve("countmin_scatter", force_pallas, eligible):
+        return _countmin_lax(value, bits, w, seeds)
+    interpret = jax.default_backend() != "tpu"
+
+    return registry.launch(
+        "countmin_scatter",
+        lambda: _countmin_pallas(value, bits, w, seeds, interpret=interpret),
+        lambda: _countmin_lax(value, bits, w, seeds),
+        cost_key=(n, depth, width),
+        # ~6 u32 ops per hash per (key, row) + the one-hot compare+add sweep
+        flops=6.0 * n * depth + 3.0 * n * depth * width,
+        # keys + weights read once, table read and written
+        bytes_accessed=8.0 * n + 8.0 * depth * width,
+    )

@@ -1,0 +1,131 @@
+"""Fused multiclass stat-scores counts as a Pallas TPU kernel.
+
+The macro reduce path of ``functional/classification/stat_scores.py`` lands
+all three per-class counts in ONE length-``3C`` scatter-add::
+
+    idx = [target, pred + C, target + 2C]
+    wts = [valid, valid, correct]
+    counts = zeros(3C).at[idx].add(wts)
+
+TPU scatter serializes, so this kernel re-expresses the scatter as a tiled
+one-hot compare+reduce: each batch tile builds its ``(BN, C)`` class masks
+in VMEM and folds them into a grid-revisited ``(3, C)`` accumulator —
+row 0 target counts, row 1 prediction counts, row 2 true positives. All
+accumulation is exact (0/1 weights summed in f32 stay integral below 2^24),
+so the counts cast back to the scatter dtype bit-identically.
+
+The lax fallback below IS the production scatter formulation, moved here
+verbatim so both paths live next to each other under the registry's parity
+contract (tests/ops/test_kernel_parity.py).
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from metrics_tpu.ops import registry
+
+_BN = 128  # batch tile (sublane-friendly)
+
+registry.register(
+    "stat_scores",
+    "pallas",
+    ("Accuracy", "Precision", "Recall", "F1Score", "FBeta", "StatScores", "Specificity"),
+    "multiclass TP/FP/TN/FN scatter-add as tiled one-hot compare+reduce",
+)
+
+
+def _stat_counts_kernel(target_ref, pred_ref, corr_ref, w_ref, out_ref):
+    """One batch tile: fold target/pred/correct class masks into (3, C)."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tgt = target_ref[:]  # (BN, 1) i32 (padding rows: 0, weighted 0)
+    prd = pred_ref[:]    # (BN, 1) i32
+    corr = corr_ref[:]   # (BN, 1) f32 — correct & valid, pre-masked
+    w = w_ref[:]         # (BN, 1) f32 — validity weight
+
+    c = out_ref.shape[1]
+    class_idx = jax.lax.broadcasted_iota(jnp.int32, (1, c), 1)
+    oh_t = (tgt == class_idx).astype(jnp.float32)  # (BN, C)
+    oh_p = (prd == class_idx).astype(jnp.float32)
+    out_ref[0:1, :] += jnp.sum(oh_t * w, axis=0, keepdims=True)
+    out_ref[1:2, :] += jnp.sum(oh_p * w, axis=0, keepdims=True)
+    out_ref[2:3, :] += jnp.sum(oh_t * corr, axis=0, keepdims=True)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "interpret"))
+def _stat_counts_pallas(target_cls, pred_cls, correct, w, num_classes, interpret=False):
+    n = target_cls.shape[0]
+    n_pad = (-n) % _BN
+    col = lambda x, dt: jnp.pad(x.astype(dt), (0, n_pad)).reshape(-1, 1)
+    tgt = col(target_cls, jnp.int32)
+    prd = col(pred_cls, jnp.int32)
+    corr = col(correct, jnp.float32)
+    wts = col(w, jnp.float32)
+    grid = (tgt.shape[0] // _BN,)
+
+    counts = pl.pallas_call(
+        _stat_counts_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BN, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BN, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BN, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BN, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((3, num_classes), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, num_classes), jnp.float32),
+        interpret=interpret,
+    )(tgt, prd, corr, wts)
+    return counts
+
+
+def _stat_counts_lax(target_cls, pred_cls, correct, w, num_classes):
+    """Production formulation: one scatter-add over a 3C counts vector."""
+    dtype = w.dtype
+    idx = jnp.concatenate([target_cls, pred_cls + num_classes, target_cls + 2 * num_classes])
+    wts = jnp.concatenate([w, w, correct.astype(dtype)])
+    counts = jnp.zeros(3 * num_classes, dtype).at[idx].add(wts)
+    return counts[:num_classes], counts[num_classes : 2 * num_classes], counts[2 * num_classes :]
+
+
+def stat_scores_counts(target_cls, pred_cls, correct, w, num_classes, force_pallas=None):
+    """Per-class ``(target_count, pred_count, tp)`` for one batch.
+
+    ``target_cls``/``pred_cls`` are ``(B,)`` int class indices, ``correct``
+    the (already validity-masked) hit mask, ``w`` the 0/1 validity weights
+    whose dtype fixes the count dtype. Bit-identical between both paths.
+
+    ``force_pallas``: None → env-gated (``METRICS_TPU_FORCE_PALLAS=1``);
+    True → Pallas (interpret-mode off-TPU); False → the lax scatter.
+    """
+    n = target_cls.shape[0]
+    # one-hot tiles (BN, C) x3 must fit VMEM; empty batches give Mosaic a
+    # zero-size grid; counts above 2^24 would lose integrality in f32
+    eligible = 0 < n < 2**24 and 4 * _BN * num_classes * 4 <= 12 * 2**20
+    if not registry.resolve("stat_scores", force_pallas, eligible):
+        return _stat_counts_lax(target_cls, pred_cls, correct, w, num_classes)
+    interpret = jax.default_backend() != "tpu"
+    dtype = w.dtype
+
+    def kernel_thunk():
+        counts = _stat_counts_pallas(
+            target_cls, pred_cls, correct, w, num_classes, interpret=interpret
+        )
+        return counts[0].astype(dtype), counts[1].astype(dtype), counts[2].astype(dtype)
+
+    return registry.launch(
+        "stat_scores",
+        kernel_thunk,
+        lambda: _stat_counts_lax(target_cls, pred_cls, correct, w, num_classes),
+        cost_key=(n, num_classes, str(dtype)),
+        # one compare+select+add per (row, class) per mask, three masks
+        flops=3.0 * 3 * n * num_classes,
+        # rows read once (4 x 4B columns), (3, C) f32 accumulator written
+        bytes_accessed=16.0 * n + 12.0 * num_classes,
+    )

@@ -1,0 +1,84 @@
+"""Fused ``SlidingWindow`` tick: the whole update as ONE device program.
+
+An eager sliding-window tick issues a handful of small launches — cursor
+advance (compare + modular increment), ring-bucket clear, prefix-cache
+maintenance, bucket gather, the inner ``pure_update``, and the scatter
+back (``streaming/window.py``). This op compiles the wrapper's own
+``pure_update`` — gather → inner update → scatter → cursor advance, prefix
+fold under ``lax.cond`` — into a single cached executable per window
+instance, so one tick is one launch (``window_tick_launches == 1``, pinned
+by bench ``_cfg_kernels``).
+
+Registered as a ``fused-jit`` kernel: there is no hand-written Mosaic body
+(the inner metric's update is arbitrary user code), but the registry
+treats it like any other kernel — opt-in knob, resilience demotion to the
+eager multi-launch tick, cost entry, trace_report attribution.
+
+Bit-exactness is structural: the traced program is the wrapper's own
+``pure_update`` (the exact code the eager tick runs), so values match the
+eager path by construction — pinned by tests/ops/test_kernel_parity.py.
+"""
+from typing import Any, Dict, Tuple
+
+import jax
+
+from metrics_tpu import profiling
+from metrics_tpu.ops import registry
+
+registry.register(
+    "window_tick",
+    "fused-jit",
+    ("SlidingWindow",),
+    "one-launch fused sliding-window tick (gather + update + scatter + advance)",
+)
+
+
+def _tick_fn(window) -> Any:
+    """The cached single-launch tick executable for one window instance."""
+    fn = getattr(window, "_fused_tick_fn", None)
+    if fn is None:
+        # donate the state argument: ring buffers are the window's whole
+        # footprint and the old leaves die with the tick
+        fn = jax.jit(lambda state, *a, **kw: window.pure_update(state, *a, **kw))
+        object.__setattr__(window, "_fused_tick_fn", fn)
+    return fn
+
+
+def _model_terms(state: Dict[str, Any]) -> Tuple[float, float]:
+    """Analytic cost terms: one tick touches every state leaf once."""
+    nbytes = float(sum(getattr(v, "nbytes", 0) or 0 for v in state.values()))
+    return 2.0 * len(state), 2.0 * nbytes  # leaves read + written
+
+
+def fused_window_tick(window, args: Tuple, kwargs: Dict) -> bool:
+    """Run one tick of ``window`` as a single compiled program.
+
+    Returns True when the fused program ran (state already written back);
+    False when the registry demoted the call — the caller then runs the
+    eager multi-launch tick. The ``launch`` fault probe and the per-kernel
+    resilience policy sit on the same seam as the Pallas kernels.
+    """
+    names = list(window._defaults)
+    state = {k: getattr(window, k) for k in names}
+
+    def kernel_thunk():
+        new_state = _tick_fn(window)(state, *args, **kwargs)
+        for k in names:
+            object.__setattr__(window, k, new_state[k])
+        # the state changed behind the attribute setters, so the memoized
+        # compute is stale (Metric._wrap_update clears it only on the
+        # wrapped update path)
+        object.__setattr__(window, "_computed", None)
+        profiling.record_dispatch(type(window).__name__, "window-tick")
+        return True
+
+    flops, nbytes = _model_terms(state)
+    out = registry.launch(
+        "window_tick",
+        kernel_thunk,
+        lambda: False,
+        cost_key=tuple((k, tuple(getattr(state[k], "shape", ()))) for k in names),
+        flops=flops,
+        bytes_accessed=nbytes,
+    )
+    return bool(out)

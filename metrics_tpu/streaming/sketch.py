@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 
 from metrics_tpu import telemetry
+from metrics_tpu.ops.sketch_ops import hash_u32
 from metrics_tpu.aggregation import BaseAggregator
 
 __all__ = [
@@ -45,14 +46,9 @@ __all__ = [
 Array = jax.Array
 
 
-def _hash_u32(x: Array) -> Array:
-    """Avalanche hash over uint32 lanes (splitmix32-style: xor-shift +
-    odd-constant multiply twice). Unsigned arithmetic wraps, so this is
-    deterministic across backends with no x64 requirement."""
-    x = x.astype(jnp.uint32)
-    x = (x ^ (x >> 16)) * jnp.uint32(0x45D9F3B)
-    x = (x ^ (x >> 16)) * jnp.uint32(0x45D9F3B)
-    return x ^ (x >> 16)
+# the avalanche finalizer lives next to its Pallas kernel form; one
+# definition keeps the sketch indices and the kernel indices identical
+_hash_u32 = hash_u32
 
 
 def _key_bits(x: Array) -> Array:
@@ -373,18 +369,24 @@ class CountMinHeavyHitters(BaseAggregator):
         self.depth = depth
         self.width = width
 
+    def _seeds(self) -> Array:
+        """One independent hash seed per table row."""
+        return jnp.arange(self.depth, dtype=jnp.uint32) * jnp.uint32(0x9E3779B9) + jnp.uint32(1)
+
     def _indices(self, value: Array) -> Array:
         """(depth, n) column index per key per row — one seed per row."""
         bits = _key_bits(value)
-        seeds = (jnp.arange(self.depth, dtype=jnp.uint32) * jnp.uint32(0x9E3779B9) + jnp.uint32(1))
-        h = _hash_u32(bits[None, :] ^ seeds[:, None])
+        h = _hash_u32(bits[None, :] ^ self._seeds()[:, None])
         return (h % jnp.uint32(self.width)).astype(jnp.int32)
 
     def _add(self, value: Array, weight: Array, mask: Array) -> None:
-        idx = self._indices(jnp.where(mask, value, 0.0))
+        # hash + scatter live in ops/ as the lax half of the
+        # countmin_scatter kernel (kernel opt-in: docs/kernels.md)
+        from metrics_tpu.ops import countmin_update
+
+        bits = _key_bits(jnp.where(mask, value, 0.0))
         w = jnp.where(mask, weight, 0.0)
-        rows = jnp.arange(self.depth, dtype=jnp.int32)[:, None]
-        self.value = self.value.at[rows, idx].add(jnp.broadcast_to(w[None, :], idx.shape))
+        self.value = countmin_update(self.value, bits, w, self._seeds())
 
     def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
         value, mask = self._cast_and_nan_mask_input(value)

@@ -349,6 +349,17 @@ class SlidingWindow(_StreamingWindow):
         self.in_bucket = self.in_bucket + live
 
     def update(self, *args: Any, **kwargs: Any) -> None:
+        if not isinstance(self.cursor, jax.core.Tracer):
+            # opt-in fused tick: the whole gather → inner update → scatter
+            # → advance sequence as ONE compiled launch (docs/kernels.md);
+            # a registry demotion falls through to the eager tick below
+            from metrics_tpu.ops import registry as ops_registry
+
+            if ops_registry.resolve("window_tick", None, True):
+                from metrics_tpu.ops import fused_window_tick
+
+                if fused_window_tick(self, args, kwargs):
+                    return
         gate = jnp.asarray(True)
         adv, cursor = self._advance(gate)
         bucket = {k: getattr(self, f"ring_{k}")[cursor] for k in self._inner_names}

@@ -143,8 +143,25 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 28
+    assert len(skipped) == 29
     assert "detail_elapsed_s" in detail
+
+
+def test_kernels_config_counts_and_keys():
+    """Pin the kernel-vs-lax bench config: every registered Pallas op gets
+    a (kernel_us, lax_us) pair, the fused window tick is exactly ONE
+    dispatch per step, and the registry census matches the shipped set."""
+    detail = {}
+    bench._cfg_kernels(detail, reps=3)
+    assert detail["window_tick_launches"] == 1
+    assert detail["kernels_registered"] == 6
+    assert detail["kernels_engaged_forced"] == 6
+    for op in ("stat_scores", "confusion_matrix", "retrieval_sort",
+               "countmin_scatter", "binned_stats"):
+        assert detail[f"{op}_kernel_us"] > 0
+        assert detail[f"{op}_lax_us"] > 0
+    assert detail["window_tick_fused_us"] > 0
+    assert detail["window_tick_eager_us"] > 0
 
 
 def test_sync_engine_config_counts_and_keys(monkeypatch):
@@ -390,7 +407,7 @@ def test_perf_sentinel_capstone_matches_live_bench_counters():
     spec.loader.exec_module(ps)
 
     # the cheap structural configs, at the exact scales pinned above
-    report = ps.collect(only=("sync_engine", "streaming"))
+    report = ps.collect(only=("sync_engine", "streaming", "kernels"))
     s = report["structural"]
     assert s["sync_collectives_fused_collection"] == 1
     assert s["sync_bucket_count_fused_collection"] == 1
@@ -398,6 +415,9 @@ def test_perf_sentinel_capstone_matches_live_bench_counters():
     assert s["sync_bytes_fused_collection"] == s["sync_bytes_perleaf_collection"]
     assert s["window_retraces_1k_steps"] == 0
     assert s["window_dispatches_1k_steps"] == 40
+    assert s["window_tick_launches"] == 1
+    assert s["kernels_registered"] == 6
+    assert s["kernels_engaged_forced"] == 6
     assert s["sketch_sync_collectives_2replica"] == 1
 
     # every structural counter the sentinel measured equals the checked-in
@@ -417,6 +437,7 @@ def test_perf_sentinel_capstone_matches_live_bench_counters():
         "window_retraces_1k_steps",
         "read_second_unticked_launches",
         "fleet_read_collectives",
+        "window_tick_launches",
     } <= scheduled
     # and the latency front keeps the idle-overhead ratio under the same
     # pin _cfg_telemetry_overhead enforces (band IS the 2.0 bound)
@@ -425,4 +446,5 @@ def test_perf_sentinel_capstone_matches_live_bench_counters():
     assert ps.BAND_OVERRIDES["telemetry_idle_overhead_ratio"] == 2.0
     # the scales must match the pins above, or "equal counters" is vacuous
     assert sched["streaming"][0] == {"steps": 40}
+    assert sched["kernels"][0] == {"reps": 3}
     assert sched["read_path"][0] == {"sessions": 16, "reps": 3}

@@ -1,0 +1,213 @@
+"""Per-kernel interpret-mode parity suite for :mod:`metrics_tpu.ops`.
+
+Every registered kernel must be BITWISE equal to its lax fallback — the
+registry's whole safety argument (silent demotion, kill switch, chaos
+fallback) rests on the two formulations being interchangeable. Off-TPU the
+Pallas bodies run in interpreter mode, so these pins execute the real
+kernel logic (tiling, padding, accumulator revisiting) on the CI backend.
+
+Two pin families per kernel:
+
+* **value pins** — ``assert_array_equal`` (atol=0) between
+  ``force_pallas=True`` and ``force_pallas=False`` across a dtype ×
+  pow2-bucket grid (sizes straddling the ``_BN=128`` tile boundary);
+* **structural pins** — ``jax.make_jaxpr`` contains exactly ONE
+  ``pallas_call`` when forced and ZERO on the fallback path, so a refactor
+  cannot silently split a kernel into multiple launches or leak the Pallas
+  body into the production path.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, SlidingWindow, profiling
+from metrics_tpu.ops import (
+    binned_stat_scores,
+    confusion_matrix_counts,
+    countmin_update,
+    fused_window_tick,
+    sorted_by_preds,
+    stat_scores_counts,
+)
+from tests.helpers import seed_all
+
+seed_all(11)
+
+# the fused-tick helpers drive fused_window_tick directly (no Metric.update
+# wrapper), so the metric's update counter never ticks and compute() warns
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:The ``compute`` method of metric:UserWarning"
+)
+
+
+def _pallas_calls(fn, *args) -> int:
+    """Recursive ``pallas_call`` count in the traced program."""
+    from metrics_tpu.analysis.jaxpr_audit import iter_eqns
+
+    closed = jax.make_jaxpr(fn)(*args)
+    return sum(1 for eqn in iter_eqns(closed.jaxpr) if eqn.primitive.name == "pallas_call")
+
+
+# ------------------------------------------------------------- stat scores
+@pytest.mark.parametrize("n", [1, 100, 128, 129, 512])
+@pytest.mark.parametrize("c", [2, 7, 33])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.int32])
+def test_stat_scores_bitwise_parity(n, c, dtype):
+    rng = np.random.RandomState(n + c)
+    target = jnp.asarray(rng.randint(0, c, n))
+    pred = jnp.asarray(rng.randint(0, c, n))
+    w = jnp.asarray(rng.randint(0, 2, n), dtype)  # 0/1 validity weights
+    correct = ((pred == target) & (w > 0)).astype(jnp.float32)
+    lax_out = stat_scores_counts(target, pred, correct, w, c, force_pallas=False)
+    ker_out = stat_scores_counts(target, pred, correct, w, c, force_pallas=True)
+    for ref, got, name in zip(lax_out, ker_out, ("targ", "pred", "tp")):
+        assert got.dtype == ref.dtype
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref), err_msg=name)
+
+
+# -------------------------------------------------------- confusion matrix
+@pytest.mark.parametrize("n", [1, 64, 128, 200, 1024])
+@pytest.mark.parametrize("c", [2, 10, 40])
+def test_confusion_matrix_bitwise_parity(n, c):
+    rng = np.random.RandomState(n * 7 + c)
+    target = jnp.asarray(rng.randint(0, c, n))
+    pred = jnp.asarray(rng.randint(0, c, n))
+    ref = confusion_matrix_counts(target, pred, c, force_pallas=False)
+    got = confusion_matrix_counts(target, pred, c, force_pallas=True)
+    assert got.dtype == ref.dtype and got.shape == (c, c)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    assert int(got.sum()) == n  # every row lands in exactly one cell
+
+
+# ---------------------------------------------------------- retrieval sort
+@pytest.mark.parametrize("n", [1, 5, 128, 129, 1000])
+@pytest.mark.parametrize("dtype", [jnp.int32, jnp.float32, jnp.bool_])
+def test_retrieval_sort_bitwise_parity(n, dtype):
+    rng = np.random.RandomState(n)
+    preds = jnp.asarray(rng.rand(n).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, n), dtype)
+    ref = sorted_by_preds(preds, target, force_pallas=False)
+    got = sorted_by_preds(preds, target, force_pallas=True)
+    assert got.dtype == ref.dtype
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_retrieval_sort_tie_stability_matches_stable_argsort():
+    # duplicate scores: the kernel's (score, index) ranking must match
+    # jnp.argsort(stable=True) exactly, not just up to tie permutation
+    preds = jnp.asarray([0.5, 0.2, 0.5, 0.2, 0.5])
+    target = jnp.asarray([1, 2, 3, 4, 5])
+    ref = sorted_by_preds(preds, target, force_pallas=False)
+    got = sorted_by_preds(preds, target, force_pallas=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+# -------------------------------------------------------- countmin scatter
+@pytest.mark.parametrize("n", [1, 100, 128, 300])
+@pytest.mark.parametrize("depth,width", [(2, 128), (4, 1024)])
+def test_countmin_bitwise_parity(n, depth, width):
+    from metrics_tpu.ops import hash_u32  # noqa: F401 — the shared hash
+
+    rng = np.random.RandomState(n + depth)
+    value = jnp.asarray(rng.randint(0, 50, (depth, width)).astype(np.float32))
+    bits = jnp.asarray(rng.randint(0, 2**31, n).astype(np.uint32))
+    w = jnp.asarray(rng.randint(0, 3, n).astype(np.float32))  # integral weights
+    seeds = jnp.arange(depth, dtype=jnp.uint32) * jnp.uint32(0x9E3779B9) + jnp.uint32(1)
+    ref = countmin_update(value, bits, w, seeds, force_pallas=False)
+    got = countmin_update(value, bits, w, seeds, force_pallas=True)
+    assert got.dtype == ref.dtype
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+# ------------------------------------------------------------ binned stats
+@pytest.mark.parametrize("n,c,t", [(1, 1, 5), (200, 3, 17)])
+def test_binned_stats_bitwise_parity(n, c, t):
+    rng = np.random.RandomState(n + c + t)
+    preds = jnp.asarray(rng.rand(n, c).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, (n, c)))
+    thr = jnp.linspace(0, 1, t)
+    ref = binned_stat_scores(preds, target, thr, force_pallas=False)
+    got = binned_stat_scores(preds, target, thr, force_pallas=True)
+    for r, g, name in zip(ref, got, ("tp", "fp", "fn")):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r), err_msg=name)
+
+
+# ---------------------------------------------------------- structural pins
+def test_jaxpr_pins_one_pallas_call_forced_zero_on_fallback():
+    """Every Pallas kernel is exactly ONE pallas_call when forced, and the
+    production path contains none (the kill-switch structural guarantee)."""
+    rng = np.random.RandomState(3)
+    c = 6
+    target = jnp.asarray(rng.randint(0, c, 64))
+    pred = jnp.asarray(rng.randint(0, c, 64))
+    correct = (pred == target).astype(jnp.float32)
+    w = jnp.ones(64, jnp.float32)
+    preds1d = jnp.asarray(rng.rand(64).astype(np.float32))
+    bits = jnp.asarray(rng.randint(0, 2**31, 64).astype(np.uint32))
+    seeds = jnp.arange(2, dtype=jnp.uint32) * jnp.uint32(0x9E3779B9) + jnp.uint32(1)
+    value = jnp.zeros((2, 128), jnp.float32)
+    probs2d = jnp.asarray(rng.rand(64, c).astype(np.float32))
+    ml = jnp.asarray(rng.randint(0, 2, (64, c)))
+    thr = jnp.linspace(0, 1, 9)
+
+    cases = {
+        "stat_scores": lambda f: (lambda t_, p_: stat_scores_counts(t_, p_, correct, w, c, force_pallas=f), target, pred),
+        "confusion_matrix": lambda f: (lambda t_, p_: confusion_matrix_counts(t_, p_, c, force_pallas=f), target, pred),
+        "retrieval_sort": lambda f: (lambda p_, t_: sorted_by_preds(p_, t_, force_pallas=f), preds1d, target),
+        "countmin_scatter": lambda f: (lambda b_, w_: countmin_update(value, b_, w_, seeds, force_pallas=f), bits, w),
+        "binned_stats": lambda f: (lambda p_, t_: binned_stat_scores(p_, t_, thr, force_pallas=f), probs2d, ml),
+    }
+    for name, make in cases.items():
+        fn, *args = make(True)
+        assert _pallas_calls(fn, *args) == 1, f"{name}: forced path must be ONE pallas_call"
+        fn, *args = make(False)
+        assert _pallas_calls(fn, *args) == 0, f"{name}: fallback path must contain NO pallas_call"
+
+
+# -------------------------------------------------------- fused window tick
+def _window_stream(steps, fused):
+    rng = np.random.RandomState(5)
+    w = SlidingWindow(Accuracy(num_classes=4, average="macro"), window=4, slide=2, jit_update=False)
+    batches = [
+        (jnp.asarray(rng.rand(8, 4).astype(np.float32)), jnp.asarray(rng.randint(0, 4, 8)))
+        for _ in range(steps)
+    ]
+    outs = []
+    for probs, labels in batches:
+        if fused:
+            assert fused_window_tick(w, (probs, labels), {})
+        else:
+            w.update(probs, labels)
+        outs.append(np.asarray(w.compute()))
+    return outs
+
+
+def test_fused_window_tick_bit_exact_and_single_launch():
+    """The fused tick runs the window's own pure_update as one launch, so
+    per-step computes are bit-identical to the eager tick and each tick is
+    exactly one ``window-tick`` dispatch (the `window_tick_launches == 1`
+    pin `_cfg_kernels` ratchets)."""
+    steps = 9
+    eager = _window_stream(steps, fused=False)
+    with profiling.track_dispatches() as t:
+        fused = _window_stream(steps, fused=True)
+    assert t.dispatch_count(kind="window-tick") == steps
+    for i, (a, b) in enumerate(zip(eager, fused)):
+        np.testing.assert_array_equal(a, b, err_msg=f"step {i}")
+
+
+def test_window_update_routes_through_fused_tick_when_enabled(monkeypatch):
+    from metrics_tpu import ops
+
+    monkeypatch.setenv("METRICS_TPU_FORCE_PALLAS", "1")
+    ops.refresh()
+    try:
+        w = SlidingWindow(Accuracy(num_classes=4, average="macro"), window=4, slide=2, jit_update=False)
+        rng = np.random.RandomState(6)
+        with profiling.track_dispatches() as t:
+            for _ in range(5):
+                w.update(jnp.asarray(rng.rand(8, 4).astype(np.float32)), jnp.asarray(rng.randint(0, 4, 8)))
+        assert t.dispatch_count(kind="window-tick") == 5
+    finally:
+        ops.refresh()

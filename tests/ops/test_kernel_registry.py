@@ -1,0 +1,244 @@
+"""The ops/ kernel registry: opt-in knobs, env cache, status verdicts,
+kill-switch bit-identity, and chaos-tested resilience demotion.
+
+The registry's contract has three legs, each pinned here:
+
+* **tri-state resolution** — ``force_pallas=None`` defers to the cached
+  ``METRICS_TPU_FORCE_PALLAS`` sample (one env read per process;
+  ``refresh()`` re-samples for tests), ``True``/``False`` override per
+  call;
+* **kill switch** — with the env off, every op is bit-identical to the
+  production lax path (there is literally no kernel in the program:
+  tests/ops/test_kernel_parity.py pins the structural half);
+* **fault parity** — an injected ``launch`` fault demotes that ONE kernel
+  to its lax fallback through its ResiliencePolicy (cause-tagged degrade
+  span, exponential backoff, never permanent) and the answer is still
+  exact; after the cooldown the kernel re-promotes on the next success.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from metrics_tpu import faults, telemetry
+from metrics_tpu.ops import registry
+from metrics_tpu.ops import (
+    confusion_matrix_counts,
+    sorted_by_preds,
+    stat_scores_counts,
+)
+from tests.helpers import seed_all
+
+seed_all(13)
+
+EXPECTED_KERNELS = {
+    "binned_stats", "confusion_matrix", "countmin_scatter",
+    "retrieval_sort", "stat_scores", "window_tick",
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_FORCE_PALLAS", raising=False)
+    registry.refresh()
+    registry.reset_stats()
+    yield
+    registry.refresh()
+    registry.reset_stats()
+
+
+def _example(c=5, n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    target = jnp.asarray(rng.randint(0, c, n))
+    pred = jnp.asarray(rng.randint(0, c, n))
+    correct = (pred == target).astype(jnp.float32)
+    w = jnp.ones(n, jnp.float32)
+    return target, pred, correct, w, c
+
+
+# ------------------------------------------------------------ the registry
+def test_registry_lists_every_shipped_kernel():
+    assert EXPECTED_KERNELS <= set(registry.names())
+    for name in EXPECTED_KERNELS:
+        spec = registry.get(name)
+        assert spec.kind in ("pallas", "fused-jit")
+        assert spec.covers, f"{name} must declare which owners it covers"
+        assert spec.doc
+
+
+def test_register_is_idempotent_and_keeps_policy_state():
+    spec = registry.get("stat_scores")
+    spec.policy.note_failure("test")
+    again = registry.register("stat_scores", "pallas", (), "other doc")
+    assert again is spec and again.policy.failures == 1
+
+
+def test_env_switch_is_cached_until_refresh(monkeypatch):
+    assert registry.pallas_enabled() is False
+    monkeypatch.setenv("METRICS_TPU_FORCE_PALLAS", "1")
+    # the satellite bugfix: mutating the env does NOT flip the cached
+    # sample (no per-call os.environ read on the update hot path)...
+    assert registry.pallas_enabled() is False
+    registry.refresh()  # ...an explicit refresh re-samples it
+    assert registry.pallas_enabled() is True
+
+
+def test_resolve_tristate_and_eligibility():
+    assert registry.resolve("stat_scores", None) is False  # env off
+    assert registry.resolve("stat_scores", True) is True
+    assert registry.resolve("stat_scores", False) is False
+    assert registry.resolve("stat_scores", True, eligible=False) is False
+    assert registry.resolve("never_registered", True) is True  # spec-less ops still force
+
+
+def test_kernel_status_verdicts():
+    assert registry.kernel_status("ops.stat_scores", "kernel") == "yes"
+    assert registry.kernel_status("Accuracy") == "eligible"   # covered, not engaged
+    assert registry.kernel_status("MeanSquaredError") == "no"  # nothing covers it
+    t, p, corr, w, c = _example()
+    stat_scores_counts(t, p, corr, w, c, force_pallas=True)
+    assert "stat_scores" in registry.engaged("ops.stat_scores")["ops.stat_scores"]
+
+
+def test_lowering_context_attributes_engagement_to_owner():
+    t, p, corr, w, c = _example()
+    with registry.lowering("Accuracy"):
+        stat_scores_counts(t, p, corr, w, c, force_pallas=True)
+    assert registry.engaged("Accuracy")["Accuracy"] == {"stat_scores"}
+    assert registry.kernel_status("Accuracy") == "yes"
+
+
+def test_launch_records_kernel_cost_entry_and_event():
+    from metrics_tpu.analysis import cost_model
+
+    t, p, corr, w, c = _example()
+    with telemetry.instrument() as sess:
+        stat_scores_counts(t, p, corr, w, c, force_pallas=True)
+    kernels = [e for e in sess.events if e.name == "kernel" and e.owner == "ops.stat_scores"]
+    assert kernels and kernels[0].attrs["model_flops"] > 0
+    assert any(
+        e.owner == "ops.stat_scores" and e.family == "kernel"
+        for e in cost_model.entries().values()
+    )
+
+
+# ------------------------------------------------------------- kill switch
+def test_kill_switch_off_is_bit_identical_to_production(monkeypatch):
+    """``METRICS_TPU_FORCE_PALLAS=0`` (and unset): the default-knob path
+    IS the production lax path, bit for bit."""
+    t, p, corr, w, c = _example(seed=3)
+    preds1d = jnp.asarray(np.random.RandomState(3).rand(64).astype(np.float32))
+    for env in (None, "0"):
+        if env is None:
+            monkeypatch.delenv("METRICS_TPU_FORCE_PALLAS", raising=False)
+        else:
+            monkeypatch.setenv("METRICS_TPU_FORCE_PALLAS", env)
+        registry.refresh()
+        for default, explicit_lax in (
+            (stat_scores_counts(t, p, corr, w, c),
+             stat_scores_counts(t, p, corr, w, c, force_pallas=False)),
+            ((confusion_matrix_counts(t, p, c),),
+             (confusion_matrix_counts(t, p, c, force_pallas=False),)),
+            ((sorted_by_preds(preds1d, t),),
+             (sorted_by_preds(preds1d, t, force_pallas=False),)),
+        ):
+            for got, ref in zip(default, explicit_lax):
+                np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_env_opt_in_flips_every_op_to_kernels_with_same_values(monkeypatch):
+    t, p, corr, w, c = _example(seed=4)
+    baseline = stat_scores_counts(t, p, corr, w, c)
+    monkeypatch.setenv("METRICS_TPU_FORCE_PALLAS", "1")
+    registry.refresh()
+    opted = stat_scores_counts(t, p, corr, w, c)
+    assert registry.engaged("ops.stat_scores")["ops.stat_scores"] == {"stat_scores"}
+    for a, b in zip(baseline, opted):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------------- chaos
+@pytest.mark.chaos
+def test_injected_launch_fault_demotes_to_exact_lax_answer():
+    t, p, corr, w, c = _example(seed=7)
+    ref = stat_scores_counts(t, p, corr, w, c, force_pallas=False)
+    with telemetry.instrument() as sess:
+        with faults.inject("launch", count=1):
+            got = stat_scores_counts(t, p, corr, w, c, force_pallas=True)
+    for a, b in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    degrades = [e for e in sess.events if e.name == "degrade" and e.owner == "ops.stat_scores"]
+    assert degrades and degrades[0].attrs["cause"] == "injected:launch"
+    policy = registry.get("stat_scores").policy
+    assert policy.failures == 1 and policy.demotions == 1
+    assert not policy.permanent, "a kernel demotion must NEVER be permanent"
+    assert policy.cooldown > 0
+
+
+@pytest.mark.chaos
+def test_demoted_kernel_backs_off_then_repromotes():
+    t, p, corr, w, c = _example(seed=8)
+    with faults.inject("launch", count=1):
+        stat_scores_counts(t, p, corr, w, c, force_pallas=True)
+    policy = registry.get("stat_scores").policy
+    cooldown = policy.cooldown
+    assert cooldown > 0
+    # while cooling down, even forced calls resolve to the lax path and
+    # burn one backoff slot each
+    for _ in range(cooldown):
+        assert registry.resolve("stat_scores", True) is False
+    # clock expired: the next call retries the kernel, succeeds, re-promotes
+    assert registry.resolve("stat_scores", True) is True
+    stat_scores_counts(t, p, corr, w, c, force_pallas=True)
+    assert policy.failures == 0 and policy.repromotions == 1 and policy.cooldown == 0
+
+
+@pytest.mark.chaos
+def test_kernel_demotion_never_permanent_even_with_resilience_off(monkeypatch):
+    """With METRICS_TPU_RESILIENCE=0, engine demotions go permanent — but
+    kernel demotions must not: the lax path being bit-exact means a
+    retry is always safe."""
+    monkeypatch.setenv("METRICS_TPU_RESILIENCE", "0")
+    t, p, corr, w, c = _example(seed=9)
+    ref = stat_scores_counts(t, p, corr, w, c, force_pallas=False)
+    with faults.inject("launch", count=1):
+        got = stat_scores_counts(t, p, corr, w, c, force_pallas=True)
+    for a, b in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not registry.get("stat_scores").policy.permanent
+    # and with resilience off the policy never gates resolution at all
+    assert registry.resolve("stat_scores", True) is True
+
+
+@pytest.mark.chaos
+def test_fused_window_tick_fault_falls_back_to_eager_tick():
+    from metrics_tpu import Accuracy, SlidingWindow
+    from metrics_tpu import ops
+
+    rng = np.random.RandomState(10)
+    batches = [
+        (jnp.asarray(rng.rand(8, 4).astype(np.float32)), jnp.asarray(rng.randint(0, 4, 8)))
+        for _ in range(4)
+    ]
+
+    def run(with_fault):
+        registry.reset_stats()
+        w = SlidingWindow(Accuracy(num_classes=4, average="macro"), window=4, slide=2, jit_update=False)
+        outs = []
+        for i, (probs, labels) in enumerate(batches):
+            if with_fault and i == 1:
+                with faults.inject("launch", count=1):
+                    ran = ops.fused_window_tick(w, (probs, labels), {})
+                assert ran is False  # demoted: caller would run the eager tick
+                w.update(probs, labels)
+            elif with_fault:
+                w.update(probs, labels)  # eager (env off -> eager path anyway)
+            else:
+                w.update(probs, labels)
+            outs.append(np.asarray(w.compute()))
+        return outs
+
+    clean = run(with_fault=False)
+    faulted = run(with_fault=True)
+    for i, (a, b) in enumerate(zip(clean, faulted)):
+        np.testing.assert_array_equal(a, b, err_msg=f"step {i}")
+    assert not registry.get("window_tick").policy.permanent

@@ -119,6 +119,30 @@ SCHEDULE: Tuple[Tuple[str, str, Dict[str, Any], Tuple[str, ...], Tuple[str, ...]
         ("window_advance_us",),
     ),
     (
+        "kernels",
+        "_cfg_kernels",
+        {"reps": 3},
+        (
+            "window_tick_launches",
+            "kernels_registered",
+            "kernels_engaged_forced",
+        ),
+        (
+            "stat_scores_kernel_us",
+            "stat_scores_lax_us",
+            "confusion_matrix_kernel_us",
+            "confusion_matrix_lax_us",
+            "retrieval_sort_kernel_us",
+            "retrieval_sort_lax_us",
+            "countmin_scatter_kernel_us",
+            "countmin_scatter_lax_us",
+            "binned_stats_kernel_us",
+            "binned_stats_lax_us",
+            "window_tick_fused_us",
+            "window_tick_eager_us",
+        ),
+    ),
+    (
         "read_path",
         "_cfg_read_path",
         {"sessions": 16, "reps": 3},

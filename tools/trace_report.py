@@ -133,6 +133,13 @@ def summarize(events: List[Dict[str, Any]]) -> str:
         if (e.get("attrs") or {}).get("model_flops") is not None
     ]
     if costed:
+        # kernel column: the ops/ registry's verdict per row — `yes` when
+        # the owner's programs engaged a registered Pallas kernel (or the
+        # row IS an ops.* kernel launch), `eligible` when a registered
+        # kernel covers the owner but was not engaged (a kernelization
+        # target), `no` otherwise. See docs/kernels.md.
+        from metrics_tpu.ops import registry as ops_registry
+
         by_cfg: Dict[str, List[Dict[str, Any]]] = {}
         for e in costed:
             cfg = f"{e.get('owner', '?')}:{e.get('kind', '?')}"
@@ -154,6 +161,9 @@ def summarize(events: List[Dict[str, Any]]) -> str:
                 "gflops": best_gflops,
                 "gbps": best_gbps,
                 "frac": frac,
+                "kernel": ops_registry.kernel_status(
+                    str(evs[0].get("owner", "?")), str(evs[0].get("kind", "?"))
+                ),
             })
         # relative basis: normalize each regime's wall against the best
         # achieved rate for that wall anywhere in this trace
@@ -171,12 +181,13 @@ def summarize(events: List[Dict[str, Any]]) -> str:
         lines.append(f"roofline ({basis} basis), ranked by distance to roofline:")
         lines.append(
             f"  {'config':<36}{'launches':>9}{'intensity':>11}  {'regime':<16}"
-            f"{'GB/s':>9}{'GFLOP/s':>10}{'of roof':>9}"
+            f"{'GB/s':>9}{'GFLOP/s':>10}{'of roof':>9}  {'kernel':<8}"
         )
         for r in rows:
             lines.append(
                 f"  {r['cfg']:<36}{r['n']:>9}{r['intensity']:>11.3f}  {r['regime']:<16}"
                 f"{r['gbps']:>9.2f}{r['gflops']:>10.2f}{100.0 * r['frac']:>8.1f}%"
+                f"  {r['kernel']:<8}"
             )
 
     # persistent AOT cache + in-process LRU churn (metrics_tpu.aot_cache):
