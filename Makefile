@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load kernels doctest audit sentinel bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load kernels quant doctest audit sentinel bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -71,6 +71,7 @@ chaos:
 	$(MAKE) load
 	$(MAKE) chaos-elastic
 	$(MAKE) kernels
+	$(MAKE) quant
 	$(MAKE) sentinel
 
 # kernel-registry lane (docs/kernels.md): interpret-mode bitwise parity of
@@ -80,6 +81,14 @@ chaos:
 kernels:
 	python -m pytest tests/ops/ -q
 	python -c "import json, bench; d = {}; bench._cfg_kernels(d, reps=3); print(json.dumps(d, indent=2))"
+
+# quantized-wire lane (docs/distributed.md "Quantized collectives"): the
+# codec property suite + sync/fleet-read/replication integration + the
+# quant-corruption fault matrix, then the wire-vs-logical byte pairs and
+# correctness flags at sentinel scale (the 3.94x f32 shrink pin)
+quant:
+	python -m pytest tests/bases/test_quant.py -q
+	python -c "import json, bench; d = {}; bench._cfg_quant(d); print(json.dumps(d, indent=2))"
 
 # kill-and-recover loop: for EVERY registered crash point a subprocess is
 # SIGKILLed at that instruction, then a fresh process recover()s

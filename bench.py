@@ -324,6 +324,135 @@ def _cfg_sync_engine(detail: dict) -> None:
             os.environ["METRICS_TPU_FUSED_SYNC"] = prev
 
 
+def _cfg_quant(detail: dict) -> None:
+    """Quantized packed collectives (metrics_tpu/quant.py): the wire-vs-
+    logical byte pair for each of the three quantized wires — the int8
+    sync bucket, the quantized fleet read, and the replication ship frame
+    — plus the correctness flags the error model promises (int-sum
+    bit-exact below the scale threshold, float parity within the q8
+    bound, HLL registers lossless). The byte ratios are structural (the
+    codec's block layout), so they are stable across devices."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import profiling, quant, telemetry
+    from metrics_tpu.fabric import ShardedMetricsService
+    from metrics_tpu.metric import Metric
+    from metrics_tpu.parallel.dist_env import NoOpEnv
+    from metrics_tpu.streaming.sketch import HyperLogLog
+
+    class _Loopback2(NoOpEnv):
+        def world_size(self):
+            return 2
+
+        def all_gather(self, x):
+            x = jnp.atleast_1d(x)
+            return [x, x]
+
+        def all_reduce(self, x, op):
+            stacked = jnp.stack([jnp.atleast_1d(x)] * 2)
+            red = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}.get(op)
+            return None if red is None else red(stacked, axis=0)
+
+    class _Vec(Metric):
+        full_state_update = False
+
+        def __init__(self, n=2048, dtype=jnp.float32, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("value", jnp.zeros((n,), dtype), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.value = self.value + x
+
+        def compute(self):
+            return jnp.sum(self.value)
+
+    env = _Loopback2()
+    rng = np.random.RandomState(7)
+    x = np.asarray(rng.randn(2048), np.float32)
+
+    # (1) sync bucket: wire vs logical bytes + float parity vs the bound
+    m = _Vec(sync_precision="int8")
+    m.update(jnp.asarray(x))
+    with profiling.track_syncs() as t:
+        m.sync(env=env)
+    got = np.asarray(m.value)
+    m.unsync()
+    detail["quant_sync_bytes_on_wire"] = t.bytes_on_wire
+    detail["quant_sync_bytes_logical"] = t.bytes_logical
+    detail["quant_sync_wire_ratio"] = round(t.bytes_logical / max(t.bytes_on_wire, 1), 2)
+    exact = 2.0 * x
+    # documented bound: per element <= amax_block/254 per participant
+    bound = 2.0 * float(np.abs(x).max()) * quant.REL_ERROR_BOUND
+    err = float(np.max(np.abs(got - exact)))
+    detail["quant_sync_float_within_bound"] = bool(err <= bound * (1 + 1e-5))
+
+    # (2) int-sum bucket is bit-exact below INT_EXACT_BOUND
+    mi = _Vec(n=1024, dtype=jnp.int32, sync_precision="int8")
+    counts = np.asarray(rng.randint(0, 50, 1024), np.int32)
+    mi.update(jnp.asarray(counts))
+    mi.sync(env=env)
+    got_i = np.asarray(mi.value)
+    mi.unsync()
+    detail["quant_sync_int_sum_bitexact"] = bool(np.array_equal(got_i, 2 * counts))
+
+    # (3) HLL registers cross on the bit-plane pack codec: lossless
+    data = jnp.asarray(rng.randn(2000))
+
+    def _hll(precision_on):
+        h = HyperLogLog(precision=10)
+        if precision_on:
+            h.sync_precision = "int8"
+        h.update(data)
+        h.sync(env=env)
+        regs = np.asarray(h.value)
+        h.unsync()
+        return regs
+
+    detail["quant_hll_union_bitexact"] = bool(np.array_equal(_hll(True), _hll(False)))
+
+    # (4) fleet read: wire vs logical from the packed-read span
+    fab = ShardedMetricsService(_Vec(sync_precision="int8"), num_shards=2)
+    for i in range(6):
+        fab.submit(f"t{i}", jnp.asarray(rng.randn(2048).astype(np.float32)))
+    fab.drain()
+    with telemetry.instrument() as sess:
+        fab.compute_all()
+    fab.shutdown()
+    span = sess.spans(name="collective", kind="packed-read")[0]
+    detail["quant_fleet_read_bytes_on_wire"] = span.attrs["nbytes"]
+    detail["quant_fleet_read_bytes_logical"] = span.attrs["logical_nbytes"]
+    detail["quant_fleet_read_wire_ratio"] = round(
+        span.attrs["logical_nbytes"] / max(span.attrs["nbytes"], 1), 2)
+
+    # (5) replication ship frame: quantized vs full-precision frame bytes
+    from metrics_tpu import MeanMetric
+
+    with tempfile.TemporaryDirectory() as d:
+        fab = ShardedMetricsService(
+            MeanMetric(), num_shards=2, data_dir=d,
+            standby=True, replication_precision="int8",
+        )
+        for i in range(6):
+            fab.submit(f"t{i}", jnp.asarray(rng.randn(256).astype(np.float32)))
+        fab.drain()
+        fab.replicate()  # seeds the standbys
+        for i in range(6):
+            fab.submit(f"t{i}", jnp.asarray(rng.randn(256).astype(np.float32)))
+        fab.drain()
+        with telemetry.instrument() as sess:
+            fab.replicate()
+        fab.shutdown()
+    ship = [s for s in sess.spans(name="replicate", kind="ship") if s.attrs.get("records")]
+    if ship:
+        wire = sum(s.attrs["nbytes"] for s in ship)
+        logical = sum(s.attrs["logical_nbytes"] for s in ship)
+        detail["quant_ship_bytes_on_wire"] = wire
+        detail["quant_ship_bytes_logical"] = logical
+        detail["quant_ship_wire_ratio"] = round(logical / max(wire, 1), 2)
+
+
 def _cfg_static_audit(detail: dict) -> None:
     """Static-analysis sweep health: size/latency of the registry audit,
     the ratchet verdict against the checked-in STATIC_AUDIT.json, and the
@@ -1812,6 +1941,7 @@ def _bench_detail() -> dict:
         ("wer_update_ms_1k_pairs", _cfg_wer),
         ("collection_dist_sync_8dev_us", _cfg_dist_sync),
         ("sync_collectives_fused_collection", _cfg_sync_engine),
+        ("quant_sync_wire_ratio", _cfg_quant),
         ("audit_metrics_swept", _cfg_static_audit),
         ("forward_launches_single_metric_10_steps", _cfg_forward_engine),
         ("telemetry_idle_overhead_ratio", _cfg_telemetry_overhead),
@@ -2038,6 +2168,7 @@ def _bench_detail_fast() -> dict:
         ("collection", _cfg_collection),
         ("dispatch_engine", _cfg_dispatch_engine),
         ("sync_engine", _cfg_sync_engine),
+        ("quant", _cfg_quant),
         ("forward_engine", _cfg_forward_engine),
         ("telemetry_overhead", _cfg_telemetry_overhead),
         ("resilience_overhead", _cfg_resilience_overhead),

@@ -83,6 +83,7 @@ class MetricCollection:
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
         fused_update: Optional[bool] = None,
+        sync_precision: Optional[str] = None,
     ) -> None:
         self._modules: "OrderedDict[str, Metric]" = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
@@ -118,6 +119,19 @@ class MetricCollection:
         self._synced_members: Optional[List[Tuple[Metric, bool, bool]]] = None
 
         self.add_metrics(metrics, *additional_metrics)
+
+        # collection-level quantized-wire opt-in: applied to every member
+        # that did not choose its own sync_precision (a member's explicit
+        # setting wins) — the fused bucket passes then route the members'
+        # eligible leaves through the quantized wire together
+        if sync_precision is not None:
+            if sync_precision != "int8":
+                raise ValueError(
+                    f'Expected keyword argument `sync_precision` to be None or "int8" but got {sync_precision}'
+                )
+            for _, m in self.items(keep_base=True):
+                if getattr(m, "sync_precision", None) is None:
+                    m.sync_precision = sync_precision
 
     def __getstate__(self) -> Dict[str, Any]:
         # jitted/AOT dispatchers hold unpicklable callables; rebuilt lazily

@@ -92,7 +92,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import faults, resilience, sync_engine, telemetry, wal
+from metrics_tpu import faults, quant, resilience, sync_engine, telemetry, wal
 from metrics_tpu.analysis import cost_model
 from metrics_tpu.serve import _MIN_SESSION_BUCKET, MetricsService, ValueTicket
 from metrics_tpu.utilities.data import bucket_pow2
@@ -291,6 +291,7 @@ class ShardedMetricsService:
         vnodes: int = 64,
         auto_failover: bool = True,
         standby: bool = False,
+        replication_precision: Optional[str] = None,
         suspect_p99_multiple: float = 4.0,
         suspect_min_requests: int = 32,
         coalesce: bool = True,
@@ -333,6 +334,17 @@ class ShardedMetricsService:
 
         # hot-standby replication (see module docstring)
         self.standby = bool(standby)
+        if replication_precision not in (None, "int8"):
+            raise ValueError(
+                f"replication_precision must be None or 'int8', got "
+                f"{replication_precision!r}"
+            )
+        # opt-in quantized replication wire: ship batches and bulk
+        # re-seeds cross as crc-guarded int8 frames (metrics_tpu.wal
+        # encode_ship_frame / encode_seed_frame) — float leaves lossy
+        # within the documented codec bound, int/bool/opted-out leaves
+        # exact; anti_entropy() switches to the tolerance-aware comparand
+        self.replication_precision = replication_precision
         self._standbys: Dict[int, wal.StandbyReplica] = {}
         # gray-failure suspicion thresholds
         self.suspect_p99_multiple = float(suspect_p99_multiple)
@@ -545,17 +557,21 @@ class ShardedMetricsService:
     def compute(self, name: str) -> Any:
         return self._route(name).service.compute(name)
 
-    def _fleet_program(self, kind: str, n: int, m: int, builder, example_args: Tuple) -> Tuple[Any, Any]:
+    def _fleet_program(self, kind: str, n: int, m: int, builder, example_args: Tuple, wire_sig: Tuple = ()) -> Tuple[Any, Any]:
         """The AOT-compiled packed program for one fleet-read signature,
         plus its :class:`~metrics_tpu.analysis.cost_model.CostEntry`.
         Compiled ONCE per (kind, shard count, session bucket, input aval
-        signature) via ``jit(...).lower(...).compile()`` — the compile is
-        announced as a ``compile`` span (kind ``fleet-<kind>``) carrying
-        the executable's cost attrs, like every other AOT seam."""
+        signature, wire codec signature) via
+        ``jit(...).lower(...).compile()`` — the compile is announced as a
+        ``compile`` span (kind ``fleet-<kind>``) carrying the
+        executable's cost attrs, like every other AOT seam. ``wire_sig``
+        is the per-leaf codec tag tuple (`sync_engine.fleet_wire_sig`) so
+        toggling quantization never reuses a stale program."""
         flat, _ = jax.tree_util.tree_flatten(example_args)
         key = (
             kind, n, m,
             tuple((tuple(x.shape), str(jnp.dtype(x.dtype))) for x in flat),
+            wire_sig,
         )
         cached = self._fleet_programs.get(key)
         if cached is not None:
@@ -623,22 +639,24 @@ class ShardedMetricsService:
                 shard_leaves.append(tuple(svc._stacked[k] for k in svc._names))
                 shard_idx.append(jnp.asarray(idx))
             program_args = (tuple(shard_leaves), tuple(shard_idx))
+            wire_specs = sync_engine._leaf_wire_specs(template, leaf_names, m=m)
             program, cost_entry = self._fleet_program(
                 "read", n, m,
                 lambda: sync_engine.build_fleet_read(template, leaf_names, n, m),
                 program_args,
+                wire_sig=sync_engine.fleet_wire_sig(wire_specs),
             )
             c0 = telemetry.clock()
             vals = program(*program_args)
             c_dur = None if c0 is None else (time.perf_counter() - c0) * 1e6
             self.stats["fleet_read_collectives"] += 1
-            nbytes = sum(
-                spec[3] * n * m
-                for spec in sync_engine._leaf_wire_specs(template, leaf_names)
-            )
+            logical_nbytes = sum(spec[3] * n * m for spec in wire_specs)
+            nbytes = sync_engine.fleet_wire_nbytes(wire_specs, n, m)
             telemetry.emit(
                 "collective", self.label, "packed-read", t0=c0, dur_us=c_dur,
-                nbytes=nbytes, nleaves=len(leaf_names), shards=n,
+                nbytes=nbytes, logical_nbytes=logical_nbytes,
+                quantized=any(spec[4] is not None for spec in wire_specs),
+                nleaves=len(leaf_names), shards=n,
                 **(cost_model.launch_attrs(cost_entry, c_dur)
                    if telemetry.subscribed() else {}),
             )
@@ -708,10 +726,12 @@ class ShardedMetricsService:
             shard_leaves.append(tuple(svc._stacked[k] for k in svc._names))
             shard_idx.append(jnp.asarray(idx))
         program_args = (tuple(shard_leaves), tuple(shard_idx), jnp.asarray(valid))
+        wire_specs = sync_engine._leaf_wire_specs(template, leaf_names, m=m)
         program, cost_entry = self._fleet_program(
             "rollup", n, m,
             lambda: sync_engine.build_fleet_rollup(template, leaf_names, n, m),
             program_args,
+            wire_sig=sync_engine.fleet_wire_sig(wire_specs),
         )
         r0 = telemetry.clock()
         val = program(*program_args)
@@ -720,6 +740,8 @@ class ShardedMetricsService:
         telemetry.emit(
             "read", self.label, "rollup", t0=t0, stream="serve",
             shards=n, sessions=int(valid.sum()), collectives=1,
+            nbytes=sync_engine.fleet_wire_nbytes(wire_specs, n, m),
+            logical_nbytes=sum(spec[3] * n * m for spec in wire_specs),
             **(cost_model.launch_attrs(cost_entry, r_dur)
                if telemetry.subscribed() else {}),
         )
@@ -1132,6 +1154,25 @@ class ShardedMetricsService:
         # the applied floor past what actually shipped (the next ship
         # detects the gap, if any, and re-seeds)
         floor = min(floor, records[-1].seq if records else standby.cursor)
+        nbytes = logical_nbytes = 0
+        if self.replication_precision is not None:
+            # the batch crosses the shard boundary as a crc-guarded
+            # quantized wire frame — float args int8, everything else
+            # exact. A garbled frame (the quant-corruption fault, or
+            # real bit damage) fails the crc and raises
+            # StateCorruptionError before any state can diverge.
+            frame = wal.encode_ship_frame(
+                records, floor, precision=self.replication_precision
+            )
+            nbytes = len(frame)
+            if telemetry.subscribed():
+                logical_nbytes = len(wal.encode_ship_frame(records, floor))
+            if faults.should_fire("quant-corruption"):
+                frame = frame[: len(frame) // 2] + bytes(
+                    [frame[len(frame) // 2] ^ 0xFF]
+                ) + frame[len(frame) // 2 + 1 :]
+            records, floor = wal.decode_ship_frame(frame)
+            standby.lossy_budget += wal.frame_error_budget(frame)
         applied = standby.apply(records, floor)
         # hold truncation back to the ship cursor: the next checkpoint
         # fence must not delete records the standby has not streamed
@@ -1139,7 +1180,9 @@ class ShardedMetricsService:
         telemetry.emit(
             "replicate", self.label, "ship", t0=telemetry.clock(),
             stream="serve", shard=shard.shard_id, records=len(records),
-            applied=applied, floor=floor,
+            applied=applied, floor=floor, nbytes=nbytes,
+            logical_nbytes=logical_nbytes,
+            quantized=self.replication_precision is not None,
         )
         return applied
 
@@ -1151,7 +1194,7 @@ class ShardedMetricsService:
         svc = shard.service
         with svc._flush_lock:
             floor = svc.replication_floor()
-            standby.seed_from(svc, floor)
+            standby.seed_from(svc, floor, precision=self.replication_precision)
         svc.journal.retain_seq = standby.cursor
         telemetry.emit(
             "replicate", self.label, "reseed-gap", t0=telemetry.clock(),
@@ -1174,9 +1217,40 @@ class ShardedMetricsService:
             # pin the floor: no flush may advance the state between the
             # floor read and the mirror, or the cursor would lie
             floor = shard.service.replication_floor()
-            standby.seed_from(shard.service, floor)
+            standby.seed_from(
+                shard.service, floor, precision=self.replication_precision
+            )
         standby.host = host
         return standby
+
+    def _lossy_states_close(self, svc: MetricsService, standby: wal.StandbyReplica) -> bool:
+        """Quantization-aware anti-entropy comparand. A standby fed
+        int8-quantized wire frames can never be bit-identical on float
+        leaves, so those compare within the standby's accumulated error
+        allowance — ``standby.lossy_budget``, the exact sum of
+        per-element ``scale / 2`` bounds over every quantized frame it
+        ingested since its last seed (:func:`metrics_tpu.wal.
+        frame_error_budget`), not a guess from state magnitudes. Integer
+        / bool / opted-out leaves must still match bit-for-bit, so real
+        corruption on exact state is never excused by the float
+        allowance."""
+        sb = standby.service
+        if sorted(svc._rows) != sorted(sb._rows):
+            return False
+        optout = getattr(svc.template, "_quantize", None) or {}
+        tol = standby.lossy_budget * (1.0 + 1e-6) + 1e-9
+        for name in sorted(svc._rows):
+            rp, rs = svc._rows[name], sb._rows[name]
+            for k in svc._names:
+                a = np.asarray(svc._stacked[k][rp])
+                b = np.asarray(sb._stacked[k][rs])
+                lossy = a.dtype.kind == "f" and optout.get(k, True)
+                if lossy:
+                    if not np.allclose(a, b, rtol=0.0, atol=tol):
+                        return False
+                elif not np.array_equal(a, b):
+                    return False
+        return True
 
     def anti_entropy(self) -> List[int]:
         """Checksum every standby against its primary at a common
@@ -1184,7 +1258,11 @@ class ShardedMetricsService:
         the stacked rows); a divergent standby is re-seeded by bulk state
         transfer. Returns the shard ids that diverged. Divergence should
         never happen through the shipping path — this is the backstop
-        that turns a silent replica corruption into a bounded repair."""
+        that turns a silent replica corruption into a bounded repair.
+        Under ``replication_precision="int8"`` the digest comparison
+        becomes tolerance-aware for lossy float leaves
+        (:meth:`_lossy_states_close`) — the quantized wire's bounded
+        error is expected, not divergence."""
         diverged: List[int] = []
         for shard in self._live_shards():
             standby = self._standbys.get(shard.shard_id)
@@ -1203,10 +1281,15 @@ class ShardedMetricsService:
                         records,
                         min(floor, records[-1].seq if records else standby.cursor),
                     )
-                    ok = svc.state_digest() == standby.digest()
+                    if self.replication_precision is not None:
+                        ok = self._lossy_states_close(svc, standby)
+                    else:
+                        ok = svc.state_digest() == standby.digest()
                 if not ok:
                     diverged.append(shard.shard_id)
-                    standby.seed_from(svc, floor)
+                    standby.seed_from(
+                        svc, floor, precision=self.replication_precision
+                    )
                 svc.journal.retain_seq = standby.cursor
             telemetry.emit(
                 "anti-entropy", self.label, "scrub", t0=telemetry.clock(),

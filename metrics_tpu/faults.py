@@ -54,6 +54,16 @@ fault name                where it fires
                           after which every journaled write from the
                           old owner raises ``StaleEpochError``: exactly
                           one side of the partition wins
+``quant-corruption``      the quantized wire is damaged in flight: a
+                          sync-engine quantized bucket codec raises at
+                          its injection point (the engine must demote
+                          that bucket to the full-precision collective
+                          with a cause-tagged ``degrade`` span and
+                          still produce correct values), and a
+                          replication ship frame is bit-garbled before
+                          decode (the crc guard must convert it into
+                          ``StateCorruptionError``, never silently
+                          apply damaged state)
 ========================= ==============================================
 
 Activation is per-test via the context manager::
@@ -123,6 +133,7 @@ FAULT_NAMES = (
     "shard-death",
     "shard-slow",
     "network-partition",
+    "quant-corruption",
 )
 
 _ENV_VAR = "METRICS_TPU_INJECT_FAULT"

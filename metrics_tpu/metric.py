@@ -216,6 +216,7 @@ class Metric(ABC):
         sync_env: Optional[DistEnv] = None,
         jit_update: bool = False,
         sync_dtype: Optional[Any] = None,
+        sync_precision: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         # Unknown kwargs are swallowed for drop-in compatibility with the
@@ -239,6 +240,17 @@ class Metric(ABC):
         if sync_dtype is not None and not jnp.issubdtype(jnp.dtype(sync_dtype), jnp.floating):
             raise ValueError(f"Expected keyword argument `sync_dtype` to be a float dtype but got {sync_dtype}")
         self.sync_dtype = None if sync_dtype is None else jnp.dtype(sync_dtype)
+        # opt-in quantized wire for the fused sync buckets (and, via the
+        # serving fabric, fleet reads): "int8" routes eligible buckets
+        # through the metrics_tpu.quant codec — see docs/distributed.md
+        # "Quantized collectives" for the per-family error model. Composes
+        # with sync_dtype (quantization supersedes it for eligible leaves);
+        # METRICS_TPU_QUANT_SYNC=0 kills it bit-exactly.
+        if sync_precision is not None and sync_precision != "int8":
+            raise ValueError(
+                f'Expected keyword argument `sync_precision` to be None or "int8" but got {sync_precision}'
+            )
+        self.sync_precision = sync_precision
         self._sync_env = sync_env
         if jit_update and type(self).host_only:
             # refuse up front with a visible reason instead of letting the
@@ -288,6 +300,9 @@ class Metric(ABC):
         self._defaults: Dict[str, StateType] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Optional[Callable]] = {}
+        # per-leaf quantized-wire opt-out (``add_state(quantize=False)``);
+        # absent means eligible when ``sync_precision`` is set
+        self._quantize: Dict[str, bool] = {}
 
         self._is_synced = False
         self._cache: Optional[Dict[str, StateType]] = None
@@ -299,12 +314,15 @@ class Metric(ABC):
         default: Union[Array, List, float, int],
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
+        quantize: bool = True,
     ) -> None:
         """Declare a metric state (ref metric.py:129-196).
 
         ``default`` must be an array(-like) or an **empty** list. The
         reduction governs both cross-device sync and ``forward``'s
-        batch-state merge.
+        batch-state merge. ``quantize=False`` exempts this leaf from the
+        quantized wire even when the metric opted in via
+        ``sync_precision=`` — it then always crosses at full precision.
         """
         if not isinstance(default, (list,)) and not hasattr(default, "shape") and not isinstance(default, (int, float)):
             raise ValueError("state variable must be an array or an empty list (where you can append arrays)")
@@ -333,6 +351,7 @@ class Metric(ABC):
         self._defaults[name] = default if isinstance(default, list) else default
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
+        self._quantize[name] = bool(quantize)
 
     def state(self) -> Dict[str, StateType]:
         """Current state as a dict pytree.
@@ -916,9 +935,12 @@ class Metric(ABC):
         # supplied their own gather (which may communicate regardless)
         will_communicate = env.is_distributed() or dist_sync_fn is not None
 
-        def _record(kind: str, x: Any) -> None:
+        def _record(kind: str, x: Any, logical: Optional[int] = None) -> None:
             # comms observability: every collective this sync issues is
-            # counted with its payload bytes (see metrics_tpu.telemetry)
+            # counted with its payload bytes (see metrics_tpu.telemetry).
+            # ``logical`` is the pre-compression state size when the leaf
+            # crossed the wire narrowed (sync_dtype) — spans carry BOTH, so
+            # trace reports can attribute the compression ratio.
             if not will_communicate:
                 return
             nbytes = int(np.prod(jnp.shape(x))) * jnp.dtype(x.dtype).itemsize
@@ -926,27 +948,28 @@ class Metric(ABC):
             self._sync_stats["bytes_on_wire"] += nbytes
             telemetry.emit(
                 "collective", type(self).__name__, kind,
-                nbytes=nbytes, dtype=jnp.dtype(x.dtype).name,
+                nbytes=nbytes, logical_nbytes=nbytes if logical is None else int(logical),
+                dtype=jnp.dtype(x.dtype).name,
             )
 
         if dist_sync_fn is not None:
             # documented custom-gather contract: (state_tensor, env) -> List[Array]
-            def base_gather(x):
-                _record("gather", x)
+            def base_gather(x, _logical=None):
+                _record("gather", x, _logical)
                 return dist_sync_fn(x, env)
 
             uniform_gather = base_gather  # custom gathers see every state as-is
         else:
 
-            def base_gather(x):
-                _record("gather", x)
+            def base_gather(x, _logical=None):
+                _record("gather", x, _logical)
                 return env.all_gather(x)
 
-            def uniform_gather(x):
+            def uniform_gather(x, _logical=None):
                 # fixed-shape states are equal-shaped on every rank by
                 # construction, so the env may skip any shape-agreement
                 # round trip (ProcessEnv drops its per-leaf size exchange)
-                _record("gather", x)
+                _record("gather", x, _logical)
                 return env.all_gather_uniform(x)
 
         if self.sync_dtype is not None and will_communicate:
@@ -959,7 +982,8 @@ class Metric(ABC):
             def _compressed(inner):
                 def gather(x):
                     if jnp.issubdtype(x.dtype, jnp.floating) and jnp.dtype(x.dtype).itemsize > self.sync_dtype.itemsize:
-                        return [g.astype(x.dtype) for g in inner(x.astype(self.sync_dtype))]
+                        logical = int(np.prod(jnp.shape(x))) * jnp.dtype(x.dtype).itemsize
+                        return [g.astype(x.dtype) for g in inner(x.astype(self.sync_dtype), _logical=logical)]
                     return inner(x)
 
                 return gather

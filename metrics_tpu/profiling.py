@@ -105,8 +105,9 @@ def _route_event(event: telemetry.TelemetryEvent) -> None:
             tracker._record_launch(event.owner, event.kind, event.dur_us)
     elif name == "collective":
         nbytes = int(event.attrs.get("nbytes", 0))
+        logical = int(event.attrs.get("logical_nbytes", nbytes))
         for tracker in _snapshot(_active_sync_trackers):
-            tracker._record(event.owner, event.kind, nbytes)
+            tracker._record(event.owner, event.kind, nbytes, logical)
 
 
 def _activate(trackers: List, tracker) -> None:
@@ -205,6 +206,9 @@ class SyncTracker:
         bytes_on_wire: total payload bytes crossing the interconnect, summed
             over every recorded collective (the *launch* payload; an
             all-gather additionally returns ``world x`` that many bytes).
+        bytes_logical: total pre-compression state bytes behind those
+            payloads (``logical_nbytes`` span attr; equals ``bytes_on_wire``
+            when nothing was compressed or quantized).
         events: ``(owner, kind, nbytes)`` tuples in record order.
     """
 
@@ -212,6 +216,7 @@ class SyncTracker:
         self.collectives = 0
         self.buckets = 0
         self.bytes_on_wire = 0
+        self.bytes_logical = 0
         self.events: List[Tuple[str, str, int]] = []
         self._by_kind: Dict[str, int] = {}
 
@@ -229,9 +234,10 @@ class SyncTracker:
             return self.bytes_on_wire
         return sum(n for o, k, n in self.events if (kind is None or k == kind) and (owner is None or owner in o))
 
-    def _record(self, owner: str, kind: str, nbytes: int) -> None:
+    def _record(self, owner: str, kind: str, nbytes: int, logical: Optional[int] = None) -> None:
         self.collectives += 1
         self.bytes_on_wire += nbytes
+        self.bytes_logical += nbytes if logical is None else logical
         if kind == "fused":
             self.buckets += 1
         self._by_kind[kind] = self._by_kind.get(kind, 0) + 1

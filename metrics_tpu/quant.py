@@ -1,0 +1,230 @@
+"""Quantized wire codec for packed collectives (EQuARX-style, arxiv 2506.17615).
+
+The fused sync engine, the packed fleet reads, and WAL replication all move
+metric state across an interconnect as ONE packed buffer per schedule entry;
+this module shrinks those buffers with a block-wise int8 encoding plus a
+bit-plane packer for small-integer register states (HyperLogLog). It is a
+pure codec: no engine imports, ``jnp`` ops only on the device paths (so
+encode/decode trace cleanly inside ``shard_map``) and a ``numpy`` twin for
+the host-side replication wire.
+
+Wire formats
+============
+
+``q8`` — block-wise symmetric int8 (the EQuARX scheme):
+    the flat buffer is split into blocks of ``block`` elements (default
+    256, ``METRICS_TPU_QUANT_BLOCK``); each block crosses as int8 codes
+    plus ONE f32 scale, chosen symmetric (``amax / 127``) so zero maps to
+    zero exactly. Wire cost: ``1 + 4/block`` bytes per element — a 3.94x
+    shrink for f32 at the default block (the 4x headline minus the 1.6%
+    scale overhead), 7.88x for f64.
+
+``pack<bits>`` — bit-plane packing of small non-negative integers:
+    ``bits`` bit-planes of 8 values each per byte. Exact (never a value
+    cast) for ``0 <= v < 2**bits``; used for HyperLogLog registers, whose
+    values are leading-zero ranks bounded by ``32 - precision + 1`` — 5
+    bits at the default precision, a 6.4x shrink over the int32 state.
+
+Error model (the contract the tests pin)
+========================================
+
+* **Accumulation is always full precision**: quantization happens only at
+  the wire boundary — encode, ONE collective on the packed payload,
+  decode, then reduce in the state dtype. No reduction ever runs on int8.
+* **Float states** (``q8``, nearest rounding): per element,
+  ``|decoded - x| <= amax_block / 254`` — relative error at most
+  ``1/254`` of the block's max magnitude. Zero blocks are exact.
+* **Integer-sum states**: decode rounds back to the integer lattice, so a
+  leaf is **bit-exact** whenever every block's max magnitude is at most
+  ``INT_EXACT_BOUND`` (= 127: the quantization step is then <= 1 and
+  round-to-nearest recovers each integer). Above the bound the float
+  error model applies before re-rounding.
+* **Never-underestimate states** (``rounding="up"``, CountMin): codes are
+  ``ceil`` with denominator 126, so ``x <= decoded <= x + amax_block/126``
+  per element — each worker's contribution only over-counts, preserving
+  the sketch's upper-bound guarantee through the wire.
+* **Register states** (``pack``): lossless by construction.
+
+Kill switch: ``METRICS_TPU_QUANT_SYNC=0`` disables every quantized path
+(sync buckets, fleet reads, replication frames) bit-exactly.
+"""
+import os
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_BLOCK = 256
+# integer leaves are bit-exact through the q8 wire while every block's max
+# magnitude stays at or below this (step <= 1 => rounding recovers exactly)
+INT_EXACT_BOUND = 127
+# documented per-element relative error bound of the nearest-rounded q8
+# wire (fraction of the block's max magnitude)
+REL_ERROR_BOUND = 1.0 / 254.0
+
+
+def quant_enabled() -> bool:
+    """Is the quantized wire enabled? (default: yes; the paths are still
+    opt-in per metric via ``sync_precision=``.)
+
+    Kill switch: ``METRICS_TPU_QUANT_SYNC=0`` (or ``false``/``off``)
+    restores every full-precision wire bit-exactly.
+    """
+    return os.environ.get("METRICS_TPU_QUANT_SYNC", "1").strip().lower() not in ("0", "false", "off")
+
+
+def default_block() -> int:
+    try:
+        return max(8, int(os.environ.get("METRICS_TPU_QUANT_BLOCK", DEFAULT_BLOCK)))
+    except ValueError:
+        return DEFAULT_BLOCK
+
+
+class QuantCodec(NamedTuple):
+    """One leaf's negotiated wire encoding.
+
+    ``kind`` is ``"q8"`` (block int8 + f32 scales) or ``"pack"`` (lossless
+    bit-plane packing, ``bits`` wide). ``rounding`` is ``"nearest"`` or
+    ``"up"`` (ceil codes — never-underestimate sketches).
+    """
+
+    kind: str
+    bits: int = 8
+    rounding: str = "nearest"
+
+
+def wire_tag(codec: Optional[QuantCodec], wire_name: str) -> str:
+    """The bucket-key wire label: the plain dtype name for full precision,
+    ``q8:<dtype>`` / ``q8u:<dtype>`` / ``pack<bits>:<dtype>`` quantized —
+    codecs with different semantics never share a bucket."""
+    if codec is None:
+        return wire_name
+    if codec.kind == "pack":
+        return f"pack{codec.bits}:{wire_name}"
+    return f"q8{'u' if codec.rounding == 'up' else ''}:{wire_name}"
+
+
+def bits_for_bound(bound: int) -> int:
+    """Smallest bit width holding values ``0..bound`` (>=1)."""
+    return max(1, int(bound).bit_length())
+
+
+# ------------------------------------------------------------- jnp codec
+def encode_q8(x: Any, block: Optional[int] = None, rounding: str = "nearest") -> Tuple[Any, Any]:
+    """Block-wise symmetric int8: ``(codes (nblocks, block) int8,
+    scales (nblocks,) f32)``. Trailing pad elements encode as zero."""
+    block = block or default_block()
+    x = jnp.ravel(x).astype(jnp.float32)
+    n = int(x.size)
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        x = jnp.pad(x, (0, pad))
+    xb = x.reshape(nb, block)
+    amax = jnp.max(jnp.abs(xb), axis=1)
+    denom = 126.0 if rounding == "up" else 127.0
+    scale = jnp.where(amax > 0, amax / denom, 1.0).astype(jnp.float32)
+    y = xb / scale[:, None]
+    q = jnp.ceil(y) if rounding == "up" else jnp.rint(y)
+    return jnp.clip(q, -127.0, 127.0).astype(jnp.int8), scale
+
+
+def decode_q8(q: Any, scale: Any, n: int) -> Any:
+    """Dequantize :func:`encode_q8` output back to a flat f32 ``(n,)``."""
+    return (q.astype(jnp.float32) * scale[:, None]).reshape(-1)[:n]
+
+
+def pack_bits(x: Any, bits: int) -> Any:
+    """Bit-plane pack non-negative ints ``< 2**bits`` into uint8: plane
+    ``j`` holds bit ``j`` of 8 consecutive values per byte. Exact."""
+    x = jnp.ravel(x).astype(jnp.uint32)
+    n = int(x.size)
+    g = -(-n // 8)
+    pad = g * 8 - n
+    if pad:
+        x = jnp.pad(x, (0, pad))
+    xb = x.reshape(g, 8)
+    weights = jnp.left_shift(jnp.uint32(1), jnp.arange(8, dtype=jnp.uint32))
+    planes = [
+        jnp.sum(((xb >> jnp.uint32(j)) & jnp.uint32(1)) * weights, axis=1).astype(jnp.uint8)
+        for j in range(bits)
+    ]
+    return planes[0] if bits == 1 else jnp.concatenate(planes)
+
+
+def unpack_bits(packed: Any, bits: int, n: int) -> Any:
+    """Inverse of :func:`pack_bits`; returns int32 ``(n,)``."""
+    g = -(-n // 8)
+    planes = packed.reshape(bits, g).astype(jnp.uint32)
+    lanes = jnp.arange(8, dtype=jnp.uint32)
+    vals = jnp.zeros((g, 8), jnp.uint32)
+    for j in range(bits):
+        vals = vals | (((planes[j][:, None] >> lanes) & jnp.uint32(1)) << jnp.uint32(j))
+    return vals.reshape(-1)[:n].astype(jnp.int32)
+
+
+def bucket_wire_nbytes(n: int, codec: QuantCodec, block: Optional[int] = None) -> int:
+    """Static wire size of one encoded bucket payload of ``n`` elements."""
+    if codec.kind == "pack":
+        return codec.bits * (-(-n // 8))
+    block = block or default_block()
+    nb = -(-n // block)
+    return nb * block + 4 * nb
+
+
+def encode_bucket(buf: Any, codec: QuantCodec, block: Optional[int] = None) -> Any:
+    """Encode a flat bucket buffer into ONE uint8 payload — the single
+    array the bucket's collective carries (codes first, then the per-block
+    scales bitcast to bytes, so payload size is static)."""
+    if codec.kind == "pack":
+        return pack_bits(buf, codec.bits)
+    q, scale = encode_q8(buf, block=block, rounding=codec.rounding)
+    q_bytes = jnp.ravel(jax.lax.bitcast_convert_type(q, jnp.uint8))
+    s_bytes = jnp.ravel(jax.lax.bitcast_convert_type(scale, jnp.uint8))
+    return jnp.concatenate([q_bytes, s_bytes])
+
+
+def decode_bucket(payload: Any, codec: QuantCodec, n: int, block: Optional[int] = None) -> Any:
+    """Decode one :func:`encode_bucket` payload to a flat full-precision
+    buffer: f32 ``(n,)`` for ``q8``, int32 ``(n,)`` for ``pack``."""
+    if codec.kind == "pack":
+        return unpack_bits(payload, codec.bits, n)
+    block = block or default_block()
+    nb = -(-n // block)
+    q = jax.lax.bitcast_convert_type(payload[: nb * block].reshape(nb, block), jnp.int8)
+    scale = jax.lax.bitcast_convert_type(
+        payload[nb * block : nb * block + 4 * nb].reshape(nb, 4), jnp.float32
+    )
+    return decode_q8(q, scale, n)
+
+
+# ------------------------------------------------------------ numpy twin
+# The replication wire (wal.py ship/seed frames) runs host-side on numpy
+# arrays; these mirror the jnp codec bit-for-bit in layout and match its
+# error model exactly.
+def np_encode_q8(x: np.ndarray, block: Optional[int] = None, rounding: str = "nearest") -> Tuple[bytes, bytes]:
+    """Host-side :func:`encode_q8`: ``(code bytes, scale bytes)``."""
+    block = block or default_block()
+    x = np.asarray(x, dtype=np.float32).ravel()
+    n = x.size
+    nb = -(-n // block)
+    if nb * block != n:
+        x = np.pad(x, (0, nb * block - n))
+    xb = x.reshape(nb, block)
+    amax = np.max(np.abs(xb), axis=1)
+    denom = 126.0 if rounding == "up" else 127.0
+    scale = np.where(amax > 0, amax / denom, 1.0).astype(np.float32)
+    y = xb / scale[:, None]
+    q = np.ceil(y) if rounding == "up" else np.rint(y)
+    q = np.clip(q, -127.0, 127.0).astype(np.int8)
+    return q.tobytes(), scale.tobytes()
+
+
+def np_decode_q8(q_bytes: bytes, scale_bytes: bytes, n: int, block: Optional[int] = None) -> np.ndarray:
+    """Host-side :func:`decode_q8` from the raw wire bytes."""
+    block = block or default_block()
+    nb = -(-n // block)
+    q = np.frombuffer(q_bytes, dtype=np.int8).reshape(nb, block)
+    scale = np.frombuffer(scale_bytes, dtype=np.float32)
+    return (q.astype(np.float32) * scale[:, None]).reshape(-1)[:n]

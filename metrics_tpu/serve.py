@@ -1947,12 +1947,20 @@ class MetricsService:
                 self._memo.pop(name, None)
             return len(payload["rows"])
 
-    def mirror_state(self, src: "MetricsService") -> None:
+    def mirror_state(self, src: "MetricsService", precision: Optional[str] = None) -> Optional[float]:
         """Install a bit-identical copy of another service's stacked state
         (standby seeding and the anti-entropy re-ship). jax arrays are
         immutable, so the leaves are shared, not copied — O(sessions)
         bookkeeping, O(1) state bytes. Takes this service's flush lock
-        (the caller pins the SOURCE's floor under the source's lock)."""
+        (the caller pins the SOURCE's floor under the source's lock).
+
+        With ``precision="int8"`` the bulk transfer models the real
+        replication wire instead of in-process sharing: every stacked
+        leaf crosses as a crc-guarded seed frame
+        (:func:`metrics_tpu.wal.encode_seed_frame`), float leaves
+        block-wise int8-quantized and integer / bool / opted-out leaves
+        raw — so exact state stays lossless and lossy leaves land within
+        the documented codec bound."""
         with self._flush_lock:
             self._capacity = src._capacity
             self._stacked = dict(src._stacked)
@@ -1966,11 +1974,27 @@ class MetricsService:
                 self._rid = src._rid
                 self._rid_stride = src._rid_stride
             self._install_template_attrs(src._portable_template_attrs())
+            budget = None
+            if precision is not None:
+                frame = wal.encode_seed_frame(
+                    {k: self._stacked[k] for k in self._names},
+                    precision=precision,
+                    quantize_opt=getattr(src.template, "_quantize", None),
+                )
+                if faults.should_fire("quant-corruption"):
+                    # bit-garble the frame in flight — the crc guard must
+                    # convert this into StateCorruptionError, never a
+                    # silently divergent standby
+                    frame = frame[:-1] + bytes([frame[-1] ^ 0xFF])
+                decoded = wal.decode_seed_frame(frame)
+                self._stacked = {k: jnp.asarray(v) for k, v in decoded.items()}
+                budget = wal.frame_error_budget(frame)
             self._exec_cache.clear()
             self._compute_stack = None
             self._compute_one = None
             self._row_version = [0] * self._capacity
             self._memo.clear()
+            return budget
 
     def state_digest(self, names: Optional[List[str]] = None) -> str:
         """sha1 over the stacked rows of the named (default: every open)

@@ -33,13 +33,26 @@ single ``psum``/``pmean``/``pmax``/``pmin`` on :class:`AxisEnv` that never
 materializes the ``(world, ...)`` stacked intermediate — and fall back to
 one packed gather + host reduce when the env has no native reduction.
 
+Metrics that opt in via ``sync_precision="int8"`` additionally route their
+eligible buckets through the **quantized wire** (:mod:`metrics_tpu.quant`,
+EQuARX-style): the packed buffer is block-quantized to int8 codes plus
+per-block f32 scales, ONE gather crosses the single uint8 payload, and
+every participant dequantizes before reducing at full precision — exact
+for integer-sum leaves below ``quant.INT_EXACT_BOUND`` per block, bounded
+relative error for float leaves, lossless bit-plane packing for registered
+sketch states (``_quant_state_specs``). Quantized leaves bucket under
+codec-tagged keys (``("q8:float32", "sum")``), buckets too small to shrink
+cross at full precision, and any codec failure demotes the bucket to the
+full-precision wire through the resilience policy (cause ``quant-sync``).
+``METRICS_TPU_QUANT_SYNC=0`` kills the quantized wire bit-exactly.
+
 The engine is on by default and gated by ``METRICS_TPU_FUSED_SYNC``
 (``0``/``false``/``off`` restores the per-leaf protocol bit-for-bit). Every
 bucket collective is emitted on the :mod:`metrics_tpu.telemetry` stream
-(``collective`` span, kind ``"fused"``, attrs: payload ``nbytes``, reduce
-``op``, ``wire_dtype``, packed ``nleaves``) — the legacy
-``profiling.track_syncs`` tracker rides that stream — and counted in the
-owner's ``sync_stats``.
+(``collective`` span, kind ``"fused"``, attrs: payload ``nbytes`` and
+pre-wire ``logical_nbytes``, reduce ``op``, ``wire_dtype``, ``quantized``,
+packed ``nleaves``) — the legacy ``profiling.track_syncs`` tracker rides
+that stream — and counted in the owner's ``sync_stats``.
 """
 import os
 import time
@@ -49,7 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu import telemetry
+from metrics_tpu import faults, quant, resilience, telemetry
 from metrics_tpu.analysis import cost_model
 from metrics_tpu.utilities.data import dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
 
@@ -97,6 +110,10 @@ class LeafSpec(NamedTuple):
     wire_dtype: Any
     dtype: Any
     shape: Tuple[int, ...]
+    # negotiated quantized wire (metrics_tpu.quant.QuantCodec) or None for
+    # the full-precision wire; set only when the metric opted in via
+    # ``sync_precision=`` and the leaf/op/dtype is eligible
+    codec: Optional[Any] = None
 
 
 def plan_metric_leaves(metric: Any, states: Dict[str, Any], tag: Optional[Hashable] = None) -> List[LeafSpec]:
@@ -113,6 +130,12 @@ def plan_metric_leaves(metric: Any, states: Dict[str, Any], tag: Optional[Hashab
     sync_dtype = metric.sync_dtype
     sample_names = getattr(metric, "_sample_state_names", ()) or ()
     ragged = getattr(metric, "_ragged_state_specs", None) or {}
+    # quantized wire negotiation inputs: the metric-level opt-in knob, the
+    # per-leaf opt-out (``add_state(quantize=False)``), and any native
+    # per-leaf codecs a sketch registered (``_quant_state_specs``)
+    quant_on = getattr(metric, "sync_precision", None) is not None and quant.quant_enabled()
+    quant_optout = getattr(metric, "_quantize", None) or {}
+    quant_native = getattr(metric, "_quant_state_specs", None) or {}
     for attr, value in states.items():
         if isinstance(value, list) or attr in ragged or not isinstance(value, jax.Array):
             continue
@@ -120,6 +143,7 @@ def plan_metric_leaves(metric: Any, states: Dict[str, Any], tag: Optional[Hashab
         if op is None:
             continue
         dt = jnp.dtype(value.dtype)
+        codec = None
         if dt == jnp.bool_:
             if op not in ("max", "min"):
                 continue  # a bool `sum` promotes on reduce; keep per-leaf semantics
@@ -134,6 +158,14 @@ def plan_metric_leaves(metric: Any, states: Dict[str, Any], tag: Optional[Hashab
             wire = dt
         else:
             continue  # complex &c. stay on the per-leaf path
+        if quant_on and quant_optout.get(attr, True) and attr not in sample_names:
+            codec = quant_native.get(attr)
+            if codec is None and jnp.issubdtype(dt, jnp.floating):
+                codec = quant.QuantCodec("q8")
+                wire = dt  # the quantized wire supersedes sync_dtype narrowing
+            elif codec is None and jnp.issubdtype(dt, jnp.integer) and dt.itemsize > 1:
+                # exact below quant.INT_EXACT_BOUND per block, bounded above
+                codec = quant.QuantCodec("q8")
         shape = tuple(value.shape) or (1,)  # post-sync atleast_1d semantics
         specs.append(
             LeafSpec(
@@ -143,6 +175,7 @@ def plan_metric_leaves(metric: Any, states: Dict[str, Any], tag: Optional[Hashab
                 wire_dtype=wire,
                 dtype=dt,
                 shape=shape,
+                codec=codec,
             )
         )
     return specs
@@ -159,7 +192,11 @@ def bucket_plan(specs: List[LeafSpec]) -> Dict[Tuple[str, str], List[LeafSpec]]:
     """
     buckets: Dict[Tuple[str, str], List[LeafSpec]] = {}
     for s in specs:
-        buckets.setdefault((jnp.dtype(s.wire_dtype).name, s.op), []).append(s)
+        # quantized leaves bucket under a codec-tagged wire name
+        # (``q8:float32``, ``pack5:int32``, ...): leaves with different
+        # wire semantics never share a payload
+        tag = quant.wire_tag(s.codec, jnp.dtype(s.wire_dtype).name)
+        buckets.setdefault((tag, s.op), []).append(s)
     return buckets
 
 
@@ -173,16 +210,21 @@ _bucket_cost_cache: Dict[Tuple, Any] = {}
 
 
 def _bucket_cost(owner: str, leaves: List[LeafSpec], wire_name: str, op: str) -> Any:
+    codec = leaves[0].codec
     key = (owner, wire_name, op, tuple((s.shape, str(s.dtype)) for s in leaves))
     if key in _bucket_cost_cache:
         return _bucket_cost_cache[key]
-    wire = jnp.dtype(wire_name)
+    wire = jnp.dtype(leaves[0].wire_dtype)
     sizes = [int(np.prod(s.shape)) for s in leaves]
     offsets = np.concatenate([[0], np.cumsum(sizes)])
 
     def probe(*vals):
         flat = [jnp.ravel(v).astype(wire) for v in vals]
         buf = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+        if codec is not None:
+            # the roofline sees the real quantized bucket: encode + decode
+            # bracket the collective, so flops/bytes attribute the codec
+            buf = quant.decode_bucket(quant.encode_bucket(buf, codec), codec, int(buf.size))
         outs = []
         for s, o, n in zip(leaves, offsets, sizes):
             outs.append(buf[o : o + n].astype(s.dtype).reshape(s.shape))
@@ -222,39 +264,83 @@ def execute_buckets(
     for wire_name, op in sorted(buckets):
         t0 = telemetry.clock()
         leaves = buckets[(wire_name, op)]
-        wire = jnp.dtype(wire_name)
+        codec = leaves[0].codec
+        wire = jnp.dtype(leaves[0].wire_dtype)
         flat = [jnp.ravel(s.value).astype(wire) for s in leaves]
         buf = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
-        nbytes = int(buf.size) * wire.itemsize
         sizes = [int(np.prod(s.shape)) for s in leaves]
         offsets = np.concatenate([[0], np.cumsum(sizes)])
-
-        # a bucket is "compressed" when any float leaf crosses the wire
-        # narrower than its state dtype — then accumulation must happen at
-        # full precision AFTER the cast-back, so the native all_reduce
-        # (which reduces in wire dtype) is off the table
-        compressed = any(
-            jnp.issubdtype(s.dtype, jnp.floating) and jnp.dtype(s.dtype) != wire for s in leaves
+        # pre-wire payload size (the bytes-attribution satellite: every
+        # collective span carries both what the state IS and what CROSSED)
+        logical_nbytes = sum(
+            int(np.prod(s.shape)) * (1 if s.dtype == jnp.bool_ else jnp.dtype(s.dtype).itemsize)
+            for s in leaves
         )
+        nbytes = int(buf.size) * wire.itemsize
 
-        if compressed:
-            gather = getattr(env, "all_gather_uniform", env.all_gather)
-            stacked = jnp.stack([jnp.ravel(g) for g in gather(buf)])  # (world, total)
-            for s, o, n in zip(leaves, offsets, sizes):
-                seg = stacked[:, o : o + n].astype(s.dtype)
-                out[s.key] = _HOST_REDUCE[op](seg).reshape(s.shape)
-        else:
-            reduced = env.all_reduce(buf, op)
-            if reduced is None:
+        if codec is not None and quant.bucket_wire_nbytes(int(buf.size), codec) >= nbytes:
+            # block padding + scale overhead would not shrink this bucket
+            # (tiny states): cross at full precision, no degrade — this is
+            # a cost decision, not a failure
+            codec = None
+        if codec is not None:
+            # quantized bucket: encode -> ONE gather on the packed int8
+            # payload (codes + per-block scales in a single uint8 buffer)
+            # -> per-participant decode -> reduce at FULL precision. Any
+            # codec failure (including an injected ``quant-corruption``
+            # fault) demotes this bucket to the full-precision wire below,
+            # cause-tagged — values stay correct either way.
+            try:
+                faults.check("quant-corruption", f"sync_engine.bucket:{wire_name}:{op}")
+                payload = quant.encode_bucket(buf, codec)
                 gather = getattr(env, "all_gather_uniform", env.all_gather)
-                stacked = jnp.stack([jnp.ravel(g) for g in gather(buf)])
-                reduced = _HOST_REDUCE[op](stacked)
-            reduced = jnp.ravel(reduced)
-            for s, o, n in zip(leaves, offsets, sizes):
-                seg = reduced[o : o + n]
-                if jnp.dtype(seg.dtype) != s.dtype:
-                    seg = seg.astype(s.dtype)  # bool leaves rode the wire as int32
-                out[s.key] = seg.reshape(s.shape)
+                stacked = jnp.stack(
+                    [quant.decode_bucket(jnp.ravel(g), codec, int(buf.size)) for g in gather(payload)]
+                )
+                for s, o, n in zip(leaves, offsets, sizes):
+                    seg = stacked[:, o : o + n]
+                    if codec.kind == "q8" and jnp.issubdtype(s.dtype, jnp.integer):
+                        # integer leaves re-enter the lattice BEFORE the
+                        # reduction: exact below quant.INT_EXACT_BOUND
+                        seg = jnp.rint(seg).astype(s.dtype)
+                    else:
+                        seg = seg.astype(s.dtype)
+                    out[s.key] = _HOST_REDUCE[op](seg).reshape(s.shape)
+                nbytes = int(payload.size)  # uint8 wire
+            except Exception as err:
+                if not resilience.resilience_enabled():
+                    raise
+                resilience.record_degrade(owner, "quant-sync", err)
+                codec = None
+
+        if codec is None:
+            # a bucket is "compressed" when any float leaf crosses the wire
+            # narrower than its state dtype — then accumulation must happen at
+            # full precision AFTER the cast-back, so the native all_reduce
+            # (which reduces in wire dtype) is off the table
+            compressed = any(
+                jnp.issubdtype(s.dtype, jnp.floating) and jnp.dtype(s.dtype) != wire for s in leaves
+            )
+
+            if compressed:
+                gather = getattr(env, "all_gather_uniform", env.all_gather)
+                stacked = jnp.stack([jnp.ravel(g) for g in gather(buf)])  # (world, total)
+                for s, o, n in zip(leaves, offsets, sizes):
+                    seg = stacked[:, o : o + n].astype(s.dtype)
+                    out[s.key] = _HOST_REDUCE[op](seg).reshape(s.shape)
+            else:
+                reduced = env.all_reduce(buf, op)
+                if reduced is None:
+                    gather = getattr(env, "all_gather_uniform", env.all_gather)
+                    stacked = jnp.stack([jnp.ravel(g) for g in gather(buf)])
+                    reduced = _HOST_REDUCE[op](stacked)
+                reduced = jnp.ravel(reduced)
+                for s, o, n in zip(leaves, offsets, sizes):
+                    seg = reduced[o : o + n]
+                    if jnp.dtype(seg.dtype) != s.dtype:
+                        seg = seg.astype(s.dtype)  # bool leaves rode the wire as int32
+                    out[s.key] = seg.reshape(s.shape)
+            nbytes = int(buf.size) * wire.itemsize
 
         cost = {}
         if telemetry.subscribed() and not isinstance(buf, jax.core.Tracer):
@@ -267,8 +353,13 @@ def execute_buckets(
             "fused",
             t0=t0,
             nbytes=nbytes,
+            logical_nbytes=logical_nbytes,
             op=op,
             wire_dtype=wire_name,
+            # the bucket KEY stays codec-tagged either way; this attr says
+            # whether the payload actually crossed quantized (False after a
+            # too-small-to-shrink decision or a resilience demotion)
+            quantized=codec is not None,
             nleaves=len(leaves),
             **cost,
         )
@@ -276,6 +367,7 @@ def execute_buckets(
             stats["collectives"] = stats.get("collectives", 0) + 1
             stats["buckets"] = stats.get("buckets", 0) + 1
             stats["bytes_on_wire"] = stats.get("bytes_on_wire", 0) + nbytes
+            stats["bytes_logical"] = stats.get("bytes_logical", 0) + logical_nbytes
     return out
 
 
@@ -308,16 +400,118 @@ def _from_wire_bytes(flat: Array, shape: Tuple[int, ...], dtype: Any) -> Array:
     return jax.lax.bitcast_convert_type(flat.reshape(shape + (dt.itemsize,)), dt)
 
 
-def _leaf_wire_specs(template: Any, names: List[str]) -> List[Tuple[str, Tuple[int, ...], Any, int]]:
-    """(name, row shape, dtype, wire bytes per row) for every state leaf."""
+def _fleet_codec(template: Any, name: str, dt: Any) -> Optional[Any]:
+    """The negotiated fleet-wire codec for one leaf (None = full
+    precision). Mirrors the sync-bucket negotiation: opt-in via
+    ``sync_precision``, per-leaf ``add_state(quantize=False)`` opt-out,
+    global ``METRICS_TPU_QUANT_SYNC=0`` kill switch. Fleet reads only
+    quantize float leaves (q8) — integer/bool leaves cross exact."""
+    if getattr(template, "sync_precision", None) is None or not quant.quant_enabled():
+        return None
+    if not (getattr(template, "_quantize", None) or {}).get(name, True):
+        return None
+    if jnp.issubdtype(dt, jnp.floating):
+        return quant.QuantCodec("q8")
+    return None
+
+
+def _leaf_wire_specs(
+    template: Any, names: List[str], m: Optional[int] = None
+) -> List[Tuple[str, Tuple[int, ...], Any, int, Optional[Any]]]:
+    """(name, row shape, dtype, full-precision wire bytes per row, codec)
+    for every state leaf. Codec negotiation needs the session bucket ``m``
+    (the too-small guard compares quantized vs full segment bytes), so
+    ``m=None`` callers — layout-only consumers — always see full
+    precision."""
     defaults = template.default_state()
     specs = []
     for k in names:
         d = jnp.asarray(defaults[k])
         dt = jnp.dtype(d.dtype)
         itemsize = 1 if dt == jnp.bool_ else dt.itemsize
-        specs.append((k, tuple(d.shape), dt, int(np.prod(d.shape, dtype=np.int64)) * itemsize))
+        row_elems = int(np.prod(d.shape, dtype=np.int64))
+        codec = None if m is None else _fleet_codec(template, k, dt)
+        if codec is not None:
+            count = row_elems * m
+            if quant.bucket_wire_nbytes(count, codec) >= count * itemsize:
+                codec = None  # quantizing this leaf would inflate the wire
+        specs.append((k, tuple(d.shape), dt, row_elems * itemsize, codec))
     return specs
+
+
+def fleet_wire_sig(specs: List[Tuple]) -> Tuple[str, ...]:
+    """Per-leaf wire tags — part of the fleet-program cache key so a
+    codec change (knob or kill switch) never reuses a stale program."""
+    return tuple(quant.wire_tag(c, str(dt)) for _k, _sh, dt, _rb, c in specs)
+
+
+def fleet_wire_nbytes(specs: List[Tuple], n_shards: int, m: int) -> int:
+    """Actual bytes crossing the packed gather for one fleet read."""
+    total = 0
+    for _k, shape, _dt, row_bytes, codec in specs:
+        if codec is None:
+            total += row_bytes * n_shards * m
+        else:
+            count = int(np.prod(shape, dtype=np.int64)) * m
+            total += quant.bucket_wire_nbytes(count, codec) * n_shards
+    return total
+
+
+def _pack_fleet_segments(specs, shard_leaves, shard_idx, n_shards, block):
+    """The packed-gather byte buffer: leaf-major then shard, quantized
+    leaves as per-shard q8 code segments followed by that leaf's scale
+    segments (both regions contiguous, so decode is reshape/slice only).
+    Exactly one ``concatenate`` regardless of codecs — the jaxpr pin."""
+    segs = []
+    for ki, (_k, _shape, _dt, _rb, codec) in enumerate(specs):
+        if codec is None:
+            for s in range(n_shards):
+                rows = shard_leaves[s][ki][shard_idx[s]]
+                segs.append(jnp.ravel(_to_wire_bytes(rows)))
+        else:
+            scale_segs = []
+            for s in range(n_shards):
+                rows = shard_leaves[s][ki][shard_idx[s]]
+                q, scale = quant.encode_q8(rows, block=block)
+                segs.append(jnp.ravel(jax.lax.bitcast_convert_type(q, jnp.uint8)))
+                scale_segs.append(
+                    jnp.ravel(jax.lax.bitcast_convert_type(scale, jnp.uint8))
+                )
+            segs.extend(scale_segs)
+    return segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+
+
+def _unpack_fleet_segments(packed, specs, n_shards, m, block):
+    """Per-leaf ``(n_shards * m,) + shape`` row arrays from the packed
+    buffer. Quantized leaves decode with reshapes and slices only — no
+    extra concatenate enters the jaxpr."""
+    leaves_rows = []
+    off = 0
+    for _k, shape, dt, row_bytes, codec in specs:
+        if codec is None:
+            size = n_shards * m * row_bytes
+            leaves_rows.append(
+                _from_wire_bytes(packed[off : off + size], (n_shards * m,) + shape, dt)
+            )
+            off += size
+        else:
+            count = int(np.prod(shape, dtype=np.int64)) * m
+            nb = -(-count // block)
+            qsize = n_shards * nb * block
+            q = jax.lax.bitcast_convert_type(
+                packed[off : off + qsize].reshape(n_shards * nb, block), jnp.int8
+            )
+            off += qsize
+            ssize = n_shards * nb * 4
+            scales = _from_wire_bytes(
+                packed[off : off + ssize], (n_shards * nb,), jnp.float32
+            )
+            off += ssize
+            vals = (q.astype(jnp.float32) * scales[:, None]).reshape(
+                n_shards, nb * block
+            )[:, :count]
+            leaves_rows.append(vals.reshape((n_shards * m,) + shape).astype(dt))
+    return leaves_rows
 
 
 def build_fleet_read(template: Any, names: List[str], n_shards: int, m: int) -> Any:
@@ -332,24 +526,16 @@ def build_fleet_read(template: Any, names: List[str], n_shards: int, m: int) -> 
     vmapped ``pure_compute`` values over the ``n_shards * m`` rows, row
     index ``shard * m + lane``. Segments are packed leaf-major then shard
     so each leaf's region is contiguous — exactly one ``concatenate``
-    (the packed gather) appears in the jaxpr, which the bench pins."""
-    specs = _leaf_wire_specs(template, names)
+    (the packed gather) appears in the jaxpr, which the bench pins.
+    When the template opts into ``sync_precision``, eligible float leaves
+    cross as block-wise int8 codes + f32 scales (~4x fewer wire bytes),
+    still inside the same single concatenate."""
+    specs = _leaf_wire_specs(template, names, m=m)
+    block = quant.default_block()
 
     def fleet_read(shard_leaves, shard_idx):
-        segs = []
-        for ki in range(len(specs)):
-            for s in range(n_shards):
-                rows = shard_leaves[s][ki][shard_idx[s]]
-                segs.append(jnp.ravel(_to_wire_bytes(rows)))
-        packed = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
-        leaves_rows = []
-        off = 0
-        for _k, shape, dt, row_bytes in specs:
-            size = n_shards * m * row_bytes
-            leaves_rows.append(
-                _from_wire_bytes(packed[off : off + size], (n_shards * m,) + shape, dt)
-            )
-            off += size
+        packed = _pack_fleet_segments(specs, shard_leaves, shard_idx, n_shards, block)
+        leaves_rows = _unpack_fleet_segments(packed, specs, n_shards, m, block)
         return jax.vmap(
             lambda *row: template.pure_compute(dict(zip(names, row)))
         )(*leaves_rows)
@@ -365,26 +551,17 @@ def build_fleet_rollup(template: Any, names: List[str], n_shards: int, m: int) -
     tracks nonempty rows so running-mean merges stay exact) and ONE
     ``pure_compute`` of the merged state — the fleet-wide value in a
     single launch. ``valid`` is a ``(n_shards * m,)`` mask in the packed
-    row order."""
-    specs = _leaf_wire_specs(template, names)
+    row order. Quantized leaves (template ``sync_precision``) ride the
+    same wire encoding as :func:`build_fleet_read`."""
+    specs = _leaf_wire_specs(template, names, m=m)
+    block = quant.default_block()
     defaults = template.default_state()
     acc0 = {k: jnp.zeros_like(jnp.asarray(defaults[k])) + jnp.asarray(defaults[k]) for k in names}
 
     def fleet_rollup(shard_leaves, shard_idx, valid):
-        segs = []
-        for ki in range(len(specs)):
-            for s in range(n_shards):
-                rows = shard_leaves[s][ki][shard_idx[s]]
-                segs.append(jnp.ravel(_to_wire_bytes(rows)))
-        packed = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
-        rows_by_leaf = {}
-        off = 0
-        for k, shape, dt, row_bytes in specs:
-            size = n_shards * m * row_bytes
-            rows_by_leaf[k] = _from_wire_bytes(
-                packed[off : off + size], (n_shards * m,) + shape, dt
-            )
-            off += size
+        packed = _pack_fleet_segments(specs, shard_leaves, shard_idx, n_shards, block)
+        leaves_rows = _unpack_fleet_segments(packed, specs, n_shards, m, block)
+        rows_by_leaf = dict(zip(names, leaves_rows))
 
         def step(carry, xs):
             acc, seen = carry

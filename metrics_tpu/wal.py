@@ -85,12 +85,13 @@ import pickle
 import struct
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from metrics_tpu import faults, telemetry
+from metrics_tpu import faults, quant, resilience, telemetry
 
 __all__ = [
     "WriteAheadLog",
@@ -717,6 +718,205 @@ class WriteAheadLog:
         return out
 
 
+# ------------------------------------------------------- replication frames
+#
+# The quantized replication wire: when the fabric opts into
+# ``replication_precision="int8"``, ship batches and bulk re-seed state
+# cross shard boundaries as self-describing frames instead of in-process
+# object handoff — MAGIC + kind byte + crc32(payload) + pickled payload,
+# with float array leaves negotiated down to the block-wise int8 codec
+# (:mod:`metrics_tpu.quant`) and integer / bool / opted-out leaves kept
+# raw, so exact state stays lossless. The crc guard turns any in-flight
+# bit damage (including the injected ``quant-corruption`` fault) into a
+# :class:`~metrics_tpu.resilience.StateCorruptionError` instead of a
+# silently divergent standby.
+
+FRAME_MAGIC = b"MTQF"
+FRAME_SHIP = 1
+FRAME_SEED = 2
+_FRAME_KIND_NAMES = {FRAME_SHIP: "ship", FRAME_SEED: "seed"}
+_ARR_MARK = "__mtqf_arr__"
+
+
+def _encode_array(arr: Any, precision: Optional[str], quantize_ok: bool = True) -> Tuple:
+    """Per-leaf wire negotiation: float arrays ride the block-wise int8
+    codec when ``precision`` asks for it (and it actually shrinks the
+    leaf); everything else crosses as raw bytes — exact."""
+    a = np.asarray(arr)
+    if (
+        precision == "int8"
+        and quantize_ok
+        and a.dtype.kind == "f"
+        and quant.quant_enabled()
+    ):
+        block = quant.default_block()
+        codec = quant.QuantCodec("q8")
+        if quant.bucket_wire_nbytes(int(a.size), codec, block) < a.nbytes:
+            qb, sb = quant.np_encode_q8(a, block=block)
+            return ("q8", a.dtype.str, tuple(a.shape), block, qb, sb)
+    return ("raw", a.dtype.str, tuple(a.shape), a.tobytes())
+
+
+def _decode_array(enc: Tuple) -> np.ndarray:
+    if enc[0] == "q8":
+        _tag, dt, shape, block, qb, sb = enc
+        n = int(np.prod(shape, dtype=np.int64))
+        vals = quant.np_decode_q8(qb, sb, n, block=block)
+        return vals.reshape(shape).astype(np.dtype(dt))
+    _tag, dt, shape, raw = enc
+    return np.frombuffer(raw, dtype=np.dtype(dt)).reshape(shape)
+
+
+def _encode_tree(x: Any, precision: Optional[str]) -> Any:
+    if isinstance(x, (list, tuple)):
+        return type(x)(_encode_tree(v, precision) for v in x)
+    if isinstance(x, dict):
+        return {k: _encode_tree(v, precision) for k, v in x.items()}
+    if hasattr(x, "dtype"):
+        return (_ARR_MARK,) + _encode_array(x, precision)
+    return x
+
+
+def _decode_tree(x: Any) -> Any:
+    if isinstance(x, tuple) and x and x[0] == _ARR_MARK:
+        return _decode_array(x[1:])
+    if isinstance(x, (list, tuple)):
+        return type(x)(_decode_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _decode_tree(v) for k, v in x.items()}
+    return x
+
+
+def _frame(kind: int, payload: bytes) -> bytes:
+    return (
+        FRAME_MAGIC
+        + bytes([kind])
+        + struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
+        + payload
+    )
+
+
+def _check_frame(data: bytes, expect_kind: int) -> bytes:
+    """Validate a wire frame; raises ``StateCorruptionError`` on any
+    damage — a corrupted replication frame must NEVER apply silently."""
+    want = _FRAME_KIND_NAMES.get(expect_kind, str(expect_kind))
+    if len(data) < 9 or data[:4] != FRAME_MAGIC:
+        raise resilience.StateCorruptionError(
+            f"replication {want} frame: bad magic/truncated header"
+        )
+    if data[4] != expect_kind:
+        raise resilience.StateCorruptionError(
+            f"replication {want} frame: unexpected kind byte {data[4]}"
+        )
+    (crc,) = struct.unpack("<I", data[5:9])
+    payload = data[9:]
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise resilience.StateCorruptionError(
+            f"replication {want} frame: crc mismatch (frame damaged in flight)"
+        )
+    return payload
+
+
+def encode_ship_frame(records: List["WalRecord"], floor: int, precision: Optional[str] = None) -> bytes:
+    """One replication ship batch (records + floor) as a crc-guarded
+    wire frame. ``precision="int8"`` quantizes float array args."""
+    recs = [
+        (
+            r.seq, r.kind, r.session,
+            _encode_tree(tuple(r.args), precision),
+            _encode_tree(dict(r.kwargs), precision),
+            r.rid,
+        )
+        for r in records
+    ]
+    payload = pickle.dumps(
+        {"floor": int(floor), "records": recs},
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
+    return _frame(FRAME_SHIP, payload)
+
+
+def decode_ship_frame(data: bytes) -> Tuple[List["WalRecord"], int]:
+    """Inverse of :func:`encode_ship_frame`; raises
+    ``StateCorruptionError`` on magic/kind/crc damage."""
+    obj = pickle.loads(_check_frame(data, FRAME_SHIP))
+    records = [
+        WalRecord(seq, kind, session, _decode_tree(args), _decode_tree(kwargs), rid)
+        for seq, kind, session, args, kwargs, rid in obj["records"]
+    ]
+    return records, int(obj["floor"])
+
+
+def encode_seed_frame(
+    leaves: Dict[str, Any],
+    precision: Optional[str] = None,
+    quantize_opt: Optional[Dict[str, bool]] = None,
+) -> bytes:
+    """Bulk re-seed state transfer: ``{leaf name: stacked array}`` as a
+    crc-guarded frame, per-leaf negotiated (``quantize_opt`` carries the
+    template's ``add_state(quantize=False)`` opt-outs)."""
+    quantize_opt = quantize_opt or {}
+    enc = {
+        k: _encode_array(v, precision, quantize_opt.get(k, True))
+        for k, v in leaves.items()
+    }
+    return _frame(FRAME_SEED, pickle.dumps(enc, protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def decode_seed_frame(data: bytes) -> Dict[str, np.ndarray]:
+    enc = pickle.loads(_check_frame(data, FRAME_SEED))
+    return {k: _decode_array(e) for k, e in enc.items()}
+
+
+def _collect_q8(x: Any, out: List[Tuple]) -> None:
+    if isinstance(x, tuple) and x and x[0] == _ARR_MARK:
+        if x[1] == "q8":
+            out.append(x[1:])
+        return
+    if isinstance(x, (list, tuple)):
+        for v in x:
+            _collect_q8(v, out)
+    elif isinstance(x, dict):
+        for v in x.values():
+            _collect_q8(v, out)
+
+
+def frame_error_budget(data: bytes) -> float:
+    """Exact upper bound on the total absolute decode error of one wire
+    frame: nearest-rounding q8 is off by at most ``scale / 2`` per
+    element, so the bound is the per-block scales weighted by real (un-
+    padded) element counts, summed over every quantized array in the
+    frame. Raw / integer payloads contribute zero. The fabric
+    accumulates this per standby — the tolerance the anti-entropy
+    comparand grants lossy leaves, derived from the frames actually
+    shipped rather than guessed from state magnitudes."""
+    if len(data) < 9:
+        raise resilience.StateCorruptionError(
+            "replication frame: truncated header"
+        )
+    kind = data[4]
+    obj = pickle.loads(_check_frame(data, kind))
+    encs: List[Tuple] = []
+    if kind == FRAME_SHIP:
+        for _seq, _k, _session, args, kwargs, _rid in obj["records"]:
+            _collect_q8(args, encs)
+            _collect_q8(kwargs, encs)
+    else:
+        for e in obj.values():
+            if e[0] == "q8":
+                encs.append(e)
+    total = 0.0
+    for _tag, _dt, shape, block, _qb, sb in encs:
+        scale = np.frombuffer(sb, dtype=np.float32)
+        n = int(np.prod(shape, dtype=np.int64))
+        nb = scale.size
+        counts = np.full(nb, block, dtype=np.int64)
+        if nb:
+            counts[-1] = n - (nb - 1) * block
+        total += float(np.sum(scale * counts) / 2.0)
+    return total
+
+
 class StandbyReplica:
     """Hot-standby applier: a warm, bit-identical copy of one shard's
     stacked state, maintained by log shipping instead of full replay.
@@ -754,6 +954,10 @@ class StandbyReplica:
         self.cursor = 0
         # highest resolved seq applied to the warm state
         self.applied_seq = 0
+        # accumulated absolute-error allowance from quantized wire frames
+        # (Σ frame_error_budget since the last seed) — 0.0 means the warm
+        # copy must be bit-identical
+        self.lossy_budget = 0.0
         self._pending: Dict[int, WalRecord] = {}
         self._dropped: set = set()
         self.stats: Dict[str, int] = {
@@ -791,13 +995,18 @@ class StandbyReplica:
         self.stats["held_records"] = len(self._pending)
         return len(ready)
 
-    def seed_from(self, primary: Any, floor: int) -> None:
+    def seed_from(self, primary: Any, floor: int, precision: Optional[str] = None) -> None:
         """Bulk state transfer: install a bit-identical copy of the
         primary's stacked state at its replication floor (standby
         creation, and the anti-entropy re-ship after divergence). The
         ship cursor rewinds to the floor so the next batch re-reads the
-        unresolved tail."""
-        self.service.mirror_state(primary)
+        unresolved tail. ``precision="int8"`` routes the transfer
+        through the quantized seed frame (lossy for float leaves, exact
+        for the rest)."""
+        budget = self.service.mirror_state(primary, precision=precision)
+        # the seed itself is one lossy round trip; later quantized ships
+        # stack their own frame_error_budget on top
+        self.lossy_budget = float(budget or 0.0)
         self.applied_seq = int(floor)
         self.cursor = int(floor)
         self._pending.clear()
@@ -815,5 +1024,6 @@ class StandbyReplica:
             "cursor": self.cursor,
             "applied_seq": self.applied_seq,
             "held": len(self._pending),
+            "lossy_budget": self.lossy_budget,
             **dict(self.stats),
         }

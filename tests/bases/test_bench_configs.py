@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 29
+    assert len(skipped) == 30
     assert "detail_elapsed_s" in detail
 
 
@@ -183,6 +183,24 @@ def test_sync_engine_config_counts_and_keys(monkeypatch):
     # the config must restore the kill switch it toggles
     assert os.environ.get("METRICS_TPU_FUSED_SYNC") is None or (
         os.environ["METRICS_TPU_FUSED_SYNC"] != "0")
+
+
+def test_quant_config_counts_and_keys(monkeypatch):
+    """Pin the quantized-wire bench config: the byte ratios are structural
+    (block layout of the q8 codec — 3.94x for f32 at block 256), and the
+    three correctness flags the error model documents must hold."""
+    monkeypatch.delenv("METRICS_TPU_QUANT_SYNC", raising=False)
+    monkeypatch.delenv("METRICS_TPU_QUANT_BLOCK", raising=False)
+    detail = {}
+    bench._cfg_quant(detail)
+    assert detail["quant_sync_wire_ratio"] >= 3.9
+    assert detail["quant_fleet_read_wire_ratio"] >= 3.9
+    # ship frames carry pickle/marker overhead, so the floor is looser
+    assert detail["quant_ship_wire_ratio"] >= 2.0
+    assert detail["quant_sync_bytes_logical"] > detail["quant_sync_bytes_on_wire"] > 0
+    assert detail["quant_sync_float_within_bound"] is True
+    assert detail["quant_sync_int_sum_bitexact"] is True
+    assert detail["quant_hll_union_bitexact"] is True
 
 
 def test_static_audit_config_counts_and_keys():
@@ -438,6 +456,8 @@ def test_perf_sentinel_capstone_matches_live_bench_counters():
         "read_second_unticked_launches",
         "fleet_read_collectives",
         "window_tick_launches",
+        "quant_sync_wire_ratio",
+        "quant_fleet_read_wire_ratio",
     } <= scheduled
     # and the latency front keeps the idle-overhead ratio under the same
     # pin _cfg_telemetry_overhead enforces (band IS the 2.0 bound)

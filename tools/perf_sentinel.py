@@ -85,6 +85,25 @@ SCHEDULE: Tuple[Tuple[str, str, Dict[str, Any], Tuple[str, ...], Tuple[str, ...]
         ("sync_us_fused_collection", "sync_us_perleaf_collection"),
     ),
     (
+        "quant",
+        "_cfg_quant",
+        {},
+        (
+            # the byte pairs and ratios are structural: the q8 block layout
+            # (1 + 4/block bytes per f32 element) fixes them per shape
+            "quant_sync_bytes_on_wire",
+            "quant_sync_bytes_logical",
+            "quant_sync_wire_ratio",
+            "quant_sync_float_within_bound",
+            "quant_sync_int_sum_bitexact",
+            "quant_hll_union_bitexact",
+            "quant_fleet_read_bytes_on_wire",
+            "quant_fleet_read_bytes_logical",
+            "quant_fleet_read_wire_ratio",
+        ),
+        (),
+    ),
+    (
         "forward_engine",
         "_cfg_forward_engine",
         {},

@@ -20,7 +20,7 @@ import argparse
 import json
 import os
 import sys
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
@@ -113,13 +113,35 @@ def summarize(events: List[Dict[str, Any]]) -> str:
 
     collectives = [e for e in events if e["name"] == "collective"]
     total_bytes = sum(int((e.get("attrs") or {}).get("nbytes", 0)) for e in collectives)
+    # logical_nbytes = the bytes the same payload would cost at full
+    # precision (spans without the attr count their wire bytes — a 1.0x
+    # ratio); wire < logical means the quantized / narrowed wire paid off
+    total_logical = sum(
+        int((e.get("attrs") or {}).get("logical_nbytes",
+                                       (e.get("attrs") or {}).get("nbytes", 0)))
+        for e in collectives
+    )
     lines.append("")
-    lines.append(f"collectives: {len(collectives)}   bytes on wire: {total_bytes}")
-    by_kind: Dict[str, List[int]] = {}
+    ratio = (total_logical / total_bytes) if total_bytes else 1.0
+    lines.append(
+        f"collectives: {len(collectives)}   bytes on wire: {total_bytes}"
+        f"   logical: {total_logical}   compression: {ratio:.2f}x"
+    )
+    by_kind: Dict[str, List[Tuple[int, int]]] = {}
     for e in collectives:
-        by_kind.setdefault(e.get("kind", "?"), []).append(int((e.get("attrs") or {}).get("nbytes", 0)))
+        a = e.get("attrs") or {}
+        nb = int(a.get("nbytes", 0))
+        by_kind.setdefault(e.get("kind", "?"), []).append(
+            (nb, int(a.get("logical_nbytes", nb)))
+        )
     for kind in sorted(by_kind):
-        lines.append(f"  {kind:<8}{len(by_kind[kind]):>5} launches, {sum(by_kind[kind]):>10} bytes")
+        wire = sum(w for w, _l in by_kind[kind])
+        logical = sum(l for _w, l in by_kind[kind])
+        kr = (logical / wire) if wire else 1.0
+        lines.append(
+            f"  {kind:<12}{len(by_kind[kind]):>5} launches, {wire:>10} bytes"
+            f"  ({kr:.2f}x compression)"
+        )
 
     # roofline attribution (metrics_tpu.analysis.cost_model): every launch
     # span that rode a cost-registry entry carries model flops/bytes and
