@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load kernels quant doctest audit sentinel bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load kernels quant shard doctest audit sentinel bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -72,6 +72,7 @@ chaos:
 	$(MAKE) chaos-elastic
 	$(MAKE) kernels
 	$(MAKE) quant
+	$(MAKE) shard
 	$(MAKE) sentinel
 
 # kernel-registry lane (docs/kernels.md): interpret-mode bitwise parity of
@@ -89,6 +90,14 @@ kernels:
 quant:
 	python -m pytest tests/bases/test_quant.py -q
 	python -c "import json, bench; d = {}; bench._cfg_quant(d); print(json.dumps(d, indent=2))"
+
+# sharded-state lane (docs/distributed.md "Sharded state"): the
+# shard_state= test suite (reduce-scatter pins, replicated parity, the
+# capacity-sharded service) + the C-sweep byte curve and serve capacity
+# counters at sentinel scale (the 1-reduce-scatter / bytes=logical/N pins)
+shard:
+	python -m pytest tests/bases/test_shard_state.py -q
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" python -c "import json, bench; d = {}; bench._cfg_sharded_state(d); print(json.dumps(d, indent=2))"
 
 # kill-and-recover loop: for EVERY registered crash point a subprocess is
 # SIGKILLed at that instruction, then a fresh process recover()s

@@ -453,6 +453,123 @@ def _cfg_quant(detail: dict) -> None:
         detail["quant_ship_wire_ratio"] = round(logical / max(wire, 1), 2)
 
 
+def _cfg_sharded_state(detail: dict) -> None:
+    """Sharded metric state (``add_state(shard_state=...)``): the
+    confusion-matrix C sweep pinning replicated O(C²) vs sharded O(C²/N)
+    per-device bytes, the structural collective count (ONE reduce-scatter
+    per sharded bucket, zero psum), the OOM-threshold extrapolation (the
+    largest C a device of a given HBM could hold in each layout), and the
+    capacity-sharded serving facade (N× sessions at flat per-shard modeled
+    bytes, one coalesced launch per local shard). Byte numbers are
+    structural — exact on CPU."""
+    import math
+    import re
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import ConfusionMatrix, telemetry
+    from metrics_tpu._compat import shard_map
+    from metrics_tpu.analysis import cost_model
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        detail["sharded_state_skipped"] = f"needs 8 devices, have {len(devices)}"
+        return
+    n = 8
+    mesh = Mesh(np.array(devices[:n]), ("dp",))
+
+    def _worker(m):
+        def worker(p, t):
+            st = m.pure_update(m.default_state(), p[0], t[0])
+            return m.pure_sync(st, "dp")["confmat"]
+
+        return worker
+
+    # (1) C sweep: per-device vs logical state bytes in each layout. The
+    # sharded number comes from the actual traced post-sync leaf, not
+    # arithmetic — the reduce-scatter really leaves C/N rows per device.
+    rng = np.random.RandomState(9)
+    for c in (64, 256, 1024):
+        m = ConfusionMatrix(num_classes=c, shard_state="dp", jit_update=False)
+        preds = jnp.asarray(rng.randint(0, c, size=(n, 64)))
+        target = jnp.asarray(rng.randint(0, c, size=(n, 64)))
+        jaxpr = jax.make_jaxpr(
+            shard_map(_worker(m), mesh=mesh, in_specs=(P("dp"), P("dp")),
+                      out_specs=P("dp"), check_vma=False)
+        )(preds, target)
+        logical = c * c * 4
+        detail[f"sharded_confmat_bytes_logical_C{c}"] = logical
+        detail[f"sharded_confmat_bytes_per_device_C{c}"] = logical // n
+        sjaxpr = str(jaxpr)
+        if c == 256:
+            detail["sharded_sync_collectives"] = len(re.findall(r"\breduce_scatter\b", sjaxpr))
+            detail["sharded_sync_psums"] = len(re.findall(r"\bpsum\b", sjaxpr))
+    detail["sharded_confmat_bytes_ratio"] = float(n)
+
+    # (2) one executed sync for span + cost-model evidence of logical/N.
+    # No cost_model.reset() here: the sentinel accumulates the model front
+    # across its whole schedule — filter by family instead of wiping.
+    c = 256
+    m = ConfusionMatrix(num_classes=c, shard_state="dp", jit_update=False)
+    preds = jnp.asarray(rng.randint(0, c, size=(n, 64)))
+    target = jnp.asarray(rng.randint(0, c, size=(n, 64)))
+    with telemetry.instrument() as sess:
+        jax.jit(
+            shard_map(_worker(m), mesh=mesh, in_specs=(P("dp"), P("dp")),
+                      out_specs=P("dp"), check_vma=False)
+        )(preds, target).block_until_ready()
+    spans = [s for s in sess.spans(name="collective") if s.attrs.get("sharded")]
+    if spans:
+        detail["sharded_span_logical_nbytes"] = spans[0].attrs["logical_nbytes"]
+        detail["sharded_span_shard_nbytes"] = spans[0].attrs["shard_nbytes"]
+    entries = [e for e in cost_model.entries().values()
+               if e.family == "sync-sharded" and e.owner == "ConfusionMatrix"]
+    if entries:
+        detail["sharded_cost_out_bytes"] = int(entries[-1].out_bytes)
+
+    # (3) OOM-threshold extrapolation: largest C whose (C, C) int32 state
+    # fits a 16 GiB device in each layout — the sweep's curve extended to
+    # the wall. Sharded buys sqrt(N)× on the class axis.
+    hbm = 16 * 1024**3
+    detail["sharded_oom_cmax_replicated"] = int(math.isqrt(hbm // 4))
+    detail["sharded_oom_cmax_sharded"] = int(math.isqrt(n * hbm // 4))
+
+    # (4) capacity-sharded serving: N× tenants, one coalesced stacked
+    # launch per local shard, per-shard modeled bytes flat vs one plain
+    # service at 1/N the tenant count.
+    from metrics_tpu import Accuracy
+    from metrics_tpu.serve import MetricsService
+
+    def _template():
+        return Accuracy(task="multiclass", num_classes=8)
+
+    shards = 4
+    per = 8
+    svc = MetricsService(_template(), shard_capacity=shards)
+    plain = MetricsService(_template())
+    batch = (jnp.asarray(rng.rand(16, 8), jnp.float32),
+             jnp.asarray(rng.randint(0, 8, 16)))
+    for i in range(shards * per):
+        svc.open_session(f"tenant-{i}")
+        svc.submit(f"tenant-{i}", *batch)
+    for i in range(per):
+        plain.open_session(f"tenant-{i}")
+        plain.submit(f"tenant-{i}", *batch)
+    svc.flush()
+    plain.flush()
+    detail["serve_capacity_sharded_sessions"] = svc.session_count
+    detail["serve_capacity_launches_per_flush"] = int(svc.stats.get("launches", 0))
+    ms, pm = svc.memory_snapshot(), plain.memory_snapshot()
+    detail["serve_capacity_bytes_per_shard"] = int(ms["total_bytes"])
+    detail["serve_capacity_bytes_plain"] = int(pm["total_bytes"])
+    detail["serve_capacity_sessions_ratio"] = round(
+        svc.session_count / max(plain.session_count, 1), 2)
+    svc.shutdown()
+    plain.shutdown()
+
+
 def _cfg_static_audit(detail: dict) -> None:
     """Static-analysis sweep health: size/latency of the registry audit,
     the ratchet verdict against the checked-in STATIC_AUDIT.json, and the
@@ -1942,6 +2059,7 @@ def _bench_detail() -> dict:
         ("collection_dist_sync_8dev_us", _cfg_dist_sync),
         ("sync_collectives_fused_collection", _cfg_sync_engine),
         ("quant_sync_wire_ratio", _cfg_quant),
+        ("sharded_sync_collectives", _cfg_sharded_state),
         ("audit_metrics_swept", _cfg_static_audit),
         ("forward_launches_single_metric_10_steps", _cfg_forward_engine),
         ("telemetry_idle_overhead_ratio", _cfg_telemetry_overhead),
@@ -2169,6 +2287,7 @@ def _bench_detail_fast() -> dict:
         ("dispatch_engine", _cfg_dispatch_engine),
         ("sync_engine", _cfg_sync_engine),
         ("quant", _cfg_quant),
+        ("sharded_state", _cfg_sharded_state),
         ("forward_engine", _cfg_forward_engine),
         ("telemetry_overhead", _cfg_telemetry_overhead),
         ("resilience_overhead", _cfg_resilience_overhead),

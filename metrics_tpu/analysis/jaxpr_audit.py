@@ -31,6 +31,11 @@ JX301  P0   hidden host sync (trace fails concretizing a traced value)
 JX401  P0   dynamic-shape op in a pure path (defeats pow2 bucketing)
 JX501  P1   collective primitive inside update/compute (none belong)
 ====== ==== =========================================================
+
+``shard_state=`` note: sharded sync buckets (``rs[axis]:`` wire tags in
+the static schedule) are the one sanctioned emitter of ``reduce_scatter``
+/ ``all_to_all`` — they live in ``pure_sync``, which JX501 deliberately
+does not police; update/compute/forward remain collective-free.
 """
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
@@ -303,6 +308,11 @@ def audit_metric(case: registry.AuditCase, pools: Dict[str, Any]) -> Tuple[Dict[
         "fused_collectives": len(buckets),
         "perleaf_collectives": len(specs),
         "buckets": {f"{k[0]}:{k[1]}": len(v) for k, v in sorted(buckets.items())},
+        # shard_state= buckets (``rs[axis]:`` wire tags): the ONE sync
+        # bucket class whose lowering may emit reduce_scatter/all_to_all —
+        # sanctioned there and only there (JX501 still bans collectives
+        # from update/compute/forward)
+        "sharded_buckets": sum(1 for k in buckets if k[0].startswith("rs[")),
         "unbucketed": sorted(
             a for a, v in state.items()
             if not isinstance(v, list) and a not in {s.key for s in specs}
@@ -412,6 +422,7 @@ def collection_sync_plan(members: Dict[str, Any]) -> Dict[str, Any]:
         "fused_collectives": len(buckets),
         "perleaf_collectives": len(specs),
         "buckets": {f"{k[0]}:{k[1]}": len(v) for k, v in sorted(buckets.items())},
+        "sharded_buckets": sum(1 for k in buckets if k[0].startswith("rs[")),
     }
 
 

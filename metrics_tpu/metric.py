@@ -303,6 +303,11 @@ class Metric(ABC):
         # per-leaf quantized-wire opt-out (``add_state(quantize=False)``);
         # absent means eligible when ``sync_precision`` is set
         self._quantize: Dict[str, bool] = {}
+        # per-leaf sharded placement (``add_state(shard_state="axis")``):
+        # leaf name -> mesh-axis name its leading dim shards over. Read
+        # through :meth:`sharded_axes`, which folds in the
+        # METRICS_TPU_SHARD_STATE kill switch.
+        self._shard_state: Dict[str, str] = {}
 
         self._is_synced = False
         self._cache: Optional[Dict[str, StateType]] = None
@@ -315,6 +320,7 @@ class Metric(ABC):
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
         quantize: bool = True,
+        shard_state: Optional[str] = None,
     ) -> None:
         """Declare a metric state (ref metric.py:129-196).
 
@@ -323,11 +329,31 @@ class Metric(ABC):
         batch-state merge. ``quantize=False`` exempts this leaf from the
         quantized wire even when the metric opted in via
         ``sync_precision=`` — it then always crosses at full precision.
+
+        ``shard_state="axis"`` declares the leaf's LEADING dimension
+        sharded over the named mesh axis for extreme-cardinality states
+        (a (C, C) confusion matrix at C=100k does not fit one chip
+        replicated). Updates still accumulate the full shape per device;
+        at sync time under ``shard_map`` over that axis the leaf's bucket
+        lowers to ONE reduce-scatter and each device keeps only its own
+        ``d0/N`` reduced shard. :meth:`assemble_sharded` /
+        :meth:`pure_compute_sharded` gather on demand at compute time.
+        Outside a matching mesh axis — and under the
+        ``METRICS_TPU_SHARD_STATE=0`` kill switch — the leaf syncs
+        replicated, bit-identically to an undeclared leaf.
         """
         if not isinstance(default, (list,)) and not hasattr(default, "shape") and not isinstance(default, (int, float)):
             raise ValueError("state variable must be an array or an empty list (where you can append arrays)")
         if isinstance(default, list) and default:
             raise ValueError("state variable must be an array or an empty list (where you can append arrays)")
+        if shard_state is not None:
+            if not isinstance(shard_state, str) or not shard_state:
+                raise ValueError(
+                    f"`shard_state` must be a mesh-axis name (str) or None, got {shard_state!r}"
+                )
+            if isinstance(default, list):
+                raise ValueError(f"state {name!r}: list states cannot be sharded (no fixed leading dim)")
+
 
         if dist_reduce_fx == "sum":
             dist_reduce_fx = dim_zero_sum
@@ -347,11 +373,21 @@ class Metric(ABC):
         else:
             default = _stable_default(_as_array(default))
 
+        if shard_state is not None and (not hasattr(default, "ndim") or default.ndim < 1):
+            raise ValueError(
+                f"state {name!r}: shard_state needs a leading dimension to shard, "
+                f"got a scalar default"
+            )
+
         object.__setattr__(self, name, [] if isinstance(default, list) else default)
         self._defaults[name] = default if isinstance(default, list) else default
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
         self._quantize[name] = bool(quantize)
+        if shard_state is not None:
+            self._shard_state[name] = shard_state
+        else:
+            self._shard_state.pop(name, None)
 
     def state(self) -> Dict[str, StateType]:
         """Current state as a dict pytree.
@@ -491,6 +527,50 @@ class Metric(ABC):
             return self._copy_state()
         finally:
             self._load_state(saved)
+
+    def sharded_axes(self) -> Dict[str, str]:
+        """Effective ``{leaf name: mesh axis}`` sharded placement — the
+        ``add_state(shard_state=...)`` declarations with the
+        ``METRICS_TPU_SHARD_STATE`` kill switch folded in (the switch off
+        means NO leaf is placed sharded, restoring the replicated layout
+        bit-for-bit)."""
+        if not self._shard_state or not sync_engine.shard_state_enabled():
+            return {}
+        return dict(self._shard_state)
+
+    def assemble_sharded(
+        self, state: Dict[str, StateType], axis_name: Union[str, Tuple[str, ...]]
+    ) -> Dict[str, StateType]:
+        """Gather post-sync sharded leaves back to their full logical shape.
+
+        Usable **inside** ``shard_map`` over ``axis_name`` (one
+        ``all_gather`` per sharded leaf, tiled along the leading dim).
+        Leaves already at full shape — replicated leaves, or a state that
+        never went through a sharded sync — pass through untouched, so the
+        call is idempotent and safe on either layout.
+        """
+        axes = self.sharded_axes()
+        if not axes:
+            return dict(state)
+        out = dict(state)
+        for attr, ax in axes.items():
+            v = out.get(attr)
+            if ax != axis_name or not isinstance(v, jax.Array) or v.ndim < 1:
+                continue
+            full = self._defaults.get(attr)
+            full_d0 = None if isinstance(full, list) or full is None else int(jnp.shape(full)[0])
+            if full_d0 is not None and v.shape[0] < full_d0:
+                out[attr] = jax.lax.all_gather(v, ax, tiled=True)
+        return out
+
+    def pure_compute_sharded(
+        self, state: Dict[str, StateType], axis_name: Union[str, Tuple[str, ...]]
+    ) -> Any:
+        """:meth:`pure_compute` over a sharded post-sync state: assembles
+        the sharded leaves on demand (see :meth:`assemble_sharded`) and
+        computes — every device returns the identical full value, exactly
+        what the replicated path would have produced."""
+        return self.pure_compute(self.assemble_sharded(state, axis_name))
 
     def scan_update(self, state: Dict[str, StateType], *batched_args: Any, **batched_kwargs: Any) -> Dict[str, StateType]:
         """Fold a whole stack of batches into ``state`` as ONE ``lax.scan``.
@@ -854,27 +934,41 @@ class Metric(ABC):
     def memory_snapshot(self, top_n: int = 10) -> Dict[str, Any]:
         """Per-leaf state-byte attribution: ``{"total_bytes", "leaf_count",
         "leaves"}`` with the ``top_n`` largest leaves (descending) as
-        ``{"name", "shape", "dtype", "nbytes"}``. A list state contributes
-        one entry summing its elements (its footprint grows with the
-        stream; the shape reports the element count). ``total_bytes`` is
-        exact over ALL leaves — the input the sharding arc needs to decide
-        which states to place across the mesh."""
+        ``{"name", "shape", "dtype", "nbytes", "logical_nbytes"}``. A list
+        state contributes one entry summing its elements (its footprint
+        grows with the stream; the shape reports the element count).
+        ``nbytes`` is what THIS device holds; ``logical_nbytes`` is the
+        full logical leaf — they differ only for ``shard_state=`` leaves
+        currently holding a shard-of-N slice of the declared default (then
+        ``logical_nbytes = nbytes * N``). ``total_bytes`` is exact over ALL
+        leaves — the per-device number that decides what fits one chip."""
+        sharded = self.sharded_axes()
         leaves: List[Dict[str, Any]] = []
         for name in self._defaults:
             current = getattr(self, name)
             if isinstance(current, list):
+                nbytes = int(sum(int(v.nbytes) for v in current))
                 leaves.append({
                     "name": name,
                     "shape": (len(current),),
                     "dtype": str(current[0].dtype) if current else "empty-list",
-                    "nbytes": int(sum(int(v.nbytes) for v in current)),
+                    "nbytes": nbytes,
+                    "logical_nbytes": nbytes,
                 })
             else:
+                shape = tuple(int(d) for d in jnp.shape(current))
+                nbytes = int(jnp.asarray(current).nbytes)
+                logical = nbytes
+                if name in sharded and shape:
+                    full_d0 = int(jnp.shape(self._defaults[name])[0])
+                    if 0 < shape[0] < full_d0 and full_d0 % shape[0] == 0:
+                        logical = nbytes * (full_d0 // shape[0])
                 leaves.append({
                     "name": name,
-                    "shape": tuple(int(d) for d in jnp.shape(current)),
+                    "shape": shape,
                     "dtype": str(jnp.asarray(current).dtype),
-                    "nbytes": int(jnp.asarray(current).nbytes),
+                    "nbytes": nbytes,
+                    "logical_nbytes": logical,
                 })
         total = sum(leaf["nbytes"] for leaf in leaves)
         leaves.sort(key=lambda leaf: (-leaf["nbytes"], leaf["name"]))

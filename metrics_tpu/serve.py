@@ -107,6 +107,7 @@ import json
 import os
 import threading
 import time
+import zlib
 from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -121,6 +122,7 @@ from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
 
 __all__ = [
     "MetricsService",
+    "ShardedCapacityService",
     "MetricSession",
     "ValueTicket",
     "QueueFullError",
@@ -363,7 +365,21 @@ class MetricsService:
             A peer recovering a dead shard opens at the fenced epoch + 1;
             the zombie's next journaled write raises
             :class:`~metrics_tpu.wal.StaleEpochError`.
+        shard_capacity: with an int ``N > 1``, the constructor returns a
+            :class:`ShardedCapacityService` instead — the capacity axis is
+            placed across ``N`` local shards (crc32 session routing, one
+            coalesced stacked launch per shard), so one service handle
+            holds ``N``× the tenants at the same per-shard state bytes.
+            ``None``/``1`` (default) keeps the single stacked layout.
     """
+
+    def __new__(cls, *args: Any, **kwargs: Any) -> "MetricsService":
+        # shard_capacity is a constructor-level layout choice: the sharded
+        # capacity axis is a facade over N stacked services, not a flag the
+        # single-stack hot path branches on.
+        if cls is MetricsService and int(kwargs.get("shard_capacity") or 1) > 1:
+            return super().__new__(ShardedCapacityService)
+        return super().__new__(cls)
 
     def __init__(
         self,
@@ -383,7 +399,11 @@ class MetricsService:
         rid_offset: int = 0,
         rid_stride: int = 1,
         epoch: int = 0,
+        shard_capacity: Optional[int] = None,
     ) -> None:
+        # shard_capacity > 1 was dispatched to ShardedCapacityService by
+        # __new__; here it can only be the degenerate single-shard ask
+        del shard_capacity
         from metrics_tpu.collections import MetricCollection
         from metrics_tpu.metric import Metric
 
@@ -1415,6 +1435,7 @@ class MetricsService:
                 "shape": tuple(int(d) for d in self._stacked[k].shape),
                 "dtype": str(self._stacked[k].dtype),
                 "nbytes": int(self._stacked[k].nbytes),
+                "logical_nbytes": int(self._stacked[k].nbytes),
             }
             for k in self._names
         ]
@@ -1422,6 +1443,7 @@ class MetricsService:
         leaves.sort(key=lambda leaf: (-leaf["nbytes"], leaf["name"]))
         return {
             "total_bytes": total,
+            "logical_bytes": total,
             "leaf_count": len(leaves),
             "per_session_bytes": total // max(1, self._capacity),
             "leaves": leaves[: max(0, int(top_n))],
@@ -2040,4 +2062,231 @@ class MetricsService:
             "wal": self._wal.stats() if self._wal is not None else None,
             "memory": self.memory_snapshot(),
             "health": self.health(),
+        }
+
+
+class ShardedCapacityService(MetricsService):
+    """The stacked capacity axis placed across N local shards.
+
+    ``MetricsService(template, shard_capacity=N)`` (or this class
+    directly) builds ``N`` child services over the SAME template and
+    routes every session to ``crc32(name) % N`` — one handle holding N×
+    the tenants of a single stack at the same per-shard state bytes.
+    Each child keeps its own stacked rows, queue, journal subdirectory,
+    and coalescing window, so a flush is still **one coalesced stacked
+    launch per local shard** (the structural pin the bench asserts), and
+    shard k's rows can be pinned to device k via ``shard_devices``. This
+    is the serving face of the ``shard_state=`` axis: the metric-level
+    wire shards one leaf across the mesh; this shards the *session* axis
+    across stacks (see docs/serving.md "Sharded capacity").
+
+    The facade deliberately exposes the session-facing surface
+    (open/close/reset/submit/update/forward/flush/drain/compute/
+    checkpoint/restore/snapshots); per-shard internals stay reachable via
+    ``.shards``. Rid lattices interleave (shard k mints ``offset + k·s``
+    stepping ``N·s``) so request ids stay globally unique.
+    """
+
+    def __init__(
+        self,
+        template: Any,
+        *,
+        shard_capacity: int,
+        shard_devices: Optional[List[Any]] = None,
+        checkpoint_dir: Optional[str] = None,
+        journal_dir: Optional[str] = None,
+        rid_offset: int = 0,
+        rid_stride: int = 1,
+        epoch: int = 0,
+        **kwargs: Any,
+    ) -> None:
+        n = int(shard_capacity)
+        if n < 2:
+            raise ValueError(f"shard_capacity must be >= 2, got {n}")
+        if shard_devices is not None and len(shard_devices) < n:
+            raise ValueError(
+                f"shard_devices has {len(shard_devices)} devices for {n} shards"
+            )
+        self.template = template
+        self.n_shards = n
+        self.shard_id = None
+        self.epoch = int(epoch)
+        self.label = f"ShardedCapacityService[{type(template).__name__}]x{n}"
+        stride = max(1, int(rid_stride))
+        self.shards: List[MetricsService] = [
+            MetricsService(
+                template,
+                checkpoint_dir=(
+                    os.path.join(checkpoint_dir, f"shard{k}") if checkpoint_dir else None
+                ),
+                journal_dir=(
+                    os.path.join(journal_dir, f"shard{k}") if journal_dir else None
+                ),
+                shard_id=k,
+                rid_offset=int(rid_offset) + k * stride,
+                rid_stride=stride * n,
+                epoch=epoch,
+                **kwargs,
+            )
+            for k in range(n)
+        ]
+        if shard_devices is not None:
+            for k, child in enumerate(self.shards):
+                child._stacked = {
+                    name: jax.device_put(v, shard_devices[k])
+                    for name, v in child._stacked.items()
+                }
+
+    # ------------------------------------------------------------- routing
+    def shard_of(self, name: str) -> int:
+        """The stable shard index serving ``name`` (crc32 routing — the
+        same content-hash discipline as the fabric ring, so a session
+        never migrates between flushes)."""
+        return zlib.crc32(name.encode()) % self.n_shards
+
+    def _child(self, name: str) -> MetricsService:
+        return self.shards[self.shard_of(name)]
+
+    # ------------------------------------------------------------- sessions
+    @property
+    def session_count(self) -> int:
+        return sum(c.session_count for c in self.shards)
+
+    def open_session(self, name: str) -> int:
+        return self._child(name).open_session(name)
+
+    def close_session(self, name: str) -> None:
+        self._child(name).close_session(name)
+
+    def reset_session(self, name: str) -> None:
+        self._child(name).reset_session(name)
+
+    def configure_session(self, name: str, **kwargs: Any) -> None:
+        self._child(name).configure_session(name, **kwargs)
+
+    def session_config(self, name: str) -> Dict[str, Any]:
+        return self._child(name).session_config(name)
+
+    # -------------------------------------------------------------- intake
+    def submit(
+        self, name: str, *args: Any, return_value: bool = False, **kwargs: Any
+    ) -> Optional[ValueTicket]:
+        return self._child(name).submit(
+            name, *args, return_value=return_value, **kwargs
+        )
+
+    def update(self, name: str, *args: Any, **kwargs: Any) -> None:
+        self._child(name).update(name, *args, **kwargs)
+
+    def forward(self, name: str, *args: Any, **kwargs: Any) -> Any:
+        return self._child(name).forward(name, *args, **kwargs)
+
+    def flush(self) -> int:
+        return sum(c.flush() for c in self.shards)
+
+    def drain(self) -> None:
+        for c in self.shards:
+            c.drain()
+
+    def shutdown(self) -> None:
+        for c in self.shards:
+            c.shutdown()
+
+    # ------------------------------------------------------------- results
+    def compute(self, name: str, **kwargs: Any) -> Any:
+        return self._child(name).compute(name, **kwargs)
+
+    def compute_all(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for c in self.shards:
+            out.update(c.compute_all())
+        return out
+
+    def compute_window(self, name: Optional[str] = None) -> Any:
+        if name is not None:
+            return self._child(name).compute_window(name)
+        out = {}
+        for c in self.shards:
+            out.update(c.compute_window())
+        return out
+
+    def digest(self, names: Optional[List[str]] = None) -> str:
+        h = hashlib.sha1()
+        for c in self.shards:
+            h.update(c.digest(names).encode())
+        return h.hexdigest()
+
+    # ---------------------------------------------------------- durability
+    def checkpoint(self, path: Optional[str] = None) -> str:
+        paths = [
+            c.checkpoint(None if path is None else f"{path}.shard{k}")
+            for k, c in enumerate(self.shards)
+        ]
+        return paths[0] if path is None else path
+
+    def restore(self, path: Optional[str] = None, **kwargs: Any) -> Any:
+        return [
+            c.restore(None if path is None else f"{path}.shard{k}", **kwargs)
+            for k, c in enumerate(self.shards)
+        ]
+
+    def recover(self, path: Optional[str] = None) -> bool:
+        got = [
+            c.recover(None if path is None else f"{path}.shard{k}")
+            for k, c in enumerate(self.shards)
+        ]
+        return any(got)
+
+    # --------------------------------------------------------------- stats
+    @property
+    def stats(self) -> Dict[str, int]:  # type: ignore[override]
+        out: Dict[str, int] = {}
+        for c in self.shards:
+            for k, v in c.stats.items():
+                out[k] = out.get(k, 0) + int(v)
+        return out
+
+    def memory_snapshot(self, top_n: int = 10) -> Dict[str, Any]:
+        """Capacity-sharded byte attribution: ``total_bytes`` /
+        ``per_session_bytes`` are PER-SHARD maxima (what one device
+        holds — the number that decides fit), ``logical_bytes`` the sum
+        over shards. Leaves carry per-shard ``nbytes`` next to the
+        summed ``logical_nbytes``."""
+        snaps = [c.memory_snapshot(top_n=top_n) for c in self.shards]
+        by_name: Dict[str, Dict[str, Any]] = {}
+        for snap in snaps:
+            for leaf in snap["leaves"]:
+                agg = by_name.setdefault(
+                    leaf["name"],
+                    {**leaf, "nbytes": 0, "logical_nbytes": 0},
+                )
+                agg["nbytes"] = max(agg["nbytes"], leaf["nbytes"])
+                agg["logical_nbytes"] += leaf["logical_nbytes"]
+        leaves = sorted(by_name.values(), key=lambda l: (-l["nbytes"], l["name"]))
+        return {
+            "total_bytes": max(s["total_bytes"] for s in snaps),
+            "logical_bytes": sum(s["total_bytes"] for s in snaps),
+            "leaf_count": snaps[0]["leaf_count"],
+            "per_session_bytes": max(s["per_session_bytes"] for s in snaps),
+            "n_shards": self.n_shards,
+            "leaves": leaves[: max(0, int(top_n))],
+        }
+
+    def health(self) -> Dict[str, Any]:
+        return {"shards": [c.health() for c in self.shards]}
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for c in self.shards:
+            out.update(c.slo_snapshot())
+        return out
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        return {
+            "owner": self.label,
+            "n_shards": self.n_shards,
+            "sessions": self.session_count,
+            "serve": dict(self.stats),
+            "memory": self.memory_snapshot(),
+            "shards": [c.telemetry_snapshot() for c in self.shards],
         }

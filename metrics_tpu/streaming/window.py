@@ -40,7 +40,7 @@ once at trace time, so emission is guarded on concreteness and the
 compiled paths are observed through the usual ``update``/``forward``
 launch spans instead.
 """
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -191,6 +191,13 @@ class SlidingWindow(_StreamingWindow):
         metric: inner metric; fixed-shape array states only.
         window: horizon in updates. Must be a positive multiple of ``slide``.
         slide: advance granularity in updates (default 1 = exact horizon).
+        shard_state: optional mesh axis name placing the ring's bucket
+            axis across devices — each replica holds ``num_buckets / N``
+            buckets' worth of ``ring_*`` state and sync reduce-scatters
+            instead of replicating (see docs/distributed.md "Sharded
+            state"). Bookkeeping leaves (cursor/counts/prefix cache) stay
+            replicated. ``num_buckets`` must be divisible by the axis size
+            for the sharded wire to engage.
         jit_update: engine eligibility (fast dispatch + fused forward);
             default on — streaming exists for the hot path.
 
@@ -206,7 +213,14 @@ class SlidingWindow(_StreamingWindow):
     """
 
     def __init__(
-        self, metric: Metric, *, window: int, slide: int = 1, jit_update: bool = True, **kwargs: Any
+        self,
+        metric: Metric,
+        *,
+        window: int,
+        slide: int = 1,
+        shard_state: Optional[str] = None,
+        jit_update: bool = True,
+        **kwargs: Any,
     ) -> None:
         super().__init__(metric, jit_update=jit_update, **kwargs)
         _check_inner(metric, "SlidingWindow")
@@ -223,6 +237,7 @@ class SlidingWindow(_StreamingWindow):
                 f"ring_{k}",
                 jnp.broadcast_to(d[None], (self.num_buckets,) + d.shape) + jnp.zeros_like(d),
                 dist_reduce_fx=metric._reductions[k],
+                shard_state=shard_state,
             )
         # replicas in lockstep hold the same bucket alignment: counts sum,
         # cursors agree (max is a cheap idempotent reconciliation)

@@ -46,6 +46,20 @@ cross at full precision, and any codec failure demotes the bucket to the
 full-precision wire through the resilience policy (cause ``quant-sync``).
 ``METRICS_TPU_QUANT_SYNC=0`` kills the quantized wire bit-exactly.
 
+States declared with ``add_state(shard_state="axis")`` form a third
+bucket class (``rs[axis]:``-tagged keys): instead of every device keeping
+the full reduced leaf, ONE ``psum_scatter`` (the ``reduce_scatter``
+primitive) per sum/mean bucket leaves each device holding only its own
+``d0/N`` shard — per-device state bytes drop to logical/N, the
+arXiv 2004.13336 replicated→sharded transformation applied to metric
+state. max/min buckets and quantized (``sync_precision="int8"``) sharded
+buckets transpose shard blocks with ONE ``all_to_all`` and reduce locally
+at full precision, so the int8 wire composes with sharding under the same
+error model. Sharded execution engages only under a matching named mesh
+axis (``AxisEnv`` inside ``shard_map``) with axis-divisible leading dims;
+everywhere else — and under ``METRICS_TPU_SHARD_STATE=0`` — the leaves
+execute replicated, bit-identical to the undeclared layout.
+
 The engine is on by default and gated by ``METRICS_TPU_FUSED_SYNC``
 (``0``/``false``/``off`` restores the per-leaf protocol bit-for-bit). Every
 bucket collective is emitted on the :mod:`metrics_tpu.telemetry` stream
@@ -94,6 +108,18 @@ def fused_sync_enabled() -> bool:
     return os.environ.get("METRICS_TPU_FUSED_SYNC", "1").strip().lower() not in ("0", "false", "off")
 
 
+def shard_state_enabled() -> bool:
+    """Is the sharded-state placement (``add_state(shard_state=...)``)
+    honored? (default: yes)
+
+    Kill switch: ``METRICS_TPU_SHARD_STATE=0`` (or ``false``/``off``)
+    restores the replicated layout bit-for-bit: sharded leaves rejoin
+    their replicated buckets and every post-sync leaf keeps its full
+    logical shape.
+    """
+    return os.environ.get("METRICS_TPU_SHARD_STATE", "1").strip().lower() not in ("0", "false", "off")
+
+
 class LeafSpec(NamedTuple):
     """One fixed-shape reduce-state leaf scheduled into a bucket.
 
@@ -114,6 +140,11 @@ class LeafSpec(NamedTuple):
     # the full-precision wire; set only when the metric opted in via
     # ``sync_precision=`` and the leaf/op/dtype is eligible
     codec: Optional[Any] = None
+    # mesh-axis name this leaf's leading dim is declared sharded over
+    # (``add_state(shard_state=...)``), or None for the replicated layout.
+    # Sharded leaves bucket under an ``rs[<axis>]:``-tagged key and sync
+    # via reduce-scatter when the executing env matches the axis.
+    shard_axis: Optional[str] = None
 
 
 def plan_metric_leaves(metric: Any, states: Dict[str, Any], tag: Optional[Hashable] = None) -> List[LeafSpec]:
@@ -136,6 +167,9 @@ def plan_metric_leaves(metric: Any, states: Dict[str, Any], tag: Optional[Hashab
     quant_on = getattr(metric, "sync_precision", None) is not None and quant.quant_enabled()
     quant_optout = getattr(metric, "_quantize", None) or {}
     quant_native = getattr(metric, "_quant_state_specs", None) or {}
+    # sharded-state placement (``add_state(shard_state=...)``); the kill
+    # switch folds every sharded leaf back into its replicated bucket
+    sharded = (getattr(metric, "_shard_state", None) or {}) if shard_state_enabled() else {}
     for attr, value in states.items():
         if isinstance(value, list) or attr in ragged or not isinstance(value, jax.Array):
             continue
@@ -144,13 +178,23 @@ def plan_metric_leaves(metric: Any, states: Dict[str, Any], tag: Optional[Hashab
             continue
         dt = jnp.dtype(value.dtype)
         codec = None
+        shard_axis = sharded.get(attr) if value.ndim >= 1 else None
         if dt == jnp.bool_:
             if op not in ("max", "min"):
                 continue  # a bool `sum` promotes on reduce; keep per-leaf semantics
             wire = jnp.dtype(jnp.int32)
         elif jnp.issubdtype(dt, jnp.floating):
             wire = dt
-            if sync_dtype is not None and attr not in sample_names and dt.itemsize > sync_dtype.itemsize:
+            # sharded leaves keep their state dtype on the wire: the
+            # reduce-scatter accumulates IN wire dtype, so sync_dtype's
+            # compress-then-accumulate-at-full-precision contract cannot
+            # hold there — quantization (below) is their compression story
+            if (
+                sync_dtype is not None
+                and attr not in sample_names
+                and shard_axis is None
+                and dt.itemsize > sync_dtype.itemsize
+            ):
                 wire = sync_dtype
         elif jnp.issubdtype(dt, jnp.integer):
             if op == "mean":
@@ -176,6 +220,7 @@ def plan_metric_leaves(metric: Any, states: Dict[str, Any], tag: Optional[Hashab
                 dtype=dt,
                 shape=shape,
                 codec=codec,
+                shard_axis=shard_axis,
             )
         )
     return specs
@@ -196,6 +241,12 @@ def bucket_plan(specs: List[LeafSpec]) -> Dict[Tuple[str, str], List[LeafSpec]]:
         # (``q8:float32``, ``pack5:int32``, ...): leaves with different
         # wire semantics never share a payload
         tag = quant.wire_tag(s.codec, jnp.dtype(s.wire_dtype).name)
+        # sharded leaves form their own bucket class per mesh axis
+        # (``rs[dp]:int32``, ``rs[dp]:q8:float32``, ...): one
+        # reduce-scatter (or quantized all_to_all) per such bucket, never
+        # sharing a payload with replicated leaves
+        if s.shard_axis is not None:
+            tag = f"rs[{s.shard_axis}]:{tag}"
         buckets.setdefault((tag, s.op), []).append(s)
     return buckets
 
@@ -241,6 +292,132 @@ def _bucket_cost(owner: str, leaves: List[LeafSpec], wire_name: str, op: str) ->
     return entry
 
 
+def _shard_world(env: Any, axis: Optional[str]) -> Optional[int]:
+    """World size for a sharded bucket, or None when the env cannot shard.
+
+    Sharded execution needs named-axis collectives over EXACTLY the
+    declared mesh axis — an :class:`~metrics_tpu.parallel.dist_env.AxisEnv`
+    tracing inside ``shard_map``. Any other env (NoOpEnv, ProcessEnv,
+    loopback test doubles, tuple axes, axis mismatch) executes the bucket
+    replicated: full-shape results, bit-identical to the undeclared
+    layout.
+    """
+    if axis is None or getattr(env, "axis_name", None) != axis:
+        return None
+    try:
+        return int(env.world_size())
+    except Exception:  # noqa: BLE001 — outside the SPMD region: no axis size
+        return None
+
+
+def _bucket_cost_sharded(owner: str, leaves: List[LeafSpec], wire_name: str, op: str, n: int) -> Any:
+    """Cost entry for a sharded bucket: the probe's outputs carry the
+    PER-SHARD shapes, so ``entry.out_bytes`` is logical/N by construction —
+    the structural per-device-bytes fact the sharding tests assert."""
+    codec = leaves[0].codec
+    key = (owner, wire_name, op, n, tuple((s.shape, str(s.dtype)) for s in leaves))
+    if key in _bucket_cost_cache:
+        return _bucket_cost_cache[key]
+    wire = jnp.dtype(leaves[0].wire_dtype)
+    pers = [s.shape[0] // n for s in leaves]
+    tails = [int(np.prod(s.shape[1:], dtype=np.int64)) for s in leaves]
+
+    def probe(*vals):
+        mats = [jnp.reshape(v.astype(wire), (n, p * t)) for v, p, t in zip(vals, pers, tails)]
+        buf2d = mats[0] if len(mats) == 1 else jnp.concatenate(mats, axis=1)
+        if codec is not None:
+            block = quant.default_block(wire)
+            m = int(buf2d.shape[1])
+            buf2d = jax.vmap(
+                lambda r: quant.decode_bucket(
+                    quant.encode_bucket(r, codec, block=block), codec, m, block=block
+                )
+            )(buf2d)
+        red = _HOST_REDUCE[op](buf2d)  # shard-shaped stand-in for the scatter-reduce
+        outs = []
+        off = 0
+        for s, p, t in zip(leaves, pers, tails):
+            outs.append(red[off : off + p * t].astype(s.dtype).reshape((p,) + s.shape[1:]))
+            off += p * t
+        return tuple(outs)
+
+    entry = None
+    try:
+        avals = [jax.ShapeDtypeStruct(tuple(s.value.shape), s.dtype) for s in leaves]
+        compiled = jax.jit(probe).lower(*avals).compile()
+        entry = cost_model.record(owner, "sync-sharded", key, compiled)
+    except Exception:
+        entry = None
+    _bucket_cost_cache[key] = entry
+    return entry
+
+
+def _execute_sharded(
+    leaves: List[LeafSpec],
+    axis: str,
+    n: int,
+    op: str,
+    wire: Any,
+    codec: Optional[Any],
+    out: Dict[Hashable, Array],
+) -> int:
+    """ONE collective for a sharded bucket; each device keeps only its own
+    reduced shard. Returns the per-device wire payload bytes.
+
+    Leaves pack shard-major into an ``(n, M)`` buffer — row ``r`` holds
+    shard ``r`` of every leaf — so one scatter-reduce serves the whole
+    bucket and leaf boundaries stay shard-aligned. sum/mean at full
+    precision lower to a single ``psum_scatter`` (the ``reduce_scatter``
+    primitive the jaxpr pin counts). max/min (XLA has no scatter form for
+    them) and quantized buckets transpose shard blocks with a single
+    ``all_to_all`` — on the quantized wire the payload is the block-int8
+    codes + scales, and every participant decodes before reducing at full
+    precision, the same error model as the replicated quantized bucket.
+    """
+    pers = [s.shape[0] // n for s in leaves]
+    tails = [int(np.prod(s.shape[1:], dtype=np.int64)) for s in leaves]
+    mats = [
+        jnp.reshape(jnp.asarray(s.value).astype(wire), (n, p * t))
+        for s, p, t in zip(leaves, pers, tails)
+    ]
+    buf2d = mats[0] if len(mats) == 1 else jnp.concatenate(mats, axis=1)  # (n, M)
+    m = int(buf2d.shape[1])
+
+    def _unpack(red_or_stack, stacked: bool) -> None:
+        off = 0
+        for s, p, t in zip(leaves, pers, tails):
+            shard_shape = (p,) + s.shape[1:]
+            if stacked:
+                seg = red_or_stack[:, off : off + p * t]
+                if codec is not None and codec.kind == "q8" and jnp.issubdtype(s.dtype, jnp.integer):
+                    # integers re-enter the lattice BEFORE the reduction:
+                    # exact below quant.INT_EXACT_BOUND, same as replicated
+                    seg = jnp.rint(seg).astype(s.dtype)
+                else:
+                    seg = seg.astype(s.dtype)
+                out[s.key] = _HOST_REDUCE[op](seg).reshape(shard_shape)
+            else:
+                out[s.key] = red_or_stack[off : off + p * t].astype(s.dtype).reshape(shard_shape)
+            off += p * t
+
+    if codec is not None:
+        block = quant.default_block(wire)
+        payload = jax.vmap(lambda r: quant.encode_bucket(r, codec, block=block))(buf2d)
+        swapped = jax.lax.all_to_all(payload, axis, split_axis=0, concat_axis=0)
+        decoded = jax.vmap(lambda p: quant.decode_bucket(p, codec, m, block=block))(swapped)
+        _unpack(decoded, stacked=True)
+        return int(payload.size)
+    if op in ("sum", "mean"):
+        red = jax.lax.psum_scatter(buf2d, axis, scatter_dimension=0, tiled=False)  # (M,)
+        if op == "mean":
+            red = red / n
+        _unpack(red, stacked=False)
+    else:  # max / min: transpose shard blocks, reduce locally
+        swapped = jax.lax.all_to_all(buf2d, axis, split_axis=0, concat_axis=0)
+        _unpack(swapped, stacked=True)
+    return int(buf2d.size) * jnp.dtype(wire).itemsize
+
+
 def execute_buckets(
     env: Any,
     specs: List[LeafSpec],
@@ -266,6 +443,57 @@ def execute_buckets(
         leaves = buckets[(wire_name, op)]
         codec = leaves[0].codec
         wire = jnp.dtype(leaves[0].wire_dtype)
+
+        # sharded bucket class (``rs[axis]:`` keys): ONE scatter-reduce
+        # leaves each device holding only its own reduced shard —
+        # per-device state bytes drop to logical/N. Falls back to the
+        # replicated branches below whenever the env is not a matching
+        # named-axis env or a leading dim does not divide the axis (the
+        # kill switch never even plans these buckets).
+        shard_axis = leaves[0].shard_axis
+        n_shard = _shard_world(env, shard_axis)
+        if n_shard is not None and all(s.shape[0] % n_shard == 0 for s in leaves):
+            logical_nbytes = sum(
+                int(np.prod(s.shape)) * (1 if s.dtype == jnp.bool_ else jnp.dtype(s.dtype).itemsize)
+                for s in leaves
+            )
+            try:
+                nbytes = _execute_sharded(leaves, shard_axis, n_shard, op, wire, codec, out)
+            except Exception as err:  # noqa: BLE001 — replicated fallback below
+                if not resilience.resilience_enabled():
+                    raise
+                resilience.record_degrade(owner, "shard-sync", err)
+            else:
+                cost = {}
+                if telemetry.subscribed():
+                    entry = _bucket_cost_sharded(owner, leaves, wire_name, op, n_shard)
+                    dur = None if t0 is None else (time.perf_counter() - t0) * 1e6
+                    cost = cost_model.launch_attrs(entry, dur)
+                telemetry.emit(
+                    "collective",
+                    owner,
+                    "fused",
+                    t0=t0,
+                    nbytes=nbytes,
+                    logical_nbytes=logical_nbytes,
+                    op=op,
+                    wire_dtype=wire_name,
+                    quantized=codec is not None,
+                    nleaves=len(leaves),
+                    sharded=True,
+                    shard_axis=shard_axis,
+                    shard_world=n_shard,
+                    shard_nbytes=logical_nbytes // n_shard,
+                    **cost,
+                )
+                if stats is not None:
+                    stats["collectives"] = stats.get("collectives", 0) + 1
+                    stats["buckets"] = stats.get("buckets", 0) + 1
+                    stats["sharded_buckets"] = stats.get("sharded_buckets", 0) + 1
+                    stats["bytes_on_wire"] = stats.get("bytes_on_wire", 0) + nbytes
+                    stats["bytes_logical"] = stats.get("bytes_logical", 0) + logical_nbytes
+                continue
+
         flat = [jnp.ravel(s.value).astype(wire) for s in leaves]
         buf = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
         sizes = [int(np.prod(s.shape)) for s in leaves]

@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 30
+    assert len(skipped) == 31
     assert "detail_elapsed_s" in detail
 
 
@@ -201,6 +201,34 @@ def test_quant_config_counts_and_keys(monkeypatch):
     assert detail["quant_sync_float_within_bound"] is True
     assert detail["quant_sync_int_sum_bitexact"] is True
     assert detail["quant_hll_union_bitexact"] is True
+
+
+def test_sharded_state_config_counts_and_keys(monkeypatch):
+    """Pin the sharded-state bench config: ONE reduce-scatter and zero
+    psums on the sharded confusion-matrix wire, per-device bytes exactly
+    logical/8 at every swept C (three independent witnesses: the sweep
+    arithmetic, the collective span, the cost-model entry), the OOM
+    extrapolation's sqrt(N) class-axis gain, and the capacity-sharded
+    service holding 4x the tenants at flat per-shard bytes with one
+    coalesced launch per shard."""
+    monkeypatch.delenv("METRICS_TPU_SHARD_STATE", raising=False)
+    detail = {}
+    bench._cfg_sharded_state(detail)
+    assert detail["sharded_sync_collectives"] == 1
+    assert detail["sharded_sync_psums"] == 0
+    for c in (64, 256, 1024):
+        assert (detail[f"sharded_confmat_bytes_logical_C{c}"]
+                == 8 * detail[f"sharded_confmat_bytes_per_device_C{c}"]
+                == c * c * 4)
+    assert detail["sharded_span_shard_nbytes"] == detail["sharded_span_logical_nbytes"] // 8
+    assert detail["sharded_cost_out_bytes"] == detail["sharded_span_shard_nbytes"]
+    cmax_r, cmax_s = (detail["sharded_oom_cmax_replicated"],
+                      detail["sharded_oom_cmax_sharded"])
+    assert abs(cmax_s / cmax_r - 8 ** 0.5) < 0.01
+    assert detail["serve_capacity_sharded_sessions"] == 32
+    assert detail["serve_capacity_launches_per_flush"] == 4
+    assert detail["serve_capacity_bytes_per_shard"] == detail["serve_capacity_bytes_plain"]
+    assert detail["serve_capacity_sessions_ratio"] == 4.0
 
 
 def test_static_audit_config_counts_and_keys():

@@ -1,22 +1,32 @@
-"""Kill-switch matrix: all 2^3 combinations of the three execution-engine
+"""Kill-switch matrix: all 2^4 combinations of the four execution-engine
 switches — ``METRICS_TPU_FAST_DISPATCH``, ``METRICS_TPU_FUSED_FORWARD``,
-``METRICS_TPU_FUSED_SYNC`` — must produce results **bit-identical** to the
-all-on default on a standard classification suite (forward per step,
-extra updates, synced compute under a 2-rank loopback env). Any drift
-between an engine and its legacy fallback is a correctness bug the
-switches would otherwise let users "fix" silently.
+``METRICS_TPU_FUSED_SYNC``, ``METRICS_TPU_SHARD_STATE`` — must produce
+results **bit-identical** to the all-on default on a standard
+classification suite (forward per step, extra updates, synced compute
+under a 2-rank loopback env) plus a ``shard_state=`` confusion matrix
+synced under an 8-device shard_map mesh. Any drift between an engine and
+its legacy fallback is a correctness bug the switches would otherwise
+let users "fix" silently.
 """
 import itertools
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import Mesh, PartitionSpec as P
 
-from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
+from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, MetricCollection, Precision, Recall
+from metrics_tpu._compat import shard_map
 from metrics_tpu.parallel.dist_env import NoOpEnv
 
 NUM_CLASSES = 5
-SWITCHES = ("METRICS_TPU_FAST_DISPATCH", "METRICS_TPU_FUSED_FORWARD", "METRICS_TPU_FUSED_SYNC")
+SWITCHES = (
+    "METRICS_TPU_FAST_DISPATCH",
+    "METRICS_TPU_FUSED_FORWARD",
+    "METRICS_TPU_FUSED_SYNC",
+    "METRICS_TPU_SHARD_STATE",
+)
 
 
 class Loopback2(NoOpEnv):
@@ -44,6 +54,34 @@ def _suite(env):
     )
 
 
+def _sharded_confmat():
+    """compute() of a shard_state= confusion matrix synced under an
+    8-device shard_map mesh — the one path where METRICS_TPU_SHARD_STATE
+    changes the wire (reduce-scatter vs replicated psum); both layouts
+    must agree bitwise on integer state."""
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 devices (root conftest forces 8 host devices)")
+    mesh = Mesh(np.array(devices[:8]), ("dp",))
+    rng = np.random.RandomState(4321)
+    preds = jnp.asarray(rng.randint(0, 8, size=(8, 64)))
+    target = jnp.asarray(rng.randint(0, 8, size=(8, 64)))
+    m = ConfusionMatrix(num_classes=8, shard_state="dp", jit_update=False)
+
+    def worker(p, t):
+        st = m.pure_update(m.default_state(), p[0], t[0])
+        return m.pure_compute_sharded(m.pure_sync(st, "dp"), "dp")
+
+    return np.asarray(
+        jax.jit(
+            shard_map(
+                worker, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(),
+                check_vma=False,
+            )
+        )(preds, target)
+    )
+
+
 def _run_suite():
     """One standard classification run: 3 forwards + 2 updates + synced
     compute. Fresh metrics, fresh RNG — byte-comparable across combos."""
@@ -61,6 +99,7 @@ def _run_suite():
         target = jnp.asarray(rng.randint(0, NUM_CLASSES, b))
         col.update(preds, target)
     final = {k: np.asarray(v) for k, v in col.compute().items()}
+    final["confmat_sharded"] = _sharded_confmat()
     return step_vals, final
 
 
@@ -75,8 +114,8 @@ def all_on_baseline():
 
 
 @pytest.mark.parametrize(
-    "combo", list(itertools.product(("1", "0"), repeat=3)),
-    ids=lambda c: "dispatch%s-forward%s-sync%s" % c,
+    "combo", list(itertools.product(("1", "0"), repeat=4)),
+    ids=lambda c: "dispatch%s-forward%s-sync%s-shard%s" % c,
 )
 def test_kill_switch_combo_bit_identical(combo, all_on_baseline, monkeypatch):
     for switch, value in zip(SWITCHES, combo):

@@ -205,3 +205,97 @@ def test_merge_mean_running_formula_pinned():
     np.testing.assert_allclose(
         np.asarray(m.pure_compute(acc)), np.asarray(streamed.compute()), rtol=1e-6
     )
+
+
+# ------------------------------------- sharded-state merge family
+# shard_state= places a leaf's rows across a mesh axis; merges stay
+# LEAFWISE, so merging per-shard row slices and reassembling must equal
+# the replicated merge bit for bit — the algebraic fact that makes the
+# reduce-scatter sync a legal implementation of pure_merge. The oracle
+# here is the replicated ConfusionMatrix; the "shards" are row slices of
+# its partial states (exactly what each device holds post-sync).
+_N_SHARDS = 4
+_CC = 8  # confmat classes; _CC % _N_SHARDS == 0
+
+
+def _confmat_batches(seed, n=3):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.randint(0, _CC, 32)),
+            jnp.asarray(rng.randint(0, _CC, 32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _row_shard(state, k):
+    rows = _CC // _N_SHARDS
+    return {"confmat": state["confmat"][k * rows : (k + 1) * rows]}
+
+
+def _assemble(shards):
+    return {"confmat": jnp.concatenate([s["confmat"] for s in shards], axis=0)}
+
+
+def _sharded_merge(m, a, b, count):
+    """Merge performed independently per row shard, then reassembled."""
+    return _assemble(
+        [m.pure_merge(_row_shard(a, k), _row_shard(b, k), count=count) for k in range(_N_SHARDS)]
+    )
+
+
+def test_sharded_confmat_merge_identity():
+    from metrics_tpu import ConfusionMatrix
+
+    m = ConfusionMatrix(num_classes=_CC)
+    (s1,) = _states(m, _confmat_batches(20, n=1))
+    for merged in (
+        _sharded_merge(m, m.default_state(), s1, count=1),
+        _sharded_merge(m, s1, m.default_state(), count=1),
+    ):
+        np.testing.assert_array_equal(np.asarray(merged["confmat"]), np.asarray(s1["confmat"]))
+
+
+def test_sharded_confmat_merge_commutative_vs_replicated_oracle():
+    from metrics_tpu import ConfusionMatrix
+
+    m = ConfusionMatrix(num_classes=_CC)
+    s1, s2 = _states(m, _confmat_batches(21, n=2))
+    want = m.pure_merge(s1, s2, count=2)
+    for merged in (_sharded_merge(m, s1, s2, 2), _sharded_merge(m, s2, s1, 2)):
+        np.testing.assert_array_equal(
+            np.asarray(merged["confmat"]), np.asarray(want["confmat"])
+        )
+
+
+def test_sharded_confmat_merge_associative_any_bucketing():
+    from metrics_tpu import ConfusionMatrix
+
+    m = ConfusionMatrix(num_classes=_CC)
+    s1, s2, s3 = _states(m, _confmat_batches(22, n=3))
+    want = m.pure_merge(m.pure_merge(s1, s2, count=2), s3, count=3)
+    left = _sharded_merge(m, _sharded_merge(m, s1, s2, 2), s3, 3)
+    right = _sharded_merge(m, s1, _sharded_merge(m, s2, s3, 2), 3)
+    for got in (left, right):
+        np.testing.assert_array_equal(np.asarray(got["confmat"]), np.asarray(want["confmat"]))
+
+
+def test_sharded_confmat_fold_equals_streamed_updates():
+    """Per-shard left fold of every batch's partial == one replicated
+    metric that saw the whole stream — compute() on the assembled fold is
+    the streamed value bit for bit."""
+    from metrics_tpu import ConfusionMatrix
+
+    batches = _confmat_batches(23, n=4)
+    m = ConfusionMatrix(num_classes=_CC)
+    partials = _states(m, batches)
+    acc = partials[0]
+    for i, s in enumerate(partials[1:], start=2):
+        acc = _sharded_merge(m, acc, s, i)
+    streamed = ConfusionMatrix(num_classes=_CC)
+    for b in batches:
+        streamed.update(*b)
+    np.testing.assert_array_equal(
+        np.asarray(m.pure_compute(acc)), np.asarray(streamed.compute())
+    )

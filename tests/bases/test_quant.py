@@ -114,6 +114,24 @@ def _vec(seed=0, n=2048, scale=1.0):
 
 
 # ------------------------------------------------------------- codec properties
+def test_default_block_is_dtype_aware(monkeypatch):
+    """256 for f32 (the TPU lane-width sweet spot), 128 for f64 (same
+    bytes-per-block on the wire); METRICS_TPU_QUANT_BLOCK overrides both."""
+    monkeypatch.delenv("METRICS_TPU_QUANT_BLOCK", raising=False)
+    assert quant.default_block() == 256
+    assert quant.default_block(jnp.float32) == 256
+    assert quant.default_block(jnp.dtype("float64")) == 128
+    monkeypatch.setenv("METRICS_TPU_QUANT_BLOCK", "64")
+    assert quant.default_block() == 64
+    assert quant.default_block(jnp.float32) == 64
+    assert quant.default_block(jnp.float64) == 64
+    # override floors at 8 and garbage falls back to the dtype default
+    monkeypatch.setenv("METRICS_TPU_QUANT_BLOCK", "2")
+    assert quant.default_block() == 8
+    monkeypatch.setenv("METRICS_TPU_QUANT_BLOCK", "nope")
+    assert quant.default_block(jnp.float64) == 128
+
+
 @pytest.mark.parametrize("block", [8, 32, 256, 1024])
 def test_q8_roundtrip_error_within_documented_bound(block):
     """|decode(encode(x)) - x| <= amax_block / 254 for nearest rounding,

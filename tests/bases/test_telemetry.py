@@ -470,6 +470,27 @@ def test_metric_memory_snapshot_is_exact():
     assert m.telemetry_snapshot()["memory"]["total_bytes"] == mem["total_bytes"]
 
 
+def test_metric_memory_snapshot_logical_nbytes():
+    from metrics_tpu import ConfusionMatrix
+
+    # Replicated metric: every leaf's logical bytes equal its resident bytes.
+    rng = np.random.RandomState(13)
+    m = Accuracy(num_classes=C, average="macro")
+    m.update(*_batch(rng, 32))
+    mem = m.memory_snapshot(top_n=100)
+    for leaf in mem["leaves"]:
+        assert leaf["logical_nbytes"] == leaf["nbytes"]
+
+    # Sharded metric holding a 1/N row slice: nbytes is the per-device
+    # footprint, logical_nbytes the assembled (C, C) state.
+    cm = ConfusionMatrix(num_classes=8, shard_state="dp")
+    full = int(jnp.zeros((8, 8), jnp.int32).nbytes)
+    cm.confmat = jnp.zeros((2, 8), jnp.int32)  # post reduce-scatter, N=4
+    (leaf,) = cm.memory_snapshot(top_n=10)["leaves"]
+    assert leaf["nbytes"] == full // 4
+    assert leaf["logical_nbytes"] == full
+
+
 def test_collection_memory_snapshot_prefixes_members():
     rng = np.random.RandomState(12)
     col = MetricCollection(

@@ -46,6 +46,12 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")  # structural fronts never need a device
+# the sharded-state front needs a real multi-device mesh; mirror the test
+# conftest's 8 forced host devices when nothing chose a count already
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 _BASELINE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "..", "PERF_BASELINE.json"
@@ -160,6 +166,26 @@ SCHEDULE: Tuple[Tuple[str, str, Dict[str, Any], Tuple[str, ...], Tuple[str, ...]
             "window_tick_fused_us",
             "window_tick_eager_us",
         ),
+    ),
+    (
+        "sharded",
+        "_cfg_sharded_state",
+        {},
+        (
+            # all structural: collective counts from the jaxpr, byte pairs
+            # from the (C, C) int32 layout, capacity counters from the
+            # shard router — exact on CPU, exact on the chip
+            "sharded_sync_collectives",
+            "sharded_sync_psums",
+            "sharded_confmat_bytes_logical_C1024",
+            "sharded_confmat_bytes_per_device_C1024",
+            "sharded_span_shard_nbytes",
+            "sharded_cost_out_bytes",
+            "serve_capacity_sharded_sessions",
+            "serve_capacity_launches_per_flush",
+            "serve_capacity_sessions_ratio",
+        ),
+        (),
     ),
     (
         "read_path",
