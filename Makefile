@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load kernels quant shard doctest audit sentinel bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load kernels quant shard timetravel doctest audit sentinel bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -73,6 +73,7 @@ chaos:
 	$(MAKE) kernels
 	$(MAKE) quant
 	$(MAKE) shard
+	$(MAKE) timetravel
 	$(MAKE) sentinel
 
 # kernel-registry lane (docs/kernels.md): interpret-mode bitwise parity of
@@ -98,6 +99,14 @@ quant:
 shard:
 	python -m pytest tests/bases/test_shard_state.py -q
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" python -c "import json, bench; d = {}; bench._cfg_sharded_state(d); print(json.dumps(d, indent=2))"
+
+# point-in-time-recovery lane (docs/serving.md "Time travel"): the ladder
+# retention + compute_at + scrub + fold-tree/resolution-ladder suite, the
+# clock-skew and history-corruption fault drills, then the log(n) merge
+# counts and ladder-vs-full-replay record pair at sentinel scale
+timetravel:
+	python -m pytest tests/bases/test_time_travel.py -q
+	python -c "import json, bench; d = {}; bench._cfg_time_travel(d, ops=40, window=64, reps=2); print(json.dumps(d, indent=2))"
 
 # kill-and-recover loop: for EVERY registered crash point a subprocess is
 # SIGKILLed at that instruction, then a fresh process recover()s

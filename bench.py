@@ -1481,6 +1481,101 @@ def _cfg_read_path(detail: dict, sessions: int = 64, reps: int = 20) -> None:
     fab.shutdown()
 
 
+def _cfg_time_travel(detail: dict, ops: int = 120, window: int = 256, reps: int = 5) -> None:
+    """Point-in-time recovery costs (serve ladder + fold-tree ranges).
+
+    Two claims. (1) **Fold-tree range reads are O(log n)**: on a full
+    ``window``-bucket ring, a sub-range read is a greedy sparse-table
+    decomposition — the worst-case span costs exactly ``ceil(log2(n))``
+    ``pure_merge`` calls (structural counter, pinned) and the read-µs
+    stays flat from a 4-bucket span to an (n-1)-bucket span. (2)
+    **``compute_at`` rides the checkpoint ladder**: a point-in-time read
+    restores the nearest rung at or below the boundary fence and replays
+    only the short tail above it — strictly fewer replayed records (and
+    less wall time) than rebuilding the same instant from the whole
+    journal. ``ops``/``window``/``reps`` let the bench-config pin test
+    run the same code paths at test-budget scale."""
+    import math
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+    from metrics_tpu.aggregation import SumMetric
+    from metrics_tpu.serve import HistoryPolicy, MetricsService
+    from metrics_tpu.streaming import FoldTreeWindow
+
+    rng = np.random.RandomState(23)
+
+    # (1) range reads: flat in span length, log(n) in merges
+    w = FoldTreeWindow(SumMetric(), window=window, slide=1, jit_update=False)
+    for _ in range(window):
+        w.update(jnp.asarray([1.0, 2.0]))
+    w.compute_range(0, window)  # warm: builds the sparse table once
+    for span in (4, window // 4, window - 1):
+        total = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            w.compute_range(0, span)
+            total += time.perf_counter() - t0
+        detail[f"tt_range_read_us_span{span}"] = round(total / reps * 1e6, 1)
+    w.compute_range(0, window - 1)  # the worst-case greedy decomposition
+    detail["tt_range_merges_worst_span"] = w.range_merge_count
+    detail["tt_range_merges_log2_bound"] = int(math.ceil(math.log2(window)))
+    detail["tt_range_tree_builds"] = w.tree_builds
+
+    # (2) compute_at via the ladder vs a full-journal rebuild
+    C, B = 8, 16
+    preds = jnp.asarray(rng.randint(0, C, (8, B)))
+    targs = jnp.asarray(rng.randint(0, C, (8, B)))
+    with tempfile.TemporaryDirectory() as root:
+        svc = MetricsService(
+            Accuracy(task="multiclass", num_classes=C),
+            journal_dir=os.path.join(root, "wal"),
+            checkpoint_dir=os.path.join(root, "ckpt"),
+            history=HistoryPolicy(keep_last=4),
+        )
+        svc.journal.retain_seq = 0  # keep the whole journal: the full-
+        # rebuild baseline below needs every record still readable
+        cut = (ops * 3) // 4
+        for j in range(cut):
+            svc.submit(f"s{j % 8}", preds[j % 8], targs[j % 8])
+        svc.drain()
+        svc.checkpoint()  # the rung compute_at should land on
+        for j in range(cut, ops):
+            svc.submit(f"s{j % 8}", preds[j % 8], targs[j % 8])
+        svc.drain()
+        t_end = svc.journal.read_tail(0)[-1].ts
+
+        scratch, fence = svc.service_at(t_end)  # warm + structural counts
+        detail["tt_time_travel_fence"] = fence
+        detail["tt_time_travel_replay_records"] = scratch.stats["replayed_records"]
+        scratch.shutdown()
+        total = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            svc.compute_at(t_end)
+            total += time.perf_counter() - t0
+        detail["tt_compute_at_us"] = round(total / reps * 1e6, 1)
+
+        detail["tt_full_replay_records"] = len(svc.journal.read_tail(0))
+        total = 0.0
+        for _ in range(reps):
+            # the honest rebuild baseline pays everything compute_at pays —
+            # journal scan, scratch construction — plus the full replay
+            t0 = time.perf_counter()
+            rebuild = MetricsService(Accuracy(task="multiclass", num_classes=C))
+            rebuild.apply_records(svc.journal.read_tail(0))
+            rebuild.compute_all()
+            total += time.perf_counter() - t0
+            rebuild.shutdown()
+        detail["tt_full_replay_us"] = round(total / reps * 1e6, 1)
+        detail["tt_compute_at_speedup"] = round(
+            detail["tt_full_replay_us"] / max(detail["tt_compute_at_us"], 1e-9), 2
+        )
+        svc.shutdown()
+
+
 def _cfg_compute_group_detection(detail: dict, reps: int = 5) -> None:
     """First-update cost of auto compute-group detection (VERDICT r3 #7).
 
@@ -2071,6 +2166,7 @@ def _bench_detail() -> dict:
         ("request_tracing_idle_overhead_ratio", _cfg_request_tracing),
         ("fabric_updates_per_sec", _cfg_fabric),
         ("read_path_second_read_launches", _cfg_read_path),
+        ("time_travel_compute_at_us", _cfg_time_travel),
     ]
     detail["detail_elapsed_s"] = _run_configs(detail, configs, budget, "detail")
     return detail
@@ -2296,6 +2392,7 @@ def _bench_detail_fast() -> dict:
         ("request_tracing", lambda d: _cfg_request_tracing(d, sessions=32, reps=2, loops=3)),
         ("fabric", lambda d: _cfg_fabric(d, sessions=32, events=300, shards=2)),
         ("read_path", lambda d: _cfg_read_path(d, sessions=16, reps=5)),
+        ("time_travel", lambda d: _cfg_time_travel(d, ops=40, window=64, reps=2)),
         ("cg_detection", lambda d: _cfg_compute_group_detection(d, reps=3)),
         ("cg_steady_state", lambda d: _cfg_cg_steady_state(d, steps=100, reps=2)),
         ("scan_epoch", lambda d: _cfg_scan_epoch(d, reps=3)),

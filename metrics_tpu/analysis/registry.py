@@ -162,6 +162,14 @@ def _device_table():
             lambda: M.TumblingWindow(M.Accuracy(num_classes=_C, average="macro"), window=4),
             "probs", "labels",
         ),
+        "FoldTreeWindow": cls_args(
+            lambda: M.FoldTreeWindow(M.Accuracy(num_classes=_C, average="macro"), window=4, slide=2),
+            "probs", "labels",
+        ),
+        "ResolutionLadder": cls_args(
+            lambda: M.ResolutionLadder(M.Accuracy(num_classes=_C, average="macro"), levels=(4, 3)),
+            "probs", "labels",
+        ),
         "ExponentialDecay": cls_args(
             lambda: M.ExponentialDecay(M.MeanSquaredError(), halflife=8.0), "reg_p", "reg_t"
         ),

@@ -557,6 +557,48 @@ class ShardedMetricsService:
     def compute(self, name: str) -> Any:
         return self._route(name).service.compute(name)
 
+    # ------------------------------------------------------- time travel
+    def compute_at(self, t: float, name: Optional[str] = None) -> Any:
+        """Fleet point-in-time read: each shard materializes its own
+        partition as of wall-clock ``t`` from its checkpoint ladder +
+        fenced journal replay (:meth:`MetricsService.compute_at`) — served
+        through the fabric like any read (dead shards heal first, the
+        union over disjoint partitions is exact, ``read:time-travel``
+        spans per shard). With ``name`` the read routes to the owning
+        shard alone."""
+        if name is not None:
+            return self._route(name).service.compute_at(t, name)
+        out: Dict[str, Any] = {}
+        for part in self._fan_out(
+            lambda s: s.service.compute_at(t), self._serving_shards()
+        ):
+            out.update(part)
+        return out
+
+    def compute_range(self, t1: float, t2: float, name: Optional[str] = None) -> Any:
+        """Fleet range read over journal ``ts`` in ``(t1, t2]`` — the
+        per-shard :meth:`MetricsService.compute_range` fanned out on the
+        bounded read pool, union-merged (partitions are disjoint)."""
+        if name is not None:
+            return self._route(name).service.compute_range(t1, t2, name)
+        out: Dict[str, Any] = {}
+        for part in self._fan_out(
+            lambda s: s.service.compute_range(t1, t2), self._serving_shards()
+        ):
+            out.update(part)
+        return out
+
+    def scrub(self, *, quarantine: bool = True) -> Dict[int, Dict[str, Any]]:
+        """Walk every serving shard's checkpoint ladder
+        (:meth:`MetricsService.scrub`): verify, quarantine (never delete)
+        corrupt rungs, re-pin journal floors. Returns per-shard reports
+        keyed by shard id."""
+        shards = self._serving_shards()
+        reports = self._fan_out(
+            lambda s: s.service.scrub(quarantine=quarantine), shards
+        )
+        return {s.shard_id: r for s, r in zip(shards, reports)}
+
     def _fleet_program(self, kind: str, n: int, m: int, builder, example_args: Tuple, wire_sig: Tuple = ()) -> Tuple[Any, Any]:
         """The AOT-compiled packed program for one fleet-read signature,
         plus its :class:`~metrics_tpu.analysis.cost_model.CostEntry`.

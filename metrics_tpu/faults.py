@@ -64,6 +64,21 @@ fault name                where it fires
                           decode (the crc guard must convert it into
                           ``StateCorruptionError``, never silently
                           apply damaged state)
+``history-corruption``    a retained checkpoint-ladder rung is bit-
+                          flipped on disk (param ``rung``: ladder index,
+                          default oldest). ``scrub()`` must quarantine
+                          the rung (never delete it) with a cause-tagged
+                          ``degrade:history`` span, and recovery /
+                          ``compute_at`` must fall back to the newest
+                          *verified* rung — damaged state is never
+                          served
+``clock-skew``            the wall clock steps backwards under the WAL
+                          appender (param ``skew_s``, default 3600):
+                          appended ``ts`` headers go non-monotonic like
+                          a stepped NTP host. Nothing raises anywhere;
+                          time-travel reads must pick their boundary by
+                          scanning in **seq** order (never sorting by
+                          ts) so replay stays bit-identical
 ========================= ==============================================
 
 Activation is per-test via the context manager::
@@ -134,6 +149,8 @@ FAULT_NAMES = (
     "shard-slow",
     "network-partition",
     "quant-corruption",
+    "history-corruption",
+    "clock-skew",
 )
 
 _ENV_VAR = "METRICS_TPU_INJECT_FAULT"
@@ -356,6 +373,7 @@ CRASH_POINTS = (
     "mid-flush",           # serve.flush: some waves launched, rest pending
     "mid-checkpoint",      # serve.checkpoint: payload written, not renamed
     "mid-truncate",        # wal.truncate: some retired segments unlinked
+    "mid-history-gc",      # serve.checkpoint: some expired ladder rungs unlinked
 )
 
 _CRASH_ENV = "METRICS_TPU_CRASH"

@@ -14,6 +14,8 @@ from metrics_tpu.streaming.sketch import (  # noqa: F401
 )
 from metrics_tpu.streaming.window import (  # noqa: F401
     ExponentialDecay,
+    FoldTreeWindow,
+    ResolutionLadder,
     SlidingWindow,
     TumblingWindow,
 )
@@ -21,9 +23,11 @@ from metrics_tpu.streaming.window import (  # noqa: F401
 __all__ = [
     "CountMinHeavyHitters",
     "ExponentialDecay",
+    "FoldTreeWindow",
     "HostQuantileSketch",
     "HyperLogLog",
     "QuantileSketch",
+    "ResolutionLadder",
     "SlidingWindow",
     "TumblingWindow",
 ]

@@ -49,7 +49,13 @@ from metrics_tpu import telemetry
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.exceptions import MetricsUserError
 
-__all__ = ["SlidingWindow", "TumblingWindow", "ExponentialDecay"]
+__all__ = [
+    "SlidingWindow",
+    "FoldTreeWindow",
+    "ResolutionLadder",
+    "TumblingWindow",
+    "ExponentialDecay",
+]
 
 Array = jax.Array
 
@@ -143,6 +149,21 @@ class _StreamingWindow(Metric):
 
     def _masked_update_supported(self) -> bool:
         return self._inner._masked_update_supported()
+
+    def _fold_step(self, carry: Tuple, xs: Tuple) -> Tuple[Tuple, None]:
+        """One oracle fold step: merge a bucket iff it holds updates, with
+        ``count`` = #nonempty buckets folded so far (the running-mean merge
+        law then weighs each bucket equally, and count=1 on the first live
+        bucket drops the fold's default-state seed exactly)."""
+        acc, seen = carry
+        bucket, c = xs
+        nonempty = c > 0
+        seen_new = seen + nonempty.astype(jnp.int32)
+        merged = self._inner.pure_merge(
+            acc, bucket, count=jnp.maximum(seen_new, 1).astype(jnp.float32)
+        )
+        acc = {k: jnp.where(nonempty, merged[k], acc[k]) for k in acc}
+        return (acc, seen_new), None
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({type(self._inner).__name__}())"
@@ -285,21 +306,6 @@ class SlidingWindow(_StreamingWindow):
         return adv, cursor
 
     # ------------------------------------------------------------ read cache
-    def _fold_step(self, carry: Tuple, xs: Tuple) -> Tuple[Tuple, None]:
-        """One oracle fold step: merge a bucket iff it holds updates, with
-        ``count`` = #nonempty buckets folded so far (the running-mean merge
-        law then weighs each bucket equally, and count=1 on the first live
-        bucket drops the fold's default-state seed exactly)."""
-        acc, seen = carry
-        bucket, c = xs
-        nonempty = c > 0
-        seen_new = seen + nonempty.astype(jnp.int32)
-        merged = self._inner.pure_merge(
-            acc, bucket, count=jnp.maximum(seen_new, 1).astype(jnp.float32)
-        )
-        acc = {k: jnp.where(nonempty, merged[k], acc[k]) for k in acc}
-        return (acc, seen_new), None
-
     def _fold_positions(self, order: Array) -> Tuple[Dict[str, Array], Array]:
         """Oracle left fold over the given ring positions, oldest-first."""
         buckets = {k: getattr(self, f"ring_{k}")[order] for k in self._inner_names}
@@ -441,6 +447,404 @@ class SlidingWindow(_StreamingWindow):
 
             leaves = jax.lax.cond(valid, lambda _: self._cached_fold(), full, None)
         return self._inner.pure_compute(dict(zip(self._inner_names, leaves)))
+
+
+class FoldTreeWindow(SlidingWindow):
+    """A :class:`SlidingWindow` whose ring also answers **sub-range**
+    reads in O(log n) merges.
+
+    The prefix cache makes the full-window read O(1), but incident
+    forensics ask for arbitrary slices ("the 3rd through 9th bucket of
+    the last hour"). This variant maintains a host-side **sparse table of
+    monoid folds** over the ring: level ``k`` holds the fold of every
+    ``2^k``-bucket run, each node built by ONE inner ``pure_merge`` of
+    two level ``k-1`` nodes. :meth:`compute_range` then decomposes any
+    logical bucket range greedily into at most ``ceil(log2(n))``
+    power-of-two spans and merges one table node per span — the
+    ``range_merge_count`` counter records exactly how many ``pure_merge``
+    calls the query issued (the structural pin the bench asserts).
+
+    Associativity is what makes the re-bracketing legal:
+    ``test_merge_properties.py`` proves sum/max/min/concat merges
+    associative (EXACT for integer-count states, fp-tolerance for float
+    sums), so a range read is bit-identical to the left-fold oracle for
+    integer-dtype states and within fp tolerance for float sums. The
+    running **mean** merge law is asymmetric by construction, so
+    mean-reduced inner metrics are rejected up front (same posture as
+    :class:`ExponentialDecay` rejecting max/min).
+
+    The table is lazy: any tick (fused or eager), masked update, or
+    ``reset()`` drops it, and the next range read rebuilds (``n-1``
+    merges, amortized over every read that shares the frozen ring).
+    Range reads are host-side (eager) by design — they are a forensic /
+    dashboard surface, not a hot-path launch.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric
+        >>> from metrics_tpu.streaming import FoldTreeWindow
+        >>> w = FoldTreeWindow(SumMetric(), window=4, jit_update=False)
+        >>> for v in (1.0, 2.0, 4.0, 8.0):
+        ...     w.update(jnp.asarray(v))
+        >>> float(w.compute_range(1, 3))  # buckets 1..2, oldest-first
+        6.0
+    """
+
+    def __init__(
+        self,
+        metric: Metric,
+        *,
+        window: int,
+        slide: int = 1,
+        shard_state: Optional[str] = None,
+        jit_update: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            metric, window=window, slide=slide, shard_state=shard_state,
+            jit_update=jit_update, **kwargs,
+        )
+        from metrics_tpu.utilities.data import dim_zero_mean
+
+        for name, red in metric._reductions.items():
+            if red is dim_zero_mean:
+                raise MetricsUserError(
+                    f"FoldTreeWindow cannot wrap {type(metric).__name__}: state "
+                    f"{name!r} uses the running-mean reduction, which is not "
+                    "associative — a fold tree would change its value. Use "
+                    "SlidingWindow (full-window reads only) instead."
+                )
+        # sparse table: _tree[k][i] = (state, seen) folding logical buckets
+        # [i, i + 2^k). Host-side cache, dropped on any state change.
+        self._tree: Optional[list] = None
+        self.range_merge_count = 0
+        self.tree_builds = 0
+
+    # every mutation path drops the table (ticks, masked ticks, resets —
+    # the fused window_tick kernel is reached through update() too)
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._tree = None
+        super().update(*args, **kwargs)
+
+    def _masked_update(self, sample_mask: Array, *args: Any, **kwargs: Any) -> None:
+        self._tree = None
+        super()._masked_update(sample_mask, *args, **kwargs)
+
+    def reset(self) -> None:
+        self._tree = None
+        super().reset()
+
+    def _node_combine(self, a: Tuple, b: Tuple) -> Tuple:
+        """Combine two fold nodes. Empty nodes pass through untouched (the
+        oracle fold skips empty buckets), so a combine never spends a
+        merge — or perturbs a bit — on a default-state seed."""
+        sa, na = a
+        sb, nb = b
+        if nb == 0:
+            return a
+        if na == 0:
+            return b
+        merged = self._inner.pure_merge(sa, sb, count=float(na + nb))
+        return (merged, na + nb)
+
+    def _ensure_tree(self) -> None:
+        if self._tree is not None:
+            return
+        n = self.num_buckets
+        order = (int(self.cursor) + 1 + jnp.arange(n, dtype=jnp.int32)) % n
+        counts = jnp.asarray(self.counts)[order]
+        level0 = [
+            (
+                {k: getattr(self, f"ring_{k}")[order[i]] for k in self._inner_names},
+                int(counts[i] > 0),
+            )
+            for i in range(n)
+        ]
+        tree = [level0]
+        size = 1
+        while size * 2 <= n:
+            prev = tree[-1]
+            tree.append(
+                [
+                    self._node_combine(prev[i], prev[i + size])
+                    for i in range(n - size * 2 + 1)
+                ]
+            )
+            size *= 2
+        self._tree = tree
+        self.tree_builds += 1
+
+    def compute_range(self, lo: int, hi: Optional[int] = None) -> Any:
+        """The inner metric's value over logical buckets ``[lo, hi)``
+        (0 = oldest retained bucket, ``num_buckets - 1`` = the live
+        cursor bucket; ``hi`` defaults to the ring size). Greedy
+        largest-span decomposition over the sparse table: at most
+        ``ceil(log2(n))`` ``pure_merge`` calls, recorded in
+        ``range_merge_count``. Emits a ``read:window-range`` span."""
+        if isinstance(self.cursor, jax.core.Tracer):
+            raise MetricsUserError(
+                "compute_range is a host-side (eager) read; call it outside jit"
+            )
+        n = self.num_buckets
+        hi = n if hi is None else int(hi)
+        lo = int(lo)
+        if not 0 <= lo < hi <= n:
+            raise MetricsUserError(
+                f"compute_range wants 0 <= lo < hi <= {n}, got ({lo}, {hi})"
+            )
+        t0 = telemetry.clock()
+        self._ensure_tree()
+        assert self._tree is not None
+        acc = (
+            {k: jnp.zeros_like(d) + d for k, d in self._inner_defaults.items()},
+            0,
+        )
+        merges = 0
+        p = lo
+        while p < hi:
+            k = min((hi - p).bit_length() - 1, len(self._tree) - 1)
+            node = self._tree[k][p]
+            if node[1] > 0:
+                acc = self._node_combine(acc, node)
+                merges += 1
+            p += 1 << k
+        self.range_merge_count = merges
+        telemetry.emit(
+            "read", type(self).__name__, "window-range", t0=t0,
+            buckets=n, span=hi - lo, merges=merges,
+        )
+        return self._inner.pure_compute(acc[0])
+
+
+class ResolutionLadder(_StreamingWindow):
+    """Cascading rings at widening resolutions — minute → hour → day.
+
+    A single :class:`SlidingWindow` holding a day of per-minute buckets
+    would pay 1440 buckets of state; the ladder holds
+    ``sum(levels)`` instead: level 0 is a ring of ``levels[0]`` per-tick
+    buckets; every time it wraps, its whole ring folds (one
+    :meth:`~metrics_tpu.metric.Metric.pure_merge` chain, oldest-first)
+    into ONE bucket of level 1, and so on up the ladder. Every level's
+    fold is amortized over the ticks that filled it —
+    ``sum(1/prod(levels[:l]))`` extra merges per tick, strictly < 1 — so
+    the ladder stays **O(1) amortized per tick** with fixed-shape state
+    (engine-eligible, stackable, checkpointable like any wrapper).
+
+    ``compute()`` folds every level coarsest-first (chronological order,
+    the same left-fold law as :class:`SlidingWindow`), giving the value
+    over the entire retained horizon (``prod(levels)`` ticks at
+    wrap-granularity); :meth:`compute_level` reads one level alone —
+    level 0 is "the last minute so far", level 1 "the completed minutes
+    of this hour", etc.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric
+        >>> from metrics_tpu.streaming import ResolutionLadder
+        >>> m = ResolutionLadder(SumMetric(), levels=(2, 2), jit_update=False)
+        >>> for v in (1.0, 2.0, 4.0, 8.0, 16.0):
+        ...     m.update(jnp.asarray(v))
+        >>> float(m.compute())  # whole retained horizon
+        31.0
+    """
+
+    def __init__(
+        self,
+        metric: Metric,
+        *,
+        levels: Tuple[int, ...] = (60, 60, 24),
+        jit_update: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(metric, jit_update=jit_update, **kwargs)
+        _check_inner(metric, "ResolutionLadder")
+        levels = tuple(int(x) for x in levels)
+        if not levels or any(x < 2 for x in levels):
+            raise MetricsUserError(
+                f"levels must be ring sizes >= 2 (finest first), got {levels}"
+            )
+        self.levels = levels
+        self.n_levels = len(levels)
+        # _strides[l] = ticks per level-l bucket (1, L0, L0*L1, ...)
+        strides = [1]
+        for L in levels[:-1]:
+            strides.append(strides[-1] * L)
+        self._strides = tuple(strides)
+        for l, L in enumerate(levels):
+            for k, d in self._inner_defaults.items():
+                self.add_state(
+                    f"lvl{l}_{k}",
+                    jnp.broadcast_to(d[None], (L,) + d.shape) + jnp.zeros_like(d),
+                    dist_reduce_fx=metric._reductions[k],
+                )
+            self.add_state(
+                f"lvl{l}_counts", jnp.zeros((L,), jnp.int32), dist_reduce_fx="sum"
+            )
+        self.add_state("ticks", jnp.asarray(0, jnp.int32), dist_reduce_fx="max")
+
+    # ------------------------------------------------------------- cascade
+    def _level_leaves(self, l: int) -> Tuple[Dict[str, Array], Array]:
+        return (
+            {k: getattr(self, f"lvl{l}_{k}") for k in self._inner_names},
+            getattr(self, f"lvl{l}_counts"),
+        )
+
+    def _install_level(self, l: int, buckets: Dict[str, Array], counts: Array) -> None:
+        for k in self._inner_names:
+            object.__setattr__(self, f"lvl{l}_{k}", buckets[k])
+        object.__setattr__(self, f"lvl{l}_counts", counts)
+
+    def _fold_level_chrono(
+        self, l: int, carry: Tuple[Dict[str, Array], Array], t: Array
+    ) -> Tuple[Dict[str, Array], Array]:
+        """Continue a fold across level ``l``'s ring oldest-first. The next
+        write position is the oldest bucket (rings are written cyclically;
+        a cleared bucket has count 0 and is skipped by the fold)."""
+        L = self.levels[l]
+        cursor = (t // self._strides[l]) % L
+        order = (cursor + jnp.arange(L, dtype=jnp.int32)) % L
+        buckets, counts = self._level_leaves(l)
+        (acc, seen), _ = jax.lax.scan(
+            self._fold_step,
+            carry,
+            ({k: buckets[k][order] for k in self._inner_names}, counts[order]),
+        )
+        return acc, seen
+
+    def _cascade_leaves(
+        self, l: int, t: Array
+    ) -> Tuple[Dict[str, Array], Array, Dict[str, Array], Array]:
+        """Fold level ``l-1``'s (full) ring into one level-``l`` bucket and
+        clear the child — pure: returns (child buckets, child counts,
+        parent buckets, parent counts)."""
+        child, ccounts = self._level_leaves(l - 1)
+        acc0 = {k: jnp.zeros_like(d) + d for k, d in self._inner_defaults.items()}
+        (acc, _seen), _ = jax.lax.scan(
+            # a just-wrapped child ring was filled 0..L-1 in tick order, so
+            # index order IS chronological
+            self._fold_step, (acc0, jnp.asarray(0, jnp.int32)), (child, ccounts)
+        )
+        p = ((t // self._strides[l]) - 1) % self.levels[l]
+        parent, pcounts = self._level_leaves(l)
+        parent = {k: parent[k].at[p].set(acc[k]) for k in self._inner_names}
+        pcounts = pcounts.at[p].set(jnp.sum(ccounts))
+        cleared = {
+            k: jnp.broadcast_to(
+                self._inner_defaults[k][None], child[k].shape
+            ) + jnp.zeros_like(child[k])
+            for k in self._inner_names
+        }
+        return cleared, jnp.zeros_like(ccounts), parent, pcounts
+
+    def _maybe_cascade(self, t: Array, gate: Array) -> None:
+        """Run every due cascade. Gated: a fully-masked tick advances
+        nothing, so it must not cascade either — a re-run at the same
+        ``t`` would re-fold the just-cleared child over the parent."""
+        names = self._inner_names
+        for l in range(1, self.n_levels):
+            stride = self._strides[l]
+            if not isinstance(t, jax.core.Tracer) and not isinstance(
+                gate, jax.core.Tracer
+            ):
+                if bool(gate) and int(t) > 0 and int(t) % stride == 0:
+                    child, ccounts, parent, pcounts = self._cascade_leaves(l, t)
+                    self._install_level(l - 1, child, ccounts)
+                    self._install_level(l, parent, pcounts)
+                    telemetry.emit(
+                        "window", type(self).__name__, "cascade",
+                        level=l, buckets=self.levels[l - 1],
+                    )
+                continue
+            fire = jnp.logical_and(
+                jnp.logical_and(t > 0, t % stride == 0), gate
+            )
+
+            def fired(_: Any, _l: int = l) -> Tuple:
+                child, ccounts, parent, pcounts = self._cascade_leaves(_l, t)
+                return (
+                    tuple(child[k] for k in names), ccounts,
+                    tuple(parent[k] for k in names), pcounts,
+                )
+
+            def kept(_: Any, _l: int = l) -> Tuple:
+                child, ccounts = self._level_leaves(_l - 1)
+                parent, pcounts = self._level_leaves(_l)
+                return (
+                    tuple(child[k] for k in names), ccounts,
+                    tuple(parent[k] for k in names), pcounts,
+                )
+
+            child_t, ccounts, parent_t, pcounts = jax.lax.cond(fire, fired, kept, None)
+            self._install_level(l - 1, dict(zip(names, child_t)), ccounts)
+            self._install_level(l, dict(zip(names, parent_t)), pcounts)
+
+    # --------------------------------------------------------------- tick
+    def _tick(self, gate: Array, new_bucket_fn: Any) -> None:
+        t = self.ticks
+        self._maybe_cascade(t, gate)
+        p = t % self.levels[0]
+        buckets, counts = self._level_leaves(0)
+        bucket = {k: buckets[k][p] for k in self._inner_names}
+        new_bucket = new_bucket_fn(bucket)
+        live = gate.astype(jnp.int32)
+        for k in self._inner_names:
+            object.__setattr__(
+                self,
+                f"lvl0_{k}",
+                jnp.where(gate, buckets[k].at[p].set(new_bucket[k]), buckets[k]),
+            )
+        object.__setattr__(self, "lvl0_counts", counts.at[p].add(live))
+        self.ticks = t + live
+        if not isinstance(t, jax.core.Tracer):
+            telemetry.emit(
+                "window", type(self).__name__, "update",
+                levels=self.n_levels, tick=int(t),
+            )
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._tick(
+            jnp.asarray(True),
+            lambda bucket: self._inner.pure_update(bucket, *args, **kwargs),
+        )
+
+    def _masked_update(self, sample_mask: Array, *args: Any, **kwargs: Any) -> None:
+        self._tick(
+            jnp.any(sample_mask),
+            lambda bucket: self._inner._masked_pure_update(
+                bucket, sample_mask, *args, **kwargs
+            ),
+        )
+
+    # ------------------------------------------------------------- compute
+    def compute_level(self, level: int) -> Any:
+        """The inner value over level ``level``'s ring alone (0 = finest)."""
+        if not 0 <= level < self.n_levels:
+            raise MetricsUserError(
+                f"level must be in [0, {self.n_levels}), got {level}"
+            )
+        acc0 = {k: jnp.zeros_like(d) + d for k, d in self._inner_defaults.items()}
+        acc, _seen = self._fold_level_chrono(
+            level, (acc0, jnp.asarray(0, jnp.int32)), self.ticks
+        )
+        _emit_concrete(
+            self.ticks, "window", type(self).__name__, "compute",
+            level=level, buckets=self.levels[level],
+        )
+        return self._inner.pure_compute(acc)
+
+    def compute(self) -> Any:
+        """The inner value over the entire retained horizon: one left fold
+        across every level's ring, coarsest level first (chronological —
+        coarse buckets hold the oldest traffic)."""
+        acc = {k: jnp.zeros_like(d) + d for k, d in self._inner_defaults.items()}
+        carry = (acc, jnp.asarray(0, jnp.int32))
+        for l in reversed(range(self.n_levels)):
+            carry = self._fold_level_chrono(l, carry, self.ticks)
+        _emit_concrete(
+            self.ticks, "window", type(self).__name__, "compute",
+            levels=self.n_levels, buckets=sum(self.levels),
+        )
+        return self._inner.pure_compute(carry[0])
 
 
 class TumblingWindow(_StreamingWindow):

@@ -199,6 +199,11 @@ class WalRecord(NamedTuple):
     # pre-flight-recorder journals and non-UPDATE kinds. Replay reuses it so
     # a request keeps its identity across a crash.
     rid: int = 0
+    # wall-clock append time. Frames written before the ts header existed
+    # decode with ``ts=None``. Advisory ONLY: wall clocks skew and step
+    # (the ``clock-skew`` fault), so time-travel reads pick a ts *boundary*
+    # but always order and fence by ``seq``.
+    ts: Optional[float] = None
 
     @property
     def kind_name(self) -> str:
@@ -286,6 +291,11 @@ class WriteAheadLog:
         # fence can never truncate records the standby has not seen yet
         # (None = no consumer; truncate freely)
         self.retain_seq: Optional[int] = None
+        # history hold-back: with a checkpoint ladder retained (see
+        # serve.HistoryPolicy), the service pins this to the oldest retained
+        # rung's fence so no rung's replay tail is ever truncated out from
+        # under a time-travel read. Composes with retain_seq by min().
+        self.history_floor: Optional[int] = None
         self._active: Optional[Any] = None  # open file handle of the last segment
         self._active_path: Optional[str] = None
         self._fsync_us: deque = deque(maxlen=512)
@@ -304,10 +314,16 @@ class WriteAheadLog:
 
     # ------------------------------------------------------------------ scan
     def _segment_paths(self) -> List[str]:
-        names = sorted(
-            n for n in os.listdir(self.directory)
-            if n.startswith("wal-") and n.endswith(".seg")
-        )
+        try:
+            names = sorted(
+                n for n in os.listdir(self.directory)
+                if n.startswith("wal-") and n.endswith(".seg")
+            )
+        except FileNotFoundError:
+            # a ladder GC / offline scrub emptied the state volume out from
+            # under us; an empty journal is the honest answer (the next
+            # append re-creates the directory chain)
+            return []
         return [os.path.join(self.directory, n) for n in names]
 
     @staticmethod
@@ -465,6 +481,14 @@ class WriteAheadLog:
         header: Dict[str, Any] = {"session": session}
         if self.epoch:
             header["epoch"] = self.epoch
+        # wall-clock header (versioned: readers use header.get("ts")). The
+        # clock-skew fault steps the sampled clock backwards — appended ts
+        # values go non-monotonic exactly like a stepped NTP host, which is
+        # why every consumer must order by seq, never by ts.
+        ts = time.time()
+        if faults.should_fire("clock-skew"):
+            ts -= float(faults.fault_params("clock-skew").get("skew_s", 3600.0))
+        header["ts"] = round(ts, 6)
         if kind == UPDATE:
             args = _to_numpy(args)
             kwargs = _to_numpy(kwargs)
@@ -539,8 +563,17 @@ class WriteAheadLog:
         for seg in segments:
             if seg.last_seq <= after_seq:
                 continue
-            with open(seg.path, "rb") as f:
-                data = f.read()
+            try:
+                with open(seg.path, "rb") as f:
+                    data = f.read()
+            except FileNotFoundError:
+                # retired by a concurrent truncate (or a ladder GC) between
+                # the snapshot and the open. A fenced replay never needed
+                # its frames; an unfenced one must not leap the gap — stop
+                # at the discontinuity and return the contiguous prefix.
+                if frames:
+                    break
+                continue
             offset = 0
             while offset < len(data):
                 frame = self._parse_frame(data, offset, seg.path)
@@ -564,7 +597,7 @@ class WriteAheadLog:
                 args, kwargs = (), {}
             records.append(WalRecord(
                 seq, kind, str(header.get("session", "")), args, kwargs,
-                rid=int(header.get("rid", 0)),
+                rid=int(header.get("rid", 0)), ts=header.get("ts"),
             ))
         with self._lock:
             self._stats["replayed"] += len(records)
@@ -630,7 +663,7 @@ class WriteAheadLog:
                     args, kwargs = (), {}
                 out.append(WalRecord(
                     seq, kind, str(header.get("session", "")), args, kwargs,
-                    rid=int(header.get("rid", 0)),
+                    rid=int(header.get("rid", 0)), ts=header.get("ts"),
                 ))
             if gap:
                 break
@@ -652,11 +685,15 @@ class WriteAheadLog:
         the fabric pins it to the ship cursor after every ship), the
         effective fence is ``min(upto_seq, retain_seq)`` — a checkpoint
         can never delete records the standby has not streamed, so the
-        replication cursor never silently leaps truncated records."""
+        replication cursor never silently leaps truncated records.
+        :attr:`history_floor` (the oldest retained checkpoint-ladder
+        rung's fence) composes the same way, so every retained rung keeps
+        a contiguous replay tail for time-travel reads."""
         removed = 0
-        retain = self.retain_seq
-        if retain is not None:
-            upto_seq = min(int(upto_seq), int(retain))
+        upto_seq = int(upto_seq)
+        for floor in (self.retain_seq, self.history_floor):
+            if floor is not None:
+                upto_seq = min(upto_seq, int(floor))
         self.check_epoch()
         t0 = telemetry.clock()
         with self._lock:

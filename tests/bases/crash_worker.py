@@ -70,13 +70,16 @@ def main():
     import jax.numpy as jnp
 
     from metrics_tpu import Accuracy
-    from metrics_tpu.serve import MetricsService
+    from metrics_tpu.serve import HistoryPolicy, MetricsService
 
     svc = MetricsService(
         Accuracy(task="multiclass", num_classes=8),
         journal_dir=os.path.join(root, "wal"),
         checkpoint_dir=os.path.join(root, "ckpt"),
         checkpoint_every=2,
+        # keep-last-1 makes the ladder GC fire from the 2nd checkpoint on,
+        # so the mid-history-gc crash point lands mid-stream
+        history=HistoryPolicy(keep_last=1),
     )
     start_seq = 0
     if phase == "recover":

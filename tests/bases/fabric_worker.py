@@ -87,7 +87,7 @@ def main():
 
     from metrics_tpu import Accuracy, wal
     from metrics_tpu.fabric import HashRing
-    from metrics_tpu.serve import MetricsService
+    from metrics_tpu.serve import HistoryPolicy, MetricsService
 
     ring = HashRing(list(range(nshards)))
     journal_dir = os.path.join(root, f"shard-{shard:02d}", "wal")
@@ -101,6 +101,9 @@ def main():
         journal_dir=journal_dir,
         checkpoint_dir=os.path.join(root, f"shard-{shard:02d}", "ckpt"),
         checkpoint_every=2,
+        # ladder GC starts at this shard's 2nd checkpoint (keep-last-1), so
+        # the mid-history-gc point is reachable within the shorter slice
+        history=HistoryPolicy(keep_last=1),
         shard_id=shard,
         rid_offset=shard,
         rid_stride=nshards,

@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 31
+    assert len(skipped) == 32
     assert "detail_elapsed_s" in detail
 
 
@@ -422,6 +422,30 @@ def test_read_path_config_counts_and_keys():
     assert 0.0 < detail["read_memo_hit_rate_mixed"] < 1.0
     assert detail["fleet_read_collectives"] == 1
     assert detail["read_fleet_us_2shards"] > 0
+
+
+def test_time_travel_config_counts_and_keys():
+    """Pin the PITR bench config at test-budget scale. The structural
+    claims: a worst-case fold-tree range read on a full n=64 ring is
+    EXACTLY ceil(log2(64)) = 6 pure_merge calls off ONE cached table
+    build, and a ``compute_at`` anchored past the rung replays only the
+    post-checkpoint tail (10 records) where a full-journal rebuild of
+    the same instant replays all 40 — the wall-clock pair is recorded
+    for BASELINE.md / the sentinel bands; strictly-ordered timing
+    doesn't belong in CI."""
+    detail = {}
+    bench._cfg_time_travel(detail, ops=40, window=64, reps=2)
+    assert detail["tt_range_merges_worst_span"] == 6
+    assert detail["tt_range_merges_log2_bound"] == 6
+    assert detail["tt_range_tree_builds"] == 1
+    for span in (4, 16, 63):
+        assert detail[f"tt_range_read_us_span{span}"] > 0
+    assert detail["tt_time_travel_fence"] == 40
+    assert detail["tt_time_travel_replay_records"] == 10
+    assert detail["tt_full_replay_records"] == 40
+    assert detail["tt_time_travel_replay_records"] < detail["tt_full_replay_records"]
+    assert detail["tt_compute_at_us"] > 0 and detail["tt_full_replay_us"] > 0
+    assert detail["tt_compute_at_speedup"] > 0
 
 
 def test_cg_configs_record_host_pinning():

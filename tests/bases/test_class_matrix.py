@@ -40,6 +40,8 @@ _WRAPPED = {  # wrappers: construct around a simple base metric
         metrics_tpu.functional.scale_invariant_signal_noise_ratio, "max"
     ),
     "SlidingWindow": lambda cls: cls(metrics_tpu.MeanSquaredError(), window=4, slide=2),
+    "FoldTreeWindow": lambda cls: cls(metrics_tpu.MeanSquaredError(), window=4, slide=2),
+    "ResolutionLadder": lambda cls: cls(metrics_tpu.MeanSquaredError(), levels=(4, 3)),
     "TumblingWindow": lambda cls: cls(metrics_tpu.MeanSquaredError(), window=4),
     "ExponentialDecay": lambda cls: cls(metrics_tpu.MeanSquaredError(), halflife=8.0),
 }

@@ -38,6 +38,9 @@ _CRASH_NTH = {
     "mid-flush": 3,
     "mid-checkpoint": 2,
     "mid-truncate": 2,
+    # keep-last-1 ladder: the 2nd GC unlink happens inside the worker's
+    # 3rd periodic checkpoint — mid-stream, with a retained rung on disk
+    "mid-history-gc": 2,
 }
 
 
@@ -144,6 +147,9 @@ _FABRIC_CRASH_NTH = {
     "mid-flush": 2,
     "mid-checkpoint": 2,
     "mid-truncate": 2,
+    # the per-shard slice is shorter: the first ladder-GC unlink (the
+    # shard's 2nd checkpoint under keep-last-1) is the kill site
+    "mid-history-gc": 1,
 }
 
 

@@ -1,0 +1,572 @@
+"""Point-in-time recovery tier: checkpoint ladder, WAL time travel,
+fold-tree range reads, and the resolution ladder.
+
+The serving pins: ``compute_at(t)`` resolves a wall-clock instant to a
+sequence *fence* (clocks skew; replay is strictly by seq) and must be
+bit-identical to a dedicated-metric oracle fed the same seq prefix;
+ladder GC + manual truncation can NEVER orphan a retained rung's replay
+tail (``first_seq() <= fence + 1`` is invariant); scrub quarantines —
+never deletes — corrupt rungs and recovery falls back to the newest
+verified one. The windowed pins: any fold-tree bucket sub-range is
+bit-identical to the left-fold oracle in exactly O(log n) ``pure_merge``
+calls (structural counter), and the minute→hour→day resolution ladder
+stays bit-identical to a streamed twin across cascade boundaries.
+"""
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, PeakSignalNoiseRatio, faults, telemetry, wal
+from metrics_tpu.resilience import StateCorruptionError
+from metrics_tpu.serve import HistoryPolicy, MetricsService
+from metrics_tpu.streaming import FoldTreeWindow, ResolutionLadder
+from metrics_tpu.utilities.exceptions import MetricsUserError
+
+_C = 8
+_B = 8
+
+
+def _acc():
+    return Accuracy(task="multiclass", num_classes=_C)
+
+
+def _svc(tmp_path, **kwargs):
+    kwargs.setdefault("history", HistoryPolicy(keep_last=2))
+    return MetricsService(
+        _acc(),
+        journal_dir=str(tmp_path / "wal"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        **kwargs,
+    )
+
+
+def _batch(i):
+    rng = np.random.RandomState(7000 + i)
+    return (
+        jnp.asarray(rng.randint(0, _C, _B)),
+        jnp.asarray(rng.randint(0, _C, _B)),
+    )
+
+
+def _oracle(ops):
+    """Dedicated per-session metrics fed an op prefix — the ground truth
+    any seq-fenced replay must hit bit-for-bit."""
+    refs = {}
+    for name, preds, target in ops:
+        refs.setdefault(name, _acc()).update(preds, target)
+    return {k: np.asarray(v.compute()) for k, v in refs.items()}
+
+
+def _run_stream(svc, n_ops, sessions=3, flush_every=4):
+    """Deterministic update-only stream; op i journals as seq i + 1.
+    Returns the op list (the oracle's input)."""
+    ops = []
+    for i in range(n_ops):
+        name = f"s{i % sessions}"
+        preds, target = _batch(i)
+        svc.submit(name, preds, target)
+        ops.append((name, preds, target))
+        if (i + 1) % flush_every == 0:
+            svc.flush()
+    svc.drain()
+    return ops
+
+
+# ----------------------------------------------------------- WAL regression
+def test_wal_stats_percentiles_survive_empty_sample(tmp_path):
+    """Regression: ``stats()`` on a journal that has never fsynced must
+    report zeroed percentiles instead of indexing an empty sample."""
+    log = wal.WriteAheadLog(str(tmp_path / "wal"), owner="test")
+    stats = log.stats()
+    assert stats["fsyncs"] == 0
+    assert stats["fsync_us_p50"] == 0.0 and stats["fsync_us_p95"] == 0.0
+
+
+def test_wal_reads_survive_externally_cleaned_directory(tmp_path):
+    """Regression: a retention job (or over-eager GC) removing segment
+    files out from under an open journal must degrade reads to the empty
+    tail, not raise FileNotFoundError."""
+    log = wal.WriteAheadLog(str(tmp_path / "wal"), owner="test")
+    for i in range(3):
+        log.append(wal.UPDATE, "s0", (np.zeros(4, np.float32) + i,))
+    for name in os.listdir(str(tmp_path / "wal")):
+        if name.endswith(".seg"):
+            os.remove(str(tmp_path / "wal" / name))
+    assert log.read_tail(0) == []
+    assert log.first_seq() >= 1  # no crash; floor still well-defined
+    # a fresh open of the gutted directory resumes cleanly too
+    log.close()
+    log2 = wal.WriteAheadLog(str(tmp_path / "wal"), owner="test")
+    assert log2.read_tail(0) == [] and log2.first_seq() == log2.last_seq + 1
+
+
+def test_wal_records_carry_wall_clock_ts(tmp_path):
+    log = wal.WriteAheadLog(str(tmp_path / "wal"), owner="test")
+    t0 = time.time()
+    log.append(wal.UPDATE, "s0", (np.zeros(2, np.float32),))
+    rec = log.read_tail(0)[0]
+    assert rec.ts is not None and t0 - 1.0 <= rec.ts <= time.time() + 1.0
+
+
+# ------------------------------------------------------------------- ladder
+def test_ladder_retains_rungs_and_pins_journal_floor(tmp_path):
+    svc = _svc(tmp_path, history=HistoryPolicy(keep_last=2))
+    try:
+        for k in range(4):
+            _run_stream(svc, 6)
+            svc.checkpoint()
+        rungs = svc._ladder_rungs()
+        assert len(rungs) == 2  # keep-last-2 retention held
+        oldest_fence = rungs[0][0]
+        # the PITR invariant: every retained rung keeps its replay tail
+        assert svc.journal.first_seq() <= oldest_fence + 1
+        assert svc.journal.history_floor == oldest_fence
+        assert svc.stats["history_rungs_gcd"] == 2
+    finally:
+        svc.shutdown()
+
+
+def test_manual_truncation_clamped_by_history_floor(tmp_path):
+    svc = _svc(tmp_path, history=HistoryPolicy(keep_last=2))
+    try:
+        _run_stream(svc, 8)
+        svc.checkpoint()
+        _run_stream(svc, 8)
+        svc.checkpoint()
+        oldest_fence = svc._ladder_rungs()[0][0]
+        # an operator (or retention job) trying to retire everything is
+        # clamped at the ladder's floor — rung tails are never orphaned
+        svc.journal.truncate(svc.journal.last_seq)
+        assert svc.journal.first_seq() <= oldest_fence + 1
+    finally:
+        svc.shutdown()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_ladder_gc_interleaving_property(tmp_path, seed):
+    """Property pin: after ANY interleaving of updates, checkpoints (each
+    runs retention GC), and aggressive manual truncations, every retained
+    rung still satisfies ``first_seq() <= fence + 1``, and ``service_at``
+    anchored at the OLDEST rung is bit-identical to the oracle."""
+    rng = np.random.RandomState(seed)
+    svc = _svc(
+        tmp_path / f"s{seed}",
+        history=HistoryPolicy(keep_last=2, keep_per_interval_s=3600.0),
+    )
+    ops = []
+    try:
+        for step in range(60):
+            roll = rng.rand()
+            if roll < 0.70 or not ops:
+                name = f"s{rng.randint(3)}"
+                preds, target = _batch(1000 * seed + step)
+                svc.submit(name, preds, target)
+                ops.append((name, preds, target))
+                if rng.rand() < 0.3:
+                    svc.flush()
+            elif roll < 0.90:
+                svc.checkpoint()
+            else:
+                svc.journal.truncate(svc.journal.last_seq)
+            for fence, _ in svc._ladder_rungs():
+                assert svc.journal.first_seq() <= fence + 1, (
+                    f"step {step}: rung {fence} lost its replay tail "
+                    f"(first_seq={svc.journal.first_seq()})"
+                )
+        svc.drain()
+        rungs = svc._ladder_rungs()
+        assert rungs, "the interleaving produced no retained rungs"
+        oldest_fence, oldest_path = rungs[0]
+        t = float(svc._rung_meta(oldest_path)["ts"])
+        scratch, fence = svc.service_at(t)
+        try:
+            assert fence >= oldest_fence
+            got = {k: np.asarray(v) for k, v in scratch.compute_all().items()}
+        finally:
+            scratch.shutdown()
+        want = _oracle(ops[:fence])
+        assert sorted(got) == sorted(want)
+        for name in want:
+            np.testing.assert_array_equal(got[name], want[name])
+    finally:
+        svc.shutdown()
+
+
+# ------------------------------------------------------------- compute_at
+def test_compute_at_matches_seq_prefix_oracle(tmp_path):
+    """Every journaled instant is reconstructable: for a spread of
+    boundaries t, ``service_at(t)`` equals a dedicated-metric oracle fed
+    exactly the records the fence admits — bit for bit."""
+    svc = _svc(tmp_path)
+    try:
+        ops = _run_stream(svc, 12)
+        svc.checkpoint()
+        ops += _run_stream(svc, 8)
+        svc.drain()
+        # the checkpoint truncated the retained tail up to the ladder
+        # floor; boundaries below it resolve through the rung itself
+        records = svc.journal.read_tail(0)
+        base = svc.journal.first_seq() - 1
+        assert base >= 1  # truncation really happened
+        for k in (0, 2, len(records) // 2, len(records) - 1):
+            t = records[k].ts
+            expect_fence = max(
+                [base] + [r.seq for r in records if r.ts is not None and r.ts <= t]
+            )
+            scratch, fence = svc.service_at(t)
+            try:
+                assert fence == expect_fence
+                got = {k2: np.asarray(v) for k2, v in scratch.compute_all().items()}
+            finally:
+                scratch.shutdown()
+            want = _oracle(ops[:fence])
+            assert sorted(got) == sorted(want)
+            for name in want:
+                np.testing.assert_array_equal(got[name], want[name])
+    finally:
+        svc.shutdown()
+
+
+def test_compute_at_before_history_and_digest_identity(tmp_path):
+    """t before the first record resolves to the empty service; a twin
+    service stopped at the same fence produces the identical state digest
+    (the crash-matrix bit-identity claim, in-process)."""
+    svc = _svc(tmp_path)
+    twin = MetricsService(_acc())
+    try:
+        ops = _run_stream(svc, 10)
+        assert svc.compute_at(0.0) == {}  # epoch 0: nothing had happened yet
+        t = svc.journal.read_tail(0)[-1].ts
+        scratch, fence = svc.service_at(t)
+        try:
+            assert fence == 10
+            for name, preds, target in ops[:fence]:
+                twin.submit(name, preds, target)
+            twin.drain()
+            assert scratch.state_digest() == twin.state_digest()
+        finally:
+            scratch.shutdown()
+    finally:
+        twin.shutdown()
+        svc.shutdown()
+
+
+def test_compute_at_emits_time_travel_span_and_counter(tmp_path):
+    telemetry.reset_counters()
+    svc = _svc(tmp_path)
+    try:
+        _run_stream(svc, 6)
+        t = svc.journal.read_tail(0)[-1].ts
+        with telemetry.instrument() as tr:
+            svc.compute_at(t)
+        spans = tr.spans(name="read", kind="time-travel")
+        assert len(spans) == 1 and spans[0].attrs["fence"] == 6
+        assert svc.stats["time_travel_reads"] == 1
+        assert telemetry.snapshot()["read:time-travel"] == 1
+    finally:
+        svc.shutdown()
+
+
+def test_compute_range_replays_ts_window(tmp_path):
+    svc = _svc(tmp_path)
+    try:
+        ops = _run_stream(svc, 12)
+        records = svc.journal.read_tail(0)
+        t1, t2 = records[3].ts, records[8].ts
+        picked = [r.seq for r in records if t1 < r.ts <= t2]
+        got = svc.compute_range(t1, t2)
+        want = _oracle([ops[s - 1] for s in picked])
+        assert sorted(got) == sorted(want)
+        for name in want:
+            np.testing.assert_array_equal(np.asarray(got[name]), want[name])
+        with pytest.raises(ValueError):
+            svc.compute_range(t2, t1)
+    finally:
+        svc.shutdown()
+
+
+def test_clock_skew_fault_cannot_reorder_time_travel(tmp_path):
+    """The clock-skew pin: a record whose wall clock stepped backwards
+    (NTP slew, dual-clock host) still replays with its seq prefix — the
+    boundary picks a FENCE and replay is strictly by seq, so a skewed ts
+    an hour in the past cannot eject the record from later boundaries."""
+    svc = _svc(tmp_path)
+    try:
+        ops = _run_stream(svc, 4, flush_every=2)
+        with faults.inject("clock-skew", count=1, skew_s=3600.0):
+            name, (preds, target) = "s0", _batch(99)
+            svc.submit(name, preds, target)
+            ops.append((name, preds, target))
+            svc.flush()
+        time.sleep(0.002)  # keep post-skew appends strictly later in ts
+        ops += _run_stream(svc, 3, flush_every=2)
+        records = svc.journal.read_tail(0)
+        assert records[4].ts < records[3].ts - 3000  # the skew really landed
+        # boundary at the LAST pre-skew record's ts: the skewed record has
+        # an earlier ts, so seq-max boundary resolution must include it
+        t = records[3].ts
+        scratch, fence = svc.service_at(t)
+        try:
+            assert fence == 5
+            got = {k: np.asarray(v) for k, v in scratch.compute_all().items()}
+        finally:
+            scratch.shutdown()
+        want = _oracle(ops[:5])
+        for name in want:
+            np.testing.assert_array_equal(got[name], want[name])
+    finally:
+        svc.shutdown()
+
+
+# ------------------------------------------------------------------- scrub
+def test_history_corruption_scrub_quarantines_and_reads_fall_back(tmp_path):
+    """The at-rest bit-rot drill: corrupt a retained rung via the
+    ``history-corruption`` fault; reads degrade (cause-tagged span) but
+    stay CORRECT by falling back to an older rung's longer replay tail;
+    scrub quarantines the rung — renamed, never deleted."""
+    telemetry.reset_counters()
+    svc = _svc(tmp_path, history=HistoryPolicy(keep_last=3))
+    try:
+        ops = _run_stream(svc, 6)
+        svc.checkpoint()  # clean rung
+        ops += _run_stream(svc, 6)
+        with faults.inject("history-corruption", count=1):
+            svc.checkpoint()  # this rung lands corrupted
+        rungs = svc._ladder_rungs()
+        assert len(rungs) == 2
+        bad_fence, bad_path = rungs[-1]
+
+        # read path: newest rung fails verification -> degrade span, fall
+        # back to the older rung, value still bit-identical to the oracle
+        t = svc.journal.read_tail(0)[-1].ts
+        with telemetry.instrument() as tr:
+            got = svc.compute_at(t)
+        assert tr.spans(name="degrade", kind="history")
+        want = _oracle(ops)
+        for name in want:
+            np.testing.assert_array_equal(np.asarray(got[name]), want[name])
+        assert os.path.exists(bad_path)  # reads never mutate the ladder
+
+        report = svc.scrub()
+        assert report["quarantined"] == [bad_path]
+        # the live head checkpoint carries the same fence and is intact,
+        # so it stays the newest verified recovery source
+        assert report["newest_verified"] == bad_fence
+        assert not os.path.exists(bad_path)
+        assert os.path.exists(bad_path + ".quarantine")  # evidence retained
+        assert svc.stats["quarantined_rungs"] == 1
+        # second pass: the ladder is clean again
+        report2 = svc.scrub()
+        assert report2["quarantined"] == [] and rungs[0][0] in report2["verified"]
+    finally:
+        svc.shutdown()
+
+
+def test_recover_falls_back_to_newest_verified_rung(tmp_path):
+    """Corrupt the HEAD checkpoint on disk: a fresh process must
+    quarantine it, restore the newest verified rung, replay the fenced
+    tail, and land bit-identical to the uncrashed twin."""
+    svc = _svc(tmp_path)
+    ops = _run_stream(svc, 8)
+    svc.checkpoint()
+    ops += _run_stream(svc, 5)
+    svc.shutdown()
+    heads = [
+        os.path.join(str(tmp_path / "ckpt"), n)
+        for n in os.listdir(str(tmp_path / "ckpt"))
+        if ".rung-" not in n and n.endswith(".npz")
+    ]
+    assert len(heads) == 1
+    # rot the head's bytes INDEPENDENTLY of the rung (the retention hard
+    # link shares the inode; a rewrite models media rot on one file)
+    with open(heads[0], "rb") as f:
+        blob = f.read()
+    os.remove(heads[0])
+    with open(heads[0], "wb") as f:
+        f.write(blob)
+    MetricsService._corrupt_rung_file(heads[0])
+
+    svc2 = _svc(tmp_path)
+    try:
+        with telemetry.instrument() as tr:
+            assert svc2.recover()
+        assert tr.spans(name="degrade", kind="history")
+        assert svc2.stats["quarantined_rungs"] == 1
+        assert os.path.exists(heads[0] + ".quarantine")
+        got = {k: np.asarray(v) for k, v in svc2.compute_all().items()}
+        want = _oracle(ops)
+        for name in want:
+            np.testing.assert_array_equal(got[name], want[name])
+    finally:
+        svc2.shutdown()
+
+
+def test_offline_wal_scrub_tool_matrix(tmp_path):
+    """The offline scrubber (``tools/wal_scrub.py``) agrees with the
+    online one and reports via exit status: 0 clean, 1 quarantined."""
+    from tools import wal_scrub
+
+    svc = _svc(tmp_path)
+    _run_stream(svc, 6)
+    svc.checkpoint()
+    _run_stream(svc, 6)
+    svc.checkpoint()
+    rungs = svc._ladder_rungs()
+    svc.shutdown()
+    ckpt, journal = str(tmp_path / "ckpt"), str(tmp_path / "wal")
+
+    assert wal_scrub.main(["--checkpoint-dir", ckpt, "--journal-dir", journal]) == 0
+    MetricsService._corrupt_rung_file(rungs[0][1])
+    # dry run reports without renaming; the real pass quarantines
+    assert wal_scrub.main(
+        ["--checkpoint-dir", ckpt, "--journal-dir", journal, "--dry-run"]
+    ) == 1
+    assert os.path.exists(rungs[0][1])
+    assert wal_scrub.main(["--checkpoint-dir", ckpt, "--journal-dir", journal]) == 1
+    assert os.path.exists(rungs[0][1] + ".quarantine")
+    assert wal_scrub.main(["--checkpoint-dir", ckpt, "--journal-dir", journal]) == 0
+    assert wal_scrub.main(["--checkpoint-dir", str(tmp_path / "nope")]) == 2
+
+
+# -------------------------------------------------------------- fold tree
+def _fold_tree(n=8):
+    return FoldTreeWindow(_acc(), window=n, slide=1, jit_update=False)
+
+
+def test_fold_tree_range_matches_left_fold_oracle():
+    """Any bucket sub-range is bit-identical to a dedicated metric fed
+    the same ticks — the fold tree is an access path, not a semantics
+    change (exact because the merge algebra is associative on int sums)."""
+    n = 8
+    w = _fold_tree(n)
+    ticks = [_batch(200 + i) for i in range(n + 3)]  # ring wraps
+    for preds, target in ticks:
+        w.update(preds, target)
+    for lo, hi in [(0, n), (0, 7), (1, 4), (3, 8), (5, 6), (2, 7)]:
+        got = np.asarray(w.compute_range(lo, hi))
+        ref = _acc()
+        for preds, target in ticks[len(ticks) - n + lo : len(ticks) - n + hi]:
+            ref.update(preds, target)
+        np.testing.assert_array_equal(got, np.asarray(ref.compute()))
+
+
+def test_fold_tree_range_is_log_n_merges():
+    """The O(log n) structural pin: the worst-case span on a full ring of
+    n=8 costs exactly ceil(log2(8)) = 3 ``pure_merge`` calls — counted,
+    not timed — and the full ring folds in ONE node hit."""
+    n = 8
+    w = _fold_tree(n)
+    for i in range(n):
+        w.update(*_batch(300 + i))
+    with telemetry.instrument() as tr:
+        w.compute_range(0, 7)
+    assert w.range_merge_count == 3  # 4 + 2 + 1: the greedy decomposition
+    spans = tr.spans(name="read", kind="window-range")
+    assert len(spans) == 1 and spans[0].attrs["merges"] == 3
+    w.compute_range(0, n)
+    assert w.range_merge_count == 1  # the root node covers the full ring
+    w.compute_range(3, 4)
+    assert w.range_merge_count == 1
+
+
+def test_fold_tree_cache_invalidation_and_bounds():
+    w = _fold_tree(4)
+    for i in range(4):
+        w.update(*_batch(400 + i))
+    w.compute_range(0, 4)
+    w.compute_range(1, 3)
+    assert w.tree_builds == 1  # second read reuses the table
+    w.update(*_batch(450))
+    w.compute_range(0, 4)
+    assert w.tree_builds == 2  # any tick drops the cache
+    with pytest.raises(MetricsUserError):
+        w.compute_range(2, 2)
+    with pytest.raises(MetricsUserError):
+        w.compute_range(0, 5)
+
+
+def test_fold_tree_rejects_non_associative_reductions():
+    """A running-mean state would change value under re-association; the
+    wrapper must refuse it outright instead of folding wrong answers."""
+    with pytest.raises(MetricsUserError, match="running-mean"):
+        FoldTreeWindow(PeakSignalNoiseRatio(data_range=8.0), window=4)
+
+
+# ------------------------------------------------------- resolution ladder
+def test_resolution_ladder_bitwise_vs_streamed_oracle():
+    """minute->hour cascades are pure refolds of the same associative
+    algebra: compute() over the whole horizon stays bit-identical to one
+    dedicated metric streamed every tick, across cascade boundaries."""
+    w = ResolutionLadder(_acc(), levels=(4, 3), jit_update=True)
+    ref = _acc()
+    for i in range(11):  # crosses two lvl0->lvl1 cascades (t=4, t=8)
+        preds, target = _batch(500 + i)
+        w.update(preds, target)
+        ref.update(preds, target)
+        np.testing.assert_array_equal(
+            np.asarray(w.compute()), np.asarray(ref.compute())
+        )
+    assert int(w.ticks) == 11
+
+
+def test_resolution_ladder_per_level_reads():
+    w = ResolutionLadder(_acc(), levels=(4, 3), jit_update=False)
+    ticks = [_batch(600 + i) for i in range(11)]
+    for preds, target in ticks:
+        w.update(preds, target)
+    # after 11 ticks: lvl1 holds folds of ticks [0,4) and [4,8); lvl0
+    # holds the unfolded ticks 8..10
+    ref_coarse, ref_fine = _acc(), _acc()
+    for preds, target in ticks[:8]:
+        ref_coarse.update(preds, target)
+    for preds, target in ticks[8:]:
+        ref_fine.update(preds, target)
+    np.testing.assert_array_equal(
+        np.asarray(w.compute_level(1)), np.asarray(ref_coarse.compute())
+    )
+    np.testing.assert_array_equal(
+        np.asarray(w.compute_level(0)), np.asarray(ref_fine.compute())
+    )
+    with pytest.raises(MetricsUserError):
+        w.compute_level(2)
+
+
+def test_resolution_ladder_masked_noop_does_not_cascade():
+    """A fully-masked tick is a no-op END TO END: the clock must not
+    advance and no cascade may fire (a gated-off cascade would refold a
+    cleared ring over the parent bucket)."""
+    w = ResolutionLadder(_acc(), levels=(2, 2), jit_update=False)
+    for i in range(4):
+        w.update(*_batch(700 + i))
+    before = np.asarray(w.compute())
+    preds, target = _batch(750)
+    w._masked_update(jnp.zeros(_B, dtype=bool), preds, target)
+    assert int(w.ticks) == 4
+    np.testing.assert_array_equal(np.asarray(w.compute()), before)
+
+
+def test_resolution_ladder_jit_parity():
+    eager = ResolutionLadder(_acc(), levels=(3, 2), jit_update=False)
+    jitted = ResolutionLadder(_acc(), levels=(3, 2), jit_update=True)
+    for i in range(8):
+        preds, target = _batch(800 + i)
+        eager.update(preds, target)
+        jitted.update(preds, target)
+    np.testing.assert_array_equal(
+        np.asarray(eager.compute()), np.asarray(jitted.compute())
+    )
+    for lvl in (0, 1):
+        np.testing.assert_array_equal(
+            np.asarray(eager.compute_level(lvl)),
+            np.asarray(jitted.compute_level(lvl)),
+        )
+
+
+def test_resolution_ladder_validates_levels():
+    with pytest.raises(MetricsUserError):
+        ResolutionLadder(_acc(), levels=())
+    with pytest.raises(MetricsUserError):
+        ResolutionLadder(_acc(), levels=(4, 1))

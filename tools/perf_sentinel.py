@@ -198,6 +198,23 @@ SCHEDULE: Tuple[Tuple[str, str, Dict[str, Any], Tuple[str, ...], Tuple[str, ...]
         ),
         ("read_all_memoized_us", "read_fleet_us_2shards"),
     ),
+    (
+        "time_travel",
+        "_cfg_time_travel",
+        {"ops": 40, "window": 64, "reps": 2},
+        (
+            # all structural: the greedy sparse-table decomposition fixes
+            # the merge count at ceil(log2(n)); the op stream fixes the
+            # boundary fence and the ladder-vs-full replay record pair
+            "tt_range_merges_worst_span",
+            "tt_range_merges_log2_bound",
+            "tt_range_tree_builds",
+            "tt_time_travel_fence",
+            "tt_time_travel_replay_records",
+            "tt_full_replay_records",
+        ),
+        ("tt_compute_at_us", "tt_full_replay_us", "tt_range_read_us_span63"),
+    ),
 )
 
 # Per-key noise-band overrides. The default wall-clock band is generous
