@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (test / doctest / clean)
-.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load kernels quant shard timetravel doctest audit sentinel bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
+.PHONY: test test-fast parity chaos chaos-fabric chaos-elastic crash load kernels quant shard timetravel cost doctest audit sentinel bench bench-forward serve-bench stream-bench read-bench trace slo tpu-smoke tpu-capture clean
 
 test:
 	python -m pytest tests/ -q
@@ -74,6 +74,7 @@ chaos:
 	$(MAKE) quant
 	$(MAKE) shard
 	$(MAKE) timetravel
+	$(MAKE) cost
 	$(MAKE) sentinel
 
 # kernel-registry lane (docs/kernels.md): interpret-mode bitwise parity of
@@ -107,6 +108,14 @@ shard:
 timetravel:
 	python -m pytest tests/bases/test_time_travel.py -q
 	python -c "import json, bench; d = {}; bench._cfg_time_travel(d, ops=40, window=64, reps=2); print(json.dumps(d, indent=2))"
+
+# dollar-attribution lane (docs/observability.md "Cost attribution"):
+# apportionment exactness + the 1k-submit conservation acceptance +
+# budget trip/recover lifecycle + kill-switch/scrubber/fleet coverage,
+# then the billing overhead + conservation pins at sentinel scale
+cost:
+	python -m pytest tests/bases/test_billing.py -q
+	python -c "import json, bench; d = {}; bench._cfg_cost_attribution(d, sessions=16, reps=2, loops=3); print(json.dumps(d, indent=2))"
 
 # kill-and-recover loop: for EVERY registered crash point a subprocess is
 # SIGKILLed at that instruction, then a fresh process recover()s

@@ -806,6 +806,100 @@ def _cfg_request_tracing(detail: dict, sessions: int = 64, reps: int = 3, loops:
     )
 
 
+def _cfg_cost_attribution(detail: dict, sessions: int = 32, reps: int = 2, loops: int = 3) -> None:
+    """Dollar attribution on the serving path: idle overhead + conservation.
+
+    Billing (:mod:`metrics_tpu.analysis.billing`) prices every stacked
+    launch from the roofline cost registry and apportions the integer
+    microdollars back across member rids by masked-row count. Its two
+    claims: the accounting is EXACT (Σ request shares == Σ launch costs,
+    no float drift — the conservation pin), and it costs ~nothing on the
+    idle submit path. This config times the warm submit+flush loop with
+    billing killed (``METRICS_TPU_BILLING=0``) vs on (telemetry idle in
+    both — the ratio isolates billing's own overhead), then replays an
+    instrumented pass with mixed-size batches (coalescing plus uneven
+    apportionment) and pins conservation, the costed-launch fraction,
+    rate-table resolution, and microdollars per launch (== 1.0 on CPU:
+    the quantization floor that keeps the pin non-vacuous). The
+    kill-switch pass also asserts no span carries a cost attr — billing
+    off restores the pre-billing spans byte-for-byte."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, telemetry
+    from metrics_tpu.analysis import billing
+    from metrics_tpu.serve import MetricsService
+
+    rng = np.random.RandomState(37)
+    C = 8
+    svc = MetricsService(Accuracy(task="multiclass", num_classes=C))
+    # ragged batch sizes inside one pow2 bucket: the largest-remainder
+    # apportionment sees genuinely uneven weights, and same-tenant
+    # duplicates coalesce (every submit still retires individually)
+    batches = [
+        (jnp.asarray(rng.randint(0, C, 33 + i)), jnp.asarray(rng.randint(0, C, 33 + i)))
+        for i in range(sessions)
+    ]
+
+    def step():
+        for i, (p, tg) in enumerate(batches):
+            svc.submit(f"tenant-{i % max(1, sessions // 2)}", p, tg)
+        svc.flush()
+
+    step()
+    svc.drain()  # compile the stacked programs before timing
+
+    def timed():
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(loops):
+                step()
+            svc.drain()
+            best = min(best, (time.perf_counter() - t0) / (loops * sessions) * 1e6)
+        return round(best, 2)
+
+    prev = os.environ.get("METRICS_TPU_BILLING")
+    os.environ["METRICS_TPU_BILLING"] = "0"
+    try:
+        detail["cost_off_submit_us"] = timed()
+        # kill-switch contract: a billing-off instrumented pass must show
+        # spans bit-identical to the pre-billing taxonomy (no cost attrs)
+        with telemetry.instrument() as dark:
+            step()
+            svc.drain()
+        leaked = sum(
+            1 for e in dark.events
+            if "cost_microusd" in (e.attrs or {}) or "cost_usd" in (e.attrs or {})
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("METRICS_TPU_BILLING", None)
+        else:
+            os.environ["METRICS_TPU_BILLING"] = prev
+
+    detail["cost_on_submit_us"] = timed()
+    detail["cost_idle_overhead_ratio"] = round(
+        detail["cost_on_submit_us"] / max(detail["cost_off_submit_us"], 1e-9), 3
+    )
+    detail["cost_kill_switch_leaked_attrs"] = leaked
+
+    with telemetry.instrument() as session:
+        step()
+        svc.drain()
+    launch_spans = [
+        e for e in session.events if e.name == "update" and e.kind == "stacked-aot"
+    ]
+    request_spans = [e for e in session.events if e.name == "request"]
+    launch_micro = sum(int((e.attrs or {}).get("cost_microusd", 0)) for e in launch_spans)
+    request_micro = sum(int((e.attrs or {}).get("cost_microusd", 0)) for e in request_spans)
+    costed = sum(1 for e in launch_spans if "cost_microusd" in (e.attrs or {}))
+    detail["cost_conservation_exact"] = 1.0 if launch_micro == request_micro else 0.0
+    detail["cost_launch_spans_costed"] = round(costed / max(len(launch_spans), 1), 3)
+    detail["cost_rate_resolved"] = 1.0 if billing.device_rate()[1] > 0 else 0.0
+    detail["cost_microusd_per_launch"] = round(launch_micro / max(len(launch_spans), 1), 3)
+    svc.shutdown()
+
+
 def _cfg_fabric(
     detail: dict,
     sessions: int = 128,
@@ -2164,6 +2258,7 @@ def _bench_detail() -> dict:
         ("window_advance_us", _cfg_streaming),
         ("kernel_vs_lax_us", _cfg_kernels),
         ("request_tracing_idle_overhead_ratio", _cfg_request_tracing),
+        ("cost_idle_overhead_ratio", _cfg_cost_attribution),
         ("fabric_updates_per_sec", _cfg_fabric),
         ("read_path_second_read_launches", _cfg_read_path),
         ("time_travel_compute_at_us", _cfg_time_travel),
@@ -2390,6 +2485,7 @@ def _bench_detail_fast() -> dict:
         ("serving", _cfg_serving),
         ("crash_recovery", lambda d: _cfg_crash_recovery(d, sessions=32, steps=2, tail=200)),
         ("request_tracing", lambda d: _cfg_request_tracing(d, sessions=32, reps=2, loops=3)),
+        ("cost_attribution", lambda d: _cfg_cost_attribution(d, sessions=16, reps=2, loops=3)),
         ("fabric", lambda d: _cfg_fabric(d, sessions=32, events=300, shards=2)),
         ("read_path", lambda d: _cfg_read_path(d, sessions=16, reps=5)),
         ("time_travel", lambda d: _cfg_time_travel(d, ops=40, window=64, reps=2)),

@@ -25,6 +25,11 @@ a per-executable registry of XLA's ``cost_analysis`` /
 launch spans derive achieved GFLOP/s / GB/s and a roofline regime
 (``tools/perf_sentinel.py``, ``make sentinel``, rides it the way
 ``static_audit`` rides the jaxpr front).
+:mod:`~metrics_tpu.analysis.billing` prices that registry in dollars —
+a ``DEVICE_RATES`` $/hr table over the roofline occupancy model, with
+integer-microdollar accounting and the largest-remainder apportionment
+the serving path uses for exact per-request cost conservation
+(``docs/observability.md`` "Cost attribution").
 
 This ``__init__`` stays import-light (lazy submodules): the hot path
 imports ``analysis.hazards`` at module load, and the heavy fronts import
@@ -32,7 +37,7 @@ imports ``analysis.hazards`` at module load, and the heavy fronts import
 """
 import importlib
 
-_SUBMODULES = ("ast_lint", "cost_model", "hazards", "jaxpr_audit", "registry", "report")
+_SUBMODULES = ("ast_lint", "billing", "cost_model", "hazards", "jaxpr_audit", "registry", "report")
 
 __all__ = list(_SUBMODULES)
 

@@ -1,0 +1,224 @@
+"""Dollar attribution for the serving path: $/hr rates over the roofline model.
+
+The cost model (:mod:`metrics_tpu.analysis.cost_model`) already knows, for
+every compiled executable, the model flops and HBM bytes XLA charges one
+launch. This module turns those structural numbers into **money**:
+
+* :data:`DEVICE_RATES` maps device-kind substrings to an on-demand $/hr
+  rate, keyed exactly like ``DEVICE_PEAKS`` (plus a ``cpu`` host row so
+  the accounting stays structural — and the conservation pins
+  non-vacuous — on CPU-only hosts).
+* :func:`modeled_device_seconds` is the roofline occupancy estimate for
+  one launch: ``max(flops / peak_flops, bytes / peak_bandwidth)`` —
+  whichever wall binds is how long the chip is busy.
+* :func:`cost_microusd` quantizes that to **integer microdollars**
+  (``seconds * rate / 3600 * 1e6``). All internal accounting is integer
+  microdollars; floats appear only at render time (:func:`usd`). A launch
+  that did modeled work never rounds to free — the ``max(1, ...)`` floor
+  keeps CPU-scale conservation pins structural instead of 0 == 0.
+* :func:`apportion` splits one launch's microdollars across the member
+  requests of a coalesced stack by masked-row count, with a
+  largest-remainder scheme so the per-rid shares sum to the launch cost
+  **exactly** (the conservation pin is bitwise, not approximate).
+
+Rates are *nominal on-demand list prices*, not a quote: the point is a
+stable, documented denominator for $/M-updates comparisons across
+configs and tenants (the arxiv 2605.25645 methodology), not cloud-bill
+precision. Override or extend :data:`DEVICE_RATES` before the first
+:func:`device_rate` call (or pass ``refresh=True``) to re-key.
+
+``METRICS_TPU_BILLING=0`` is the kill switch: :func:`billing_enabled`
+gates every span attribute and snapshot section this module feeds, so
+disabling it restores the pre-billing telemetry byte-for-byte.
+"""
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import cost_model
+
+__all__ = [
+    "DEVICE_RATES",
+    "CPU_HOST_PEAKS",
+    "billing_enabled",
+    "device_rate",
+    "modeled_device_seconds",
+    "cost_microusd",
+    "apportion",
+    "usd",
+    "launch_cost_attrs",
+    "rate_snapshot",
+    "reset",
+]
+
+# device_kind / platform substring -> nominal on-demand $/hr. Keyed like
+# DEVICE_PEAKS (longest-substring-first against device_kind, then the
+# platform string as a fallback, then the "cpu" host row). Values are
+# published list-price ballparks — a stable denominator, not a quote.
+DEVICE_RATES: Dict[str, float] = {
+    "TPU v2": 4.50,
+    "TPU v3": 8.00,
+    "TPU v4": 3.22,
+    "TPU v5 lite": 1.20,
+    "TPU v5e": 1.20,
+    "TPU v5p": 4.20,
+    "TPU v6e": 2.70,
+    "H100": 6.98,
+    "cuda": 4.00,
+    "rocm": 4.00,
+    # CPU-host row: the serving host itself costs money, and pricing it
+    # keeps every dollar pin structural (non-zero, deterministic) on the
+    # CPU-only CI hosts where the conservation tests run.
+    "cpu": 0.20,
+}
+
+# Nominal host-CPU peaks (GFLOP/s, GB/s) used for modeled seconds when
+# cost_model.device_peaks() has no absolute entry (the relative basis).
+# Same spirit as NOMINAL_RIDGE: a fixed denominator so the same HLO
+# models the same seconds on every host.
+CPU_HOST_PEAKS: Tuple[float, float] = (200.0, 50.0)
+
+MICRO_PER_USD = 1_000_000
+
+
+def billing_enabled() -> bool:
+    """Kill switch: ``METRICS_TPU_BILLING=0`` disables all dollar attrs."""
+    return os.environ.get("METRICS_TPU_BILLING", "1") != "0"
+
+
+_lock = threading.Lock()
+_rate_cache: Optional[Tuple[str, float]] = None
+
+
+def device_rate(refresh: bool = False) -> Tuple[str, float]:
+    """``(rate_key, usd_per_hour)`` for the attached default device.
+
+    Resolution order mirrors :func:`cost_model.device_peaks`:
+    longest-substring match of :data:`DEVICE_RATES` keys against
+    ``jax.devices()[0].device_kind``, then the device *platform* string
+    (``cuda`` / ``rocm`` / ``cpu``), then the ``cpu`` host row — the
+    table always resolves. Cached after the first probe."""
+    global _rate_cache
+    with _lock:
+        if _rate_cache is not None and not refresh:
+            return _rate_cache
+    key, rate = "cpu", DEVICE_RATES["cpu"]
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        kind = str(getattr(dev, "device_kind", "")).lower()
+        platform = str(getattr(dev, "platform", "")).lower()
+        best = ""
+        for sub, r in DEVICE_RATES.items():
+            if sub.lower() in kind and len(sub) > len(best):
+                best, rate = sub, r
+        if best:
+            key = best
+        elif platform in DEVICE_RATES:
+            key, rate = platform, DEVICE_RATES[platform]
+        elif platform == "gpu":
+            key, rate = "cuda", DEVICE_RATES["cuda"]
+    except Exception:
+        pass
+    with _lock:
+        _rate_cache = (key, rate)
+    return key, rate
+
+
+def reset() -> None:
+    """Drop the cached rate probe (tests that monkeypatch the table)."""
+    global _rate_cache
+    with _lock:
+        _rate_cache = None
+
+
+def modeled_device_seconds(entry: Optional[cost_model.CostEntry]) -> float:
+    """Roofline occupancy for one launch of ``entry``'s executable.
+
+    ``max(flops / peak_flops, bytes / peak_bandwidth)`` — the binding
+    wall is how long the chip is busy. Uses the absolute device peaks
+    when the attached device has them, else :data:`CPU_HOST_PEAKS`."""
+    if entry is None:
+        return 0.0
+    peaks = cost_model.device_peaks() or CPU_HOST_PEAKS
+    peak_gflops, peak_gbps = peaks
+    compute_s = entry.flops / (peak_gflops * 1e9) if peak_gflops > 0 else 0.0
+    memory_s = entry.bytes_accessed / (peak_gbps * 1e9) if peak_gbps > 0 else 0.0
+    return max(compute_s, memory_s)
+
+
+def cost_microusd(entry: Optional[cost_model.CostEntry]) -> int:
+    """Integer microdollars for one launch of ``entry``'s executable.
+
+    Zero only for a launch that modeled zero work; any nonzero modeled
+    occupancy floors at 1 microdollar so quantization never makes a real
+    launch free (which would turn the CPU-scale conservation pins into
+    vacuous ``0 == 0`` checks)."""
+    seconds = modeled_device_seconds(entry)
+    if seconds <= 0.0:
+        return 0
+    _, rate = device_rate()
+    micro = seconds * rate / 3600.0 * MICRO_PER_USD
+    return max(1, int(round(micro)))
+
+
+def apportion(total_microusd: int, weights: Sequence[int]) -> List[int]:
+    """Split ``total_microusd`` across ``weights`` by largest remainder.
+
+    Shares are proportional to the (masked-row-count) weights, every
+    share is a non-negative int, and the shares sum to ``total_microusd``
+    **exactly** — the conservation invariant the acceptance test pins.
+    All-zero weights split evenly; remainder ties break to the lowest
+    index, so the split is deterministic."""
+    n = len(weights)
+    if n == 0:
+        return []
+    total = int(total_microusd)
+    w = [max(0, int(x)) for x in weights]
+    wsum = sum(w)
+    if wsum <= 0:
+        w = [1] * n
+        wsum = n
+    shares = []
+    remainders = []
+    floor_sum = 0
+    for i, wi in enumerate(w):
+        exact = total * wi
+        q, r = divmod(exact, wsum)
+        shares.append(q)
+        remainders.append((-r, i))
+        floor_sum += q
+    leftover = total - floor_sum
+    for _, i in sorted(remainders):
+        if leftover <= 0:
+            break
+        shares[i] += 1
+        leftover -= 1
+    return shares
+
+
+def usd(microusd: int) -> float:
+    """Render integer microdollars as float dollars (render time ONLY)."""
+    return round(int(microusd) / MICRO_PER_USD, 6)
+
+
+def launch_cost_attrs(entry: Optional[cost_model.CostEntry]) -> Dict[str, Any]:
+    """Dollar attrs for one launch span: modeled seconds + cost.
+
+    Empty when billing is killed or the entry is unknown — the launch
+    span then carries exactly its pre-billing attributes."""
+    if entry is None or not billing_enabled():
+        return {}
+    micro = cost_microusd(entry)
+    return {
+        "modeled_device_s": round(modeled_device_seconds(entry), 9),
+        "cost_microusd": micro,
+        "cost_usd": usd(micro),
+    }
+
+
+def rate_snapshot() -> Dict[str, Any]:
+    """The resolved rate, for health()/fleet views and trace headers."""
+    key, rate = device_rate()
+    return {"rate_key": key, "usd_per_hour": rate, "enabled": billing_enabled()}

@@ -76,6 +76,14 @@ DEVICE_PEAKS: Dict[str, Tuple[float, float]] = {
     "TPU v5e": (98000.0, 819.0),
     "TPU v5p": (229000.0, 2765.0),
     "TPU v6e": (459000.0, 1640.0),
+    # GPU rows (ROADMAP item 5's second backend): a named H100-class
+    # entry, plus generic per-platform fallbacks so roofline_frac still
+    # resolves on accelerators whose device_kind names no specific row —
+    # device_peaks() falls back to the platform string (cuda / rocm)
+    # when no device_kind substring matches.
+    "H100": (67000.0, 3350.0),
+    "cuda": (30000.0, 2000.0),
+    "rocm": (45000.0, 1600.0),
 }
 
 
@@ -203,8 +211,10 @@ _peaks_cache: Optional[Tuple[bool, Optional[Tuple[float, float]]]] = None
 
 def device_peaks(refresh: bool = False) -> Optional[Tuple[float, float]]:
     """(peak GFLOP/s, peak GB/s) for the attached default device, or
-    ``None`` when the device kind is not in the table (CPU — the
-    relative basis). Cached after the first probe."""
+    ``None`` when neither the device kind nor the platform is in the
+    table (CPU — the relative basis). Resolution is longest-substring
+    match against ``device_kind``, then the platform string (``cuda`` /
+    ``rocm``) as a generic fallback. Cached after the first probe."""
     global _peaks_cache
     if _peaks_cache is not None and not refresh:
         return _peaks_cache[1]
@@ -212,11 +222,18 @@ def device_peaks(refresh: bool = False) -> Optional[Tuple[float, float]]:
     try:
         import jax
 
-        kind = jax.devices()[0].device_kind
+        dev = jax.devices()[0]
+        kind = str(getattr(dev, "device_kind", ""))
         best = ""
         for sub, p in DEVICE_PEAKS.items():
-            if sub.lower() in str(kind).lower() and len(sub) > len(best):
+            if sub.lower() in kind.lower() and len(sub) > len(best):
                 best, peaks = sub, p
+        if peaks is None:
+            platform = str(getattr(dev, "platform", "")).lower()
+            if platform in DEVICE_PEAKS:
+                peaks = DEVICE_PEAKS[platform]
+            elif platform == "gpu":
+                peaks = DEVICE_PEAKS["cuda"]
     except Exception:
         peaks = None
     _peaks_cache = (True, peaks)

@@ -93,7 +93,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu import faults, quant, resilience, sync_engine, telemetry, wal
-from metrics_tpu.analysis import cost_model
+from metrics_tpu.analysis import billing, cost_model
 from metrics_tpu.serve import _MIN_SESSION_BUCKET, MetricsService, ValueTicket
 from metrics_tpu.utilities.data import bucket_pow2
 
@@ -1471,7 +1471,9 @@ class ShardedMetricsService:
         (read concurrently on the fleet pool), aggregated
         breaker/resilience posture
         (:func:`metrics_tpu.resilience.aggregate_policy_stats`), failover
-        history with causes, and replication standby cursors."""
+        history with causes, replication standby cursors, and the fleet
+        dollar roll-up under ``"cost"`` (microdollar-exact across
+        shards; $/M-updates rendered at this edge)."""
         live = self._live_shards()
         per_shard = dict(zip(
             [s.shard_id for s in live],
@@ -1481,11 +1483,27 @@ class ShardedMetricsService:
         for snap in per_shard.values():
             for k, v in snap["serve"].items():
                 totals[k] = totals.get(k, 0) + int(v)
+        billed = totals.get("billed_requests", 0)
+        cost_micro = totals.get("cost_microusd", 0)
         return {
             "owner": self.label,
             "num_shards": self.num_shards,
             "shards": per_shard,
             "serve_totals": totals,
+            # fleet dollar roll-up: integer microdollars summed across
+            # shards (lossless — the serve_totals summation above IS the
+            # merge), rendered to $ and $/M-updates here at the edge
+            "cost": {
+                **billing.rate_snapshot(),
+                "cost_microusd": cost_micro,
+                "cost_usd": billing.usd(cost_micro),
+                "billed_requests": billed,
+                "usd_per_million_updates": (
+                    round(cost_micro / billed, 4) if billed else 0.0
+                ),
+                "budget_shed": totals.get("budget_shed", 0),
+                "budget_rejected": totals.get("budget_rejected", 0),
+            },
             "reads": {
                 "fleet_reads": self.stats["fleet_reads"],
                 "fleet_read_collectives": self.stats["fleet_read_collectives"],

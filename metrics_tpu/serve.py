@@ -117,7 +117,7 @@ import numpy as np
 
 from metrics_tpu import aot_cache, faults, resilience, telemetry, wal
 from metrics_tpu._compat import profiler_annotation
-from metrics_tpu.analysis import cost_model
+from metrics_tpu.analysis import billing, cost_model
 from metrics_tpu.utilities.data import bucket_pow2, pad_axis0
 
 __all__ = [
@@ -127,6 +127,7 @@ __all__ = [
     "ValueTicket",
     "QueueFullError",
     "CircuitOpenError",
+    "CostBudgetExceededError",
     "HistoryPolicy",
 ]
 
@@ -144,6 +145,13 @@ class QueueFullError(RuntimeError):
 class CircuitOpenError(RuntimeError):
     """The per-session circuit breaker is open: this session failed
     repeatedly and is in backoff cooldown (counted in submits)."""
+
+
+class CostBudgetExceededError(RuntimeError):
+    """This session's trailing spend rate exceeds its configured
+    ``cost_budget_usd_per_s`` and its admission posture rejects the
+    submit. Recovery is breaker-style: the guard re-admits as soon as the
+    trailing-window spend falls back under budget."""
 
 
 # sentinel for configure_session(): "leave this override untouched"
@@ -242,6 +250,7 @@ class _Request:
         "name", "args", "kwargs", "seq", "rid", "t_enq", "t0", "submit_tid",
         "journal_us", "queue_us", "launch_us", "launch_ts_us", "launch_tid",
         "t_launch_done", "replayed", "members", "deadline_s", "ticket", "value",
+        "rows", "cost_microusd",
     )
 
     def __init__(
@@ -282,6 +291,11 @@ class _Request:
         # per-request batch value from the stacked launch
         self.ticket = ticket
         self.value: Any = None
+        # masked-row count (batch rows) this request contributed to its
+        # launch — the apportionment weight for cost conservation — and
+        # the integer-microdollar share apportioned back at launch time
+        self.rows = 0
+        self.cost_microusd = 0
         # a coalesced merge keeps the original requests here so every one
         # of them retires (and traces) individually
         self.members: Optional[List["_Request"]] = None
@@ -297,7 +311,7 @@ class _SessionSLO:
     :class:`~metrics_tpu.streaming.QuantileSketch` via ``to_device()``
     when a tenant's histogram needs to enter the fused-sync world."""
 
-    __slots__ = ("e2e_us", "queue_us", "counts")
+    __slots__ = ("e2e_us", "queue_us", "counts", "cost_microusd", "billed")
 
     _OUTCOMES = (
         "served", "fallback", "shed", "expired",
@@ -312,24 +326,101 @@ class _SessionSLO:
         self.e2e_us = HostQuantileSketch(bins=512, alpha=0.05)
         self.queue_us = HostQuantileSketch(bins=512, alpha=0.05)
         self.counts: Dict[str, int] = {k: 0 for k in self._OUTCOMES}
+        # dollar attribution: integer microdollars (lossless to sum and
+        # merge across shards) over the requests that actually updated
+        # state ("billed" = served + fallback, never replayed)
+        self.cost_microusd = 0
+        self.billed = 0
 
     def record(
         self,
         outcome: str,
         e2e_us: Optional[float] = None,
         queue_us: Optional[float] = None,
+        cost_microusd: Optional[int] = None,
     ) -> None:
         self.counts[outcome] = self.counts.get(outcome, 0) + 1
         if e2e_us is not None:
             self.e2e_us.add(e2e_us)
         if queue_us is not None:
             self.queue_us.add(queue_us)
+        if cost_microusd is not None:
+            self.cost_microusd += int(cost_microusd)
+            self.billed += 1
 
     def snapshot(self) -> Dict[str, Any]:
-        return {
+        snap = {
             "e2e_us": self.e2e_us.snapshot(),
             "queue_us": self.queue_us.snapshot(),
             **self.counts,
+        }
+        if billing.billing_enabled():
+            snap["cost_microusd"] = self.cost_microusd
+            snap["cost_usd"] = billing.usd(self.cost_microusd)
+            # microdollars-per-update IS dollars-per-million-updates
+            snap["usd_per_million_updates"] = (
+                round(self.cost_microusd / self.billed, 4) if self.billed else 0.0
+            )
+        return snap
+
+
+class _CostBudget:
+    """Trailing-window spend-rate guard for one tenant.
+
+    Retirements :meth:`charge` integer microdollars into a timestamped
+    deque; :meth:`over_budget` prunes the window and compares the
+    trailing spend *rate* against the configured $/s budget. Recovery is
+    breaker-style but clockwork rather than counted: as charged spend
+    falls out of the trailing window the rate drops back under budget
+    and the guard re-admits on its own — no reset call needed. ``trips``
+    counts distinct over-budget episodes for the health view."""
+
+    __slots__ = ("budget_usd_per_s", "window_s", "_events", "_lock", "tripped", "trips")
+
+    #: trailing horizon the spend rate is averaged over. Short enough
+    #: that tests (and incident recovery) see re-admission in fractions
+    #: of a second, long enough to absorb one flush's burstiness.
+    WINDOW_S = 0.25
+
+    def __init__(self, budget_usd_per_s: float, window_s: Optional[float] = None) -> None:
+        self.budget_usd_per_s = float(budget_usd_per_s)
+        self.window_s = float(window_s if window_s is not None else self.WINDOW_S)
+        self._events: deque = deque()  # (monotonic ts, microusd)
+        self._lock = threading.Lock()
+        self.tripped = False
+        self.trips = 0
+
+    def charge(self, microusd: int) -> None:
+        if microusd > 0:
+            with self._lock:
+                self._events.append((time.monotonic(), int(microusd)))
+
+    def spend_usd_per_s(self) -> float:
+        """Trailing-window spend rate in $/s (prunes expired charges)."""
+        now = time.monotonic()
+        with self._lock:
+            while self._events and self._events[0][0] < now - self.window_s:
+                self._events.popleft()
+            total = sum(m for _, m in self._events)
+        return total / billing.MICRO_PER_USD / self.window_s
+
+    def over_budget(self) -> bool:
+        over = self.spend_usd_per_s() > self.budget_usd_per_s
+        if over and not self.tripped:
+            self.trips += 1
+        self.tripped = over
+        return over
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Live health view: the spend rate is re-pruned at read time, so
+        ``over_budget`` reflects clockwork recovery even while the tenant
+        stays quiet (no submit-gate probe to refresh the trip latch)."""
+        spend = self.spend_usd_per_s()
+        return {
+            "budget_usd_per_s": self.budget_usd_per_s,
+            "spend_usd_per_s": round(spend, 6),
+            "over_budget": spend > self.budget_usd_per_s,
+            "trips": int(self.trips),
         }
 
 
@@ -392,6 +483,14 @@ class MetricsService:
             :func:`metrics_tpu.telemetry.set_thread_name`); call
             :meth:`shutdown` to stop it. ``None`` (default) keeps the
             caller-driven flush model.
+        scrub_interval_s: with a value, a daemon "scrub-worker" thread
+            runs :meth:`scrub` over the checkpoint ladder every interval
+            (rate-limited background integrity verification — ladder
+            corruption is found within one interval instead of at the
+            next operator-driven scrub). Run counts and the latest
+            report land under ``telemetry_snapshot()["history"]``;
+            :meth:`shutdown` joins the worker. ``None`` (default) keeps
+            scrubbing operator-driven.
         shard_id: fabric shard index this service hosts
             (:mod:`metrics_tpu.fabric`). Tags the telemetry owner label
             (``MetricsService[T]@shard<k>``) and every ``request`` span
@@ -443,6 +542,7 @@ class MetricsService:
         admission_timeout_s: Optional[float] = None,
         request_deadline_s: Optional[float] = None,
         flush_interval_s: Optional[float] = None,
+        scrub_interval_s: Optional[float] = None,
         shard_id: Optional[int] = None,
         rid_offset: int = 0,
         rid_stride: int = 1,
@@ -548,6 +648,10 @@ class MetricsService:
         # per-session config overrides (configure_session): deadline /
         # admission policy per tenant, consulted at admission time
         self._tenant_cfg: Dict[str, Dict[str, Any]] = {}
+        # per-session cost-budget guards (configure_session
+        # cost_budget_usd_per_s=); consulted at admission, charged at
+        # retirement
+        self._budgets: Dict[str, _CostBudget] = {}
         # sessions explicitly closed: submit() for one raises KeyError until
         # open_session() reclaims the name (never-seen names still auto-open)
         self._closed: set = set()
@@ -583,6 +687,13 @@ class MetricsService:
             "replayed_records": 0,
             "read_memo_hits": 0,
             "read_memo_misses": 0,
+            # dollar attribution (integer microdollars — int so the
+            # fleet's serve_totals summation stays lossless) and the
+            # budget-enforcement outcomes
+            "cost_microusd": 0,
+            "billed_requests": 0,
+            "budget_shed": 0,
+            "budget_rejected": 0,
         }
 
         self.flush_interval_s = flush_interval_s
@@ -593,6 +704,31 @@ class MetricsService:
                 target=self._flush_loop, name="flush-worker", daemon=True
             )
             self._flush_thread.start()
+
+        self.scrub_interval_s = scrub_interval_s
+        self._scrub_stats: Dict[str, Any] = {"runs": 0, "errors": 0, "last": None}
+        self._stop_scrub = threading.Event()
+        self._scrub_thread: Optional[threading.Thread] = None
+        if scrub_interval_s is not None:
+            self._scrub_thread = threading.Thread(
+                target=self._scrub_loop, name="scrub-worker", daemon=True
+            )
+            self._scrub_thread.start()
+
+    def _scrub_loop(self) -> None:
+        telemetry.set_thread_name("scrub-worker")
+        while not self._stop_scrub.wait(self.scrub_interval_s):
+            try:
+                # serialize with the periodic checkpoint inside flush():
+                # a rung must never be verified mid-write
+                with self._flush_lock:
+                    report = self.scrub()
+                self._scrub_stats["runs"] += 1
+                self._scrub_stats["last"] = report
+            except Exception as err:  # noqa: BLE001 - the worker must survive
+                # a failed pass; the degrade span records the cause
+                self._scrub_stats["errors"] += 1
+                resilience.record_degrade(self.label, "history", err, stage="scrub-worker")
 
     def _flush_loop(self) -> None:
         telemetry.set_thread_name("flush-worker")
@@ -608,13 +744,18 @@ class MetricsService:
                 resilience.record_degrade(self.label, "flush-worker", err)
 
     def shutdown(self) -> None:
-        """Stop the background flush worker (if any), then flush and retire
-        everything outstanding. Idempotent; services without
-        ``flush_interval_s`` are unaffected beyond the final drain."""
+        """Stop the background flush and scrub workers (if any), then flush
+        and retire everything outstanding. Idempotent; services without
+        ``flush_interval_s`` / ``scrub_interval_s`` are unaffected beyond
+        the final drain."""
         self._stop_flush.set()
+        self._stop_scrub.set()
         if self._flush_thread is not None:
             self._flush_thread.join(timeout=5.0)
             self._flush_thread = None
+        if self._scrub_thread is not None:
+            self._scrub_thread.join(timeout=5.0)
+            self._scrub_thread = None
         self.drain()
 
     # -------------------------------------------------------------- sessions
@@ -700,6 +841,7 @@ class MetricsService:
         *,
         request_deadline_s: Any = _UNSET,
         admission: Any = _UNSET,
+        cost_budget_usd_per_s: Any = _UNSET,
     ) -> None:
         """Per-tenant overrides of the service-wide admission posture.
 
@@ -707,9 +849,18 @@ class MetricsService:
         session's future submits (``None`` = this tenant never expires);
         ``admission`` replaces the overload policy applied when *this
         tenant's* submit meets a full queue (``None`` = back to the
-        service default). Unset arguments leave the existing override
-        untouched. Overrides are routing metadata, not state — they are
-        NOT journaled, and a fabric router re-applies them after failover
+        service default). ``cost_budget_usd_per_s`` arms a spend-rate
+        guard: while the tenant's trailing billed spend exceeds the
+        budget, its submits flip to the degraded admission posture —
+        shed (policy ``shed-oldest``: the tenant's own incoming request
+        is dropped, never another tenant's queued work) or reject
+        (:class:`CostBudgetExceededError`, policies ``reject`` /
+        ``block`` — waiting cannot free budget) — each victim one
+        ``degrade:cost-budget`` span; recovery is automatic when spend
+        falls back under budget (``None`` disarms). Unset arguments
+        leave the existing override untouched. Overrides are routing
+        metadata, not state — they are NOT journaled, and a fabric
+        router re-applies them after failover
         (:class:`metrics_tpu.fabric.ShardedMetricsService` keeps the
         authoritative copy)."""
         if admission is not _UNSET and admission is not None:
@@ -722,6 +873,22 @@ class MetricsService:
             cfg["request_deadline_s"] = request_deadline_s
         if admission is not _UNSET:
             cfg["admission"] = admission
+        if cost_budget_usd_per_s is not _UNSET:
+            cfg["cost_budget_usd_per_s"] = cost_budget_usd_per_s
+            if cost_budget_usd_per_s is None:
+                self._budgets.pop(name, None)
+            else:
+                budget = float(cost_budget_usd_per_s)
+                if budget <= 0:
+                    raise ValueError(
+                        f"cost_budget_usd_per_s must be positive (or None to "
+                        f"disarm), got {cost_budget_usd_per_s!r}"
+                    )
+                guard = self._budgets.get(name)
+                if guard is None:
+                    self._budgets[name] = _CostBudget(budget)
+                else:
+                    guard.budget_usd_per_s = budget
 
     def session_config(self, name: str) -> Dict[str, Any]:
         """Effective admission config for one session (overrides folded
@@ -732,6 +899,7 @@ class MetricsService:
                 "request_deadline_s", self.request_deadline_s
             ),
             "admission": cfg.get("admission") or self.admission,
+            "cost_budget_usd_per_s": cfg.get("cost_budget_usd_per_s"),
         }
 
     def submit(
@@ -742,8 +910,11 @@ class MetricsService:
 
         Order of gates: a closed session raises ``KeyError`` immediately
         (never deep inside the coalescer); an open circuit breaker raises
-        :class:`CircuitOpenError`; a full bounded queue engages the
-        admission policy — the *submitting session's* policy when
+        :class:`CircuitOpenError`; an over-budget tenant
+        (:meth:`configure_session` ``cost_budget_usd_per_s=``) is shed or
+        rejected per its admission policy
+        (:class:`CostBudgetExceededError`); a full bounded queue engages
+        the admission policy — the *submitting session's* policy when
         :meth:`configure_session` set one. Only an *admitted* request is
         journaled — by the time this returns, the record is durable and
         the request is eligible for flush, in that order (the write-ahead
@@ -770,6 +941,40 @@ class MetricsService:
                 f"session {name!r} circuit breaker is open after "
                 f"{breaker.failures} failure(s); retry after the cooldown "
                 f"({breaker.cooldown} more submits) or reset_session()"
+            )
+        guard = self._budgets.get(name)
+        if guard is not None and billing.billing_enabled() and guard.over_budget():
+            # cost-budget enforcement: the over-budget tenant's OWN submit
+            # is the victim — shed or reject per its admission policy, one
+            # degrade span each — and no other tenant's queued work is
+            # touched (the wave stays clean). "block" maps to reject:
+            # waiting in the queue cannot free budget.
+            cfg = self._tenant_cfg.get(name)
+            policy = (cfg.get("admission") if cfg else None) or self.admission
+            spend = round(guard.spend_usd_per_s(), 6)
+            telemetry.emit(
+                "degrade", self.label, kind="admission", cause="cost-budget",
+                session=name, policy=policy, spend_usd_per_s=spend,
+                budget_usd_per_s=guard.budget_usd_per_s,
+            )
+            if policy == "shed-oldest":
+                self.stats["budget_shed"] += 1
+                self._slo_record(name, "shed")
+                if return_value:
+                    ticket = ValueTicket()
+                    ticket._reject(CostBudgetExceededError(
+                        f"session {name!r} submit shed: spend "
+                        f"{spend} $/s exceeds its cost budget "
+                        f"{guard.budget_usd_per_s} $/s"
+                    ))
+                    return ticket
+                return None
+            self.stats["budget_rejected"] += 1
+            self._slo_record(name, "rejected")
+            raise CostBudgetExceededError(
+                f"session {name!r} spend {spend} $/s exceeds its cost "
+                f"budget {guard.budget_usd_per_s} $/s; re-admission is "
+                f"automatic once trailing spend falls under budget"
             )
         self.open_session(name)
         cfg = self._tenant_cfg.get(name)
@@ -1027,6 +1232,9 @@ class MetricsService:
                 return None
             if len({int(x.shape[0]) for x in flat}) != 1:
                 return None
+            # the member's batch-row count: its apportionment weight when
+            # the merged launch's cost is split back across member rids
+            req.rows = int(flat[0].shape[0])
             flats.append(flat)
             treedefs.append(treedef)
         if any(t != treedefs[0] for t in treedefs[1:]):
@@ -1139,7 +1347,21 @@ class MetricsService:
                 )
             faults.check("launch", self.label)
             state_leaves = tuple(self._stacked[k] for k in self._names)
-            reqs = [r for entry in group for r in entry[0].all()]
+            # flatten the group to the individually-retiring requests,
+            # keeping each one's masked-row count alongside — the
+            # apportionment weight when the launch's cost is split back
+            # across member rids
+            reqs: List[_Request] = []
+            weights: List[int] = []
+            for g_entry in group:
+                g_req = g_entry[0]
+                if g_req.members is None:
+                    reqs.append(g_req)
+                    weights.append(int(g_entry[5]))
+                else:
+                    for m in g_req.members:
+                        reqs.append(m)
+                        weights.append(m.rows)
             rids = [r.rid for r in reqs]
             t0 = telemetry.clock()
             l0 = time.monotonic()
@@ -1156,8 +1378,24 @@ class MetricsService:
                 out = tuple(out)
             l1 = time.monotonic()
             launch_us = (l1 - l0) * 1e6
+            cost_entry = self._cost.get(key)
+            if billing.billing_enabled():
+                # dollar attribution (always-on accounting, independent of
+                # telemetry subscription): price the launch once, then
+                # split it across the member rids by masked-row count with
+                # largest remainder — the shares sum to the launch cost
+                # EXACTLY (the conservation pin)
+                launch_micro = billing.cost_microusd(cost_entry)
+                if launch_micro:
+                    for r, share in zip(reqs, billing.apportion(launch_micro, weights)):
+                        r.cost_microusd = share
             cost = (
-                cost_model.launch_attrs(self._cost.get(key), launch_us)
+                cost_model.launch_attrs(cost_entry, launch_us)
+                if telemetry.subscribed()
+                else {}
+            )
+            bill = (
+                billing.launch_cost_attrs(cost_entry)
                 if telemetry.subscribed()
                 else {}
             )
@@ -1174,6 +1412,7 @@ class MetricsService:
                 rid_count=len(rids),
                 rids=rids[:128],
                 **cost,
+                **bill,
             )
             launch_tid = threading.get_ident()
             for r in reqs:
@@ -1359,12 +1598,13 @@ class MetricsService:
         outcome: str,
         e2e_us: Optional[float] = None,
         queue_us: Optional[float] = None,
+        cost_microusd: Optional[int] = None,
     ) -> None:
         with self._slo_lock:
             slo = self._slo.get(name)
             if slo is None:
                 slo = self._slo[name] = _SessionSLO()
-            slo.record(outcome, e2e_us, queue_us)
+            slo.record(outcome, e2e_us, queue_us, cost_microusd)
 
     def _finish_request(
         self, req: _Request, outcome: str, t_ret: Optional[float] = None
@@ -1389,13 +1629,24 @@ class MetricsService:
             retire_us = max(0.0, (t_ret - req.t_launch_done) * 1e6)
         if not req.replayed:
             latencied = outcome in ("served", "fallback")
+            billed = latencied and billing.billing_enabled()
+            if billed:
+                self.stats["cost_microusd"] += req.cost_microusd
+                self.stats["billed_requests"] += 1
+                guard = self._budgets.get(req.name)
+                if guard is not None:
+                    guard.charge(req.cost_microusd)
             self._slo_record(
                 req.name, outcome,
                 e2e_us if latencied else None,
                 req.queue_us if latencied or outcome == "expired" else None,
+                req.cost_microusd if billed else None,
             )
         if req.t0 is not None and telemetry.clock() is not None:
             extra: Dict[str, Any] = {"replayed": True} if req.replayed else {}
+            if billing.billing_enabled():
+                extra["cost_microusd"] = req.cost_microusd
+                extra["cost_usd"] = billing.usd(req.cost_microusd)
             if self.shard_id is not None:
                 extra["shard"] = self.shard_id
             if req.launch_ts_us is not None:
@@ -1439,7 +1690,7 @@ class MetricsService:
         ``blocked`` view, never ``allow()`` (which burns cooldown)."""
         with self._queue_cond:
             queue_depth = len(self._queue)
-        return {
+        out = {
             "queue_depth": queue_depth,
             "inflight": len(self._inflight),
             "sessions": self.session_count,
@@ -1456,6 +1707,17 @@ class MetricsService:
                 for name, b in self._breakers.items()
             },
         }
+        if billing.billing_enabled():
+            out["cost"] = {
+                **billing.rate_snapshot(),
+                "cost_microusd": self.stats["cost_microusd"],
+                "cost_usd": billing.usd(self.stats["cost_microusd"]),
+                "billed_requests": self.stats["billed_requests"],
+                "budgets": {
+                    name: g.snapshot() for name, g in self._budgets.items()
+                },
+            }
+        return out
 
     def slo_snapshot(self) -> Dict[str, Any]:
         """Per-tenant SLO view: end-to-end + queue-wait p50/p95/p99 (from
@@ -1467,6 +1729,7 @@ class MetricsService:
         e2e = HostQuantileSketch(bins=512, alpha=0.05)
         qws = HostQuantileSketch(bins=512, alpha=0.05)
         totals: Dict[str, Any] = {k: 0 for k in _SessionSLO._OUTCOMES}
+        cost_micro = billed = 0
         with self._slo_lock:
             sessions = {name: slo.snapshot() for name, slo in self._slo.items()}
             for slo in self._slo.values():
@@ -1474,8 +1737,18 @@ class MetricsService:
                     totals[k] += slo.counts.get(k, 0)
                 e2e.merge(slo.e2e_us)
                 qws.merge(slo.queue_us)
+                cost_micro += slo.cost_microusd
+                billed += slo.billed
         totals["e2e_us"] = e2e.snapshot()
         totals["queue_us"] = qws.snapshot()
+        if billing.billing_enabled():
+            # integer-microdollar sums — lossless under merge, exactly
+            # like the sketches' elementwise bin merge above
+            totals["cost_microusd"] = cost_micro
+            totals["cost_usd"] = billing.usd(cost_micro)
+            totals["usd_per_million_updates"] = (
+                round(cost_micro / billed, 4) if billed else 0.0
+            )
         return {"sessions": sessions, "totals": totals}
 
     def memory_snapshot(self, top_n: int = 10) -> Dict[str, Any]:
@@ -2448,8 +2721,10 @@ class MetricsService:
         Shed / expired / breaker-tripped request counts live under
         ``"serve"`` (``shed_requests`` / ``expired_requests`` /
         ``breaker_rejected``). ``"memory"`` carries the per-leaf state-byte
-        attribution (:meth:`memory_snapshot`) and ``"health"`` the live
-        gauges (:meth:`health`)."""
+        attribution (:meth:`memory_snapshot`), ``"health"`` the live
+        gauges (:meth:`health`), and ``"history"`` the background
+        scrubber's run/error counts plus its latest report
+        (``scrub_interval_s=``; all zeros/None without the worker)."""
         return {
             "owner": self.label,
             "shard": self.shard_id,
@@ -2462,6 +2737,7 @@ class MetricsService:
             "wal": self._wal.stats() if self._wal is not None else None,
             "memory": self.memory_snapshot(),
             "health": self.health(),
+            "history": dict(self._scrub_stats),
         }
 
 

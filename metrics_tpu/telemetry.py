@@ -68,6 +68,14 @@ request    one SERVED request's end-to-end flight record
            the ``session``, the latency decomposition
            (``queue_us``/``journal_us``/``launch_us``/``retire_us``)
            and — for replayed journal records — ``replayed=True``.
+           With billing enabled (the default; kill switch
+           ``METRICS_TPU_BILLING=0``) each span also carries its
+           apportioned dollar share (``cost_microusd`` — integer
+           microdollars — and the render-time ``cost_usd``); launch
+           (``update:stacked-aot``) spans carry the modeled occupancy
+           and launch cost (``modeled_device_s`` / ``cost_microusd`` /
+           ``cost_usd``), with Σ request shares == launch cost exactly
+           (:mod:`metrics_tpu.analysis.billing`).
            The Chrome exporter turns each one into a flow arrow
            (``ph: s/t/f``) linking the submit lane to the launch and
            retire slices (see :func:`export_chrome_trace`)
@@ -92,8 +100,9 @@ read       one read-path decision (the O(1) read machinery): kinds
 
 The serving admission layer reuses the ``degrade`` name for shed work:
 kinds ``admission`` (causes ``queue-full-shed`` / ``queue-full-reject``
-/ ``deadline-expired``) and ``session`` (cause ``breaker-open``) — every
-rejected, shed, or expired request is exactly one cause-tagged span.
+/ ``deadline-expired`` / ``cost-budget``) and ``session`` (cause
+``breaker-open``) — every rejected, shed, expired, or budget-enforced
+request is exactly one cause-tagged span.
 
 Events carry the owner (metric class name or ``MetricCollection``), a
 kind, a wall-clock timestamp + duration in µs, the emitting thread id,
@@ -101,9 +110,10 @@ and structured attrs (wire bytes, shape bucket, dtypes, static key,
 retrace cause). Two consumption tiers:
 
 * **Always-on counters.** Every emit bumps a process-level counter keyed
-  ``"<name>:<kind>"`` (plus ``"collective:bytes"`` and
-  ``"compile:cause:<cause>"``) — read with :func:`snapshot`, clear with
-  :func:`reset_counters`.
+  ``"<name>:<kind>"`` (plus ``"collective:bytes"``,
+  ``"compile:cause:<cause>"``, and — while billing is enabled — the
+  integer-microdollar ``"billing:microusd"`` sum over request spans) —
+  read with :func:`snapshot`, clear with :func:`reset_counters`.
 * **Always-on timeline.** Every *timed* span additionally feeds a
   per-``(family, owner)`` sliding latency/throughput aggregate — a
   :class:`~metrics_tpu.streaming.sketch.HostQuantileSketch` of span µs
@@ -431,6 +441,11 @@ def emit(
             _counters[f"degrade:cause:{cause}"] = _counters.get(f"degrade:cause:{cause}", 0) + 1
         elif name == "journal" and kind == "append":
             _counters["journal:bytes"] = _counters.get("journal:bytes", 0) + attrs.get("nbytes", 0)
+        elif name == "request" and "cost_microusd" in attrs:
+            # dollar attribution rides the always-on counters as integer
+            # microdollars (exact under summation; absent entirely when
+            # METRICS_TPU_BILLING=0 keeps spans cost-free)
+            _counters["billing:microusd"] = _counters.get("billing:microusd", 0) + int(attrs.get("cost_microusd") or 0)
     timed = t0 is not None or dur_us is not None
     if not subs and not timed:
         return

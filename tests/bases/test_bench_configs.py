@@ -143,7 +143,7 @@ def test_bench_detail_budget_zero_skips_everything(monkeypatch):
     monkeypatch.setenv("BENCH_DETAIL_BUDGET", "0")
     detail = bench._bench_detail()
     skipped = [k for k in detail if k.endswith("_skipped")]
-    assert len(skipped) == 32
+    assert len(skipped) == 33
     assert "detail_elapsed_s" in detail
 
 
@@ -307,6 +307,32 @@ def test_request_tracing_config_counts_and_keys(monkeypatch):
     # the config must restore the kill switch it toggles
     assert os.environ.get("METRICS_TPU_TELEMETRY") is None or (
         os.environ["METRICS_TPU_TELEMETRY"] != "0")
+
+
+def test_cost_attribution_config_counts_and_keys(monkeypatch):
+    """Pin the dollar-attribution bench config: 'billing costs nothing
+    measurable on the submit path and its accounting is exact' — the
+    on/off submit ratio key must exist and stay near 1 (lenient bound
+    for CI noise; BASELINE.md records the real number), the conservation
+    pin must hold bitwise (Σ request-span microdollars == Σ launch-span
+    microdollars, integer arithmetic — no float drift possible), every
+    stacked launch must carry a cost attr, the kill switch must leak
+    zero cost attrs into spans, and the CPU quantization floor fixes
+    cost-per-launch at exactly 1 microdollar."""
+    monkeypatch.delenv("METRICS_TPU_BILLING", raising=False)
+    detail = {}
+    bench._cfg_cost_attribution(detail, sessions=16, reps=2, loops=3)
+    assert detail["cost_off_submit_us"] > 0
+    assert detail["cost_on_submit_us"] > 0
+    assert 0 < detail["cost_idle_overhead_ratio"] < 2.0
+    assert detail["cost_conservation_exact"] == 1.0
+    assert detail["cost_launch_spans_costed"] == 1.0
+    assert detail["cost_rate_resolved"] == 1.0
+    assert detail["cost_kill_switch_leaked_attrs"] == 0
+    assert detail["cost_microusd_per_launch"] == 1.0
+    # the config must restore the kill switch it toggles
+    assert os.environ.get("METRICS_TPU_BILLING") is None or (
+        os.environ["METRICS_TPU_BILLING"] != "0")
 
 
 def test_fabric_config_counts_and_keys():

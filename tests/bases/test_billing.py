@@ -1,0 +1,432 @@
+"""Dollar-attributed serving (metrics_tpu/analysis/billing.py + serve.py).
+
+The accounting contract: every stacked launch is priced in INTEGER
+microdollars off the roofline cost registry, the launch cost is
+apportioned across its coalesced member rids by masked-row count with a
+largest-remainder scheme, and the per-request shares sum to the launch
+cost EXACTLY — bitwise, on CPU, for every flush, across coalescing,
+fallback, shedding, and journal replay (conservation). Tenant budgets
+(``configure_session(cost_budget_usd_per_s=)``) shed or reject the
+over-budget tenant's OWN submits without touching the wave, and recover
+by clockwork once trailing spend falls under budget.
+``METRICS_TPU_BILLING=0`` restores the pre-billing spans byte-for-byte.
+"""
+import contextlib
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, faults, telemetry
+from metrics_tpu.analysis import billing
+from metrics_tpu.serve import CostBudgetExceededError, MetricsService
+
+
+def _service(**kwargs):
+    return MetricsService(Accuracy(task="multiclass", num_classes=8), **kwargs)
+
+
+def _batch(rng, n=16, C=8):
+    return (
+        jnp.asarray(rng.randint(0, C, n)),
+        jnp.asarray(rng.randint(0, C, n)),
+    )
+
+
+def _span_micro(spans):
+    return sum(int(e.attrs.get("cost_microusd", 0)) for e in spans)
+
+
+# ------------------------------------------------------------- apportionment
+def test_apportion_sums_exactly_for_arbitrary_weights():
+    rng = np.random.RandomState(0)
+    for _ in range(200):
+        n = int(rng.randint(1, 12))
+        total = int(rng.randint(0, 10_000))
+        weights = [int(w) for w in rng.randint(0, 50, n)]
+        shares = billing.apportion(total, weights)
+        assert len(shares) == n
+        assert all(s >= 0 for s in shares)
+        assert sum(shares) == total, (total, weights, shares)
+
+
+def test_apportion_is_deterministic_and_proportional():
+    assert billing.apportion(10, [1, 1]) == [5, 5]
+    assert billing.apportion(10, [3, 1]) == [8, 2]  # 7.5 -> remainder to i=0
+    # ties break to the LOWEST index — a re-run never re-deals the shares
+    assert billing.apportion(1, [1, 1, 1]) == [1, 0, 0]
+    assert billing.apportion(2, [1, 1, 1]) == [1, 1, 0]
+    # zero-weight members never take a share while any weight is positive
+    assert billing.apportion(5, [0, 5]) == [0, 5]
+    # all-zero weights split evenly instead of dividing by zero
+    assert billing.apportion(4, [0, 0]) == [2, 2]
+    assert billing.apportion(0, [7, 9]) == [0, 0]
+    assert billing.apportion(3, []) == []
+
+
+def test_cost_microusd_floors_nonzero_work_at_one_microdollar():
+    """A launch that modeled ANY work never rounds to free — on CPU hosts
+    every real launch costs exactly the 1-microdollar floor, which is what
+    keeps the conservation pins structural instead of vacuous 0 == 0."""
+
+    class _Entry:
+        flops = 100.0       # tiny modeled work: far below a microdollar
+        bytes_accessed = 64.0
+
+    assert billing.modeled_device_seconds(_Entry()) > 0
+    assert billing.cost_microusd(_Entry()) == 1
+    assert billing.cost_microusd(None) == 0
+
+
+def test_device_rate_resolves_on_cpu_host():
+    billing.reset()
+    key, rate = billing.device_rate()
+    assert key in billing.DEVICE_RATES and rate > 0
+    snap = billing.rate_snapshot()
+    assert snap["rate_key"] == key
+    assert snap["usd_per_hour"] == rate
+    assert snap["enabled"] is True
+
+
+# -------------------------------------------------------------- conservation
+def test_conservation_1k_submits_with_coalescing_shed_and_fallback(tmp_path):
+    """The acceptance workload: 1k journaled submits over mixed tenants and
+    ragged batch sizes, with shedding rounds (bounded queue) and injected
+    launch faults (eager fallback) — the sum of request-span microdollars
+    equals the sum of launch-span microdollars EXACTLY, and the always-on
+    stats/SLO totals agree with the same integers."""
+    rng = np.random.RandomState(1)
+    svc = _service(
+        journal_dir=str(tmp_path / "wal"), max_queue=64, admission="shed-oldest"
+    )
+    n_tenants, n_rounds, per_round = 10, 10, 10  # 1000 submits
+    with telemetry.instrument() as session:
+        for r in range(n_rounds):
+            with contextlib.ExitStack() as stack:
+                if r % 4 == 3:  # fault rounds: the whole wave falls back
+                    stack.enter_context(faults.inject("launch"))
+                for _ in range(per_round):
+                    for t in range(n_tenants):
+                        svc.submit(f"tenant-{t}", *_batch(rng, n=8 + (t % 3) * 4))
+                svc.flush()
+        svc.drain()
+
+    requests = session.spans(name="request")
+    launches = session.spans(name="update", kind="stacked-aot")
+    assert len(requests) == n_tenants * n_rounds * per_round
+    # every admitted request span carries the integer share (0 when unserved)
+    assert all("cost_microusd" in e.attrs for e in requests)
+    req_micro, launch_micro = _span_micro(requests), _span_micro(launches)
+    assert req_micro == launch_micro  # the conservation pin, bitwise
+    assert launch_micro >= len(launches) >= 1  # floor: no launch is free
+
+    # the always-on books agree with the spans: only served/fallback
+    # requests bill, and they bill exactly their span share
+    billed_spans = [e for e in requests if e.kind in ("served", "fallback")]
+    assert svc.stats["cost_microusd"] == _span_micro(billed_spans)
+    assert svc.stats["billed_requests"] == len(billed_spans)
+    slo = svc.slo_snapshot()
+    assert slo["totals"]["cost_microusd"] == svc.stats["cost_microusd"]
+    assert slo["totals"]["cost_usd"] == billing.usd(svc.stats["cost_microusd"])
+    assert slo["totals"]["usd_per_million_updates"] == round(
+        svc.stats["cost_microusd"] / svc.stats["billed_requests"], 4
+    )
+    # per-tenant SLO shares also sum to the total — lossless merge
+    assert sum(
+        s["cost_microusd"] for s in slo["sessions"].values()
+    ) == slo["totals"]["cost_microusd"]
+
+    # health exposes the same integers plus the resolved rate
+    cost = svc.health()["cost"]
+    assert cost["cost_microusd"] == svc.stats["cost_microusd"]
+    assert cost["rate_key"] in billing.DEVICE_RATES
+
+
+def test_coalesced_launch_cost_apportions_by_row_weight():
+    """Six submits for three tenants coalesce; the single launch's
+    microdollars land on the member rids by masked-row count and sum back
+    to the launch cost exactly."""
+    rng = np.random.RandomState(2)
+    svc = _service()
+    sizes = {"a": 5, "b": 6, "c": 7}  # coalesced pairs share one pow2 bucket
+    with telemetry.instrument() as session:
+        for name, n in sizes.items():
+            svc.submit(name, *_batch(rng, n=n))
+            svc.submit(name, *_batch(rng, n=n))
+        svc.flush()
+        svc.drain()
+    launches = session.spans(name="update", kind="stacked-aot")
+    requests = session.spans(name="request")
+    assert len(launches) == 1 and len(requests) == 6
+    assert _span_micro(requests) == _span_micro(launches) >= 1
+    assert all(e.kind == "served" for e in requests)
+
+
+def test_unstackable_fallback_requests_conserve_at_zero():
+    """Per-row eager fallbacks never ride a stacked launch, so neither
+    side of the conservation equation counts them: zero launch spans,
+    zero request-span microdollars — still exactly equal."""
+    from tests.bases.test_chaos import FloatSum
+
+    svc = MetricsService(FloatSum())
+    with telemetry.instrument() as session:
+        svc.submit("scalar", jnp.asarray(2.5))
+        svc.flush()
+    requests = session.spans(name="request")
+    assert len(requests) == 1 and requests[0].kind == "fallback"
+    assert requests[0].attrs["cost_microusd"] == 0
+    assert not session.spans(name="update", kind="stacked-aot")
+
+
+def test_replay_spans_conserve_but_never_bill(tmp_path):
+    """Journal replay rides the normal flush, so replayed spans carry
+    their apportioned shares and conserve — but the recovered process's
+    stats, SLOs, and budgets stay clean (replay is bookkeeping, not
+    traffic)."""
+    rng = np.random.RandomState(3)
+    wal_dir = str(tmp_path / "wal")
+    svc = _service(journal_dir=wal_dir)
+    batches = [_batch(rng) for _ in range(6)]
+    for i, b in enumerate(batches):
+        svc.submit(f"t{i % 2}", *b)
+    svc.drain()
+
+    fresh = _service(journal_dir=wal_dir)
+    with telemetry.instrument() as session:
+        fresh.recover()
+    spans = session.spans(name="request")
+    assert len(spans) == 6 and all(e.attrs.get("replayed") for e in spans)
+    assert _span_micro(spans) == _span_micro(
+        session.spans(name="update", kind="stacked-aot")
+    ) >= 1
+    assert fresh.stats["cost_microusd"] == 0
+    assert fresh.stats["billed_requests"] == 0
+    assert fresh.slo_snapshot()["totals"]["cost_microusd"] == 0
+
+
+# --------------------------------------------------------------- kill switch
+def test_kill_switch_restores_prebilling_spans(monkeypatch):
+    """METRICS_TPU_BILLING=0: no span carries any cost attr, and every
+    snapshot drops its dollar section — the pre-billing surfaces come
+    back byte-for-byte."""
+    monkeypatch.setenv("METRICS_TPU_BILLING", "0")
+    rng = np.random.RandomState(4)
+    svc = _service()
+    with telemetry.instrument() as session:
+        for i in range(4):
+            svc.submit(f"t{i % 2}", *_batch(rng))
+        svc.drain()
+    for e in session.events:
+        for attr in ("cost_microusd", "cost_usd", "modeled_device_s"):
+            assert attr not in e.attrs, (e.name, attr)
+    assert "cost" not in svc.health()
+    totals = svc.slo_snapshot()["totals"]
+    for key in ("cost_microusd", "cost_usd", "usd_per_million_updates"):
+        assert key not in totals
+    assert billing.rate_snapshot()["enabled"] is False
+    # budgets disarm with billing: an armed guard must not gate submits
+    svc.configure_session("t0", cost_budget_usd_per_s=1e-12)
+    svc.submit("t0", *_batch(rng))
+    svc.drain()
+    assert svc.stats["budget_shed"] == 0 and svc.stats["budget_rejected"] == 0
+
+
+# ------------------------------------------------------------ tenant budgets
+def _trip_budget(svc, rng, name="hog"):
+    """Arm a floor-level budget and charge it with one served submit."""
+    svc.configure_session(name, cost_budget_usd_per_s=1e-9)
+    svc.submit(name, *_batch(rng))
+    svc.drain()  # retires -> charges the guard with >= 1 microdollar
+
+
+def test_budget_trip_sheds_own_submits_then_recovers():
+    rng = np.random.RandomState(5)
+    svc = _service(admission="shed-oldest")
+    _trip_budget(svc, rng)
+    with telemetry.instrument() as session:
+        assert svc.submit("hog", *_batch(rng)) is None  # shed at the gate
+        svc.submit("quiet", *_batch(rng))  # other tenants stay admitted
+        svc.drain()
+    degrades = session.spans(name="degrade", kind="admission")
+    assert len(degrades) == 1  # one span per victim, the wave stays clean
+    assert degrades[0].attrs["cause"] == "cost-budget"
+    assert degrades[0].attrs["session"] == "hog"
+    assert degrades[0].attrs["spend_usd_per_s"] > degrades[0].attrs["budget_usd_per_s"]
+    assert svc.stats["budget_shed"] == 1
+    assert svc.slo_snapshot()["sessions"]["hog"]["shed"] == 1
+    assert svc.slo_snapshot()["sessions"]["quiet"]["served"] == 1
+
+    budgets = svc.health()["cost"]["budgets"]
+    assert budgets["hog"]["over_budget"] is True
+    assert budgets["hog"]["trips"] >= 1
+    assert budgets["hog"]["spend_usd_per_s"] > budgets["hog"]["budget_usd_per_s"]
+
+    # breaker-style recovery is clockwork: charges age out of the window
+    time.sleep(0.3)
+    assert svc.health()["cost"]["budgets"]["hog"]["over_budget"] is False
+    svc.submit("hog", *_batch(rng))
+    svc.drain()
+    assert svc.slo_snapshot()["sessions"]["hog"]["served"] == 2
+
+
+def test_budget_reject_policy_raises_and_block_maps_to_reject():
+    rng = np.random.RandomState(6)
+    for policy in ("reject", "block"):  # waiting cannot free budget
+        svc = _service(admission=policy)
+        _trip_budget(svc, rng)
+        with pytest.raises(CostBudgetExceededError, match="cost budget"):
+            svc.submit("hog", *_batch(rng))
+        assert svc.stats["budget_rejected"] == 1
+        assert svc.slo_snapshot()["sessions"]["hog"]["rejected"] == 1
+
+
+def test_budget_shed_rejects_value_ticket():
+    rng = np.random.RandomState(7)
+    svc = _service(admission="shed-oldest")
+    _trip_budget(svc, rng)
+    ticket = svc.submit("hog", *_batch(rng), return_value=True)
+    assert ticket is not None
+    with pytest.raises(CostBudgetExceededError):
+        ticket.result(timeout=1.0)
+
+
+def test_budget_configuration_validation():
+    svc = _service()
+    with pytest.raises(ValueError, match="positive"):
+        svc.configure_session("t", cost_budget_usd_per_s=0)
+    svc.configure_session("t", cost_budget_usd_per_s=2.5)
+    assert svc.session_config("t")["cost_budget_usd_per_s"] == 2.5
+    svc.configure_session("t", cost_budget_usd_per_s=None)  # disarm
+    assert svc.session_config("t")["cost_budget_usd_per_s"] is None
+    assert "t" not in svc.health()["cost"]["budgets"]
+
+
+# --------------------------------------------------------- background scrub
+def test_scrub_worker_runs_reports_and_joins(tmp_path):
+    rng = np.random.RandomState(8)
+    svc = _service(
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        journal_dir=str(tmp_path / "wal"),
+        scrub_interval_s=0.05,
+    )
+    svc.submit("t", *_batch(rng))
+    svc.drain()
+    svc.checkpoint()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        hist = svc.telemetry_snapshot()["history"]
+        if hist["runs"] >= 2 and hist["last"] is not None:
+            break
+        time.sleep(0.02)
+    hist = svc.telemetry_snapshot()["history"]
+    assert hist["runs"] >= 2
+    assert hist["errors"] == 0
+    assert hist["last"]["checked"] >= 1
+    assert hist["last"]["quarantined"] == []
+    svc.shutdown()
+    assert svc._scrub_thread is None  # joined and cleared
+    runs_after = svc.telemetry_snapshot()["history"]["runs"]
+    time.sleep(0.12)  # a joined worker never ticks again
+    assert svc.telemetry_snapshot()["history"]["runs"] == runs_after
+
+
+def test_scrub_worker_off_by_default():
+    svc = _service()
+    assert svc.telemetry_snapshot()["history"] == {
+        "runs": 0, "errors": 0, "last": None
+    }
+    assert svc._scrub_thread is None
+
+
+# ------------------------------------------------------- fleet aggregation
+def test_sharded_capacity_service_sums_cost_losslessly():
+    rng = np.random.RandomState(9)
+    svc = _service(shard_capacity=2)
+    for i in range(8):
+        svc.submit(f"t{i}", *_batch(rng))
+    svc.drain()
+    child_micro = sum(s.stats["cost_microusd"] for s in svc.shards)
+    assert svc.stats["cost_microusd"] == child_micro >= 2  # >= 1 per shard launch
+    assert svc.stats["billed_requests"] == 8
+
+
+def test_fleet_snapshot_carries_dollar_rollup():
+    from metrics_tpu.fabric import ShardedMetricsService
+
+    rng = np.random.RandomState(10)
+    fab = ShardedMetricsService(
+        Accuracy(task="multiclass", num_classes=8), num_shards=2
+    )
+    for i in range(8):
+        fab.submit(f"t{i}", *_batch(rng))
+    fab.drain()
+    cost = fab.fleet_snapshot()["cost"]
+    assert cost["billed_requests"] == 8
+    assert cost["cost_microusd"] >= 1
+    assert cost["cost_usd"] == billing.usd(cost["cost_microusd"])
+    assert cost["usd_per_million_updates"] == round(
+        cost["cost_microusd"] / cost["billed_requests"], 4
+    )
+    assert cost["rate_key"] in billing.DEVICE_RATES
+
+
+# ------------------------------------------------------ trace_report compat
+def _trace_report():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report",
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "tools", "trace_report.py"
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_report_precost_fixture_replays_cleanly(tmp_path):
+    """Regression fixture: a JSONL trace recorded BEFORE dollar
+    attribution existed (request spans with stage timings, launch spans
+    with roofline attrs, no cost anywhere) must replay through
+    trace_report with the cost section marked unavailable — never a
+    KeyError, never invented zeros."""
+    tr = _trace_report()
+    precost = [
+        {"name": "request", "owner": "MetricsService[Accuracy]",
+         "kind": "served", "ts_us": 10.0, "dur_us": 120.0, "tid": 1,
+         "attrs": {"rid": 1, "session": "t0", "queue_us": 5.0,
+                   "journal_us": 0.0, "launch_us": 80.0, "retire_us": 2.0}},
+        {"name": "request", "owner": "MetricsService[Accuracy]",
+         "kind": "served", "ts_us": 11.0, "dur_us": 130.0, "tid": 1,
+         "attrs": {"rid": 2, "session": "t1", "queue_us": 6.0,
+                   "journal_us": 0.0, "launch_us": 81.0, "retire_us": 2.0}},
+        {"name": "update", "owner": "MetricsService[Accuracy]",
+         "kind": "stacked-aot", "ts_us": 20.0, "dur_us": 90.0, "tid": 1,
+         "attrs": {"sessions": 2, "flops": 100.0}},
+    ]
+    path = tmp_path / "precost.jsonl"
+    path.write_text("\n".join(json.dumps(e) for e in precost) + "\n")
+    report = tr.summarize(tr.load_events(str(path)))
+    assert "cost attribution: unavailable" in report
+    assert "re-record with METRICS_TPU_BILLING" in report
+    assert "requests: " in report  # the rest of the report still renders
+
+
+def test_trace_report_costed_trace_reports_conservation(tmp_path):
+    rng = np.random.RandomState(11)
+    svc = _service()
+    with telemetry.instrument() as session:
+        for i in range(6):
+            svc.submit(f"t{i % 3}", *_batch(rng))
+        svc.drain()
+    path = str(tmp_path / "costed.jsonl")
+    session.export_jsonl(path)
+    tr = _trace_report()
+    report = tr.summarize(tr.load_events(path))
+    assert "conserved exactly" in report
+    assert "$/M-updates" in report
+    assert "nominal on-demand list prices" in report

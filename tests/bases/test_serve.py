@@ -344,11 +344,14 @@ def test_telemetry_snapshot_shape():
     assert set(snap) == {
         "owner", "serve", "sessions", "capacity", "resilience",
         "aot_cache", "wal", "memory", "health", "shard", "epoch",
+        "history",
     }
     assert snap["shard"] is None and snap["epoch"] == 0  # single-host posture
     assert snap["memory"]["total_bytes"] > 0
     assert snap["health"]["sessions"] == 1
     assert snap["wal"] is None  # no journal_dir configured
+    # scrubber off by default: zeroed stats, no worker thread
+    assert snap["history"] == {"runs": 0, "errors": 0, "last": None}
 
 
 def test_submit_after_close_names_the_session():

@@ -263,6 +263,16 @@ def run_inproc(args: argparse.Namespace) -> Dict[str, Any]:
     )
     p99 = durs[min(len(durs) - 1, int(round(0.99 * (len(durs) - 1))))] if durs else 0.0
     report["p99_ms_2x_overload"] = round(p99 / 1e3, 3)
+    # dollar attribution at overload: the integer-microdollar deltas over
+    # the overload window render to $ and $/M-updates (microdollars per
+    # billed update IS dollars per million updates); zeros with
+    # METRICS_TPU_BILLING=0
+    cost_micro = _overload_delta("cost_microusd")
+    billed = _overload_delta("billed_requests")
+    report["cost_usd_2x_overload"] = round(cost_micro / 1e6, 6)
+    report["usd_per_million_updates"] = (
+        round(cost_micro / billed, 4) if billed else 0.0
+    )
     report["max_queue_depth_sampled"] = max_depth
     report["queue_bound"] = None if elastic else args.max_queue
     report["failover_events"] = snap["failover_events"]

@@ -188,6 +188,24 @@ SCHEDULE: Tuple[Tuple[str, str, Dict[str, Any], Tuple[str, ...], Tuple[str, ...]
         (),
     ),
     (
+        "cost",
+        "_cfg_cost_attribution",
+        {"sessions": 16, "reps": 2, "loops": 3},
+        (
+            # all structural on CPU: conservation is exact by construction
+            # (largest-remainder apportionment over integer microdollars),
+            # every stacked launch must carry a cost attr, the rate table
+            # must resolve, the kill switch must leak zero attrs, and the
+            # microdollar quantization floor fixes cost-per-launch at 1.0
+            "cost_conservation_exact",
+            "cost_launch_spans_costed",
+            "cost_rate_resolved",
+            "cost_kill_switch_leaked_attrs",
+            "cost_microusd_per_launch",
+        ),
+        ("cost_idle_overhead_ratio",),
+    ),
+    (
         "read_path",
         "_cfg_read_path",
         {"sessions": 16, "reps": 3},
@@ -223,7 +241,10 @@ SCHEDULE: Tuple[Tuple[str, str, Dict[str, Any], Tuple[str, ...], Tuple[str, ...]
 # already a ratio of two same-box measurements, so its band IS the pin
 # the bench-config test enforces (0 < ratio < 2.0).
 DEFAULT_BAND = 5.0
-BAND_OVERRIDES: Dict[str, float] = {"telemetry_idle_overhead_ratio": 2.0}
+BAND_OVERRIDES: Dict[str, float] = {
+    "telemetry_idle_overhead_ratio": 2.0,
+    "cost_idle_overhead_ratio": 2.0,
+}
 
 
 def collect(only: Optional[Iterable[str]] = None) -> Dict[str, Any]:

@@ -340,6 +340,73 @@ def summarize(events: List[Dict[str, Any]]) -> str:
                 f"   p99 {_percentile(vals, 99):>10.1f} us"
             )
 
+    # dollar attribution (metrics_tpu.analysis.billing): launch spans carry
+    # their modeled cost in integer microdollars, request spans the shares
+    # apportioned back by masked-row count — the two sums must agree
+    # exactly (the conservation pin). Tenants and owners rank by $;
+    # $/M-updates is microdollars-per-update read off the same integers.
+    # A pre-cost trace (request spans but no cost attrs anywhere) reports
+    # the section as unavailable instead of inventing zeros.
+    req_cost = [e for e in requests if "cost_microusd" in (e.get("attrs") or {})]
+    launch_cost = [
+        e for e in events
+        if e["name"] != "request" and "cost_microusd" in (e.get("attrs") or {})
+    ]
+    if req_cost or launch_cost:
+        total_req = sum(int((e.get("attrs") or {}).get("cost_microusd", 0)) for e in req_cost)
+        total_launch = sum(int((e.get("attrs") or {}).get("cost_microusd", 0)) for e in launch_cost)
+        conserved = (
+            "conserved exactly" if total_req == total_launch
+            else f"DRIFT: requests {total_req} != launches {total_launch} microusd"
+        )
+        lines.append("")
+        lines.append(
+            f"cost: ${total_launch / 1e6:.6f} over {len(launch_cost)} costed launches"
+            f"   request-share sum: ${total_req / 1e6:.6f}   ({conserved})"
+        )
+        lines.append(
+            "  rates are nominal on-demand list prices (analysis.billing."
+            "DEVICE_RATES) — comparison denominators, not a bill"
+        )
+        by_tenant: Dict[str, List[int]] = {}
+        for e in req_cost:
+            a = e.get("attrs") or {}
+            t = by_tenant.setdefault(str(a.get("session", "?")), [0, 0])
+            t[0] += int(a.get("cost_microusd", 0))
+            if e.get("kind") in ("served", "fallback"):
+                t[1] += 1
+        if by_tenant:
+            lines.append(f"  {'tenant':<28}{'$':>12}{'updates':>9}{'$/M-updates':>13}")
+            ranked = sorted(by_tenant.items(), key=lambda kv: (-kv[1][0], kv[0]))
+            for tenant, (micro, updates) in ranked[:12]:
+                per_m = (micro / updates) if updates else 0.0
+                lines.append(
+                    f"  {tenant:<28}{micro / 1e6:>12.6f}{updates:>9}{per_m:>13.4f}"
+                )
+            if len(ranked) > 12:
+                lines.append(f"  ... {len(ranked) - 12} more tenants")
+        by_owner_cost: Dict[str, List[float]] = {}
+        for e in launch_cost:
+            a = e.get("attrs") or {}
+            key = f"{e.get('owner', '?')}:{e.get('kind', '?')}"
+            o = by_owner_cost.setdefault(key, [0, 0, 0.0])
+            o[0] += int(a.get("cost_microusd", 0))
+            o[1] += 1
+            o[2] += float(a.get("modeled_device_s", 0.0))
+        if by_owner_cost:
+            lines.append(f"  {'config':<36}{'$':>12}{'launches':>9}{'modeled s':>12}")
+            for key, (micro, n, dev_s) in sorted(
+                by_owner_cost.items(), key=lambda kv: (-kv[1][0], kv[0])
+            ):
+                lines.append(f"  {key:<36}{micro / 1e6:>12.6f}{n:>9}{dev_s:>12.6f}")
+    elif requests:
+        lines.append("")
+        lines.append(
+            "cost attribution: unavailable (pre-cost trace — no span carries "
+            "cost_usd/modeled_device_s; re-record with METRICS_TPU_BILLING "
+            "enabled for the dollar section)"
+        )
+
     # memory gauges (serve flight recorder): the latest per-flush sample of
     # stacked-state bytes, with the largest leaves — the sharding input
     mem_gauges = [
